@@ -1,0 +1,27 @@
+"""partisan_tpu — a TPU-native rebuild of Partisan's capabilities.
+
+The reference (Partisan, /root/reference) is a BEAM membership and
+distribution layer: pluggable overlay topologies, multi-channel TCP,
+Plumtree epidemic broadcast, causal delivery, and a deterministic
+trace/replay + fault-injection test plane (reference README.md:11-96).
+
+This package re-designs those capabilities TPU-first: the entire cluster
+lives as sharded tensors (adjacency, bounded message queues, vector-clock
+matrices), gossip rounds step as batched sparse exchanges under
+``jax.jit``/``shard_map``, and per-node protocol state machines run
+vectorized under ``jax.vmap``. See SURVEY.md for the full layer map.
+
+Public API (mirrors the facade in reference src/partisan.erl and
+src/partisan_peer_service.erl):
+
+- :mod:`partisan_tpu.config` — configuration (partisan_config.erl)
+- :mod:`partisan_tpu.cluster` — cluster construction + round stepping
+- :mod:`partisan_tpu.managers` — peer-service managers (overlays)
+- :mod:`partisan_tpu.broadcast` — plumtree / causality / ack backends
+- :mod:`partisan_tpu.models` — protocol workload corpus (protocols/*.erl)
+- :mod:`partisan_tpu.faults` — interposition + fault injection
+- :mod:`partisan_tpu.trace` — trace record / deterministic replay
+"""
+
+from partisan_tpu.config import Config, ChannelSpec  # noqa: F401
+from partisan_tpu.version import __version__  # noqa: F401

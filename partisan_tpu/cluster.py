@@ -1,0 +1,155 @@
+"""The cluster engine: state pytree + the jitted round step.
+
+One ``round(state) -> state`` is the whole cluster advancing ``round_ms``
+of virtual time (SURVEY.md §7 architecture stance):
+
+  1. derive per-node round keys (deterministic, placement-invariant),
+  2. manager transition  — timers, handle_message over the inbox,
+     membership gossip (vectorized over nodes),
+  3. model transition    — the protocol workload, given the overlay,
+  4. interposition       — fault masks over emitted event messages
+     (the reference's interposition-fun injection point),
+  5. exchange            — route events into next round's inboxes;
+     crashed receivers drop their deliveries,
+  6. stats accumulation.
+
+Everything is statically shaped; ``Cluster.steps(state, k)`` runs k rounds
+under one ``lax.scan`` so long simulations are a single XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import managers as managers_mod
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import exchange, rng
+
+_MSG_FILTER_TAG = 11
+
+
+class Stats(NamedTuple):
+    """Cumulative counters (the telemetry-events analogue, SURVEY.md §5.5)."""
+
+    emitted: Array    # int32 — event messages emitted (pre-fault)
+    delivered: Array  # int32 — event messages delivered into inboxes
+    dropped: Array    # int32 — overflow + fault + dead-receiver drops
+
+
+class ClusterState(NamedTuple):
+    rnd: Array              # int32 scalar — round counter (virtual time)
+    faults: faults_mod.FaultState
+    inbox: exchange.Inbox   # deliveries awaiting consumption this round
+    manager: Any            # manager-specific pytree
+    model: Any              # model-specific pytree (or () if no model)
+    stats: Stats
+
+
+@dataclasses.dataclass
+class Cluster:
+    """Builds and runs the jitted round step for one configuration.
+
+    ``manager``/``model`` are static (they specialize the trace); state
+    lives in the ClusterState pytree.
+    """
+
+    cfg: Config
+    manager: Any = None
+    model: Any = None
+
+    def __post_init__(self) -> None:
+        if self.manager is None:
+            self.manager = managers_mod.get(self.cfg.peer_service_manager)
+        self.comm = LocalComm(
+            n_global=self.cfg.n_nodes,
+            inbox_cap=self.cfg.inbox_cap,
+            msg_words=self.cfg.msg_words,
+        )
+        self._step = jax.jit(self._round)
+        self._steps = jax.jit(self._scan, static_argnums=1)
+
+    # ---- state construction ------------------------------------------
+    def init(self) -> ClusterState:
+        cfg, comm = self.cfg, self.comm
+        return ClusterState(
+            rnd=jnp.int32(0),
+            faults=faults_mod.none(cfg.n_nodes),
+            inbox=exchange.empty_inbox(comm.n_local, cfg.inbox_cap, cfg.msg_words),
+            manager=self.manager.init(cfg, comm),
+            model=self.model.init(cfg, comm) if self.model is not None else (),
+            stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        )
+
+    # ---- the round ----------------------------------------------------
+    def _round(self, state: ClusterState) -> ClusterState:
+        cfg, comm = self.cfg, self.comm
+        gids = comm.local_ids()
+        keys = rng.node_keys(cfg.seed, state.rnd, gids)
+        alive_local = state.faults.alive  # LocalComm: local == global
+        ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
+                       inbox=state.inbox, faults=state.faults)
+
+        mstate, m_emit = self.manager.step(cfg, comm, state.manager, ctx)
+
+        if self.model is not None:
+            nbrs = self.manager.neighbors(cfg, mstate, comm)
+            dstate, a_emit = self.model.step(cfg, comm, state.model, ctx, nbrs)
+            emitted = jnp.concatenate([m_emit, a_emit], axis=1)
+        else:
+            dstate, emitted = (), m_emit
+
+        n_emitted = jnp.sum(emitted[..., 0] != 0, dtype=jnp.int32)
+
+        # Interposition point: fault masks between emit and deliver.
+        fkey = rng.subkey(rng.round_key(cfg.seed, state.rnd), _MSG_FILTER_TAG)
+        emitted = faults_mod.filter_msgs(state.faults, emitted, fkey)
+
+        inbox = comm.route(emitted)
+        # Crash-stopped receivers drop everything addressed to them.
+        dead = ~alive_local
+        inbox = exchange.Inbox(
+            data=jnp.where(dead[:, None, None], 0, inbox.data),
+            count=jnp.where(dead, 0, inbox.count),
+            drops=inbox.drops + jnp.where(dead, inbox.count, 0),
+        )
+
+        delivered = jnp.sum(inbox.count, dtype=jnp.int32)
+        stats = Stats(
+            emitted=state.stats.emitted + n_emitted,
+            delivered=state.stats.delivered + delivered,
+            dropped=state.stats.dropped + (n_emitted - delivered),
+        )
+        return ClusterState(rnd=state.rnd + 1, faults=state.faults,
+                            inbox=inbox, manager=mstate, model=dstate,
+                            stats=stats)
+
+    def _scan(self, state: ClusterState, k: int) -> ClusterState:
+        return jax.lax.scan(
+            lambda s, _: (self._round(s), None), state, None, length=k
+        )[0]
+
+    # ---- public API ---------------------------------------------------
+    def step(self, state: ClusterState) -> ClusterState:
+        return self._step(state)
+
+    def steps(self, state: ClusterState, k: int) -> ClusterState:
+        """Run k rounds as one XLA program (lax.scan)."""
+        return self._steps(state, k)
+
+    def run_until(self, state: ClusterState, pred, max_rounds: int,
+                  check_every: int = 1) -> tuple[ClusterState, int]:
+        """Step until host-side ``pred(state)`` is True. Returns (state,
+        rounds_taken) or (state, -1) if the bound was hit."""
+        for _ in range(0, max_rounds, check_every):
+            if pred(state):
+                return state, int(state.rnd)
+            state = self.steps(state, check_every)
+        return (state, int(state.rnd)) if pred(state) else (state, -1)

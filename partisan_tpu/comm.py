@@ -1,0 +1,59 @@
+"""Communication substrate: how a round's traffic actually moves.
+
+The reference's transport stack (L0/L1: sockets, framing, connection
+registry — SURVEY.md §2 "Connection layer") is replaced by two batched
+primitives that managers/models program against:
+
+- ``route(emitted)``  — event-message delivery into per-node inboxes
+- ``push_max(rows, dst)`` / ``push_or`` — monotonic state-gossip merge
+
+``LocalComm`` runs them on one device.  ``ShardComm`` (parallel/sharded.py)
+runs the same interface inside ``shard_map`` over a device mesh: emissions
+are all-gathered over ICI and each shard routes/merges only its own node
+range — the TPU-native replacement for the reference's TCP fan-out.
+Protocol code is identical under both, which is the analogue of the
+reference's manager-behaviour portability across transports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.ops import exchange, gossip
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalComm:
+    """Single-device communication: all nodes live on one shard."""
+
+    n_global: int
+    inbox_cap: int
+    msg_words: int
+
+    # Shard geometry (trivial here; ShardComm overrides).
+    @property
+    def n_local(self) -> int:
+        return self.n_global
+
+    @property
+    def node_offset(self) -> int:
+        return 0
+
+    def local_ids(self) -> Array:
+        """Global ids of the nodes this shard owns."""
+        return jnp.arange(self.n_global, dtype=jnp.int32)
+
+    def route(self, emitted: Array) -> exchange.Inbox:
+        """Deliver int32[n_local, E, W] emissions -> local Inbox."""
+        return exchange.route(emitted, self.n_global, self.inbox_cap)
+
+    def push_max(self, rows: Array, dst: Array) -> Array:
+        """Scatter-max rows along edges; returns merged rows for local nodes
+        (zeros where nothing arrived)."""
+        return gossip.push_max(rows, dst, n_out=self.n_global)
+
+    def push_or(self, rows: Array, dst: Array) -> Array:
+        return self.push_max(rows.astype(jnp.uint8), dst).astype(jnp.bool_)

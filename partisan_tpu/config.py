@@ -1,0 +1,209 @@
+"""Configuration system.
+
+Mirrors the reference's ``partisan_config`` (src/partisan_config.erl:563-690
+defaults list): a single validated, immutable configuration read once at
+startup.  The reference stores config in ``persistent_term`` for lock-free
+reads (partisan_config.erl:757-765); the TPU-native equivalent is a frozen
+dataclass whose fields are Python statics — they specialize the jitted round
+step at trace time, so "config reads" cost nothing at run time.
+
+Timers: the reference schedules wall-clock timers (gossip 10s, connection
+retry 1s, retransmit 1s, plumtree lazy tick 1s, AAE exchange 10s —
+include/partisan.hrl:139,280-281).  The simulator is round-based; a round
+represents ``round_ms`` of virtual time and each cadence is expressed in
+rounds via :meth:`Config.rounds`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+# Reserved channel names (include/partisan.hrl:120-121, :259-266).
+DEFAULT_CHANNEL = "default"
+MEMBERSHIP_CHANNEL = "partisan_membership"
+RPC_CHANNEL = "rpc"
+BROADCAST_CHANNEL = "broadcast"
+
+
+@dataclasses.dataclass(frozen=True)
+class ChannelSpec:
+    """A named logical link.
+
+    Mirrors ``channel_opts()`` (reference src/partisan.erl:60 and channel
+    coercion in partisan_config.erl:82-101): per-channel ``parallelism``
+    (N independent lanes per edge), ``monotonic`` (load-shed stale state
+    when the lane is backed up — partisan_peer_socket.erl:108-129) and
+    ``compression`` (a wire concern; retained for config parity, a no-op
+    in the tensor transport).
+    """
+
+    name: str = DEFAULT_CHANNEL
+    parallelism: int = 1
+    monotonic: bool = False
+    compression: bool = False
+
+
+DEFAULT_CHANNELS = (
+    ChannelSpec(DEFAULT_CHANNEL),
+    ChannelSpec(MEMBERSHIP_CHANNEL, monotonic=True),
+    ChannelSpec(RPC_CHANNEL),
+    ChannelSpec(BROADCAST_CHANNEL),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class HyParViewConfig:
+    """HyParView protocol parameters (include/partisan.hrl:204-217)."""
+
+    active_max: int = 6
+    active_min: int = 3
+    passive_max: int = 30
+    arwl: int = 6          # active random-walk length (forward_join TTL)
+    prwl: int = 6          # passive random-walk length
+    shuffle_interval_ms: int = 10_000
+    shuffle_k_active: int = 3
+    shuffle_k_passive: int = 4
+    random_promotion_interval_ms: int = 5_000
+
+
+@dataclasses.dataclass(frozen=True)
+class ScampConfig:
+    """SCAMP parameters (include/partisan.hrl:240-241)."""
+
+    c: int = 5                    # extra subscription copies on join
+    message_window: int = 10      # missed-ping isolation window (v2)
+    partial_max: int = 64         # capacity of partial (out) view arrays
+    in_max: int = 64              # capacity of in-view arrays (v2)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Cluster-simulation configuration.
+
+    Key names follow partisan_config.erl's defaults (:563-690) where a
+    counterpart exists; tensor-capacity knobs (inbox_cap, emit_cap,
+    msg_words, ...) are new — they bound the static shapes of the
+    message-queue tensors, replacing the reference's unbounded Erlang
+    mailboxes.
+    """
+
+    # --- cluster shape -------------------------------------------------
+    n_nodes: int = 16
+    name: str = "partisan"
+
+    # --- manager / strategy selection (partisan_config.erl:624, :637) --
+    peer_service_manager: str = "fullmesh"     # fullmesh|hyparview|scamp_v1|scamp_v2|client_server|static
+    membership_strategy: str = "full"          # full|scamp_v1|scamp_v2
+
+    # --- virtual time --------------------------------------------------
+    round_ms: int = 1_000
+
+    # --- cadences (include/partisan.hrl:139,280-281) -------------------
+    periodic_interval_ms: int = 10_000   # membership gossip
+    connection_interval_ms: int = 1_000  # reconnect attempts
+    retransmit_interval_ms: int = 1_000  # un-acked resend
+    lazy_tick_ms: int = 1_000            # plumtree i_have flush
+    exchange_tick_ms: int = 10_000       # plumtree AAE
+    distance_interval_ms: int = 10_000   # ping/pong RTT probing
+
+    # --- delivery semantics knobs --------------------------------------
+    relay_ttl: int = 5                   # include/partisan.hrl:138
+    broadcast: bool = True               # transitive tree relay enabled
+    causal_labels: tuple[str, ...] = ()  # one causality lane per label
+
+    # --- channels ------------------------------------------------------
+    channels: tuple[ChannelSpec, ...] = DEFAULT_CHANNELS
+
+    # --- overlay parameter blocks --------------------------------------
+    hyparview: HyParViewConfig = HyParViewConfig()
+    scamp: ScampConfig = ScampConfig()
+
+    # --- tensor capacities (sim-specific) ------------------------------
+    inbox_cap: int = 32          # queued event messages per node per round
+    emit_cap: int = 16           # event messages a node may emit per round
+    msg_words: int = 12          # int32 words per message record
+    max_broadcasts: int = 64     # concurrent broadcast slots (plumtree/anti-entropy)
+    n_actors: int = 64           # vclock width for causal delivery
+    seed: int = 0                # deterministic seeding (partisan_config:seed/0)
+
+    # --- test plane ----------------------------------------------------
+    replaying: bool = False
+    shrinking: bool = False
+    tracing: bool = False
+
+    def __post_init__(self) -> None:
+        if self.n_nodes < 1:
+            raise ValueError(f"n_nodes must be >= 1, got {self.n_nodes}")
+        names = [c.name for c in self.channels]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate channel names: {names}")
+        if DEFAULT_CHANNEL not in names:
+            raise ValueError("channels must include the default channel")
+        for c in self.channels:
+            if c.parallelism < 1:
+                raise ValueError(f"channel {c.name}: parallelism must be >= 1")
+        if self.msg_words < 8:
+            raise ValueError("msg_words must be >= 8 (header is 8 words)")
+
+    # --- channel helpers (partisan_config:channels/0, :82-101) ---------
+    @property
+    def n_channels(self) -> int:
+        return len(self.channels)
+
+    def channel_id(self, name: str) -> int:
+        for i, c in enumerate(self.channels):
+            if c.name == name:
+                return i
+        raise KeyError(f"unknown channel {name!r}; have {[c.name for c in self.channels]}")
+
+    def channel(self, name: str) -> ChannelSpec:
+        return self.channels[self.channel_id(name)]
+
+    # --- virtual-time helpers -----------------------------------------
+    def rounds(self, interval_ms: int) -> int:
+        """Convert a wall-clock cadence to a whole number of rounds (>=1)."""
+        return max(1, round(interval_ms / self.round_ms))
+
+    @property
+    def gossip_every(self) -> int:
+        return self.rounds(self.periodic_interval_ms)
+
+    @property
+    def retransmit_every(self) -> int:
+        return self.rounds(self.retransmit_interval_ms)
+
+    @property
+    def lazy_tick_every(self) -> int:
+        return self.rounds(self.lazy_tick_ms)
+
+    @property
+    def exchange_tick_every(self) -> int:
+        return self.rounds(self.exchange_tick_ms)
+
+    @property
+    def shuffle_every(self) -> int:
+        return self.rounds(self.hyparview.shuffle_interval_ms)
+
+    @property
+    def promotion_every(self) -> int:
+        return self.rounds(self.hyparview.random_promotion_interval_ms)
+
+    # --- construction helpers -----------------------------------------
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Config":
+        """Build from a flat mapping (the app-env analogue)."""
+        d = dict(d)
+        if "channels" in d and d["channels"] and not isinstance(d["channels"][0], ChannelSpec):
+            d["channels"] = tuple(
+                ChannelSpec(**c) if isinstance(c, Mapping) else ChannelSpec(str(c))
+                for c in d["channels"]
+            )
+        if "hyparview" in d and isinstance(d["hyparview"], Mapping):
+            d["hyparview"] = HyParViewConfig(**d["hyparview"])
+        if "scamp" in d and isinstance(d["scamp"], Mapping):
+            d["scamp"] = ScampConfig(**d["scamp"])
+        return cls(**d)

@@ -1,0 +1,108 @@
+"""Fault injection & interposition.
+
+The reference's test plane hooks every send with interposition funs that
+may drop, delay or rewrite messages
+(partisan_pluggable_peer_service_manager.erl:195-197, :58-130) and injects
+partitions at the manager level (inject_partition/resolve_partition,
+partisan_peer_service_manager.erl:163-166).  The TPU-native equivalents are
+masks applied between the emit and deliver phases of each round
+(SURVEY.md §5.3):
+
+- **crash-stop**  — bool[n] ``alive`` mask: dead nodes neither emit nor
+  merge nor receive (prop_partisan_crash_fault_model.erl crash faults),
+- **send/receive omission** — per-edge drops: iid probability and/or an
+  explicit severed-edge ``partition`` matrix (filibuster omission
+  schedules compile to these masks per round),
+- **delay** — messages re-queued for a later round (the ``$delay``
+  interposition, pluggable manager :1221-1237) — carried by the
+  scheduled-fault list below.
+
+Deterministic: all randomness keys off (seed, round), so a fault schedule
+replays exactly (the trace orchestrator's replay guarantee,
+partisan_trace_orchestrator.erl:197-240, is native here).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.ops import rng
+from partisan_tpu.types import W_DST, W_KIND, W_SRC
+
+
+class FaultState(NamedTuple):
+    """Dynamic fault state carried in ClusterState (all jit-updatable)."""
+
+    alive: Array          # bool[n_global] — False = crash-stopped
+    link_drop: Array      # float32 scalar — iid per-edge drop probability
+    partition: Array      # bool[n_global, n_global] — True = edge severed
+
+
+def none(n: int) -> FaultState:
+    return FaultState(
+        alive=jnp.ones((n,), jnp.bool_),
+        link_drop=jnp.float32(0.0),
+        partition=jnp.zeros((n, n), jnp.bool_),
+    )
+
+
+def edge_cut(faults: FaultState, src: Array, dst: Array, key: Array) -> Array:
+    """bool mask, True where the (src, dst) edge is cut this round.
+
+    src, dst: same-shape int32 global ids (dst may contain -1 = unused;
+    unused entries report uncut)."""
+    ok_dst = dst >= 0
+    d = jnp.where(ok_dst, dst, 0)
+    s = jnp.where(src >= 0, src, 0)
+    cut = faults.partition[s, d]
+    cut = cut | ~faults.alive[d] | ~faults.alive[s]
+    drop = jax.random.bernoulli(key, faults.link_drop, shape=dst.shape)
+    return ok_dst & (cut | drop)
+
+
+def filter_edges(faults: FaultState, src_gids: Array, dst: Array,
+                 key: Array) -> Array:
+    """Null out (-1) gossip edges hit by faults. dst: int32[n_local, K]."""
+    src = jnp.broadcast_to(src_gids[:, None], dst.shape)
+    return jnp.where(edge_cut(faults, src, dst, key), jnp.int32(-1), dst)
+
+
+def filter_msgs(faults: FaultState, emitted: Array, key: Array) -> Array:
+    """Apply crash + omission faults to event messages int32[n, E, W]
+    (kind := NONE where the edge is cut) — the central interposition
+    point between emit and deliver."""
+    src = emitted[..., W_SRC]
+    dst = jnp.where(emitted[..., W_KIND] != 0, emitted[..., W_DST], -1)
+    cut = edge_cut(faults, src, dst, key)
+    return emitted.at[..., W_KIND].set(
+        jnp.where(cut, 0, emitted[..., W_KIND])
+    )
+
+
+# --- scenario scripting (host-side, between jitted steps) ---------------
+
+def crash(faults: FaultState, node: int) -> FaultState:
+    return faults._replace(alive=faults.alive.at[node].set(False))
+
+
+def recover(faults: FaultState, node: int) -> FaultState:
+    return faults._replace(alive=faults.alive.at[node].set(True))
+
+
+def inject_partition(faults: FaultState, group_a, group_b) -> FaultState:
+    """Sever all edges between two node groups (inject_partition/2)."""
+    p = faults.partition
+    a = jnp.asarray(group_a)
+    b = jnp.asarray(group_b)
+    p = p.at[a[:, None], b[None, :]].set(True)
+    p = p.at[b[:, None], a[None, :]].set(True)
+    return faults._replace(partition=p)
+
+
+def resolve_partition(faults: FaultState) -> FaultState:
+    """Heal all partitions (resolve_partition/1)."""
+    return faults._replace(partition=jnp.zeros_like(faults.partition))

@@ -1,0 +1,34 @@
+"""Peer-service managers: overlay topologies as vectorized transition fns.
+
+Mirrors the reference behaviour ``partisan_peer_service_manager``
+(src/partisan_peer_service_manager.erl:93-170) and its four backends
+(SURVEY.md §2).  Each manager here is a stateless namespace of pure
+functions over a node-axis pytree; the cluster engine (cluster.py) wires
+one manager into the jitted round step.
+"""
+
+from partisan_tpu.managers.base import Manager, RoundCtx  # noqa: F401
+from partisan_tpu.managers import fullmesh  # noqa: F401
+
+
+def get(name: str) -> "Manager":
+    """Resolve Config.peer_service_manager -> manager implementation
+    (the ?PEER_SERVICE_MANAGER macro, include/partisan.hrl:141)."""
+    if name == "fullmesh":
+        return fullmesh.FullMesh()
+    if name == "hyparview":
+        from partisan_tpu.managers import hyparview
+        return hyparview.HyParView()
+    if name in ("scamp_v1", "scamp_v2"):
+        from partisan_tpu.managers import scamp
+        return scamp.Scamp(version=int(name[-1]))
+    if name == "client_server":
+        from partisan_tpu.managers import client_server
+        return client_server.ClientServer()
+    if name == "static":
+        from partisan_tpu.managers import static
+        return static.Static()
+    raise KeyError(
+        f"unknown peer_service_manager {name!r}: fullmesh|hyparview|"
+        f"scamp_v1|scamp_v2|client_server|static"
+    )

@@ -1,0 +1,68 @@
+"""Manager interface + per-round context.
+
+The reference behaviour contract (partisan_peer_service_manager.erl:93-170)
+is a set of callbacks on a gen_server; here it is a set of pure functions
+over node-axis arrays, run once per simulated round for ALL nodes at once:
+
+- ``init``       — boot state (one singleton cluster per node)
+- ``step``       — periodic timers + handle_message for every queued
+                   message + membership gossip, vectorized
+- ``neighbors``  — current overlay out-edges (who forward_message may
+                   reach directly); feeds models and broadcast layers
+- ``members``    — bool membership matrix (members/1 callback)
+- ``join/leave`` — scenario scripting (partisan_peer_service:join/leave)
+
+All per-node branching uses masks/lax primitives so the whole cluster
+steps in one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Protocol
+
+from jax import Array
+
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.ops.exchange import Inbox
+
+
+class RoundCtx(NamedTuple):
+    """Everything a transition function may read this round."""
+
+    rnd: Array    # int32 scalar — current round number
+    alive: Array  # bool[n_local] — crash mask for THIS shard's nodes
+    keys: Array   # PRNGKey[n_local] — per-node round keys (ops/rng.py)
+    inbox: Inbox  # last round's deliveries
+    faults: Any   # faults.FaultState (global) — for edge filtering
+
+
+class Manager(Protocol):
+    """One overlay topology. Implementations are immutable namespaces."""
+
+    def init(self, cfg: Config, comm: LocalComm) -> Any:
+        ...
+
+    def step(self, cfg: Config, comm: LocalComm, state: Any,
+             ctx: RoundCtx) -> tuple[Any, Array]:
+        """Advance one round. Returns (state', emitted int32[n_local, E, W])."""
+        ...
+
+    def neighbors(self, cfg: Config, state: Any,
+                  comm: LocalComm | None = None) -> Array:
+        """int32[n_local, K] global ids a node can send to directly (-1 pad).
+        ``comm`` supplies shard geometry (local->global id mapping); when
+        omitted, local index == global id (single-device)."""
+        ...
+
+    def members(self, cfg: Config, state: Any) -> Array:
+        """bool[n_local, n_global] — each node's view of the membership."""
+        ...
+
+    def join(self, cfg: Config, state: Any, node: int, target: int) -> Any:
+        """Scenario scripting: ``node`` joins the cluster via ``target``."""
+        ...
+
+    def leave(self, cfg: Config, state: Any, node: int) -> Any:
+        """Graceful leave of ``node`` (leave/0)."""
+        ...

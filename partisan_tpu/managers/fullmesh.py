@@ -1,0 +1,126 @@
+"""Full-mesh manager + full membership strategy.
+
+TPU rebuild of the reference default stack:
+``partisan_pluggable_peer_service_manager`` (full mesh, SURVEY.md §2) with
+``partisan_full_membership_strategy`` (OR-set membership, gossip to all
+peers every periodic tick — partisan_full_membership_strategy.erl:101-110).
+
+State is one OR-set view per node (ops/orset.py).  A periodic gossip tick
+pushes the node's whole view to every peer it believes is a member and
+merges by elementwise max — the reference's CRDT-merge-on-receive
+(full_membership_strategy.erl:131-163) batched into one scatter-max.
+
+Timer phasing: each node's periodic timer fires at
+``(round + node_id) % gossip_every == 0`` — staggered like the reference's
+independently-started wall-clock timers rather than lockstep.
+
+Join/leave mirror partisan_peer_service:join/leave: a joiner learns the
+target's spec (out-of-band node_spec, as in service discovery) and both
+sides converge via gossip; joins/leaves mark the node "urgent" so it
+gossips next round instead of waiting for its periodic tick (the
+reference gossips immediately on connect —
+partisan_pluggable_peer_service_manager.erl:1557-1570).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import orset, rng
+
+_GOSSIP_EDGE_TAG = 101  # rng stream tag for gossip-edge fault filtering
+
+
+class FullMeshState(NamedTuple):
+    view: Array    # uint32[n_local, 2, n_global] — OR-set views
+    urgent: Array  # bool[n_local] — gossip next round regardless of phase
+
+
+class FullMesh:
+    name = "fullmesh"
+
+    def init(self, cfg: Config, comm: LocalComm) -> FullMeshState:
+        gids = comm.local_ids()
+        add = (jnp.arange(comm.n_global)[None, :] == gids[:, None]).astype(orset.DTYPE)
+        rm = jnp.zeros_like(add)
+        return FullMeshState(
+            view=jnp.stack([add, rm], axis=1),
+            urgent=jnp.zeros((comm.n_local,), jnp.bool_),
+        )
+
+    def step(self, cfg: Config, comm: LocalComm, state: FullMeshState,
+             ctx: RoundCtx) -> tuple[FullMeshState, Array]:
+        n_local, _, n_global = state.view.shape
+        gids = comm.local_ids()
+
+        # Periodic gossip timer (partisan_full_membership_strategy.erl:101-110).
+        phase = gids % cfg.gossip_every
+        fires = ((ctx.rnd + phase) % cfg.gossip_every == 0) | state.urgent
+        fires = fires & ctx.alive
+
+        member = orset.members(state.view)                      # [n_local, n_global]
+        all_ids = jnp.arange(n_global, dtype=jnp.int32)
+        peer = member & (all_ids[None, :] != gids[:, None])
+        dst = jnp.where(fires[:, None] & peer, all_ids[None, :], jnp.int32(-1))
+
+        ekey = rng.subkey(rng.round_key(cfg.seed, ctx.rnd), _GOSSIP_EDGE_TAG)
+        dst = faults_mod.filter_edges(ctx.faults, gids, dst, ekey)
+
+        flat = state.view.reshape(n_local, 2 * n_global)
+        pushed = comm.push_max(flat, dst).reshape(n_local, 2, n_global)
+        merged = orset.merge(state.view, pushed)
+        # Crashed nodes are frozen (their gen_server is dead) — including
+        # their pending-urgent flag, which survives until they recover.
+        view = jnp.where(ctx.alive[:, None, None], merged, state.view)
+        urgent = jnp.where(ctx.alive, False, state.urgent)
+
+        emitted = jnp.zeros((n_local, 0, cfg.msg_words), jnp.int32)
+        return FullMeshState(view=view, urgent=urgent), emitted
+
+    # ---- views -------------------------------------------------------
+    def neighbors(self, cfg: Config, state: FullMeshState,
+                  comm: LocalComm | None = None) -> Array:
+        n_local, _, n_global = state.view.shape
+        gids = (comm.local_ids() if comm is not None
+                else jnp.arange(n_local, dtype=jnp.int32))
+        member = orset.members(state.view)
+        all_ids = jnp.arange(n_global, dtype=jnp.int32)
+        peer = member & (all_ids[None, :] != gids[:, None])
+        return jnp.where(peer, all_ids[None, :], jnp.int32(-1))
+
+    def members(self, cfg: Config, state: FullMeshState) -> Array:
+        return orset.members(state.view)
+
+    # ---- scenario scripting (host-side) ------------------------------
+    def join(self, cfg: Config, state: FullMeshState, node: int,
+             target: int) -> FullMeshState:
+        """``node`` joins via ``target`` (partisan_peer_service:join/1).
+        The joiner learns the target's current spec (incarnation) and
+        gossips urgently; the target learns the joiner when that gossip
+        lands (handle_info connected -> strategy join, pluggable :1537)."""
+        inc = jnp.maximum(state.view[target, 0, target], 1)
+        view = state.view.at[node].set(orset.add(state.view[node], target, inc))
+        return FullMeshState(view=view, urgent=state.urgent.at[node].set(True))
+
+    def leave(self, cfg: Config, state: FullMeshState, node: int) -> FullMeshState:
+        """Graceful leave: observed-remove own spec + urgent gossip
+        (full_membership_strategy.erl:171-210)."""
+        view = state.view.at[node].set(orset.remove(state.view[node], node))
+        return FullMeshState(view=view, urgent=state.urgent.at[node].set(True))
+
+    def rejoin(self, cfg: Config, state: FullMeshState, node: int,
+               target: int) -> FullMeshState:
+        """Rejoin after a leave: a fresh incarnation distinguishes the new
+        spec from the removed one (partisan_membership_set.erl:23-60
+        staleness semantics)."""
+        inc = state.view[node, 0, node] + 1
+        view = state.view.at[node].set(orset.add(state.view[node], node, inc))
+        st = FullMeshState(view=view, urgent=state.urgent)
+        return self.join(cfg, st, node, target)

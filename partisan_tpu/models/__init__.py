@@ -1,0 +1,6 @@
+"""Protocol workload corpus — the reference's ``protocols/`` directory
+(SURVEY.md §2 "Protocol corpus") rebuilt as vectorized models that run on
+top of any manager: anti-entropy, rumor mongering, direct mail, broadcast
+(plumtree-backed), primary-backup, 2PC/3PC."""
+
+from partisan_tpu.models.base import Model  # noqa: F401

@@ -1,0 +1,103 @@
+"""Demers anti-entropy broadcast (protocols/demers_anti_entropy.erl).
+
+Reference behavior (:118-196): every 2 s each node picks FANOUT=2 random
+members and pushes its whole message store; the receiver merges and replies
+with ITS store (push-pull), so stores converge epidemically.
+
+TPU mapping: the store is a seen-bitmap ``bool[n, max_broadcasts]`` riding
+the state-gossip lane.
+
+- push: firing nodes scatter-OR their store to their fanout targets,
+- pull: the same targets get an AE_PULL event message; owners answer it
+  next round by scatter-ORing their store back to each requester (one
+  virtual-time round of reply latency — within the 2 s timer cadence).
+
+Broadcast injection (`broadcast/2` in the reference) sets a store bit at
+the origin; convergence = every alive node's row contains the bit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import rng
+
+FANOUT = 2                 # demers_anti_entropy.erl:42 ?FANOUT
+INTERVAL_MS = 2_000        # :118 anti-entropy timer
+OP_PULL = 1                # APP payload[0] opcode
+
+_FANOUT_TAG = 201
+_PUSH_EDGE_TAG = 202
+_PULL_EDGE_TAG = 203
+
+
+class AntiEntropyState(NamedTuple):
+    store: Array  # bool[n_local, max_broadcasts]
+
+
+class AntiEntropy:
+    name = "demers_anti_entropy"
+
+    def init(self, cfg: Config, comm: LocalComm) -> AntiEntropyState:
+        return AntiEntropyState(
+            store=jnp.zeros((comm.n_local, cfg.max_broadcasts), jnp.bool_)
+        )
+
+    def step(self, cfg: Config, comm: LocalComm, state: AntiEntropyState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[AntiEntropyState, Array]:
+        n_local = state.store.shape[0]
+        gids = comm.local_ids()
+        every = cfg.rounds(INTERVAL_MS)
+        fires = ((ctx.rnd + gids) % every == 0) & ctx.alive
+
+        # Pick FANOUT random neighbors (do_gossip, demers_anti_entropy.erl:176-189).
+        def pick(key, row, fire):
+            slots = rng.choice_slots(rng.subkey(key, _FANOUT_TAG), row >= 0, FANOUT)
+            ids = jnp.where(slots >= 0, row[slots], jnp.int32(-1))
+            return jnp.where(fire, ids, jnp.int32(-1))
+
+        targets = jax.vmap(pick)(ctx.keys, nbrs, fires)       # int32[n_local, FANOUT]
+
+        rkey = rng.round_key(cfg.seed, ctx.rnd)
+        push_dst = faults_mod.filter_edges(
+            ctx.faults, gids, targets, rng.subkey(rkey, _PUSH_EDGE_TAG))
+
+        # Pull replies for LAST round's AE_PULL requests (inbox).
+        in_msgs = ctx.inbox.data
+        is_pull = (in_msgs[:, :, T.W_KIND] == T.MsgKind.APP) & \
+                  (in_msgs[:, :, T.P0] == OP_PULL)
+        pull_dst = jnp.where(is_pull, in_msgs[:, :, T.W_SRC], jnp.int32(-1))
+        pull_dst = jnp.where(ctx.alive[:, None], pull_dst, jnp.int32(-1))
+        pull_dst = faults_mod.filter_edges(
+            ctx.faults, gids, pull_dst, rng.subkey(rkey, _PULL_EDGE_TAG))
+
+        dst = jnp.concatenate([push_dst, pull_dst], axis=1)
+        pushed = comm.push_or(state.store, dst)
+        store = state.store | (pushed & ctx.alive[:, None])
+
+        # Emit this round's pull requests (answered next round).
+        emitted = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], targets,
+            payload=(jnp.int32(OP_PULL),),
+        )
+        return AntiEntropyState(store=store), emitted
+
+    # ---- scenario helpers --------------------------------------------
+    def broadcast(self, state: AntiEntropyState, node: int, slot: int) -> AntiEntropyState:
+        """Inject a broadcast at ``node`` (demers_anti_entropy:broadcast/2)."""
+        return AntiEntropyState(store=state.store.at[node, slot].set(True))
+
+    def coverage(self, state: AntiEntropyState, alive: Array, slot: int) -> Array:
+        """Fraction of alive nodes that have received ``slot``."""
+        have = state.store[:, slot] & alive
+        return jnp.sum(have) / jnp.maximum(jnp.sum(alive), 1)

@@ -1,0 +1,26 @@
+"""Workload model interface.
+
+Each reference protocol (protocols/*.erl) is a gen_server per node using
+``partisan:forward_message`` + membership callbacks; here a model is a pure
+per-round transition over node-axis arrays, given the manager's current
+overlay ``nbrs`` (the members/neighbors callback analogue)."""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+from jax import Array
+
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+
+
+class Model(Protocol):
+    def init(self, cfg: Config, comm: LocalComm) -> Any:
+        ...
+
+    def step(self, cfg: Config, comm: LocalComm, state: Any, ctx: RoundCtx,
+             nbrs: Array) -> tuple[Any, Array]:
+        """Returns (state', emitted int32[n_local, E, W])."""
+        ...

@@ -1,0 +1,2 @@
+"""TPU-friendly primitive ops: vclocks, OR-set membership, message routing,
+state-gossip merges, per-node RNG discipline."""

@@ -1,0 +1,107 @@
+"""The per-round message exchange: route emitted messages into inboxes.
+
+This collapses the reference's entire hot send path — connection dispatch
+(partisan_peer_connections.erl:897-942), per-connection encode/send
+(partisan_peer_service_client.erl:173-196) and the server-side receive
+funnel (partisan_peer_service_server.erl:88-103) — into ONE batched,
+statically-shaped kernel per round:
+
+    emitted int32[n, emit_cap, W]  --route-->  Inbox(data int32[n, cap, W])
+
+Algorithm (all static shapes, jit/TPU friendly):
+  1. flatten to [n*emit_cap] messages; empty slots (kind==NONE) get a
+     sentinel destination ``n`` so they sort to the end,
+  2. stable-sort by destination — stability preserves per-sender emission
+     order, the tensor analogue of per-connection FIFO ordering,
+  3. per-destination counts via bincount, slot = rank within destination,
+  4. scatter rows into inbox slots; slots beyond ``cap`` fall out of bounds
+     and XLA's default scatter drop-semantics discards them — these are
+     counted as drops (the reference's TCP never silently drops except on
+     monotonic channels, so callers surface ``drops`` — SURVEY.md §7
+     "Hard parts": overflow accounting).
+
+The destination id in W_DST is a GLOBAL node id; the sharded wrapper in
+parallel/ all-gathers emissions and lets each shard route only its own
+node range (see parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.types import W_DST, W_KIND
+
+
+class Inbox(NamedTuple):
+    """One round's deliveries. data[i, s] is the s-th message for node i."""
+
+    data: Array   # int32[n, cap, W]; kind==NONE marks empty slots
+    count: Array  # int32[n] — valid slots per node
+    drops: Array  # int32[n] — messages dropped for this node (overflow)
+
+
+def empty_inbox(n: int, cap: int, msg_words: int) -> Inbox:
+    return Inbox(
+        data=jnp.zeros((n, cap, msg_words), jnp.int32),
+        count=jnp.zeros((n,), jnp.int32),
+        drops=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def route(emitted: Array, n: int, cap: int, *, node_offset: int | Array = 0) -> Inbox:
+    """Route ``emitted`` int32[m, E, W] (or [m*E, W]) into an n-node inbox.
+
+    ``node_offset``: the global id of local node 0 — destinations outside
+    [node_offset, node_offset+n) are ignored (used by the sharded exchange,
+    where each shard routes the globally-gathered emissions into its own
+    node range).
+    """
+    flat = emitted.reshape(-1, emitted.shape[-1])
+    kind = flat[:, W_KIND]
+    dst = flat[:, W_DST] - node_offset
+    # Empty slots and out-of-range destinations -> sentinel bucket n.
+    local = (kind != 0) & (dst >= 0) & (dst < n)
+    dst = jnp.where(local, dst, n)
+
+    order = jnp.argsort(dst, stable=True)
+    dst_sorted = dst[order]
+    msgs_sorted = flat[order]
+
+    counts = jnp.bincount(dst, length=n + 1)              # int32[n+1]
+    starts = jnp.cumsum(counts) - counts                  # first flat index per dst
+    slot = jnp.arange(dst.shape[0], dtype=jnp.int32) - starts[dst_sorted]
+
+    # Out-of-bounds (slot >= cap, or sentinel dst) => dropped by scatter.
+    row = jnp.where(dst_sorted < n, dst_sorted, n + cap)
+    data = jnp.zeros((n, cap, flat.shape[-1]), jnp.int32)
+    data = data.at[row, slot].set(msgs_sorted, mode="drop")
+
+    delivered = jnp.minimum(counts[:n], cap)
+    return Inbox(data=data, count=delivered, drops=counts[:n] - delivered)
+
+
+def merge_inboxes(a: Inbox, b: Inbox) -> Inbox:
+    """Append b's messages after a's (capacity permitting) — used to merge
+    locally-routed and remotely-routed traffic or delayed re-deliveries."""
+    n, cap, w = a.data.shape
+    both = jnp.concatenate(
+        [a.data, b.data], axis=1
+    )  # [n, 2cap, w] — a's slots first
+    # Re-route through the same compaction: positions keep relative order.
+    # Build per-node slot indices: valid slots of `a` then valid slots of `b`.
+    kind = both[:, :, W_KIND]
+    valid = kind != 0
+    slot = jnp.cumsum(valid, axis=1) - 1
+    slot = jnp.where(valid, slot, 2 * cap)  # invalid -> dropped
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, 2 * cap))
+    data = jnp.zeros_like(a.data).at[rows, slot].set(both, mode="drop")
+    total = a.count + b.count
+    delivered = jnp.minimum(total, cap)
+    return Inbox(
+        data=data,
+        count=delivered,
+        drops=a.drops + b.drops + total - delivered,
+    )

@@ -1,0 +1,77 @@
+"""State-gossip lane: dense per-node state merged along gossip edges.
+
+Large monotonic payloads in the reference — membership CRDTs re-gossiped to
+every peer (partisan_full_membership_strategy.erl:101-110), anti-entropy
+stores pushed to random peers (protocols/demers_anti_entropy.erl:118-196),
+vclock exchange — never ride the bounded event-message lane here.  Instead
+each is a dense matrix ``state: [n, D]`` whose rows merge by an idempotent,
+commutative, associative op (max / or) along this round's gossip edges:
+
+    new_state[j] = op(state[j], op over senders i->j of state[i])
+
+With per-sender fanout K the edges are ``dst: int32[n, K]`` (global ids,
+-1 = unused) and the merge is one scatter-max — the "gossip round as a
+batched sparse matmul" from the north star (BASELINE.json), in max-plus
+algebra.  Because the op is idempotent, redelivery and self-loops are free,
+which is exactly why the reference ships these payloads on *monotonic*
+channels that may shed stale sends (partisan_peer_socket.erl:108-129).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def push_max(state: Array, dst: Array, *, n_out: int | None = None,
+             node_offset: int | Array = 0, payload: Array | None = None) -> Array:
+    """Scatter-max rows of ``state`` (or ``payload``) onto destinations.
+
+    state:   [n_local, D] — sender rows (any unsigned/int/bool dtype)
+    dst:     int32[n_local, K] global destination ids, -1 for unused
+    payload: optional [n_local, D] to send instead of ``state`` itself
+    n_out:   rows of the output (defaults to n_local)
+    node_offset: global id of output row 0 (sharded case)
+
+    Returns [n_out, D]: the elementwise max of everything pushed at each
+    destination (zeros where nothing arrived).  Callers combine with the
+    receiver's own state, e.g. ``jnp.maximum(state, push_max(...))``.
+    """
+    src_rows = state if payload is None else payload
+    n_local, d = src_rows.shape
+    k = dst.shape[1]
+    n_out = n_local if n_out is None else n_out
+
+    flat_dst = dst.reshape(-1) - node_offset
+    ok = (dst.reshape(-1) >= 0) & (flat_dst >= 0) & (flat_dst < n_out)
+    flat_dst = jnp.where(ok, flat_dst, n_out)  # out of bounds -> dropped
+
+    rows = jnp.repeat(src_rows, k, axis=0)  # [n_local*K, D]
+    out = jnp.zeros((n_out, d), src_rows.dtype)
+    return out.at[flat_dst].max(rows, mode="drop")
+
+
+def push_or(state: Array, dst: Array, **kw) -> Array:
+    """Boolean OR variant (stores / seen-sets).  state: bool[n, D]."""
+    return push_max(state.astype(jnp.uint8), dst, **kw).astype(jnp.bool_)
+
+
+def pull_max(state: Array, src: Array) -> Array:
+    """Gather-max: merge the rows named by ``src`` int32[n, K] into each
+    receiver — the pull half of push-pull anti-entropy
+    (protocols/demers_anti_entropy.erl:162-196, the pull reply merge).
+
+    Single-device form (gathers arbitrary global rows).  The sharded
+    exchange instead models pull as a deferred push: PULL requests ride the
+    event lane and the owner pushes its state next round (same semantics,
+    one extra round of latency — calibrated out by the round→virtual-time
+    mapping).  state: [n, D]; returns [n, D] max over the K pulled rows.
+    """
+    n = state.shape[0]
+    idx = jnp.where((src >= 0) & (src < n), src, n)
+    padded = jnp.concatenate([state, jnp.zeros((1,) + state.shape[1:], state.dtype)])
+    return jnp.max(padded[idx], axis=1)
+
+
+def pull_or(state: Array, src: Array) -> Array:
+    return pull_max(state.astype(jnp.uint8), src).astype(jnp.bool_)

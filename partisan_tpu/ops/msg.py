@@ -1,0 +1,45 @@
+"""Building fixed-width message records (see types.py for the layout)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+
+
+def build(msg_words: int, kind: Array | int, src: Array, dst: Array, *,
+          channel: Array | int = 0, ttl: Array | int = 0,
+          clock: Array | int = 0, lane: Array | int = 0,
+          flags: Array | int = 0, payload: tuple = ()) -> Array:
+    """Build message records of shape broadcast(src, dst, ...) + [msg_words].
+
+    A record whose ``dst`` is negative is marked empty (kind NONE) so
+    callers can pass -1 destinations from unused sampling slots directly.
+    """
+    shape = jnp.broadcast_shapes(
+        jnp.shape(kind), jnp.shape(src), jnp.shape(dst),
+        jnp.shape(channel), jnp.shape(ttl), jnp.shape(clock),
+        jnp.shape(lane), jnp.shape(flags),
+        *(jnp.shape(p) for p in payload),
+    )
+    out = jnp.zeros(shape + (msg_words,), jnp.int32)
+    dst = jnp.broadcast_to(jnp.asarray(dst, jnp.int32), shape)
+    valid = dst >= 0
+    kind = jnp.where(valid, jnp.asarray(kind, jnp.int32), 0)
+    out = out.at[..., T.W_KIND].set(jnp.broadcast_to(kind, shape))
+    out = out.at[..., T.W_SRC].set(jnp.broadcast_to(jnp.asarray(src, jnp.int32), shape))
+    out = out.at[..., T.W_DST].set(jnp.where(valid, dst, 0))
+    out = out.at[..., T.W_CHANNEL].set(jnp.broadcast_to(jnp.asarray(channel, jnp.int32), shape))
+    out = out.at[..., T.W_TTL].set(jnp.broadcast_to(jnp.asarray(ttl, jnp.int32), shape))
+    out = out.at[..., T.W_CLOCK].set(jnp.broadcast_to(jnp.asarray(clock, jnp.int32), shape))
+    out = out.at[..., T.W_LANE].set(jnp.broadcast_to(jnp.asarray(lane, jnp.int32), shape))
+    out = out.at[..., T.W_FLAGS].set(jnp.broadcast_to(jnp.asarray(flags, jnp.int32), shape))
+    for i, p in enumerate(payload):
+        out = out.at[..., T.HDR_WORDS + i].set(jnp.broadcast_to(jnp.asarray(p, jnp.int32), shape))
+    return out
+
+
+def is_kind(msgs: Array, kind: int) -> Array:
+    """bool mask over [..., W] records."""
+    return msgs[..., T.W_KIND] == kind

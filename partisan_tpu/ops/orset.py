@@ -1,0 +1,80 @@
+"""Membership set: a rank-reduced OR-set over node ids.
+
+The reference wraps ``state_orset`` (per-actor dot sets) in
+partisan_membership_set.erl:116-213, whose observable semantics on node
+specs are: add wins over concurrent absence, observed-remove deletes only
+adds you have seen, and a node that leaves and rejoins is distinguished by
+a fresh spec (staleness discussion, partisan_membership_set.erl:23-60).
+
+Full per-actor dot sets explode at scale (SURVEY.md §7 "CRDT OR-set at
+scale"), so the TPU encoding is rank-reduced: each node's view holds two
+uint32 counters per member,
+
+    add[j] — highest incarnation of j this view has observed joining
+    rm[j]  — highest incarnation of j this view has observed leaving
+
+with ``member(j) = add[j] > rm[j]`` and merge = elementwise max of both.
+Incarnations play the role of dots: a rejoin bumps j's incarnation above
+any observed remove, reproducing the OR-set's add/remove/re-add behavior
+for the single-actor-per-spec case the managers actually exercise (each
+node only ever adds/removes its own spec or relays others' observed
+state).  ``compare`` mirrors partisan_membership_set:compare → {joiners,
+leavers}.
+
+A node view is ``uint32[2, n]`` (stacked add/rm) so a whole cluster's
+views are ``uint32[n, 2, n]`` and a gossip round is one scatter-max.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+DTYPE = jnp.uint32
+
+
+def fresh_views(n: int) -> Array:
+    """Every node starts knowing only itself at incarnation 1 (the
+    reference boots a new OR-Set containing self —
+    partisan_full_membership_strategy.erl:70-82)."""
+    add = jnp.eye(n, dtype=DTYPE)
+    rm = jnp.zeros((n, n), DTYPE)
+    return jnp.stack([add, rm], axis=1)  # [n, 2, n]
+
+
+def members(view: Array) -> Array:
+    """bool[...] mask of live members in a view [..., 2, n]."""
+    return view[..., 0, :] > view[..., 1, :]
+
+
+def add(view: Array, member: Array, incarnation: Array | int = 1) -> Array:
+    """Observe ``member`` joining at ``incarnation`` (max-merge)."""
+    onehot = jnp.arange(view.shape[-1]) == member
+    bumped = jnp.maximum(view[..., 0, :], jnp.where(onehot, DTYPE(incarnation), 0))
+    return view.at[..., 0, :].set(bumped)
+
+
+def remove(view: Array, member: Array) -> Array:
+    """Observed-remove: delete every incarnation of ``member`` this view
+    has seen (partisan_full_membership_strategy.erl:171-210 leave)."""
+    onehot = jnp.arange(view.shape[-1]) == member
+    newrm = jnp.where(onehot, jnp.maximum(view[..., 1, :], view[..., 0, :]),
+                      view[..., 1, :])
+    return view.at[..., 1, :].set(newrm)
+
+
+def merge(a: Array, b: Array) -> Array:
+    """CRDT join — elementwise max over both planes."""
+    return jnp.maximum(a, b)
+
+
+def equal(a: Array, b: Array) -> Array:
+    return jnp.all(a == b, axis=(-2, -1))
+
+
+def compare(old: Array, new: Array) -> tuple[Array, Array]:
+    """(joiners, leavers) bool masks — partisan_membership_set:compare/2
+    feeding the up/down callbacks
+    (partisan_pluggable_peer_service_manager.erl:1583-1597)."""
+    mo, mn = members(old), members(new)
+    return mn & ~mo, mo & ~mn

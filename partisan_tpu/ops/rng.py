@@ -1,0 +1,70 @@
+"""Deterministic per-node randomness.
+
+The reference seeds each node's RNG deterministically for reproducible test
+schedules (``partisan_config:seed/0,1``, partisan_config.erl:701-710).  The
+TPU-native discipline: every random draw is keyed by
+``fold_in(fold_in(seed, round), node_id)`` so results are
+
+- deterministic given (seed, round, node),
+- independent across nodes and rounds, and
+- **placement-invariant**: node ids are global, so resharding the node axis
+  across a different device count cannot change any draw (SURVEY.md §7
+  "Determinism across shards").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def round_key(seed: int | jax.Array, rnd: jax.Array) -> jax.Array:
+    """Key for a whole round (scalar)."""
+    base = jax.random.key(seed) if isinstance(seed, int) else seed
+    return jax.random.fold_in(base, rnd)
+
+
+def node_keys(seed: int | jax.Array, rnd: jax.Array, node_ids: jax.Array) -> jax.Array:
+    """One key per node for this round. ``node_ids`` is int32[n] of GLOBAL ids."""
+    rk = round_key(seed, rnd)
+    return jax.vmap(lambda i: jax.random.fold_in(rk, i))(node_ids)
+
+
+def subkey(key: jax.Array, tag: int) -> jax.Array:
+    """Derive an independent stream from a node key for a named purpose.
+
+    Use distinct small ints per call site (protocol phase) so adding a new
+    draw never perturbs existing streams.
+    """
+    return jax.random.fold_in(key, tag)
+
+
+def choice_slots(key: jax.Array, valid: jax.Array, k: int) -> jax.Array:
+    """Pick ``k`` distinct SLOT indices from a bool[v] validity mask.
+
+    Returns int32[k]; -1 where fewer than k valid slots exist.  Used to
+    sample fanout targets from a neighbor list / membership row.
+    """
+    g = jax.random.gumbel(key, valid.shape)
+    score = jnp.where(valid, g, -jnp.inf)
+    _, top = jax.lax.top_k(score, k)
+    top = top.astype(jnp.int32)
+    return jnp.where(valid[top], top, jnp.int32(-1))
+
+
+def choice_without(key: jax.Array, n: int, exclude: jax.Array, k: int) -> jax.Array:
+    """Pick ``k`` distinct node ids from [0, n) avoiding ids in ``exclude``.
+
+    ``exclude`` is int32[e] (use -1 for empty slots).  Returns int32[k], with
+    -1 where no eligible candidate remained.  Gumbel-top-k over a masked
+    score vector: O(n) per node, fully vectorizable under vmap.
+    """
+    g = jax.random.gumbel(key, (n,))
+    ids = jnp.arange(n, dtype=jnp.int32)
+    banned = jnp.any(ids[:, None] == exclude[None, :], axis=1)
+    score = jnp.where(banned, -jnp.inf, g)
+    _, top = jax.lax.top_k(score, k)
+    top = top.astype(jnp.int32)
+    # Slots that fell on banned entries (when < k candidates) become -1.
+    ok = ~banned[top]
+    return jnp.where(ok, top, jnp.int32(-1))

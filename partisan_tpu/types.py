@@ -1,0 +1,90 @@
+"""Core wire/tensor types: the fixed-width message record and its fields.
+
+The reference sends arbitrary Erlang terms (``term_to_binary`` framing,
+partisan_util.erl:171-183).  The tensor transport instead uses a fixed-width
+record of ``msg_words`` int32 words per message: an 8-word header followed by
+protocol-specific payload words.  Every protocol message in the reference's
+managers/broadcast layers (join/forward_join/neighbor/shuffle/disconnect —
+partisan_hyparview_peer_service_manager.erl:1234-1795; eager/i_have/graft/
+prune — partisan_plumtree_broadcast.erl:843-905; ping/pong/ack —
+partisan_pluggable_peer_service_manager.erl:1696-1885) carries only node ids,
+message ids, TTLs and small counters, so a bounded word vector is a faithful
+encoding.  Large state payloads (membership CRDTs, anti-entropy stores,
+vclocks) do NOT ride the event-message lane — they are merged along gossip
+edges as dense max/or reductions (see ops/gossip.py), which is the TPU-native
+analogue of the reference's monotonic state-exchange channels.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# ---------------------------------------------------------------------------
+# Header word indices (first HDR_WORDS words of every message record).
+# ---------------------------------------------------------------------------
+HDR_WORDS = 8
+
+W_KIND = 0      # MsgKind — 0 (NONE) marks an empty slot
+W_SRC = 1       # sender node id
+W_DST = 2       # destination node id (routing key)
+W_CHANNEL = 3   # channel id (index into Config.channels)
+W_TTL = 4       # remaining hops for random walks / tree relay
+W_CLOCK = 5     # per-sender monotonic message clock (ack/retransmit key)
+W_LANE = 6      # parallelism lane (partition-key affinity within a channel)
+W_FLAGS = 7     # bitfield: ACK_REQUIRED etc.
+
+# W_FLAGS bits
+F_ACK_REQUIRED = 1 << 0     # {ack, true} forward option
+F_RETRANSMISSION = 1 << 1   # re-sent by the retransmit timer
+F_CAUSAL = 1 << 2           # routed through a causality lane
+
+# Payload word indices, by message family.  Payload starts at HDR_WORDS.
+P0, P1, P2, P3 = HDR_WORDS, HDR_WORDS + 1, HDR_WORDS + 2, HDR_WORDS + 3
+
+
+class MsgKind(enum.IntEnum):
+    """Every protocol message type carried on the event lane.
+
+    Groups mirror the reference's per-layer message vocabularies; see the
+    file:line citations on each group.
+    """
+
+    NONE = 0
+
+    # -- manager liveness (partisan_pluggable_peer_service_manager.erl:1696-1737)
+    PING = 1            # payload: [probe_id]
+    PONG = 2            # payload: [probe_id, echo_round]
+
+    # -- acked delivery (partisan_acknowledgement_backend.erl:70-85)
+    ACK = 3             # payload: [acked_clock]; W_CLOCK = acked msg clock
+
+    # -- HyParView (partisan_hyparview_peer_service_manager.erl:1234-1795)
+    HPV_JOIN = 10            # payload: []
+    HPV_FORWARD_JOIN = 11    # payload: [joiner]; W_TTL = remaining walk
+    HPV_NEIGHBOR = 12        # payload: [priority]  (1 = high)
+    HPV_NEIGHBOR_ACCEPTED = 13
+    HPV_NEIGHBOR_REJECTED = 14
+    HPV_DISCONNECT = 15
+    HPV_SHUFFLE = 16         # payload: [origin, k_slots...]; W_TTL = walk
+    HPV_SHUFFLE_REPLY = 17   # payload: [k_slots...]
+
+    # -- SCAMP (partisan_scamp_v1_membership_strategy.erl:67-297, v2)
+    SCAMP_SUBSCRIPTION = 20       # forward_subscription; payload: [subscriber]
+    SCAMP_UNSUBSCRIBE = 21        # payload: [node, replacement]
+    SCAMP_KEEPALIVE = 22          # periodic ping for isolation detection (v2)
+
+    # -- Plumtree (partisan_plumtree_broadcast.erl:843-905)
+    PT_GOSSIP = 30      # eager push; payload: [slot, root, msg_round]
+    PT_IHAVE = 31       # lazy advert; payload: [slot, root]
+    PT_GRAFT = 32       # payload: [slot, root]
+    PT_PRUNE = 33       # payload: []
+
+    # -- application / protocol corpus (models/)
+    APP = 40            # payload: model-defined
+    RPC_CALL = 41       # payload: [fn_id, arg, call_ref] (partisan_rpc.erl:69-98)
+    RPC_RESPONSE = 42   # payload: [result, call_ref]
+
+
+# Convenience: number of payload words available given msg_words.
+def payload_words(msg_words: int) -> int:
+    return msg_words - HDR_WORDS

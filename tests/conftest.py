@@ -1,0 +1,26 @@
+"""Test environment: force a hermetic 8-virtual-device CPU platform.
+
+Tests never touch the TPU tunnel: this image's sitecustomize registers an
+``axon`` PJRT plugin in every interpreter and force-selects it via
+``jax.config.update('jax_platforms', 'axon,cpu')``; we undo both BEFORE
+any backend initializes, then force 8 virtual CPU devices so sharding
+tests exercise a real ``jax.sharding.Mesh`` without hardware (the
+multi-node-without-a-cluster fixture analogue, reference
+test/partisan_support.erl:46+).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._backend_factories.pop("axon", None)

@@ -1,0 +1,160 @@
+"""Cluster-level tests for the full-mesh manager + anti-entropy model —
+the sim analogues of reference test/partisan_SUITE.erl basic_test /
+leave_test / rejoin_test and the demers_anti_entropy gossip demo."""
+
+import jax.numpy as jnp
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.models.anti_entropy import AntiEntropy
+from partisan_tpu.ops import orset
+
+
+def converged_members(cl, st, expect_n):
+    m = cl.manager.members(cl.cfg, st.manager)
+    alive = st.faults.alive
+    rows = m[alive]
+    counts = jnp.sum(rows, axis=1)
+    return bool(jnp.all(counts == expect_n)) and bool(
+        jnp.all(rows == rows[0][None, :])
+    )
+
+
+def chain_join(cl, st):
+    """Every node i>0 joins via node 0 (the SUITE's star bootstrap)."""
+    m = st.manager
+    for i in range(1, cl.cfg.n_nodes):
+        m = cl.manager.join(cl.cfg, m, i, 0)
+    return st._replace(manager=m)
+
+
+def test_basic_join_convergence():
+    cfg = Config(n_nodes=8, seed=42)
+    cl = Cluster(cfg)
+    st = chain_join(cl, cl.init())
+    st, rounds = cl.run_until(
+        st, lambda s: converged_members(cl, s, 8), max_rounds=200)
+    assert rounds != -1, "membership never converged"
+    # Everyone sees everyone: full mesh.
+    m = cl.manager.members(cfg, st.manager)
+    assert bool(jnp.all(m))
+
+
+def test_leave():
+    cfg = Config(n_nodes=6, seed=7)
+    cl = Cluster(cfg)
+    st = chain_join(cl, cl.init())
+    st, r = cl.run_until(st, lambda s: converged_members(cl, s, 6), 200)
+    assert r != -1
+    st = st._replace(manager=cl.manager.leave(cfg, st.manager, 3))
+    st, r = cl.run_until(
+        st,
+        lambda s: bool(
+            jnp.all(~cl.manager.members(cfg, s.manager)[:, 3])
+        ),
+        200,
+    )
+    assert r != -1, "leave never propagated"
+
+
+def test_rejoin_fresh_incarnation():
+    cfg = Config(n_nodes=4, seed=3)
+    cl = Cluster(cfg)
+    st = chain_join(cl, cl.init())
+    st, r = cl.run_until(st, lambda s: converged_members(cl, s, 4), 200)
+    assert r != -1
+    st = st._replace(manager=cl.manager.leave(cfg, st.manager, 2))
+    st, r = cl.run_until(
+        st, lambda s: bool(jnp.all(~cl.manager.members(cfg, s.manager)[:, 2])), 200)
+    assert r != -1
+    st = st._replace(manager=cl.manager.rejoin(cfg, st.manager, 2, 0))
+    st, r = cl.run_until(st, lambda s: converged_members(cl, s, 4), 200)
+    assert r != -1, "rejoin never converged"
+
+
+def test_crash_fault_freezes_node():
+    cfg = Config(n_nodes=4, seed=1)
+    cl = Cluster(cfg)
+    st = chain_join(cl, cl.init())
+    st = st._replace(faults=faults_mod.crash(st.faults, 3))
+    st = cl.steps(st, 30)
+    # Node 3's view is frozen at what it had when it crashed: itself plus
+    # the join target it learned host-side in chain_join.
+    m = cl.manager.members(cfg, st.manager)
+    assert m[3].tolist() == [True, False, False, True]
+    # Others converged among themselves without node 3's gossip... they may
+    # still BELIEVE 3 is a member (no failure detector pruning yet), but
+    # they must have found each other.
+    assert bool(jnp.all(m[:3, :3]))
+
+
+def test_anti_entropy_broadcast_converges():
+    cfg = Config(n_nodes=16, seed=9)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = chain_join(cl, cl.init())
+    st, r = cl.run_until(st, lambda s: converged_members(cl, s, 16), 300)
+    assert r != -1
+    st = st._replace(model=model.broadcast(st.model, node=0, slot=0))
+    st, r = cl.run_until(
+        st,
+        lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
+        max_rounds=200,
+    )
+    assert r != -1, "anti-entropy broadcast never covered the cluster"
+
+
+def test_anti_entropy_under_link_drop():
+    cfg = Config(n_nodes=16, seed=11)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = chain_join(cl, cl.init())
+    st, r = cl.run_until(st, lambda s: converged_members(cl, s, 16), 300)
+    assert r != -1
+    st = st._replace(
+        faults=st.faults._replace(link_drop=jnp.float32(0.05)),
+        model=model.broadcast(st.model, node=2, slot=1),
+    )
+    st, r = cl.run_until(
+        st,
+        lambda s: float(model.coverage(s.model, s.faults.alive, 1)) == 1.0,
+        max_rounds=400,
+    )
+    assert r != -1, "anti-entropy did not survive 5% link drop"
+
+
+def test_partition_blocks_then_heals():
+    cfg = Config(n_nodes=8, seed=5)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = chain_join(cl, cl.init())
+    st, r = cl.run_until(st, lambda s: converged_members(cl, s, 8), 300)
+    assert r != -1
+    st = st._replace(
+        faults=faults_mod.inject_partition(st.faults, [0, 1, 2, 3], [4, 5, 6, 7]),
+        model=model.broadcast(st.model, node=0, slot=0),
+    )
+    st = cl.steps(st, 60)
+    cov = float(model.coverage(st.model, st.faults.alive, 0))
+    assert cov <= 0.5, f"broadcast crossed a partition: {cov}"
+    st = st._replace(faults=faults_mod.resolve_partition(st.faults))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0, 200)
+    assert r != -1, "broadcast did not heal after partition resolution"
+
+
+def test_determinism():
+    cfg = Config(n_nodes=8, seed=123)
+    model = AntiEntropy()
+
+    def run():
+        cl = Cluster(cfg, model=model)
+        st = chain_join(cl, cl.init())
+        st = st._replace(model=model.broadcast(st.model, 0, 0))
+        return cl.steps(st, 50)
+
+    a, b = run(), run()
+    assert bool(orset.equal(a.manager.view, b.manager.view).all())
+    assert bool(jnp.all(a.model.store == b.model.store))
+    assert int(a.stats.delivered) == int(b.stats.delivered)
