@@ -1,0 +1,61 @@
+"""Benchmark: simulated gossip rounds/sec (north-star metric, BASELINE.md).
+
+Runs driver config #1 — full-mesh + full membership strategy +
+demers_anti_entropy — sized up to 256 nodes, and measures how many whole
+cluster rounds per second the jitted simulator steps on one chip.
+
+``vs_baseline``: the reference is a LIVE system whose gossip timers tick
+in wall-clock seconds — one simulated round == ``round_ms`` (1 s) of
+virtual time.  A live Partisan cluster therefore advances 1 round/sec by
+construction; ``vs_baseline`` is the simulation speedup over that
+real-time baseline (rounds-per-sec / 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config
+    from partisan_tpu.models.anti_entropy import AntiEntropy
+
+    n = 256
+    cfg = Config(n_nodes=n, seed=1)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, n):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+
+    k = 100
+    st = cl.steps(st, k)               # warmup + compile
+    jax.block_until_ready(st)
+    assert float(model.coverage(st.model, st.faults.alive, 0)) == 1.0, (
+        "anti-entropy broadcast failed to converge during warmup")
+
+    reps = 3
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        st = cl.steps(st, k)
+        jax.block_until_ready(st)
+        best = min(best, time.perf_counter() - t0)
+
+    rps = k / best
+    print(json.dumps({
+        "metric": f"simulated gossip rounds/sec ({n}-node full-mesh + anti-entropy)",
+        "value": round(rps, 1),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rps, 1),   # live system: 1 round == 1 s wall
+    }))
+
+
+if __name__ == "__main__":
+    main()

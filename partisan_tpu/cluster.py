@@ -53,6 +53,64 @@ class ClusterState(NamedTuple):
     stats: Stats
 
 
+def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
+               state: ClusterState) -> ClusterState:
+    """ONE round, generic over the comm substrate — executed directly on a
+    single device (LocalComm) or per shard inside shard_map (ShardComm).
+    Sharing this body is what guarantees single-device and sharded runs
+    evolve identically (tests/test_sharded.py)."""
+    gids = comm.local_ids()
+    keys = rng.node_keys(cfg.seed, state.rnd, gids)
+    alive_local = jax.lax.dynamic_slice(
+        state.faults.alive, (comm.node_offset,), (comm.n_local,))
+    ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
+                   inbox=state.inbox, faults=state.faults)
+
+    mstate, m_emit = manager.step(cfg, comm, state.manager, ctx)
+    if model is not None:
+        nbrs = manager.neighbors(cfg, mstate, comm)
+        dstate, a_emit = model.step(cfg, comm, state.model, ctx, nbrs)
+        emitted = jnp.concatenate([m_emit, a_emit], axis=1)
+    else:
+        dstate, emitted = (), m_emit
+
+    n_emitted = comm.allsum(jnp.sum(emitted[..., 0] != 0, dtype=jnp.int32))
+
+    # Interposition point: fault masks between emit and deliver.
+    emitted = faults_mod.filter_msgs(
+        state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
+
+    inbox = comm.route(emitted)
+    # Crash-stopped receivers drop everything addressed to them.
+    dead = ~alive_local
+    inbox = exchange.Inbox(
+        data=jnp.where(dead[:, None, None], 0, inbox.data),
+        count=jnp.where(dead, 0, inbox.count),
+        drops=inbox.drops + jnp.where(dead, inbox.count, 0),
+    )
+
+    delivered = comm.allsum(jnp.sum(inbox.count, dtype=jnp.int32))
+    stats = Stats(
+        emitted=state.stats.emitted + n_emitted,
+        delivered=state.stats.delivered + delivered,
+        dropped=state.stats.dropped + (n_emitted - delivered),
+    )
+    return ClusterState(rnd=state.rnd + 1, faults=state.faults,
+                        inbox=inbox, manager=mstate, model=dstate,
+                        stats=stats)
+
+
+def run_until(cluster: Any, state: ClusterState, pred, max_rounds: int,
+              check_every: int = 1) -> tuple[ClusterState, int]:
+    """Step until host-side ``pred(state)`` is True. Returns (state,
+    rounds_taken) or (state, -1) if the bound was hit."""
+    for _ in range(0, max_rounds, check_every):
+        if pred(state):
+            return state, int(state.rnd)
+        state = cluster.steps(state, check_every)
+    return (state, int(state.rnd)) if pred(state) else (state, -1)
+
+
 @dataclasses.dataclass
 class Cluster:
     """Builds and runs the jitted round step for one configuration.
@@ -90,46 +148,7 @@ class Cluster:
 
     # ---- the round ----------------------------------------------------
     def _round(self, state: ClusterState) -> ClusterState:
-        cfg, comm = self.cfg, self.comm
-        gids = comm.local_ids()
-        keys = rng.node_keys(cfg.seed, state.rnd, gids)
-        alive_local = state.faults.alive  # LocalComm: local == global
-        ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
-                       inbox=state.inbox, faults=state.faults)
-
-        mstate, m_emit = self.manager.step(cfg, comm, state.manager, ctx)
-
-        if self.model is not None:
-            nbrs = self.manager.neighbors(cfg, mstate, comm)
-            dstate, a_emit = self.model.step(cfg, comm, state.model, ctx, nbrs)
-            emitted = jnp.concatenate([m_emit, a_emit], axis=1)
-        else:
-            dstate, emitted = (), m_emit
-
-        n_emitted = jnp.sum(emitted[..., 0] != 0, dtype=jnp.int32)
-
-        # Interposition point: fault masks between emit and deliver.
-        fkey = rng.subkey(rng.round_key(cfg.seed, state.rnd), _MSG_FILTER_TAG)
-        emitted = faults_mod.filter_msgs(state.faults, emitted, fkey)
-
-        inbox = comm.route(emitted)
-        # Crash-stopped receivers drop everything addressed to them.
-        dead = ~alive_local
-        inbox = exchange.Inbox(
-            data=jnp.where(dead[:, None, None], 0, inbox.data),
-            count=jnp.where(dead, 0, inbox.count),
-            drops=inbox.drops + jnp.where(dead, inbox.count, 0),
-        )
-
-        delivered = jnp.sum(inbox.count, dtype=jnp.int32)
-        stats = Stats(
-            emitted=state.stats.emitted + n_emitted,
-            delivered=state.stats.delivered + delivered,
-            dropped=state.stats.dropped + (n_emitted - delivered),
-        )
-        return ClusterState(rnd=state.rnd + 1, faults=state.faults,
-                            inbox=inbox, manager=mstate, model=dstate,
-                            stats=stats)
+        return round_body(self.cfg, self.manager, self.model, self.comm, state)
 
     def _scan(self, state: ClusterState, k: int) -> ClusterState:
         return jax.lax.scan(
@@ -146,10 +165,4 @@ class Cluster:
 
     def run_until(self, state: ClusterState, pred, max_rounds: int,
                   check_every: int = 1) -> tuple[ClusterState, int]:
-        """Step until host-side ``pred(state)`` is True. Returns (state,
-        rounds_taken) or (state, -1) if the bound was hit."""
-        for _ in range(0, max_rounds, check_every):
-            if pred(state):
-                return state, int(state.rnd)
-            state = self.steps(state, check_every)
-        return (state, int(state.rnd)) if pred(state) else (state, -1)
+        return run_until(self, state, pred, max_rounds, check_every)

@@ -26,11 +26,9 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
-import jax
 import jax.numpy as jnp
 from jax import Array
 
-from partisan_tpu.ops import rng
 from partisan_tpu.types import W_DST, W_KIND, W_SRC
 
 
@@ -50,7 +48,45 @@ def none(n: int) -> FaultState:
     )
 
 
-def edge_cut(faults: FaultState, src: Array, dst: Array, key: Array) -> Array:
+def _mix32(x: Array) -> Array:
+    """murmur3 finalizer — a counter-based uniform hash.  Used instead of
+    jax.random so a drop decision depends ONLY on (seed, round, src, dst,
+    salt) — never on array shape — keeping fault schedules identical
+    across shardings (the replay-determinism requirement,
+    partisan_trace_orchestrator.erl:197-240)."""
+    x = jnp.asarray(x, jnp.uint32)
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    return x
+
+
+def edge_hash(seed: int, rnd: Array, salt: int, src: Array,
+              dst: Array) -> Array:
+    """Deterministic uint32 hash per (edge, round, call-site).  Mixing is
+    cascaded (not one linear XOR-combine) so distinct edges can't collide
+    permanently across all rounds/salts."""
+    site = (seed * 0x27D4EB2F + salt) & 0xFFFFFFFF
+    h = _mix32(jnp.asarray(src, jnp.uint32) ^ jnp.uint32(0x9E3779B1))
+    h = _mix32(h ^ jnp.asarray(dst, jnp.uint32))
+    h = _mix32(h ^ jnp.asarray(rnd, jnp.uint32) ^ jnp.uint32(site))
+    return h
+
+
+def hash_bernoulli(h: Array, p: Array) -> Array:
+    """True with probability p (quantized to 2^-24) given a uniform uint32
+    hash.  The top 24 bits convert to float32 EXACTLY, so u spans
+    [0, 1 - 2^-24]: p=1.0 fires always, p=0.0 never (a 32-bit h/2^32
+    would round up to exactly 1.0 for h >= 0xFFFFFF80 and break
+    drop-everything scenarios)."""
+    u = (h >> 8).astype(jnp.float32) / jnp.float32(2**24)
+    return u < jnp.asarray(p, jnp.float32)
+
+
+def edge_cut(faults: FaultState, src: Array, dst: Array, seed: int,
+             rnd: Array, salt: int) -> Array:
     """bool mask, True where the (src, dst) edge is cut this round.
 
     src, dst: same-shape int32 global ids (dst may contain -1 = unused;
@@ -60,24 +96,26 @@ def edge_cut(faults: FaultState, src: Array, dst: Array, key: Array) -> Array:
     s = jnp.where(src >= 0, src, 0)
     cut = faults.partition[s, d]
     cut = cut | ~faults.alive[d] | ~faults.alive[s]
-    drop = jax.random.bernoulli(key, faults.link_drop, shape=dst.shape)
+    drop = hash_bernoulli(edge_hash(seed, rnd, salt, s, d), faults.link_drop)
     return ok_dst & (cut | drop)
 
 
-def filter_edges(faults: FaultState, src_gids: Array, dst: Array,
-                 key: Array) -> Array:
+def filter_edges(faults: FaultState, src_gids: Array, dst: Array, seed: int,
+                 rnd: Array, salt: int) -> Array:
     """Null out (-1) gossip edges hit by faults. dst: int32[n_local, K]."""
     src = jnp.broadcast_to(src_gids[:, None], dst.shape)
-    return jnp.where(edge_cut(faults, src, dst, key), jnp.int32(-1), dst)
+    return jnp.where(edge_cut(faults, src, dst, seed, rnd, salt),
+                     jnp.int32(-1), dst)
 
 
-def filter_msgs(faults: FaultState, emitted: Array, key: Array) -> Array:
+def filter_msgs(faults: FaultState, emitted: Array, seed: int, rnd: Array,
+                salt: int) -> Array:
     """Apply crash + omission faults to event messages int32[n, E, W]
     (kind := NONE where the edge is cut) — the central interposition
     point between emit and deliver."""
     src = emitted[..., W_SRC]
     dst = jnp.where(emitted[..., W_KIND] != 0, emitted[..., W_DST], -1)
-    cut = edge_cut(faults, src, dst, key)
+    cut = edge_cut(faults, src, dst, seed, rnd, salt)
     return emitted.at[..., W_KIND].set(
         jnp.where(cut, 0, emitted[..., W_KIND])
     )

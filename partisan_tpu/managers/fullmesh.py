@@ -33,9 +33,9 @@ from partisan_tpu import faults as faults_mod
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
-from partisan_tpu.ops import orset, rng
+from partisan_tpu.ops import orset
 
-_GOSSIP_EDGE_TAG = 101  # rng stream tag for gossip-edge fault filtering
+_GOSSIP_EDGE_TAG = 101  # fault-hash call-site salt for gossip edges
 
 
 class FullMeshState(NamedTuple):
@@ -70,8 +70,8 @@ class FullMesh:
         peer = member & (all_ids[None, :] != gids[:, None])
         dst = jnp.where(fires[:, None] & peer, all_ids[None, :], jnp.int32(-1))
 
-        ekey = rng.subkey(rng.round_key(cfg.seed, ctx.rnd), _GOSSIP_EDGE_TAG)
-        dst = faults_mod.filter_edges(ctx.faults, gids, dst, ekey)
+        dst = faults_mod.filter_edges(
+            ctx.faults, gids, dst, cfg.seed, ctx.rnd, _GOSSIP_EDGE_TAG)
 
         flat = state.view.reshape(n_local, 2 * n_global)
         pushed = comm.push_max(flat, dst).reshape(n_local, 2, n_global)

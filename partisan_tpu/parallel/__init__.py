@@ -1,0 +1,9 @@
+"""Device-parallel execution: the node axis sharded over a TPU mesh.
+
+The reference scales by adding BEAM nodes connected over TCP (its
+distributed communication backend, SURVEY.md §5.8); the TPU-native
+equivalent shards the simulated node axis across chips with
+``jax.shard_map`` over a ``jax.sharding.Mesh`` and moves each round's
+traffic with XLA collectives over ICI/DCN."""
+
+from partisan_tpu.parallel.sharded import ShardComm, ShardedCluster, make_mesh  # noqa: F401

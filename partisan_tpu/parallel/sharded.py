@@ -1,0 +1,201 @@
+"""Sharded cluster execution over a device mesh.
+
+The node axis is split across devices; one round is a single SPMD program
+under ``jax.shard_map``:
+
+- per-node protocol transitions run shard-locally (no communication),
+- the event-message exchange and state-gossip merges cross shards with
+  one ``all_gather`` over the ``nodes`` mesh axis (ICI), after which each
+  shard routes/merges only its own node range — the TPU-native analogue
+  of the reference's per-connection TCP fan-out (SURVEY.md §5.8).
+
+``ShardComm`` implements the same interface as ``LocalComm`` (comm.py),
+so managers and models run unchanged on 1 or N devices.  Determinism is
+placement-invariant because all randomness keys off GLOBAL node ids
+(ops/rng.py).
+
+Scaling note: the all-gather volume is O(n_global * emit_cap * msg_words)
+per round, which rides ICI comfortably for the target scenarios (100k
+nodes x 16 slots x 12 words x 4 B ~ 77 MB/round across the slice); a
+sorted all_to_all exchange is the planned optimization once profiles
+justify it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+from jax.sharding import Mesh, PartitionSpec as P
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import managers as managers_mod
+from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import exchange, gossip, rng
+
+AXIS = "nodes"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D device mesh over the node axis."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardComm:
+    """LocalComm interface, executed inside shard_map on one shard."""
+
+    n_global: int
+    inbox_cap: int
+    msg_words: int
+    n_shards: int
+
+    @property
+    def n_local(self) -> int:
+        return self.n_global // self.n_shards
+
+    @property
+    def node_offset(self) -> Array:
+        return jax.lax.axis_index(AXIS) * self.n_local
+
+    def local_ids(self) -> Array:
+        return self.node_offset + jnp.arange(self.n_local, dtype=jnp.int32)
+
+    def route(self, emitted: Array) -> exchange.Inbox:
+        # [n_local, E, W] -> gather every shard's emissions over ICI, then
+        # keep only messages addressed to this shard's node range.
+        all_emitted = jax.lax.all_gather(emitted, AXIS, axis=0, tiled=True)
+        return exchange.route(all_emitted, self.n_local, self.inbox_cap,
+                              node_offset=self.node_offset)
+
+    def push_max(self, rows: Array, dst: Array) -> Array:
+        all_rows = jax.lax.all_gather(rows, AXIS, axis=0, tiled=True)
+        all_dst = jax.lax.all_gather(dst, AXIS, axis=0, tiled=True)
+        return gossip.push_max(all_rows, all_dst, n_out=self.n_local,
+                               node_offset=self.node_offset)
+
+    def push_or(self, rows: Array, dst: Array) -> Array:
+        return self.push_max(rows.astype(jnp.uint8), dst).astype(jnp.bool_)
+
+    def allsum(self, x: Array) -> Array:
+        """Cross-shard scalar sum (keeps Stats replicated)."""
+        return jax.lax.psum(x, AXIS)
+
+
+@dataclasses.dataclass
+class ShardedCluster:
+    """Same API as cluster.Cluster, but the round step is one shard_map'd
+    SPMD program over ``mesh``.  State pytrees are sharded on the leading
+    node axis; round counter, fault state and stats are replicated."""
+
+    cfg: Config
+    mesh: Mesh
+    manager: Any = None
+    model: Any = None
+
+    def __post_init__(self) -> None:
+        if self.manager is None:
+            self.manager = managers_mod.get(self.cfg.peer_service_manager)
+        n_shards = self.mesh.devices.size
+        if self.cfg.n_nodes % n_shards:
+            raise ValueError(
+                f"n_nodes={self.cfg.n_nodes} not divisible by "
+                f"mesh size {n_shards}")
+        self.comm = ShardComm(
+            n_global=self.cfg.n_nodes,
+            inbox_cap=self.cfg.inbox_cap,
+            msg_words=self.cfg.msg_words,
+            n_shards=n_shards,
+        )
+        # Full-size comm used for host-side init / scripting helpers.
+        self.host_comm = LocalComm(
+            n_global=self.cfg.n_nodes,
+            inbox_cap=self.cfg.inbox_cap,
+            msg_words=self.cfg.msg_words,
+        )
+        self._specs = None
+        self._step = None
+
+    # ---- sharding specs ----------------------------------------------
+    def _state_specs(self, state: ClusterState):
+        """PartitionSpecs: node-axis leaves sharded, control state
+        replicated."""
+        shard = P(AXIS)
+        repl = P()
+
+        def spec_like(subtree, s):
+            return jax.tree.map(lambda _: s, subtree)
+
+        return ClusterState(
+            rnd=repl,
+            faults=spec_like(state.faults, repl),
+            inbox=spec_like(state.inbox, shard),
+            manager=spec_like(state.manager, shard),
+            model=spec_like(state.model, shard),
+            stats=spec_like(state.stats, repl),
+        )
+
+    # ---- state construction ------------------------------------------
+    def init(self) -> ClusterState:
+        cfg = self.cfg
+        state = ClusterState(
+            rnd=jnp.int32(0),
+            faults=faults_mod.none(cfg.n_nodes),
+            inbox=exchange.empty_inbox(cfg.n_nodes, cfg.inbox_cap, cfg.msg_words),
+            manager=self.manager.init(cfg, self.host_comm),
+            model=self.model.init(cfg, self.host_comm) if self.model is not None else (),
+            stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        )
+        return self.shard_state(state)
+
+    def shard_state(self, state: ClusterState) -> ClusterState:
+        """Place a host/global state onto the mesh per the specs."""
+        specs = self._state_specs(state)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(
+                x, jax.sharding.NamedSharding(self.mesh, s)),
+            state, specs,
+        )
+
+    # ---- the sharded round -------------------------------------------
+    def _round_shard(self, state: ClusterState) -> ClusterState:
+        """Per-shard body under shard_map: the SAME round_body as the
+        single-device Cluster, with the shard-aware comm."""
+        return round_body(self.cfg, self.manager, self.model, self.comm, state)
+
+    def _build(self, state: ClusterState) -> None:
+        specs = self._state_specs(state)
+        body = jax.shard_map(
+            self._round_shard, mesh=self.mesh,
+            in_specs=(specs,), out_specs=specs, check_vma=False,
+        )
+        self._round_sharded = body
+        self._step = jax.jit(body)
+        self._steps = jax.jit(
+            lambda s, k: jax.lax.scan(
+                lambda c, _: (body(c), None), s, None, length=k)[0],
+            static_argnums=1)
+
+    # ---- public API ---------------------------------------------------
+    def step(self, state: ClusterState) -> ClusterState:
+        if self._step is None:
+            self._build(state)
+        return self._step(state)
+
+    def steps(self, state: ClusterState, k: int) -> ClusterState:
+        if self._step is None:
+            self._build(state)
+        return self._steps(state, k)
+
+    def run_until(self, state, pred, max_rounds: int, check_every: int = 1):
+        return run_until(self, state, pred, max_rounds, check_every)

@@ -1,0 +1,51 @@
+"""Fault-hash determinism and boundary tests."""
+
+import jax.numpy as jnp
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.anti_entropy import AntiEntropy
+
+
+def test_hash_bernoulli_boundaries():
+    h = faults_mod.edge_hash(
+        0, jnp.int32(3), 7,
+        jnp.arange(4096, dtype=jnp.int32),
+        jnp.arange(4096, dtype=jnp.int32)[::-1])
+    assert bool(jnp.all(faults_mod.hash_bernoulli(h, 1.0)))
+    assert not bool(jnp.any(faults_mod.hash_bernoulli(h, 0.0)))
+    frac = float(jnp.mean(faults_mod.hash_bernoulli(h, 0.3)))
+    assert abs(frac - 0.3) < 0.05, frac
+
+
+def test_edge_hash_decorrelated_across_rounds():
+    """Edges must not keep identical fates forever (the cascade-mix fix):
+    over many rounds, two fixed distinct edges agree ~50% of the time for
+    p=0.5, not 100%."""
+    rounds = jnp.arange(512, dtype=jnp.int32)
+    h1 = faults_mod.edge_hash(0, rounds, 7, jnp.int32(3), jnp.int32(5))
+    h2 = faults_mod.edge_hash(0, rounds, 7, jnp.int32(5), jnp.int32(3))
+    d1 = faults_mod.hash_bernoulli(h1, 0.5)
+    d2 = faults_mod.hash_bernoulli(h2, 0.5)
+    agree = float(jnp.mean(d1 == d2))
+    assert 0.3 < agree < 0.7, agree
+
+
+def test_total_link_drop_blocks_everything():
+    cfg = Config(n_nodes=8, seed=2)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, 8):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    st = st._replace(
+        faults=st.faults._replace(link_drop=jnp.float32(1.0)),
+        model=model.broadcast(st.model, 0, 0),
+    )
+    st = cl.steps(st, 40)
+    # Nothing crosses a fully lossy network: no deliveries, no spread.
+    assert int(st.stats.delivered) == 0
+    assert float(model.coverage(st.model, st.faults.alive, 0)) == 1 / 8
+    m = cl.manager.members(cfg, st.manager)
+    assert int(jnp.sum(m)) == 8 + 7  # self-knowledge + the join targets only
