@@ -61,3 +61,8 @@ class LocalComm:
     def allsum(self, x: Array) -> Array:
         """Sum a per-shard scalar across all shards (identity here)."""
         return x
+
+    def gather_vec(self, x: Array) -> Array:
+        """Concatenate a per-node local vector into the global one
+        (identity here; an all_gather on shards)."""
+        return x

@@ -55,8 +55,10 @@ class Manager(Protocol):
         omitted, local index == global id (single-device)."""
         ...
 
-    def members(self, cfg: Config, state: Any) -> Array:
-        """bool[n_local, n_global] — each node's view of the membership."""
+    def members(self, cfg: Config, state: Any,
+                comm: LocalComm | None = None) -> Array:
+        """bool[n_local, n_global] — each node's view of the membership.
+        ``comm`` supplies shard geometry; omitted => local == global."""
         ...
 
     def join(self, cfg: Config, state: Any, node: int, target: int) -> Any:

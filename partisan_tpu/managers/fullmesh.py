@@ -95,7 +95,8 @@ class FullMesh:
         peer = member & (all_ids[None, :] != gids[:, None])
         return jnp.where(peer, all_ids[None, :], jnp.int32(-1))
 
-    def members(self, cfg: Config, state: FullMeshState) -> Array:
+    def members(self, cfg: Config, state: FullMeshState,
+                comm: LocalComm | None = None) -> Array:
         return orset.members(state.view)
 
     # ---- scenario scripting (host-side) ------------------------------

@@ -1,0 +1,334 @@
+"""HyParView partial-view overlay manager.
+
+TPU rebuild of ``partisan_hyparview_peer_service_manager`` (reference
+src/partisan_hyparview_peer_service_manager.erl, paper-faithful moduledoc
+:20-215): each node keeps a small symmetric ACTIVE view (its overlay
+links) and a larger PASSIVE view (healing candidates), maintained by
+
+- JOIN / FORWARD_JOIN random walks with TTL = ARWL, depositing the
+  joiner into passive views at TTL == PRWL (:1234, :1381),
+- NEIGHBOR request/accept/reject with priority (high when isolated)
+  promoting passive peers into the active view (:1619-1746),
+- DISCONNECT demoting peers to passive (:1565),
+- periodic SHUFFLE random walks exchanging view samples (:1750-1795),
+- periodic random promotion when the active view is under-full (:1046),
+- crash healing: dead active peers are pruned (the TCP-EXIT failure
+  detector analogue, :1134-1186) and promotion refills the view.
+
+Tensor mapping: views are fixed-width id arrays (ops/views.py); ALL
+nodes' message handling runs as one ``vmap`` over a per-node
+``lax.scan`` across inbox slots, with ``lax.switch`` dispatch per
+message kind.  Every handled message may emit up to 2 replies into
+statically-allocated slots; the one JOIN fan-out per node per round gets
+its own A_MAX-slot block (excess JOINs re-queue to self for the next
+round).  Random-walk hops advance one virtual round per hop — the
+round→virtual-time calibration note in SURVEY.md §7 applies.
+
+Not yet implemented from the reference (tracked for later rounds):
+X-BOT overlay optimization (:1880-2050), reserved slots, epochs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import rng, views
+
+# Shuffle wire format: payload[0] = origin, payload[1:1+SAMPLE] = ids.
+SHUFFLE_SAMPLE = 7            # 3 active + 4 passive (k_a + k_p)
+MIN_MSG_WORDS = T.HDR_WORDS + 1 + SHUFFLE_SAMPLE
+
+# RNG stream tags (ops/rng.py discipline: distinct per call site).  The
+# per-slot range starts at 1000 so it can NEVER collide with the named
+# tags below (inbox_cap is far below 700).
+_TAG_SHUFFLE = 303
+_TAG_PROMOTE = 304
+_TAG_JOIN = 305
+_TAG_SLOT = 1000
+
+
+class HyParViewState(NamedTuple):
+    active: Array       # int32[n_local, active_max]
+    passive: Array      # int32[n_local, passive_max]
+    join_target: Array  # int32[n_local] — pending scripted JOIN (-1 none)
+    leaving: Array      # bool[n_local] — send disconnects THIS round
+    left: Array         # bool[n_local] — has left: inert until rejoin
+
+
+class HyParView:
+    name = "hyparview"
+
+    # ------------------------------------------------------------------
+    def init(self, cfg: Config, comm: LocalComm) -> HyParViewState:
+        if cfg.msg_words < MIN_MSG_WORDS:
+            raise ValueError(
+                f"hyparview needs msg_words >= {MIN_MSG_WORDS} "
+                f"(shuffle sample wire format), got {cfg.msg_words}")
+        n = comm.n_local
+        return HyParViewState(
+            active=views.empty_batch(n, cfg.hyparview.active_max),
+            passive=views.empty_batch(n, cfg.hyparview.passive_max),
+            join_target=jnp.full((n,), -1, jnp.int32),
+            leaving=jnp.zeros((n,), jnp.bool_),
+            left=jnp.zeros((n,), jnp.bool_),
+        )
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, state: HyParViewState,
+             ctx: RoundCtx) -> tuple[HyParViewState, Array]:
+        hv = cfg.hyparview
+        W = cfg.msg_words
+        n_local = state.active.shape[0]
+        gids = comm.local_ids()
+
+        # Failure detector: prune crash-stopped AND left peers from active
+        # views (connection EXIT -> on_down, reference :1489-1535: a left
+        # node's closed socket looks the same as a crashed one's).
+        reachable = ctx.faults.alive & ~comm.gather_vec(state.left)
+        active = jax.vmap(views.keep_only, in_axes=(0, None))(
+            state.active, reachable)
+
+        def per_node(me, key, active, passive, join_tgt, leaving, inbox_row):
+            """One node's whole round. Returns new views + emitted msgs."""
+
+            def mk(kind, dst, *, ttl=0, payload=()):
+                return msg_ops.build(W, kind, me, dst, ttl=ttl, payload=payload)
+
+            nomsg = jnp.zeros((W,), jnp.int32)
+
+            # ---- scripted join / leave (timer-ish, before the inbox) --
+            jkey = rng.subkey(key, _TAG_JOIN)
+            do_join = join_tgt >= 0
+            active, ev_j = views.add(
+                active, jnp.where(do_join, join_tgt, -1), jkey)
+            join_msg = jnp.where(do_join, mk(T.MsgKind.HPV_JOIN, join_tgt), nomsg)
+            join_ev_msg = mk(T.MsgKind.HPV_DISCONNECT, ev_j)  # -1 dst => NONE
+
+            # ---- inbox scan ---------------------------------------...
+            def handle(carry, x):
+                active, passive, fanout_joiner = carry
+                msg, slot = x
+                k = msg[T.W_KIND]
+                src = msg[T.W_SRC]
+                ttl = msg[T.W_TTL]
+                skey = rng.subkey(key, _TAG_SLOT + slot)
+                k1 = rng.subkey(skey, 1)
+                k2 = rng.subkey(skey, 2)
+                k3 = rng.subkey(skey, 3)
+
+                def b_noop(a, p, fj):
+                    return a, p, fj, nomsg, nomsg
+
+                def b_join(a, p, fj):
+                    # First JOIN this round is handled: joiner enters my
+                    # active view and gets fanned out (reference :1234);
+                    # later JOINs re-queue to self for next round.
+                    first = fj < 0
+                    a2, ev = views.add(a, jnp.where(first, src, -1), k1)
+                    p2 = views.remove(p, src)
+                    r0 = jnp.where(
+                        first,
+                        mk(T.MsgKind.HPV_DISCONNECT, ev),
+                        msg.at[T.W_DST].set(me),   # re-queue original JOIN
+                    )
+                    return (jnp.where(first, a2, a), jnp.where(first, p2, p),
+                            jnp.where(first, src, fj), r0, nomsg)
+
+                def b_forward_join(a, p, fj):
+                    j = msg[T.P0]
+                    nxt = views.pick_one(
+                        a, k2, exclude=jnp.stack([src, j, me]))
+                    stop = ((ttl <= 0) | (views.size(a) <= 1) | (nxt < 0)
+                            | views.contains(a, j))
+                    stop_ok = stop & (j != me) & ~views.contains(a, j)
+                    # stop: adopt the joiner (walk end, reference :1381)
+                    a2, ev = views.add(a, jnp.where(stop_ok, j, -1), k1)
+                    r0_stop = mk(T.MsgKind.HPV_DISCONNECT, ev)
+                    r1_stop = jnp.where(
+                        stop_ok, mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, j), nomsg)
+                    # continue: deposit at PRWL, forward the walk
+                    deposit = (ttl == hv.prwl) & (j != me)
+                    p2 = views.merge_sample(
+                        p, jnp.where(deposit, j, -1)[None], me, k3)
+                    fwd = msg.at[T.W_DST].set(nxt).at[T.W_SRC].set(me) \
+                             .at[T.W_TTL].set(ttl - 1)
+                    return (a2, jnp.where(stop, p, p2), fj,
+                            jnp.where(stop, r0_stop, fwd),
+                            jnp.where(stop, r1_stop, nomsg))
+
+                def b_neighbor(a, p, fj):
+                    accept = (msg[T.P0] == 1) | ~views.is_full(a)
+                    a2, ev = views.add(a, jnp.where(accept, src, -1), k1)
+                    p2 = jnp.where(accept, views.remove(p, src), p)
+                    r0 = jnp.where(
+                        accept,
+                        mk(T.MsgKind.HPV_DISCONNECT, ev),
+                        mk(T.MsgKind.HPV_NEIGHBOR_REJECTED, src))
+                    r1 = jnp.where(
+                        accept, mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, src), nomsg)
+                    return a2, p2, fj, r0, r1
+
+                def b_accepted(a, p, fj):
+                    a2, ev = views.add(a, src, k1)
+                    return (a2, views.remove(p, src), fj,
+                            mk(T.MsgKind.HPV_DISCONNECT, ev), nomsg)
+
+                def b_rejected(a, p, fj):
+                    return a, p, fj, nomsg, nomsg
+
+                def b_disconnect(a, p, fj):
+                    a2 = views.remove(a, src)
+                    p2 = views.merge_sample(p, src[None], me, k1)
+                    return a2, p2, fj, nomsg, nomsg
+
+                def b_shuffle(a, p, fj):
+                    origin = msg[T.P0]
+                    ids = jax.lax.dynamic_slice(
+                        msg, (T.P1,), (SHUFFLE_SAMPLE,))
+                    nxt = views.pick_one(
+                        a, k2, exclude=jnp.stack([src, origin, me]))
+                    fwd_ok = (ttl - 1 > 0) & (views.size(a) > 1) & (nxt >= 0)
+                    # integrate: sample ids + origin -> passive; reply with
+                    # my own passive sample directly to origin (:1750-1795)
+                    allids = jnp.concatenate([ids, origin[None]])
+                    p2 = views.merge_sample(p, allids, me, k1)
+                    mine = views.sample(p, k3, SHUFFLE_SAMPLE)
+                    reply = mk(T.MsgKind.HPV_SHUFFLE_REPLY,
+                               jnp.where(origin == me, -1, origin),
+                               payload=(me, *jnp.unstack(mine)))
+                    fwd = msg.at[T.W_DST].set(nxt).at[T.W_SRC].set(me) \
+                             .at[T.W_TTL].set(ttl - 1)
+                    return (a, jnp.where(fwd_ok, p, p2), fj,
+                            jnp.where(fwd_ok, fwd, reply), nomsg)
+
+                def b_shuffle_reply(a, p, fj):
+                    ids = jax.lax.dynamic_slice(
+                        msg, (T.P1,), (SHUFFLE_SAMPLE,))
+                    return a, views.merge_sample(p, ids, me, k1), fj, nomsg, nomsg
+
+                branches = [b_join, b_forward_join, b_neighbor, b_accepted,
+                            b_rejected, b_disconnect, b_shuffle,
+                            b_shuffle_reply, b_noop]
+                idx = jnp.where(
+                    (k >= T.MsgKind.HPV_JOIN) & (k <= T.MsgKind.HPV_SHUFFLE_REPLY),
+                    k - T.MsgKind.HPV_JOIN, len(branches) - 1)
+                a2, p2, fj2, r0, r1 = jax.lax.switch(
+                    idx, branches, active, passive, fanout_joiner)
+                return (a2, p2, fj2), jnp.stack([r0, r1])
+
+            (active, passive, fanout_joiner), replies = jax.lax.scan(
+                handle, (active, passive, jnp.int32(-1)),
+                (inbox_row, jnp.arange(inbox_row.shape[0])))
+            replies = replies.reshape(-1, W)   # [CAP*2, W]
+
+            # ---- fan-out block: forward_join OR leave-disconnects -----
+            # (a node processing a JOIN fans the walk to every active
+            # peer; a leaving node disconnects every active peer)
+            fj = fanout_joiner
+            tgt = jnp.where((active != fj) & (active >= 0), active, -1)
+            fanout_fj = jax.vmap(
+                lambda d: mk(T.MsgKind.HPV_FORWARD_JOIN, d,
+                             ttl=hv.arwl, payload=(fj,)))(tgt)
+            fanout_lv = jax.vmap(
+                lambda d: mk(T.MsgKind.HPV_DISCONNECT, d))(active)
+            fanout = jnp.where(leaving, fanout_lv,
+                               jnp.where(fj >= 0, fanout_fj, 0))
+
+            # ---- shuffle timer (:1078) --------------------------------
+            skey = rng.subkey(key, _TAG_SHUFFLE)
+            sh_fire = (ctx.rnd + me) % cfg.shuffle_every == 0
+            sh_tgt = views.pick_one(active, rng.subkey(skey, 1))
+            smp = jnp.concatenate([
+                views.sample(active, rng.subkey(skey, 2), hv.shuffle_k_active),
+                views.sample(passive, rng.subkey(skey, 3), hv.shuffle_k_passive),
+            ])[:SHUFFLE_SAMPLE]
+            shuffle_msg = jnp.where(
+                sh_fire & (sh_tgt >= 0),
+                mk(T.MsgKind.HPV_SHUFFLE, sh_tgt, ttl=hv.arwl,
+                   payload=(me, *jnp.unstack(smp))),
+                nomsg)
+
+            # ---- random promotion timer (:1046) -----------------------
+            pkey = rng.subkey(key, _TAG_PROMOTE)
+            pr_fire = ((ctx.rnd + me) % cfg.promotion_every == 0) & \
+                      (views.size(active) < hv.active_min)
+            pr_tgt = views.pick_one(passive, pkey, exclude=active)
+            promote_msg = jnp.where(
+                pr_fire & (pr_tgt >= 0),
+                mk(T.MsgKind.HPV_NEIGHBOR, pr_tgt,
+                   payload=(jnp.asarray(views.size(active) == 0, jnp.int32),)),
+                nomsg)
+
+            # leave: clear own views after disconnecting
+            active = jnp.where(leaving, -1, active)
+            passive = jnp.where(leaving, -1, passive)
+
+            emitted = jnp.concatenate([
+                replies, fanout,
+                jnp.stack([join_msg, join_ev_msg, shuffle_msg, promote_msg]),
+            ])
+            return active, passive, emitted
+
+        new_active, new_passive, emitted = jax.vmap(per_node)(
+            gids, ctx.keys, active, state.passive, state.join_target,
+            state.leaving, ctx.inbox.data)
+
+        # Crash-stopped and left nodes are frozen and silent (a left node
+        # is inert until a scripted rejoin — the reference's leaver shuts
+        # its partisan instance down, pluggable analogue :1790-1805).
+        # A node IS still live during its leave round (it must emit the
+        # disconnect fan-out), and a rejoin (join_target set) clears left.
+        live = ctx.alive & (~state.left | (state.join_target >= 0))
+        new_active = jnp.where(live[:, None], new_active, state.active)
+        new_passive = jnp.where(live[:, None], new_passive, state.passive)
+        emitted = emitted.at[..., T.W_KIND].set(
+            jnp.where(live[:, None], emitted[..., T.W_KIND], 0))
+
+        new_state = HyParViewState(
+            active=new_active,
+            passive=new_passive,
+            join_target=jnp.where(ctx.alive, -1, state.join_target),
+            leaving=jnp.where(live, False, state.leaving),
+            left=(state.left | (state.leaving & live))
+                 & ~(state.join_target >= 0),
+        )
+        return new_state, emitted
+
+    # ---- views -------------------------------------------------------
+    def neighbors(self, cfg: Config, state: HyParViewState,
+                  comm: LocalComm | None = None) -> Array:
+        return state.active
+
+    def members(self, cfg: Config, state: HyParViewState,
+                comm: LocalComm | None = None) -> Array:
+        """bool[n_local, n_global]: itself + its active view.  HyParView
+        keeps no global membership — the members/1 callback returns the
+        active view (reference moduledoc :20-215)."""
+        n_local = state.active.shape[0]
+        if comm is not None:
+            n_global, gids = comm.n_global, comm.local_ids()
+        else:
+            n_global, gids = n_local, jnp.arange(n_local, dtype=jnp.int32)
+        out = jnp.zeros((n_local, n_global), jnp.bool_)
+        out = out.at[jnp.arange(n_local), gids].set(True)
+        rows = jnp.repeat(jnp.arange(n_local), state.active.shape[1])
+        cols = jnp.where(state.active >= 0, state.active, n_global).reshape(-1)
+        return out.at[rows, cols].set(True, mode="drop")
+
+    # ---- scenario scripting ------------------------------------------
+    def join(self, cfg: Config, state: HyParViewState, node: int,
+             target: int) -> HyParViewState:
+        return state._replace(
+            join_target=state.join_target.at[node].set(target))
+
+    def leave(self, cfg: Config, state: HyParViewState, node: int) -> HyParViewState:
+        return state._replace(leaving=state.leaving.at[node].set(True))

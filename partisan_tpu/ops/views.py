@@ -1,0 +1,106 @@
+"""Fixed-width partial-view arrays (HyParView active/passive views,
+SCAMP partial/in views).
+
+A view is ``int32[K]`` of global node ids with -1 marking empty slots.
+The reference stores these as sets of node specs
+(partisan_hyparview_peer_service_manager.erl:230-243); K is a small
+protocol constant (active 6, passive 30 — include/partisan.hrl:204-217),
+so fixed-width arrays + masked ops vectorize cleanly under vmap.
+
+All ops are pure and per-node (1-D); batch with jax.vmap.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+EMPTY = -1
+
+
+def empty(k: int) -> Array:
+    return jnp.full((k,), EMPTY, jnp.int32)
+
+
+def empty_batch(n: int, k: int) -> Array:
+    return jnp.full((n, k), EMPTY, jnp.int32)
+
+
+def contains(view: Array, nid: Array) -> Array:
+    return jnp.any((view == nid) & (nid >= 0))
+
+
+def size(view: Array) -> Array:
+    return jnp.sum(view >= 0)
+
+
+def is_full(view: Array) -> Array:
+    return jnp.all(view >= 0)
+
+
+def add(view: Array, nid: Array, key: Array) -> tuple[Array, Array]:
+    """Insert ``nid``; if full, evict a RANDOM member to make room
+    (drop-random-if-full, add_to_active_view
+    partisan_hyparview_peer_service_manager.erl:2344-2420).
+
+    Returns (view', evicted) where evicted is the displaced id or -1.
+    No-op (evicted=-1) if nid already present or nid < 0.
+    """
+    k = view.shape[0]
+    already = contains(view, nid) | (nid < 0)
+    # Target slot: first empty, else random occupied.
+    has_empty = jnp.any(view == EMPTY)
+    first_empty = jnp.argmax(view == EMPTY)
+    rand_slot = jax.random.randint(key, (), 0, k)
+    slot = jnp.where(has_empty, first_empty, rand_slot)
+    evicted = jnp.where(has_empty, EMPTY, view[slot])
+    new = view.at[slot].set(nid)
+    view = jnp.where(already, view, new)
+    return view, jnp.where(already, EMPTY, evicted)
+
+
+def remove(view: Array, nid: Array) -> Array:
+    return jnp.where((view == nid) & (nid >= 0), EMPTY, view)
+
+
+def keep_only(view: Array, keep_mask_of_id) -> Array:
+    """Clear slots whose id fails ``keep_mask_of_id`` (bool[n_global]
+    lookup) — e.g. pruning dead active peers (TCP-EXIT analogue)."""
+    ids = jnp.where(view >= 0, view, 0)
+    ok = (view >= 0) & keep_mask_of_id[ids]
+    return jnp.where(ok, view, EMPTY)
+
+
+def sample(view: Array, key: Array, k: int, exclude: Array | None = None) -> Array:
+    """k distinct random members (-1 padded), optionally excluding ids."""
+    valid = view >= 0
+    if exclude is not None:
+        valid &= ~jnp.any(view[:, None] == exclude[None, :], axis=1)
+    g = jax.random.gumbel(key, view.shape)
+    score = jnp.where(valid, g, -jnp.inf)
+    _, top = jax.lax.top_k(score, k)
+    picked = view[top]
+    return jnp.where(valid[top], picked, EMPTY)
+
+
+def pick_one(view: Array, key: Array, exclude: Array | None = None) -> Array:
+    """One random member (or -1)."""
+    return sample(view, key, 1, exclude)[0]
+
+
+def merge_sample(view: Array, new_ids: Array, self_id: Array,
+                 key: Array) -> Array:
+    """Integrate a shuffle sample into a (passive) view: add each id not
+    already present / not self, evicting random entries when full
+    (merge_exchange, partisan_hyparview_peer_service_manager.erl:2569).
+    """
+    def body(v, x):
+        nid, k = x
+        ok = (nid >= 0) & (nid != self_id)
+        v2, _ = add(v, jnp.where(ok, nid, EMPTY), k)
+        return v2, None
+
+    keys = jax.random.split(key, new_ids.shape[0])
+    out, _ = jax.lax.scan(body, view, (new_ids, keys))
+    return out

@@ -91,6 +91,9 @@ class ShardComm:
         """Cross-shard scalar sum (keeps Stats replicated)."""
         return jax.lax.psum(x, AXIS)
 
+    def gather_vec(self, x: Array) -> Array:
+        return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
+
 
 @dataclasses.dataclass
 class ShardedCluster:

@@ -66,7 +66,7 @@ class MsgKind(enum.IntEnum):
     HPV_NEIGHBOR_REJECTED = 14
     HPV_DISCONNECT = 15
     HPV_SHUFFLE = 16         # payload: [origin, k_slots...]; W_TTL = walk
-    HPV_SHUFFLE_REPLY = 17   # payload: [k_slots...]
+    HPV_SHUFFLE_REPLY = 17   # payload: [origin, k_slots...] (same layout)
 
     # -- SCAMP (partisan_scamp_v1_membership_strategy.erl:67-297, v2)
     SCAMP_SUBSCRIPTION = 20       # forward_subscription; payload: [subscriber]

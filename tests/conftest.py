@@ -24,3 +24,8 @@ jax.config.update("jax_platforms", "cpu")
 from jax._src import xla_bridge  # noqa: E402
 
 xla_bridge._backend_factories.pop("axon", None)
+
+# Persistent compilation cache: the hyparview/plumtree round steps take
+# seconds to compile; cache across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,167 @@
+"""HyParView overlay tests — sim analogues of the reference suite's
+hyparview group (partisan_SUITE.erl:287-307): membership forms a connected
+overlay with bounded view sizes, heals around crashes, and supports
+transitive dissemination."""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.models.anti_entropy import AntiEntropy
+from partisan_tpu.parallel import ShardedCluster, make_mesh
+
+
+def hv_config(n, seed, **kw):
+    return Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                  msg_words=16, **kw)
+
+
+def staggered_join(cl, st, contact=0):
+    """Each node joins via the contact, a few per round (the reference
+    suite boots nodes one at a time, partisan_support.erl:46+)."""
+    cfg = cl.cfg
+    for base in range(1, cfg.n_nodes, 4):
+        m = st.manager
+        for i in range(base, min(base + 4, cfg.n_nodes)):
+            m = cl.manager.join(cfg, m, i, contact)
+        st = st._replace(manager=m)
+        st = cl.steps(st, 2)
+    return st
+
+
+def components(active, alive):
+    """Connected components of the overlay (undirected union of active
+    views), host-side."""
+    n = active.shape[0]
+    adj = collections.defaultdict(set)
+    for i in range(n):
+        if not alive[i]:
+            continue
+        for j in active[i]:
+            j = int(j)
+            if j >= 0 and alive[j]:
+                adj[i].add(j)
+                adj[j].add(i)
+    seen, comps = set(), []
+    for s in range(n):
+        if not alive[s] or s in seen:
+            continue
+        comp, stack = set(), [s]
+        while stack:
+            x = stack.pop()
+            if x in comp:
+                continue
+            comp.add(x)
+            stack.extend(adj[x] - comp)
+        seen |= comp
+        comps.append(comp)
+    return comps
+
+
+def test_overlay_forms_and_is_connected():
+    cfg = hv_config(32, seed=13)
+    cl = Cluster(cfg)
+    st = staggered_join(cl, cl.init())
+    st = cl.steps(st, 60)
+    active = np.asarray(st.manager.active)
+    alive = np.asarray(st.faults.alive)
+
+    sizes = (active >= 0).sum(axis=1)
+    assert sizes.max() <= cfg.hyparview.active_max
+    assert (sizes >= 1).all(), f"isolated nodes: {np.where(sizes == 0)[0]}"
+    comps = components(active, alive)
+    assert len(comps) == 1, f"overlay partitioned into {len(comps)} comps"
+    # Passive views populated by shuffles/walks.
+    passive_sizes = (np.asarray(st.manager.passive) >= 0).sum(axis=1)
+    assert passive_sizes.mean() > 2.0, passive_sizes.mean()
+    # No self-loops, no dead ids, no duplicate active entries.
+    for i in range(cfg.n_nodes):
+        row = [x for x in active[i] if x >= 0]
+        assert i not in row
+        assert len(row) == len(set(row))
+
+
+def test_active_views_mostly_symmetric():
+    cfg = hv_config(24, seed=3)
+    cl = Cluster(cfg)
+    st = staggered_join(cl, cl.init())
+    st = cl.steps(st, 80)
+    active = np.asarray(st.manager.active)
+    edges = {(i, int(j)) for i in range(cfg.n_nodes)
+             for j in active[i] if j >= 0}
+    sym = sum((b, a) in edges for (a, b) in edges) / max(len(edges), 1)
+    assert sym > 0.8, f"symmetry ratio {sym}"
+
+
+def test_crash_healing():
+    cfg = hv_config(32, seed=29)
+    cl = Cluster(cfg)
+    st = staggered_join(cl, cl.init())
+    st = cl.steps(st, 60)
+    f = st.faults
+    for node in (3, 7, 11, 19, 23):
+        f = faults_mod.crash(f, node)
+    st = st._replace(faults=f)
+    st = cl.steps(st, 80)
+    active = np.asarray(st.manager.active)
+    alive = np.asarray(st.faults.alive)
+    # Dead peers pruned from every live active view.
+    for i in np.where(alive)[0]:
+        for j in active[i]:
+            assert j < 0 or alive[int(j)], f"node {i} holds dead peer {j}"
+    comps = components(active, alive)
+    assert len(comps) == 1, f"overlay did not heal: {len(comps)} comps"
+
+
+def test_leave_disconnects():
+    cfg = hv_config(16, seed=5)
+    cl = Cluster(cfg)
+    st = staggered_join(cl, cl.init())
+    st = cl.steps(st, 40)
+    st = st._replace(manager=cl.manager.leave(cfg, st.manager, 4))
+    st = cl.steps(st, 20)
+    active = np.asarray(st.manager.active)
+    assert (active[4] < 0).all(), "leaver kept active peers"
+    for i in range(16):
+        if i != 4:
+            assert 4 not in active[i][active[i] >= 0], f"{i} kept leaver"
+
+
+def test_dissemination_over_overlay():
+    """Anti-entropy gossip rides the hyparview active views (transitive
+    delivery without full membership)."""
+    cfg = hv_config(32, seed=17)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = staggered_join(cl, cl.init())
+    st = cl.steps(st, 40)
+    st = st._replace(model=model.broadcast(st.model, node=9, slot=0))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
+        max_rounds=200, check_every=5)
+    assert r != -1, "gossip never covered the overlay"
+
+
+def test_sharded_parity():
+    cfg = hv_config(16, seed=77)
+    assert len(jax.devices()) >= 8
+
+    def run(make):
+        cl = make()
+        st = cl.init()
+        m = st.manager
+        for i in range(1, 16):
+            m = cl.manager.join(cfg, m, i, 0)
+        st = st._replace(manager=m)
+        return jax.device_get(cl.steps(st, 50))
+
+    a = run(lambda: Cluster(cfg))
+    b = run(lambda: ShardedCluster(cfg, make_mesh(8)))
+    assert (a.manager.active == b.manager.active).all()
+    assert (a.manager.passive == b.manager.passive).all()
