@@ -68,6 +68,17 @@ class HyParViewConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PlumtreeConfig:
+    """Plumtree broadcast-layer capacities (sim-specific backpressure knobs;
+    the reference's mailboxes are unbounded, SURVEY.md §7 "Hard parts")."""
+
+    push_slots: int = 4   # broadcast slots eager-pushed per node per round
+    lazy_cap: int = 8     # i_have messages per node per lazy tick
+    aae: bool = True      # exchange-tick handler anti-entropy
+                          # (partisan_plumtree_broadcast.erl:1040-1070)
+
+
+@dataclasses.dataclass(frozen=True)
 class ScampConfig:
     """SCAMP parameters (include/partisan.hrl:240-241)."""
 
@@ -118,6 +129,7 @@ class Config:
     # --- overlay parameter blocks --------------------------------------
     hyparview: HyParViewConfig = HyParViewConfig()
     scamp: ScampConfig = ScampConfig()
+    plumtree: PlumtreeConfig = PlumtreeConfig()
 
     # --- tensor capacities (sim-specific) ------------------------------
     inbox_cap: int = 32          # queued event messages per node per round
@@ -206,4 +218,6 @@ class Config:
             d["hyparview"] = HyParViewConfig(**d["hyparview"])
         if "scamp" in d and isinstance(d["scamp"], Mapping):
             d["scamp"] = ScampConfig(**d["scamp"])
+        if "plumtree" in d and isinstance(d["plumtree"], Mapping):
+            d["plumtree"] = PlumtreeConfig(**d["plumtree"])
         return cls(**d)

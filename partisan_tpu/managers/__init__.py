@@ -32,3 +32,14 @@ def get(name: str) -> "Manager":
         f"unknown peer_service_manager {name!r}: fullmesh|hyparview|"
         f"scamp_v1|scamp_v2|client_server|static"
     )
+
+
+def neighbor_width(cfg) -> int:
+    """Static width K of the configured manager's ``neighbors`` arrays —
+    lets layered handlers (plumtree) allocate per-link state at init."""
+    name = cfg.peer_service_manager
+    if name == "hyparview":
+        return cfg.hyparview.active_max
+    if name in ("scamp_v1", "scamp_v2"):
+        return cfg.scamp.partial_max
+    return cfg.n_nodes  # fullmesh / client_server / static: dense rows

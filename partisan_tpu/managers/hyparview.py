@@ -43,9 +43,12 @@ from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
 from partisan_tpu.ops import rng, views
 
-# Shuffle wire format: payload[0] = origin, payload[1:1+SAMPLE] = ids.
-SHUFFLE_SAMPLE = 7            # 3 active + 4 passive (k_a + k_p)
-MIN_MSG_WORDS = T.HDR_WORDS + 1 + SHUFFLE_SAMPLE
+# Shuffle wire format: payload[0] = origin, payload[1:1+S] = ids, where
+# S = shuffle_k_active + shuffle_k_passive (config-dependent).
+
+
+def _shuffle_sample(cfg: Config) -> int:
+    return cfg.hyparview.shuffle_k_active + cfg.hyparview.shuffle_k_passive
 
 # RNG stream tags (ops/rng.py discipline: distinct per call site).  The
 # per-slot range starts at 1000 so it can NEVER collide with the named
@@ -69,9 +72,10 @@ class HyParView:
 
     # ------------------------------------------------------------------
     def init(self, cfg: Config, comm: LocalComm) -> HyParViewState:
-        if cfg.msg_words < MIN_MSG_WORDS:
+        need = T.HDR_WORDS + 1 + _shuffle_sample(cfg)
+        if cfg.msg_words < need:
             raise ValueError(
-                f"hyparview needs msg_words >= {MIN_MSG_WORDS} "
+                f"hyparview needs msg_words >= {need} "
                 f"(shuffle sample wire format), got {cfg.msg_words}")
         n = comm.n_local
         return HyParViewState(
@@ -87,15 +91,22 @@ class HyParView:
              ctx: RoundCtx) -> tuple[HyParViewState, Array]:
         hv = cfg.hyparview
         W = cfg.msg_words
+        SAMPLE = _shuffle_sample(cfg)
         n_local = state.active.shape[0]
         gids = comm.local_ids()
 
         # Failure detector: prune crash-stopped AND left peers from active
         # views (connection EXIT -> on_down, reference :1489-1535: a left
-        # node's closed socket looks the same as a crashed one's).
+        # node's closed socket looks the same as a crashed one's).  Passive
+        # views shed them too — the reference discovers stale passive
+        # entries when a promotion's connect fails and moves on to the
+        # next candidate (:1619-1746); eager purging collapses that retry
+        # loop into one round.
         reachable = ctx.faults.alive & ~comm.gather_vec(state.left)
         active = jax.vmap(views.keep_only, in_axes=(0, None))(
             state.active, reachable)
+        passive_in = jax.vmap(views.keep_only, in_axes=(0, None))(
+            state.passive, reachable)
 
         def per_node(me, key, active, passive, join_tgt, leaving, inbox_row):
             """One node's whole round. Returns new views + emitted msgs."""
@@ -193,7 +204,7 @@ class HyParView:
                 def b_shuffle(a, p, fj):
                     origin = msg[T.P0]
                     ids = jax.lax.dynamic_slice(
-                        msg, (T.P1,), (SHUFFLE_SAMPLE,))
+                        msg, (T.P1,), (SAMPLE,))
                     nxt = views.pick_one(
                         a, k2, exclude=jnp.stack([src, origin, me]))
                     fwd_ok = (ttl - 1 > 0) & (views.size(a) > 1) & (nxt >= 0)
@@ -201,7 +212,7 @@ class HyParView:
                     # my own passive sample directly to origin (:1750-1795)
                     allids = jnp.concatenate([ids, origin[None]])
                     p2 = views.merge_sample(p, allids, me, k1)
-                    mine = views.sample(p, k3, SHUFFLE_SAMPLE)
+                    mine = views.sample(p, k3, SAMPLE)
                     reply = mk(T.MsgKind.HPV_SHUFFLE_REPLY,
                                jnp.where(origin == me, -1, origin),
                                payload=(me, *jnp.unstack(mine)))
@@ -212,7 +223,7 @@ class HyParView:
 
                 def b_shuffle_reply(a, p, fj):
                     ids = jax.lax.dynamic_slice(
-                        msg, (T.P1,), (SHUFFLE_SAMPLE,))
+                        msg, (T.P1,), (SAMPLE,))
                     return a, views.merge_sample(p, ids, me, k1), fj, nomsg, nomsg
 
                 branches = [b_join, b_forward_join, b_neighbor, b_accepted,
@@ -230,18 +241,21 @@ class HyParView:
                 (inbox_row, jnp.arange(inbox_row.shape[0])))
             replies = replies.reshape(-1, W)   # [CAP*2, W]
 
-            # ---- fan-out block: forward_join OR leave-disconnects -----
+            # ---- fan-out blocks: forward_join AND leave-disconnects ---
             # (a node processing a JOIN fans the walk to every active
-            # peer; a leaving node disconnects every active peer)
+            # peer; a leaving node disconnects every active peer — a
+            # leaving contact that just handled a JOIN must emit BOTH, so
+            # the joiner's walk is not silently eaten)
             fj = fanout_joiner
-            tgt = jnp.where((active != fj) & (active >= 0), active, -1)
+            tgt = jnp.where((active != fj) & (active >= 0) & (fj >= 0),
+                            active, -1)
             fanout_fj = jax.vmap(
                 lambda d: mk(T.MsgKind.HPV_FORWARD_JOIN, d,
                              ttl=hv.arwl, payload=(fj,)))(tgt)
             fanout_lv = jax.vmap(
-                lambda d: mk(T.MsgKind.HPV_DISCONNECT, d))(active)
-            fanout = jnp.where(leaving, fanout_lv,
-                               jnp.where(fj >= 0, fanout_fj, 0))
+                lambda d: mk(T.MsgKind.HPV_DISCONNECT,
+                             jnp.where(leaving, d, -1)))(active)
+            fanout = jnp.concatenate([fanout_fj, fanout_lv])
 
             # ---- shuffle timer (:1078) --------------------------------
             skey = rng.subkey(key, _TAG_SHUFFLE)
@@ -250,7 +264,7 @@ class HyParView:
             smp = jnp.concatenate([
                 views.sample(active, rng.subkey(skey, 2), hv.shuffle_k_active),
                 views.sample(passive, rng.subkey(skey, 3), hv.shuffle_k_passive),
-            ])[:SHUFFLE_SAMPLE]
+            ])[:SAMPLE]
             shuffle_msg = jnp.where(
                 sh_fire & (sh_tgt >= 0),
                 mk(T.MsgKind.HPV_SHUFFLE, sh_tgt, ttl=hv.arwl,
@@ -279,7 +293,7 @@ class HyParView:
             return active, passive, emitted
 
         new_active, new_passive, emitted = jax.vmap(per_node)(
-            gids, ctx.keys, active, state.passive, state.join_target,
+            gids, ctx.keys, active, passive_in, state.join_target,
             state.leaving, ctx.inbox.data)
 
         # Crash-stopped and left nodes are frozen and silent (a left node

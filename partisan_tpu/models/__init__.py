@@ -4,3 +4,5 @@ top of any manager: anti-entropy, rumor mongering, direct mail, broadcast
 (plumtree-backed), primary-backup, 2PC/3PC."""
 
 from partisan_tpu.models.base import Model  # noqa: F401
+from partisan_tpu.models.anti_entropy import AntiEntropy  # noqa: F401
+from partisan_tpu.models.plumtree import Plumtree  # noqa: F401

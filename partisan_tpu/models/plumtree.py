@@ -1,0 +1,279 @@
+"""Plumtree epidemic broadcast trees (partisan_plumtree_broadcast.erl).
+
+Reference behavior: per-root EAGER/LAZY peer sets carve a spanning tree
+out of the overlay. A broadcast eager-pushes down tree links; receiving a
+duplicate moves the sender to lazy and sends PRUNE (:843-857); lazy links
+carry periodic I_HAVE adverts (flushed every lazy_tick, :990-1030); a
+receiver missing an advertised message sends GRAFT, which re-activates the
+link and re-sends the payload (:861-905); AAE exchanges with a random peer
+every exchange_tick (:1040-1070).
+
+TPU mapping (one tensor program per round, layered over ANY manager):
+
+- the handler store (partisan_plumtree_broadcast_handler behaviour) is a
+  bounded slot table ``data int32[n, B]`` merged by elementwise max — the
+  monotonic-payload semantic of the default heartbeat handler
+  (partisan_plumtree_backend.erl:191-260): a slot's payload is a version
+  counter, re-broadcasts bump it and re-propagate,
+- eager/lazy sets become ``pruned bool[n, B, K]`` flags over the overlay's
+  K neighbor slots: eager(b, k) = link k alive and not pruned for tree b.
+  The reference keys trees by broadcast ROOT; we key by broadcast slot
+  (identical while roots are distinct — a per-root tree cache is a later
+  optimization). Overlay churn invalidates flags per link slot, which is
+  the membership-update ``neighbors_down`` pruning (:910-950),
+- per-round emission is bounded: ``push_slots`` fresh slots per node per
+  round (excess carried over in ``need_push``) and ``lazy_cap`` I_HAVEs
+  per lazy tick — the sim analogue of mailbox backpressure; I_HAVEs repeat
+  every tick until acked by GRAFT or IGNORED_I_HAVE, the reference's
+  outstanding-ETS retransmission contract (:880-905).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import managers as managers_mod
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import BROADCAST_CHANNEL, Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import rng
+
+_TAG_AAE = 401
+_AAE_EDGE_TAG = 402
+
+
+class PlumtreeState(NamedTuple):
+    data: Array          # int32[n, B] — handler store (version per slot)
+    rround: Array        # int32[n, B] — tree hop distance of our copy
+    pruned: Array        # bool[n, B, K] — link k demoted to lazy for tree b
+    lazy_pending: Array  # bool[n, B, K] — outstanding i_have to link k
+    need_push: Array     # bool[n, B] — fresh slot awaiting eager push
+    push_src: Array      # int32[n, B] — eager parent (excluded from push)
+    tree_nbrs: Array     # int32[n, K] — link occupants flags refer to
+
+
+class Plumtree:
+    name = "plumtree"
+
+    def init(self, cfg: Config, comm: LocalComm) -> PlumtreeState:
+        n, B = comm.n_local, cfg.max_broadcasts
+        K = managers_mod.neighbor_width(cfg)
+        return PlumtreeState(
+            data=jnp.zeros((n, B), jnp.int32),
+            rround=jnp.zeros((n, B), jnp.int32),
+            pruned=jnp.zeros((n, B, K), jnp.bool_),
+            lazy_pending=jnp.zeros((n, B, K), jnp.bool_),
+            need_push=jnp.zeros((n, B), jnp.bool_),
+            push_src=jnp.full((n, B), -1, jnp.int32),
+            tree_nbrs=jnp.full((n, K), -1, jnp.int32),
+        )
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, state: PlumtreeState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[PlumtreeState, Array]:
+        pt = cfg.plumtree
+        W = cfg.msg_words
+        n_local, B = state.data.shape
+        K = nbrs.shape[1]
+        S, L = pt.push_slots, pt.lazy_cap
+        CH = cfg.channel_id(BROADCAST_CHANNEL)
+        gids = comm.local_ids()
+
+        # Overlay churn: a link slot with a new occupant sheds its flags
+        # (neighbors_down/up membership handling, reference :910-950).
+        changed = nbrs != state.tree_nbrs                       # [n, K]
+        pruned0 = state.pruned & ~changed[:, None, :]
+        lazyp0 = state.lazy_pending & ~changed[:, None, :]
+
+        def per_node(me, nbrs_row, pruned, lazyp, data, rr, npu, psrc,
+                     inbox_row):
+            def mk(kind, dst, payload=()):
+                return msg_ops.build(W, kind, me, dst, channel=CH,
+                                     payload=payload)
+
+            nomsg = jnp.zeros((W,), jnp.int32)
+
+            def slot_of(src):
+                hit = (nbrs_row == src) & (src >= 0)
+                return jnp.where(hit.any(), jnp.argmax(hit), -1)
+
+            # ---- inbox scan ---------------------------------------
+            def handle(carry, msg):
+                pruned, lazyp, data, rr, npu, psrc = carry
+                kind = msg[T.W_KIND]
+                src = msg[T.W_SRC]
+                b = jnp.clip(msg[T.P0], 0, B - 1)
+                ver = msg[T.P1]
+                mr = msg[T.P2]
+                ks = slot_of(src)
+                ks_ok = ks >= 0
+                ki = jnp.where(ks_ok, ks, 0)
+
+                def b_gossip(pruned, lazyp, data, rr, npu, psrc):
+                    fresh = ver > data[b]
+                    data2 = data.at[b].max(ver)
+                    rr2 = rr.at[b].set(jnp.where(fresh, mr + 1, rr[b]))
+                    npu2 = npu.at[b].set(npu[b] | fresh)
+                    psrc2 = psrc.at[b].set(jnp.where(fresh, src, psrc[b]))
+                    # fresh: add_eager(sender); stale: demote sender + PRUNE
+                    pr2 = pruned.at[b, ki].set(
+                        jnp.where(ks_ok, ~fresh, pruned[b, ki]))
+                    reply = jnp.where(fresh, nomsg,
+                                      mk(T.MsgKind.PT_PRUNE, src,
+                                         payload=(b,)))
+                    return pr2, lazyp, data2, rr2, npu2, psrc2, reply
+
+                def b_ihave(pruned, lazyp, data, rr, npu, psrc):
+                    missing = ver > data[b]
+                    pr2 = pruned.at[b, ki].set(
+                        jnp.where(ks_ok & missing, False, pruned[b, ki]))
+                    reply = jnp.where(
+                        missing,
+                        mk(T.MsgKind.PT_GRAFT, src, payload=(b, ver)),
+                        mk(T.MsgKind.PT_IHAVE_ACK, src, payload=(b, ver)))
+                    return pr2, lazyp, data, rr, npu, psrc, reply
+
+                def b_graft(pruned, lazyp, data, rr, npu, psrc):
+                    pr2 = pruned.at[b, ki].set(
+                        jnp.where(ks_ok, False, pruned[b, ki]))
+                    lz2 = lazyp.at[b, ki].set(
+                        jnp.where(ks_ok, False, lazyp[b, ki]))
+                    reply = jnp.where(
+                        data[b] > 0,
+                        mk(T.MsgKind.PT_GOSSIP, src,
+                           payload=(b, data[b], rr[b])),
+                        nomsg)
+                    return pr2, lz2, data, rr, npu, psrc, reply
+
+                def b_prune(pruned, lazyp, data, rr, npu, psrc):
+                    pr2 = pruned.at[b, ki].set(
+                        jnp.where(ks_ok, True, pruned[b, ki]))
+                    return pr2, lazyp, data, rr, npu, psrc, nomsg
+
+                def b_ack(pruned, lazyp, data, rr, npu, psrc):
+                    lz2 = lazyp.at[b, ki].set(
+                        jnp.where(ks_ok, False, lazyp[b, ki]))
+                    return pruned, lz2, data, rr, npu, psrc, nomsg
+
+                def b_noop(pruned, lazyp, data, rr, npu, psrc):
+                    return pruned, lazyp, data, rr, npu, psrc, nomsg
+
+                branches = [b_gossip, b_ihave, b_graft, b_prune, b_ack,
+                            b_noop]
+                idx = jnp.where(
+                    (kind >= T.MsgKind.PT_GOSSIP)
+                    & (kind <= T.MsgKind.PT_IHAVE_ACK),
+                    kind - T.MsgKind.PT_GOSSIP, len(branches) - 1)
+                *carry2, reply = jax.lax.switch(
+                    idx, branches, pruned, lazyp, data, rr, npu, psrc)
+                return tuple(carry2), reply
+
+            (pruned, lazyp, data, rr, npu, psrc), replies = jax.lax.scan(
+                handle, (pruned, lazyp, data, rr, npu, psrc), inbox_row)
+
+            # ---- eager push: up to S carried-over fresh slots ------
+            pend = npu & (data > 0)
+            prio = jnp.where(pend, B - jnp.arange(B), 0)
+            pv, sel = jax.lax.top_k(prio, S)
+            sel_ok = pv > 0
+
+            def push_one(b, ok):
+                eager = (nbrs_row >= 0) & ~pruned[b] & (nbrs_row != psrc[b])
+                dst = jnp.where(ok & eager, nbrs_row, -1)
+                msgs = jax.vmap(
+                    lambda d: mk(T.MsgKind.PT_GOSSIP, d,
+                                 payload=(b, data[b], rr[b])))(dst)
+                lazy_new = ok & (nbrs_row >= 0) & pruned[b]
+                return msgs, lazy_new
+
+            push_msgs, lazy_new = jax.vmap(push_one)(sel, sel_ok)
+            lazyp = lazyp.at[sel].set(lazyp[sel] | lazy_new)
+            npu = npu.at[sel].set(jnp.where(sel_ok, False, npu[sel]))
+
+            # ---- lazy tick: flush up to L outstanding i_haves ------
+            fire = (ctx.rnd + me) % cfg.lazy_tick_every == 0
+            flat = (lazyp & (nbrs_row >= 0)[None, :]).reshape(B * K)
+            lprio = jnp.where(flat & fire, B * K - jnp.arange(B * K), 0)
+            lv, li = jax.lax.top_k(lprio, L)
+            bi, kix = li // K, li % K
+            ihave_msgs = jax.vmap(
+                lambda ok, b, k: mk(T.MsgKind.PT_IHAVE,
+                                    jnp.where(ok, nbrs_row[k], -1),
+                                    payload=(b, data[b])))(lv > 0, bi, kix)
+
+            emitted = jnp.concatenate(
+                [replies, push_msgs.reshape(-1, W), ihave_msgs])
+            return pruned, lazyp, data, rr, npu, psrc, emitted
+
+        (pruned, lazyp, data, rr, npu, psrc, emitted) = jax.vmap(per_node)(
+            gids, nbrs, pruned0, lazyp0, state.data, state.rround,
+            state.need_push, state.push_src, ctx.inbox.data)
+
+        # ---- AAE exchange tick (handler exchange, :1040-1070): push the
+        # whole store to one random peer on the monotonic state lane.  The
+        # reference exchange is a session between two nodes; the one-way
+        # periodic push converges identically under symmetric firing.
+        if pt.aae:
+            fires = ((ctx.rnd + gids) % cfg.exchange_tick_every == 0) \
+                    & ctx.alive
+
+            def pick(key, row, fire):
+                slots = rng.choice_slots(
+                    rng.subkey(key, _TAG_AAE), row >= 0, 1)
+                t = jnp.where(slots >= 0, row[slots], jnp.int32(-1))
+                return jnp.where(fire, t, jnp.int32(-1))
+
+            tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)    # [n, 1]
+            tgt = faults_mod.filter_edges(
+                ctx.faults, gids, tgt, cfg.seed, ctx.rnd, _AAE_EDGE_TAG)
+            pulled = comm.push_max(data, tgt)
+            data = jnp.maximum(data, jnp.where(ctx.alive[:, None], pulled, 0))
+
+        # Crash-stopped nodes are frozen and silent.
+        dead = ~ctx.alive
+
+        def keep(new, old):
+            return jnp.where(
+                dead.reshape((-1,) + (1,) * (new.ndim - 1)), old, new)
+
+        emitted = emitted.at[..., T.W_KIND].set(
+            jnp.where(dead[:, None], 0, emitted[..., T.W_KIND]))
+        new_state = PlumtreeState(
+            data=keep(data, state.data),
+            rround=keep(rr, state.rround),
+            pruned=keep(pruned, state.pruned),
+            lazy_pending=keep(lazyp, state.lazy_pending),
+            need_push=keep(npu, state.need_push),
+            push_src=keep(psrc, state.push_src),
+            tree_nbrs=keep(nbrs, state.tree_nbrs),
+        )
+        return new_state, emitted
+
+    # ---- scenario helpers (broadcast/2, partisan.erl:1556) -----------
+    def broadcast(self, state: PlumtreeState, node: int, slot: int,
+                  version: int = 1) -> PlumtreeState:
+        return state._replace(
+            data=state.data.at[node, slot].max(version),
+            need_push=state.need_push.at[node, slot].set(True),
+            push_src=state.push_src.at[node, slot].set(-1),
+        )
+
+    def coverage(self, state: PlumtreeState, alive: Array, slot: int,
+                 version: int = 1) -> Array:
+        have = (state.data[:, slot] >= version) & alive
+        return jnp.sum(have) / jnp.maximum(jnp.sum(alive), 1)
+
+    def eager_degree(self, state: PlumtreeState, slot: int) -> Array:
+        """Mean eager out-degree for a tree — flood = overlay degree,
+        converged tree ~ spanning-tree degree (debug_get_tree analogue,
+        partisan_plumtree_broadcast.erl:179-188)."""
+        live = state.tree_nbrs >= 0
+        eager = live & ~state.pruned[:, slot, :]
+        return jnp.sum(eager) / state.data.shape[0]

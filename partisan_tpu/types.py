@@ -74,10 +74,11 @@ class MsgKind(enum.IntEnum):
     SCAMP_KEEPALIVE = 22          # periodic ping for isolation detection (v2)
 
     # -- Plumtree (partisan_plumtree_broadcast.erl:843-905)
-    PT_GOSSIP = 30      # eager push; payload: [slot, root, msg_round]
-    PT_IHAVE = 31       # lazy advert; payload: [slot, root]
-    PT_GRAFT = 32       # payload: [slot, root]
-    PT_PRUNE = 33       # payload: []
+    PT_GOSSIP = 30      # eager push; payload: [slot, version, msg_round]
+    PT_IHAVE = 31       # lazy advert; payload: [slot, version]
+    PT_GRAFT = 32       # payload: [slot, version]
+    PT_PRUNE = 33       # payload: [slot]
+    PT_IHAVE_ACK = 34   # ignored_i_have ack (:861-876); payload: [slot, version]
 
     # -- application / protocol corpus (models/)
     APP = 40            # payload: model-defined

@@ -1,0 +1,75 @@
+"""Shared test fixtures — the multi-node-without-a-cluster fixture
+analogue (reference test/partisan_support.erl:46+): config factories,
+staggered bootstrap, and host-side overlay graph checks."""
+
+import collections
+
+from partisan_tpu.config import Config
+
+
+def hv_config(n, seed, **kw):
+    kw.setdefault("msg_words", 16)
+    return Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                  **kw)
+
+
+def fm_config(n, seed, **kw):
+    kw.setdefault("inbox_cap", max(32, n + 8))
+    return Config(n_nodes=n, seed=seed, **kw)
+
+
+def boot_fullmesh(cl, contact=0, settle=15):
+    """All nodes join via the contact, then membership gossip settles."""
+    st = cl.init()
+    m = st.manager
+    for i in range(cl.cfg.n_nodes):
+        if i != contact:
+            m = cl.manager.join(cl.cfg, m, i, contact)
+    st = st._replace(manager=m)
+    return cl.steps(st, settle)
+
+
+def staggered_join(cl, st, contact=0):
+    """Each node joins via the contact, a few per round (the reference
+    suite boots nodes one at a time, partisan_support.erl:46+)."""
+    cfg = cl.cfg
+    for base in range(1, cfg.n_nodes, 4):
+        m = st.manager
+        for i in range(base, min(base + 4, cfg.n_nodes)):
+            m = cl.manager.join(cfg, m, i, contact)
+        st = st._replace(manager=m)
+        st = cl.steps(st, 2)
+    return st
+
+
+def boot_hyparview(cl, settle=40):
+    return cl.steps(staggered_join(cl, cl.init()), settle)
+
+
+def components(active, alive):
+    """Connected components of the overlay (undirected union of active
+    views), host-side."""
+    n = active.shape[0]
+    adj = collections.defaultdict(set)
+    for i in range(n):
+        if not alive[i]:
+            continue
+        for j in active[i]:
+            j = int(j)
+            if j >= 0 and alive[j]:
+                adj[i].add(j)
+                adj[j].add(i)
+    seen, comps = set(), []
+    for s in range(n):
+        if not alive[s] or s in seen:
+            continue
+        comp, stack = set(), [s]
+        while stack:
+            x = stack.pop()
+            if x in comp:
+                continue
+            comp.add(x)
+            stack.extend(adj[x] - comp)
+        seen |= comp
+        comps.append(comp)
+    return comps

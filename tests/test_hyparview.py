@@ -3,65 +3,15 @@ hyparview group (partisan_SUITE.erl:287-307): membership forms a connected
 overlay with bounded view sizes, heals around crashes, and supports
 transitive dissemination."""
 
-import collections
-
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from partisan_tpu.cluster import Cluster
-from partisan_tpu.config import Config
 from partisan_tpu import faults as faults_mod
 from partisan_tpu.models.anti_entropy import AntiEntropy
 from partisan_tpu.parallel import ShardedCluster, make_mesh
 
-
-def hv_config(n, seed, **kw):
-    return Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
-                  msg_words=16, **kw)
-
-
-def staggered_join(cl, st, contact=0):
-    """Each node joins via the contact, a few per round (the reference
-    suite boots nodes one at a time, partisan_support.erl:46+)."""
-    cfg = cl.cfg
-    for base in range(1, cfg.n_nodes, 4):
-        m = st.manager
-        for i in range(base, min(base + 4, cfg.n_nodes)):
-            m = cl.manager.join(cfg, m, i, contact)
-        st = st._replace(manager=m)
-        st = cl.steps(st, 2)
-    return st
-
-
-def components(active, alive):
-    """Connected components of the overlay (undirected union of active
-    views), host-side."""
-    n = active.shape[0]
-    adj = collections.defaultdict(set)
-    for i in range(n):
-        if not alive[i]:
-            continue
-        for j in active[i]:
-            j = int(j)
-            if j >= 0 and alive[j]:
-                adj[i].add(j)
-                adj[j].add(i)
-    seen, comps = set(), []
-    for s in range(n):
-        if not alive[s] or s in seen:
-            continue
-        comp, stack = set(), [s]
-        while stack:
-            x = stack.pop()
-            if x in comp:
-                continue
-            comp.add(x)
-            stack.extend(adj[x] - comp)
-        seen |= comp
-        comps.append(comp)
-    return comps
+from support import components, hv_config, staggered_join
 
 
 def test_overlay_forms_and_is_connected():

@@ -1,0 +1,117 @@
+"""Plumtree broadcast tests — sim analogues of the reference suite's
+with_broadcast group (partisan_SUITE.erl:214-315): full dissemination over
+full-mesh and hyparview overlays, tree convergence via prunes, lazy-link
+repair via i_have/graft under message loss, and sharded parity."""
+
+import jax
+import numpy as np
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.models.plumtree import Plumtree
+from partisan_tpu.parallel import ShardedCluster, make_mesh
+
+from support import boot_fullmesh, boot_hyparview, fm_config, hv_config
+
+
+def test_broadcast_covers_fullmesh():
+    cfg = fm_config(16, seed=11)
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    st = st._replace(model=model.broadcast(st.model, node=3, slot=0))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
+        max_rounds=60, check_every=2)
+    assert r != -1, "broadcast never covered the cluster"
+
+
+def test_tree_converges_via_prunes():
+    """After a few broadcasts, stale-duplicate prunes carve the flood down
+    toward a spanning tree (handle_broadcast stale path, reference
+    :843-857): mean eager degree falls well below the full-mesh degree."""
+    cfg = fm_config(16, seed=23)
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    flood_degree = cfg.n_nodes - 1
+    for ver in range(1, 5):  # re-broadcasts bump the slot version
+        st = st._replace(model=model.broadcast(st.model, 3, 0, version=ver))
+        st = cl.steps(st, 12)
+    assert float(model.coverage(st.model, st.faults.alive, 0, version=4)) == 1.0
+    deg = float(model.eager_degree(st.model, 0))
+    assert deg < 0.5 * flood_degree, (
+        f"eager degree {deg} did not shrink from flood {flood_degree}")
+    # The eager subgraph still spans the cluster: a fresh version over the
+    # pruned tree reaches everyone.
+    st = st._replace(model=model.broadcast(st.model, 3, 0, version=9))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0, 9)) == 1.0,
+        max_rounds=40, check_every=2)
+    assert r != -1, "pruned tree no longer spans the cluster"
+
+
+def test_lazy_repair_under_link_drops():
+    """Driver config #3: 5%+ link drops; i_have/graft repairs holes
+    (reference :861-905)."""
+    cfg = fm_config(16, seed=31)
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    st = st._replace(faults=st.faults._replace(link_drop=np.float32(0.2)))
+    for ver in (1, 2):
+        st = st._replace(model=model.broadcast(st.model, 5, 1, version=ver))
+        st, r = cl.run_until(
+            st,
+            lambda s, v=ver: float(
+                model.coverage(s.model, s.faults.alive, 1, v)) == 1.0,
+            max_rounds=150, check_every=5)
+        assert r != -1, f"version {ver} never repaired to full coverage"
+
+
+def test_broadcast_over_hyparview():
+    cfg = hv_config(32, seed=17)
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = boot_hyparview(cl)
+    st = st._replace(model=model.broadcast(st.model, node=9, slot=2))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(s.model, s.faults.alive, 2)) == 1.0,
+        max_rounds=120, check_every=5)
+    assert r != -1, "broadcast never covered the hyparview overlay"
+
+
+def test_concurrent_broadcast_slots():
+    cfg = fm_config(16, seed=41)
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    m = st.model
+    for slot in range(6):
+        m = model.broadcast(m, node=slot, slot=slot)
+    st = st._replace(model=m)
+
+    def all_covered(s):
+        return all(
+            float(model.coverage(s.model, s.faults.alive, b)) == 1.0
+            for b in range(6))
+
+    st, r = cl.run_until(st, all_covered, max_rounds=80, check_every=4)
+    assert r != -1, "concurrent broadcasts did not all converge"
+
+
+def test_sharded_parity():
+    cfg = fm_config(16, seed=77)
+    assert len(jax.devices()) >= 8
+    model = Plumtree()
+
+    def run(make):
+        cl = make()
+        st = boot_fullmesh(cl)
+        st = st._replace(model=model.broadcast(st.model, 0, 0))
+        return jax.device_get(cl.steps(st, 30))
+
+    a = run(lambda: Cluster(cfg, model=model))
+    b = run(lambda: ShardedCluster(cfg, make_mesh(8), model=model))
+    assert (a.model.data == b.model.data).all()
+    assert (a.model.pruned == b.model.pruned).all()
+    assert (a.model.lazy_pending == b.model.lazy_pending).all()
