@@ -121,6 +121,35 @@ def filter_msgs(faults: FaultState, emitted: Array, seed: int, rnd: Array,
     )
 
 
+# --- churn engine (driver config #4: SCAMP v2 + churn) ------------------
+
+_CHURN_DEATH_TAG = 31
+_CHURN_BIRTH_TAG = 32
+
+
+def churn_step(faults: FaultState, seed: int, rnd: Array, death_p,
+               birth_p) -> FaultState:
+    """One round of a birth/death process over the alive mask
+    (SURVEY.md §7 step 5: "churn = per-round birth/death process mutating
+    alive mask"; the live-system analogue is crash-stop + node
+    resurrection, partisan_membership_set.erl:23-60 staleness semantics).
+
+    Each alive node crash-stops with probability ``death_p`` and each dead
+    node revives with probability ``birth_p``.  Decisions come from the
+    counter-based hash (same discipline as edge faults) so a churn
+    trajectory is a pure function of (seed, round) — replayable and
+    placement-invariant.  Jit-safe: call inside a scenario's round loop.
+    """
+    n = faults.alive.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    die = hash_bernoulli(
+        edge_hash(seed, rnd, _CHURN_DEATH_TAG, ids, ids), death_p)
+    born = hash_bernoulli(
+        edge_hash(seed, rnd, _CHURN_BIRTH_TAG, ids, ids), birth_p)
+    alive = jnp.where(faults.alive, ~die, born)
+    return faults._replace(alive=alive)
+
+
 # --- scenario scripting (host-side, between jitted steps) ---------------
 
 def crash(faults: FaultState, node: int) -> FaultState:

@@ -1,0 +1,384 @@
+"""SCAMP membership strategies, v1 and v2.
+
+TPU rebuild of ``partisan_scamp_v1_membership_strategy`` (reference
+src/partisan_scamp_v1_membership_strategy.erl) and
+``partisan_scamp_v2_membership_strategy`` (src/partisan_scamp_v2_
+membership_strategy.erl), after the SCAMP papers they cite
+(scamp-ngc.pdf / hiscamp-sigops.pdf):
+
+- **subscription walks**: a joiner subscribes through a contact; the
+  contact fans the subscription out to its whole partial view plus ``c``
+  extra copies (v1; ``c - 1`` in v2 — scamp_v2 :119-134); each copy is
+  kept with probability P = 1/(1 + |view|) or forwarded to one random
+  member (v1 :264-297, v2 :313-341).  View sizes self-stabilize to
+  (c+1)·log n.
+- **isolation detection** (both versions, v1 :173-216): periodic pings to
+  the partial view; a node that hears nothing for
+  ``message_window`` periodic intervals re-subscribes via a random
+  member.
+- **v2 in-view accounting**: a keeper notifies the subscriber with
+  ``keep_subscription`` so it can track its in-edges (:342-347).
+- **v2 graceful unsubscription** (:230-274): the leaver tells the first
+  ``L - (c - 1)`` of its in-view to *replace* their edge with one of the
+  leaver's partial-view members (round-robin) and the remainder to
+  *remove* it, preserving the scaling relation.
+- **remove_subscription gossip** (v1 :230-262): removals propagate
+  epidemically — a node that removes a present member re-gossips the
+  removal to its (pre-removal) view.
+
+Documented deviations from the reference (not the paper):
+- The reference's ``random_0_or_1/0`` (v1 :322-329) makes the keep
+  probability a constant 0.4 regardless of view size; we implement the
+  paper rule P = 1/(1 + |view|) that the adjacent comment states.  The
+  stored view excludes self (the reference's includes it), so the rule
+  reads 1/(2 + stored_size).
+- Forwarded subscriptions carry a TTL (reference walks are unbounded;
+  with the paper keep-rule the expected walk length is ~|view| hops, so
+  a generous TTL bounds the tensor program without changing behavior).
+  On expiry the subscription is force-kept, honoring the paper's "not
+  destroyed until some node keeps them" (cited at scamp_v2 :121-124).
+- The contact-side fanout follows the paper; the reference performs the
+  equivalent fanout joiner-side inside ``join/3`` (v1 :69-119) where
+  both orderings coincide for a fresh joiner.
+
+Tensor mapping: partial/in views are fixed-width id arrays
+(ops/views.py); message handling is one ``vmap`` over a per-node
+``lax.scan`` across inbox slots (same skeleton as managers/hyparview.py);
+pings ride the monotonic state-gossip lane (``comm.push_max`` of the
+round number along partial-view edges) instead of event-message slots —
+the reference's ping is exactly a monotonic-channel heartbeat.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import rng, views
+
+# rng subkey tags: 42x — distinct from hyparview (30x) AND the model
+# layer (20x anti-entropy, 40x plumtree), since manager and model draw
+# from the same per-node round keys.
+_TAG_JOIN = 421
+_TAG_ISOLATION = 422
+_TAG_FANOUT = 423
+_TAG_SLOT = 1000
+
+_PING_EDGE_TAG = 424
+_WALK_TTL = 32  # forwarded-subscription hop budget (deviation note above)
+
+
+class ScampState(NamedTuple):
+    partial: Array        # int32[n_local, partial_max] — out-edges (no self)
+    in_view: Array        # int32[n_local, in_max] — in-edges (v2; unused v1)
+    last_heard: Array     # int32[n_local] — round of last ping heard + 1 (0 = never)
+    join_target: Array    # int32[n_local] — pending scripted join (-1 none)
+    leaving: Array        # bool[n_local]
+    left: Array           # bool[n_local]
+
+
+class Scamp:
+    """Both SCAMP versions; ``v2`` toggles in-view tracking, keep
+    notifications, the graceful-unsubscription rebalance and the c-1
+    join fanout."""
+
+    def __init__(self, version: int = 1) -> None:
+        if version not in (1, 2):
+            raise ValueError(f"scamp version must be 1 or 2, got {version}")
+        self.v2 = version == 2
+        self.name = f"scamp_v{version}"
+
+    # ------------------------------------------------------------------
+    def init(self, cfg: Config, comm: LocalComm) -> ScampState:
+        n = comm.n_local
+        return ScampState(
+            partial=views.empty_batch(n, cfg.scamp.partial_max),
+            in_view=views.empty_batch(n, cfg.scamp.in_max),
+            last_heard=jnp.zeros((n,), jnp.int32),
+            join_target=jnp.full((n,), -1, jnp.int32),
+            leaving=jnp.zeros((n,), jnp.bool_),
+            left=jnp.zeros((n,), jnp.bool_),
+        )
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, state: ScampState,
+             ctx: RoundCtx) -> tuple[ScampState, Array]:
+        sc = cfg.scamp
+        W = cfg.msg_words
+        v2 = self.v2
+        n_local = state.partial.shape[0]
+        gids = comm.local_ids()
+
+        def per_node(me, key, partial, in_view, join_tgt, leaving, inbox_row):
+            def mk(kind, dst, *, ttl=0, payload=()):
+                return msg_ops.build(W, kind, me, dst, ttl=ttl, payload=payload)
+
+            nomsg = jnp.zeros((W,), jnp.int32)
+
+            # ---- scripted join (scamp_v1 :69-119 step 1-2) ------------
+            do_join = join_tgt >= 0
+            partial = jnp.where(
+                do_join,
+                views.add(partial, join_tgt, rng.subkey(key, _TAG_JOIN))[0],
+                partial)
+            join_msg = jnp.where(
+                do_join,
+                mk(T.MsgKind.SCAMP_SUBSCRIPTION, join_tgt, ttl=_WALK_TTL,
+                   payload=(me, jnp.int32(1))),     # direct: contact fans out
+                nomsg)
+            # v2: the joiner holds the contact as an out-edge, so the
+            # contact gains an in-edge (closes the reference's open
+            # "@todo Join of InView", scamp_v2 :32).
+            join_keep = jnp.where(
+                do_join & jnp.bool_(v2),
+                mk(T.MsgKind.SCAMP_KEEP, join_tgt), nomsg)
+
+            # ---- inbox scan -------------------------------------------
+            def handle(carry, x):
+                partial, in_view, fan_sub, gossip_rm = carry
+                msg, slot = x
+                k = msg[T.W_KIND]
+                src = msg[T.W_SRC]
+                ttl = msg[T.W_TTL]
+                sub = msg[T.P0]
+                skey = rng.subkey(key, _TAG_SLOT + slot)
+                k1 = rng.subkey(skey, 1)
+                k2 = rng.subkey(skey, 2)
+                k3 = rng.subkey(skey, 3)
+                # A self-requeue is a local carry-over, not a network
+                # send: stamp W_SRC = me so the emit->deliver fault
+                # filter can't drop it for the ORIGINAL sender's sake.
+                self_requeue = msg.at[T.W_DST].set(me).at[T.W_SRC].set(me)
+
+                def b_noop(p, iv, fs, gr):
+                    return p, iv, fs, gr, nomsg
+
+                def b_subscription(p, iv, fs, gr):
+                    direct = msg[T.P1] == 1
+                    # Direct first hop: one fanout per node per round;
+                    # extras re-queue to self for the next round.
+                    take_fan = direct & (fs < 0)
+                    requeue = direct & (fs >= 0)
+
+                    # Keep rule (v1 :264-297): P = 1/(1 + |view incl self|).
+                    size = views.size(p)
+                    p_keep = 1.0 / (2.0 + size.astype(jnp.float32))
+                    dice = jax.random.uniform(k1) < p_keep
+                    known = views.contains(p, sub) | (sub == me) | (sub < 0)
+                    # Forward target: one random member, not the subscriber.
+                    nxt = views.pick_one(p, k2, exclude=jnp.stack([sub]))
+                    expired = ttl <= 0
+                    keep = ~known & (dice | expired | (nxt < 0)) & ~requeue
+                    # Not kept and not a first hop: forward to one random
+                    # member — including subscriptions for already-known
+                    # nodes (v1 :287-296 forwards in that case too).
+                    fwd_ok = ~direct & ~keep & ~requeue & ~expired & (nxt >= 0)
+
+                    p2, _ = views.add(p, jnp.where(keep, sub, -1), k3)
+                    keep_note = jnp.where(
+                        keep & jnp.bool_(v2),
+                        mk(T.MsgKind.SCAMP_KEEP, sub), nomsg)
+                    fwd = msg.at[T.W_DST].set(nxt).at[T.W_SRC].set(me) \
+                             .at[T.W_TTL].set(ttl - 1)
+                    reply = jnp.where(
+                        requeue, self_requeue,
+                        jnp.where(fwd_ok, fwd, keep_note))
+                    return (p2, iv, jnp.where(take_fan, sub, fs), gr, reply)
+
+                def b_unsubscribe(p, iv, fs, gr):
+                    node = sub
+                    present = views.contains(p, node)
+                    take = present & (gr < 0)
+                    requeue = present & (gr >= 0)
+                    p2 = jnp.where(take, views.remove(p, node), p)
+                    iv2 = views.remove(iv, node) if v2 else iv
+                    reply = jnp.where(requeue, self_requeue, nomsg)
+                    return (p2, jnp.where(present, iv2, iv),
+                            fs, jnp.where(take, node, gr), reply)
+
+                def b_keep(p, iv, fs, gr):
+                    if not v2:
+                        return p, iv, fs, gr, nomsg
+                    iv2, _ = views.add(iv, src, k1)
+                    return p, iv2, fs, gr, nomsg
+
+                def b_replace(p, iv, fs, gr):
+                    if not v2:
+                        return p, iv, fs, gr, nomsg
+                    node, repl = msg[T.P0], msg[T.P1]
+                    # Dedup: if the replacement is already an out-edge,
+                    # this is a plain removal (scamp_v2 :275-294).
+                    have_repl = views.contains(p, repl) | (repl == me)
+                    did = views.contains(p, node) & (node >= 0) & ~have_repl
+                    p2 = jnp.where(
+                        (p == node) & (node >= 0),
+                        jnp.where(have_repl, views.EMPTY, repl), p)
+                    # Tell the replacement it gained an in-edge (the
+                    # reference leaves in-views stale here — its own
+                    # open question at scamp_v2 :281-283; we close it so
+                    # the rebalance invariant holds transitively).
+                    reply = jnp.where(
+                        did, mk(T.MsgKind.SCAMP_KEEP, repl), nomsg)
+                    return p2, iv, fs, gr, reply
+
+                branches = [b_subscription, b_unsubscribe, b_keep,
+                            b_replace, b_noop]
+                idx = jnp.where(
+                    (k >= T.MsgKind.SCAMP_SUBSCRIPTION)
+                    & (k <= T.MsgKind.SCAMP_REPLACE),
+                    k - T.MsgKind.SCAMP_SUBSCRIPTION, len(branches) - 1)
+                p2, iv2, fs2, gr2, reply = jax.lax.switch(
+                    idx, branches, partial, in_view, fan_sub, gossip_rm)
+                return (p2, iv2, fs2, gr2), reply
+
+            (partial2, in_view2, fan_sub, gossip_rm), replies = jax.lax.scan(
+                handle, (partial, in_view, jnp.int32(-1), jnp.int32(-1)),
+                (inbox_row, jnp.arange(inbox_row.shape[0])))
+
+            # ---- contact fanout (paper; reference joiner-side v1 :86-115):
+            # the whole partial view + c (v1) / c-1 (v2) random extra copies.
+            copies = sc.c - 1 if v2 else sc.c
+            fkey = rng.subkey(key, _TAG_FANOUT)
+            extra_slots = rng.choice_slots(
+                fkey, partial2 >= 0, copies) if copies > 0 else \
+                jnp.zeros((0,), jnp.int32)
+            extra = jnp.where(extra_slots >= 0, partial2[extra_slots], -1)
+            fan_dst = jnp.concatenate([partial2, extra])
+            fan_dst = jnp.where(
+                (fan_sub >= 0) & (fan_dst != fan_sub), fan_dst, -1)
+            fanout_sub = jax.vmap(
+                lambda d: mk(T.MsgKind.SCAMP_SUBSCRIPTION, d, ttl=_WALK_TTL,
+                             payload=(fan_sub, jnp.int32(0))))(fan_dst)
+
+            # ---- removal gossip (v1 :247-255): to the pre-scan view ----
+            rm_dst = jnp.where(gossip_rm >= 0, partial, -1)
+            fanout_rm = jax.vmap(
+                lambda d: mk(T.MsgKind.SCAMP_UNSUBSCRIBE, d,
+                             payload=(gossip_rm,)))(rm_dst)
+
+            # ---- graceful leave ---------------------------------------
+            if v2:
+                # scamp_v2 :242-267: in_view[:L-(c-1)] -> replace with
+                # partial[i mod size]; the rest -> remove.
+                L = views.size(in_view2)
+                n_replace = jnp.maximum(L - (sc.c - 1), 0)
+                occ = jnp.cumsum((in_view2 >= 0).astype(jnp.int32)) - 1
+                psize = jnp.maximum(views.size(partial2), 1)
+                # Round-robin replacement from the packed partial view.
+                porder = jnp.argsort(jnp.where(partial2 >= 0, 0, 1),
+                                     stable=True)
+                packed = partial2[porder]            # members first
+                repl = packed[occ % psize]
+                do_repl = (in_view2 >= 0) & (occ < n_replace) & (repl >= 0)
+                kind_lv = jnp.where(do_repl, T.MsgKind.SCAMP_REPLACE,
+                                    T.MsgKind.SCAMP_UNSUBSCRIBE)
+                fanout_lv = jax.vmap(
+                    lambda kd, d, r: msg_ops.build(
+                        W, kd, me, jnp.where(leaving, d, -1),
+                        payload=(me, r)))(kind_lv, in_view2, repl)
+            else:
+                # v1 leave (:122-142): gossip remove_subscription(self).
+                fanout_lv = jax.vmap(
+                    lambda d: mk(T.MsgKind.SCAMP_UNSUBSCRIBE,
+                                 jnp.where(leaving, d, -1),
+                                 payload=(me,)))(partial2)
+
+            partial2 = jnp.where(leaving, views.EMPTY, partial2)
+            in_view2 = jnp.where(leaving, views.EMPTY, in_view2)
+
+            # ---- periodic timer phase (v1 :173-216); the ping/isolation
+            # work is vectorized below, outside the per-node scan --------
+            fires = (ctx.rnd + me) % cfg.gossip_every == 0
+            return partial2, in_view2, jnp.concatenate([
+                replies, fanout_sub, fanout_rm, fanout_lv,
+                jnp.stack([join_msg, join_keep])]), fires
+
+        partial2, in_view2, emitted, fires = jax.vmap(per_node)(
+            gids, ctx.keys, state.partial, state.in_view,
+            state.join_target, state.leaving, ctx.inbox.data)
+
+        # ---- periodic pings on the monotonic gossip lane --------------
+        fires = fires & ctx.alive & ~state.left
+        ping_dst = jnp.where(fires[:, None], partial2, -1)
+        ping_dst = faults_mod.filter_edges(
+            ctx.faults, gids, ping_dst, cfg.seed, ctx.rnd, _PING_EDGE_TAG)
+        stamp = jnp.broadcast_to(
+            (ctx.rnd + 1)[None, None], (n_local, 1)).astype(jnp.uint32)
+        heard = comm.push_max(stamp, ping_dst)[:, 0].astype(jnp.int32)
+        last_heard = jnp.maximum(state.last_heard, heard)
+        # A consumed join seeds the isolation clock: a late joiner is not
+        # "isolated" until a full window passes with no pings AFTER it
+        # joined (otherwise every late join double-subscribes).
+        joined_now = (state.join_target >= 0) & ctx.alive
+        last_heard = jnp.maximum(
+            last_heard, jnp.where(joined_now, ctx.rnd + 1, 0))
+
+        # ---- isolation re-subscription (v1 :196-215) ------------------
+        window = cfg.gossip_every * sc.message_window
+        isolated = fires & (last_heard + window < ctx.rnd + 1) & \
+            (ctx.rnd >= window)
+        iso_keys = jax.vmap(lambda k: rng.subkey(k, _TAG_ISOLATION))(ctx.keys)
+        iso_tgt = jax.vmap(views.pick_one)(partial2, iso_keys)
+        iso_msg = jax.vmap(
+            lambda m, d, ok: msg_ops.build(
+                cfg.msg_words, T.MsgKind.SCAMP_SUBSCRIPTION, m,
+                jnp.where(ok, d, -1), ttl=_WALK_TTL,
+                payload=(m, jnp.int32(0))))(gids, iso_tgt, isolated)
+        emitted = jnp.concatenate([emitted, iso_msg[:, None, :]], axis=1)
+
+        # Crash-stopped and left nodes are frozen and silent.
+        live = ctx.alive & (~state.left | (state.join_target >= 0))
+        partial2 = jnp.where(live[:, None], partial2, state.partial)
+        in_view2 = jnp.where(live[:, None], in_view2, state.in_view)
+        emitted = emitted.at[..., T.W_KIND].set(
+            jnp.where(live[:, None], emitted[..., T.W_KIND], 0))
+
+        new_state = ScampState(
+            partial=partial2,
+            in_view=in_view2,
+            last_heard=last_heard,
+            join_target=jnp.where(ctx.alive, -1, state.join_target),
+            leaving=jnp.where(live, False, state.leaving),
+            left=(state.left | (state.leaving & live))
+                 & ~(state.join_target >= 0),
+        )
+        return new_state, emitted
+
+    # ---- views -------------------------------------------------------
+    def neighbors(self, cfg: Config, state: ScampState,
+                  comm: LocalComm | None = None) -> Array:
+        return state.partial
+
+    def members(self, cfg: Config, state: ScampState,
+                comm: LocalComm | None = None) -> Array:
+        """Self + partial view (the strategy's members list — scamp_v1
+        :304-305 includes self; the view is partial by design)."""
+        n_local = state.partial.shape[0]
+        if comm is not None:
+            n_global, gids = comm.n_global, comm.local_ids()
+        else:
+            n_global, gids = n_local, jnp.arange(n_local, dtype=jnp.int32)
+        out = jnp.zeros((n_local, n_global), jnp.bool_)
+        out = out.at[jnp.arange(n_local), gids].set(True)
+        rows = jnp.repeat(jnp.arange(n_local), state.partial.shape[1])
+        cols = jnp.where(state.partial >= 0, state.partial,
+                         n_global).reshape(-1)
+        return out.at[rows, cols].set(True, mode="drop")
+
+    # ---- scenario scripting ------------------------------------------
+    def join(self, cfg: Config, state: ScampState, node: int,
+             target: int) -> ScampState:
+        return state._replace(
+            join_target=state.join_target.at[node].set(target))
+
+    def leave(self, cfg: Config, state: ScampState, node: int) -> ScampState:
+        return state._replace(leaving=state.leaving.at[node].set(True))

@@ -69,9 +69,12 @@ class MsgKind(enum.IntEnum):
     HPV_SHUFFLE_REPLY = 17   # payload: [origin, k_slots...] (same layout)
 
     # -- SCAMP (partisan_scamp_v1_membership_strategy.erl:67-297, v2)
-    SCAMP_SUBSCRIPTION = 20       # forward_subscription; payload: [subscriber]
-    SCAMP_UNSUBSCRIBE = 21        # payload: [node, replacement]
-    SCAMP_KEEPALIVE = 22          # periodic ping for isolation detection (v2)
+    SCAMP_SUBSCRIPTION = 20       # forward_subscription; payload: [subscriber,
+                                  #   direct] (direct=1: first hop, fan out)
+    SCAMP_UNSUBSCRIBE = 21        # remove_subscription; payload: [node]
+    SCAMP_KEEP = 22               # keep_subscription (v2); src = keeper
+    SCAMP_REPLACE = 23            # replace_subscription (v2);
+                                  #   payload: [node, replacement]
 
     # -- Plumtree (partisan_plumtree_broadcast.erl:843-905)
     PT_GOSSIP = 30      # eager push; payload: [slot, version, msg_round]
