@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu.comm import LocalComm
@@ -50,6 +51,7 @@ class ClusterState(NamedTuple):
     inbox: exchange.Inbox   # deliveries awaiting consumption this round
     manager: Any            # manager-specific pytree
     model: Any              # model-specific pytree (or () if no model)
+    delivery: Any           # delivery.DeliveryState (or () if disabled)
     stats: Stats
 
 
@@ -69,10 +71,17 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     mstate, m_emit = manager.step(cfg, comm, state.manager, ctx)
     if model is not None:
         nbrs = manager.neighbors(cfg, mstate, comm)
-        dstate, a_emit = model.step(cfg, comm, state.model, ctx, nbrs)
+        dstate_model, a_emit = model.step(cfg, comm, state.model, ctx, nbrs)
         emitted = jnp.concatenate([m_emit, a_emit], axis=1)
     else:
-        dstate, emitted = (), m_emit
+        dstate_model, emitted = (), m_emit
+
+    # Delivery semantics: ack generation/consumption/retransmit + causal
+    # clock stamping (pulls causal messages onto their wide side lanes).
+    dstate, wides = state.delivery, ()
+    if delivery_mod.enabled(cfg):
+        dstate, emitted, wides = delivery_mod.outbound(
+            cfg, comm, dstate, emitted, ctx)
 
     n_emitted = comm.allsum(jnp.sum(emitted[..., 0] != 0, dtype=jnp.int32))
 
@@ -88,16 +97,26 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         count=jnp.where(dead, 0, inbox.count),
         drops=inbox.drops + jnp.where(dead, inbox.count, 0),
     )
+    ev_delivered = comm.allsum(jnp.sum(inbox.count, dtype=jnp.int32))
 
-    delivered = comm.allsum(jnp.sum(inbox.count, dtype=jnp.int32))
+    causal_delivered = jnp.int32(0)
+    if wides:
+        # Causal lanes bypass route(): inbound gathers the bounded actor
+        # block itself, applies per-receiver transmission faults, and
+        # suppresses dead receivers internally.
+        dstate, inbox, causal_delivered = delivery_mod.inbound(
+            cfg, comm, dstate, inbox, wides, ctx)
+
+    # `dropped` tracks the event lane only: a causal broadcast is one
+    # emission with up-to-n deliveries, so it gets its own counter.
     stats = Stats(
         emitted=state.stats.emitted + n_emitted,
-        delivered=state.stats.delivered + delivered,
-        dropped=state.stats.dropped + (n_emitted - delivered),
+        delivered=state.stats.delivered + ev_delivered + causal_delivered,
+        dropped=state.stats.dropped + (n_emitted - ev_delivered),
     )
     return ClusterState(rnd=state.rnd + 1, faults=state.faults,
-                        inbox=inbox, manager=mstate, model=dstate,
-                        stats=stats)
+                        inbox=inbox, manager=mstate, model=dstate_model,
+                        delivery=dstate, stats=stats)
 
 
 def run_until(cluster: Any, state: ClusterState, pred, max_rounds: int,
@@ -143,6 +162,8 @@ class Cluster:
             inbox=exchange.empty_inbox(comm.n_local, cfg.inbox_cap, cfg.msg_words),
             manager=self.manager.init(cfg, comm),
             model=self.model.init(cfg, comm) if self.model is not None else (),
+            delivery=(delivery_mod.init(cfg, comm)
+                      if delivery_mod.enabled(cfg) else ()),
             stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         )
 

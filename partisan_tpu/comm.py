@@ -62,6 +62,16 @@ class LocalComm:
         """Sum a per-shard scalar across all shards (identity here)."""
         return x
 
+    def actor_gather(self, x: Array, a: int) -> Array:
+        """Rows of ``x`` for global nodes 0..a-1 (the causal actor
+        space), visible to every shard.  Requires a <= n_local so the
+        actor block lives on one shard (cross-shard it is a psum of
+        zero-padded local slices)."""
+        if a > self.n_local:
+            raise ValueError(
+                f"n_actors={a} must be <= nodes per shard ({self.n_local})")
+        return x[:a]
+
     def gather_vec(self, x: Array) -> Array:
         """Concatenate a per-node local vector into the global one
         (identity here; an all_gather on shards)."""

@@ -122,6 +122,12 @@ class Config:
     relay_ttl: int = 5                   # include/partisan.hrl:138
     broadcast: bool = True               # transitive tree relay enabled
     causal_labels: tuple[str, ...] = ()  # one causality lane per label
+    ack_cap: int = 0                     # outstanding acked sends per node
+                                         #   (0 disables the ack lane)
+    causal_buf_cap: int = 8              # undelivered causal msgs buffered
+    causal_emit_cap: int = 4             # causal sends per node per round
+    causal_hist_cap: int = 8             # sender-side re-emission history
+    causal_deliver_cap: int = 16         # causal deliveries per node/round
 
     # --- channels ------------------------------------------------------
     channels: tuple[ChannelSpec, ...] = DEFAULT_CHANNELS

@@ -1,0 +1,490 @@
+"""Delivery semantics: acked delivery with retransmission, and causal
+broadcast lanes.
+
+TPU rebuild of two reference backends that wrap the send path:
+
+- **Acked delivery** (partisan_acknowledgement_backend.erl:70-85, driven
+  by the pluggable manager: store-on-send :1290-1307, retransmit timer
+  :1421-1470, receiver ack + deliver :1835-1881): a message sent with
+  ``F_ACK_REQUIRED`` is stored by the sender keyed by its per-sender
+  monotonic clock; every ``retransmit_interval`` it is re-sent (flagged
+  ``F_RETRANSMISSION``) until the matching ``ACK`` arrives.  Delivery is
+  at-least-once — receivers may see duplicates, exactly as in the
+  reference fast path.
+
+- **Causal delivery** (partisan_causality_backend.erl: emit stores the
+  stamped message for re-emission :172-201, receive buffers until
+  dependencies are satisfied :204-220 + :309-344, delivery merges clocks
+  :263-300).  The reference's scheme is point-to-point with
+  per-destination dependency clocks and a *dominance* check that can be
+  satisfied transitively without the dependency being delivered — an
+  approximation it acknowledges.  The TPU lane targets the headline
+  workload instead (causal **broadcast** at cluster scale, driver config
+  #5) and implements exact vector-clock causal broadcast: each logical
+  message increments its sender's entry once, every node delivers it at
+  most once, in causal order, buffering out-of-order arrivals.  Senders
+  must live in the bounded actor space (``gid < cfg.n_actors``); anyone
+  receives.  Loss recovery is sender-side: every stamped record enters a
+  history ring replayed on the retransmit cadence (the order-buffer-on-
+  the-wire analogue, wire format :115), and receivers stale-drop
+  already-covered counters, making replay idempotent — app-visible
+  delivery is exactly-once, in causal order.
+
+Tensor mapping: a causal record is ``[msg_words + n_actors]`` int32 (the
+event words followed by the clock).  Per round, each lane's records from
+ALL actors are combined into ONE shared candidate table (an ``lax.psum``
+over the shard axis — actors are zero-padded rows off their home shard),
+and deliverability for every (node, candidate) pair is evaluated as a
+dense vectorized sweep — no per-node scans.  ``CAUSAL_SWEEPS`` sweeps
+per round bound in-round chain delivery; longer chains resume next
+round, like the reference's redelivery timer (:303-306).
+
+Models opt in per message via flags: ``F_ACK_REQUIRED`` for acked sends;
+``F_CAUSAL`` (+ ``W_LANE`` = label index) emits ONE record per logical
+broadcast (the destination word is ignored — every node is a receiver;
+the sender's own copy is suppressed by the stale-drop since its clock
+already covers it).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import exchange, vclock
+
+CAUSAL_SWEEPS = 3     # in-round delivery passes (chain depth per round)
+_CAUSAL_SALT = 21     # fault-filter call-site salt for causal lanes
+
+
+class AckState(NamedTuple):
+    outstanding: Array  # int32[n_local, ack_cap, W] — kind==NONE = free slot
+    next_clock: Array   # int32[n_local] — next per-sender message clock
+    overflow: Array     # int32 — acked sends dropped: store was full
+
+
+class CausalLane(NamedTuple):
+    clock: Array      # uint32[n_local, A] — delivered-state vclock
+    buf: Array        # int32[n_local, B, W+A] — out-of-order arrivals
+    hist: Array       # int32[n_local, H, W+A] — sent-record replay ring
+    hist_ptr: Array   # int32[n_local] — ring write position
+    overflow: Array   # int32 — records dropped: emit/buffer slots full
+
+
+class DeliveryState(NamedTuple):
+    ack: AckState | tuple
+    lanes: tuple           # one CausalLane per cfg.causal_labels entry
+    invalid_causal: Array  # int32 — F_CAUSAL sends dropped (non-actor
+                           #   sender or unconfigured lane)
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.ack_cap > 0 or bool(cfg.causal_labels)
+
+
+def init(cfg: Config, comm) -> DeliveryState:
+    n = comm.n_local
+    WA = cfg.msg_words + cfg.n_actors
+    ack = AckState(
+        outstanding=jnp.zeros((n, cfg.ack_cap, cfg.msg_words), jnp.int32),
+        next_clock=jnp.ones((n,), jnp.int32),
+        overflow=jnp.int32(0),
+    ) if cfg.ack_cap > 0 else ()
+    lanes = tuple(
+        CausalLane(
+            clock=vclock.fresh_matrix(n, cfg.n_actors),
+            buf=jnp.zeros((n, cfg.causal_buf_cap, WA), jnp.int32),
+            hist=jnp.zeros((n, cfg.causal_hist_cap, WA), jnp.int32),
+            hist_ptr=jnp.zeros((n,), jnp.int32),
+            overflow=jnp.int32(0),
+        )
+        for _ in cfg.causal_labels
+    )
+    return DeliveryState(ack=ack, lanes=lanes,
+                         invalid_causal=jnp.int32(0))
+
+
+def _compact(rows: Array, mask: Array, cap: int) -> tuple[Array, Array]:
+    """Per-node: gather ``rows[i, e]`` where ``mask`` into ``cap`` slots,
+    preserving slot order.  Returns (packed [n, cap, w], n_dropped)."""
+    n, e, w = rows.shape
+    rank = jnp.cumsum(mask, axis=1) - 1
+    slot = jnp.where(mask, rank, e + cap)
+    out = jnp.zeros((n, cap, w), rows.dtype)
+    rows_idx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
+    out = out.at[rows_idx, slot].set(rows, mode="drop")
+    dropped = jnp.sum(jnp.maximum(
+        jnp.sum(mask, axis=1) - cap, 0), dtype=jnp.int32)
+    return out, dropped
+
+
+# ---------------------------------------------------------------------------
+# Outbound
+# ---------------------------------------------------------------------------
+
+def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
+             ctx: RoundCtx) -> tuple[DeliveryState, Array, tuple]:
+    """Process the send path.  Returns (state', emitted', wide_per_lane):
+    ack/retransmit records are appended to ``emitted``; causal messages
+    are REMOVED from it and returned as per-lane wide-record tensors."""
+    gids = comm.local_ids()
+    n = emitted.shape[0]
+    inb = ctx.inbox.data
+    flags_in = inb[..., T.W_FLAGS]
+    kind_in = inb[..., T.W_KIND]
+
+    extra = []
+    ack = st.ack
+    if cfg.ack_cap > 0:
+        # 1. Ack everything that arrived flagged (receiver side,
+        #    pluggable :1835-1846).  Duplicates re-ack — the reference
+        #    acks retransmissions too.
+        need_ack = (kind_in != 0) & (flags_in & T.F_ACK_REQUIRED != 0) \
+            & ctx.alive[:, None]
+        ack_msgs = jnp.zeros_like(inb)
+        ack_msgs = ack_msgs.at[..., T.W_KIND].set(
+            jnp.where(need_ack, T.MsgKind.ACK, 0))
+        ack_msgs = ack_msgs.at[..., T.W_SRC].set(
+            jnp.where(need_ack, gids[:, None], 0))
+        ack_msgs = ack_msgs.at[..., T.W_DST].set(
+            jnp.where(need_ack, inb[..., T.W_SRC], 0))
+        ack_msgs = ack_msgs.at[..., T.W_CLOCK].set(
+            jnp.where(need_ack, inb[..., T.W_CLOCK], 0))
+        extra.append(ack_msgs)
+
+        # 2. Consume arriving ACKs: clear matching outstanding slots
+        #    (match on clock + the acker being the stored destination).
+        is_ack = kind_in == T.MsgKind.ACK
+        out = ack.outstanding
+        hit = (
+            (out[..., T.W_CLOCK][:, :, None] == inb[..., T.W_CLOCK][:, None, :])
+            & (out[..., T.W_DST][:, :, None] == inb[..., T.W_SRC][:, None, :])
+            & is_ack[:, None, :]
+            & (out[..., T.W_KIND][:, :, None] != 0)
+        ).any(axis=2)
+        out = out.at[..., T.W_KIND].set(
+            jnp.where(hit, 0, out[..., T.W_KIND]))
+
+        # 3. Stamp + store fresh acked sends (sender side :1290-1307).
+        e_flags = emitted[..., T.W_FLAGS]
+        fresh = (emitted[..., T.W_KIND] != 0) \
+            & (e_flags & T.F_ACK_REQUIRED != 0) \
+            & (e_flags & T.F_RETRANSMISSION == 0) \
+            & (e_flags & T.F_CAUSAL == 0) \
+            & (emitted[..., T.W_KIND] != T.MsgKind.ACK)
+        rank = jnp.cumsum(fresh, axis=1) - 1
+        clocks = ack.next_clock[:, None] + rank
+        emitted = emitted.at[..., T.W_CLOCK].set(
+            jnp.where(fresh, clocks, emitted[..., T.W_CLOCK]))
+
+        # Store each fresh send into the k-th free slot of the store,
+        # where k is the send's order among this round's fresh sends.
+        C = cfg.ack_cap
+        free = out[..., T.W_KIND] == 0
+        free_rank = jnp.cumsum(free, axis=1) - 1
+        rows_n = jnp.arange(n)[:, None]
+        # slot_of_rank[i, r] = index of node i's r-th free slot (C = none).
+        slot_of_rank = jnp.full((n, C), C, jnp.int32)
+        slot_of_rank = slot_of_rank.at[
+            jnp.broadcast_to(rows_n, free.shape),
+            jnp.where(free, free_rank, C)
+        ].set(jnp.broadcast_to(
+            jnp.arange(C, dtype=jnp.int32)[None, :], free.shape),
+            mode="drop")
+        n_free = free.sum(axis=1)
+        tgt = jnp.take_along_axis(
+            slot_of_rank, jnp.clip(rank, 0, C - 1), axis=1)
+        store_slot = jnp.where(fresh & (rank < n_free[:, None]), tgt, C)
+        out = out.at[
+            jnp.broadcast_to(rows_n, store_slot.shape), store_slot
+        ].set(emitted, mode="drop")
+        # allsum keeps the replicated counter identical across shards.
+        overflow = comm.allsum(jnp.sum(
+            jnp.maximum(fresh.sum(axis=1) - n_free, 0), dtype=jnp.int32))
+        next_clock = ack.next_clock + fresh.sum(axis=1, dtype=jnp.int32)
+
+        # 4. Retransmit tick (pluggable :1421-1470): re-emit the whole
+        #    store, flagged.
+        refire = ((ctx.rnd + gids) % cfg.retransmit_every == 0) & ctx.alive
+        re = out.at[..., T.W_FLAGS].set(
+            out[..., T.W_FLAGS] | T.F_RETRANSMISSION)
+        re = re.at[..., T.W_KIND].set(
+            jnp.where(refire[:, None], out[..., T.W_KIND], 0))
+        extra.append(re)
+
+        # Crashed senders freeze their store (their gen_server is dead).
+        out = jnp.where(ctx.alive[:, None, None], out, ack.outstanding)
+        next_clock = jnp.where(ctx.alive, next_clock, ack.next_clock)
+        ack = AckState(outstanding=out, next_clock=next_clock,
+                       overflow=ack.overflow + overflow)
+
+    # 5. Causal stamping: pull causal messages off the event lane into
+    #    per-lane wide records (emit side, causality_backend :172-201).
+    lanes_out = []
+    wide_out = []
+    for li, lane in enumerate(st.lanes):
+        A = cfg.n_actors
+        is_c = (emitted[..., T.W_KIND] != 0) \
+            & (emitted[..., T.W_FLAGS] & T.F_CAUSAL != 0) \
+            & (emitted[..., T.W_LANE] == li)
+        # Only actor-resident nodes may send causally.
+        actor_ok = (gids < A) & ctx.alive
+        is_c = is_c & actor_ok[:, None]
+
+        # The k-th logical message this round gets the clock incremented
+        # k+1 times at the sender's own entry.
+        n_sent = is_c.sum(axis=1, dtype=vclock.DTYPE)
+        rank1 = jnp.cumsum(is_c, axis=1)           # 1-based where is_c
+        me_actor = jnp.where(gids < A, gids, 0)
+        onehot = (jnp.arange(A)[None, :] ==
+                  me_actor[:, None]).astype(vclock.DTYPE)
+        msg_clocks = lane.clock[:, None, :] + \
+            onehot[:, None, :] * rank1[:, :, None].astype(vclock.DTYPE)
+        new_clock = lane.clock + onehot * n_sent[:, None]
+
+        wide = jnp.concatenate(
+            [emitted, msg_clocks.astype(jnp.int32)], axis=-1)
+        packed, dropped = _compact(wide, is_c, cfg.causal_emit_cap)
+
+        # Sender-side loss recovery: history ring + cadenced replay.
+        H = cfg.causal_hist_cap
+        valid_p = packed[..., T.W_KIND] != 0
+        k_idx = jnp.cumsum(valid_p, axis=1) - 1
+        pos = jnp.where(valid_p,
+                        (lane.hist_ptr[:, None] + k_idx) % H, H)
+        rows_n = jnp.broadcast_to(jnp.arange(n)[:, None], pos.shape)
+        hist = lane.hist.at[rows_n, pos].set(packed, mode="drop")
+        hist_ptr = (lane.hist_ptr
+                    + valid_p.sum(axis=1, dtype=jnp.int32)) % H
+        refire = ((ctx.rnd + gids) % cfg.retransmit_every == 0) & ctx.alive
+        live_slot = refire[:, None] & (hist[..., T.W_KIND] != 0)
+        replay = hist.at[..., T.W_FLAGS].set(
+            hist[..., T.W_FLAGS] | T.F_RETRANSMISSION)
+        # Whole-record zeroing keeps off-actor/idle rows all-zero — the
+        # invariant ShardComm.actor_gather's psum reconstruction needs.
+        replay = jnp.where(live_slot[..., None], replay, 0)
+
+        wide_out.append(jnp.concatenate([packed, replay], axis=1))
+        lanes_out.append(lane._replace(
+            clock=jnp.where(ctx.alive[:, None], new_clock, lane.clock),
+            hist=jnp.where(ctx.alive[:, None, None], hist, lane.hist),
+            hist_ptr=jnp.where(ctx.alive, hist_ptr, lane.hist_ptr),
+            overflow=lane.overflow + comm.allsum(dropped)))
+        # Remove from the event lane.
+        emitted = emitted.at[..., T.W_KIND].set(
+            jnp.where(is_c, 0, emitted[..., T.W_KIND]))
+
+    # Any message still flagged F_CAUSAL was emitted by a non-actor node
+    # or names an unconfigured lane: it must NOT leak onto the unicast
+    # path unordered.  Drop it and account for it.
+    invalid = jnp.int32(0)
+    if st.lanes:
+        leak = (emitted[..., T.W_KIND] != 0) & \
+            (emitted[..., T.W_FLAGS] & T.F_CAUSAL != 0)
+        invalid = comm.allsum(jnp.sum(leak, dtype=jnp.int32))
+        emitted = emitted.at[..., T.W_KIND].set(
+            jnp.where(leak, 0, emitted[..., T.W_KIND]))
+
+    if extra:
+        emitted = jnp.concatenate([emitted] + extra, axis=1)
+    return (DeliveryState(ack=ack, lanes=tuple(lanes_out),
+                          invalid_causal=st.invalid_causal + invalid),
+            emitted, tuple(wide_out))
+
+
+# ---------------------------------------------------------------------------
+# Inbound: dense vectorized causal delivery
+# ---------------------------------------------------------------------------
+
+def _fetch(buf: Array, shared: Array, idx: Array) -> Array:
+    """Per-node record fetch over the combined candidate index space:
+    ``idx < B`` reads the node's buffer row, else the shared table.
+    buf [n, B, w], shared [G, w], idx [n, D] -> [n, D, w]."""
+    n, B, w = buf.shape
+    G = shared.shape[0]
+    from_buf = jnp.take_along_axis(
+        buf, jnp.clip(idx, 0, B - 1)[..., None], axis=1)
+    from_shared = shared[jnp.clip(idx - B, 0, G - 1)]
+    out = jnp.where((idx < B)[..., None], from_buf, from_shared)
+    return jnp.where((idx < B + G)[..., None], out, 0)
+
+
+def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
+            wides: tuple, ctx: RoundCtx
+            ) -> tuple[DeliveryState, exchange.Inbox, Array]:
+    """Causal receive path: combine this round's records from all actors
+    into one shared table, run dense deliverability sweeps for every
+    node at once, merge deliveries (in causal order) into the
+    model-visible inbox, buffer out-of-order futures.  Also returns the
+    global count of causal deliveries this round (for Stats)."""
+    W = cfg.msg_words
+    A = cfg.n_actors
+    B = cfg.causal_buf_cap
+    n = comm.n_local
+    gids = comm.local_ids()
+    rows_n = jnp.arange(n)[:, None]
+
+    n_causal = jnp.int32(0)
+    lanes_out = []
+    for li, (lane, payload) in enumerate(zip(st.lanes, wides)):
+        # Shared candidate table: every actor's records this round.
+        shared = comm.actor_gather(payload, A)      # [A, Ec+H, W+A]
+        shared = shared.reshape(-1, W + A)
+        G = shared.shape[0]
+        s_msg, s_clk = shared[:, :W], shared[:, W:].astype(vclock.DTYPE)
+        s_src = jnp.minimum(jnp.maximum(s_msg[:, T.W_SRC], 0), A - 1)
+        s_cnt = s_clk[jnp.arange(G), s_src]
+        s_dep = s_clk.at[jnp.arange(G), s_src].set(0)   # deps w/o sender
+        s_valid = s_msg[:, T.W_KIND] != 0
+
+        # Per-receiver transmission faults: each record's arrival at each
+        # node rides the (src -> node) edge this round (replays re-ride
+        # it next tick — loss is per-transmission, as on a real link).
+        cut = faults_mod.edge_cut(
+            ctx.faults,
+            jnp.broadcast_to(s_msg[None, :, T.W_SRC], (n, G)),
+            jnp.where(s_valid[None, :], gids[:, None], -1),
+            cfg.seed, ctx.rnd, _CAUSAL_SALT + li)
+        arr_ok = s_valid[None, :] & ~cut & ctx.alive[:, None]
+
+        # Buffered candidates (already arrived in earlier rounds).
+        b_msg, b_clk = lane.buf[..., :W], \
+            lane.buf[..., W:].astype(vclock.DTYPE)
+        b_src = jnp.minimum(jnp.maximum(b_msg[..., T.W_SRC], 0), A - 1)
+        b_cnt = jnp.take_along_axis(b_clk, b_src[..., None], axis=2)[..., 0]
+        b_dep = jnp.where(
+            (jnp.arange(A)[None, None, :] == b_src[..., None]), 0, b_clk)
+        b_valid = b_msg[..., T.W_KIND] != 0
+
+        clock0 = lane.clock
+        INF = jnp.int32(B + G + 1)
+        D = min(B + G, cfg.causal_deliver_cap)
+
+        def sweep(carry):
+            clock, b_avail, s_avail, quota = carry
+            loc_b = jnp.take_along_axis(clock, b_src, axis=1)
+            loc_s = clock[:, s_src]                      # [n, G]
+            ok_b = b_avail & (b_cnt == loc_b + 1) & \
+                jnp.all(b_dep <= clock[:, None, :], axis=2)
+            ok_s = s_avail & (s_cnt[None, :] == loc_s + 1) & \
+                jnp.all(s_dep[None] <= clock[:, None, :], axis=2)
+            # Dedup per (node, sender): lowest combined index wins
+            # (buffered records are older -> priority).
+            ib = jnp.where(ok_b, jnp.arange(B)[None, :], INF)
+            is_ = jnp.where(ok_s, B + jnp.arange(G)[None, :], INF)
+            win = jnp.full((n, A), INF, jnp.int32)
+            win = win.at[jnp.broadcast_to(rows_n, b_src.shape), b_src
+                         ].min(ib)
+            win = win.at[jnp.broadcast_to(rows_n, (n, G)),
+                         jnp.broadcast_to(s_src[None, :], (n, G))
+                         ].min(is_)
+            # Delivery quota: the round delivers at most D records per
+            # node (the inbox-merge capacity).  Winners beyond the
+            # remaining quota stay undelivered — their clocks do NOT
+            # advance, so they re-buffer as futures and deliver next
+            # round.  Rank winners by index for a deterministic cut.
+            rank = jnp.sum((win[:, None, :] < win[:, :, None]), axis=2)
+            deliver = (win < INF) & (rank < quota[:, None])
+            del_b = ok_b & (ib == jnp.take_along_axis(win, b_src, axis=1)) \
+                & jnp.take_along_axis(deliver, b_src, axis=1)
+            del_s = ok_s & (is_ == win[:, s_src]) & deliver[:, s_src]
+            mb = jnp.max(jnp.where(del_b[..., None], b_clk, 0), axis=1)
+            ms = jnp.max(jnp.where(del_s[..., None], s_clk[None], 0),
+                         axis=1)
+            clock2 = jnp.maximum(clock, jnp.maximum(mb, ms))
+            quota2 = quota - jnp.sum(deliver, axis=1, dtype=jnp.int32)
+            return (clock2, b_avail & ~del_b, s_avail & ~del_s, quota2), \
+                (del_b, del_s)
+
+        b_avail, s_avail = b_valid, arr_ok
+        clock = clock0
+        quota = jnp.full((n,), D, jnp.int32)
+        dels = []
+        for _ in range(CAUSAL_SWEEPS):
+            (clock, b_avail, s_avail, quota), d = sweep(
+                (clock, b_avail, s_avail, quota))
+            dels.append(d)
+        clock_f = jnp.where(ctx.alive[:, None], clock, clock0)
+
+        # Delivery order = (sweep, combined index).
+        def order_key(del_list, idx_base, count):
+            key = jnp.full((n, count), jnp.int32(2**30))
+            for s_i, d in enumerate(del_list):
+                k = s_i * (B + G) + idx_base
+                key = jnp.minimum(key, jnp.where(d, k, 2**30))
+            return key
+
+        key_b = order_key([d[0] for d in dels],
+                          jnp.arange(B)[None, :], B)
+        key_s = order_key([d[1] for d in dels],
+                          B + jnp.arange(G)[None, :], G)
+        keys = jnp.concatenate([key_b, key_s], axis=1)     # [n, B+G]
+        # top_k of -keys yields the SMALLEST keys first = delivery order;
+        # the returned positions ARE combined candidate indices.
+        topv, topi = jax.lax.top_k(-keys, D)
+        deliv_idx = jnp.where(-topv < 2**30, topi, B + G + 1)
+        recs = _fetch(lane.buf, shared, deliv_idx)
+        dmsgs = recs[..., :W]
+        n_deliv = jnp.sum(keys < 2**30, axis=1, dtype=jnp.int32)
+        n_causal = n_causal + comm.allsum(jnp.sum(n_deliv))
+        inbox = exchange.merge_inboxes(
+            inbox,
+            exchange.Inbox(
+                data=dmsgs,
+                count=jnp.minimum(n_deliv, D),
+                drops=jnp.zeros_like(inbox.drops)))
+
+        # Buffer the undelivered futures (stale ones vanish).  Dedup by
+        # (sender, counter-offset): replay cycles re-deliver copies of a
+        # blocked message every tick — only one copy may occupy a slot
+        # (buffered copies, having lower combined index, win).  Offsets
+        # beyond B can't deliver before nearer ones fill the buffer, so
+        # they're shed and recovered by a later replay.
+        loc_bf = jnp.take_along_axis(clock_f, b_src, axis=1)
+        off_b = b_cnt.astype(jnp.int32) - loc_bf.astype(jnp.int32)
+        off_s = s_cnt[None, :].astype(jnp.int32) - \
+            clock_f[:, s_src].astype(jnp.int32)
+        fut_b = b_valid & b_avail & (off_b >= 1) & (off_b <= B)
+        fut_s = arr_ok & s_avail & (off_s >= 1) & (off_s <= B)
+        idx_b = jnp.broadcast_to(jnp.arange(B)[None, :], (n, B))
+        idx_s = jnp.broadcast_to(B + jnp.arange(G)[None, :], (n, G))
+        tab = jnp.full((n, A, B), INF, jnp.int32)
+        tab = tab.at[jnp.broadcast_to(rows_n, (n, B)), b_src,
+                     jnp.clip(off_b - 1, 0, B - 1)
+                     ].min(jnp.where(fut_b, idx_b, INF))
+        tab = tab.at[jnp.broadcast_to(rows_n, (n, G)),
+                     jnp.broadcast_to(s_src[None, :], (n, G)),
+                     jnp.clip(off_s - 1, 0, B - 1)
+                     ].min(jnp.where(fut_s, idx_s, INF))
+        keep_b = fut_b & (idx_b == tab[
+            jnp.broadcast_to(rows_n, (n, B)), b_src,
+            jnp.clip(off_b - 1, 0, B - 1)])
+        keep_s = fut_s & (idx_s == tab[
+            jnp.broadcast_to(rows_n, (n, G)),
+            jnp.broadcast_to(s_src[None, :], (n, G)),
+            jnp.clip(off_s - 1, 0, B - 1)])
+        fkeys = jnp.concatenate(
+            [jnp.where(keep_b, idx_b, INF),
+             jnp.where(keep_s, idx_s, INF)], axis=1)
+        ftop, fidx = jax.lax.top_k(-fkeys, B)
+        keep_idx = jnp.where(-ftop < INF, fidx, B + G + 1)
+        new_buf = _fetch(lane.buf, shared, keep_idx)
+        n_fut = jnp.sum(fkeys < INF, axis=1, dtype=jnp.int32)
+        buf_overflow = comm.allsum(jnp.sum(
+            jnp.maximum(n_fut - B, 0), dtype=jnp.int32))
+
+        new_buf = jnp.where(ctx.alive[:, None, None], new_buf, lane.buf)
+        lanes_out.append(lane._replace(
+            clock=clock_f,
+            buf=new_buf,
+            overflow=lane.overflow + buf_overflow,
+        ))
+
+    return st._replace(lanes=tuple(lanes_out)), inbox, n_causal

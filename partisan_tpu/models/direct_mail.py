@@ -1,0 +1,92 @@
+"""Demers direct-mail broadcast (protocols/demers_direct_mail.erl) and
+its acked variant (protocols/demers_direct_mail_acked.erl).
+
+Reference behavior: ``broadcast`` sends the message directly to every
+member once — no epidemics, no repair; the acked variant sends with
+``{ack, true}`` so the manager's acknowledgement backend retransmits
+until every receiver acks (SURVEY.md §2 protocol corpus).
+
+TPU mapping: a pending-broadcast bitmap; a node with pending slots mails
+one slot per round to all its neighbors as APP event messages (flagged
+``F_ACK_REQUIRED`` in the acked variant — the delivery layer handles
+store/ack/retransmit).  The store is the same seen-bitmap as
+anti-entropy, so coverage is measured identically.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+OP_MAIL = 2   # APP payload[0] opcode (OP_PULL=1 is anti-entropy's)
+
+
+class DirectMailState(NamedTuple):
+    store: Array    # bool[n_local, max_broadcasts] — received slots
+    pending: Array  # bool[n_local, max_broadcasts] — queued to mail
+
+
+class DirectMail:
+    name = "demers_direct_mail"
+
+    def __init__(self, acked: bool = False) -> None:
+        self.acked = acked
+        if acked:
+            self.name = "demers_direct_mail_acked"
+
+    def init(self, cfg: Config, comm: LocalComm) -> DirectMailState:
+        z = jnp.zeros((comm.n_local, cfg.max_broadcasts), jnp.bool_)
+        return DirectMailState(store=z, pending=z)
+
+    def step(self, cfg: Config, comm: LocalComm, state: DirectMailState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[DirectMailState, Array]:
+        gids = comm.local_ids()
+
+        # Receive: APP/OP_MAIL messages set store bits (duplicates from
+        # retransmission are naturally idempotent).
+        inb = ctx.inbox.data
+        is_mail = (inb[..., T.W_KIND] == T.MsgKind.APP) & \
+                  (inb[..., T.P0] == OP_MAIL)
+        slots = jnp.where(is_mail, inb[..., T.P1], 0)
+        hits = jnp.zeros_like(state.store, jnp.int32)
+        rows = jnp.broadcast_to(
+            jnp.arange(state.store.shape[0])[:, None], slots.shape)
+        hits = hits.at[rows, jnp.where(is_mail, slots, cfg.max_broadcasts)
+                       ].add(1, mode="drop")
+        store = state.store | (hits > 0) & ctx.alive[:, None]
+        store = jnp.where(ctx.alive[:, None], store, state.store)
+
+        # Send: mail the lowest pending slot to every neighbor
+        # (demers_direct_mail.erl: send to all members once).
+        has = state.pending & ctx.alive[:, None]
+        slot = jnp.argmax(has, axis=1).astype(jnp.int32)
+        any_p = has.any(axis=1)
+        flags = T.F_ACK_REQUIRED if self.acked else 0
+        dst = jnp.where(any_p[:, None], nbrs, -1)
+        emitted = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], dst,
+            flags=flags, payload=(jnp.int32(OP_MAIL), slot[:, None]))
+        pending = state.pending & ~(
+            (jnp.arange(cfg.max_broadcasts)[None, :] == slot[:, None])
+            & any_p[:, None])
+        return DirectMailState(store=store, pending=pending), emitted
+
+    # ---- scenario helpers --------------------------------------------
+    def broadcast(self, state: DirectMailState, node: int,
+                  slot: int) -> DirectMailState:
+        return DirectMailState(
+            store=state.store.at[node, slot].set(True),
+            pending=state.pending.at[node, slot].set(True))
+
+    def coverage(self, state: DirectMailState, alive: Array,
+                 slot: int) -> Array:
+        have = state.store[:, slot] & alive
+        return jnp.sum(have) / jnp.maximum(jnp.sum(alive), 1)

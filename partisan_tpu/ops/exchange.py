@@ -85,18 +85,20 @@ def route(emitted: Array, n: int, cap: int, *, node_offset: int | Array = 0) -> 
 
 def merge_inboxes(a: Inbox, b: Inbox) -> Inbox:
     """Append b's messages after a's (capacity permitting) — used to merge
-    locally-routed and remotely-routed traffic or delayed re-deliveries."""
+    locally-routed and remotely-routed traffic or delayed re-deliveries.
+    ``b`` may have any slot count (and need not be compacted); the result
+    keeps a's capacity."""
     n, cap, w = a.data.shape
     both = jnp.concatenate(
         [a.data, b.data], axis=1
-    )  # [n, 2cap, w] — a's slots first
+    )  # [n, cap + bcap, w] — a's slots first
+    m = both.shape[1]
     # Re-route through the same compaction: positions keep relative order.
-    # Build per-node slot indices: valid slots of `a` then valid slots of `b`.
     kind = both[:, :, W_KIND]
     valid = kind != 0
     slot = jnp.cumsum(valid, axis=1) - 1
-    slot = jnp.where(valid, slot, 2 * cap)  # invalid -> dropped
-    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, 2 * cap))
+    slot = jnp.where(valid, slot, m)  # invalid -> dropped (>= cap)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
     data = jnp.zeros_like(a.data).at[rows, slot].set(both, mode="drop")
     total = a.count + b.count
     delivered = jnp.minimum(total, cap)

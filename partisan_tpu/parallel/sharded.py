@@ -32,6 +32,7 @@ import numpy as np
 from jax import Array
 from jax.sharding import Mesh, PartitionSpec as P
 
+from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
@@ -94,6 +95,17 @@ class ShardComm:
     def gather_vec(self, x: Array) -> Array:
         return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
 
+    def actor_gather(self, x: Array, a: int) -> Array:
+        """Causal actor rows, replicated to every shard.  The actor
+        block is shard 0's first ``a`` rows; other shards' slices are
+        all-zero (senders are masked by gid < n_actors), so a psum
+        reconstructs the block everywhere over ICI."""
+        if a > self.n_local:
+            raise ValueError(
+                f"n_actors={a} must be <= nodes per shard "
+                f"({self.n_local}) so the actor block is shard-resident")
+        return jax.lax.psum(x[:a], AXIS)
+
 
 @dataclasses.dataclass
 class ShardedCluster:
@@ -139,12 +151,20 @@ class ShardedCluster:
         def spec_like(subtree, s):
             return jax.tree.map(lambda _: s, subtree)
 
+        def delivery_specs(d):
+            if d == ():
+                return ()
+            # Node-axis slabs shard; scalar overflow counters replicate.
+            return jax.tree.map(
+                lambda x: repl if jnp.ndim(x) == 0 else shard, d)
+
         return ClusterState(
             rnd=repl,
             faults=spec_like(state.faults, repl),
             inbox=spec_like(state.inbox, shard),
             manager=spec_like(state.manager, shard),
             model=spec_like(state.model, shard),
+            delivery=delivery_specs(state.delivery),
             stats=spec_like(state.stats, repl),
         )
 
@@ -157,6 +177,8 @@ class ShardedCluster:
             inbox=exchange.empty_inbox(cfg.n_nodes, cfg.inbox_cap, cfg.msg_words),
             manager=self.manager.init(cfg, self.host_comm),
             model=self.model.init(cfg, self.host_comm) if self.model is not None else (),
+            delivery=(delivery_mod.init(cfg, self.host_comm)
+                      if delivery_mod.enabled(cfg) else ()),
             stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         )
         return self.shard_state(state)
