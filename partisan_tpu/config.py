@@ -106,6 +106,9 @@ class Config:
     # --- manager / strategy selection (partisan_config.erl:624, :637) --
     peer_service_manager: str = "fullmesh"     # fullmesh|hyparview|scamp_v1|scamp_v2|client_server|static
     membership_strategy: str = "full"          # full|scamp_v1|scamp_v2
+    cs_servers: int = 1                        # client_server: global ids
+                                               #   < cs_servers are servers
+                                               #   (the reference's tag)
 
     # --- virtual time --------------------------------------------------
     round_ms: int = 1_000

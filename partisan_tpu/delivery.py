@@ -242,16 +242,23 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
         # k+1 times at the sender's own entry.
         n_sent = is_c.sum(axis=1, dtype=vclock.DTYPE)
         rank1 = jnp.cumsum(is_c, axis=1)           # 1-based where is_c
+        # Emit-cap overflow drops the TAIL records (slot order), so the
+        # clock advances only by the kept prefix — otherwise receivers
+        # would wait forever for counters that were never emitted.
+        n_kept = jnp.minimum(n_sent, vclock.DTYPE(cfg.causal_emit_cap))
+        is_c_all = is_c                       # incl. overflow tail, for
+        is_c = is_c & (rank1 <= cfg.causal_emit_cap)  # event-lane removal
         me_actor = jnp.where(gids < A, gids, 0)
         onehot = (jnp.arange(A)[None, :] ==
                   me_actor[:, None]).astype(vclock.DTYPE)
         msg_clocks = lane.clock[:, None, :] + \
             onehot[:, None, :] * rank1[:, :, None].astype(vclock.DTYPE)
-        new_clock = lane.clock + onehot * n_sent[:, None]
+        new_clock = lane.clock + onehot * n_kept[:, None]
 
         wide = jnp.concatenate(
             [emitted, msg_clocks.astype(jnp.int32)], axis=-1)
-        packed, dropped = _compact(wide, is_c, cfg.causal_emit_cap)
+        packed, _ = _compact(wide, is_c, cfg.causal_emit_cap)
+        dropped = jnp.sum(n_sent - n_kept, dtype=jnp.int32)
 
         # Sender-side loss recovery: history ring + cadenced replay.
         H = cfg.causal_hist_cap
@@ -277,9 +284,10 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             hist=jnp.where(ctx.alive[:, None, None], hist, lane.hist),
             hist_ptr=jnp.where(ctx.alive, hist_ptr, lane.hist_ptr),
             overflow=lane.overflow + comm.allsum(dropped)))
-        # Remove from the event lane.
+        # Remove from the event lane (overflow tail included: it was a
+        # causal send, dropped and counted — it must not leak unicast).
         emitted = emitted.at[..., T.W_KIND].set(
-            jnp.where(is_c, 0, emitted[..., T.W_KIND]))
+            jnp.where(is_c_all, 0, emitted[..., T.W_KIND]))
 
     # Any message still flagged F_CAUSAL was emitted by a non-actor node
     # or names an unconfigured lane: it must NOT leak onto the unicast
@@ -366,6 +374,12 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
         clock0 = lane.clock
         INF = jnp.int32(B + G + 1)
         D = min(B + G, cfg.causal_deliver_cap)
+        # The per-node quota is bounded by the inbox space actually left
+        # after the event lane (and prior lanes) — a record whose clock
+        # advance survived but whose payload got cut at the merge would
+        # be a silent zero-times delivery.
+        free = jnp.maximum(cfg.inbox_cap - inbox.count, 0)
+        quota0 = jnp.minimum(jnp.int32(D), free)
 
         def sweep(carry):
             clock, b_avail, s_avail, quota = carry
@@ -403,9 +417,9 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
             return (clock2, b_avail & ~del_b, s_avail & ~del_s, quota2), \
                 (del_b, del_s)
 
-        b_avail, s_avail = b_valid, arr_ok
+        b_avail, s_avail = b_valid & ctx.alive[:, None], arr_ok
         clock = clock0
-        quota = jnp.full((n,), D, jnp.int32)
+        quota = quota0
         dels = []
         for _ in range(CAUSAL_SWEEPS):
             (clock, b_avail, s_avail, quota), d = sweep(

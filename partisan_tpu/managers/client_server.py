@@ -1,0 +1,102 @@
+"""Client-server (star) peer-service manager.
+
+TPU rebuild of ``partisan_client_server_peer_service_manager``
+(reference src/partisan_client_server_peer_service_manager.erl):
+
+- tag-based roles (moduledoc :24-41): the first ``cfg.cs_servers``
+  global ids are *servers*, the rest *clients*,
+- servers maintain connections with all other servers (full mesh);
+  clients connect only to servers; client-client joins are REFUSED
+  (``accept_join_with_tag`` :895-903),
+- membership is eventually consistent, replicated by gossip (:38-39):
+  servers exchange their member bitmaps over server-server edges on the
+  periodic tick, and push them to their clients, so every node's
+  ``members`` view converges on the full roster,
+- sends to unconnected nodes fail, exactly like the reference's
+  ``do_send_message`` → ``not_yet_connected`` (:880-892): a client that
+  wants another client must route via a server (its ``neighbors`` row
+  only ever lists servers, so overlay-driven models do this naturally).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+
+_GOSSIP_EDGE_TAG = 111
+
+
+class ClientServerState(NamedTuple):
+    joined: Array  # bool[n_local, n_global] — established connections
+    known: Array   # bool[n_local, n_global] — gossiped membership view
+
+
+class ClientServer:
+    name = "client_server"
+
+    def init(self, cfg: Config, comm: LocalComm) -> ClientServerState:
+        n, g = comm.n_local, comm.n_global
+        gids = comm.local_ids()
+        self_row = jnp.arange(g)[None, :] == gids[:, None]
+        return ClientServerState(
+            joined=jnp.zeros((n, g), jnp.bool_),
+            known=self_row,
+        )
+
+    def step(self, cfg: Config, comm: LocalComm, state: ClientServerState,
+             ctx: RoundCtx) -> tuple[ClientServerState, Array]:
+        n_local, n_global = state.joined.shape
+        gids = comm.local_ids()
+        all_ids = jnp.arange(n_global, dtype=jnp.int32)
+
+        # Periodic membership gossip along established edges (:38-39).
+        fires = ((ctx.rnd + gids) % cfg.gossip_every == 0) & ctx.alive
+        dst = jnp.where(fires[:, None] & state.joined,
+                        all_ids[None, :], jnp.int32(-1))
+        dst = faults_mod.filter_edges(
+            ctx.faults, gids, dst, cfg.seed, ctx.rnd, _GOSSIP_EDGE_TAG)
+        pushed = comm.push_or(state.known, dst)
+        known = state.known | (pushed & ctx.alive[:, None])
+        known = jnp.where(ctx.alive[:, None], known, state.known)
+
+        emitted = jnp.zeros((n_local, 0, cfg.msg_words), jnp.int32)
+        return ClientServerState(joined=state.joined, known=known), emitted
+
+    # ---- views -------------------------------------------------------
+    def neighbors(self, cfg: Config, state: ClientServerState,
+                  comm: LocalComm | None = None) -> Array:
+        n_local, n_global = state.joined.shape
+        all_ids = jnp.arange(n_global, dtype=jnp.int32)
+        return jnp.where(state.joined, all_ids[None, :], jnp.int32(-1))
+
+    def members(self, cfg: Config, state: ClientServerState,
+                comm: LocalComm | None = None) -> Array:
+        return state.known
+
+    # ---- scenario scripting (host-side; single-device layout) --------
+    def join(self, cfg: Config, state: ClientServerState, node: int,
+             target: int) -> ClientServerState:
+        """Join refused between two clients (accept_join_with_tag
+        :895-903) — the state is returned unchanged, mirroring the
+        reference closing the connection."""
+        if node >= cfg.cs_servers and target >= cfg.cs_servers:
+            return state
+        j = state.joined.at[node, target].set(True)
+        j = j.at[target, node].set(True)
+        k = state.known.at[node, target].set(True)
+        k = k.at[target, node].set(True)
+        return ClientServerState(joined=j, known=k)
+
+    def leave(self, cfg: Config, state: ClientServerState,
+              node: int) -> ClientServerState:
+        j = state.joined.at[node, :].set(False)
+        j = j.at[:, node].set(False)
+        k = state.known.at[:, node].set(False)
+        return ClientServerState(joined=j, known=k)

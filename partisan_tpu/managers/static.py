@@ -1,0 +1,66 @@
+"""Static peer-service manager.
+
+TPU rebuild of ``partisan_static_peer_service_manager`` (reference
+src/partisan_static_peer_service_manager.erl): membership changes ONLY
+by explicit join/leave — no gossip, no overlay maintenance, no healing.
+A join establishes a (bidirectional) connection; both ends record the
+peer (the hello/state handshake, peer_service_server.erl:150-166).
+
+State is one adjacency bitmap.  Crash-stopped peers keep their slots —
+exactly like the reference, where the strategy state outlives a dead TCP
+connection and the reconnect loop re-establishes it on recovery.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+
+
+class StaticState(NamedTuple):
+    joined: Array  # bool[n_local, n_global] — established connections
+
+
+class Static:
+    name = "static"
+
+    def init(self, cfg: Config, comm: LocalComm) -> StaticState:
+        return StaticState(
+            joined=jnp.zeros((comm.n_local, comm.n_global), jnp.bool_))
+
+    def step(self, cfg: Config, comm: LocalComm, state: StaticState,
+             ctx: RoundCtx) -> tuple[StaticState, Array]:
+        emitted = jnp.zeros((comm.n_local, 0, cfg.msg_words), jnp.int32)
+        return state, emitted
+
+    def neighbors(self, cfg: Config, state: StaticState,
+                  comm: LocalComm | None = None) -> Array:
+        n_local, n_global = state.joined.shape
+        all_ids = jnp.arange(n_global, dtype=jnp.int32)
+        return jnp.where(state.joined, all_ids[None, :], jnp.int32(-1))
+
+    def members(self, cfg: Config, state: StaticState,
+                comm: LocalComm | None = None) -> Array:
+        n_local, n_global = state.joined.shape
+        gids = (comm.local_ids() if comm is not None
+                else jnp.arange(n_local, dtype=jnp.int32))
+        self_row = jnp.arange(n_global)[None, :] == gids[:, None]
+        return state.joined | self_row
+
+    # ---- scenario scripting (host-side; single-device layout) --------
+    def join(self, cfg: Config, state: StaticState, node: int,
+             target: int) -> StaticState:
+        j = state.joined.at[node, target].set(True)
+        j = j.at[target, node].set(True)
+        return StaticState(joined=j)
+
+    def leave(self, cfg: Config, state: StaticState, node: int) -> StaticState:
+        j = state.joined.at[node, :].set(False)
+        j = j.at[:, node].set(False)
+        return StaticState(joined=j)

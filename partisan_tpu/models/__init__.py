@@ -7,3 +7,4 @@ from partisan_tpu.models.base import Model  # noqa: F401
 from partisan_tpu.models.anti_entropy import AntiEntropy  # noqa: F401
 from partisan_tpu.models.plumtree import Plumtree  # noqa: F401
 from partisan_tpu.models.direct_mail import DirectMail  # noqa: F401
+from partisan_tpu.models.rumor_mongering import RumorMongering  # noqa: F401

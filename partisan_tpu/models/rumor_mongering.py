@@ -1,0 +1,113 @@
+"""Demers rumor mongering (protocols/demers_rumor_mongering.erl).
+
+Reference behavior: infect-and-die gossip — on FIRST receipt of a rumor
+a node delivers it, stores it, and forwards it to FANOUT=2 random
+members (excluding itself and the sender, :127-158); duplicates are
+ignored.  Each node forwards a given rumor exactly once, so spread is a
+branching process that can die out before full coverage (by design —
+the reference pairs it with anti-entropy for completeness).
+
+TPU mapping: ``store`` marks rumors seen; ``pending`` marks rumors that
+still owe their one forwarding burst.  A node serves up to
+``PER_ROUND`` pending rumors per round (excess wait — the mailbox-
+backlog analogue), picking fanout targets from the manager's neighbors.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import rng
+
+FANOUT = 2        # demers_rumor_mongering.erl:42 ?THIS_FANOUT
+PER_ROUND = 2     # pending rumors forwarded per node per round
+OP_RUMOR = 3      # APP payload[0] opcode
+
+_PICK_TAG = 211
+
+
+class RumorState(NamedTuple):
+    store: Array    # bool[n_local, max_broadcasts]
+    pending: Array  # bool[n_local, max_broadcasts] — owe a forward burst
+
+
+class RumorMongering:
+    name = "demers_rumor_mongering"
+
+    def init(self, cfg: Config, comm: LocalComm) -> RumorState:
+        z = jnp.zeros((comm.n_local, cfg.max_broadcasts), jnp.bool_)
+        return RumorState(store=z, pending=z)
+
+    def step(self, cfg: Config, comm: LocalComm, state: RumorState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[RumorState, Array]:
+        n = state.store.shape[0]
+        S = cfg.max_broadcasts
+        gids = comm.local_ids()
+
+        # First receipt -> store + owe a forward (infect); dup -> ignore.
+        inb = ctx.inbox.data
+        is_r = (inb[..., T.W_KIND] == T.MsgKind.APP) & \
+               (inb[..., T.P0] == OP_RUMOR)
+        hits = jnp.zeros((n, S), jnp.int32)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], is_r.shape)
+        hits = hits.at[rows, jnp.where(is_r, inb[..., T.P1], S)
+                       ].add(1, mode="drop")
+        new = (hits > 0) & ~state.store & ctx.alive[:, None]
+        store = state.store | new
+        pending = state.pending | new
+
+        # Serve up to PER_ROUND pending rumors: FANOUT random neighbors
+        # each (die after forwarding: pending bit cleared).
+        def per_node(key, pend, row, alive):
+            slot_keys = jax.vmap(
+                lambda i: rng.subkey(rng.subkey(key, _PICK_TAG), i)
+            )(jnp.arange(PER_ROUND))
+            # lowest PER_ROUND pending slot ids
+            order = jnp.argsort(jnp.where(pend, 0, 1), stable=True)
+            slots = jnp.where(pend[order[:PER_ROUND]],
+                              order[:PER_ROUND].astype(jnp.int32), -1)
+            slots = jnp.where(alive, slots, -1)
+
+            def fan(k, slot):
+                picked = rng.choice_slots(k, row >= 0, FANOUT)
+                ids = jnp.where(picked >= 0, row[picked], -1)
+                return jnp.where(slot >= 0, ids, -1)
+
+            tgts = jax.vmap(fan)(slot_keys, slots)   # [PER_ROUND, FANOUT]
+            return slots, tgts
+
+        slots, tgts = jax.vmap(per_node)(
+            ctx.keys, pending, nbrs, ctx.alive)
+
+        emitted = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None, None], tgts,
+            payload=(jnp.int32(OP_RUMOR), slots[:, :, None]),
+        ).reshape(n, PER_ROUND * FANOUT, cfg.msg_words)
+
+        served = jnp.zeros_like(pending)
+        served = served.at[
+            jnp.broadcast_to(jnp.arange(n)[:, None], slots.shape),
+            jnp.where(slots >= 0, slots, S)].set(True, mode="drop")
+        pending = pending & ~served
+        pending = jnp.where(ctx.alive[:, None], pending, state.pending)
+        store = jnp.where(ctx.alive[:, None], store, state.store)
+        return RumorState(store=store, pending=pending), emitted
+
+    # ---- scenario helpers --------------------------------------------
+    def broadcast(self, state: RumorState, node: int, slot: int) -> RumorState:
+        return RumorState(
+            store=state.store.at[node, slot].set(True),
+            pending=state.pending.at[node, slot].set(True))
+
+    def coverage(self, state: RumorState, alive: Array, slot: int) -> Array:
+        have = state.store[:, slot] & alive
+        return jnp.sum(have) / jnp.maximum(jnp.sum(alive), 1)

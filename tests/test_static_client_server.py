@@ -1,0 +1,104 @@
+"""Static and client-server manager tests — sim analogues of the
+reference's static/client-server coverage (partisan_SUITE `default`
+group with those managers): explicit-join-only membership, star
+topology with tag-refused client-client joins, membership gossip
+convergence, and workload dissemination over the star."""
+
+import numpy as np
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.anti_entropy import AntiEntropy
+
+
+def test_static_explicit_joins_only():
+    cfg = Config(n_nodes=8, seed=1, peer_service_manager="static")
+    cl = Cluster(cfg)
+    st = cl.init()
+    m = st.manager
+    m = cl.manager.join(cfg, m, 1, 0)
+    m = cl.manager.join(cfg, m, 2, 0)
+    st = st._replace(manager=m)
+    st = cl.steps(st, 30)
+    members = np.asarray(cl.manager.members(cfg, st.manager))
+    # No gossip: node 1 and 2 know the contact but NOT each other.
+    assert members[1, 0] and members[2, 0]
+    assert not members[1, 2] and not members[2, 1]
+    nbrs = np.asarray(cl.manager.neighbors(cfg, st.manager))
+    assert set(nbrs[0][nbrs[0] >= 0]) == {1, 2}
+
+
+def test_static_leave_clears_edges():
+    cfg = Config(n_nodes=6, seed=2, peer_service_manager="static")
+    cl = Cluster(cfg)
+    st = cl.init()
+    m = st.manager
+    for i in range(1, 6):
+        m = cl.manager.join(cfg, m, i, 0)
+    m = cl.manager.leave(cfg, m, 3)
+    st = st._replace(manager=m)
+    st = cl.steps(st, 5)
+    nbrs = np.asarray(cl.manager.neighbors(cfg, st.manager))
+    assert (nbrs[3] < 0).all()
+    assert 3 not in set(nbrs[0][nbrs[0] >= 0])
+
+
+def cs_config(n, seed, servers=2, **kw):
+    return Config(n_nodes=n, seed=seed, peer_service_manager="client_server",
+                  cs_servers=servers, **kw)
+
+
+def boot_star(cl):
+    """Servers full-mesh each other; client i joins server i % S."""
+    cfg = cl.cfg
+    st = cl.init()
+    m = st.manager
+    S = cfg.cs_servers
+    for a in range(S):
+        for b in range(a + 1, S):
+            m = cl.manager.join(cfg, m, a, b)
+    for c in range(S, cfg.n_nodes):
+        m = cl.manager.join(cfg, m, c, c % S)
+    return st._replace(manager=m)
+
+
+def test_client_server_topology_and_refusal():
+    cfg = cs_config(12, seed=7, servers=3)
+    cl = Cluster(cfg)
+    st = boot_star(cl)
+    # Client-client join refused (accept_join_with_tag).
+    st = st._replace(manager=cl.manager.join(cfg, st.manager, 5, 7))
+    nbrs = np.asarray(cl.manager.neighbors(cfg, st.manager))
+    assert 7 not in set(nbrs[5][nbrs[5] >= 0]), "client-client joined"
+    # Clients only hold servers; servers hold servers + their clients.
+    for c in range(3, 12):
+        row = set(nbrs[c][nbrs[c] >= 0])
+        assert row == {c % 3}, (c, row)
+    for s in range(3):
+        row = set(nbrs[s][nbrs[s] >= 0])
+        assert {x for x in row if x < 3} == {0, 1, 2} - {s}
+
+
+def test_client_server_membership_gossip_converges():
+    cfg = cs_config(12, seed=11, servers=3)
+    cl = Cluster(cfg)
+    st = boot_star(cl)
+    st = cl.steps(st, cfg.gossip_every * 4)
+    members = np.asarray(cl.manager.members(cfg, st.manager))
+    assert members.all(), (
+        f"membership did not converge: {members.sum(axis=1)}")
+
+
+def test_dissemination_via_servers():
+    """A client's gossip reaches every other client THROUGH the star
+    (clients never talk to clients directly)."""
+    cfg = cs_config(12, seed=19, servers=2)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = boot_star(cl)
+    st = cl.steps(st, 10)
+    st = st._replace(model=model.broadcast(st.model, node=7, slot=0))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
+        max_rounds=200, check_every=5)
+    assert r != -1, "star dissemination failed"
