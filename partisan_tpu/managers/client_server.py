@@ -99,4 +99,8 @@ class ClientServer:
         j = state.joined.at[node, :].set(False)
         j = j.at[:, node].set(False)
         k = state.known.at[:, node].set(False)
+        # the leaver resets to its singleton view (a node is always its
+        # own member), clearing any stale peers it gossiped with
+        k = k.at[node, :].set(False)
+        k = k.at[node, node].set(True)
         return ClientServerState(joined=j, known=k)

@@ -8,3 +8,5 @@ from partisan_tpu.models.anti_entropy import AntiEntropy  # noqa: F401
 from partisan_tpu.models.plumtree import Plumtree  # noqa: F401
 from partisan_tpu.models.direct_mail import DirectMail  # noqa: F401
 from partisan_tpu.models.rumor_mongering import RumorMongering  # noqa: F401
+from partisan_tpu.models.commit import CommitProtocol  # noqa: F401
+from partisan_tpu.models.alsberg_day import AlsbergDay  # noqa: F401

@@ -1,0 +1,219 @@
+"""Alsberg-Day primary/backup replication (protocols/alsberg_day.erl and
+the acked variants alsberg_day_acked.erl / alsberg_day_acked_membership.erl).
+
+Reference behavior: clients send ``{write, From, Key, Value}`` to the
+membership head (the primary).  The primary applies the write locally,
+records it outstanding, and sends ``collaborate`` to the backups
+(alsberg_day.erl:181-227); each backup applies the write and answers
+``collaborate_ack`` (:256-279); once every backup acked, the primary
+replies ``{ok, Value}`` to the client (:229-254).  Reads at the primary
+return the stored value (:150-178).  The acked variants send the
+collaborate/reply messages with ``{ack, true}`` so the manager
+retransmits them until acknowledged.
+
+TPU mapping: a fixed key space ``[n_local, keys]`` of int32 registers
+per node.  Writes are scripted host-side into a client request queue;
+the step routes request -> primary apply+collaborate -> backup apply+ack
+-> client ok, all as APP messages.  The primary is global node 0 by
+convention (the membership head); non-primaries receiving a write
+answer ``not_primary`` like the reference (:223).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+# APP payload layout: [op, key, value, aux]
+OP_WRITE = 30        # client -> primary
+OP_COLLABORATE = 31  # primary -> backups
+OP_COLLAB_ACK = 32   # backup -> primary
+OP_WRITE_OK = 33     # primary -> client
+OP_NOT_PRIMARY = 34  # error reply (alsberg_day.erl:223)
+
+PRIMARY = 0          # membership head
+
+
+class AlsbergDayState(NamedTuple):
+    store: Array      # int32[n, K] — replicated registers
+    written: Array    # bool[n, K] — register has been written
+    # client side
+    req_pending: Array  # bool[n, K] — writes queued to send
+    req_value: Array    # int32[n, K]
+    req_ok: Array       # bool[n, K] — ok received
+    # primary side: outstanding collaborations
+    out_client: Array   # int32[n, K] — requesting client (-1 idle)
+    out_acks: Array     # bool[n, K, P] — backup acks collected
+    out_mask: Array     # bool[n, K, P] — backups awaited
+
+
+class AlsbergDay:
+    def __init__(self, acked: bool = False, keys: int = 8) -> None:
+        self.acked = acked
+        self.keys = keys
+        self.name = "alsberg_day_acked" if acked else "alsberg_day"
+
+    def init(self, cfg: Config, comm: LocalComm) -> AlsbergDayState:
+        n, k, p = comm.n_local, self.keys, comm.n_global
+        zi = jnp.zeros((n, k), jnp.int32)
+        zb = jnp.zeros((n, k), jnp.bool_)
+        return AlsbergDayState(
+            store=zi, written=zb,
+            req_pending=zb, req_value=zi, req_ok=zb,
+            out_client=jnp.full((n, k), -1, jnp.int32),
+            out_acks=jnp.zeros((n, k, p), jnp.bool_),
+            out_mask=jnp.zeros((n, k, p), jnp.bool_),
+        )
+
+    def step(self, cfg: Config, comm: LocalComm, st: AlsbergDayState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[AlsbergDayState, Array]:
+        n, k = st.store.shape
+        p = st.out_acks.shape[-1]
+        gids = comm.local_ids()
+        rows = jnp.arange(n, dtype=jnp.int32)
+        alive = ctx.alive
+        flags = T.F_ACK_REQUIRED if self.acked else 0
+
+        inb = ctx.inbox.data
+        cap = inb.shape[1]
+        is_app = inb[..., T.W_KIND] == T.MsgKind.APP
+        op = jnp.where(is_app & alive[:, None], inb[..., T.P0], 0)
+        key = jnp.clip(jnp.where(is_app, inb[..., T.P1], 0), 0, k - 1)
+        val = inb[..., T.P2]
+        aux = inb[..., T.P3]          # requesting client for collaborate
+        src = inb[..., T.W_SRC]
+        r2 = jnp.broadcast_to(rows[:, None], (n, cap))
+        is_primary = gids == PRIMARY
+
+        def scatter(dest: Array, m: Array, v: Array) -> Array:
+            tgt = jnp.where(m, key, k)
+            return dest.at[r2, tgt].set(v, mode="drop")
+
+        # ---- apply writes (primary) and collaborations (backups) ------
+        m_write = (op == OP_WRITE) & is_primary[:, None]
+        m_collab = op == OP_COLLABORATE
+        m_apply = m_write | m_collab
+        store = scatter(st.store, m_apply, val)
+        written = scatter(st.written, m_apply, jnp.ones_like(val, jnp.bool_))
+
+        # primary records the outstanding collaboration; backups awaited =
+        # every other GLOBALLY alive member (membership rest,
+        # alsberg_day.erl:181-208; ctx.faults.alive is the global mask —
+        # ctx.alive is only this shard's slice)
+        client = jnp.where(m_write, src, 0)
+        started = scatter(jnp.zeros((n, k), jnp.int32), m_write,
+                          jnp.ones_like(val)) > 0
+        # a newer write to a busy key subsumes the outstanding one (the
+        # primary serializes; the displaced client's write was applied
+        # before being overwritten, so it is acknowledged immediately —
+        # the reference tracks each write separately instead)
+        displaced = started & (st.out_client >= 0)
+        out_client = scatter(st.out_client, m_write, client)
+        pid = jnp.arange(p, dtype=jnp.int32)
+        galive = ctx.faults.alive
+        backups = galive[None, :] & (pid[None, :] != PRIMARY)   # [1, P]
+        new_mask = jnp.broadcast_to(backups[:, None, :], (n, k, p))
+        out_mask = jnp.where(started[..., None], new_mask, st.out_mask)
+        out_acks = jnp.where(started[..., None], False, st.out_acks)
+
+        # collect backup acks
+        m_ack = (op == OP_COLLAB_ACK) & is_primary[:, None]
+        tgt = jnp.where(m_ack, key, k)
+        out_acks = out_acks.at[r2, tgt, jnp.clip(src, 0, p - 1)].set(
+            True, mode="drop")
+
+        # ok to client when all awaited backups acked (:229-254)
+        complete = (out_client >= 0) & jnp.all(~out_mask | out_acks, axis=-1) \
+            & is_primary[:, None] & alive[:, None]
+        ok_dst = jnp.where(complete, out_client, -1)
+        out_client = jnp.where(complete, -1, out_client)
+
+        # client: mark ok
+        m_ok = op == OP_WRITE_OK
+        req_ok = scatter(st.req_ok, m_ok, jnp.ones_like(val, jnp.bool_))
+
+        # ---- emissions ------------------------------------------------
+        blocks = []
+        # (1) client write requests: send every pending key to the primary
+        # (re-sent each round until ok in the acked variant; once otherwise)
+        fire = st.req_pending & alive[:, None]
+        kid = jnp.arange(k, dtype=jnp.int32)
+        blocks.append(msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            jnp.where(fire, PRIMARY, -1), flags=flags,
+            payload=(jnp.int32(OP_WRITE), kid[None, :], st.req_value,
+                     jnp.int32(0))))
+        req_pending = st.req_pending & ~fire if not self.acked else \
+            st.req_pending & ~req_ok
+
+        # (2) primary collaborate fan-out for writes applied this round
+        aux_client = scatter(jnp.zeros((n, k), jnp.int32), m_write, client)
+        col_dst = jnp.where(started[..., None] & new_mask, pid, -1)  # [n,K,P]
+        blocks.append(msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None, None], col_dst,
+            flags=flags,
+            payload=(jnp.int32(OP_COLLABORATE), kid[None, :, None],
+                     store[..., None], aux_client[..., None]),
+        ).reshape(n, k * p, cfg.msg_words))
+
+        # (3) replies per inbox message: backup collaborate acks, plus
+        # not_primary errors for writes reaching a non-primary (:223)
+        misrouted = (op == OP_WRITE) & ~is_primary[:, None]
+        rep_op = jnp.select([m_collab, misrouted],
+                            [jnp.int32(OP_COLLAB_ACK),
+                             jnp.int32(OP_NOT_PRIMARY)], 0)
+        rep_dst = jnp.where((rep_op > 0) & alive[:, None], src, -1)
+        blocks.append(msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], rep_dst,
+            flags=flags, payload=(rep_op, key, val, aux)))
+
+        # (4) primary ok replies (completed + displaced-by-newer-write)
+        blocks.append(msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], ok_dst,
+            flags=flags,
+            payload=(jnp.int32(OP_WRITE_OK), kid[None, :], store,
+                     jnp.int32(0))))
+        disp_dst = jnp.where(displaced & alive[:, None], st.out_client, -1)
+        blocks.append(msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], disp_dst,
+            flags=flags,
+            payload=(jnp.int32(OP_WRITE_OK), kid[None, :], store,
+                     jnp.int32(0))))
+
+        emitted = jnp.concatenate(blocks, axis=1)
+        new = AlsbergDayState(
+            store=store, written=written,
+            req_pending=req_pending, req_value=st.req_value, req_ok=req_ok,
+            out_client=out_client, out_acks=out_acks, out_mask=out_mask)
+        return new, emitted
+
+    # ---- scenario helpers --------------------------------------------
+    def write(self, st: AlsbergDayState, client: int, key: int,
+              value: int) -> AlsbergDayState:
+        """Queue ``{write, Key, Value}`` at ``client`` (the protocol's
+        public write/2)."""
+        return st._replace(
+            req_pending=st.req_pending.at[client, key].set(True),
+            req_value=st.req_value.at[client, key].set(value),
+            req_ok=st.req_ok.at[client, key].set(False))
+
+    @staticmethod
+    def replicated(st: AlsbergDayState, key: int, alive: Array) -> Array:
+        """True iff every alive node stores the same written value."""
+        w = st.written[:, key] | ~alive
+        vals = jnp.where(st.written[:, key] & alive, st.store[:, key], -1)
+        ref = jnp.max(vals)
+        agree = (vals == ref) | ~(st.written[:, key] & alive)
+        return jnp.all(w) & jnp.all(agree)
+
+    @staticmethod
+    def acked_ok(st: AlsbergDayState, client: int, key: int) -> Array:
+        return st.req_ok[client, key]

@@ -1,0 +1,418 @@
+"""Atomic broadcast via commit protocols: Lampson 2PC, Bernstein CTP,
+Skeen 3PC (protocols/lampson_2pc.erl, bernstein_ctp.erl, skeen_3pc.erl).
+
+Reference behavior (one gen_server per node, two ETS tables of
+transaction records):
+
+- ``broadcast`` at a coordinator creates a transaction whose participant
+  set is the membership at begin time, then sends ``prepare`` to every
+  participant (lampson_2pc.erl:126-163).
+- Participants log the transaction and answer ``prepared``
+  (lampson_2pc.erl:370-383); when the coordinator holds acks from the
+  full participant set it replies ok to the caller and fans out
+  ``commit``; participants deliver the payload and answer ``commit_ack``
+  (lampson_2pc.erl:269-368).
+- A coordinator still collecting votes when ``coordinator_timeout``
+  fires moves to ``aborting``, answers error, and fans out ``abort``
+  (lampson_2pc.erl:202-239).
+- Skeen 3PC inserts a ``precommit``/``precommit_ack`` phase between the
+  vote and the commit (skeen_3pc.erl:390-443); its participant timeout
+  is non-blocking: timed out while ``prepared`` -> abort, while
+  ``precommit`` -> commit (skeen_3pc.erl:173-202).
+- Bernstein CTP is 2PC plus cooperative termination: a participant
+  timed out without a decision asks everyone ``decision_request``;
+  peers answer ``decision`` (commit/abort/uncertain — undefined counts
+  as abort); an ``uncertain`` replier is recorded and notified once the
+  decision is learned (bernstein_ctp.erl:170-300).
+
+TPU mapping: all three protocols are ONE vectorized engine over
+``[n_local, slots]`` transaction state, stepped for every node at once.
+A transaction is identified by its slot index (callers use distinct
+slots; the reference's unique ids become slot indices).  Coordinator
+fan-outs are edge-triggered — emitted exactly once per phase entry
+(``c_sent`` records the last phase fanned out) — so message-omission
+faults have the same blocking/abort consequences as in the reference.
+Participant sets are bool masks over the global node axis, captured at
+``begin`` time like the reference's membership snapshot.
+
+Deviation (documented): the reference's ``prepare`` carries the full
+participant list inside the transaction record, which CTP participants
+use for decision requests; the fixed-width record cannot, so CTP
+decision requests go to the node's current overlay neighbors instead —
+equivalent under stable membership.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+# APP payload layout: [op, slot, value, aux]
+OP_PREPARE = 10
+OP_PREPARED = 11
+OP_COMMIT = 12
+OP_COMMIT_ACK = 13
+OP_ABORT = 14
+OP_ABORT_ACK = 15
+OP_PRECOMMIT = 16
+OP_PRECOMMIT_ACK = 17
+OP_DECISION_REQ = 18
+OP_DECISION = 19
+
+# decision_request answers (payload aux word)
+DEC_ABORT = 1
+DEC_COMMIT = 2
+DEC_UNCERTAIN = 3
+
+# Coordinator phases (c_phase)
+C_IDLE = 0
+C_PREPARING = 1      # collecting prepared votes
+C_PRECOMMIT = 2      # 3PC only: commit_authorized, collecting precommit_acks
+C_COMMITTING = 3     # collecting commit_acks
+C_ABORTING = 4       # collecting abort_acks
+C_DONE = 5
+
+# Participant statuses (p_status)
+P_NONE = 0
+P_PREPARED = 1
+P_PRECOMMIT = 2
+P_COMMIT = 3
+P_ABORT = 4
+
+_FANOUT_OP = {C_PREPARING: OP_PREPARE, C_PRECOMMIT: OP_PRECOMMIT,
+              C_COMMITTING: OP_COMMIT, C_ABORTING: OP_ABORT}
+
+
+class CommitState(NamedTuple):
+    # Coordinator side: [n_local, slots] (+ participant axis P = n_global)
+    c_phase: Array     # int32[n, S]
+    c_sent: Array      # int32[n, S] — last phase fanned out (edge trigger)
+    c_mask: Array      # bool[n, S, P] — participant set at begin
+    c_acks: Array      # bool[n, S, P] — acks for the CURRENT phase
+    c_t0: Array        # int32[n, S] — round of phase entry (timeout base)
+    c_value: Array     # int32[n, S] — broadcast payload
+    c_outcome: Array   # int32[n, S] — 0 pending, 1 ok, 2 error (caller reply)
+    # Participant side
+    p_status: Array    # int32[n, S]
+    p_coord: Array     # int32[n, S] — -1 until a prepare is seen
+    p_value: Array     # int32[n, S]
+    p_last: Array      # int32[n, S] — round of last progress (timeout base)
+    p_uncertain: Array # bool[n, S, P] — CTP: peers that answered uncertain
+    delivered: Array   # bool[n, S] — payload handed to the server ref
+
+
+class CommitProtocol:
+    """variant: 'lampson_2pc' | 'bernstein_ctp' | 'skeen_3pc'."""
+
+    VARIANTS = ("lampson_2pc", "bernstein_ctp", "skeen_3pc")
+
+    def __init__(self, variant: str = "lampson_2pc", slots: int = 4,
+                 coordinator_timeout_rounds: int = 10,
+                 participant_timeout_rounds: int = 5) -> None:
+        if variant not in self.VARIANTS:
+            raise ValueError(f"unknown variant {variant!r}")
+        self.name = variant
+        self.variant = variant
+        self.slots = slots
+        self.c_timeout = coordinator_timeout_rounds
+        self.p_timeout = participant_timeout_rounds
+
+    @property
+    def three_phase(self) -> bool:
+        return self.variant == "skeen_3pc"
+
+    @property
+    def ctp(self) -> bool:
+        return self.variant == "bernstein_ctp"
+
+    # ------------------------------------------------------------------
+    def init(self, cfg: Config, comm: LocalComm) -> CommitState:
+        n, s, p = comm.n_local, self.slots, comm.n_global
+        zi = jnp.zeros((n, s), jnp.int32)
+        zb = jnp.zeros((n, s, p), jnp.bool_)
+        return CommitState(
+            c_phase=zi, c_sent=zi, c_mask=zb, c_acks=zb, c_t0=zi,
+            c_value=zi, c_outcome=zi,
+            p_status=zi, p_coord=jnp.full((n, s), -1, jnp.int32),
+            p_value=zi, p_last=zi, p_uncertain=zb,
+            delivered=jnp.zeros((n, s), jnp.bool_),
+        )
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, st: CommitState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[CommitState, Array]:
+        n, s, p = st.c_mask.shape
+        gids = comm.local_ids()
+        rows = jnp.arange(n, dtype=jnp.int32)
+        alive = ctx.alive
+
+        inb = ctx.inbox.data                          # [n, cap, W]
+        cap = inb.shape[1]
+        is_app = inb[..., T.W_KIND] == T.MsgKind.APP
+        op = jnp.where(is_app, inb[..., T.P0], 0)     # [n, cap]
+        slot = jnp.where(is_app, inb[..., T.P1], 0)
+        val = inb[..., T.P2]
+        aux = inb[..., T.P3]
+        src = inb[..., T.W_SRC]
+        slot = jnp.clip(slot, 0, s - 1)
+        # Dead receivers never process (their inbox is already zeroed, but
+        # keep the guard so state can't move while crashed).
+        op = jnp.where(alive[:, None], op, 0)
+
+        r2 = jnp.broadcast_to(rows[:, None], (n, cap))
+
+        def scatter_max(dest: Array, m: Array, v) -> Array:
+            """dest[n,S] := max over inbox slots where mask m ([n,cap])."""
+            tgt = jnp.where(m, slot, s)
+            return dest.at[r2, tgt].max(
+                jnp.broadcast_to(jnp.asarray(v, dest.dtype), (n, cap)),
+                mode="drop")
+
+        def scatter_val(dest: Array, m: Array, v: Array) -> Array:
+            tgt = jnp.where(m, slot, s)
+            return dest.at[r2, tgt].set(v, mode="drop")
+
+        # ---- participant: process coordinator fan-outs ----------------
+        m_prep = op == OP_PREPARE
+        fresh = st.p_status == P_NONE
+        # record tx on first prepare (coord, value); idempotent re-set is
+        # harmless because sends are edge-triggered (no duplicates).
+        p_coord = scatter_val(st.p_coord, m_prep, src)
+        p_value = scatter_val(st.p_value, m_prep, val)
+        p_status = st.p_status
+        p_status = jnp.where(
+            (scatter_max(jnp.zeros((n, s), jnp.int32), m_prep, 1) > 0)
+            & fresh, P_PREPARED, p_status)
+
+        if self.three_phase:
+            got_pc = scatter_max(jnp.zeros((n, s), jnp.int32),
+                                 op == OP_PRECOMMIT, 1) > 0
+            p_status = jnp.where(got_pc & (p_status == P_PREPARED),
+                                 P_PRECOMMIT, p_status)
+
+        got_commit = scatter_max(jnp.zeros((n, s), jnp.int32),
+                                 op == OP_COMMIT, 1) > 0
+        got_abort = scatter_max(jnp.zeros((n, s), jnp.int32),
+                                op == OP_ABORT, 1) > 0
+        terminal = (p_status == P_COMMIT) | (p_status == P_ABORT)
+        p_status = jnp.where(got_commit & ~terminal, P_COMMIT, p_status)
+        terminal = (p_status == P_COMMIT) | (p_status == P_ABORT)
+        p_status = jnp.where(got_abort & ~terminal, P_ABORT, p_status)
+
+        p_uncertain = st.p_uncertain
+        if self.ctp:
+            # decision messages (cooperative termination answers)
+            m_dec = op == OP_DECISION
+            got_dc = scatter_max(jnp.zeros((n, s), jnp.int32),
+                                 m_dec & (aux == DEC_COMMIT), 1) > 0
+            got_da = scatter_max(jnp.zeros((n, s), jnp.int32),
+                                 m_dec & (aux == DEC_ABORT), 1) > 0
+            und = (p_status != P_COMMIT) & (p_status != P_ABORT)
+            p_status = jnp.where(got_dc & und, P_COMMIT, p_status)
+            und = (p_status != P_COMMIT) & (p_status != P_ABORT)
+            p_status = jnp.where(got_da & und, P_ABORT, p_status)
+            # remember peers that answered uncertain (notified on decision,
+            # bernstein_ctp.erl:199-210)
+            m_unc = m_dec & (aux == DEC_UNCERTAIN)
+            tgt = jnp.where(m_unc, slot, s)
+            p_uncertain = p_uncertain.at[
+                r2, tgt, jnp.clip(src, 0, p - 1)].set(True, mode="drop")
+
+        progressed = p_status != st.p_status
+        p_last = jnp.where(progressed, ctx.rnd, st.p_last)
+
+        # delivery: payload handed to the app on first transition to commit
+        delivered = st.delivered | ((p_status == P_COMMIT) & alive[:, None])
+
+        # ---- coordinator: accumulate acks for the current phase -------
+        ack_phase = jnp.select(
+            [op == OP_PREPARED, op == OP_PRECOMMIT_ACK,
+             op == OP_COMMIT_ACK, op == OP_ABORT_ACK],
+            [C_PREPARING, C_PRECOMMIT, C_COMMITTING, C_ABORTING], 0)
+        phase_here = st.c_phase[r2, slot]             # [n, cap]
+        m_ack = (ack_phase > 0) & (ack_phase == phase_here)
+        tgt = jnp.where(m_ack, slot, s)
+        c_acks = st.c_acks.at[
+            r2, tgt, jnp.clip(src, 0, p - 1)].set(True, mode="drop")
+
+        # ---- coordinator transitions ----------------------------------
+        have_all = jnp.all(~st.c_mask | c_acks, axis=-1)       # [n, S]
+        timed_out = (ctx.rnd - st.c_t0) >= self.c_timeout
+        c_phase, c_outcome = st.c_phase, st.c_outcome
+
+        def to(phase_from, phase_to, cond):
+            # guarded on the ROUND-START phase: have_all reflects acks of
+            # the phase the slot was in when the round began, so chained
+            # transitions can't cascade within one round
+            nonlocal c_phase
+            c_phase = jnp.where(
+                (st.c_phase == phase_from) & (c_phase == phase_from)
+                & cond & alive[:, None], phase_to, c_phase)
+
+        # vote collection complete
+        if self.three_phase:
+            to(C_PREPARING, C_PRECOMMIT, have_all)
+            to(C_PRECOMMIT, C_COMMITTING, have_all)
+        else:
+            to(C_PREPARING, C_COMMITTING, have_all)
+        # ok reply to the caller happens when commit is decided
+        c_outcome = jnp.where(
+            (st.c_phase != C_COMMITTING) & (c_phase == C_COMMITTING)
+            & (c_outcome == 0), 1, c_outcome)
+        # ack-complete commit/abort -> done
+        to(C_COMMITTING, C_DONE, have_all)
+        to(C_ABORTING, C_DONE, have_all)
+        # timeouts while undecided -> abort + error reply (round-start
+        # phase guard: a slot whose final vote landed this round has
+        # already advanced and must not be spuriously aborted)
+        aborting = jnp.zeros((n, s), jnp.bool_)
+        for ph in ((C_PREPARING, C_PRECOMMIT) if self.three_phase
+                   else (C_PREPARING,)):
+            hit = (st.c_phase == ph) & (c_phase == ph) & timed_out \
+                & alive[:, None]
+            aborting |= hit
+            c_phase = jnp.where(hit, C_ABORTING, c_phase)
+        c_outcome = jnp.where(aborting & (c_outcome == 0), 2, c_outcome)
+
+        changed = c_phase != st.c_phase
+        c_t0 = jnp.where(changed, ctx.rnd, st.c_t0)
+        c_acks = jnp.where(changed[..., None], False, c_acks)
+
+        # ---- participant timeouts -------------------------------------
+        waiting = (p_status == P_PREPARED) | (p_status == P_PRECOMMIT)
+        p_expired = waiting & (p_coord >= 0) & \
+            ((ctx.rnd - p_last) >= self.p_timeout) & alive[:, None]
+        dreq_fire = jnp.zeros((n,), jnp.bool_)
+        dreq_slot = jnp.zeros((n,), jnp.int32)
+        if self.three_phase:
+            # non-blocking termination rule (skeen_3pc.erl:178-195)
+            p_status = jnp.where(p_expired & (p_status == P_PREPARED),
+                                 P_ABORT, p_status)
+            p_status = jnp.where(p_expired & (p_status == P_PRECOMMIT),
+                                 P_COMMIT, p_status)
+            delivered = delivered | ((p_status == P_COMMIT) & alive[:, None])
+            p_last = jnp.where(p_expired, ctx.rnd, p_last)
+        elif self.ctp:
+            # ask everyone for the decision; one slot per round bounds
+            # the fan-out (bernstein_ctp.erl:277-300)
+            dreq_fire = p_expired.any(axis=1)
+            dreq_slot = jnp.argmax(p_expired, axis=1).astype(jnp.int32)
+            p_last = jnp.where(
+                p_expired & (jnp.arange(s)[None, :] == dreq_slot[:, None]),
+                ctx.rnd, p_last)
+
+        # ---- emissions ------------------------------------------------
+        blocks = []
+
+        # (1) coordinator fan-out, edge-triggered per phase entry
+        fan_phase = c_phase
+        do_fan = (fan_phase != st.c_sent) & alive[:, None]
+        fan_op = jnp.select([fan_phase == k for k in _FANOUT_OP],
+                            [jnp.int32(v) for v in _FANOUT_OP.values()], 0)
+        do_fan &= fan_op > 0
+        c_sent = jnp.where(do_fan | (fan_phase == C_DONE), fan_phase, st.c_sent)
+        pid = jnp.arange(p, dtype=jnp.int32)
+        fan_dst = jnp.where(do_fan[..., None] & st.c_mask, pid, -1)  # [n,S,P]
+        blocks.append(msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None, None], fan_dst,
+            payload=(fan_op[..., None],
+                     jnp.arange(s, dtype=jnp.int32)[None, :, None],
+                     c_value_b := st.c_value[..., None], jnp.int32(0)),
+        ).reshape(n, s * p, cfg.msg_words))
+
+        # (2) replies to this round's inbox messages
+        rep_op = jnp.select(
+            [op == OP_PREPARE, op == OP_PRECOMMIT, op == OP_COMMIT,
+             op == OP_ABORT],
+            [jnp.int32(OP_PREPARED), jnp.int32(OP_PRECOMMIT_ACK),
+             jnp.int32(OP_COMMIT_ACK), jnp.int32(OP_ABORT_ACK)], 0)
+        rep_aux = jnp.zeros_like(op)
+        if self.ctp:
+            # answer decision requests from local status
+            # (undefined votes count as abort, bernstein_ctp.erl:246-258)
+            stat_here = p_status[r2, slot]
+            dec = jnp.select(
+                [stat_here == P_COMMIT,
+                 (stat_here == P_ABORT) | (stat_here == P_NONE)],
+                [jnp.int32(DEC_COMMIT), jnp.int32(DEC_ABORT)],
+                jnp.int32(DEC_UNCERTAIN))
+            m_req = op == OP_DECISION_REQ
+            rep_op = jnp.where(m_req, OP_DECISION, rep_op)
+            rep_aux = jnp.where(m_req, dec, rep_aux)
+        rep_dst = jnp.where((rep_op > 0) & alive[:, None], src, -1)
+        blocks.append(msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], rep_dst,
+            payload=(rep_op, slot, val, rep_aux)))
+
+        if self.ctp:
+            # (3) decision requests on participant timeout
+            req_dst = jnp.where(dreq_fire[:, None], nbrs, -1)
+            blocks.append(msg_ops.build(
+                cfg.msg_words, T.MsgKind.APP, gids[:, None], req_dst,
+                payload=(jnp.int32(OP_DECISION_REQ), dreq_slot[:, None],
+                         jnp.int32(0), jnp.int32(0))))
+            # (4) notify peers that answered uncertain once decided
+            decided_now = ((p_status == P_COMMIT) | (p_status == P_ABORT)) \
+                & ~((st.p_status == P_COMMIT) | (st.p_status == P_ABORT))
+            note = decided_now[..., None] & p_uncertain & alive[:, None, None]
+            note_dst = jnp.where(note, pid, -1)
+            note_dec = jnp.where(p_status == P_COMMIT, DEC_COMMIT, DEC_ABORT)
+            blocks.append(msg_ops.build(
+                cfg.msg_words, T.MsgKind.APP, gids[:, None, None], note_dst,
+                payload=(jnp.int32(OP_DECISION),
+                         jnp.arange(s, dtype=jnp.int32)[None, :, None],
+                         jnp.int32(0), note_dec[..., None]),
+            ).reshape(n, s * p, cfg.msg_words))
+            p_uncertain = jnp.where(decided_now[..., None], False, p_uncertain)
+
+        emitted = jnp.concatenate(blocks, axis=1)
+        new = CommitState(
+            c_phase=c_phase, c_sent=c_sent, c_mask=st.c_mask, c_acks=c_acks,
+            c_t0=c_t0, c_value=st.c_value, c_outcome=c_outcome,
+            p_status=p_status, p_coord=p_coord, p_value=p_value,
+            p_last=p_last, p_uncertain=p_uncertain, delivered=delivered)
+        return new, emitted
+
+    # ---- scenario helpers --------------------------------------------
+    def begin(self, st: CommitState, coordinator: int, slot: int, value: int,
+              members: Array, rnd) -> CommitState:
+        """Start transaction ``slot`` at ``coordinator`` with participant
+        set ``members`` (bool[n_global]) — the broadcast/3 entry
+        (lampson_2pc.erl:126-163).  Distinct transactions must use
+        distinct slots."""
+        return st._replace(
+            c_phase=st.c_phase.at[coordinator, slot].set(C_PREPARING),
+            c_sent=st.c_sent.at[coordinator, slot].set(C_IDLE),
+            c_mask=st.c_mask.at[coordinator, slot].set(members),
+            c_acks=st.c_acks.at[coordinator, slot].set(False),
+            c_t0=st.c_t0.at[coordinator, slot].set(jnp.int32(rnd)),
+            c_value=st.c_value.at[coordinator, slot].set(value),
+            c_outcome=st.c_outcome.at[coordinator, slot].set(0),
+        )
+
+    # ---- invariants (the filibuster model's postconditions) ----------
+    @staticmethod
+    def agreement(st: CommitState) -> Array:
+        """True iff no transaction slot has both a committed and an
+        aborted participant — the safety property filibuster checks."""
+        committed = (st.p_status == P_COMMIT).any(axis=0)
+        aborted = (st.p_status == P_ABORT).any(axis=0)
+        return ~(committed & aborted).any()
+
+    @staticmethod
+    def committed_implies_all(st: CommitState, slot: int, alive: Array) -> Array:
+        """If the coordinator reported ok, every alive participant
+        eventually delivers (checked after quiescence)."""
+        ok = (st.c_outcome[:, slot] == 1).any()
+        part = st.c_mask[:, slot].any(axis=0) & alive
+        alldel = jnp.all(~part | (st.p_status[:, slot] == P_COMMIT) |
+                         ~alive)
+        return ~ok | alldel

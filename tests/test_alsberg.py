@@ -1,0 +1,96 @@
+"""Alsberg-Day primary/backup replication (protocols/alsberg_day.erl)."""
+
+import jax.numpy as jnp
+import pytest
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.alsberg_day import AlsbergDay
+
+N = 5
+
+
+def build(acked=False):
+    cfg = Config(n_nodes=N, seed=5, inbox_cap=64, emit_cap=16)
+    model = AlsbergDay(acked=acked, keys=4)
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, N):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    return cfg, cl, model, st
+
+
+@pytest.mark.parametrize("acked", [False, True])
+def test_write_replicates_everywhere(acked):
+    cfg, cl, model, st = build(acked)
+    st = st._replace(model=model.write(st.model, client=3, key=1, value=42))
+    st = cl.steps(st, 8)
+    m = st.model
+    assert bool(m.req_ok[3, 1])                       # client got ok
+    assert bool(jnp.all(m.written[:, 1]))             # all replicas wrote
+    assert bool(jnp.all(m.store[:, 1] == 42))
+    assert bool(AlsbergDay.replicated(m, 1, st.faults.alive))
+
+
+def test_write_from_primary_itself():
+    cfg, cl, model, st = build()
+    st = st._replace(model=model.write(st.model, client=0, key=0, value=7))
+    st = cl.steps(st, 8)
+    assert bool(st.model.req_ok[0, 0])
+    assert bool(jnp.all(st.model.store[:, 0] == 7))
+
+
+def test_concurrent_writes_different_keys():
+    cfg, cl, model, st = build()
+    st = st._replace(model=model.write(st.model, 1, 0, 10))
+    st = st._replace(model=model.write(st.model, 2, 2, 20))
+    st = st._replace(model=model.write(st.model, 4, 3, 30))
+    st = cl.steps(st, 10)
+    m = st.model
+    for key, v in [(0, 10), (2, 20), (3, 30)]:
+        assert bool(jnp.all(m.store[:, key] == v)), key
+    assert bool(m.req_ok[1, 0]) and bool(m.req_ok[2, 2]) \
+        and bool(m.req_ok[4, 3])
+
+
+def test_acked_variant_survives_lossy_links():
+    """The acked variant's retries push a write through 40% iid loss
+    (alsberg_day_acked.erl semantics: resend until acknowledged)."""
+    cfg, cl, model, st = build(acked=True)
+    st = st._replace(faults=st.faults._replace(link_drop=jnp.float32(0.4)))
+    st = st._replace(model=model.write(st.model, client=2, key=1, value=9))
+    st, r = cl.run_until(
+        st, lambda s: bool(s.model.req_ok[2, 1]), max_rounds=120,
+        check_every=10)
+    assert r >= 0, "client never acknowledged under loss"
+    st = st._replace(faults=st.faults._replace(link_drop=jnp.float32(0.0)))
+    st = cl.steps(st, 10)
+    assert bool(jnp.all(st.model.store[:, 1] == 9))
+
+
+def test_ok_implies_all_backups_wrote():
+    """The protocol's guarantee: the client ok means every backup applied
+    the write (alsberg_day.erl:229-254 — ok only after ALL collaborate
+    acks)."""
+    cfg, cl, model, st = build()
+    st = st._replace(model=model.write(st.model, client=1, key=2, value=3))
+    for _ in range(10):
+        st = cl.step(st)
+        if bool(st.model.req_ok[1, 2]):
+            assert bool(jnp.all(st.model.written[:, 2]))
+            return
+    raise AssertionError("write never acknowledged")
+
+
+def test_second_write_same_key_does_not_strand_first_client():
+    """A newer write to a busy key subsumes the outstanding one; the
+    displaced client is still acknowledged (no hang)."""
+    cfg, cl, model, st = build()
+    st = st._replace(model=model.write(st.model, client=1, key=0, value=11))
+    st = cl.step(st)   # write 1 in flight
+    st = st._replace(model=model.write(st.model, client=2, key=0, value=22))
+    st = cl.steps(st, 10)
+    m = st.model
+    assert bool(m.req_ok[1, 0]) and bool(m.req_ok[2, 0])
+    assert bool(jnp.all(m.store[:, 0] == 22))   # last write wins

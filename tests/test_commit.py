@@ -1,0 +1,140 @@
+"""Commit-protocol corpus: 2PC / 3PC / CTP atomic broadcast.
+
+Mirrors the reference's protocol tests (protocols/lampson_2pc.erl,
+skeen_3pc.erl, bernstein_ctp.erl driven by prop_partisan system models):
+fault-free commit, omission-driven aborts, agreement under partitions.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models import commit as cp
+
+N = 6
+
+
+def build(variant, **kw):
+    cfg = Config(n_nodes=N, seed=11, inbox_cap=64, emit_cap=16, **kw)
+    model = cp.CommitProtocol(variant, slots=2)
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, N):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    return cfg, cl, model, st
+
+
+def all_members():
+    return jnp.ones((N,), jnp.bool_)
+
+
+@pytest.mark.parametrize("variant", cp.CommitProtocol.VARIANTS)
+def test_fault_free_commit(variant):
+    cfg, cl, model, st = build(variant)
+    st = st._replace(model=model.begin(
+        st.model, coordinator=2, slot=0, value=77, members=all_members(),
+        rnd=st.rnd))
+    st = cl.steps(st, 12)
+    m = st.model
+    # every node delivered the payload with the right value
+    assert bool(jnp.all(m.p_status[:, 0] == cp.P_COMMIT))
+    assert bool(jnp.all(m.delivered[:, 0]))
+    assert bool(jnp.all(m.p_value[:, 0] == 77))
+    # coordinator reported ok to the caller
+    assert int(m.c_outcome[2, 0]) == 1
+    assert bool(model.agreement(m))
+
+
+@pytest.mark.parametrize("variant", cp.CommitProtocol.VARIANTS)
+def test_concurrent_transactions(variant):
+    cfg, cl, model, st = build(variant)
+    ms = all_members()
+    st = st._replace(model=model.begin(st.model, 0, 0, 5, ms, st.rnd))
+    st = st._replace(model=model.begin(st.model, 3, 1, 9, ms, st.rnd))
+    st = cl.steps(st, 14)
+    m = st.model
+    assert bool(jnp.all(m.delivered))
+    assert bool(jnp.all(m.p_value[:, 0] == 5))
+    assert bool(jnp.all(m.p_value[:, 1] == 9))
+    assert bool(model.agreement(m))
+
+
+def test_2pc_partitioned_participant_aborts():
+    """Sever the coordinator from one participant: votes can't complete,
+    the coordinator times out and aborts (lampson_2pc.erl:202-239)."""
+    cfg, cl, model, st = build("lampson_2pc")
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, jnp.array([2]), jnp.array([5])))
+    st = st._replace(model=model.begin(
+        st.model, coordinator=2, slot=0, value=4, members=all_members(),
+        rnd=st.rnd))
+    st = cl.steps(st, 25)
+    m = st.model
+    assert int(m.c_outcome[2, 0]) == 2          # error reported
+    # nobody committed; reachable participants aborted
+    assert not bool((m.p_status[:, 0] == cp.P_COMMIT).any())
+    assert bool((m.p_status[:, 0] == cp.P_ABORT).any())
+    assert bool(model.agreement(m))
+
+
+def test_3pc_participant_timeout_nonblocking():
+    """3PC's termination rule: a participant stuck in precommit commits
+    on timeout; stuck in prepared it aborts (skeen_3pc.erl:173-202).
+    Crash the coordinator right after it authorizes the commit."""
+    cfg, cl, model, st = build("skeen_3pc")
+    st = st._replace(model=model.begin(
+        st.model, coordinator=0, slot=0, value=8, members=all_members(),
+        rnd=st.rnd))
+    # run until participants are in precommit, then crash the coordinator
+    # before it can fan out the final commit
+    def in_precommit(s):
+        pc = s.model.p_status[:, 0]
+        return bool(jnp.sum(pc == cp.P_PRECOMMIT) >= N - 1)
+    st, r = cl.run_until(st, in_precommit, 20)
+    assert r >= 0
+    st = st._replace(faults=faults_mod.crash(st.faults, 0))
+    st = cl.steps(st, 15)
+    m = st.model
+    others = jnp.arange(N) != 0
+    assert bool(jnp.all(jnp.where(others, m.p_status[:, 0] == cp.P_COMMIT,
+                                  True)))
+    assert bool(model.agreement(m))
+
+
+def test_ctp_cooperative_termination():
+    """CTP: participants cut off from the coordinator after the decision
+    learn it from peers via decision_request (bernstein_ctp.erl:170-300)."""
+    cfg, cl, model, st = build("bernstein_ctp")
+    st = st._replace(model=model.begin(
+        st.model, coordinator=0, slot=0, value=3, members=all_members(),
+        rnd=st.rnd))
+    # let the vote phase complete, then partition node 5 from the
+    # coordinator so it misses the commit fan-out
+    def all_prepared(s):
+        return bool(jnp.all(s.model.p_status[:, 0] >= cp.P_PREPARED))
+    st, r = cl.run_until(st, all_prepared, 20)
+    assert r >= 0
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, jnp.array([0]), jnp.array([5])))
+    st = cl.steps(st, 30)
+    m = st.model
+    # node 5 recovered the commit decision from its peers
+    assert int(m.p_status[5, 0]) == cp.P_COMMIT
+    assert bool(m.delivered[5, 0])
+    assert bool(model.agreement(m))
+
+
+def test_agreement_under_random_omissions():
+    """Safety sweep: iid link drops never produce commit/abort disagreement
+    (the filibuster postcondition, prop_partisan_crash_fault_model.erl)."""
+    for seed in range(3):
+        cfg, cl, model, st = build("lampson_2pc")
+        st = st._replace(faults=st.faults._replace(
+            link_drop=jnp.float32(0.3)))
+        st = st._replace(model=model.begin(
+            st.model, coordinator=1, slot=0, value=6, members=all_members(),
+            rnd=st.rnd))
+        st = cl.steps(st, 30)
+        assert bool(model.agreement(st.model)), f"seed {seed}"
