@@ -53,10 +53,22 @@ class ClusterState(NamedTuple):
     model: Any              # model-specific pytree (or () if no model)
     delivery: Any           # delivery.DeliveryState (or () if disabled)
     stats: Stats
+    interpose: Any = ()     # interposition-chain state (or () if none)
+
+
+class TraceRound(NamedTuple):
+    """One round's send-path capture (the trace-orchestrator event record,
+    partisan_trace_orchestrator.erl:80-86): every post-interposition
+    emission, and which of them the fault stage dropped before delivery."""
+
+    rnd: Array      # int32 scalar — the absolute round these sends ran in
+    sent: Array     # int32[n_local, E', W] — emissions entering the wire
+    dropped: Array  # bool[n_local, E'] — cleared by the fault stage
 
 
 def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
-               state: ClusterState) -> ClusterState:
+               state: ClusterState, interpose: Any = None,
+               capture: bool = False):
     """ONE round, generic over the comm substrate — executed directly on a
     single device (LocalComm) or per shard inside shard_map (ShardComm).
     Sharing this body is what guarantees single-device and sharded runs
@@ -83,11 +95,34 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         dstate, emitted, wides = delivery_mod.outbound(
             cfg, comm, dstate, emitted, ctx)
 
+    # Monotonic-channel load shedding: sends on a monotonic channel to a
+    # receiver whose inbox overflowed LAST round are dropped — newer
+    # state supersedes older, so shedding under backpressure is safe
+    # (partisan_peer_socket.erl:108-129 monotonic_should_send; the only
+    # drop path the reference's transport permits).
+    if cfg.monotonic_shed and any(c.monotonic for c in cfg.channels):
+        mono = jnp.asarray([c.monotonic for c in cfg.channels], jnp.bool_)
+        backed = comm.gather_vec(state.inbox.drops > 0)     # [n_global]
+        ch = jnp.clip(emitted[..., 3], 0, cfg.n_channels - 1)  # W_CHANNEL
+        dstv = jnp.clip(emitted[..., 2], 0, cfg.n_nodes - 1)   # W_DST
+        shed = mono[ch] & backed[dstv] & (emitted[..., 0] != 0)
+        emitted = emitted.at[..., 0].set(
+            jnp.where(shed, 0, emitted[..., 0]))
+
+    # Interposition chain (test plane): drop/rewrite/delay transforms on
+    # the send path, before the stochastic fault stage (mirrors the
+    # reference's interposition-before-wire placement, :58-130).
+    istate = state.interpose
+    if interpose is not None:
+        istate, emitted = interpose.apply(cfg, comm, istate, emitted, ctx)
+
     n_emitted = comm.allsum(jnp.sum(emitted[..., 0] != 0, dtype=jnp.int32))
 
-    # Interposition point: fault masks between emit and deliver.
+    # Fault stage: crash/partition/omission masks between emit and deliver.
+    sent = emitted
     emitted = faults_mod.filter_msgs(
         state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
+    fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
 
     inbox = comm.route(emitted)
     # Crash-stopped receivers drop everything addressed to them.
@@ -114,9 +149,13 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         delivered=state.stats.delivered + ev_delivered + causal_delivered,
         dropped=state.stats.dropped + (n_emitted - ev_delivered),
     )
-    return ClusterState(rnd=state.rnd + 1, faults=state.faults,
-                        inbox=inbox, manager=mstate, model=dstate_model,
-                        delivery=dstate, stats=stats)
+    out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
+                       inbox=inbox, manager=mstate, model=dstate_model,
+                       delivery=dstate, stats=stats, interpose=istate)
+    if capture:
+        return out, TraceRound(rnd=state.rnd, sent=sent,
+                               dropped=fault_dropped)
+    return out
 
 
 def run_until(cluster: Any, state: ClusterState, pred, max_rounds: int,
@@ -141,6 +180,7 @@ class Cluster:
     cfg: Config
     manager: Any = None
     model: Any = None
+    interpose: Any = None   # interpose.Interposition (or a Chain), static
 
     def __post_init__(self) -> None:
         if self.manager is None:
@@ -152,29 +192,42 @@ class Cluster:
         )
         self._step = jax.jit(self._round)
         self._steps = jax.jit(self._scan, static_argnums=1)
+        self._record = jax.jit(self._scan_traced, static_argnums=1)
 
     # ---- state construction ------------------------------------------
     def init(self) -> ClusterState:
         cfg, comm = self.cfg, self.comm
         return ClusterState(
             rnd=jnp.int32(0),
-            faults=faults_mod.none(cfg.n_nodes),
+            faults=faults_mod.none(cfg.n_nodes,
+                                   cfg.resolved_partition_mode),
             inbox=exchange.empty_inbox(comm.n_local, cfg.inbox_cap, cfg.msg_words),
             manager=self.manager.init(cfg, comm),
             model=self.model.init(cfg, comm) if self.model is not None else (),
             delivery=(delivery_mod.init(cfg, comm)
                       if delivery_mod.enabled(cfg) else ()),
             stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+            interpose=(self.interpose.init(cfg, comm)
+                       if self.interpose is not None else ()),
         )
 
     # ---- the round ----------------------------------------------------
     def _round(self, state: ClusterState) -> ClusterState:
-        return round_body(self.cfg, self.manager, self.model, self.comm, state)
+        return round_body(self.cfg, self.manager, self.model, self.comm,
+                          state, interpose=self.interpose)
 
     def _scan(self, state: ClusterState, k: int) -> ClusterState:
         return jax.lax.scan(
             lambda s, _: (self._round(s), None), state, None, length=k
         )[0]
+
+    def _round_traced(self, state: ClusterState):
+        return round_body(self.cfg, self.manager, self.model, self.comm,
+                          state, interpose=self.interpose, capture=True)
+
+    def _scan_traced(self, state: ClusterState, k: int):
+        return jax.lax.scan(
+            lambda s, _: self._round_traced(s), state, None, length=k)
 
     # ---- public API ---------------------------------------------------
     def step(self, state: ClusterState) -> ClusterState:
@@ -183,6 +236,13 @@ class Cluster:
     def steps(self, state: ClusterState, k: int) -> ClusterState:
         """Run k rounds as one XLA program (lax.scan)."""
         return self._steps(state, k)
+
+    def record(self, state: ClusterState, k: int):
+        """Run k rounds capturing the send-path trace.  Returns
+        ``(state', TraceRound)`` with trace leaves stacked on a leading
+        round axis — the trace-orchestrator record mode (SURVEY.md §5.1:
+        "trace = the per-round message tensor itself")."""
+        return self._record(state, k)
 
     def run_until(self, state: ClusterState, pred, max_rounds: int,
                   check_every: int = 1) -> tuple[ClusterState, int]:

@@ -1,0 +1,224 @@
+"""Filibuster: counterexample-guided omission-fault model checking.
+
+Mirrors the reference's fault-injection pipeline (test/filibuster_SUITE.erl,
+driven by bin/check-model.sh:17-28 / bin/filibuster.sh:31-33):
+
+1. record a passing execution (the golden trace),
+2. generate schedules of send omissions against the observed messages,
+   bounded by a fault-tolerance budget (``FAULT_TOLERANCE``,
+   prop_partisan_crash_fault_model.erl:33-37),
+3. prune invalid/equivalent schedules: an omission is only meaningful for
+   a message that was actually sent in the parent execution — the dynamic
+   analogue of the reference's causality-annotation pruning
+   (schedule_valid_causality, filibuster_SUITE.erl:1023;
+   classify_schedule :1155-1192),
+4. execute each schedule by preloading it as an interposition
+   (partisan_trace_orchestrator.erl:598-650 → interpose.OmissionSchedule),
+5. on failure, shrink the counterexample by greedily re-executing with
+   omissions removed (the SHRINKING/REPLAY loop,
+   partisan_config.erl:593-607).
+
+Determinism makes each execution a pure function of its schedule, so the
+checker needs no replay machinery beyond re-running (SURVEY.md §5.3:
+"omissions/crashes = boolean masks over the ... message tensors per
+round"; the north star explicitly requires replaying filibuster schedules
+against the simulated manager).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Iterable
+
+from partisan_tpu import interpose, trace as trace_mod
+
+Coord = tuple[int, int, int]          # (absolute round, sender, emit slot)
+
+
+@dataclasses.dataclass
+class Execution:
+    """One executed schedule and its outcome."""
+
+    schedule: frozenset[Coord]
+    trace: trace_mod.Trace
+    passed: bool
+
+
+@dataclasses.dataclass
+class Result:
+    passed: bool                      # no counterexample within budget
+    executions: int                   # schedules actually run
+    pruned: int                       # schedules skipped by pruning
+    counterexample: Execution | None  # minimal failing schedule (shrunk)
+    candidates: int                   # distinct omission candidates seen
+    base_trace: trace_mod.Trace | None = None
+
+    def render(self) -> str:
+        """Human-readable verdict (the counterexample print of
+        bin/counterexample-find.sh; omitted messages are described from
+        the fault-free golden trace since they never hit the wire in the
+        failing one)."""
+        if self.passed:
+            return (f"filibuster: PASSED — {self.executions} executions, "
+                    f"{self.pruned} pruned, {self.candidates} candidates")
+        by_coord = {}
+        if self.base_trace is not None:
+            by_coord = {(e.rnd, e.src, e.slot): e
+                        for e in self.base_trace.events()}
+        lines = [f"filibuster: FAILED — minimal counterexample "
+                 f"({len(self.counterexample.schedule)} omissions, "
+                 f"{self.executions} executions):"]
+        for coord in sorted(self.counterexample.schedule):
+            ev = by_coord.get(coord)
+            if ev is not None:
+                lines.append(f"  omit r={ev.rnd} {ev.src} => {ev.dst} "
+                             f"{ev.kind_name} payload={list(ev.payload)}")
+            else:
+                lines.append(f"  omit (rnd={coord[0]}, src={coord[1]}, "
+                             f"slot={coord[2]})")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class Checker:
+    """``build(interposition) -> (cluster, initial_state)`` constructs the
+    system under test — called ONCE with a zeroed
+    ``interpose.OmissionSchedule``; every schedule execution then swaps
+    the schedule into the (immutable) initial state and re-runs the SAME
+    jitted program, so the search costs one compile total (the reference
+    re-boots its ct fixture per schedule; determinism lets us reuse the
+    booted state).  ``assertion(cluster, final_state) -> bool`` is the
+    system model's postcondition.  ``candidate(TraceEvent) -> bool`` marks
+    messages eligible for omission (the annotation files' message classes,
+    annotations/partisan-annotations-*)."""
+
+    build: Callable[[Any], tuple[Any, Any]]
+    horizon: int
+    assertion: Callable[[Any, Any], bool]
+    candidate: Callable[[trace_mod.TraceEvent], bool]
+    max_faults: int = 1
+    max_executions: int = 200
+    sched_width: int = 64   # >= emission width (OmissionSchedule clips)
+
+    def __post_init__(self) -> None:
+        import numpy as np
+
+        self._np = np
+        # Probe shape-free: build with a 1-round zero schedule to learn n
+        # and the boot round, then rebuild the canonical-size schedule
+        # state directly (same cluster/jit — only state is remade).
+        self._cl, self._st0 = self.build(interpose.OmissionSchedule(
+            np.zeros((1, 1, 1), np.bool_), start=0))
+        n = self._cl.cfg.n_nodes
+        self._total = int(self._st0.rnd) + self.horizon
+        zeros = np.zeros((self._total, n, self.sched_width), np.bool_)
+        self._st0 = self._st0._replace(interpose=self._sched_state(zeros))
+
+    def _sched_state(self, drops):
+        """Build the schedule state through OmissionSchedule.init — the
+        single source of truth for the compiled apply()'s state layout."""
+        return interpose.OmissionSchedule(drops, start=0).init(
+            self._cl.cfg, self._cl.comm)
+
+    # ---- one execution -------------------------------------------------
+    def _execute(self, schedule: frozenset[Coord]) -> Execution:
+        np = self._np
+        n = self._cl.cfg.n_nodes
+        drops = np.zeros((self._total, n, self.sched_width), np.bool_)
+        for (r, s, e) in schedule:
+            if e >= self.sched_width:
+                raise ValueError(f"emit slot {e} >= sched_width "
+                                 f"{self.sched_width}; raise sched_width")
+            drops[r, s, e] = True
+        st = self._st0._replace(interpose=self._sched_state(drops))
+        st, cap = self._cl.record(st, self.horizon)
+        tr = trace_mod.from_capture(cap)
+        return Execution(schedule=schedule, trace=tr,
+                         passed=bool(self.assertion(self._cl, st)))
+
+    def _candidates(self, tr: trace_mod.Trace) -> list[Coord]:
+        return [(e.rnd, e.src, e.slot) for e in tr.events()
+                if not e.dropped and self.candidate(e)]
+
+    # ---- shrinking (counterexample-replay.sh / SHRINKING) --------------
+    def _shrink(self, cex: Execution) -> Execution:
+        current = cex
+        for om in sorted(cex.schedule):
+            if om not in current.schedule or len(current.schedule) == 1:
+                continue
+            trial = self._execute(current.schedule - {om})
+            if not trial.passed:
+                current = trial
+        return current
+
+    # ---- the search ----------------------------------------------------
+    def run(self, *, verbose: bool = False) -> Result:
+        base = self._execute(frozenset())
+        if not base.passed:
+            return Result(passed=False, executions=1, pruned=0,
+                          counterexample=base, candidates=0,
+                          base_trace=base.trace)
+
+        seen: set[frozenset[Coord]] = {frozenset()}
+        all_candidates: set[Coord] = set(self._candidates(base.trace))
+        executions, pruned = 1, 0
+        # Worklist of (schedule, parent-observed candidates): extend each
+        # passing execution's schedule with one more omission drawn from
+        # messages observed IN THAT execution (causality-valid schedules
+        # only — an omission of a never-sent message is equivalent to its
+        # parent, filibuster_SUITE.erl:1155-1192).
+        work: list[tuple[frozenset[Coord], list[Coord]]] = [
+            (frozenset(), self._candidates(base.trace))]
+        while work and executions < self.max_executions:
+            schedule, cands = work.pop(0)
+            if len(schedule) >= self.max_faults:
+                continue
+            for om in cands:
+                nxt = schedule | {om}
+                if nxt in seen:
+                    pruned += 1
+                    continue
+                seen.add(nxt)
+                ex = self._execute(nxt)
+                executions += 1
+                if verbose:
+                    print(f"  schedule {sorted(nxt)} -> "
+                          f"{'pass' if ex.passed else 'FAIL'}")
+                if not ex.passed:
+                    cex = self._shrink(ex)
+                    return Result(passed=False, executions=executions,
+                                  pruned=pruned, counterexample=cex,
+                                  candidates=len(all_candidates),
+                                  base_trace=base.trace)
+                obs = self._candidates(ex.trace)
+                all_candidates.update(obs)
+                # Only extend with omissions at/after the newest one to
+                # avoid permuted duplicates (schedules are sets; ordering
+                # by coordinate canonicalizes the enumeration).
+                newest = max(nxt)
+                later = [c for c in obs if c > newest and c not in nxt]
+                if later and len(nxt) < self.max_faults:
+                    work.append((nxt, later))
+                if executions >= self.max_executions:
+                    break
+        return Result(passed=True, executions=executions, pruned=pruned,
+                      counterexample=None, candidates=len(all_candidates),
+                      base_trace=base.trace)
+
+
+def app_messages(ev: trace_mod.TraceEvent) -> bool:
+    """Default candidate class: application-lane messages (the reference
+    omits protocol messages of the system under test, not membership
+    gossip — annotations/partisan-annotations-* background sets)."""
+    from partisan_tpu import types as T
+    return ev.kind in (T.MsgKind.APP, T.MsgKind.RPC_CALL,
+                       T.MsgKind.RPC_RESPONSE)
+
+
+def iter_schedules(candidates: Iterable[Coord], k: int):
+    """Exhaustive ≤k-subset enumeration (the static schedule generator;
+    the Checker uses the dynamic trace-guided variant instead)."""
+    cands = sorted(set(candidates))
+    for r in range(1, k + 1):
+        yield from (frozenset(c) for c in itertools.combinations(cands, r))

@@ -1,0 +1,244 @@
+"""Interposition layer: drop / rewrite / delay hooks on the send path.
+
+The reference's pluggable manager lets tests register pre-, inter- and
+post-interposition funs that observe, drop, rewrite or ``$delay``-requeue
+every forwarded message (partisan_pluggable_peer_service_manager.erl:195-197,
+fired at :58-130; delay re-queue :1221-1237).  Filibuster preloads omission
+schedules as such funs (partisan_trace_orchestrator.erl:598-650).
+
+TPU-native equivalent: an interposition is a pure transform over the
+emitted-message tensor, compiled into the round step between the *emit*
+phase and the *deliver* phase (SURVEY.md §5.3: "omissions/crashes = boolean
+masks over the ... message tensors per round").  Its dynamic state (e.g.
+the delay buffer, the omission schedule cursor) rides in ``ClusterState``
+so everything works under ``jax.lax.scan`` and on shards.
+
+Ordering within a round (cluster.round_body):
+
+    emit -> [interposition chain] -> stochastic/partition faults -> route
+
+which mirrors the reference's interposition-before-wire placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Protocol, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+
+
+class Interposition(Protocol):
+    """A send-path transform.  Implementations are immutable namespaces
+    (static under jit); mutable state lives in the pytree they init."""
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        ...
+
+    def apply(self, cfg: Config, comm: Any, state: Any, emitted: Array,
+              ctx: RoundCtx) -> tuple[Any, Array]:
+        """Transform emitted int32[n_local, E, W]; returns (state', emitted')."""
+        ...
+
+
+def _drop_where(emitted: Array, mask: Array) -> Array:
+    """Clear kind (mark-empty) where ``mask`` [n, E] is True."""
+    return emitted.at[..., T.W_KIND].set(
+        jnp.where(mask, 0, emitted[..., T.W_KIND]))
+
+
+@dataclasses.dataclass(frozen=True)
+class Drop:
+    """Drop messages matching a static predicate.
+
+    ``pred(cfg, ctx, emitted) -> bool[n, E]`` — the analogue of an
+    interposition fun returning ``undefined`` to drop
+    (partisan_pluggable_peer_service_manager.erl:81-101).
+    """
+
+    pred: Callable[[Config, RoundCtx, Array], Array]
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        return ()
+
+    def specs(self, shard, repl):
+        return ()
+
+    def apply(self, cfg, comm, state, emitted, ctx):
+        return state, _drop_where(emitted, self.pred(cfg, ctx, emitted))
+
+
+@dataclasses.dataclass(frozen=True)
+class Rewrite:
+    """Arbitrary message rewrite: ``fn(cfg, ctx, emitted) -> emitted``
+    (the message-transformation interposition)."""
+
+    fn: Callable[[Config, RoundCtx, Array], Array]
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        return ()
+
+    def specs(self, shard, repl):
+        return ()
+
+    def apply(self, cfg, comm, state, emitted, ctx):
+        return state, self.fn(cfg, ctx, emitted)
+
+
+@dataclasses.dataclass(frozen=True)
+class Observe:
+    """Side-effect-free probe: ``fn(cfg, ctx, emitted) -> aux`` accumulated
+    into the interposition state (pre/post-interposition observer funs used
+    for tracing).  ``combine(state, aux) -> state`` folds it in."""
+
+    fn: Callable[[Config, RoundCtx, Array], Any]
+    combine: Callable[[Any, Any], Any]
+    init_state: Any = 0
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        return jnp.asarray(self.init_state)
+
+    def specs(self, shard, repl):
+        return repl
+
+    def apply(self, cfg, comm, state, emitted, ctx):
+        return self.combine(state, self.fn(cfg, ctx, emitted)), emitted
+
+
+@dataclasses.dataclass(frozen=True)
+class OmissionSchedule:
+    """Scripted per-round, per-slot send omissions — the executor for
+    filibuster schedules and trace replay
+    (partisan_trace_orchestrator.erl:598-650 preloaded omissions).
+
+    ``drops``: host bool[T, n_global, E]; row i applies at absolute round
+    ``start + i``.  Rounds outside [start, start+T) pass everything
+    through (schedules are finite windows).  Slots are identified by the
+    (round, sender, emission-slot) coordinate, which is stable because the
+    round step is deterministic.
+    """
+
+    drops: Any  # np/jnp bool[T, n_global, E]
+    start: int = 0
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        d = jnp.asarray(self.drops, jnp.bool_)
+        # Pad with one all-pass round so reads at rnd >= T are in range.
+        return jnp.concatenate(
+            [d, jnp.zeros((1,) + d.shape[1:], jnp.bool_)], axis=0)
+
+    def specs(self, shard, repl):
+        return repl  # schedule covers all senders; shards slice their rows
+
+    def apply(self, cfg, comm, state, emitted, ctx):
+        t = ctx.rnd - self.start
+        n_pad = state.shape[0] - 1  # the appended all-pass row
+        t = jnp.where((t >= 0) & (t < n_pad), t, n_pad)
+        sched = jax.lax.dynamic_index_in_dim(state, t, keepdims=False)
+        if sched.shape[0] < comm.n_global:  # partial schedules: rest passes
+            sched = jnp.pad(
+                sched, ((0, comm.n_global - sched.shape[0]), (0, 0)))
+        # Slice this shard's sender rows; clip E to the emitted width.
+        local = jax.lax.dynamic_slice(
+            sched, (comm.node_offset, 0),
+            (comm.n_local, sched.shape[1]))
+        e = emitted.shape[1]
+        if local.shape[1] < e:
+            local = jnp.pad(local, ((0, 0), (0, e - local.shape[1])))
+        return state, _drop_where(emitted, local[:, :e])
+
+
+@dataclasses.dataclass(frozen=True)
+class Delay:
+    """``$delay`` interposition: hold matching messages for ``rounds``
+    rounds, then re-inject them on the send path
+    (partisan_pluggable_peer_service_manager.erl:1221-1237 re-queue).
+
+    ``pred(cfg, ctx, emitted) -> bool[n, E]`` selects messages to delay
+    (only on their first pass — re-injected messages are not re-delayed,
+    matching the reference's one-shot re-queue).  ``cap`` bounds held
+    messages per node; overflow passes through undelayed (surfaced in the
+    held counter staying flat).
+    """
+
+    pred: Callable[[Config, RoundCtx, Array], Array]
+    rounds: int = 1
+    cap: int = 8
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        n = comm.n_local
+        return {
+            "buf": jnp.zeros((n, self.cap, cfg.msg_words), jnp.int32),
+            "due": jnp.full((n, self.cap), -1, jnp.int32),  # release round
+        }
+
+    def specs(self, shard, repl):
+        return {"buf": shard, "due": shard}
+
+    def apply(self, cfg, comm, state, emitted, ctx):
+        n, e, w = emitted.shape
+        buf, due = state["buf"], state["due"]
+
+        # 1. Release matured messages (due in (0, rnd]).
+        ripe = (due >= 0) & (due <= ctx.rnd)
+        released = _drop_where(buf, ~ripe)
+        # Mark released as re-injected so a re-applied pred can skip them.
+        released = released.at[..., T.W_FLAGS].set(jnp.where(
+            ripe, released[..., T.W_FLAGS] | T.F_RETRANSMISSION,
+            released[..., T.W_FLAGS]))
+        buf = _drop_where(buf, ripe)
+        due = jnp.where(ripe, -1, due)
+
+        # 2. Capture newly-matching messages into free slots.
+        hold = self.pred(cfg, ctx, emitted) & (emitted[..., T.W_KIND] != 0)
+        free = due < 0                                   # [n, cap]
+        # Rank of each message among this node's holds / each slot among frees.
+        hold_rank = jnp.cumsum(hold, axis=1) - 1         # [n, e]
+        free_rank = jnp.cumsum(free, axis=1) - 1         # [n, cap]
+        n_free = jnp.sum(free, axis=1)                   # [n]
+        can = hold & (hold_rank < n_free[:, None])
+        # Scatter captured messages into the free slots by matching ranks.
+        slot_of_rank = jnp.full((n, self.cap), self.cap, jnp.int32)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, self.cap))
+        slot_of_rank = slot_of_rank.at[
+            rows, jnp.where(free, free_rank, self.cap)
+        ].set(jnp.arange(self.cap, dtype=jnp.int32)[None, :], mode="drop")
+        tgt = jnp.where(can, slot_of_rank[
+            jnp.broadcast_to(jnp.arange(n)[:, None], (n, e)),
+            jnp.minimum(hold_rank, self.cap - 1)], self.cap)
+        erows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
+        buf = buf.at[erows, tgt].set(emitted, mode="drop")
+        due = due.at[erows, tgt].set(ctx.rnd + self.rounds, mode="drop")
+        emitted = _drop_where(emitted, can)
+
+        # 3. Append released messages to this round's emissions.
+        out = jnp.concatenate([emitted, released], axis=1)
+        return {"buf": buf, "due": due}, out
+
+
+@dataclasses.dataclass(frozen=True)
+class Chain:
+    """Pre/inter/post composition: applies each interposition in order
+    (the reference fires pre funs, then interposition funs, then post funs
+    — :58-130)."""
+
+    items: Sequence[Interposition]
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        return tuple(i.init(cfg, comm) for i in self.items)
+
+    def specs(self, shard, repl):
+        return tuple(i.specs(shard, repl) for i in self.items)
+
+    def apply(self, cfg, comm, state, emitted, ctx):
+        out_states = []
+        for item, s in zip(self.items, state):
+            s, emitted = item.apply(cfg, comm, s, emitted, ctx)
+            out_states.append(s)
+        return tuple(out_states), emitted

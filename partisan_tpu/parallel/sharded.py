@@ -117,6 +117,7 @@ class ShardedCluster:
     mesh: Mesh
     manager: Any = None
     model: Any = None
+    interpose: Any = None
 
     def __post_init__(self) -> None:
         if self.manager is None:
@@ -166,6 +167,8 @@ class ShardedCluster:
             model=spec_like(state.model, shard),
             delivery=delivery_specs(state.delivery),
             stats=spec_like(state.stats, repl),
+            interpose=(self.interpose.specs(shard, repl)
+                       if self.interpose is not None else ()),
         )
 
     # ---- state construction ------------------------------------------
@@ -173,13 +176,16 @@ class ShardedCluster:
         cfg = self.cfg
         state = ClusterState(
             rnd=jnp.int32(0),
-            faults=faults_mod.none(cfg.n_nodes),
+            faults=faults_mod.none(cfg.n_nodes,
+                                   cfg.resolved_partition_mode),
             inbox=exchange.empty_inbox(cfg.n_nodes, cfg.inbox_cap, cfg.msg_words),
             manager=self.manager.init(cfg, self.host_comm),
             model=self.model.init(cfg, self.host_comm) if self.model is not None else (),
             delivery=(delivery_mod.init(cfg, self.host_comm)
                       if delivery_mod.enabled(cfg) else ()),
             stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+            interpose=(self.interpose.init(cfg, self.host_comm)
+                       if self.interpose is not None else ()),
         )
         return self.shard_state(state)
 
@@ -196,7 +202,8 @@ class ShardedCluster:
     def _round_shard(self, state: ClusterState) -> ClusterState:
         """Per-shard body under shard_map: the SAME round_body as the
         single-device Cluster, with the shard-aware comm."""
-        return round_body(self.cfg, self.manager, self.model, self.comm, state)
+        return round_body(self.cfg, self.manager, self.model, self.comm,
+                          state, interpose=self.interpose)
 
     def _build(self, state: ClusterState) -> None:
         specs = self._state_specs(state)

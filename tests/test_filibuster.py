@@ -1,0 +1,76 @@
+"""Filibuster model-checker tests (reference test/filibuster_SUITE.erl):
+the checker finds a single-omission counterexample against unacked direct
+mail (no retransmission => reliable broadcast fails), and certifies the
+acked variant against the same fault budget (retransmission repairs every
+single omission)."""
+
+from partisan_tpu import filibuster
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.models.direct_mail import DirectMail
+from tests.support import fm_config, boot_fullmesh
+
+N = 6
+HORIZON = 12
+
+
+def _build_fn(acked):
+    model = DirectMail(acked=acked)
+
+    def build(interp):
+        cfg = fm_config(N, seed=17, ack_cap=8 if acked else 0)
+        cl = Cluster(cfg, model=model, interpose=interp)
+        st = boot_fullmesh(cl)
+        st = st._replace(model=model.broadcast(st.model, 0, 0))
+        return cl, st
+
+    return model, build
+
+
+def _assertion(model):
+    # Reliable broadcast: every (alive) node eventually delivers.
+    def check(cl, st):
+        return float(model.coverage(st.model, st.faults.alive, 0)) == 1.0
+    return check
+
+
+def test_finds_counterexample_for_unacked_direct_mail():
+    model, build = _build_fn(acked=False)
+    checker = filibuster.Checker(
+        build=build, horizon=HORIZON, assertion=_assertion(model),
+        candidate=filibuster.app_messages, max_faults=1)
+    res = checker.run()
+    assert not res.passed
+    assert len(res.counterexample.schedule) == 1  # shrunk to minimal
+    assert "omit" in res.render() and "APP" in res.render()
+
+
+def test_certifies_acked_direct_mail_single_omission():
+    model, build = _build_fn(acked=True)
+    checker = filibuster.Checker(
+        build=build, horizon=HORIZON, assertion=_assertion(model),
+        candidate=filibuster.app_messages, max_faults=1)
+    res = checker.run()
+    assert res.passed, res.render()
+    assert res.executions >= N  # base + one per first-mailing candidate
+    assert "PASSED" in res.render()
+
+
+def test_budget_two_prunes_and_bounds():
+    model, build = _build_fn(acked=False)
+    checker = filibuster.Checker(
+        build=build, horizon=HORIZON, assertion=_assertion(model),
+        candidate=filibuster.app_messages, max_faults=2,
+        max_executions=30)
+    res = checker.run()
+    # Still fails at depth 1 — deeper budget must not hide the minimal cex.
+    assert not res.passed
+    assert len(res.counterexample.schedule) == 1
+
+
+def test_iter_schedules_enumeration():
+    cands = [(0, 1, 0), (0, 2, 0), (1, 1, 1)]
+    scheds = list(filibuster.iter_schedules(cands, 2))
+    assert frozenset({(0, 1, 0)}) in scheds
+    assert frozenset({(0, 1, 0), (1, 1, 1)}) in scheds
+    assert all(len(s) <= 2 for s in scheds)
+    assert len(scheds) == 3 + 3

@@ -1,0 +1,125 @@
+"""Interposition-fun parity tests (drop / rewrite / delay / schedules —
+reference partisan_pluggable_peer_service_manager.erl:195-197, :58-130,
+:1221-1237)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from partisan_tpu import interpose, types as T
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.models.direct_mail import DirectMail
+from tests.support import fm_config, boot_fullmesh
+
+N = 8
+
+
+def _booted(interp=None, acked=False):
+    cfg = fm_config(N, seed=5)
+    model = DirectMail(acked=acked)
+    cl = Cluster(cfg, model=model, interpose=interp)
+    st = boot_fullmesh(cl)
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    return cl, model, st
+
+
+def _coverage(model, st):
+    return float(model.coverage(st.model, st.faults.alive, 0))
+
+
+def test_baseline_direct_mail_covers():
+    cl, model, st = _booted()
+    st = cl.steps(st, 10)
+    assert _coverage(model, st) == 1.0
+
+
+def test_drop_all_app_blocks_delivery():
+    drop_app = interpose.Drop(
+        lambda cfg, ctx, em: em[..., T.W_KIND] == T.MsgKind.APP)
+    cl, model, st = _booted(drop_app)
+    st = cl.steps(st, 10)
+    # Only the broadcaster has the slot: every mail was interposed away.
+    assert _coverage(model, st) == 1.0 / N
+
+
+def test_rewrite_redirects_messages():
+    # Rewrite every APP message's destination to node 1 (the
+    # message-transformation interposition): the broadcast reaches node 1
+    # but nobody else (direct mail has no repair path).
+    def redirect(cfg, ctx, em):
+        is_app = em[..., T.W_KIND] == T.MsgKind.APP
+        return em.at[..., T.W_DST].set(
+            jnp.where(is_app, 1, em[..., T.W_DST]))
+
+    cl, model, st = _booted(interpose.Rewrite(redirect))
+    st = cl.steps(st, 10)
+    assert _coverage(model, st) == 2.0 / N
+    assert bool(st.model.store[1, 0])
+
+
+def test_delay_holds_then_delivers():
+    d = 4
+    delay_app = interpose.Delay(
+        pred=lambda cfg, ctx, em: (em[..., T.W_KIND] == T.MsgKind.APP)
+        & (em[..., T.W_FLAGS] & T.F_RETRANSMISSION == 0),
+        rounds=d, cap=N + 2)
+    cl, model, st = _booted(delay_app)
+    base_round = int(st.rnd)
+    # Two rounds in, nothing has arrived (messages are parked).
+    st2 = cl.steps(st, 2)
+    assert _coverage(model, st2) == 1.0 / N
+    # After the delay matures (+1 round for delivery), everyone has it.
+    st3 = cl.steps(st2, d + 2)
+    assert _coverage(model, st3) == 1.0
+    del base_round
+
+
+def test_observe_counts_app_traffic():
+    probe = interpose.Observe(
+        fn=lambda cfg, ctx, em: jnp.sum(
+            em[..., T.W_KIND] == T.MsgKind.APP, dtype=jnp.int32),
+        combine=lambda s, aux: s + aux,
+        init_state=jnp.int32(0))
+    cl, model, st = _booted(probe)
+    st = cl.steps(st, 10)
+    # One broadcast mailed once to N-1 neighbors.
+    assert int(st.interpose) == N - 1
+
+
+def test_chain_order_pre_then_drop():
+    # Chain = [Observe(pre), Drop]: the observer sees traffic the dropper
+    # then removes (pre-interposition ordering, :58-130).
+    probe = interpose.Observe(
+        fn=lambda cfg, ctx, em: jnp.sum(
+            em[..., T.W_KIND] == T.MsgKind.APP, dtype=jnp.int32),
+        combine=lambda s, aux: s + aux, init_state=jnp.int32(0))
+    drop_app = interpose.Drop(
+        lambda cfg, ctx, em: em[..., T.W_KIND] == T.MsgKind.APP)
+    cl, model, st = _booted(interpose.Chain([probe, drop_app]))
+    st = cl.steps(st, 10)
+    pre_count = int(st.interpose[0])
+    assert pre_count == N - 1
+    assert _coverage(model, st) == 1.0 / N
+
+
+def test_omission_schedule_drops_exact_slots():
+    # Drop everything node 0 emits in rounds 0..29: the broadcast (mailed
+    # at the first post-boot round, ~15) dies on the wire (direct mail
+    # never re-mails).  Membership is unaffected: state-gossip rides the
+    # merge lane, not the event lane.
+    sched = np.zeros((30, N, 64), np.bool_)
+    sched[:, 0, :] = True
+    cl, model, st = _booted(interpose.OmissionSchedule(sched))
+    st = cl.steps(st, 10)
+    assert _coverage(model, st) == 1.0 / N
+
+
+def test_omission_schedule_expires():
+    # Same schedule but the broadcast starts after it expires: unaffected.
+    sched = np.zeros((3, N, 64), np.bool_)
+    sched[:, 0, :] = True
+    cl, model, st = _booted(interpose.OmissionSchedule(sched))
+    # _booted already queued the broadcast at round ~15 (post-boot), which
+    # is beyond the 3-round schedule.
+    assert int(st.rnd) > 3
+    st = cl.steps(st, 10)
+    assert _coverage(model, st) == 1.0
