@@ -1,0 +1,48 @@
+"""Model composition: run several per-node services in one cluster.
+
+The reference runs many processes per node (the app's gen_servers plus
+partisan's backends — rpc, monitor, causality...), all multiplexed over
+the same connections.  The sim analogue: a ``Stack`` of models sharing
+one node axis and one inbox — each model reads the whole inbox (filtering
+by its own message kinds/opcodes, exactly like registered-process
+dispatch) and their emissions are concatenated onto the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+
+
+class Stack:
+    """Composite model; state is a tuple of sub-states."""
+
+    def __init__(self, models: Sequence[Any]) -> None:
+        self.models = tuple(models)
+        self.name = "+".join(getattr(m, "name", type(m).__name__)
+                             for m in self.models)
+
+    def init(self, cfg: Config, comm: LocalComm) -> tuple:
+        return tuple(m.init(cfg, comm) for m in self.models)
+
+    def step(self, cfg: Config, comm: LocalComm, state: tuple,
+             ctx: RoundCtx, nbrs: Array) -> tuple[tuple, Array]:
+        outs, emits = [], []
+        for m, s in zip(self.models, state):
+            s2, e = m.step(cfg, comm, s, ctx, nbrs)
+            outs.append(s2)
+            emits.append(e)
+        return tuple(outs), jnp.concatenate(emits, axis=1)
+
+    # Host-side helpers address sub-models by index.
+    def sub(self, state: tuple, i: int):
+        return state[i]
+
+    def replace_sub(self, state: tuple, i: int, sub_state) -> tuple:
+        return state[:i] + (sub_state,) + state[i + 1:]

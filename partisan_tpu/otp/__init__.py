@@ -1,0 +1,20 @@
+"""OTP-compatibility runtime analogue (reference L5, SURVEY.md §2).
+
+The reference patches OTP's gen/gen_server/gen_statem/... so every
+``erlang:send``/``erlang:monitor`` routes through partisan
+(priv/otp/24/partisan_gen.erl), and layers RPC (partisan_rpc.erl),
+process/node monitoring (partisan_monitor.erl) and node-qualified
+references (partisan_remote_ref.erl) on top.
+
+The sim's "processes" are per-node vectorized state machines (models/);
+this package provides the runtime services around them:
+
+- :mod:`partisan_tpu.otp.rpc`        — request/response calls with refs
+  and timeouts (partisan_rpc + partisan_erpc's call/multicall shapes)
+- :mod:`partisan_tpu.otp.monitor`    — node monitors and nodeup/nodedown
+  subscriptions with DOWN-signal delivery (partisan_monitor)
+- :mod:`partisan_tpu.otp.remote_ref` — encoded node-qualified refs
+  (partisan_remote_ref's three wire formats)
+"""
+
+from partisan_tpu.otp import monitor, remote_ref, rpc  # noqa: F401

@@ -1,0 +1,116 @@
+"""Node/process monitoring (reference src/partisan_monitor.erl).
+
+Reference behavior: ``partisan:monitor/2`` records monitor refs in ETS
+tables (in/out directions, partisan_monitor.erl:40-70, :460-475); the
+manager's ``on_up``/``on_down`` callbacks fire ``{'DOWN', Ref, process,
+Pid, Reason}`` signals to monitor owners and ``{nodedown, Node}`` /
+``{nodeup, Node}`` messages to ``monitor_nodes`` subscribers.  The
+failure detector is the TCP connection itself (README.md:66-70).
+
+Sim mapping: the alive mask IS the ground truth the connection layer
+would reveal; detection is modeled with one round of latency (the EXIT
+signal propagation).  State carries who-monitors-whom matrices and
+sticky signal flags the host consumes; monitors are one-shot (a fired
+monitor is removed, matching erlang:monitor semantics), node
+subscriptions persist and deliver both nodedown and nodeup.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+
+
+class MonitorState(NamedTuple):
+    monitors: Array    # bool[n_local, n_global] — one-shot DOWN monitors
+    node_subs: Array   # bool[n_local] — monitor_nodes subscription
+    prev_alive: Array  # bool[n_global] — last round's liveness view
+    down_sig: Array    # bool[n_local, n_global] — pending DOWN signals
+    nodedown: Array    # bool[n_local, n_global] — pending nodedown msgs
+    nodeup: Array      # bool[n_local, n_global] — pending nodeup msgs
+
+
+class MonitorService:
+    """Stackable model.  Emits no wire messages: liveness transitions are
+    observed from the fault state (the sim's failure detector), exactly
+    one round after they occur."""
+
+    name = "monitor"
+
+    def init(self, cfg: Config, comm: LocalComm) -> MonitorState:
+        n, g = comm.n_local, comm.n_global
+        zb = jnp.zeros((n, g), jnp.bool_)
+        return MonitorState(
+            monitors=zb, node_subs=jnp.zeros((n,), jnp.bool_),
+            prev_alive=jnp.ones((g,), jnp.bool_),
+            down_sig=zb, nodedown=zb, nodeup=zb)
+
+    def step(self, cfg: Config, comm: LocalComm, st: MonitorState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[MonitorState, Array]:
+        galive = ctx.faults.alive
+        went_down = st.prev_alive & ~galive       # [n_global]
+        came_up = ~st.prev_alive & galive
+
+        alive_row = ctx.alive[:, None]
+        fired = st.monitors & went_down[None, :] & alive_row
+        down_sig = st.down_sig | fired
+        monitors = st.monitors & ~fired           # one-shot
+        nodedown = st.nodedown | (
+            st.node_subs[:, None] & went_down[None, :] & alive_row)
+        nodeup = st.nodeup | (
+            st.node_subs[:, None] & came_up[None, :] & alive_row)
+
+        emitted = jnp.zeros((comm.n_local, 0, cfg.msg_words), jnp.int32)
+        return MonitorState(
+            monitors=monitors, node_subs=st.node_subs, prev_alive=galive,
+            down_sig=down_sig, nodedown=nodedown, nodeup=nodeup), emitted
+
+    # ---- host-side API ------------------------------------------------
+    def monitor(self, st: MonitorState, owner: int, target: int
+                ) -> MonitorState:
+        """partisan:monitor/2 — one-shot DOWN monitor on ``target``.  A
+        monitor on an already-known-dead node fires immediately (the
+        reference's noproc DOWN, partisan_monitor.erl)."""
+        if not bool(st.prev_alive[target]):
+            return st._replace(
+                down_sig=st.down_sig.at[owner, target].set(True))
+        return st._replace(monitors=st.monitors.at[owner, target].set(True))
+
+    def demonitor(self, st: MonitorState, owner: int, target: int
+                  ) -> MonitorState:
+        return st._replace(
+            monitors=st.monitors.at[owner, target].set(False),
+            down_sig=st.down_sig.at[owner, target].set(False))
+
+    def monitor_nodes(self, st: MonitorState, node: int,
+                      flag: bool = True) -> MonitorState:
+        """net_kernel:monitor_nodes analogue."""
+        return st._replace(node_subs=st.node_subs.at[node].set(flag))
+
+    @staticmethod
+    def take_down(st: MonitorState, owner: int, target: int
+                  ) -> tuple[MonitorState, bool]:
+        """Consume a pending DOWN signal (receive {'DOWN', ...})."""
+        got = bool(st.down_sig[owner, target])
+        return st._replace(
+            down_sig=st.down_sig.at[owner, target].set(False)), got
+
+    @staticmethod
+    def take_nodedown(st: MonitorState, owner: int, target: int
+                      ) -> tuple[MonitorState, bool]:
+        got = bool(st.nodedown[owner, target])
+        return st._replace(
+            nodedown=st.nodedown.at[owner, target].set(False)), got
+
+    @staticmethod
+    def take_nodeup(st: MonitorState, owner: int, target: int
+                    ) -> tuple[MonitorState, bool]:
+        got = bool(st.nodeup[owner, target])
+        return st._replace(
+            nodeup=st.nodeup.at[owner, target].set(False)), got

@@ -1,0 +1,86 @@
+"""Node-qualified references (reference src/partisan_remote_ref.erl).
+
+The reference encodes pids/refs/registered names with their origin node
+in one of three formats chosen by ``remote_ref_format``: improper list
+(default), tuple, or URI binary (partisan_remote_ref.erl:23-88, format
+type :99).  The sim's processes are (node id, process id) pairs; this
+module provides the same three encodings as host-side values plus the
+packed int32 form used inside message payload words.
+
+Process ids are small ints per node (a model/service index); registered
+names are strings resolved through a static registry.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+FORMAT_IMPROPER = "improper_list"   # the reference's default
+FORMAT_TUPLE = "tuple"
+FORMAT_URI = "uri"
+
+Ref = Union[tuple, str]
+
+# Packed form: one int32 word = node * _PACK_BASE + proc (rides in message
+# payload words; partisan encodes refs into the wire term the same way its
+# remote refs ride inside messages).
+_PACK_BASE = 1 << 12                # up to 4096 processes per node
+_MAX_NODE = (1 << 31) // _PACK_BASE
+
+
+def pack(node: int, proc: int = 0) -> int:
+    """Pack (node, proc) into one non-negative int32 payload word."""
+    if not (0 <= proc < _PACK_BASE):
+        raise ValueError(f"proc {proc} out of range [0, {_PACK_BASE})")
+    if not (0 <= node < _MAX_NODE):
+        raise ValueError(f"node {node} out of range [0, {_MAX_NODE})")
+    return node * _PACK_BASE + proc
+
+
+def unpack(word: int) -> tuple[int, int]:
+    node, proc = divmod(int(word), _PACK_BASE)
+    return node, proc
+
+
+def encode(node: int, proc: int = 0, *, name: str | None = None,
+           fmt: str = FORMAT_IMPROPER) -> Ref:
+    """Encode a process/registered-name reference.
+
+    Mirrors partisan_remote_ref:from_term/1 for the three formats:
+    improper list ``[partisan, node | target]`` becomes a nested tuple
+    here, tuple format is ``(partisan, node, target)``, URI is
+    ``"partisan:pid:<node>:<proc>"`` / ``"partisan:name:<node>:<name>"``.
+    """
+    target = ("name", name) if name is not None else ("pid", proc)
+    if fmt == FORMAT_IMPROPER:
+        return ("partisan", node, target)
+    if fmt == FORMAT_TUPLE:
+        return ("partisan", node, target[0], target[1])
+    if fmt == FORMAT_URI:
+        return f"partisan:{target[0]}:{node}:{target[1]}"
+    raise ValueError(f"unknown remote-ref format {fmt!r}")
+
+
+def decode(ref: Ref) -> dict:
+    """Decode any of the three formats to {node, kind, target}."""
+    if isinstance(ref, str):
+        parts = ref.split(":")
+        if len(parts) != 4 or parts[0] != "partisan":
+            raise ValueError(f"bad uri ref {ref!r}")
+        _, kind, node, target = parts
+        tgt: object = int(target) if kind == "pid" else target
+        return {"node": int(node), "kind": kind, "target": tgt}
+    if len(ref) == 3 and isinstance(ref[2], tuple):
+        kind, tgt = ref[2]
+        return {"node": ref[1], "kind": kind, "target": tgt}
+    if len(ref) == 4:
+        return {"node": ref[1], "kind": ref[2], "target": ref[3]}
+    raise ValueError(f"bad ref {ref!r}")
+
+
+def is_local(ref: Ref, node: int) -> bool:
+    return decode(ref)["node"] == node
+
+
+def node_of(ref: Ref) -> int:
+    return decode(ref)["node"]

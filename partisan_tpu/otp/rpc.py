@@ -1,0 +1,194 @@
+"""RPC service (reference src/partisan_rpc.erl + partisan_rpc_backend.erl
++ the erpc call shapes of src/partisan_erpc.erl).
+
+Reference behavior: ``partisan_rpc:call(Node, M, F, A, Timeout)`` sends
+``{call, M, F, A, Timeout, {origin, Self}}`` to the remote registered
+``partisan_rpc_backend``, which applies the function and forwards
+``{rpc_response, Result}`` back to the caller (partisan_rpc.erl:69-98,
+partisan_rpc_backend.erl:70-86); no reply within Timeout yields
+``{badrpc, timeout}``.
+
+Sim mapping: a per-node call table.  ``call()`` queues a request slot;
+the round step emits RPC_CALL on the rpc channel, the callee applies a
+function from the static registry (``lax.switch`` over fn ids — the MFA
+table analogue) and replies RPC_RESPONSE; the caller matches the ref and
+records the result.  Slots whose deadline passes flip to BADRPC_TIMEOUT
+(late replies are ignored, like the reference's dropped stale responses).
+
+Functions are jax-traceable ``fn(arg: int32 scalar) -> int32 scalar`` —
+the registry is static config, mirroring code that exists on every node.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+# slot status
+IDLE = 0
+QUEUED = 1       # call() recorded, request not yet emitted
+WAITING = 2      # request sent, awaiting response
+OK = 3           # response received
+BADRPC_TIMEOUT = 4   # {badrpc, timeout} (partisan_rpc.erl:90-96)
+
+
+class RpcState(NamedTuple):
+    status: Array     # int32[n, C]
+    dst: Array        # int32[n, C] — callee node
+    fn: Array         # int32[n, C] — registry index
+    arg: Array        # int32[n, C]
+    ref: Array        # int32[n, C] — per-node unique call ref
+    deadline: Array   # int32[n, C] — absolute round of timeout
+    result: Array     # int32[n, C]
+    next_ref: Array   # int32[n] — ref counter
+
+
+class RpcService:
+    """Stackable model implementing the rpc backend on every node."""
+
+    name = "rpc"
+
+    def __init__(self, fns: Sequence[Callable[[Array], Array]],
+                 cap: int = 8) -> None:
+        if not fns:
+            raise ValueError("RpcService needs at least one function")
+        self.fns = tuple(fns)
+        self.cap = cap
+
+    def init(self, cfg: Config, comm: LocalComm) -> RpcState:
+        n, c = comm.n_local, self.cap
+        zi = jnp.zeros((n, c), jnp.int32)
+        return RpcState(status=zi, dst=zi, fn=zi, arg=zi, ref=zi,
+                        deadline=zi, result=zi,
+                        next_ref=jnp.ones((n,), jnp.int32))
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, st: RpcState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[RpcState, Array]:
+        n, c = st.status.shape
+        gids = comm.local_ids()
+        alive = ctx.alive
+        try:
+            rpc_ch = cfg.channel_id("rpc")
+        except KeyError:
+            rpc_ch = 0
+
+        inb = ctx.inbox.data
+        cap = inb.shape[1]
+        rows = jnp.arange(n, dtype=jnp.int32)
+        r2 = jnp.broadcast_to(rows[:, None], (n, cap))
+
+        # ---- callee: apply and reply (partisan_rpc_backend.erl:70-86) --
+        m_call = (inb[..., T.W_KIND] == T.MsgKind.RPC_CALL) & alive[:, None]
+        fn_id = jnp.clip(inb[..., T.P0], 0, len(self.fns) - 1)
+        call_arg = inb[..., T.P1]
+        call_ref = inb[..., T.P2]
+        apply_all = jax.vmap(jax.vmap(
+            lambda i, a: jax.lax.switch(
+                i, [lambda x, _f=f: _f(x) for f in self.fns], a)))
+        res = apply_all(fn_id, call_arg)
+        resp_dst = jnp.where(m_call, inb[..., T.W_SRC], -1)
+        resp = msg_ops.build(
+            cfg.msg_words, T.MsgKind.RPC_RESPONSE, gids[:, None], resp_dst,
+            channel=rpc_ch, payload=(res, call_ref))
+
+        # ---- caller: match responses to waiting slots ------------------
+        m_resp = (inb[..., T.W_KIND] == T.MsgKind.RPC_RESPONSE) \
+            & alive[:, None]
+        # hits[i, slot] — does any inbox response match slot's ref?
+        ref_eq = (inb[..., T.P1][:, :, None] == st.ref[:, None, :]) \
+            & m_resp[:, :, None] & (st.status == WAITING)[:, None, :]
+        got = ref_eq.any(axis=1)                              # [n, C]
+        # first matching response's value per slot
+        val = jnp.max(jnp.where(ref_eq, inb[..., T.P0][:, :, None],
+                                jnp.iinfo(jnp.int32).min), axis=1)
+        status = jnp.where(got, OK, st.status)
+        result = jnp.where(got, val, st.result)
+
+        # ---- timeouts --------------------------------------------------
+        expired = (status == WAITING) & (ctx.rnd >= st.deadline)
+        status = jnp.where(expired, BADRPC_TIMEOUT, status)
+
+        # ---- emit queued requests --------------------------------------
+        fire = (status == QUEUED) & alive[:, None]
+        req = msg_ops.build(
+            cfg.msg_words, T.MsgKind.RPC_CALL, gids[:, None],
+            jnp.where(fire, st.dst, -1), channel=rpc_ch,
+            payload=(st.fn, st.arg, st.ref))
+        status = jnp.where(fire, WAITING, status)
+
+        emitted = jnp.concatenate([resp, req], axis=1)
+        return st._replace(status=status, result=result), emitted
+
+    # ---- host-side API (partisan_rpc:call/5) --------------------------
+    def call(self, st: RpcState, caller: int, dst: int, fn_id: int,
+             arg: int, timeout_rounds: int, now: int
+             ) -> tuple[RpcState, int]:
+        """Queue a call; returns (state', ref).  Raises if the caller's
+        call table is full (the reference would block the caller process;
+        a bounded table surfaces the limit instead)."""
+        import numpy as np
+
+        free = np.flatnonzero(np.asarray(st.status[caller]) == IDLE)
+        if free.size == 0:
+            raise RuntimeError(f"rpc call table full on node {caller}")
+        slot = int(free[0])
+        ref = int(st.next_ref[caller])
+        return st._replace(
+            status=st.status.at[caller, slot].set(QUEUED),
+            dst=st.dst.at[caller, slot].set(dst),
+            fn=st.fn.at[caller, slot].set(fn_id),
+            arg=st.arg.at[caller, slot].set(arg),
+            ref=st.ref.at[caller, slot].set(ref),
+            deadline=st.deadline.at[caller, slot].set(now + timeout_rounds),
+            result=st.result.at[caller, slot].set(0),
+            next_ref=st.next_ref.at[caller].add(1),
+        ), ref
+
+    def multicall(self, st: RpcState, caller: int, dsts: Sequence[int],
+                  fn_id: int, arg: int, timeout_rounds: int, now: int
+                  ) -> tuple[RpcState, list[int]]:
+        """erpc:multicall shape — one call per destination."""
+        refs = []
+        for d in dsts:
+            st, r = self.call(st, caller, d, fn_id, arg, timeout_rounds, now)
+            refs.append(r)
+        return st, refs
+
+    def response(self, st: RpcState, caller: int, ref: int
+                 ) -> tuple[str, int | None]:
+        """('ok', result) | ('badrpc_timeout', None) | ('waiting', None).
+        Consuming frees the slot (receive_response semantics)."""
+        import numpy as np
+
+        refs = np.asarray(st.ref[caller])
+        stats = np.asarray(st.status[caller])
+        hit = np.flatnonzero((refs == ref) & (stats != IDLE))
+        if hit.size == 0:
+            return "waiting", None
+        s = int(stats[hit[0]])
+        if s == OK:
+            return "ok", int(st.result[caller, int(hit[0])])
+        if s == BADRPC_TIMEOUT:
+            return "badrpc_timeout", None
+        return "waiting", None
+
+    def free(self, st: RpcState, caller: int, ref: int) -> RpcState:
+        """Release a completed slot for reuse."""
+        import numpy as np
+
+        refs = np.asarray(st.ref[caller])
+        hit = np.flatnonzero(refs == ref)
+        if hit.size == 0:
+            return st
+        return st._replace(
+            status=st.status.at[caller, int(hit[0])].set(IDLE))

@@ -1,0 +1,166 @@
+"""OTP-runtime analogue tests: RPC (partisan_rpc/partisan_erpc), node
+monitoring (partisan_monitor), remote refs (partisan_remote_ref), and the
+service Stack (the rpc_test / monitor cases of partisan_SUITE.erl)."""
+
+import jax.numpy as jnp
+import pytest
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.direct_mail import DirectMail
+from partisan_tpu.models.stack import Stack
+from partisan_tpu.otp import monitor as mon_mod
+from partisan_tpu.otp import remote_ref, rpc as rpc_mod
+
+N = 6
+
+FNS = (lambda x: x + 1,          # fn 0: increment
+       lambda x: x * 2,          # fn 1: double
+       lambda x: jnp.int32(42))  # fn 2: constant
+
+
+def build(extra=None, **cfg_kw):
+    services = [rpc_mod.RpcService(FNS), mon_mod.MonitorService()]
+    if extra is not None:
+        services.append(extra)
+    stack = Stack(services)
+    cfg = Config(n_nodes=N, seed=13, inbox_cap=48, **cfg_kw)
+    cl = Cluster(cfg, model=stack)
+    st = cl.init()
+    for i in range(1, N):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    st = cl.steps(st, 5)
+    return cl, stack, st
+
+
+def test_rpc_call_roundtrip():
+    cl, stack, st = build()
+    rpc = stack.models[0]
+    rs, ref = rpc.call(stack.sub(st.model, 0), caller=2, dst=4, fn_id=1,
+                       arg=21, timeout_rounds=10, now=int(st.rnd))
+    st = st._replace(model=stack.replace_sub(st.model, 0, rs))
+    st = cl.steps(st, 4)   # emit -> deliver -> reply -> deliver
+    status, val = rpc.response(stack.sub(st.model, 0), 2, ref)
+    assert status == "ok" and val == 42
+    # freeing the slot allows reuse
+    rs = rpc.free(stack.sub(st.model, 0), 2, ref)
+    assert int(rs.status[2].sum()) == 0
+
+
+def test_rpc_self_call_and_multicall():
+    cl, stack, st = build()
+    rpc = stack.models[0]
+    rs, refs = rpc.multicall(stack.sub(st.model, 0), caller=1,
+                             dsts=range(N), fn_id=0, arg=7,
+                             timeout_rounds=10, now=int(st.rnd))
+    st = st._replace(model=stack.replace_sub(st.model, 0, rs))
+    st = cl.steps(st, 4)
+    for ref in refs:
+        status, val = rpc.response(stack.sub(st.model, 0), 1, ref)
+        assert (status, val) == ("ok", 8)
+
+
+def test_rpc_timeout_on_partition():
+    cl, stack, st = build()
+    rpc = stack.models[0]
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, [2], [4]))
+    rs, ref = rpc.call(stack.sub(st.model, 0), caller=2, dst=4, fn_id=0,
+                       arg=1, timeout_rounds=5, now=int(st.rnd))
+    st = st._replace(model=stack.replace_sub(st.model, 0, rs))
+    st = cl.steps(st, 8)
+    status, val = rpc.response(stack.sub(st.model, 0), 2, ref)
+    assert status == "badrpc_timeout" and val is None
+
+
+def test_rpc_table_overflow_raises():
+    cl, stack, st = build()
+    rpc = stack.models[0]
+    rs = stack.sub(st.model, 0)
+    for i in range(rpc.cap):
+        rs, _ = rpc.call(rs, 0, 1, 0, i, 10, int(st.rnd))
+    with pytest.raises(RuntimeError):
+        rpc.call(rs, 0, 1, 0, 99, 10, int(st.rnd))
+
+
+def test_monitor_fires_down_once():
+    cl, stack, st = build()
+    mon = stack.models[1]
+    ms = mon.monitor(stack.sub(st.model, 1), owner=0, target=3)
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms))
+    st = cl.steps(st, 2)
+    ms = stack.sub(st.model, 1)
+    assert not bool(ms.down_sig[0, 3])
+    st = st._replace(faults=faults_mod.crash(st.faults, 3))
+    st = cl.steps(st, 2)
+    ms, got = mon_mod.MonitorService.take_down(stack.sub(st.model, 1), 0, 3)
+    assert got
+    # one-shot: revive + re-crash does not fire again
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms),
+                     faults=faults_mod.recover(st.faults, 3))
+    st = cl.steps(st, 2)
+    st = st._replace(faults=faults_mod.crash(st.faults, 3))
+    st = cl.steps(st, 2)
+    _, got2 = mon_mod.MonitorService.take_down(stack.sub(st.model, 1), 0, 3)
+    assert not got2
+
+
+def test_monitor_on_dead_node_fires_immediately():
+    cl, stack, st = build()
+    mon = stack.models[1]
+    st = st._replace(faults=faults_mod.crash(st.faults, 5))
+    st = cl.steps(st, 2)   # detector observes the crash
+    ms = mon.monitor(stack.sub(st.model, 1), owner=2, target=5)
+    _, got = mon_mod.MonitorService.take_down(ms, 2, 5)
+    assert got
+
+
+def test_monitor_nodes_down_and_up():
+    cl, stack, st = build()
+    mon = stack.models[1]
+    ms = mon.monitor_nodes(stack.sub(st.model, 1), node=0)
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms))
+    st = cl.steps(st, 1)
+    st = st._replace(faults=faults_mod.crash(st.faults, 4))
+    st = cl.steps(st, 2)
+    ms, down = mon_mod.MonitorService.take_nodedown(
+        stack.sub(st.model, 1), 0, 4)
+    assert down
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms),
+                     faults=faults_mod.recover(st.faults, 4))
+    st = cl.steps(st, 2)
+    _, up = mon_mod.MonitorService.take_nodeup(stack.sub(st.model, 1), 0, 4)
+    assert up
+
+
+def test_stack_composes_services_with_app_model():
+    app = DirectMail()
+    cl, stack, st = build(extra=app)
+    st = st._replace(model=stack.replace_sub(
+        st.model, 2, app.broadcast(stack.sub(st.model, 2), 0, 0)))
+    rpc = stack.models[0]
+    rs, ref = rpc.call(stack.sub(st.model, 0), 3, 5, 2, 0, 10, int(st.rnd))
+    st = st._replace(model=stack.replace_sub(st.model, 0, rs))
+    st = cl.steps(st, 10)
+    assert float(app.coverage(stack.sub(st.model, 2),
+                              st.faults.alive, 0)) == 1.0
+    status, val = rpc.response(stack.sub(st.model, 0), 3, ref)
+    assert (status, val) == ("ok", 42)
+
+
+def test_remote_ref_formats():
+    for fmt in (remote_ref.FORMAT_IMPROPER, remote_ref.FORMAT_TUPLE,
+                remote_ref.FORMAT_URI):
+        r = remote_ref.encode(3, 7, fmt=fmt)
+        d = remote_ref.decode(r)
+        assert d == {"node": 3, "kind": "pid", "target": 7}
+        assert remote_ref.node_of(r) == 3
+        assert remote_ref.is_local(r, 3) and not remote_ref.is_local(r, 4)
+    nm = remote_ref.encode(2, name="rpc_backend",
+                           fmt=remote_ref.FORMAT_URI)
+    assert remote_ref.decode(nm)["target"] == "rpc_backend"
+    node, proc = remote_ref.unpack(remote_ref.pack(9, 123))
+    assert (node, proc) == (9, 123)
+    with pytest.raises(ValueError):
+        remote_ref.pack(0, 1 << 13)
