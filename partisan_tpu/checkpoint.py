@@ -1,0 +1,101 @@
+"""Checkpoint / resume of cluster state (SURVEY.md §5.4).
+
+The reference persists its critical state continuously: the membership
+CRDT to ``<data_dir>/default_peer_service/cluster_state`` on every
+mutation (partisan_full_membership_strategy.erl:289-330), the causality
+backend's clock/order-buffer via ``write_state``
+(partisan_causality_backend.erl:218, :243), and test traces via dets
+(partisan_trace_file.erl).
+
+The sim's entire cluster lives in one ``ClusterState`` pytree, so a
+checkpoint is a snapshot of its leaves (the "jax checkpointing of the
+cluster-state tensors" the survey prescribes).  Restore rebuilds the
+pytree against a structural template — typically ``cluster.init()`` —
+which also revalidates that the checkpoint matches the configuration.
+
+Format: one ``.npz`` per checkpoint (leaf arrays + round number), plus
+``latest``-by-round discovery over a directory, supporting the
+crash/restart cycle the reference's re-join path exercises
+(partisan_full_membership_strategy.erl load-from-disk at init).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+_NAME = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+def save(state, path: str | os.PathLike) -> None:
+    """Snapshot a state pytree to ``path`` (.npz)."""
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    np.savez_compressed(path, version=FORMAT_VERSION,
+                        n_leaves=len(leaves), **arrays)
+
+
+def restore(path: str | os.PathLike, like):
+    """Rebuild a checkpoint against the structural template ``like``
+    (same treedef — e.g. ``cluster.init()``).  Shape/dtype mismatches
+    raise, catching config drift between save and restore."""
+    import jax.numpy as jnp
+
+    treedef = jax.tree.structure(like)
+    tmpl = jax.tree.leaves(like)
+    with np.load(path) as z:
+        if int(z["version"]) != FORMAT_VERSION:
+            raise ValueError(f"checkpoint version {int(z['version'])} != "
+                             f"{FORMAT_VERSION}")
+        n = int(z["n_leaves"])
+        if n != len(tmpl):
+            raise ValueError(
+                f"checkpoint has {n} leaves, template has {len(tmpl)} "
+                f"(configuration changed since save?)")
+        leaves = []
+        for i, t in enumerate(tmpl):
+            a = z[f"leaf_{i}"]
+            if a.shape != np.shape(t) or a.dtype != np.asarray(t).dtype:
+                raise ValueError(
+                    f"leaf {i}: checkpoint {a.shape}/{a.dtype} != template "
+                    f"{np.shape(t)}/{np.asarray(t).dtype}")
+            leaves.append(jnp.asarray(a))
+    return jax.tree.unflatten(treedef, leaves)
+
+
+# ---- step-numbered checkpoint directories ------------------------------
+
+def save_step(state, ckpt_dir: str | os.PathLike, rnd: int) -> str:
+    """Save as ``<dir>/ckpt_<round>.npz``; returns the path."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(os.fspath(ckpt_dir), f"ckpt_{int(rnd)}.npz")
+    save(state, path)
+    return path
+
+
+def steps(ckpt_dir: str | os.PathLike) -> list[int]:
+    """Rounds with a checkpoint in ``ckpt_dir``, ascending."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for f in os.listdir(ckpt_dir):
+        m = _NAME.match(f)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def restore_latest(ckpt_dir: str | os.PathLike, like):
+    """Load the newest checkpoint, or None if the directory is empty —
+    the load-or-bootstrap decision of the reference's init
+    (partisan_full_membership_strategy.erl:289-330)."""
+    all_steps = steps(ckpt_dir)
+    if not all_steps:
+        return None
+    return restore(
+        os.path.join(os.fspath(ckpt_dir), f"ckpt_{all_steps[-1]}.npz"),
+        like)

@@ -1,0 +1,120 @@
+"""Peer discovery (reference src/partisan_peer_discovery_agent.erl and
+its dns/list backends).
+
+Reference behavior: a gen_statem polls a configured backend (behaviour:
+``init/1``, ``lookup/2 -> [node_spec()]``,
+partisan_peer_discovery_agent.erl:75-86) on an interval after an initial
+delay, auto-joining any discovered peers; enabled/disabled states gate
+the loop.
+
+Sim mapping: discovery runs host-side between round batches (joins are
+scenario-level operations on the manager state).  A backend yields
+global node ids; the agent tracks which are already joined and issues
+``manager.join`` for newcomers on its polling cadence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol, Sequence
+
+import numpy as np
+
+
+class Backend(Protocol):
+    """The discovery-backend behaviour (lookup/2)."""
+
+    def lookup(self) -> Sequence[int]:
+        """Currently-discoverable node ids."""
+        ...
+
+
+@dataclasses.dataclass
+class ListBackend:
+    """Static member list (src/partisan_peer_discovery_list.erl)."""
+
+    nodes: Sequence[int]
+
+    def lookup(self) -> Sequence[int]:
+        return list(self.nodes)
+
+
+@dataclasses.dataclass
+class DnsBackend:
+    """DNS-style lookup (src/partisan_peer_discovery_dns.erl resolves
+    A/AAAA/SRV records to node specs).  The sim has no network; the
+    resolver is injectable — a callable name -> node ids — with the
+    record-type knob kept for config parity."""
+
+    query: str
+    resolver: dict[str, Sequence[int]]
+    record_type: str = "a"   # a | aaaa | srv (parity knob)
+
+    def lookup(self) -> Sequence[int]:
+        return list(self.resolver.get(self.query, ()))
+
+
+@dataclasses.dataclass
+class Agent:
+    """The polling agent (enabled/disabled gen_statem analogue).
+
+    ``poll(cluster, state)`` is called once per round batch by the
+    scenario loop; it respects the initial delay and polling interval in
+    rounds, joining newly-discovered peers via the contact node."""
+
+    backend: Backend
+    contact: int | str = 0   # fixed node id, or "random": each newcomer
+    #                          joins via a random already-known member —
+    #                          spreads a mass bootstrap across contacts
+    #                          (one fixed contact serializes admission on
+    #                          partial-view overlays)
+    initial_delay_rounds: int = 0
+    polling_interval_rounds: int = 10
+    enabled: bool = True
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        self._joined: set[int] = set()
+        self._last_poll: int | None = None
+        self._rng = np.random.default_rng(self.seed)
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def status(self) -> str:
+        return "enabled" if self.enabled else "disabled"
+
+    def poll(self, cluster, state):
+        """Maybe look up and join; returns (state', joined_now)."""
+        if not self.enabled:
+            return state, []
+        rnd = int(state.rnd)
+        if rnd < self.initial_delay_rounds:
+            return state, []
+        if self._last_poll is not None and \
+                rnd - self._last_poll < self.polling_interval_rounds:
+            return state, []
+        self._last_poll = rnd
+        # Already-members don't rejoin (the agent diffs against the
+        # current membership, partisan_peer_discovery_agent.erl join path)
+        anchor = 0 if self.contact == "random" else self.contact
+        members = np.asarray(cluster.manager.members(
+            cluster.cfg, state.manager))[anchor]
+        joined_now = []
+        known = [anchor] + sorted(self._joined)
+        m = state.manager
+        for node in self.backend.lookup():
+            if node == anchor or members[node] or node in self._joined:
+                continue
+            if self.contact == "random":
+                tgt = int(self._rng.choice(known))
+            else:
+                tgt = int(self.contact)
+            m = cluster.manager.join(cluster.cfg, m, int(node), tgt)
+            self._joined.add(int(node))
+            known.append(int(node))
+            joined_now.append(int(node))
+        return state._replace(manager=m), joined_now

@@ -1,0 +1,105 @@
+"""Test-orchestration backend (reference
+src/partisan_orchestration_backend.erl + the kubernetes/compose
+strategies).
+
+Reference behavior: under k8s/docker-compose test rigs, a backend
+behaviour exposes ``clients/servers/upload_artifact/download_artifact``
+(partisan_orchestration_backend.erl:24-27) with periodic membership
+refresh, cluster-graph construction and artifact timers; strategies
+discover pods via the k8s API (partisan_kubernetes_orchestration_
+strategy.erl:73-90) or compose services.
+
+Sim mapping: orchestration coordinates SCENARIOS — which sim nodes play
+client/server roles, and an artifact store for traces/checkpoints the
+way the reference ships debug artifacts between nodes.  The kubernetes/
+compose strategies' pod-discovery is environment-specific; here a
+strategy is anything that yields role sets (a static one is provided —
+the compose analogue; a k8s strategy would query its API the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Protocol, Sequence
+
+import numpy as np
+
+
+class Strategy(Protocol):
+    def clients(self) -> Sequence[int]:
+        ...
+
+    def servers(self) -> Sequence[int]:
+        ...
+
+
+@dataclasses.dataclass
+class StaticStrategy:
+    """Fixed role assignment (the compose-file analogue,
+    partisan_compose_orchestration_strategy.erl)."""
+
+    client_ids: Sequence[int]
+    server_ids: Sequence[int]
+
+    def clients(self) -> Sequence[int]:
+        return list(self.client_ids)
+
+    def servers(self) -> Sequence[int]:
+        return list(self.server_ids)
+
+
+@dataclasses.dataclass
+class TagStrategy:
+    """Role assignment by the client/server tag convention the reference
+    uses (tagged node specs, partisan_client_server_peer_service_
+    manager.erl:22-43): ids below ``n_servers`` are servers."""
+
+    n_nodes: int
+    n_servers: int
+
+    def clients(self) -> Sequence[int]:
+        return list(range(self.n_servers, self.n_nodes))
+
+    def servers(self) -> Sequence[int]:
+        return list(range(self.n_servers))
+
+
+@dataclasses.dataclass
+class Backend:
+    """clients/servers + artifact store + cluster-graph debug view."""
+
+    strategy: Strategy
+    artifact_dir: str = "/tmp/partisan_tpu_artifacts"
+
+    def clients(self) -> Sequence[int]:
+        return self.strategy.clients()
+
+    def servers(self) -> Sequence[int]:
+        return self.strategy.servers()
+
+    # ---- artifacts (upload_artifact/download_artifact) ---------------
+    def upload_artifact(self, name: str, data: bytes) -> str:
+        os.makedirs(self.artifact_dir, exist_ok=True)
+        path = os.path.join(self.artifact_dir, name)
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    def download_artifact(self, name: str) -> bytes | None:
+        path = os.path.join(self.artifact_dir, name)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    # ---- cluster graph (debug tree construction, orchestration
+    # backend's graph timer) -------------------------------------------
+    @staticmethod
+    def cluster_graph(cluster, state) -> dict[int, list[int]]:
+        """Adjacency (overlay out-edges) as a host dict — the graph the
+        reference builds for its debug endpoints."""
+        nbrs = np.asarray(cluster.manager.neighbors(
+            cluster.cfg, state.manager))
+        return {i: [int(d) for d in row if d >= 0]
+                for i, row in enumerate(nbrs)}

@@ -1,0 +1,98 @@
+"""Telemetry event bus (reference ``telemetry`` dep usage, SURVEY.md §5.1/5.5).
+
+The reference emits ``telemetry:execute`` events with a documented catalog
+(doc_extras/telemetry.md:1-60): ``[partisan, membership, peer,
+join|leave|up|down]`` plus channel-configuration events
+(partisan_config.erl:834-843).  Handlers attach by id and receive
+(event, measurements, metadata).
+
+The sim equivalent is host-side: jitted rounds accumulate counters in
+``Stats`` (cluster.py), and this bus carries discrete events —
+membership transitions derived by diffing states between round batches,
+plus anything scenarios emit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+# Event-name catalog (doc_extras/telemetry.md).
+PEER_JOIN = ("partisan", "membership", "peer", "join")
+PEER_LEAVE = ("partisan", "membership", "peer", "leave")
+PEER_UP = ("partisan", "membership", "peer", "up")
+PEER_DOWN = ("partisan", "membership", "peer", "down")
+CHANNEL_CONFIGURED = ("partisan", "channel", "configured")
+
+Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
+
+
+@dataclasses.dataclass
+class Bus:
+    """telemetry:attach/execute/detach."""
+
+    def __post_init__(self) -> None:
+        self._handlers: dict[str, tuple[tuple, Handler]] = {}
+
+    def attach(self, handler_id: str, event: tuple, fn: Handler) -> None:
+        if handler_id in self._handlers:
+            raise ValueError(f"handler {handler_id!r} already attached")
+        self._handlers[handler_id] = (tuple(event), fn)
+
+    def detach(self, handler_id: str) -> None:
+        self._handlers.pop(handler_id, None)
+
+    def execute(self, event: tuple, measurements: Mapping[str, Any],
+                metadata: Mapping[str, Any] | None = None) -> None:
+        event = tuple(event)
+        for prefix, fn in list(self._handlers.values()):
+            if event[:len(prefix)] == prefix:
+                fn(event, dict(measurements), dict(metadata or {}))
+
+
+@dataclasses.dataclass
+class Recorder:
+    """A handler that keeps every event (test/observability helper)."""
+
+    events: list = dataclasses.field(default_factory=list)
+
+    def __call__(self, event, measurements, metadata) -> None:
+        self.events.append((event, measurements, metadata))
+
+    def of(self, event: tuple) -> list:
+        return [e for e in self.events if e[0] == tuple(event)]
+
+
+def emit_membership_events(bus: Bus, cfg, manager, prev_state, state,
+                           observer: int = 0) -> None:
+    """Diff two cluster states' membership views (from ``observer``'s
+    perspective) and emit peer join/leave events; diff liveness for
+    up/down — the host-side analogue of the reference's event points in
+    the managers (partisan_peer_service_events fan-out +
+    telemetry.md catalog)."""
+    before = np.asarray(manager.members(cfg, prev_state.manager))[observer]
+    after = np.asarray(manager.members(cfg, state.manager))[observer]
+    rnd = int(state.rnd)
+    for node in np.flatnonzero(~before & after):
+        bus.execute(PEER_JOIN, {"count": 1},
+                    {"node": int(node), "round": rnd})
+    for node in np.flatnonzero(before & ~after):
+        bus.execute(PEER_LEAVE, {"count": 1},
+                    {"node": int(node), "round": rnd})
+    palive = np.asarray(prev_state.faults.alive)
+    alive = np.asarray(state.faults.alive)
+    for node in np.flatnonzero(~palive & alive):
+        bus.execute(PEER_UP, {"count": 1}, {"node": int(node), "round": rnd})
+    for node in np.flatnonzero(palive & ~alive):
+        bus.execute(PEER_DOWN, {"count": 1},
+                    {"node": int(node), "round": rnd})
+
+
+def emit_channels_configured(bus: Bus, cfg) -> None:
+    """partisan_config.erl:834-843's channel-configured event."""
+    for ch in cfg.channels:
+        bus.execute(CHANNEL_CONFIGURED,
+                    {"parallelism": ch.parallelism},
+                    {"channel": ch.name, "monotonic": ch.monotonic})

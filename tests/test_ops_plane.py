@@ -1,0 +1,167 @@
+"""Checkpoint/resume (§5.4), telemetry (§5.1/5.5), discovery and
+orchestration (L7 control/ops plane) tests."""
+
+import jax
+import numpy as np
+import pytest
+
+from partisan_tpu import checkpoint, discovery, faults as faults_mod, \
+    orchestration, telemetry
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.anti_entropy import AntiEntropy
+from tests.support import fm_config, boot_fullmesh
+
+N = 8
+
+
+def _booted():
+    cfg = fm_config(N, seed=6)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    return cl, model, st
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_resumes_identically(tmp_path):
+    cl, model, st = _booted()
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    st = cl.steps(st, 3)
+    p = tmp_path / "ck.npz"
+    checkpoint.save(st, p)
+    restored = checkpoint.restore(p, like=cl.init())
+    # Resume both and compare: identical trajectories.
+    a = cl.steps(st, 10)
+    b = cl.steps(restored, 10)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_checkpoint_rejects_config_drift(tmp_path):
+    cl, model, st = _booted()
+    p = tmp_path / "ck.npz"
+    checkpoint.save(st, p)
+    other = Cluster(fm_config(N + 2, seed=6), model=AntiEntropy())
+    with pytest.raises(ValueError):
+        checkpoint.restore(p, like=other.init())
+
+
+def test_checkpoint_latest_discovery(tmp_path):
+    cl, model, st = _booted()
+    d = tmp_path / "ckpts"
+    assert checkpoint.restore_latest(d, like=st) is None
+    checkpoint.save_step(st, d, int(st.rnd))
+    st2 = cl.steps(st, 5)
+    checkpoint.save_step(st2, d, int(st2.rnd))
+    assert checkpoint.steps(d) == [int(st.rnd), int(st2.rnd)]
+    latest = checkpoint.restore_latest(d, like=cl.init())
+    assert int(latest.rnd) == int(st2.rnd)
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def test_telemetry_bus_prefix_matching_and_detach():
+    bus = telemetry.Bus()
+    rec = telemetry.Recorder()
+    bus.attach("h", ("partisan", "membership"), rec)
+    bus.execute(telemetry.PEER_JOIN, {"count": 1}, {"node": 3})
+    bus.execute(("partisan", "channel", "configured"), {"parallelism": 1})
+    assert len(rec.events) == 1
+    bus.detach("h")
+    bus.execute(telemetry.PEER_JOIN, {"count": 1}, {"node": 4})
+    assert len(rec.events) == 1
+    with pytest.raises(ValueError):
+        bus.attach("h2", (), rec)
+        bus.attach("h2", (), rec)
+
+
+def test_membership_and_liveness_events():
+    cl, model, st = _booted()
+    bus = telemetry.Bus()
+    rec = telemetry.Recorder()
+    bus.attach("rec", ("partisan",), rec)
+    prev = st
+    st = st._replace(faults=faults_mod.crash(st.faults, 5))
+    st = cl.steps(st, 2)
+    telemetry.emit_membership_events(bus, cl.cfg, cl.manager, prev, st)
+    downs = rec.of(telemetry.PEER_DOWN)
+    assert len(downs) == 1 and downs[0][2]["node"] == 5
+    prev = st
+    st = st._replace(faults=faults_mod.recover(st.faults, 5))
+    telemetry.emit_membership_events(bus, cl.cfg, cl.manager, prev, st)
+    assert len(rec.of(telemetry.PEER_UP)) == 1
+    telemetry.emit_channels_configured(bus, cl.cfg)
+    assert len(rec.of(telemetry.CHANNEL_CONFIGURED)) == cl.cfg.n_channels
+
+
+# ---------------------------------------------------------------------------
+# discovery
+# ---------------------------------------------------------------------------
+
+def test_discovery_agent_joins_discovered_peers():
+    cfg = fm_config(N, seed=9)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()   # nobody joined yet
+    agent = discovery.Agent(
+        backend=discovery.ListBackend(list(range(N))),
+        polling_interval_rounds=1)
+    st, joined = agent.poll(cl, st)
+    assert set(joined) == set(range(1, N))
+    st = cl.steps(st, 15)
+    members = np.asarray(cl.manager.members(cfg, st.manager))
+    assert members.all(), "discovered peers did not converge"
+    # re-poll: nothing new
+    st, joined2 = agent.poll(cl, st)
+    assert joined2 == []
+
+
+def test_discovery_agent_respects_delay_interval_and_disable():
+    cfg = fm_config(N, seed=9)
+    cl = Cluster(cfg, model=AntiEntropy())
+    st = cl.init()
+    agent = discovery.Agent(
+        backend=discovery.ListBackend([1, 2]),
+        initial_delay_rounds=5, polling_interval_rounds=3)
+    st2, joined = agent.poll(cl, st)
+    assert joined == []          # still in initial delay
+    st = cl.steps(st, 6)
+    agent.disable()
+    _, joined = agent.poll(cl, st)
+    assert joined == [] and agent.status() == "disabled"
+    agent.enable()
+    _, joined = agent.poll(cl, st)
+    assert set(joined) == {1, 2}
+
+
+def test_dns_backend_uses_injected_resolver():
+    b = discovery.DnsBackend(
+        query="cluster.local", resolver={"cluster.local": [1, 2, 3]})
+    assert list(b.lookup()) == [1, 2, 3]
+    assert discovery.DnsBackend("other", {}).lookup() == []
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def test_orchestration_roles_artifacts_and_graph(tmp_path):
+    strat = orchestration.TagStrategy(n_nodes=6, n_servers=2)
+    be = orchestration.Backend(strat, artifact_dir=str(tmp_path / "art"))
+    assert list(be.servers()) == [0, 1]
+    assert list(be.clients()) == [2, 3, 4, 5]
+    p = be.upload_artifact("trace.bin", b"\x01\x02")
+    assert be.download_artifact("trace.bin") == b"\x01\x02"
+    assert be.download_artifact("missing") is None
+    assert p.endswith("trace.bin")
+
+    cl, model, st = _booted()
+    g = orchestration.Backend.cluster_graph(cl, st)
+    assert set(g) == set(range(N))
+    assert all(len(v) > 0 for v in g.values())   # fullmesh converged
