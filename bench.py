@@ -1,59 +1,108 @@
-"""Benchmark: simulated gossip rounds/sec (north-star metric, BASELINE.md).
+"""Benchmark: the north-star scenario (BASELINE.md) — large-scale
+HyParView + Plumtree simulated on one TPU chip.
 
-Runs driver config #1 — full-mesh + full membership strategy +
-demers_anti_entropy — sized up to 256 nodes, and measures how many whole
-cluster rounds per second the jitted simulator steps on one chip.
+Scenario: n-node HyParView overlay (staggered batched bootstrap) with
+Plumtree epidemic broadcast layered on top; validates broadcast
+convergence, then measures steady-state simulated **gossip rounds/sec**.
 
-``vs_baseline``: the reference is a LIVE system whose gossip timers tick
-in wall-clock seconds — one simulated round == ``round_ms`` (1 s) of
-virtual time.  A live Partisan cluster therefore advances 1 round/sec by
-construction; ``vs_baseline`` is the simulation speedup over that
-real-time baseline (rounds-per-sec / 1).
+``vs_baseline``: the reference is a LIVE system whose protocol timers
+tick in wall-clock seconds — one simulated round == ``round_ms`` (1 s)
+of virtual time, so a live cluster advances 1 round/sec by construction
+and ``vs_baseline`` is the simulation speedup over real time.  (The
+reference also cannot reach this scale at all: its HyParView is
+documented "up-to 2,000 nodes",
+partisan_hyparview_peer_service_manager.erl:59.)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import sys
 import time
 
 import jax
-import jax.numpy as jnp
+import numpy as np
+
+# Persistent compile cache: the hyparview round's XLA compile dominates
+# at large n; cache across bench invocations.
+jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+TIME_BUDGET_S = 480.0
 
 
-def main() -> None:
+def run(n: int, verbose: bool = False) -> dict:
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config
-    from partisan_tpu.models.anti_entropy import AntiEntropy
+    from partisan_tpu.models.plumtree import Plumtree
 
-    n = 256
-    cfg = Config(n_nodes=n, seed=1)
-    model = AntiEntropy()
+    cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups")
+    model = Plumtree()
     cl = Cluster(cfg, model=model)
     st = cl.init()
-    for i in range(1, n):
-        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
-    st = st._replace(model=model.broadcast(st.model, 0, 0))
 
-    k = 100
-    st = cl.steps(st, k)               # warmup + compile
+    # Staggered bootstrap: wave w joins via a random already-joined node.
+    rng = np.random.default_rng(7)
+    base = 1
+    while base < n:
+        hi = min(base * 4, n)
+        nodes = np.arange(base, hi, dtype=np.int32)
+        targets = rng.integers(0, base, size=nodes.shape[0]).astype(np.int32)
+        st = st._replace(manager=cl.manager.join_many(
+            cfg, st.manager, nodes, targets))
+        st = cl.steps(st, 3)
+        base = hi
+    st = cl.steps(st, 30)          # settle the overlay
     jax.block_until_ready(st)
-    assert float(model.coverage(st.model, st.faults.alive, 0)) == 1.0, (
-        "anti-entropy broadcast failed to converge during warmup")
 
-    reps = 3
+    # Broadcast convergence (the correctness gate for the numbers).
+    st = st._replace(model=model.broadcast(st.model, 0, 0, int(st.rnd)))
+    st, conv = cl.run_until(
+        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
+        max_rounds=200, check_every=10)
+    if conv < 0:
+        raise AssertionError(f"n={n}: plumtree broadcast did not converge")
+
+    # Steady-state throughput: k rounds as one compiled lax.scan program.
+    k = 60
+    st = cl.steps(st, k)           # warm the k-specialized program
+    jax.block_until_ready(st)
     best = float("inf")
-    for _ in range(reps):
+    for _ in range(3):
         t0 = time.perf_counter()
         st = cl.steps(st, k)
         jax.block_until_ready(st)
         best = min(best, time.perf_counter() - t0)
-
     rps = k / best
+    if verbose:
+        print(f"n={n}: {rps:.1f} rounds/s, broadcast converged by round "
+              f"{conv}", file=sys.stderr)
+    return {"n": n, "rounds_per_sec": rps, "converged_round": conv}
+
+
+def main() -> None:
+    # Size ladder, small -> large: always secure a result, then climb
+    # while the time budget lasts (compile time grows steeply with n).
+    t_start = time.time()
+    result = None
+    for n in (4_096, 8_192, 32_768, 100_000):
+        if result is not None and time.time() - t_start > TIME_BUDGET_S / 2:
+            break
+        try:
+            result = run(n, verbose=True)
+        except Exception as e:  # OOM / compile limits: keep prior size
+            print(f"n={n} failed: {type(e).__name__}: {e}", file=sys.stderr)
+            break
+    if result is None:
+        raise SystemExit("bench failed at every size")
     print(json.dumps({
-        "metric": f"simulated gossip rounds/sec ({n}-node full-mesh + anti-entropy)",
-        "value": round(rps, 1),
+        "metric": (f"simulated gossip rounds/sec "
+                   f"({result['n']}-node hyparview+plumtree)"),
+        "value": round(result["rounds_per_sec"], 2),
         "unit": "rounds/sec",
-        "vs_baseline": round(rps, 1),   # live system: 1 round == 1 s wall
+        # live system: 1 round == 1 s wall clock (round_ms = 1000)
+        "vs_baseline": round(result["rounds_per_sec"], 2),
     }))
 
 

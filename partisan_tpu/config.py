@@ -148,6 +148,20 @@ class Config:
     n_actors: int = 64           # vclock width for causal delivery
     seed: int = 0                # deterministic seeding (partisan_config:seed/0)
 
+    # --- fault-state representation ------------------------------------
+    partition_mode: str = "auto"  # auto | dense | groups — dense bool[n,n]
+    #                               supports arbitrary edge cuts; groups
+    #                               int32[n] is O(n) for 10k+-node runs
+    #                               (groups expresses only full splits
+    #                               and inject_partition raises on
+    #                               anything else — no silent semantics
+    #                               change when auto switches at scale)
+    monotonic_shed: bool = True   # monotonic-channel load shedding in the
+    #                               event lane (partisan_peer_socket.erl
+    #                               :108-129); disable to shave the shed
+    #                               masking off the round's hot path when
+    #                               no model emits on monotonic channels
+
     # --- test plane ----------------------------------------------------
     replaying: bool = False
     shrinking: bool = False
@@ -166,6 +180,10 @@ class Config:
                 raise ValueError(f"channel {c.name}: parallelism must be >= 1")
         if self.msg_words < 8:
             raise ValueError("msg_words must be >= 8 (header is 8 words)")
+        if self.partition_mode not in ("auto", "dense", "groups"):
+            raise ValueError(
+                f"partition_mode {self.partition_mode!r} not in "
+                f"('auto', 'dense', 'groups')")
 
     # --- channel helpers (partisan_config:channels/0, :82-101) ---------
     @property
@@ -180,6 +198,12 @@ class Config:
 
     def channel(self, name: str) -> ChannelSpec:
         return self.channels[self.channel_id(name)]
+
+    @property
+    def resolved_partition_mode(self) -> str:
+        if self.partition_mode == "auto":
+            return "dense" if self.n_nodes <= 2048 else "groups"
+        return self.partition_mode
 
     # --- virtual-time helpers -----------------------------------------
     def rounds(self, interval_ms: int) -> int:

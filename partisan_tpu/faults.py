@@ -37,14 +37,27 @@ class FaultState(NamedTuple):
 
     alive: Array          # bool[n_global] — False = crash-stopped
     link_drop: Array      # float32 scalar — iid per-edge drop probability
-    partition: Array      # bool[n_global, n_global] — True = edge severed
+    partition: Array      # dense mode:  bool[n, n]  — True = edge severed
+    #                       groups mode: int32[n]    — edges cut between
+    #                       differing group ids (a partition in the classic
+    #                       sense).  Dense supports arbitrary (even
+    #                       asymmetric) edge sets but is O(n²) memory —
+    #                       use groups for 10k+-node runs (SURVEY.md §5.7:
+    #                       per-round kernels must be O(edges), not O(n²)).
 
 
-def none(n: int) -> FaultState:
+def none(n: int, partition_mode: str = "dense") -> FaultState:
+    if partition_mode == "dense":
+        part = jnp.zeros((n, n), jnp.bool_)
+    elif partition_mode == "groups":
+        part = jnp.zeros((n,), jnp.int32)
+    else:
+        raise ValueError(f"partition_mode {partition_mode!r} not in "
+                         f"('dense', 'groups')")
     return FaultState(
         alive=jnp.ones((n,), jnp.bool_),
         link_drop=jnp.float32(0.0),
-        partition=jnp.zeros((n, n), jnp.bool_),
+        partition=part,
     )
 
 
@@ -94,7 +107,10 @@ def edge_cut(faults: FaultState, src: Array, dst: Array, seed: int,
     ok_dst = dst >= 0
     d = jnp.where(ok_dst, dst, 0)
     s = jnp.where(src >= 0, src, 0)
-    cut = faults.partition[s, d]
+    if faults.partition.ndim == 2:
+        cut = faults.partition[s, d]
+    else:
+        cut = faults.partition[s] != faults.partition[d]
     cut = cut | ~faults.alive[d] | ~faults.alive[s]
     drop = hash_bernoulli(edge_hash(seed, rnd, salt, s, d), faults.link_drop)
     return ok_dst & (cut | drop)
@@ -161,12 +177,33 @@ def recover(faults: FaultState, node: int) -> FaultState:
 
 
 def inject_partition(faults: FaultState, group_a, group_b) -> FaultState:
-    """Sever all edges between two node groups (inject_partition/2)."""
+    """Sever all edges between two node groups (inject_partition/2).
+
+    Dense mode cuts exactly the a×b edges (group_a keeps internal
+    connectivity to the rest).  Groups mode can only express a FULL
+    split — it requires ``group_a ∪ group_b`` to cover every node and
+    raises otherwise, so a scenario scaled past the dense threshold
+    fails loudly instead of silently cutting different edges; arbitrary
+    edge cuts at scale should script ``link_drop`` or interposition
+    masks, or force ``partition_mode='dense'``."""
+    import numpy as np
+
     p = faults.partition
     a = jnp.asarray(group_a)
     b = jnp.asarray(group_b)
-    p = p.at[a[:, None], b[None, :]].set(True)
-    p = p.at[b[:, None], a[None, :]].set(True)
+    if p.ndim == 2:
+        p = p.at[a[:, None], b[None, :]].set(True)
+        p = p.at[b[:, None], a[None, :]].set(True)
+    else:
+        sa, sb = set(np.asarray(a).tolist()), set(np.asarray(b).tolist())
+        if sa & sb or len(sa) + len(sb) != p.shape[0]:
+            raise ValueError(
+                "groups partition mode expresses only full splits: "
+                f"group_a ({len(sa)}) + group_b ({len(sb)}) must "
+                f"disjointly cover all {p.shape[0]} nodes (use "
+                "partition_mode='dense' or link-level masks for "
+                "arbitrary edge cuts)")
+        p = p.at[b].set(jnp.max(p) + 1)
     return faults._replace(partition=p)
 
 

@@ -140,19 +140,34 @@ class HyParView:
                     return a, p, fj, nomsg, nomsg
 
                 def b_join(a, p, fj):
-                    # First JOIN this round is handled: joiner enters my
-                    # active view and gets fanned out (reference :1234);
-                    # later JOINs re-queue to self for next round.
-                    first = fj < 0
+                    # A JOIN from a node already in my active view is a
+                    # retry whose accept was lost: re-accept WITHOUT
+                    # consuming this round's admission slot (keeps
+                    # duplicate retries from starving fresh joiners).
+                    # Otherwise the first JOIN this round is admitted:
+                    # joiner enters my active view, gets an explicit
+                    # accept (stops its retry loop — the accept stands in
+                    # for the reference's TCP connection establishment,
+                    # which IS its join confirmation) and gets fanned out
+                    # (reference :1234); later fresh JOINs re-queue to
+                    # self for the next round.
+                    dup = views.contains(a, src)
+                    first = (fj < 0) & ~dup
                     a2, ev = views.add(a, jnp.where(first, src, -1), k1)
                     p2 = views.remove(p, src)
                     r0 = jnp.where(
-                        first,
-                        mk(T.MsgKind.HPV_DISCONNECT, ev),
-                        msg.at[T.W_DST].set(me),   # re-queue original JOIN
-                    )
+                        dup,
+                        mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, src),
+                        jnp.where(
+                            first,
+                            mk(T.MsgKind.HPV_DISCONNECT, ev),
+                            msg.at[T.W_DST].set(me),  # re-queue fresh JOIN
+                        ))
+                    r1 = jnp.where(
+                        first, mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, src),
+                        nomsg)
                     return (jnp.where(first, a2, a), jnp.where(first, p2, p),
-                            jnp.where(first, src, fj), r0, nomsg)
+                            jnp.where(first, src, fj), r0, r1)
 
                 def b_forward_join(a, p, fj):
                     j = msg[T.P0]
@@ -307,10 +322,22 @@ class HyParView:
         emitted = emitted.at[..., T.W_KIND].set(
             jnp.where(live[:, None], emitted[..., T.W_KIND], 0))
 
+        # A scripted JOIN retries every round until an explicit accept
+        # (HPV_NEIGHBOR_ACCEPTED) arrives — the walk-end adoption or the
+        # contact's admission both send one.  The reference's JOIN rides
+        # reliable TCP and cannot be lost; in the sim a mass-join can
+        # overflow the contact's bounded inbox (SURVEY.md §7 hard-parts:
+        # overflow accounting), so fire-once JOINs would orphan nodes.
+        # The contact's b_join admits one JOIN per round and re-queues
+        # the rest, so retries drain without view churn.
+        confirmed = jnp.any(
+            ctx.inbox.data[..., T.W_KIND] == T.MsgKind.HPV_NEIGHBOR_ACCEPTED,
+            axis=1)
         new_state = HyParViewState(
             active=new_active,
             passive=new_passive,
-            join_target=jnp.where(ctx.alive, -1, state.join_target),
+            join_target=jnp.where(ctx.alive & confirmed, -1,
+                                  state.join_target),
             leaving=jnp.where(live, False, state.leaving),
             left=(state.left | (state.leaving & live))
                  & ~(state.join_target >= 0),
@@ -343,6 +370,15 @@ class HyParView:
              target: int) -> HyParViewState:
         return state._replace(
             join_target=state.join_target.at[node].set(target))
+
+    def join_many(self, cfg: Config, state: HyParViewState, nodes,
+                  targets) -> HyParViewState:
+        """Batched scripted joins (one scatter — required for 10k+-node
+        bootstrap, where per-node join() dispatch dominates)."""
+        nodes = jnp.asarray(nodes, jnp.int32)
+        targets = jnp.asarray(targets, jnp.int32)
+        return state._replace(
+            join_target=state.join_target.at[nodes].set(targets))
 
     def leave(self, cfg: Config, state: HyParViewState, node: int) -> HyParViewState:
         return state._replace(leaving=state.leaving.at[node].set(True))

@@ -49,3 +49,27 @@ def test_total_link_drop_blocks_everything():
     assert float(model.coverage(st.model, st.faults.alive, 0)) == 1 / 8
     m = cl.manager.members(cfg, st.manager)
     assert int(jnp.sum(m)) == 8 + 7  # self-knowledge + the join targets only
+
+
+def test_groups_partition_mode():
+    """O(n) groups representation: full splits work, partial cuts raise
+    (no silent semantics change when 'auto' switches at scale)."""
+    import pytest
+    from partisan_tpu import faults as faults_mod
+
+    f = faults_mod.none(8, partition_mode="groups")
+    assert f.partition.shape == (8,)
+    f2 = faults_mod.inject_partition(f, [0, 1, 2, 3], [4, 5, 6, 7])
+    import jax.numpy as jnp
+    cut = faults_mod.edge_cut(f2, jnp.int32(0), jnp.int32(4), 0,
+                              jnp.int32(0), 1)
+    same = faults_mod.edge_cut(f2, jnp.int32(4), jnp.int32(5), 0,
+                               jnp.int32(0), 1)
+    assert bool(cut) and not bool(same)
+    healed = faults_mod.resolve_partition(f2)
+    assert not bool(faults_mod.edge_cut(healed, jnp.int32(0), jnp.int32(4),
+                                        0, jnp.int32(0), 1))
+    with pytest.raises(ValueError):
+        faults_mod.inject_partition(f, [0], [4])      # partial cut
+    with pytest.raises(ValueError):
+        faults_mod.inject_partition(f, [0, 4], [4, 1, 2, 3, 5, 6, 7])  # overlap
