@@ -41,6 +41,10 @@ OP_NOT_PRIMARY = 34  # error reply (alsberg_day.erl:223)
 
 PRIMARY = 0          # membership head
 
+# Collaboration messages pack (generation, client) into one aux word:
+# aux = gen * GEN_BASE + client — bounds client ids to GEN_BASE.
+GEN_BASE = 1 << 12
+
 
 class AlsbergDayState(NamedTuple):
     store: Array      # int32[n, K] — replicated registers
@@ -53,6 +57,8 @@ class AlsbergDayState(NamedTuple):
     out_client: Array   # int32[n, K] — requesting client (-1 idle)
     out_acks: Array     # bool[n, K, P] — backup acks collected
     out_mask: Array     # bool[n, K, P] — backups awaited
+    gen: Array          # int32[n, K] — collaboration generation (primary)
+    b_gen: Array        # int32[n, K] — newest generation applied (backup)
 
 
 class AlsbergDay:
@@ -62,6 +68,11 @@ class AlsbergDay:
         self.name = "alsberg_day_acked" if acked else "alsberg_day"
 
     def init(self, cfg: Config, comm: LocalComm) -> AlsbergDayState:
+        if comm.n_global > GEN_BASE:
+            raise ValueError(
+                f"alsberg_day packs client ids into {GEN_BASE} slots "
+                f"(aux = gen*GEN_BASE + client); n_nodes="
+                f"{comm.n_global} exceeds that")
         n, k, p = comm.n_local, self.keys, comm.n_global
         zi = jnp.zeros((n, k), jnp.int32)
         zb = jnp.zeros((n, k), jnp.bool_)
@@ -71,6 +82,7 @@ class AlsbergDay:
             out_client=jnp.full((n, k), -1, jnp.int32),
             out_acks=jnp.zeros((n, k, p), jnp.bool_),
             out_mask=jnp.zeros((n, k, p), jnp.bool_),
+            gen=zi, b_gen=zi,
         )
 
     def step(self, cfg: Config, comm: LocalComm, st: AlsbergDayState,
@@ -98,34 +110,72 @@ class AlsbergDay:
             return dest.at[r2, tgt].set(v, mode="drop")
 
         # ---- apply writes (primary) and collaborations (backups) ------
+        # Collaborations are generation-tagged (aux = gen * GEN_BASE +
+        # client): a backup applies only generations >= its newest (a
+        # retransmitted stale COLLABORATE must not revert a newer value)
+        # and the primary counts only current-generation acks (a
+        # retransmitted stale COLLAB_ACK must not complete a newer
+        # collaboration).  The reference gets this for free by tracking
+        # each write as a separate term; fixed-width payloads need the
+        # explicit tag.
         m_write = (op == OP_WRITE) & is_primary[:, None]
         m_collab = op == OP_COLLABORATE
-        m_apply = m_write | m_collab
+        msg_gen = aux // GEN_BASE
+        collab_fresh = m_collab & (msg_gen >= st.b_gen[r2, jnp.where(
+            m_collab, key, 0)])
+        m_apply = m_write | collab_fresh
         store = scatter(st.store, m_apply, val)
         written = scatter(st.written, m_apply, jnp.ones_like(val, jnp.bool_))
+        b_gen = st.b_gen.at[r2, jnp.where(collab_fresh, key, k)].max(
+            msg_gen, mode="drop")
 
         # primary records the outstanding collaboration; backups awaited =
         # every other GLOBALLY alive member (membership rest,
         # alsberg_day.erl:181-208; ctx.faults.alive is the global mask —
         # ctx.alive is only this shard's slice)
-        client = jnp.where(m_write, src, 0)
-        started = scatter(jnp.zeros((n, k), jnp.int32), m_write,
-                          jnp.ones_like(val)) > 0
-        # a newer write to a busy key subsumes the outstanding one (the
-        # primary serializes; the displaced client's write was applied
-        # before being overwritten, so it is acknowledged immediately —
-        # the reference tracks each write separately instead)
-        displaced = started & (st.out_client >= 0)
-        out_client = scatter(st.out_client, m_write, client)
+        incoming = scatter(jnp.full((n, k), -1, jnp.int32), m_write, src)
+        incoming_val = scatter(jnp.zeros((n, k), jnp.int32), m_write, val)
+        started = incoming >= 0
+        # A re-send of the SAME client's outstanding write of the SAME
+        # value (the ack lane may retransmit the request) is a duplicate:
+        # it must not restart the collaboration nor trigger the
+        # displaced-ack path — acking before the backups replicated would
+        # break the protocol's core guarantee (ok only after ALL
+        # collaborate acks, alsberg_day.erl:229-254).  A same-client NEW
+        # value restarts (and self-displacement sends no early ok: the ok
+        # the client awaits is for its latest write).
+        dup = started & (st.out_client >= 0) \
+            & (incoming == st.out_client) & (incoming_val == st.store)
+        restart = started & ~dup
+        # a DIFFERENT client's write to a busy key subsumes the
+        # outstanding one (the primary serializes; the displaced client's
+        # write was applied before being overwritten, so it is
+        # acknowledged immediately — the reference tracks each write
+        # separately instead)
+        displaced = restart & (st.out_client >= 0) \
+            & (st.out_client != incoming)
+        out_client = jnp.where(restart, incoming, st.out_client)
+        gen = st.gen + restart.astype(jnp.int32)
         pid = jnp.arange(p, dtype=jnp.int32)
         galive = ctx.faults.alive
         backups = galive[None, :] & (pid[None, :] != PRIMARY)   # [1, P]
         new_mask = jnp.broadcast_to(backups[:, None, :], (n, k, p))
-        out_mask = jnp.where(started[..., None], new_mask, st.out_mask)
-        out_acks = jnp.where(started[..., None], False, st.out_acks)
+        out_mask = jnp.where(restart[..., None], new_mask, st.out_mask)
+        out_acks = jnp.where(restart[..., None], False, st.out_acks)
 
-        # collect backup acks
-        m_ack = (op == OP_COLLAB_ACK) & is_primary[:, None]
+        # Same-round write collisions: the per-key scatter keeps one
+        # winner; every losing write was (logically) applied and
+        # immediately overwritten by the serializing primary, so its
+        # client gets an immediate ok echoing ITS value (the reference
+        # tracks each write separately and acks each; fire-once clients
+        # would otherwise be orphaned).
+        winner = (incoming[r2, key] == src) \
+            & (incoming_val[r2, key] == val)
+        lost = m_write & ~winner
+
+        # collect backup acks for the CURRENT generation only
+        m_ack = (op == OP_COLLAB_ACK) & is_primary[:, None] \
+            & (msg_gen == gen[r2, jnp.where(op == OP_COLLAB_ACK, key, 0)])
         tgt = jnp.where(m_ack, key, k)
         out_acks = out_acks.at[r2, tgt, jnp.clip(src, 0, p - 1)].set(
             True, mode="drop")
@@ -136,14 +186,19 @@ class AlsbergDay:
         ok_dst = jnp.where(complete, out_client, -1)
         out_client = jnp.where(complete, -1, out_client)
 
-        # client: mark ok
-        m_ok = op == OP_WRITE_OK
+        # client: mark ok — only if the ok's value matches the write this
+        # client is currently awaiting (a stale ok from a superseded
+        # earlier write must not satisfy a newer one)
+        m_ok = (op == OP_WRITE_OK) & (val == st.req_value[r2, key])
         req_ok = scatter(st.req_ok, m_ok, jnp.ones_like(val, jnp.bool_))
 
         # ---- emissions ------------------------------------------------
         blocks = []
-        # (1) client write requests: send every pending key to the primary
-        # (re-sent each round until ok in the acked variant; once otherwise)
+        # (1) client write requests, sent once: the acked variant's
+        # resilience comes from the ack lane's hop retransmission
+        # (F_ACK_REQUIRED — the reference sends with {ack, true} and the
+        # acknowledgement backend retries, alsberg_day_acked.erl), not
+        # from client-level re-fires
         fire = st.req_pending & alive[:, None]
         kid = jnp.arange(k, dtype=jnp.int32)
         blocks.append(msg_ops.build(
@@ -151,12 +206,13 @@ class AlsbergDay:
             jnp.where(fire, PRIMARY, -1), flags=flags,
             payload=(jnp.int32(OP_WRITE), kid[None, :], st.req_value,
                      jnp.int32(0))))
-        req_pending = st.req_pending & ~fire if not self.acked else \
-            st.req_pending & ~req_ok
+        req_pending = st.req_pending & ~fire
 
-        # (2) primary collaborate fan-out for writes applied this round
-        aux_client = scatter(jnp.zeros((n, k), jnp.int32), m_write, client)
-        col_dst = jnp.where(started[..., None] & new_mask, pid, -1)  # [n,K,P]
+        # (2) primary collaborate fan-out for collaborations (re)started
+        # this round (duplicates don't re-collaborate; the acked lane's
+        # retransmission covers lost collaborates)
+        aux_client = jnp.where(restart, gen * GEN_BASE + incoming, 0)
+        col_dst = jnp.where(restart[..., None] & new_mask, pid, -1)  # [n,K,P]
         blocks.append(msg_ops.build(
             cfg.msg_words, T.MsgKind.APP, gids[:, None, None], col_dst,
             flags=flags,
@@ -164,12 +220,14 @@ class AlsbergDay:
                      store[..., None], aux_client[..., None]),
         ).reshape(n, k * p, cfg.msg_words))
 
-        # (3) replies per inbox message: backup collaborate acks, plus
+        # (3) replies per inbox message: backup collaborate acks (fresh
+        # generations only — a stale collaborate earns no ack), plus
         # not_primary errors for writes reaching a non-primary (:223)
         misrouted = (op == OP_WRITE) & ~is_primary[:, None]
-        rep_op = jnp.select([m_collab, misrouted],
+        rep_op = jnp.select([collab_fresh, misrouted, lost],
                             [jnp.int32(OP_COLLAB_ACK),
-                             jnp.int32(OP_NOT_PRIMARY)], 0)
+                             jnp.int32(OP_NOT_PRIMARY),
+                             jnp.int32(OP_WRITE_OK)], 0)
         rep_dst = jnp.where((rep_op > 0) & alive[:, None], src, -1)
         blocks.append(msg_ops.build(
             cfg.msg_words, T.MsgKind.APP, gids[:, None], rep_dst,
@@ -181,18 +239,21 @@ class AlsbergDay:
             flags=flags,
             payload=(jnp.int32(OP_WRITE_OK), kid[None, :], store,
                      jnp.int32(0))))
+        # displaced ok reports the DISPLACED write's value (round-start
+        # store), not the displacing one's
         disp_dst = jnp.where(displaced & alive[:, None], st.out_client, -1)
         blocks.append(msg_ops.build(
             cfg.msg_words, T.MsgKind.APP, gids[:, None], disp_dst,
             flags=flags,
-            payload=(jnp.int32(OP_WRITE_OK), kid[None, :], store,
+            payload=(jnp.int32(OP_WRITE_OK), kid[None, :], st.store,
                      jnp.int32(0))))
 
         emitted = jnp.concatenate(blocks, axis=1)
         new = AlsbergDayState(
             store=store, written=written,
             req_pending=req_pending, req_value=st.req_value, req_ok=req_ok,
-            out_client=out_client, out_acks=out_acks, out_mask=out_mask)
+            out_client=out_client, out_acks=out_acks, out_mask=out_mask,
+            gen=gen, b_gen=b_gen)
         return new, emitted
 
     # ---- scenario helpers --------------------------------------------

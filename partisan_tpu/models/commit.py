@@ -209,8 +209,12 @@ class CommitProtocol:
 
         p_uncertain = st.p_uncertain
         if self.ctp:
-            # decision messages (cooperative termination answers)
-            m_dec = op == OP_DECISION
+            # decision messages (cooperative termination answers); P2
+            # carries the tx coordinator — only same-tx participants adopt
+            # the decision (answers/notifies also reach overlay nodes
+            # outside the transaction, which must ignore them)
+            m_dec = (op == OP_DECISION) & (p_coord[r2, slot] >= 0) & \
+                (p_coord[r2, slot] == val)
             got_dc = scatter_max(jnp.zeros((n, s), jnp.int32),
                                  m_dec & (aux == DEC_COMMIT), 1) > 0
             got_da = scatter_max(jnp.zeros((n, s), jnp.int32),
@@ -325,26 +329,52 @@ class CommitProtocol:
             cfg.msg_words, T.MsgKind.APP, gids[:, None, None], fan_dst,
             payload=(fan_op[..., None],
                      jnp.arange(s, dtype=jnp.int32)[None, :, None],
-                     c_value_b := st.c_value[..., None], jnp.int32(0)),
+                     st.c_value[..., None], jnp.int32(0)),
         ).reshape(n, s * p, cfg.msg_words))
 
-        # (2) replies to this round's inbox messages
+        # (2) replies to this round's inbox messages — gated on the
+        # participant's POST-PROCESSING status: a participant that aborted
+        # (e.g. on timeout) must not ack prepare/precommit/commit, or the
+        # coordinator would count a full ack set and decide commit while
+        # this participant aborted (it stays silent; the coordinator's
+        # timeout handles it, lampson_2pc.erl vote semantics)
+        stat_now = p_status[r2, slot]
         rep_op = jnp.select(
-            [op == OP_PREPARE, op == OP_PRECOMMIT, op == OP_COMMIT,
-             op == OP_ABORT],
+            [(op == OP_PREPARE) & (stat_now >= P_PREPARED)
+             & (stat_now != P_ABORT),
+             (op == OP_PRECOMMIT) & ((stat_now == P_PRECOMMIT)
+                                     | (stat_now == P_COMMIT)),
+             (op == OP_COMMIT) & (stat_now == P_COMMIT),
+             (op == OP_ABORT) & (stat_now == P_ABORT)],
             [jnp.int32(OP_PREPARED), jnp.int32(OP_PRECOMMIT_ACK),
              jnp.int32(OP_COMMIT_ACK), jnp.int32(OP_ABORT_ACK)], 0)
         rep_aux = jnp.zeros_like(op)
         if self.ctp:
-            # answer decision requests from local status
-            # (undefined votes count as abort, bernstein_ctp.erl:246-258)
+            # Answer decision requests (bernstein_ctp.erl:246-258).  The
+            # request rides the overlay, so it can reach nodes outside the
+            # transaction; only a participant of the SAME tx (matching
+            # (coordinator, slot) — the request carries the coordinator id
+            # in P2) or the tx coordinator itself may answer with a
+            # decision, everyone else answers uncertain.  The reference's
+            # "undefined vote counts as abort" shortcut needs the request
+            # to be addressed to participants only; an unprepared
+            # participant here answers uncertain instead (it blocks rather
+            # than spuriously aborts — safety over liveness).
+            m_req = op == OP_DECISION_REQ
+            req_coord = val                    # P2 of the request
             stat_here = p_status[r2, slot]
+            same_tx = (p_coord[r2, slot] >= 0) & \
+                (p_coord[r2, slot] == req_coord)
+            self_coord = gids[:, None] == req_coord
+            oc_here = st.c_outcome[r2, slot]
+            know_commit = (same_tx & (stat_here == P_COMMIT)) | \
+                (self_coord & (oc_here == 1))
+            know_abort = (same_tx & (stat_here == P_ABORT)) | \
+                (self_coord & (oc_here == 2))
             dec = jnp.select(
-                [stat_here == P_COMMIT,
-                 (stat_here == P_ABORT) | (stat_here == P_NONE)],
+                [know_commit, know_abort],
                 [jnp.int32(DEC_COMMIT), jnp.int32(DEC_ABORT)],
                 jnp.int32(DEC_UNCERTAIN))
-            m_req = op == OP_DECISION_REQ
             rep_op = jnp.where(m_req, OP_DECISION, rep_op)
             rep_aux = jnp.where(m_req, dec, rep_aux)
         rep_dst = jnp.where((rep_op > 0) & alive[:, None], src, -1)
@@ -353,12 +383,14 @@ class CommitProtocol:
             payload=(rep_op, slot, val, rep_aux)))
 
         if self.ctp:
-            # (3) decision requests on participant timeout
+            # (3) decision requests on participant timeout; P2 carries the
+            # tx coordinator id so answerers can match the transaction
             req_dst = jnp.where(dreq_fire[:, None], nbrs, -1)
+            dreq_coord = p_coord[rows, dreq_slot]          # [n]
             blocks.append(msg_ops.build(
                 cfg.msg_words, T.MsgKind.APP, gids[:, None], req_dst,
                 payload=(jnp.int32(OP_DECISION_REQ), dreq_slot[:, None],
-                         jnp.int32(0), jnp.int32(0))))
+                         dreq_coord[:, None], jnp.int32(0))))
             # (4) notify peers that answered uncertain once decided
             decided_now = ((p_status == P_COMMIT) | (p_status == P_ABORT)) \
                 & ~((st.p_status == P_COMMIT) | (st.p_status == P_ABORT))
@@ -369,7 +401,7 @@ class CommitProtocol:
                 cfg.msg_words, T.MsgKind.APP, gids[:, None, None], note_dst,
                 payload=(jnp.int32(OP_DECISION),
                          jnp.arange(s, dtype=jnp.int32)[None, :, None],
-                         jnp.int32(0), note_dec[..., None]),
+                         p_coord[..., None], note_dec[..., None]),
             ).reshape(n, s * p, cfg.msg_words))
             p_uncertain = jnp.where(decided_now[..., None], False, p_uncertain)
 

@@ -12,7 +12,10 @@ N = 5
 
 
 def build(acked=False):
-    cfg = Config(n_nodes=N, seed=5, inbox_cap=64, emit_cap=16)
+    # The acked variant needs the ack lane (reference: the acknowledgement
+    # backend retransmits {ack, true} sends until acked).
+    cfg = Config(n_nodes=N, seed=5, inbox_cap=64, emit_cap=16,
+                 ack_cap=32 if acked else 0)
     model = AlsbergDay(acked=acked, keys=4)
     cl = Cluster(cfg, model=model)
     st = cl.init()
@@ -67,6 +70,59 @@ def test_acked_variant_survives_lossy_links():
     st = st._replace(faults=st.faults._replace(link_drop=jnp.float32(0.0)))
     st = cl.steps(st, 10)
     assert bool(jnp.all(st.model.store[:, 1] == 9))
+
+
+def test_no_premature_ack_while_backup_unreachable():
+    """Regression: with the primary partitioned from a backup, the client
+    must NOT be acked (ok only after ALL collaborate acks,
+    alsberg_day.erl:229-254) — client re-sends/retransmissions must not
+    trigger the displaced-write ack path."""
+    cfg, cl, model, st = build(acked=True)
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, [0], [4]))
+    st = st._replace(model=model.write(st.model, client=2, key=1, value=9))
+    st = cl.steps(st, 20)
+    assert not bool(st.model.req_ok[2, 1]), \
+        "client acked while backup 4 never replicated"
+    assert not bool(st.model.written[4, 1])
+    # Heal: the collaboration completes and the ack arrives.
+    st = st._replace(faults=faults_mod.resolve_partition(st.faults))
+    st = cl.steps(st, 15)
+    assert bool(st.model.req_ok[2, 1])
+    assert bool(jnp.all(st.model.store[:, 1] == 9))
+
+
+def test_same_round_write_collision_acks_both_clients():
+    """Regression: two clients writing the same key in the same round —
+    the scatter keeps one winner; the loser's write was logically applied
+    then overwritten, so BOTH clients must be acked (the reference tracks
+    and acks each write separately)."""
+    cfg, cl, model, st = build(acked=True)
+    st = st._replace(model=model.write(st.model, client=1, key=2, value=5))
+    st = st._replace(model=model.write(st.model, client=3, key=2, value=9))
+    st = cl.steps(st, 12)
+    m = st.model
+    assert bool(m.req_ok[1, 2]) and bool(m.req_ok[3, 2]), \
+        "write-collision loser never acknowledged"
+    assert bool(AlsbergDay.replicated(m, 2, st.faults.alive))
+    assert int(m.store[0, 2]) in (5, 9)
+
+
+def test_same_client_overwrite_replicates_latest():
+    """Regression: a client re-writing a key with a NEW value before the
+    first ok must restart the collaboration — the new value must reach
+    every backup (not just the primary's store), and the stale first-write
+    ok must not satisfy the second write."""
+    cfg, cl, model, st = build(acked=True)
+    st = st._replace(model=model.write(st.model, client=2, key=1, value=7))
+    st = cl.step(st)       # request in flight
+    st = st._replace(model=model.write(st.model, client=2, key=1, value=8))
+    st = cl.steps(st, 12)
+    m = st.model
+    assert bool(jnp.all(m.store[:, 1] == 8)), "backups missed the overwrite"
+    assert bool(jnp.all(m.written[:, 1]))
+    assert bool(m.req_ok[2, 1])
+    assert bool(AlsbergDay.replicated(m, 1, st.faults.alive))
 
 
 def test_ok_implies_all_backups_wrote():

@@ -126,6 +126,40 @@ def test_ctp_cooperative_termination():
     assert bool(model.agreement(m))
 
 
+def test_ctp_nonparticipants_never_answer_decisions():
+    """Regression: decision requests ride the overlay and can reach nodes
+    OUTSIDE the transaction; those must answer uncertain, not abort — a
+    prepared participant partitioned from its peers must block (stay
+    prepared), not spuriously abort while the rest commit
+    (bernstein_ctp.erl addresses requests to participants only)."""
+    cfg, cl, model, st = build("bernstein_ctp")
+    members = jnp.arange(N) < 3            # participants {0, 1, 2} only
+    st = st._replace(model=model.begin(
+        st.model, coordinator=0, slot=0, value=3, members=members,
+        rnd=st.rnd))
+
+    def participants_prepared(s):
+        return bool(jnp.all(s.model.p_status[:3, 0] >= cp.P_PREPARED))
+    st, r = cl.run_until(st, participants_prepared, 20)
+    assert r >= 0
+    # Cut node 1 off from the other participants before the commit
+    # fan-out reaches it; only non-participants 3-5 remain reachable.
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, jnp.array([1]), jnp.array([0, 2])))
+    st = cl.steps(st, 30)
+    m = st.model
+    assert int(m.p_status[0, 0]) == cp.P_COMMIT
+    assert int(m.p_status[2, 0]) == cp.P_COMMIT
+    # node 1 blocks (prepared, uncertain) — it must NOT have aborted
+    assert int(m.p_status[1, 0]) == cp.P_PREPARED
+    assert bool(model.agreement(m))
+    # healing lets the next decision request reach a participant
+    st = st._replace(faults=faults_mod.resolve_partition(st.faults))
+    st = cl.steps(st, 30)
+    assert int(st.model.p_status[1, 0]) == cp.P_COMMIT
+    assert bool(model.agreement(st.model))
+
+
 def test_agreement_under_random_omissions():
     """Safety sweep: iid link drops never produce commit/abort disagreement
     (the filibuster postcondition, prop_partisan_crash_fault_model.erl)."""
