@@ -1,0 +1,20 @@
+"""Erlang ↔ JAX bridge (SURVEY.md §5.8 / §7 step 7).
+
+The north star requires the live Erlang ``protocols/`` suite and
+filibuster replay to drive the simulated manager: an Erlang node loads
+``partisan_sim_peer_service_manager`` (erl/ in this package), which
+implements the peer-service-manager behaviour
+(reference src/partisan_peer_service_manager.erl:93-170) by speaking a
+``{packet, 4}``-framed External-Term-Format protocol over a port to the
+Python process running :mod:`partisan_tpu.bridge.server`.
+
+- :mod:`partisan_tpu.bridge.etf`    — wire codec (Erlang external term
+  format, the ``term_to_binary`` framing of
+  partisan_util.erl:171-183)
+- :mod:`partisan_tpu.bridge.server` — the port server mapping behaviour
+  calls onto a Cluster
+- ``erl/partisan_sim_peer_service_manager.erl`` — the Erlang side
+  (source; build with the reference's rebar project)
+"""
+
+from partisan_tpu.bridge import etf  # noqa: F401

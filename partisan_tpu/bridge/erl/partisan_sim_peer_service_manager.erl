@@ -1,0 +1,293 @@
+%% -----------------------------------------------------------------------
+%% partisan_sim_peer_service_manager: peer-service manager behaviour over
+%% the partisan_tpu simulation bridge.
+%%
+%% Implements the reference behaviour contract
+%% (src/partisan_peer_service_manager.erl:93-170) by delegating overlay
+%% state and message routing to the TPU-side cluster simulator through a
+%% {packet,4} ETF port (partisan_tpu/bridge/server.py).  This lets the
+%% live protocols/ suite and filibuster replay drive the simulated
+%% manager unchanged-in-spirit (the north-star requirement).
+%%
+%% Mapping:
+%%   join/leave/members        -> {join,...} / {leave,...} / {members,...}
+%%   forward_message/4         -> {forward_message, Src, Dst, Words}
+%%                                (terms are interned to int words via a
+%%                                 symbol table; large terms ride a local
+%%                                 ETS side-channel keyed by word id)
+%%   receive_message/3         <- {drain, Node} after each {step, K}
+%%   inject/resolve_partition  -> fault commands
+%%   on_up/on_down             <- membership diffs between steps
+%%
+%% The tick server batches behaviour calls between steps so port
+%% round-trips never dominate (SURVEY.md §7 "batch the behaviour calls").
+%%
+%% Build: drop this file into the reference checkout's src/ and set
+%%   {peer_service_manager, partisan_sim_peer_service_manager}
+%% -----------------------------------------------------------------------
+-module(partisan_sim_peer_service_manager).
+
+-behaviour(gen_server).
+
+%% partisan_peer_service_manager callbacks (subset; the full contract is
+%% completed incrementally — unsupported calls return {error, notsup})
+-export([start_link/0,
+         members/0,
+         members_for_orchestration/0,
+         myself/0,
+         join/1,
+         sync_join/1,
+         leave/0,
+         leave/1,
+         forward_message/2,
+         forward_message/3,
+         forward_message/4,
+         receive_message/3,
+         inject_partition/2,
+         resolve_partition/1,
+         partitions/0,
+         on_up/2,
+         on_down/2,
+         decode/1,
+         reserve/1,
+         supports_capability/1]).
+
+-export([init/1, handle_call/3, handle_cast/2, handle_info/2,
+         terminate/2, code_change/3]).
+
+-define(PORT_CMD, "python3 -m partisan_tpu.bridge.server").
+-define(TICK_MS, 100).   %% one simulated round per tick (round_ms is
+                         %% virtual; the live bridge ticks faster)
+
+-record(state, {port        :: port(),
+                self_id     :: non_neg_integer(),
+                node_ids    :: #{node() => non_neg_integer()},
+                ids_node    :: #{non_neg_integer() => node()},
+                symbols     :: ets:tid(),   %% word id -> term
+                next_sym    :: pos_integer(),
+                up_funs     :: [{node(), fun(() -> ok)}],
+                down_funs   :: [{node(), fun(() -> ok)}],
+                last_members :: [non_neg_integer()]}).
+
+%% -----------------------------------------------------------------------
+%% API
+%% -----------------------------------------------------------------------
+
+start_link() ->
+    gen_server:start_link({local, ?MODULE}, ?MODULE, [], []).
+
+members() ->
+    gen_server:call(?MODULE, members, infinity).
+
+members_for_orchestration() ->
+    members().
+
+myself() ->
+    partisan:node_spec().
+
+join(NodeSpec) ->
+    gen_server:call(?MODULE, {join, NodeSpec}, infinity).
+
+sync_join(NodeSpec) ->
+    join(NodeSpec).
+
+leave() ->
+    gen_server:call(?MODULE, leave, infinity).
+
+leave(NodeSpec) ->
+    gen_server:call(?MODULE, {leave, NodeSpec}, infinity).
+
+forward_message(Term, Message) ->
+    forward_message(partisan:node(), Term, Message, #{}).
+
+forward_message(Node, Term, Message) ->
+    forward_message(Node, Term, Message, #{}).
+
+forward_message(Node, ServerRef, Message, _Opts) ->
+    gen_server:call(?MODULE, {forward, Node, ServerRef, Message}, infinity).
+
+receive_message(_Peer, _Channel, Message) ->
+    %% deliveries drained from the simulator re-enter here
+    partisan_peer_service_manager:process_forward(element(1, Message),
+                                                  element(2, Message)).
+
+inject_partition(Origin, TTL) ->
+    gen_server:call(?MODULE, {inject_partition, Origin, TTL}, infinity).
+
+resolve_partition(Reference) ->
+    gen_server:call(?MODULE, {resolve_partition, Reference}, infinity).
+
+partitions() ->
+    {error, notsup}.
+
+on_up(Node, Fun) ->
+    gen_server:call(?MODULE, {on_up, Node, Fun}, infinity).
+
+on_down(Node, Fun) ->
+    gen_server:call(?MODULE, {on_down, Node, Fun}, infinity).
+
+decode(State) ->
+    State.
+
+reserve(_Tag) ->
+    {error, no_available_slots}.
+
+supports_capability(monitoring) -> false;
+supports_capability(_) -> false.
+
+%% -----------------------------------------------------------------------
+%% gen_server
+%% -----------------------------------------------------------------------
+
+init([]) ->
+    Port = open_port({spawn, ?PORT_CMD},
+                     [{packet, 4}, binary, exit_status]),
+    N = partisan_config:get(sim_nodes, 16),
+    ok = rpc_port(Port, {init, #{n_nodes => N}}),
+    Symbols = ets:new(?MODULE, [set, protected]),
+    erlang:send_after(?TICK_MS, self(), tick),
+    {ok, #state{port = Port, self_id = 0,
+                node_ids = #{partisan:node() => 0},
+                ids_node = #{0 => partisan:node()},
+                symbols = Symbols, next_sym = 1,
+                up_funs = [], down_funs = [], last_members = [0]}}.
+
+handle_call(members, _From, State = #state{port = P, self_id = Me,
+                                           ids_node = Ids}) ->
+    {ok, Members} = rpc_port(P, {members, Me}),
+    {reply, {ok, [maps:get(I, Ids, I) || I <- Members]}, State};
+
+handle_call({join, NodeSpec}, _From, State0) ->
+    {Id, State} = intern_node(NodeSpec, State0),
+    ok = rpc_port(State#state.port, {join, Id, State#state.self_id}),
+    {reply, ok, State};
+
+handle_call(leave, _From, State = #state{port = P, self_id = Me}) ->
+    ok = rpc_port(P, {leave, Me}),
+    {reply, ok, State};
+
+handle_call({leave, NodeSpec}, _From, State0) ->
+    {Id, State} = intern_node(NodeSpec, State0),
+    ok = rpc_port(State#state.port, {leave, Id}),
+    {reply, ok, State};
+
+handle_call({forward, Node, ServerRef, Message}, _From, State0) ->
+    {Dst, State1} = intern_node(Node, State0),
+    {Words, State} = intern_message(ServerRef, Message, State1),
+    ok = rpc_port(State#state.port,
+                  {forward_message, State#state.self_id, Dst, Words}),
+    {reply, ok, State};
+
+handle_call({inject_partition, _Origin, _TTL}, _From, State) ->
+    ok = rpc_port(State#state.port,
+                  {inject_partition, [State#state.self_id], [1]}),
+    {reply, {ok, make_ref()}, State};
+
+handle_call({resolve_partition, _Ref}, _From, State) ->
+    ok = rpc_port(State#state.port, {resolve_partition}),
+    {reply, ok, State};
+
+handle_call({on_up, Node, Fun}, _From, State = #state{up_funs = U}) ->
+    {reply, ok, State#state{up_funs = [{Node, Fun} | U]}};
+
+handle_call({on_down, Node, Fun}, _From, State = #state{down_funs = D}) ->
+    {reply, ok, State#state{down_funs = [{Node, Fun} | D]}};
+
+handle_call(_Other, _From, State) ->
+    {reply, {error, notsup}, State}.
+
+handle_cast(_Msg, State) ->
+    {noreply, State}.
+
+handle_info(tick, State = #state{port = P, self_id = Me}) ->
+    {ok, _Round} = rpc_port(P, {step, 1}),
+    {ok, Delivered} = rpc_port(P, {drain, Me}),
+    [dispatch(Words, State) || {_Src, Words} <- Delivered],
+    State1 = fire_membership_callbacks(State),
+    erlang:send_after(?TICK_MS, self(), tick),
+    {noreply, State1};
+
+handle_info({Port, {exit_status, Status}}, State = #state{port = Port}) ->
+    {stop, {port_exited, Status}, State};
+
+handle_info(_Info, State) ->
+    {noreply, State}.
+
+terminate(_Reason, #state{port = P}) ->
+    catch rpc_port(P, {stop}),
+    catch port_close(P),
+    ok.
+
+code_change(_Old, State, _Extra) ->
+    {ok, State}.
+
+%% -----------------------------------------------------------------------
+%% internals
+%% -----------------------------------------------------------------------
+
+rpc_port(Port, Req) ->
+    true = port_command(Port, term_to_binary(Req)),
+    receive
+        {Port, {data, Bin}} ->
+            case binary_to_term(Bin) of
+                ok -> ok;
+                {ok, Result} -> {ok, Result};
+                Other -> Other
+            end
+    after 30000 ->
+        {error, bridge_timeout}
+    end.
+
+intern_node(#{name := Name}, State) ->
+    intern_node(Name, State);
+intern_node(Name, State = #state{node_ids = M, ids_node = R,
+                                 next_sym = _}) when is_atom(Name) ->
+    case maps:find(Name, M) of
+        {ok, Id} ->
+            {Id, State};
+        error ->
+            Id = maps:size(M),
+            {Id, State#state{node_ids = M#{Name => Id},
+                             ids_node = R#{Id => Name}}}
+    end.
+
+%% Terms don't fit fixed-width words: intern {ServerRef, Message} into a
+%% local symbol table and ship the symbol id.  (Single-node bridges share
+%% the table; a multi-VM deployment ships the table via disterl the way
+%% the reference's test harness uses disterl as control plane,
+%% SURVEY.md §4.)
+intern_message(ServerRef, Message, State = #state{symbols = T,
+                                                  next_sym = S}) ->
+    ets:insert(T, {S, {ServerRef, Message}}),
+    {[S], State#state{next_sym = S + 1}}.
+
+dispatch([Sym | _], #state{symbols = T}) ->
+    case ets:lookup(T, Sym) of
+        [{_, {ServerRef, Message}}] ->
+            partisan_peer_service_manager:deliver(ServerRef, Message);
+        [] ->
+            ok
+    end;
+dispatch(_, _) ->
+    ok.
+
+fire_membership_callbacks(State = #state{port = P, self_id = Me,
+                                         last_members = Last,
+                                         ids_node = Ids,
+                                         up_funs = Up, down_funs = Down}) ->
+    case rpc_port(P, {members, Me}) of
+        {ok, Members} ->
+            New = Members -- Last,
+            Gone = Last -- Members,
+            [maybe_fire(maps:get(I, Ids, undefined), Up) || I <- New],
+            [maybe_fire(maps:get(I, Ids, undefined), Down) || I <- Gone],
+            State#state{last_members = Members};
+        _ ->
+            State
+    end.
+
+maybe_fire(undefined, _Funs) ->
+    ok;
+maybe_fire(Node, Funs) ->
+    [catch Fun() || {N, Fun} <- Funs, N =:= Node orelse N =:= '_'],
+    ok.

@@ -1,0 +1,214 @@
+"""Erlang External Term Format codec (the ``term_to_binary`` wire format,
+reference partisan_util.erl:171-183 encodes all partisan frames with it).
+
+Implements the subset the bridge protocol needs — atoms, integers,
+floats, tuples, lists, binaries, maps, strings — of the ETF spec
+(format version 131).  Erlang atoms map to :class:`Atom`; improper lists
+are not supported (the bridge protocol doesn't use them).
+
+This is a clean-room implementation from the published format: each term
+is one tag byte followed by a fixed layout.
+"""
+
+from __future__ import annotations
+
+import struct
+
+VERSION = 131
+
+# tags (ETF spec)
+SMALL_INTEGER_EXT = 97
+INTEGER_EXT = 98
+FLOAT_NEW_EXT = 70
+ATOM_UTF8_EXT = 118
+SMALL_ATOM_UTF8_EXT = 119
+SMALL_TUPLE_EXT = 104
+LARGE_TUPLE_EXT = 105
+NIL_EXT = 106
+STRING_EXT = 107
+LIST_EXT = 108
+BINARY_EXT = 109
+SMALL_BIG_EXT = 110
+MAP_EXT = 116
+
+
+class Atom(str):
+    """An Erlang atom (distinct from binaries/strings)."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # 'ok -> Atom('ok')
+        return f"Atom({str.__repr__(self)})"
+
+
+# Common protocol atoms.
+OK = Atom("ok")
+ERROR = Atom("error")
+TRUE = Atom("true")
+FALSE = Atom("false")
+UNDEFINED = Atom("undefined")
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+def encode(term) -> bytes:
+    """term_to_binary/1."""
+    return bytes([VERSION]) + _enc(term)
+
+
+def _enc(t) -> bytes:
+    if isinstance(t, Atom):
+        b = str(t).encode("utf-8")
+        if len(b) < 256:
+            return bytes([SMALL_ATOM_UTF8_EXT, len(b)]) + b
+        return bytes([ATOM_UTF8_EXT]) + struct.pack(">H", len(b)) + b
+    if isinstance(t, bool):
+        return _enc(TRUE if t else FALSE)
+    if isinstance(t, int):
+        if 0 <= t <= 255:
+            return bytes([SMALL_INTEGER_EXT, t])
+        if -(1 << 31) <= t < (1 << 31):
+            return bytes([INTEGER_EXT]) + struct.pack(">i", t)
+        # SMALL_BIG_EXT: sign + little-endian magnitude bytes
+        sign = 1 if t < 0 else 0
+        mag = abs(t)
+        digits = b""
+        while mag:
+            digits += bytes([mag & 0xFF])
+            mag >>= 8
+        if len(digits) > 255:
+            raise ValueError("integer too large for SMALL_BIG_EXT")
+        return bytes([SMALL_BIG_EXT, len(digits), sign]) + digits
+    if isinstance(t, float):
+        return bytes([FLOAT_NEW_EXT]) + struct.pack(">d", t)
+    if isinstance(t, tuple):
+        if len(t) < 256:
+            head = bytes([SMALL_TUPLE_EXT, len(t)])
+        else:
+            head = bytes([LARGE_TUPLE_EXT]) + struct.pack(">I", len(t))
+        return head + b"".join(_enc(x) for x in t)
+    if isinstance(t, list):
+        if not t:
+            return bytes([NIL_EXT])
+        return (bytes([LIST_EXT]) + struct.pack(">I", len(t))
+                + b"".join(_enc(x) for x in t) + bytes([NIL_EXT]))
+    if isinstance(t, (bytes, bytearray)):
+        return bytes([BINARY_EXT]) + struct.pack(">I", len(t)) + bytes(t)
+    if isinstance(t, str):
+        # plain str -> binary (the bridge's convention for text)
+        return _enc(t.encode("utf-8"))
+    if isinstance(t, dict):
+        out = bytes([MAP_EXT]) + struct.pack(">I", len(t))
+        for k, v in t.items():
+            out += _enc(k) + _enc(v)
+        return out
+    raise TypeError(f"cannot encode {type(t).__name__}: {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+def decode(data: bytes):
+    """binary_to_term/1.  Returns the term; raises on trailing bytes."""
+    if not data or data[0] != VERSION:
+        raise ValueError("bad ETF version byte")
+    term, rest = _dec(memoryview(data)[1:])
+    if len(rest):
+        raise ValueError(f"{len(rest)} trailing bytes after term")
+    return term
+
+
+def _dec(b: memoryview):
+    tag = b[0]
+    b = b[1:]
+    if tag == SMALL_INTEGER_EXT:
+        return b[0], b[1:]
+    if tag == INTEGER_EXT:
+        return struct.unpack(">i", b[:4])[0], b[4:]
+    if tag == FLOAT_NEW_EXT:
+        return struct.unpack(">d", b[:8])[0], b[8:]
+    if tag == SMALL_ATOM_UTF8_EXT:
+        n = b[0]
+        return _atom(bytes(b[1:1 + n])), b[1 + n:]
+    if tag == ATOM_UTF8_EXT:
+        n = struct.unpack(">H", b[:2])[0]
+        return _atom(bytes(b[2:2 + n])), b[2 + n:]
+    if tag in (SMALL_TUPLE_EXT, LARGE_TUPLE_EXT):
+        if tag == SMALL_TUPLE_EXT:
+            n, b = b[0], b[1:]
+        else:
+            n, b = struct.unpack(">I", b[:4])[0], b[4:]
+        items = []
+        for _ in range(n):
+            x, b = _dec(b)
+            items.append(x)
+        return tuple(items), b
+    if tag == NIL_EXT:
+        return [], b
+    if tag == STRING_EXT:  # list of small ints packed as bytes
+        n = struct.unpack(">H", b[:2])[0]
+        return list(b[2:2 + n]), b[2 + n:]
+    if tag == LIST_EXT:
+        n = struct.unpack(">I", b[:4])[0]
+        b = b[4:]
+        items = []
+        for _ in range(n):
+            x, b = _dec(b)
+            items.append(x)
+        tail, b = _dec(b)
+        if tail != []:
+            raise ValueError("improper lists unsupported")
+        return items, b
+    if tag == BINARY_EXT:
+        n = struct.unpack(">I", b[:4])[0]
+        return bytes(b[4:4 + n]), b[4 + n:]
+    if tag == SMALL_BIG_EXT:
+        n, sign = b[0], b[1]
+        mag = 0
+        for i, d in enumerate(bytes(b[2:2 + n])):
+            mag |= d << (8 * i)
+        return (-mag if sign else mag), b[2 + n:]
+    if tag == MAP_EXT:
+        n = struct.unpack(">I", b[:4])[0]
+        b = b[4:]
+        out = {}
+        for _ in range(n):
+            k, b = _dec(b)
+            v, b = _dec(b)
+            out[k] = v
+        return out, b
+    raise ValueError(f"unsupported ETF tag {tag}")
+
+
+def _atom(raw: bytes):
+    s = raw.decode("utf-8")
+    if s == "true":
+        return True
+    if s == "false":
+        return False
+    return Atom(s)
+
+
+# ---------------------------------------------------------------------------
+# {packet, 4} framing (partisan_peer_socket's framing; also standard
+# open_port({packet, 4}) framing on the Erlang side)
+# ---------------------------------------------------------------------------
+
+def frame(term) -> bytes:
+    payload = encode(term)
+    return struct.pack(">I", len(payload)) + payload
+
+
+def read_frame(stream):
+    """Read one framed term from a binary stream; None at EOF."""
+    head = stream.read(4)
+    if not head or len(head) < 4:
+        return None
+    (n,) = struct.unpack(">I", head)
+    payload = stream.read(n)
+    if len(payload) < n:
+        raise EOFError("truncated frame")
+    return decode(payload)

@@ -1,0 +1,177 @@
+"""The bridge port server: behaviour calls → simulated manager.
+
+An Erlang node runs ``partisan_sim_peer_service_manager`` (erl/), which
+``open_port({spawn, "python -m partisan_tpu.bridge.server"}, [{packet,4},
+binary])`` and speaks framed ETF requests.  Protocol (tuples tagged by
+atom; every request gets exactly one reply):
+
+    {init, CfgMap}                        -> ok
+    {join, Node, Target}                  -> ok
+    {leave, Node}                         -> ok
+    {members, Node}                       -> {ok, [id]}
+    {neighbors, Node}                     -> {ok, [id]}
+    {forward_message, Src, Dst, Words}    -> ok     (Words: int payload)
+    {step, K}                             -> {ok, Round}
+    {drain, Node}                         -> {ok, [{Src, Words}]}
+    {crash, Node} | {recover, Node}       -> ok
+    {inject_partition, [A], [B]}          -> ok
+    {resolve_partition}                   -> ok
+    {stats}                               -> {ok, Map}
+    {stop}                                -> ok (then exits)
+
+The cluster runs manager-only (no model): application messages are the
+Erlang side's business — ``forward_message`` injects APP records, and
+``drain`` hands each node's deliveries back for dispatch to local
+processes, mirroring ``Manager:receive_message -> process`` on the
+reference's receive path (partisan_peer_service_server.erl:174-189).
+
+Batching: the Erlang side batches behaviour calls between ``step``s so
+port round-trips never dominate (SURVEY.md §7 hard-parts: "batch the
+behaviour calls").
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from partisan_tpu.bridge.etf import Atom, OK, frame, read_frame
+
+
+class Bridge:
+    """Protocol handler, independent of the stdio transport (testable)."""
+
+    def __init__(self) -> None:
+        self.cl = None
+        self.st = None
+        self._pending = []   # injected messages awaiting the next step
+
+    # ---- dispatch -----------------------------------------------------
+    def handle(self, req):
+        import jax.numpy as jnp
+
+        from partisan_tpu import faults as faults_mod
+        from partisan_tpu import types as T
+        from partisan_tpu.cluster import Cluster
+        from partisan_tpu.config import Config
+        from partisan_tpu.ops import exchange, msg as msg_ops
+
+        if not (isinstance(req, tuple) and req and isinstance(req[0], Atom)):
+            return (Atom("error"), Atom("badarg"))
+        cmd, args = str(req[0]), req[1:]
+
+        if cmd == "init":
+            cfg_map = {str(k): v for k, v in (args[0] or {}).items()}
+            self.cl = Cluster(Config.from_dict(cfg_map))
+            self.st = self.cl.init()
+            self._pending = []
+            return OK
+        if self.cl is None:
+            return (Atom("error"), Atom("not_initialized"))
+
+        cl, st = self.cl, self.st
+        if cmd == "join":
+            self.st = st._replace(manager=cl.manager.join(
+                cl.cfg, st.manager, int(args[0]), int(args[1])))
+            return OK
+        if cmd == "leave":
+            self.st = st._replace(manager=cl.manager.leave(
+                cl.cfg, st.manager, int(args[0])))
+            return OK
+        if cmd == "members":
+            row = np.asarray(cl.manager.members(cl.cfg, st.manager))[int(args[0])]
+            return (OK, [int(i) for i in np.flatnonzero(row)])
+        if cmd == "neighbors":
+            row = np.asarray(cl.manager.neighbors(cl.cfg, st.manager))[int(args[0])]
+            return (OK, [int(i) for i in row if i >= 0])
+        if cmd == "forward_message":
+            src, dst, words = int(args[0]), int(args[1]), list(args[2])
+            w = cl.cfg.msg_words
+            pw = (words + [0] * w)[:w - T.HDR_WORDS]
+            rec = msg_ops.build(w, T.MsgKind.APP, src, dst,
+                                payload=tuple(jnp.int32(x) for x in pw))
+            self._pending.append(np.asarray(rec))
+            return OK
+        if cmd == "step":
+            k = int(args[0]) if args else 1
+            self.st = cl.steps(self.st, k)
+            if self._pending:
+                # Injected sends ride the wire during this step: subject
+                # them to the fault stage (crash/partition/link_drop),
+                # then deliver into the post-step inbox the drain reads.
+                flat = jnp.asarray(np.stack(self._pending))[None]  # [1,M,W]
+                flat = faults_mod.filter_msgs(
+                    self.st.faults, flat, cl.cfg.seed, self.st.rnd, 97)
+                extra = exchange.route(flat, cl.cfg.n_nodes,
+                                       cl.cfg.inbox_cap)
+                self.st = self.st._replace(
+                    inbox=exchange.merge_inboxes(self.st.inbox, extra))
+                self._pending = []
+            return (OK, int(self.st.rnd))
+        if cmd == "drain":
+            node = int(args[0])
+            data = np.asarray(self.st.inbox.data[node])
+            out = []
+            keep = data.copy()
+            for i, rec in enumerate(data):
+                if rec[T.W_KIND] == T.MsgKind.APP:
+                    out.append((int(rec[T.W_SRC]),
+                                [int(x) for x in rec[T.HDR_WORDS:]]))
+                    keep[i] = 0
+            inbox = self.st.inbox
+            self.st = self.st._replace(inbox=inbox._replace(
+                data=inbox.data.at[node].set(jnp.asarray(keep))))
+            return (OK, out)
+        if cmd == "crash":
+            self.st = st._replace(faults=faults_mod.crash(st.faults, int(args[0])))
+            return OK
+        if cmd == "recover":
+            self.st = st._replace(faults=faults_mod.recover(st.faults, int(args[0])))
+            return OK
+        if cmd == "inject_partition":
+            self.st = st._replace(faults=faults_mod.inject_partition(
+                st.faults, [int(x) for x in args[0]],
+                [int(x) for x in args[1]]))
+            return OK
+        if cmd == "resolve_partition":
+            self.st = st._replace(
+                faults=faults_mod.resolve_partition(st.faults))
+            return OK
+        if cmd == "stats":
+            s = self.st.stats
+            return (OK, {Atom("emitted"): int(s.emitted),
+                         Atom("delivered"): int(s.delivered),
+                         Atom("dropped"): int(s.dropped),
+                         Atom("round"): int(self.st.rnd)})
+        if cmd == "stop":
+            return OK
+        return (Atom("error"), (Atom("unknown_command"), Atom(cmd)))
+
+
+def main() -> None:
+    # The bridge must never steal the TPU from a concurrently-running
+    # session by surprise: honor JAX_PLATFORMS=cpu (see __graft_entry__).
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge
+        xla_bridge._backend_factories.pop("axon", None)
+
+    bridge = Bridge()
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    while True:
+        req = read_frame(stdin)
+        if req is None:
+            return
+        reply = bridge.handle(req)
+        stdout.write(frame(reply))
+        stdout.flush()
+        if isinstance(req, tuple) and req and str(req[0]) == "stop":
+            return
+
+
+if __name__ == "__main__":
+    main()

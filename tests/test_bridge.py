@@ -1,0 +1,145 @@
+"""Erlang bridge tests: ETF codec round-trips (term_to_binary parity)
+and the port-server protocol end-to-end over a real subprocess pipe
+(the open_port({packet,4}) transport)."""
+
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from partisan_tpu.bridge import etf
+from partisan_tpu.bridge.etf import Atom
+from partisan_tpu.bridge.server import Bridge
+
+
+# ---------------------------------------------------------------------------
+# ETF codec
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("term", [
+    0, 255, 256, -1, 2**31 - 1, -(2**31), 2**40, -(2**40),
+    1.5, -2.25,
+    Atom("ok"), Atom("a_rather_longer_atom_name"),
+    True, False,
+    (), (1, 2, 3), (Atom("ok"), [1, 2], b"bin"),
+    [], [1, [2, [3]]],
+    b"", b"\x00\xff", "text",
+    {Atom("a"): 1, b"k": [2.0]},
+])
+def test_roundtrip(term):
+    out = etf.decode(etf.encode(term))
+    if isinstance(term, str) and not isinstance(term, Atom):
+        assert out == term.encode("utf-8")   # strings ship as binaries
+    else:
+        assert out == term
+        assert type(out) is type(term) or isinstance(term, bool)
+
+
+def test_known_encodings_match_erlang():
+    # Golden values from erl term_to_binary/1.
+    assert etf.encode(1) == bytes([131, 97, 1])
+    assert etf.encode(1000) == bytes([131, 98, 0, 0, 3, 232])
+    assert etf.encode(Atom("ok")) == bytes([131, 119, 2]) + b"ok"
+    assert etf.encode([]) == bytes([131, 106])
+    assert etf.encode((Atom("a"), 1)) == \
+        bytes([131, 104, 2, 119, 1]) + b"a" + bytes([97, 1])
+    assert etf.encode(b"hi") == bytes([131, 109, 0, 0, 0, 2]) + b"hi"
+    # big ints use SMALL_BIG_EXT little-endian magnitude
+    assert etf.encode(2**32) == bytes([131, 110, 5, 0, 0, 0, 0, 0, 1])
+
+
+def test_decode_string_ext_and_errors():
+    # STRING_EXT (erlang lists of bytes): tag 107
+    data = bytes([131, 107, 0, 3]) + b"abc"
+    assert etf.decode(data) == [97, 98, 99]
+    with pytest.raises(ValueError):
+        etf.decode(b"\x83\x6a\x00")   # trailing byte
+    with pytest.raises(ValueError):
+        etf.decode(b"\x00")           # bad version
+
+
+def test_framing():
+    b = etf.frame((Atom("ok"), 7))
+    n = struct.unpack(">I", b[:4])[0]
+    assert n == len(b) - 4
+    import io
+    assert etf.read_frame(io.BytesIO(b)) == (Atom("ok"), 7)
+    assert etf.read_frame(io.BytesIO(b"")) is None
+
+
+# ---------------------------------------------------------------------------
+# Bridge protocol (in-process)
+# ---------------------------------------------------------------------------
+
+def test_bridge_protocol_session():
+    br = Bridge()
+    assert br.handle((Atom("members"), 0)) == \
+        (Atom("error"), Atom("not_initialized"))
+    assert br.handle((Atom("init"), {Atom("n_nodes"): 8,
+                                     Atom("seed"): 3})) == etf.OK
+    for i in range(1, 8):
+        assert br.handle((Atom("join"), i, 0)) == etf.OK
+    ok, rnd = br.handle((Atom("step"), 15))
+    assert ok == etf.OK and rnd == 15
+    ok, members = br.handle((Atom("members"), 0))
+    assert ok == etf.OK and set(members) == set(range(8))
+    ok, nbrs = br.handle((Atom("neighbors"), 0))
+    assert set(nbrs) == set(range(1, 8))
+
+    # forward an app message 2 -> 5 and drain it on the other side
+    assert br.handle((Atom("forward_message"), 2, 5, [42, 7])) == etf.OK
+    br.handle((Atom("step"), 1))
+    ok, delivered = br.handle((Atom("drain"), 5))
+    assert ok == etf.OK and len(delivered) == 1
+    src, words = delivered[0]
+    assert src == 2 and words[:2] == [42, 7]
+    # drained once: second drain is empty
+    ok, again = br.handle((Atom("drain"), 5))
+    assert again == []
+
+    # faults
+    assert br.handle((Atom("crash"), 3)) == etf.OK
+    br.handle((Atom("step"), 2))
+    ok, stats = br.handle((Atom("stats"),))
+    assert stats[Atom("round")] == 18
+    assert br.handle((Atom("recover"), 3)) == etf.OK
+    assert br.handle((Atom("inject_partition"), [0], [1])) == etf.OK
+    assert br.handle((Atom("resolve_partition"),)) == etf.OK
+    assert br.handle((Atom("bogus"),)) == \
+        (Atom("error"), (Atom("unknown_command"), Atom("bogus")))
+    assert br.handle((Atom("stop"),)) == etf.OK
+
+
+# ---------------------------------------------------------------------------
+# Port transport (subprocess, the open_port analogue)
+# ---------------------------------------------------------------------------
+
+def _rpc(proc, term):
+    proc.stdin.write(etf.frame(term))
+    proc.stdin.flush()
+    return etf.read_frame(proc.stdout)
+
+
+def test_port_server_subprocess():
+    import os
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    env["PYTHONPATH"] = "/root/repo"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "partisan_tpu.bridge.server"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+        cwd="/root/repo")
+    try:
+        assert _rpc(proc, (Atom("init"), {Atom("n_nodes"): 4})) == etf.OK
+        for i in range(1, 4):
+            assert _rpc(proc, (Atom("join"), i, 0)) == etf.OK
+        ok, rnd = _rpc(proc, (Atom("step"), 10))
+        assert ok == etf.OK and rnd == 10
+        ok, members = _rpc(proc, (Atom("members"), 0))
+        assert set(members) == set(range(4))
+        assert _rpc(proc, (Atom("stop"),)) == etf.OK
+        proc.wait(timeout=30)
+        assert proc.returncode == 0
+    finally:
+        proc.kill()
