@@ -64,8 +64,10 @@ def run(n: int, verbose: bool = False) -> dict:
     if conv < 0:
         raise AssertionError(f"n={n}: plumtree broadcast did not converge")
 
-    # Steady-state throughput: k rounds as one compiled lax.scan program.
-    k = 60
+    # Steady-state throughput: k rounds as one compiled lax.scan program
+    # (k large enough to sit well above dispatch/timer noise — a round
+    # runs in tens of microseconds).
+    k = 500
     st = cl.steps(st, k)           # warm the k-specialized program
     jax.block_until_ready(st)
     best = float("inf")

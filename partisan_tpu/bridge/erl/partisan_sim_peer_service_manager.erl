@@ -59,7 +59,15 @@
 -define(TICK_MS, 100).   %% one simulated round per tick (round_ms is
                          %% virtual; the live bridge ticks faster)
 
+%% NOTE multi-VM deployments: every participating Erlang node must talk
+%% to ONE shared simulator (each setting its own id via {set_self, Id}
+%% and draining its own deliveries).  The stdio port transport below is
+%% the single-VM harness; sharing across VMs routes the same protocol
+%% over a TCP socket to one bridge server instead (planned transport —
+%% the request/reply protocol is transport-agnostic and sequenced).
+
 -record(state, {port        :: port(),
+                seq = 0     :: non_neg_integer(),
                 self_id     :: non_neg_integer(),
                 node_ids    :: #{node() => non_neg_integer()},
                 ids_node    :: #{non_neg_integer() => node()},
@@ -143,14 +151,16 @@ init([]) ->
     Port = open_port({spawn, ?PORT_CMD},
                      [{packet, 4}, binary, exit_status]),
     N = partisan_config:get(sim_nodes, 16),
+    SelfId = partisan_config:get(sim_self_id, 0),
     ok = rpc_port(Port, {init, #{n_nodes => N}}),
+    ok = rpc_port(Port, {set_self, SelfId}),
     Symbols = ets:new(?MODULE, [set, protected]),
     erlang:send_after(?TICK_MS, self(), tick),
-    {ok, #state{port = Port, self_id = 0,
-                node_ids = #{partisan:node() => 0},
-                ids_node = #{0 => partisan:node()},
+    {ok, #state{port = Port, self_id = SelfId,
+                node_ids = #{partisan:node() => SelfId},
+                ids_node = #{SelfId => partisan:node()},
                 symbols = Symbols, next_sym = 1,
-                up_funs = [], down_funs = [], last_members = [0]}}.
+                up_funs = [], down_funs = [], last_members = [SelfId]}}.
 
 handle_call(members, _From, State = #state{port = P, self_id = Me,
                                            ids_node = Ids}) ->
@@ -225,16 +235,32 @@ code_change(_Old, State, _Extra) ->
 %% internals
 %% -----------------------------------------------------------------------
 
+%% Sequenced request/reply: each request is {Seq, Req} and the bridge
+%% echoes {Seq, Reply}.  After a timeout, stale replies with older
+%% sequence numbers are discarded on the next call instead of being
+%% paired with the wrong request (the first {step, 1} can exceed the
+%% timeout while XLA compiles the round program).
 rpc_port(Port, Req) ->
-    true = port_command(Port, term_to_binary(Req)),
+    Seq = erlang:unique_integer([positive, monotonic]),
+    true = port_command(Port, term_to_binary({Seq, Req})),
+    await_reply(Port, Seq).
+
+await_reply(Port, Seq) ->
     receive
         {Port, {data, Bin}} ->
             case binary_to_term(Bin) of
-                ok -> ok;
-                {ok, Result} -> {ok, Result};
-                Other -> Other
+                {Seq, Reply} ->
+                    case Reply of
+                        ok -> ok;
+                        {ok, Result} -> {ok, Result};
+                        Other -> Other
+                    end;
+                {Stale, _} when is_integer(Stale), Stale < Seq ->
+                    await_reply(Port, Seq);   % drop late reply, keep waiting
+                _Unexpected ->
+                    await_reply(Port, Seq)
             end
-    after 30000 ->
+    after 120000 ->
         {error, bridge_timeout}
     end.
 
@@ -246,9 +272,15 @@ intern_node(Name, State = #state{node_ids = M, ids_node = R,
         {ok, Id} ->
             {Id, State};
         error ->
-            Id = maps:size(M),
+            Id = free_id(0, M),
             {Id, State#state{node_ids = M#{Name => Id},
                              ids_node = R#{Id => Name}}}
+    end.
+
+free_id(I, M) ->
+    case lists:member(I, maps:values(M)) of
+        true -> free_id(I + 1, M);
+        false -> I
     end.
 
 %% Terms don't fit fixed-width words: intern {ServerRef, Message} into a
@@ -262,7 +294,9 @@ intern_message(ServerRef, Message, State = #state{symbols = T,
     {[S], State#state{next_sym = S + 1}}.
 
 dispatch([Sym | _], #state{symbols = T}) ->
-    case ets:lookup(T, Sym) of
+    %% take (not lookup): each symbol is delivered at most once, so
+    %% delete-on-delivery bounds the table.
+    case ets:take(T, Sym) of
         [{_, {ServerRef, Message}}] ->
             partisan_peer_service_manager:deliver(ServerRef, Message);
         [] ->

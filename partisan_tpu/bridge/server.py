@@ -45,6 +45,7 @@ class Bridge:
     def __init__(self) -> None:
         self.cl = None
         self.st = None
+        self.self_id = 0     # this Erlang node's sim id ({set_self, Id})
         self._pending = []   # injected messages awaiting the next step
 
     # ---- dispatch -----------------------------------------------------
@@ -57,9 +58,24 @@ class Bridge:
         from partisan_tpu.config import Config
         from partisan_tpu.ops import exchange, msg as msg_ops
 
+        # Sequenced form {Seq, Request} -> {Seq, Reply}: lets the Erlang
+        # side discard stale replies after a timeout instead of pairing
+        # them with the wrong call.
+        if (isinstance(req, tuple) and len(req) == 2
+                and isinstance(req[0], int)
+                and not isinstance(req[0], bool)
+                and isinstance(req[1], tuple)):
+            seq, inner = req
+            return (seq, self.handle(inner))
         if not (isinstance(req, tuple) and req and isinstance(req[0], Atom)):
             return (Atom("error"), Atom("badarg"))
         cmd, args = str(req[0]), req[1:]
+
+        if cmd == "set_self":
+            # Multi-VM deployments give each Erlang node its own sim id;
+            # replies to `drain` then cover that node's deliveries.
+            self.self_id = int(args[0])
+            return OK
 
         if cmd == "init":
             cfg_map = {str(k): v for k, v in (args[0] or {}).items()}
@@ -120,8 +136,11 @@ class Bridge:
                                 [int(x) for x in rec[T.HDR_WORDS:]]))
                     keep[i] = 0
             inbox = self.st.inbox
+            # Keep the Inbox invariant (count == valid slots): drained
+            # records leave the queue entirely.
             self.st = self.st._replace(inbox=inbox._replace(
-                data=inbox.data.at[node].set(jnp.asarray(keep))))
+                data=inbox.data.at[node].set(jnp.asarray(keep)),
+                count=inbox.count.at[node].add(-len(out))))
             return (OK, out)
         if cmd == "crash":
             self.st = st._replace(faults=faults_mod.crash(st.faults, int(args[0])))
@@ -169,7 +188,9 @@ def main() -> None:
         reply = bridge.handle(req)
         stdout.write(frame(reply))
         stdout.flush()
-        if isinstance(req, tuple) and req and str(req[0]) == "stop":
+        inner = (req[1] if (isinstance(req, tuple) and len(req) == 2
+                            and isinstance(req[0], int)) else req)
+        if isinstance(inner, tuple) and inner and str(inner[0]) == "stop":
             return
 
 

@@ -65,6 +65,8 @@ class HyParViewConfig:
     shuffle_k_active: int = 3
     shuffle_k_passive: int = 4
     random_promotion_interval_ms: int = 5_000
+    xbot: bool = False                   # X-BOT overlay optimization
+    xbot_interval_ms: int = 10_000       # xbot_execution timer (:1114)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -233,6 +235,10 @@ class Config:
     @property
     def promotion_every(self) -> int:
         return self.rounds(self.hyparview.random_promotion_interval_ms)
+
+    @property
+    def xbot_every(self) -> int:
+        return self.rounds(self.hyparview.xbot_interval_ms)
 
     # --- construction helpers -----------------------------------------
     def replace(self, **kw: Any) -> "Config":

@@ -24,8 +24,14 @@ its own A_MAX-slot block (excess JOINs re-queue to self for the next
 round).  Random-walk hops advance one virtual round per hop — the
 round→virtual-time calibration note in SURVEY.md §7 applies.
 
-Not yet implemented from the reference (tracked for later rounds):
-X-BOT overlay optimization (:1880-2050), reserved slots, epochs.
+X-BOT overlay optimization (:1880-2050) is config-gated
+(``HyParViewConfig.xbot``) with a synthetic latency oracle (the
+reference pings over the wire, :2978-3000) and a 2-party exchange in
+place of the 4-party replace handshake (demoted peers re-home through
+standard isolation healing).  Reserved slots (reserve/1) hold active
+capacity back from ordinary admission.  Epochs are transposed away:
+reference epochs disambiguate same-name node re-incarnations
+(:249-256), but sim node ids ARE incarnation-stable identities.
 """
 
 from __future__ import annotations
@@ -56,7 +62,24 @@ def _shuffle_sample(cfg: Config) -> int:
 _TAG_SHUFFLE = 303
 _TAG_PROMOTE = 304
 _TAG_JOIN = 305
+_TAG_XBOT = 306
+_TAG_XBOT_COST = 307
 _TAG_SLOT = 1000
+
+
+def link_cost(seed: int, a, b):
+    """Synthetic symmetric link-latency oracle for X-BOT.  The reference
+    measures live RTTs (is_better/3 via net_adm:ping timing,
+    partisan_hyparview_peer_service_manager.erl:2978-3000); the sim has
+    no wire, so cost is a deterministic uniform hash per unordered pair
+    — stable across rounds and placements, which is what the
+    optimization needs to converge."""
+    from partisan_tpu import faults as faults_mod
+
+    lo = jnp.minimum(a, b)
+    hi = jnp.maximum(a, b)
+    return faults_mod.edge_hash(seed, jnp.int32(0), _TAG_XBOT_COST, lo, hi) \
+        .astype(jnp.float32)
 
 
 class HyParViewState(NamedTuple):
@@ -65,6 +88,10 @@ class HyParViewState(NamedTuple):
     join_target: Array  # int32[n_local] — pending scripted JOIN (-1 none)
     leaving: Array      # bool[n_local] — send disconnects THIS round
     left: Array         # bool[n_local] — has left: inert until rejoin
+    reserved: Array     # int32[n_local] — active slots held back from
+    #                     ordinary admission (reserve/1, reference
+    #                     reserved-slot map :230-243); scripted joins
+    #                     may still use them
 
 
 class HyParView:
@@ -84,6 +111,7 @@ class HyParView:
             join_target=jnp.full((n,), -1, jnp.int32),
             leaving=jnp.zeros((n,), jnp.bool_),
             left=jnp.zeros((n,), jnp.bool_),
+            reserved=jnp.zeros((n,), jnp.int32),
         )
 
     # ------------------------------------------------------------------
@@ -108,13 +136,21 @@ class HyParView:
         passive_in = jax.vmap(views.keep_only, in_axes=(0, None))(
             state.passive, reachable)
 
-        def per_node(me, key, active, passive, join_tgt, leaving, inbox_row):
+        def per_node(me, key, active, passive, join_tgt, leaving, resv,
+                     inbox_row):
             """One node's whole round. Returns new views + emitted msgs."""
 
             def mk(kind, dst, *, ttl=0, payload=()):
                 return msg_ops.build(W, kind, me, dst, ttl=ttl, payload=payload)
 
             nomsg = jnp.zeros((W,), jnp.int32)
+            # Ordinary admission capacity: active slots minus reserved
+            # ones (reserve/1); scripted joins below still use the full
+            # width.
+            acap = jnp.int32(hv.active_max) - resv
+
+            def my_cost(ids):
+                return link_cost(cfg.seed, me, ids)
 
             # ---- scripted join / leave (timer-ish, before the inbox) --
             jkey = rng.subkey(key, _TAG_JOIN)
@@ -153,7 +189,8 @@ class HyParView:
                     # self for the next round.
                     dup = views.contains(a, src)
                     first = (fj < 0) & ~dup
-                    a2, ev = views.add(a, jnp.where(first, src, -1), k1)
+                    a2, ev = views.add_cap(a, jnp.where(first, src, -1),
+                                           k1, acap)
                     p2 = views.remove(p, src)
                     r0 = jnp.where(
                         dup,
@@ -177,7 +214,8 @@ class HyParView:
                             | views.contains(a, j))
                     stop_ok = stop & (j != me) & ~views.contains(a, j)
                     # stop: adopt the joiner (walk end, reference :1381)
-                    a2, ev = views.add(a, jnp.where(stop_ok, j, -1), k1)
+                    a2, ev = views.add_cap(a, jnp.where(stop_ok, j, -1),
+                                           k1, acap)
                     r0_stop = mk(T.MsgKind.HPV_DISCONNECT, ev)
                     r1_stop = jnp.where(
                         stop_ok, mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, j), nomsg)
@@ -192,8 +230,15 @@ class HyParView:
                             jnp.where(stop, r1_stop, nomsg))
 
                 def b_neighbor(a, p, fj):
-                    accept = (msg[T.P0] == 1) | ~views.is_full(a)
-                    a2, ev = views.add(a, jnp.where(accept, src, -1), k1)
+                    want = (msg[T.P0] == 1) | (views.size(a) < acap)
+                    a2, ev = views.add_cap(a, jnp.where(want, src, -1),
+                                           k1, acap)
+                    # Accept only what was ACTUALLY admitted: a fully
+                    # reserved view (acap <= 0) rejects even priority
+                    # requests, and claiming acceptance without the edge
+                    # would leave the requester with a one-directional
+                    # link it believes is healed.
+                    accept = views.contains(a2, src)
                     p2 = jnp.where(accept, views.remove(p, src), p)
                     r0 = jnp.where(
                         accept,
@@ -204,7 +249,7 @@ class HyParView:
                     return a2, p2, fj, r0, r1
 
                 def b_accepted(a, p, fj):
-                    a2, ev = views.add(a, src, k1)
+                    a2, ev = views.add_cap(a, src, k1, acap)
                     return (a2, views.remove(p, src), fj,
                             mk(T.MsgKind.HPV_DISCONNECT, ev), nomsg)
 
@@ -241,11 +286,58 @@ class HyParView:
                         msg, (T.P1,), (SAMPLE,))
                     return a, views.merge_sample(p, ids, me, k1), fj, nomsg, nomsg
 
+                def b_xbot_opt(a, p, fj):
+                    # X-BOT candidate side (:1880-2050, simplified to a
+                    # 2-party exchange): accept the initiator if I have
+                    # room or it beats my worst active peer, which is
+                    # then demoted via the standard disconnect/healing
+                    # path (the reference's 4-party replace handshake
+                    # additionally re-homes the demoted peers; the sim
+                    # relies on HyParView's isolation healing instead).
+                    i = src
+                    o = msg[T.P0]
+                    z = views.worst_by(a, my_cost)
+                    have_room = views.size(a) < acap
+                    better = my_cost(jnp.maximum(i, 0)) < \
+                        my_cost(jnp.maximum(z, 0))
+                    accept = (i >= 0) & ~views.contains(a, i) \
+                        & (have_room | ((z >= 0) & better))
+                    evict = accept & ~have_room
+                    a2 = jnp.where(evict, views.remove(a, z), a)
+                    a3, _ = views.add_cap(a2, jnp.where(accept, i, -1),
+                                          k1, acap)
+                    p2 = jnp.where(evict,
+                                   views.merge_sample(p, z[None], me, k2), p)
+                    r0 = mk(T.MsgKind.HPV_XBOT_OPT_REPLY, i,
+                            payload=(o, accept.astype(jnp.int32)))
+                    r1 = jnp.where(evict & (z >= 0),
+                                   mk(T.MsgKind.HPV_DISCONNECT, z), nomsg)
+                    return a3, p2, fj, r0, r1
+
+                def b_xbot_reply(a, p, fj):
+                    # initiator side: on accept, swap old worst peer for
+                    # the (closer) candidate
+                    o = msg[T.P0]
+                    ok = (msg[T.P1] == 1) & views.contains(a, o)
+                    c = src
+                    a2 = jnp.where(ok, views.remove(a, o), a)
+                    a3, _ = views.add_cap(a2, jnp.where(ok, c, -1), k1, acap)
+                    p2 = jnp.where(ok,
+                                   views.merge_sample(p, o[None], me, k2), p)
+                    r0 = jnp.where(ok & (o >= 0),
+                                   mk(T.MsgKind.HPV_DISCONNECT, o), nomsg)
+                    return a3, p2, fj, r0, nomsg
+
                 branches = [b_join, b_forward_join, b_neighbor, b_accepted,
                             b_rejected, b_disconnect, b_shuffle,
-                            b_shuffle_reply, b_noop]
+                            b_shuffle_reply]
+                last_kind = T.MsgKind.HPV_SHUFFLE_REPLY
+                if hv.xbot:
+                    branches += [b_xbot_opt, b_xbot_reply]
+                    last_kind = T.MsgKind.HPV_XBOT_OPT_REPLY
+                branches.append(b_noop)
                 idx = jnp.where(
-                    (k >= T.MsgKind.HPV_JOIN) & (k <= T.MsgKind.HPV_SHUFFLE_REPLY),
+                    (k >= T.MsgKind.HPV_JOIN) & (k <= last_kind),
                     k - T.MsgKind.HPV_JOIN, len(branches) - 1)
                 a2, p2, fj2, r0, r1 = jax.lax.switch(
                     idx, branches, active, passive, fanout_joiner)
@@ -297,19 +389,38 @@ class HyParView:
                    payload=(jnp.asarray(views.size(active) == 0, jnp.int32),)),
                 nomsg)
 
+            # ---- X-BOT optimization timer (:1114) ---------------------
+            if hv.xbot:
+                xkey = rng.subkey(key, _TAG_XBOT)
+                o_worst = views.worst_by(active, my_cost)
+                cand = views.pick_one(passive, rng.subkey(xkey, 1),
+                                      exclude=active)
+                x_fire = ((ctx.rnd + me) % cfg.xbot_every == 0) \
+                    & (views.size(active) >= acap) \
+                    & (cand >= 0) & (o_worst >= 0) \
+                    & (my_cost(jnp.maximum(cand, 0))
+                       < my_cost(jnp.maximum(o_worst, 0)))
+                xbot_msg = jnp.where(
+                    x_fire,
+                    mk(T.MsgKind.HPV_XBOT_OPT, cand, payload=(o_worst,)),
+                    nomsg)
+            else:
+                xbot_msg = nomsg
+
             # leave: clear own views after disconnecting
             active = jnp.where(leaving, -1, active)
             passive = jnp.where(leaving, -1, passive)
 
             emitted = jnp.concatenate([
                 replies, fanout,
-                jnp.stack([join_msg, join_ev_msg, shuffle_msg, promote_msg]),
+                jnp.stack([join_msg, join_ev_msg, shuffle_msg, promote_msg,
+                           xbot_msg]),
             ])
             return active, passive, emitted
 
         new_active, new_passive, emitted = jax.vmap(per_node)(
             gids, ctx.keys, active, passive_in, state.join_target,
-            state.leaving, ctx.inbox.data)
+            state.leaving, state.reserved, ctx.inbox.data)
 
         # Crash-stopped and left nodes are frozen and silent (a left node
         # is inert until a scripted rejoin — the reference's leaver shuts
@@ -341,6 +452,7 @@ class HyParView:
             leaving=jnp.where(live, False, state.leaving),
             left=(state.left | (state.leaving & live))
                  & ~(state.join_target >= 0),
+            reserved=state.reserved,
         )
         return new_state, emitted
 
@@ -370,6 +482,20 @@ class HyParView:
              target: int) -> HyParViewState:
         return state._replace(
             join_target=state.join_target.at[node].set(target))
+
+    def reserve(self, cfg: Config, state: HyParViewState, node: int,
+                count: int = 1) -> HyParViewState:
+        """Hold back ``count`` active slots on ``node`` from ordinary
+        admission (reserve/1 — the reference reserves slots per tag for
+        orchestrated topologies).  Raises if the reservation exceeds the
+        active-view width."""
+        if count < 0:
+            raise ValueError("count must be >= 0")
+        new = int(state.reserved[node]) + count
+        if new > cfg.hyparview.active_max:
+            raise ValueError(
+                f"reserving {new} > active_max={cfg.hyparview.active_max}")
+        return state._replace(reserved=state.reserved.at[node].add(count))
 
     def join_many(self, cfg: Config, state: HyParViewState, nodes,
                   targets) -> HyParViewState:

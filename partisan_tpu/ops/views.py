@@ -60,6 +60,37 @@ def add(view: Array, nid: Array, key: Array) -> tuple[Array, Array]:
     return view, jnp.where(already, EMPTY, evicted)
 
 
+def add_cap(view: Array, nid: Array, key: Array, cap) -> tuple[Array, Array]:
+    """``add`` under a soft capacity: the view counts as full once
+    ``size >= cap`` even if physical slots remain (reserved-slot support,
+    reference reserve/1 + add_to_active_view :2344-2420).  At capacity a
+    RANDOM member is evicted; ``cap <= 0`` rejects the add outright.
+
+    Returns (view', evicted)."""
+    already = contains(view, nid) | (nid < 0) | (jnp.asarray(cap) <= 0)
+    cur = size(view)
+    at_cap = cur >= jnp.asarray(cap)
+    has_empty = jnp.any(view == EMPTY)
+    first_empty = jnp.argmax(view == EMPTY)
+    evictee = pick_one(view, key)
+    evict_slot = jnp.argmax(view == evictee)
+    use_evict = at_cap | ~has_empty
+    slot = jnp.where(use_evict, evict_slot, first_empty)
+    evicted = jnp.where(use_evict, view[slot], EMPTY)
+    new = view.at[slot].set(nid)
+    view = jnp.where(already, view, new)
+    return view, jnp.where(already, EMPTY, evicted)
+
+
+def worst_by(view: Array, cost_of_id) -> Array:
+    """Member with the highest ``cost_of_id(id)`` (or -1 if empty) — the
+    X-BOT 'worst active peer' selection (is_better/3 oracle consumer)."""
+    ids = jnp.where(view >= 0, view, 0)
+    costs = jnp.where(view >= 0, cost_of_id(ids), -jnp.inf)
+    slot = jnp.argmax(costs)
+    return jnp.where(jnp.any(view >= 0), view[slot], EMPTY)
+
+
 def remove(view: Array, nid: Array) -> Array:
     return jnp.where((view == nid) & (nid >= 0), EMPTY, view)
 

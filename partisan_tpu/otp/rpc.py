@@ -96,7 +96,9 @@ class RpcService:
             lambda i, a: jax.lax.switch(
                 i, [lambda x, _f=f: _f(x) for f in self.fns], a)))
         res = apply_all(fn_id, call_arg)
-        resp_dst = jnp.where(m_call, inb[..., T.W_SRC], -1)
+        # casts (ref 0 — erpc:cast) execute but get no reply
+        resp_dst = jnp.where(m_call & (call_ref > 0),
+                             inb[..., T.W_SRC], -1)
         resp = msg_ops.build(
             cfg.msg_words, T.MsgKind.RPC_RESPONSE, gids[:, None], resp_dst,
             channel=rpc_ch, payload=(res, call_ref))
@@ -124,7 +126,9 @@ class RpcService:
             cfg.msg_words, T.MsgKind.RPC_CALL, gids[:, None],
             jnp.where(fire, st.dst, -1), channel=rpc_ch,
             payload=(st.fn, st.arg, st.ref))
-        status = jnp.where(fire, WAITING, status)
+        # a fired cast slot (ref 0) frees immediately — nothing to await
+        status = jnp.where(fire, jnp.where(st.ref > 0, WAITING, IDLE),
+                           status)
 
         emitted = jnp.concatenate([resp, req], axis=1)
         return st._replace(status=status, result=result), emitted
@@ -153,6 +157,26 @@ class RpcService:
             result=st.result.at[caller, slot].set(0),
             next_ref=st.next_ref.at[caller].add(1),
         ), ref
+
+    def cast(self, st: RpcState, caller: int, dst: int, fn_id: int,
+             arg: int, now: int) -> RpcState:
+        """erpc:cast — execute remotely, no reply, no ref (the callee
+        applies the function for its side effects; partisan_erpc.erl
+        cast path)."""
+        import numpy as np
+
+        free = np.flatnonzero(np.asarray(st.status[caller]) == IDLE)
+        if free.size == 0:
+            raise RuntimeError(f"rpc call table full on node {caller}")
+        slot = int(free[0])
+        return st._replace(
+            status=st.status.at[caller, slot].set(QUEUED),
+            dst=st.dst.at[caller, slot].set(dst),
+            fn=st.fn.at[caller, slot].set(fn_id),
+            arg=st.arg.at[caller, slot].set(arg),
+            ref=st.ref.at[caller, slot].set(0),
+            deadline=st.deadline.at[caller, slot].set(0),
+        )
 
     def multicall(self, st: RpcState, caller: int, dsts: Sequence[int],
                   fn_id: int, arg: int, timeout_rounds: int, now: int

@@ -114,6 +114,62 @@ class ReliableBroadcastSystem:
 
 
 @dataclasses.dataclass
+class LinearizabilitySystem:
+    """prop_partisan_linearizability.erl over a single replicated
+    register (Alsberg-Day key 0): clients write distinct values, commands
+    are issued sequentially, and the property is that the final
+    replicated value is the value of the LAST acknowledged write — any
+    earlier value surfacing at the end would be a non-linearizable
+    history (a lost or reordered overwrite)."""
+
+    n_nodes: int = 5
+    seed: int = 0
+    name: str = "linearizability"
+
+    def __post_init__(self):
+        self.model = AlsbergDay(acked=True, keys=1)
+        self._next = 0
+
+    def build(self):
+        return _cached_build(self, lambda: Cluster(
+            Config(n_nodes=self.n_nodes, seed=self.seed,
+                   inbox_cap=max(48, 8 * self.n_nodes),
+                   emit_cap=16, ack_cap=32),
+            model=self.model))
+
+    def gen_command(self, rng: random.Random, cl, st) -> Command:
+        client = rng.randrange(1, self.n_nodes)
+        val = 1000 + self._next
+        self._next += 1
+        return Command(
+            name="write", args=(client, 0, val),
+            apply=lambda c, s, _c=client, _v=val: s._replace(
+                model=self.model.write(s.model, _c, 0, _v)))
+
+    def postcondition(self, cl, st, script) -> bool:
+        import numpy as np
+
+        alive = st.faults.alive
+        writes = [c.args for c in script if c.name == "write"]
+        if not writes:
+            return True
+        acked = [(cl_, v) for (cl_, _k, v) in writes
+                 if bool(alive[cl_]) and
+                 bool(self.model.acked_ok(st.model, cl_, 0))]
+        surviving = [(cl_, v) for (cl_, _k, v) in writes if bool(alive[cl_])]
+        if surviving and not acked:
+            return False                     # fault-free writes must ack
+        if not bool(self.model.replicated(st.model, 0, alive)):
+            return False
+        final = int(np.asarray(st.model.store)[0, 0])
+        # Sequential issue order => the last acked write must win.
+        return final == acked[-1][1] if acked else True
+
+    def settle_rounds(self) -> int:
+        return 15
+
+
+@dataclasses.dataclass
 class PrimaryBackupSystem:
     """prop_partisan_primary_backup.erl over the Alsberg-Day protocol:
     random clients write; the property is that every write is acked to

@@ -67,6 +67,8 @@ class MsgKind(enum.IntEnum):
     HPV_DISCONNECT = 15
     HPV_SHUFFLE = 16         # payload: [origin, k_slots...]; W_TTL = walk
     HPV_SHUFFLE_REPLY = 17   # payload: [origin, k_slots...] (same layout)
+    HPV_XBOT_OPT = 18        # payload: [old_peer] — X-BOT optimization ask
+    HPV_XBOT_OPT_REPLY = 19  # payload: [old_peer, accepted]
 
     # -- SCAMP (partisan_scamp_v1_membership_strategy.erl:67-297, v2)
     SCAMP_SUBSCRIPTION = 20       # forward_subscription; payload: [subscriber,

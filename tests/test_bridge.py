@@ -111,6 +111,25 @@ def test_bridge_protocol_session():
     assert br.handle((Atom("stop"),)) == etf.OK
 
 
+def test_bridge_sequenced_requests_and_drain_invariant():
+    br = Bridge()
+    # Sequenced form echoes the sequence number with the reply.
+    assert br.handle((7, (Atom("init"), {Atom("n_nodes"): 4}))) == \
+        (7, etf.OK)
+    assert br.handle((8, (Atom("set_self"), 2))) == (8, etf.OK)
+    for i in range(1, 4):
+        br.handle((Atom("join"), i, 0))
+    br.handle((Atom("step"), 10))
+    # Drain keeps the inbox invariant: count drops with removed records.
+    br.handle((Atom("forward_message"), 1, 3, [5]))
+    br.handle((Atom("step"), 1))
+    import numpy as np
+    pre = int(np.asarray(br.st.inbox.count)[3])
+    _, out = br.handle((Atom("drain"), 3))
+    post = int(np.asarray(br.st.inbox.count)[3])
+    assert len(out) == 1 and post == pre - 1
+
+
 # ---------------------------------------------------------------------------
 # Port transport (subprocess, the open_port analogue)
 # ---------------------------------------------------------------------------
@@ -123,13 +142,16 @@ def _rpc(proc, term):
 
 def test_port_server_subprocess():
     import os
+    from pathlib import Path
+
+    repo_root = str(Path(__file__).resolve().parents[1])
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PYTHONPATH", None)
-    env["PYTHONPATH"] = "/root/repo"
+    env["PYTHONPATH"] = repo_root
     proc = subprocess.Popen(
         [sys.executable, "-m", "partisan_tpu.bridge.server"],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
-        cwd="/root/repo")
+        cwd=repo_root)
     try:
         assert _rpc(proc, (Atom("init"), {Atom("n_nodes"): 4})) == etf.OK
         for i in range(1, 4):

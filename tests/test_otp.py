@@ -164,3 +164,18 @@ def test_remote_ref_formats():
     assert (node, proc) == (9, 123)
     with pytest.raises(ValueError):
         remote_ref.pack(0, 1 << 13)
+
+
+def test_rpc_cast_executes_without_reply():
+    cl, stack, st = build()
+    rpc = stack.models[0]
+    rs = rpc.cast(stack.sub(st.model, 0), caller=1, dst=4, fn_id=0,
+                  arg=5, now=int(st.rnd))
+    st = st._replace(model=stack.replace_sub(st.model, 0, rs))
+    st = cl.steps(st, 1)
+    # slot freed after emission; no response ever tracked
+    rs = stack.sub(st.model, 0)
+    assert int(rs.status[1].sum()) == 0
+    st = cl.steps(st, 4)
+    rs = stack.sub(st.model, 0)
+    assert int(rs.status[1].sum()) == 0

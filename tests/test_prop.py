@@ -107,3 +107,25 @@ def test_single_success_scheduler_stops_after_one_run():
     res = Harness(system=sys, scheduler="single_success", n_runs=10,
                   n_commands=2).run()
     assert res.ok and res.seed == 0 + 0  # stopped at the first seed
+
+
+def test_linearizability_system_passes_and_detects():
+    from partisan_tpu.prop_models import LinearizabilitySystem
+
+    sys = LinearizabilitySystem(seed=8)
+    res = Harness(system=sys, scheduler="default", n_runs=2,
+                  n_commands=4, seed=77).run()
+    assert res.ok, res.render()
+    # Detection: a final state whose register holds a non-last value
+    # must fail the property (simulate by checking the postcondition
+    # against a doctored script order).
+    cl, st = sys.build()
+    s1 = sys.gen_command(__import__("random").Random(1), cl, st)
+    s2 = sys.gen_command(__import__("random").Random(2), cl, st)
+    st = s1.apply(cl, st)
+    st = cl.steps(st, 15)
+    st = s2.apply(cl, st)
+    st = cl.steps(st, 15)
+    assert sys.postcondition(cl, st, [s1, s2])
+    assert not sys.postcondition(cl, st, [s2, s1]), \
+        "reordered history must violate linearizability"

@@ -1,0 +1,79 @@
+"""X-BOT overlay optimization + reserved-slot tests
+(partisan_hyparview_peer_service_manager.erl:1880-2050 optimization
+handshakes; reserved-slot admission)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.managers.hyparview import link_cost
+from tests.support import hv_config, boot_hyparview
+
+N = 24
+SEED = 6
+
+
+def _mean_active_cost(cl, st):
+    """Mean synthetic link cost over all active edges."""
+    act = np.asarray(cl.manager.neighbors(cl.cfg, st.manager))
+    total, cnt = 0.0, 0
+    for i, row in enumerate(act):
+        for j in row:
+            if j >= 0:
+                total += float(link_cost(SEED, jnp.int32(i), jnp.int32(j)))
+                cnt += 1
+    return total / max(cnt, 1)
+
+
+def test_xbot_lowers_mean_link_cost():
+    def build(xbot):
+        import dataclasses
+
+        cfg = hv_config(N, SEED)
+        cfg = cfg.replace(
+            hyparview=dataclasses.replace(cfg.hyparview, xbot=xbot))
+        cl = Cluster(cfg)
+        st = boot_hyparview(cl, settle=30)
+        return cl, cl.steps(st, 120)   # several xbot cycles (every 10)
+
+    cl0, st0 = build(False)
+    cl1, st1 = build(True)
+    c0, c1 = _mean_active_cost(cl0, st0), _mean_active_cost(cl1, st1)
+    assert c1 < c0, f"xbot did not improve overlay cost: {c1:.3g} vs {c0:.3g}"
+    # The optimized overlay stays connected.
+    from tests.support import components
+    act = np.asarray(cl1.manager.neighbors(cl1.cfg, st1.manager))
+    alive = np.asarray(st1.faults.alive)
+    assert len(components(act, alive)) == 1
+
+
+def test_reserved_slots_cap_ordinary_admission():
+    cfg = hv_config(12, 3)
+    cl = Cluster(cfg)
+    st = cl.init()
+    # Reserve all but two active slots on node 0 before anyone joins.
+    held = cfg.hyparview.active_max - 2
+    st = st._replace(manager=cl.manager.reserve(cfg, st.manager, 0, held))
+    m = st.manager
+    for i in range(1, 12):
+        m = cl.manager.join(cfg, m, i, 0)
+    st = st._replace(manager=m)
+    st = cl.steps(st, 40)
+    act0 = np.asarray(st.manager.active[0])
+    assert (act0 >= 0).sum() <= 2, f"reserved slots were filled: {act0}"
+    # The rest of the overlay still forms.
+    from tests.support import components
+    act = np.asarray(cl.manager.neighbors(cfg, st.manager))
+    assert len(components(act, np.ones(12, bool))) == 1
+
+
+def test_reserve_validation():
+    import pytest
+
+    cfg = hv_config(8, 1)
+    cl = Cluster(cfg)
+    st = cl.init()
+    with pytest.raises(ValueError):
+        cl.manager.reserve(cfg, st.manager, 0, cfg.hyparview.active_max + 1)
+    with pytest.raises(ValueError):
+        cl.manager.reserve(cfg, st.manager, 0, -1)
