@@ -100,11 +100,18 @@ class Checker:
     max_faults: int = 1
     max_executions: int = 200
     sched_width: int = 64   # >= emission width (OmissionSchedule clips)
+    # Optional causality-annotation pruning (analysis.reaction_graph):
+    # omissions of kinds that provably cannot affect any ``target_kinds``
+    # are skipped (the reference feeds partisan_analysis output into
+    # schedule_valid_causality the same way, filibuster_SUITE.erl:1023).
+    reaction: dict | None = None
+    target_kinds: tuple = ()
 
     def __post_init__(self) -> None:
         import numpy as np
 
         self._np = np
+        self._closure = None   # transitive closure of `reaction`, cached
         # Probe shape-free: build with a 1-round zero schedule to learn n
         # and the boot round, then rebuild the canonical-size schedule
         # state directly (same cluster/jit — only state is remade).
@@ -137,9 +144,20 @@ class Checker:
         return Execution(schedule=schedule, trace=tr,
                          passed=bool(self.assertion(self._cl, st)))
 
+    def _relevant_kind(self, kind_name: str) -> bool:
+        if self.reaction is None or not self.target_kinds:
+            return True
+        if self._closure is None:
+            from partisan_tpu import analysis
+
+            self._closure = analysis.closure(self.reaction)
+        reach = self._closure.get(kind_name, set())
+        return any(t == kind_name or t in reach for t in self.target_kinds)
+
     def _candidates(self, tr: trace_mod.Trace) -> list[Coord]:
         return [(e.rnd, e.src, e.slot) for e in tr.events()
-                if not e.dropped and self.candidate(e)]
+                if not e.dropped and self.candidate(e)
+                and self._relevant_kind(e.kind_name)]
 
     # ---- shrinking (counterexample-replay.sh / SHRINKING) --------------
     def _shrink(self, cex: Execution) -> Execution:

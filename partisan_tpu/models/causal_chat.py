@@ -1,0 +1,93 @@
+"""Causal broadcast workload (driver config #5's application layer).
+
+Mirrors the reference's causal-delivery usage (partisan_causality_backend
+driven through forward_message with a causal label — partisan_SUITE's
+`with_causal_labels`/`with_causal_send` groups): each sender emits
+causally-ordered broadcasts (one logical record, fanned to every node by
+the delivery layer's wide lanes), and receivers log delivery order; logs
+must respect happened-before.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+
+class ChatState(NamedTuple):
+    log: Array      # int32[n, LOG] — delivered tokens in arrival order
+    log_len: Array  # int32[n]
+    seq: Array      # int32[n] — next send sequence number
+    send_at: Array  # int32[n, SLOTS] — scripted send rounds (-1 empty)
+
+
+class CausalChat:
+    """Scripted causal broadcasts + delivery-order logging."""
+
+    name = "causal_chat"
+
+    def __init__(self, log_cap: int = 32, slots: int = 8) -> None:
+        self.LOG = log_cap
+        self.SLOTS = slots
+
+    def init(self, cfg: Config, comm: LocalComm) -> ChatState:
+        n = comm.n_local
+        return ChatState(
+            log=jnp.zeros((n, self.LOG), jnp.int32),
+            log_len=jnp.zeros((n,), jnp.int32),
+            seq=jnp.ones((n,), jnp.int32),
+            send_at=jnp.full((n, self.SLOTS), -1, jnp.int32),
+        )
+
+    def step(self, cfg: Config, comm: LocalComm, state: ChatState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[ChatState, Array]:
+        gids = comm.local_ids()
+        n = state.log.shape[0]
+
+        # Log arrived causal APP messages in inbox order (the delivery
+        # layer already enforced causal order).
+        inb = ctx.inbox.data
+        is_chat = (inb[..., T.W_KIND] == T.MsgKind.APP) & \
+                  (inb[..., T.W_FLAGS] & T.F_CAUSAL != 0)
+        tok = jnp.where(is_chat,
+                        inb[..., T.W_SRC] * 1000 + inb[..., T.P0], 0)
+        rank = jnp.cumsum(is_chat, axis=1) - 1
+        slot = jnp.where(is_chat, state.log_len[:, None] + rank, self.LOG)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], slot.shape)
+        log = state.log.at[rows, slot].set(tok, mode="drop")
+        log_len = state.log_len + is_chat.sum(axis=1, dtype=jnp.int32)
+
+        # Scripted sends: ONE causal record per logical broadcast (the
+        # delivery layer fans it to every node).
+        fire = (state.send_at == ctx.rnd).any(axis=1) & ctx.alive
+        dst = jnp.where(fire, gids, -1)
+        emitted = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], dst[:, None],
+            flags=T.F_CAUSAL, payload=(state.seq[:, None],))
+        seq = state.seq + fire.astype(jnp.int32)
+        return ChatState(log=log, log_len=log_len, seq=seq,
+                         send_at=state.send_at), emitted
+
+    # ---- scenario helpers --------------------------------------------
+    def schedule(self, state: ChatState, node: int, rnd: int) -> ChatState:
+        row = np.asarray(state.send_at[node])
+        free = int(np.argmax(row < 0))
+        if row[free] >= 0:
+            raise ValueError(f"no free send slot on node {node}")
+        return state._replace(send_at=state.send_at.at[node, free].set(rnd))
+
+    @staticmethod
+    def logs(state: ChatState) -> list[list[int]]:
+        logs = np.asarray(state.log)
+        lens = np.asarray(state.log_len)
+        return [list(map(int, logs[i, :lens[i]]))
+                for i in range(logs.shape[0])]

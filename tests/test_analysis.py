@@ -1,0 +1,54 @@
+"""Causality-analysis tests (reference src/partisan_analysis.erl +
+annotations/ files): reaction graphs, background classification,
+schedule-equivalence pruning."""
+
+from partisan_tpu import analysis, trace as trace_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.models.direct_mail import DirectMail
+from partisan_tpu.models.anti_entropy import AntiEntropy
+from tests.support import fm_config, boot_fullmesh
+
+N = 6
+
+
+def _trace(model_cls, acked=False, rounds=12, seed=9):
+    cfg = fm_config(N, seed=seed, ack_cap=8 if acked else 0)
+    model = model_cls(acked=acked) if model_cls is DirectMail else model_cls()
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    _, cap = cl.record(st, rounds)
+    return trace_mod.from_capture(cap)
+
+
+def test_acked_mail_reaction_graph_has_app_to_ack():
+    tr = _trace(DirectMail, acked=True)
+    g = analysis.reaction_graph(tr)
+    # Receiving an acked APP mail causes an ACK emission.
+    assert "ACK" in g.get("APP", set()), g
+
+
+def test_background_vs_reactive_classification():
+    tr = _trace(AntiEntropy)
+    bg = analysis.background_kinds(tr)
+    # Anti-entropy pushes are timer-driven: APP appears as background.
+    assert "APP" in bg
+
+
+def test_closure_and_prunable():
+    g = {"A": {"B"}, "B": {"C"}, "D": set()}
+    c = analysis.closure(g)
+    assert c["A"] == {"B", "C"}
+    assert not analysis.prunable(g, "A", "C")   # A can reach C
+    assert analysis.prunable(g, "D", "C")       # D cannot
+    assert not analysis.prunable(g, "C", "C")   # same kind never pruned
+
+
+def test_annotations_roundtrip(tmp_path):
+    tr = _trace(DirectMail, acked=True)
+    p = tmp_path / "partisan-annotations-direct_mail.json"
+    analysis.save_annotations(tr, p, protocol="demers_direct_mail_acked")
+    doc = analysis.load_annotations(p)
+    assert "APP" in doc["causality"]
+    assert isinstance(doc["causality"]["APP"], set)
+    assert isinstance(doc["background"], set)

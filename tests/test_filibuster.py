@@ -74,3 +74,41 @@ def test_iter_schedules_enumeration():
     assert frozenset({(0, 1, 0), (1, 1, 1)}) in scheds
     assert all(len(s) <= 2 for s in scheds)
     assert len(scheds) == 3 + 3
+
+
+def test_annotation_pruning_reduces_candidates():
+    """Causality annotations prune omission candidates that cannot affect
+    the target kind (the partisan_analysis -> schedule_valid_causality
+    pipeline)."""
+    from partisan_tpu import analysis
+
+    model, build = _build_fn(acked=True)
+    # Record a golden run to derive the reaction graph.
+    cl, st = build(None)
+    _, cap = cl.record(st, HORIZON)
+    from partisan_tpu import trace as trace_mod
+    tr = trace_mod.from_capture(cap)
+    g = analysis.reaction_graph(tr)
+
+    # Ack-retransmission implication: losing an ACK re-triggers APP
+    # retransmission, so ACK must NOT be prunable against target APP
+    # (the unsound-pruning regression).
+    assert "APP" in g.get("ACK", set())
+
+    def any_kind(ev):
+        return ev.kind_name in ("APP", "ACK", "PING", "PONG")
+
+    pruned = filibuster.Checker(
+        build=build, horizon=HORIZON, assertion=_assertion(model),
+        candidate=any_kind, max_faults=1, max_executions=5,
+        reaction=g, target_kinds=("APP",))
+    base_p = pruned._execute(frozenset())
+    cp = pruned._candidates(base_p.trace)
+    kinds_kept = {e.kind_name for e in base_p.trace.events()
+                  if (e.rnd, e.src, e.slot) in set(cp)}
+    assert "APP" in kinds_kept and "ACK" in kinds_kept
+    # Pruning logic itself: a kind with no path to the target is skipped.
+    pruned.reaction = {"PONG": set(), **g}
+    pruned._closure = None
+    assert not pruned._relevant_kind("PONG")
+    assert pruned._relevant_kind("ACK") and pruned._relevant_kind("APP")

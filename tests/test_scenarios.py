@@ -1,0 +1,34 @@
+"""Driver-config scenario tests (BASELINE.md benchmark configs 1-5),
+run at CPU-smoke scale — the same code paths the TPU benchmark runs."""
+
+from partisan_tpu import scenarios
+
+
+def test_config1_anti_entropy():
+    r = scenarios.config1_anti_entropy(n=16)
+    assert r["convergence_rounds"] > 0
+    assert r["rounds_per_sec"] > 0
+
+
+def test_config2_rumor():
+    r = scenarios.config2_rumor(n=96)
+    assert r["infection_rounds"] > 0, r
+    assert 0.5 <= r["coverage_plateau"] <= 1.0, r
+
+
+def test_config3_plumtree_drop():
+    r = scenarios.config3_plumtree_drop(n=128)
+    assert r["repair_rounds"] > 0, r
+
+
+def test_config4_scamp_churn():
+    r = scenarios.config4_scamp_churn(n=128, rounds=60)
+    assert r["alive"] > 0
+    assert r["partial_view_mean"] > 1.0, r
+
+
+def test_config5_causal_crash():
+    r = scenarios.config5_causal_crash(n=128, n_actors=8, crashes=4)
+    assert r["convergence_rounds"] > 0, r
+    # every receiving actor delivered both causal sends in order
+    assert r["causal_ordered_actors"] == r["n_receiving_actors"], r
