@@ -60,14 +60,16 @@ def run(n: int, verbose: bool = False) -> dict:
     st = st._replace(model=model.broadcast(st.model, 0, 0, int(st.rnd)))
     st, conv = cl.run_until(
         st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
-        max_rounds=200, check_every=10)
+        max_rounds=max(300, 2 * int(np.log2(n)) * 20), check_every=10)
     if conv < 0:
         raise AssertionError(f"n={n}: plumtree broadcast did not converge")
 
     # Steady-state throughput: k rounds as one compiled lax.scan program
     # (k large enough to sit well above dispatch/timer noise — a round
-    # runs in tens of microseconds).
-    k = 500
+    # runs in tens of microseconds).  k=250, not more: 500-iteration
+    # scans of this body reproducibly trip a TPU kernel fault on
+    # converged-overlay state (XLA/runtime issue; 250 is reliable).
+    k = 250
     st = cl.steps(st, k)           # warm the k-specialized program
     jax.block_until_ready(st)
     best = float("inf")
@@ -91,11 +93,23 @@ def main() -> None:
     for n in (4_096, 8_192, 32_768, 100_000):
         if result is not None and time.time() - t_start > TIME_BUDGET_S / 2:
             break
-        try:
-            result = run(n, verbose=True)
-        except Exception as e:  # OOM / compile limits: keep prior size
-            print(f"n={n} failed: {type(e).__name__}: {e}", file=sys.stderr)
-            break
+        ok = False
+        for attempt in (1, 2):
+            try:
+                result = run(n, verbose=True)
+                ok = True
+                break
+            except Exception as e:
+                print(f"n={n} attempt {attempt} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                # Retry only transient device/tunnel drops; deterministic
+                # failures (OOM, compile limits) won't pass a second time.
+                transient = "RuntimeError" in type(e).__name__ \
+                    and "UNAVAILABLE" in str(e)
+                if not transient or time.time() - t_start > TIME_BUDGET_S:
+                    break
+        if not ok:
+            break                # keep the prior size's result
     if result is None:
         raise SystemExit("bench failed at every size")
     print(json.dumps({

@@ -73,7 +73,7 @@ class Bridge:
 
         if cmd == "set_self":
             # Multi-VM deployments give each Erlang node its own sim id;
-            # replies to `drain` then cover that node's deliveries.
+            # an argument-less {drain} then drains THIS node's inbox.
             self.self_id = int(args[0])
             return OK
 
@@ -126,7 +126,7 @@ class Bridge:
                 self._pending = []
             return (OK, int(self.st.rnd))
         if cmd == "drain":
-            node = int(args[0])
+            node = int(args[0]) if args else self.self_id
             data = np.asarray(self.st.inbox.data[node])
             out = []
             keep = data.copy()

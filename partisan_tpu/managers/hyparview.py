@@ -300,32 +300,41 @@ class HyParView:
                     have_room = views.size(a) < acap
                     better = my_cost(jnp.maximum(i, 0)) < \
                         my_cost(jnp.maximum(z, 0))
-                    accept = (i >= 0) & ~views.contains(a, i) \
+                    want = (i >= 0) & ~views.contains(a, i) & (acap > 0) \
                         & (have_room | ((z >= 0) & better))
-                    evict = accept & ~have_room
+                    evict = want & ~have_room
                     a2 = jnp.where(evict, views.remove(a, z), a)
-                    a3, _ = views.add_cap(a2, jnp.where(accept, i, -1),
+                    a3, _ = views.add_cap(a2, jnp.where(want, i, -1),
                                           k1, acap)
-                    p2 = jnp.where(evict,
+                    # accepted only if the edge was ACTUALLY admitted —
+                    # claiming acceptance without it would hand the
+                    # initiator a one-way link (same gating as b_neighbor)
+                    accept = want & views.contains(a3, i)
+                    p2 = jnp.where(evict & accept,
                                    views.merge_sample(p, z[None], me, k2), p)
                     r0 = mk(T.MsgKind.HPV_XBOT_OPT_REPLY, i,
                             payload=(o, accept.astype(jnp.int32)))
-                    r1 = jnp.where(evict & (z >= 0),
+                    r1 = jnp.where(evict & accept & (z >= 0),
                                    mk(T.MsgKind.HPV_DISCONNECT, z), nomsg)
                     return a3, p2, fj, r0, r1
 
                 def b_xbot_reply(a, p, fj):
-                    # initiator side: on accept, swap old worst peer for
-                    # the (closer) candidate
+                    # initiator side: the candidate has ALREADY committed
+                    # the edge on accept, so reciprocate unconditionally
+                    # (even if the old peer o meanwhile left this view —
+                    # otherwise the candidate keeps a permanent one-way
+                    # edge); swap out o only if still present
                     o = msg[T.P0]
-                    ok = (msg[T.P1] == 1) & views.contains(a, o)
-                    c = src
-                    a2 = jnp.where(ok, views.remove(a, o), a)
-                    a3, _ = views.add_cap(a2, jnp.where(ok, c, -1), k1, acap)
-                    p2 = jnp.where(ok,
+                    ok = msg[T.P1] == 1
+                    swap = ok & views.contains(a, o)
+                    a2 = jnp.where(swap, views.remove(a, o), a)
+                    a3, ev = views.add_cap(a2, jnp.where(ok, src, -1),
+                                           k1, acap)
+                    p2 = jnp.where(swap,
                                    views.merge_sample(p, o[None], me, k2), p)
-                    r0 = jnp.where(ok & (o >= 0),
-                                   mk(T.MsgKind.HPV_DISCONNECT, o), nomsg)
+                    r0 = jnp.where(swap & (o >= 0),
+                                   mk(T.MsgKind.HPV_DISCONNECT, o),
+                                   mk(T.MsgKind.HPV_DISCONNECT, ev))
                     return a3, p2, fj, r0, nomsg
 
                 branches = [b_join, b_forward_join, b_neighbor, b_accepted,
@@ -396,7 +405,7 @@ class HyParView:
                 cand = views.pick_one(passive, rng.subkey(xkey, 1),
                                       exclude=active)
                 x_fire = ((ctx.rnd + me) % cfg.xbot_every == 0) \
-                    & (views.size(active) >= acap) \
+                    & (views.size(active) >= acap) & (acap > 0) \
                     & (cand >= 0) & (o_worst >= 0) \
                     & (my_cost(jnp.maximum(cand, 0))
                        < my_cost(jnp.maximum(o_worst, 0)))

@@ -380,5 +380,13 @@ class Scamp:
         return state._replace(
             join_target=state.join_target.at[node].set(target))
 
+    def join_many(self, cfg: Config, state: ScampState, nodes,
+                  targets) -> ScampState:
+        """Batched scripted joins (one scatter — 10k+-node bootstrap)."""
+        nodes = jnp.asarray(nodes, jnp.int32)
+        targets = jnp.asarray(targets, jnp.int32)
+        return state._replace(
+            join_target=state.join_target.at[nodes].set(targets))
+
     def leave(self, cfg: Config, state: ScampState, node: int) -> ScampState:
         return state._replace(leaving=state.leaving.at[node].set(True))

@@ -60,6 +60,8 @@ def route(emitted: Array, n: int, cap: int, *, node_offset: int | Array = 0) -> 
     node range).
     """
     flat = emitted.reshape(-1, emitted.shape[-1])
+    if flat.shape[0] == 0:   # a manager with no event lane (state-gossip only)
+        return empty_inbox(n, cap, emitted.shape[-1])
     kind = flat[:, W_KIND]
     dst = flat[:, W_DST] - node_offset
     # Empty slots and out-of-range destinations -> sentinel bucket n.
@@ -68,16 +70,23 @@ def route(emitted: Array, n: int, cap: int, *, node_offset: int | Array = 0) -> 
 
     order = jnp.argsort(dst, stable=True)
     dst_sorted = dst[order]
-    msgs_sorted = flat[order]
 
-    counts = jnp.bincount(dst, length=n + 1)              # int32[n+1]
-    starts = jnp.cumsum(counts) - counts                  # first flat index per dst
-    slot = jnp.arange(dst.shape[0], dtype=jnp.int32) - starts[dst_sorted]
-
-    # Out-of-bounds (slot >= cap, or sentinel dst) => dropped by scatter.
-    row = jnp.where(dst_sorted < n, dst_sorted, n + cap)
-    data = jnp.zeros((n, cap, flat.shape[-1]), jnp.int32)
-    data = data.at[row, slot].set(msgs_sorted, mode="drop")
+    # Per-destination counts/starts via binary search on the sorted keys
+    # (bincount is a scatter-add — same TPU scatter penalty as below).
+    bounds = jnp.searchsorted(dst_sorted, jnp.arange(n + 2, dtype=dst.dtype))
+    counts = (bounds[1:] - bounds[:-1]).astype(jnp.int32)  # [n+1]
+    starts = bounds[:-1].astype(jnp.int32)                 # [n+1]
+    # GATHER the inbox rows out of the sorted order instead of scattering
+    # messages in: TPU scatter runtime degrades badly with real (dense,
+    # colliding) index traffic, while this gather is uniform — measured
+    # >100x on active 4k-node overlays.  inbox[d, s] = sorted[starts[d]+s]
+    # for s < counts[d].
+    cap_idx = jnp.arange(cap, dtype=jnp.int32)
+    src_pos = starts[:n, None] + cap_idx[None, :]          # [n, cap]
+    valid = cap_idx[None, :] < counts[:n, None]
+    src_pos = jnp.clip(src_pos, 0, dst.shape[0] - 1)
+    take = order[src_pos]                                  # flat msg index
+    data = jnp.where(valid[..., None], flat[take], 0)
 
     delivered = jnp.minimum(counts[:n], cap)
     return Inbox(data=data, count=delivered, drops=counts[:n] - delivered)
@@ -92,14 +101,16 @@ def merge_inboxes(a: Inbox, b: Inbox) -> Inbox:
     both = jnp.concatenate(
         [a.data, b.data], axis=1
     )  # [n, cap + bcap, w] — a's slots first
-    m = both.shape[1]
-    # Re-route through the same compaction: positions keep relative order.
-    kind = both[:, :, W_KIND]
-    valid = kind != 0
-    slot = jnp.cumsum(valid, axis=1) - 1
-    slot = jnp.where(valid, slot, m)  # invalid -> dropped (>= cap)
-    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
-    data = jnp.zeros_like(a.data).at[rows, slot].set(both, mode="drop")
+    # Gather-based compaction (see route() on TPU scatter cost): stable
+    # argsort floats valid slots to the front preserving relative order.
+    valid = both[:, :, W_KIND] != 0
+    order = jnp.argsort(~valid, axis=1, stable=True)       # [n, m]
+    take = order[:, :cap]
+    rows = jnp.arange(n)[:, None]
+    vcount = valid.sum(axis=1, dtype=jnp.int32)
+    keep = jnp.arange(cap, dtype=jnp.int32)[None, :] < \
+        jnp.minimum(vcount, cap)[:, None]
+    data = jnp.where(keep[..., None], both[rows, take], 0)
     total = a.count + b.count
     delivered = jnp.minimum(total, cap)
     return Inbox(
