@@ -28,7 +28,8 @@ import numpy as np
 jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-TIME_BUDGET_S = 480.0
+TIME_BUDGET_S = 400.0          # hard self-imposed wall budget
+PER_SIZE_CAP_S = 280.0         # no single rung may eat the whole budget
 
 
 def run(n: int, verbose: bool = False) -> dict:
@@ -85,31 +86,54 @@ def run(n: int, verbose: bool = False) -> dict:
     return {"n": n, "rounds_per_sec": rps, "converged_round": conv}
 
 
+def _run_one_subprocess(n: int, timeout_s: float) -> dict | None:
+    """Run one ladder size in a FRESH interpreter: a TPU device error
+    poisons the process context, so in-process retries always fail —
+    subprocess isolation makes each attempt independent."""
+    import subprocess
+
+    cmd = [sys.executable, __file__, "--one", str(n)]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"n={n}: timed out after {timeout_s:.0f}s", file=sys.stderr)
+        return None
+    sys.stderr.write(out.stderr[-2000:])
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+            if isinstance(d, dict) and "rounds_per_sec" in d:
+                return d
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
 def main() -> None:
     # Size ladder, small -> large: always secure a result, then climb
     # while the time budget lasts (compile time grows steeply with n).
     t_start = time.time()
     result = None
-    for n in (4_096, 8_192, 32_768, 100_000):
-        if result is not None and time.time() - t_start > TIME_BUDGET_S / 2:
+    for n in (1_024, 4_096, 8_192, 32_768, 100_000):
+        elapsed = time.time() - t_start
+        if result is not None and elapsed > TIME_BUDGET_S / 2:
             break
-        ok = False
-        for attempt in (1, 2):
-            try:
-                result = run(n, verbose=True)
-                ok = True
+        got = None
+        attempts = 1 if elapsed > TIME_BUDGET_S * 0.4 else 2
+        for attempt in range(1, attempts + 1):
+            remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
+            if remaining < 60 and result is not None:
                 break
-            except Exception as e:
-                print(f"n={n} attempt {attempt} failed: "
-                      f"{type(e).__name__}: {e}", file=sys.stderr)
-                # Retry only transient device/tunnel drops; deterministic
-                # failures (OOM, compile limits) won't pass a second time.
-                transient = "RuntimeError" in type(e).__name__ \
-                    and "UNAVAILABLE" in str(e)
-                if not transient or time.time() - t_start > TIME_BUDGET_S:
-                    break
-        if not ok:
+            got = _run_one_subprocess(
+                n, timeout_s=max(60.0, min(PER_SIZE_CAP_S, remaining)))
+            if got is not None:
+                break
+            print(f"n={n} attempt {attempt} produced no result",
+                  file=sys.stderr)
+        if got is None:
             break                # keep the prior size's result
+        result = got
     if result is None:
         raise SystemExit("bench failed at every size")
     print(json.dumps({
@@ -123,4 +147,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        print(json.dumps(run(int(sys.argv[2]), verbose=True)))
+    else:
+        main()

@@ -17,10 +17,18 @@ src/partisan_peer_service.erl):
 - :mod:`partisan_tpu.config` — configuration (partisan_config.erl)
 - :mod:`partisan_tpu.cluster` — cluster construction + round stepping
 - :mod:`partisan_tpu.managers` — peer-service managers (overlays)
-- :mod:`partisan_tpu.broadcast` — plumtree / causality / ack backends
-- :mod:`partisan_tpu.models` — protocol workload corpus (protocols/*.erl)
-- :mod:`partisan_tpu.faults` — interposition + fault injection
-- :mod:`partisan_tpu.trace` — trace record / deterministic replay
+- :mod:`partisan_tpu.models` — protocol corpus (protocols/*.erl) incl.
+  plumtree broadcast; :mod:`partisan_tpu.delivery` — ack + causal lanes
+- :mod:`partisan_tpu.faults` / :mod:`partisan_tpu.interpose` — fault
+  injection + interposition hooks
+- :mod:`partisan_tpu.trace` / :mod:`partisan_tpu.filibuster` /
+  :mod:`partisan_tpu.prop` / :mod:`partisan_tpu.analysis` — test plane
+- :mod:`partisan_tpu.otp` — RPC, monitors, remote refs
+- :mod:`partisan_tpu.checkpoint` / :mod:`partisan_tpu.telemetry` /
+  :mod:`partisan_tpu.discovery` / :mod:`partisan_tpu.orchestration`
+- :mod:`partisan_tpu.parallel` — shard_map multi-device execution
+- :mod:`partisan_tpu.bridge` — Erlang port bridge (ETF + server)
+- :mod:`partisan_tpu.scenarios` — the five driver benchmark configs
 """
 
 from partisan_tpu.config import Config, ChannelSpec  # noqa: F401

@@ -120,18 +120,33 @@ def pick_one(view: Array, key: Array, exclude: Array | None = None) -> Array:
     return sample(view, key, 1, exclude)[0]
 
 
+import os
+
+_BATCHED_MERGE = os.environ.get("PARTISAN_TPU_BATCHED_MERGE", "") == "1"
+
+
 def merge_sample(view: Array, new_ids: Array, self_id: Array,
                  key: Array) -> Array:
     """Integrate a shuffle sample into a (passive) view: add each id not
     already present / not self, evicting random entries when full
     (merge_exchange, partisan_hyparview_peer_service_manager.erl:2569).
 
-    Single-shot batched merge (the sequential per-id add/evict loop cost
-    ~7 scan iterations × rng × top_k per call on the manager's hot
-    path): dedupe the candidate pool, then keep K by gumbel score with
-    incoming ids prioritized — identical to sequential insertion while
-    slots remain (the common case), random-eviction-equivalent when
-    full."""
+    Default: the sequential per-id add/evict loop.  A single-shot
+    batched variant (dedupe + prioritized gumbel top-k; identical while
+    slots remain, random-eviction-equivalent when full) exists behind
+    ``PARTISAN_TPU_BATCHED_MERGE=1`` but is NOT the default because the
+    program it produces reproducibly trips a TPU kernel fault at
+    4k-node widths on the current toolchain (works on CPU)."""
+    if not _BATCHED_MERGE:
+        def body(v, x):
+            nid, k = x
+            ok = (nid >= 0) & (nid != self_id)
+            v2, _ = add(v, jnp.where(ok, nid, EMPTY), k)
+            return v2, None
+
+        keys = jax.random.split(key, new_ids.shape[0])
+        out, _ = jax.lax.scan(body, view, (new_ids, keys))
+        return out
     k = view.shape[0]
     m = new_ids.shape[0]
     ok_new = (new_ids >= 0) & (new_ids != self_id) \

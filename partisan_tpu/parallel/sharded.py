@@ -205,7 +205,13 @@ class ShardedCluster:
         return round_body(self.cfg, self.manager, self.model, self.comm,
                           state, interpose=self.interpose)
 
+    def _round_shard_traced(self, state: ClusterState):
+        return round_body(self.cfg, self.manager, self.model, self.comm,
+                          state, interpose=self.interpose, capture=True)
+
     def _build(self, state: ClusterState) -> None:
+        from partisan_tpu.cluster import TraceRound
+
         specs = self._state_specs(state)
         body = jax.shard_map(
             self._round_shard, mesh=self.mesh,
@@ -217,6 +223,26 @@ class ShardedCluster:
             lambda s, k: jax.lax.scan(
                 lambda c, _: (body(c), None), s, None, length=k)[0],
             static_argnums=1)
+        trace_specs = TraceRound(rnd=P(), sent=P(AXIS), dropped=P(AXIS))
+        tbody = jax.shard_map(
+            self._round_shard_traced, mesh=self.mesh,
+            in_specs=(specs,), out_specs=(specs, trace_specs),
+            check_vma=False,
+        )
+        self._record = jax.jit(
+            lambda s, k: jax.lax.scan(
+                lambda c, _: tbody(c), s, None, length=k),
+            static_argnums=1)
+
+    # ---- trace recording (Cluster.record parity) ----------------------
+    def record(self, state: ClusterState, k: int):
+        """Run k sharded rounds capturing the send-path trace — the same
+        TraceRound stream as the single-device ``Cluster.record`` (node
+        axis gathered across shards), so recorded traces are
+        placement-invariant."""
+        if self._step is None:
+            self._build(state)
+        return self._record(state, k)
 
     # ---- public API ---------------------------------------------------
     def step(self, state: ClusterState) -> ClusterState:

@@ -85,3 +85,40 @@ def test_mesh_size_invariance(mesh8):
 def test_indivisible_nodes_rejected(mesh8):
     with pytest.raises(ValueError, match="not divisible"):
         ShardedCluster(Config(n_nodes=12), mesh8)
+
+
+def test_sharded_trace_matches_local():
+    """Trace recording is placement-invariant: the sharded cluster's
+    TraceRound stream equals the single-device one (determinism across
+    shardings — the replay guarantee extends to multi-device)."""
+    import numpy as np
+
+    from partisan_tpu import trace as trace_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config
+    from partisan_tpu.models.anti_entropy import AntiEntropy
+    from partisan_tpu.parallel import ShardedCluster, make_mesh
+
+    cfg = Config(n_nodes=16, seed=12, inbox_cap=32)
+
+    def boot(cl):
+        st = cl.init()
+        m = st.manager
+        for i in range(1, cfg.n_nodes):
+            m = cl.manager.join(cfg, m, i, 0)
+        st = st._replace(manager=m)
+        st = cl.steps(st, 10)
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0))
+        return st
+
+    local = Cluster(cfg, model=AntiEntropy())
+    _, cap_l = local.record(boot(local), 6)
+
+    sharded = ShardedCluster(cfg, make_mesh(4), model=AntiEntropy())
+    _, cap_s = sharded.record(boot(sharded), 6)
+
+    tl = trace_mod.from_capture(cap_l)
+    ts = trace_mod.from_capture(cap_s)
+    assert np.array_equal(tl.sent, ts.sent)
+    assert np.array_equal(tl.dropped, ts.dropped)
+    assert tl.matches(ts)
