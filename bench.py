@@ -96,8 +96,12 @@ def _run_one_subprocess(n: int, timeout_s: float) -> dict | None:
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         print(f"n={n}: timed out after {timeout_s:.0f}s", file=sys.stderr)
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                text = stream.decode() if isinstance(stream, bytes) else stream
+                sys.stderr.write(text[-2000:])
         return None
     sys.stderr.write(out.stderr[-2000:])
     for line in reversed(out.stdout.strip().splitlines()):

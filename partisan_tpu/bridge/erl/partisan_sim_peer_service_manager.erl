@@ -61,10 +61,14 @@
 
 %% NOTE multi-VM deployments: every participating Erlang node must talk
 %% to ONE shared simulator (each setting its own id via {set_self, Id}
-%% and draining its own deliveries).  The stdio port transport below is
-%% the single-VM harness; sharing across VMs routes the same protocol
-%% over a TCP socket to one bridge server instead (planned transport —
-%% the request/reply protocol is transport-agnostic and sequenced).
+%% and draining its own deliveries with argument-less {drain}).  The
+%% stdio port transport below is the single-VM harness; for multi-VM,
+%% run `python -m partisan_tpu.bridge.socket_server --port P` once and
+%% replace open_port with
+%%   gen_tcp:connect(Host, P, [{packet, 4}, binary, {active, false}])
+%% + gen_tcp:send / {tcp, _, Bin} receives — the sequenced request/reply
+%% protocol is identical on both transports
+%% (partisan_tpu/bridge/socket_server.py).
 
 -record(state, {port        :: port(),
                 seq = 0     :: non_neg_integer(),

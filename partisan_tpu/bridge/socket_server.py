@@ -1,0 +1,158 @@
+"""Multi-VM bridge transport: one shared simulator over TCP.
+
+The stdio port server (server.py) binds one Erlang VM to one simulator.
+The reference's test rig boots N BEAM nodes on one host
+(test/partisan_support.erl:46+); for the bridge equivalent, every node's
+``partisan_sim_peer_service_manager`` connects to ONE simulator so they
+share the cluster: this module serves the same sequenced ETF
+request/reply protocol over TCP, {packet,4}-framed — the Erlang side
+swaps ``open_port`` for ``gen_tcp:connect(..., [{packet, 4}, binary])``
+and everything else is unchanged.
+
+Concurrency model: one OS thread per client connection, a single lock
+around the shared :class:`~partisan_tpu.bridge.server.Bridge` (behaviour
+calls are cheap; ``step`` advances the one true cluster, so serialized
+execution IS the semantics — the reference's trace orchestrator
+serializes the same way).  Per-connection ``set_self`` scoping is
+honored by binding each connection's argument-less ``drain`` to its own
+node id.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from partisan_tpu.bridge.etf import Atom, decode, encode
+
+
+class BridgeSocketServer:
+    """Serve a shared Bridge on a TCP port (localhost test rigs)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        from partisan_tpu.bridge.server import Bridge
+
+        self.bridge = Bridge()
+        self._lock = threading.Lock()
+        self._sock = socket.create_server((host, port))
+        self.host, self.port = self._sock.getsockname()
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._accept_thread: threading.Thread | None = None
+        self._stopping = threading.Event()
+
+    # ---- lifecycle ----------------------------------------------------
+    def serve_background(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def close(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # Unblock client threads parked in recv() before joining them.
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # ---- internals ----------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        conn_self_id = [0]   # per-connection set_self scoping
+        try:
+            while True:
+                head = self._recv_exact(conn, 4)
+                if head is None:
+                    return
+                (ln,) = struct.unpack(">I", head)
+                payload = self._recv_exact(conn, ln)
+                if payload is None:
+                    return
+                req = decode(payload)
+                reply = self._dispatch(req, conn_self_id)
+                out = encode(reply)
+                conn.sendall(struct.pack(">I", len(out)) + out)
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+    def _dispatch(self, req, conn_self_id):
+        seq = None
+        inner = req
+        if (isinstance(req, tuple) and len(req) == 2
+                and isinstance(req[0], int)
+                and not isinstance(req[0], bool)
+                and isinstance(req[1], tuple)):
+            seq, inner = req
+        with self._lock:
+            # connection-scoped set_self / drain-default
+            if (isinstance(inner, tuple) and inner
+                    and isinstance(inner[0], Atom)):
+                cmd = str(inner[0])
+                if cmd == "set_self":
+                    conn_self_id[0] = int(inner[1])
+                elif cmd == "drain" and len(inner) == 1:
+                    inner = (inner[0], conn_self_id[0])
+            prev = self.bridge.self_id
+            self.bridge.self_id = conn_self_id[0]
+            try:
+                reply = self.bridge.handle(inner)
+            finally:
+                self.bridge.self_id = prev
+        return (seq, reply) if seq is not None else reply
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+def main() -> None:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    srv = BridgeSocketServer(args.host, args.port)
+    print(f"listening on {srv.host}:{srv.port}", flush=True)
+    srv.serve_background()
+    try:
+        srv._accept_thread.join()
+    except KeyboardInterrupt:
+        srv.close()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,11 +12,16 @@ All ops are pure and per-node (1-D); batch with jax.vmap.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import Array
 
 EMPTY = -1
+
+# merge_sample variant toggle (see its docstring)
+_BATCHED_MERGE = os.environ.get("PARTISAN_TPU_BATCHED_MERGE", "") == "1"
 
 
 def empty(k: int) -> Array:
@@ -118,11 +123,6 @@ def sample(view: Array, key: Array, k: int, exclude: Array | None = None) -> Arr
 def pick_one(view: Array, key: Array, exclude: Array | None = None) -> Array:
     """One random member (or -1)."""
     return sample(view, key, 1, exclude)[0]
-
-
-import os
-
-_BATCHED_MERGE = os.environ.get("PARTISAN_TPU_BATCHED_MERGE", "") == "1"
 
 
 def merge_sample(view: Array, new_ids: Array, self_id: Array,

@@ -165,3 +165,60 @@ def test_port_server_subprocess():
         assert proc.returncode == 0
     finally:
         proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# Multi-VM socket transport (one shared simulator, N clients)
+# ---------------------------------------------------------------------------
+
+def _sock_recv(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("bridge socket closed")
+        buf += chunk
+    return buf
+
+
+def _sock_rpc(sock, term):
+    payload = etf.encode(term)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    (n,) = struct.unpack(">I", _sock_recv(sock, 4))
+    return etf.decode(_sock_recv(sock, n))
+
+
+def test_socket_server_shares_one_cluster_between_clients():
+    import socket
+
+    from partisan_tpu.bridge.socket_server import BridgeSocketServer
+
+    srv = BridgeSocketServer()
+    srv.serve_background()
+    try:
+        a = socket.create_connection((srv.host, srv.port))
+        b = socket.create_connection((srv.host, srv.port))
+        assert _sock_rpc(a, (Atom("init"), {Atom("n_nodes"): 4})) == etf.OK
+        # each VM claims its own sim id
+        assert _sock_rpc(a, (Atom("set_self"), 0)) == etf.OK
+        assert _sock_rpc(b, (Atom("set_self"), 1)) == etf.OK
+        for i in range(1, 4):
+            assert _sock_rpc(a, (Atom("join"), i, 0)) == etf.OK
+        ok, rnd = _sock_rpc(a, (Atom("step"), 25))   # joins + gossip period
+        assert ok == etf.OK and rnd == 25
+        # b sees the SAME cluster a built
+        ok, members = _sock_rpc(b, (Atom("members"), 1))
+        assert set(members) == set(range(4))
+        # a forwards to b's node; b drains it with the argument-less form
+        assert _sock_rpc(a, (Atom("forward_message"), 0, 1, [77])) == etf.OK
+        _sock_rpc(a, (Atom("step"), 1))
+        ok, got = _sock_rpc(b, (Atom("drain"),))
+        assert ok == etf.OK and len(got) == 1
+        src, words = got[0]
+        assert src == 0 and words[0] == 77
+        # sequenced form works over the socket too
+        assert _sock_rpc(b, (5, (Atom("stats"),)))[0] == 5
+        a.close()
+        b.close()
+    finally:
+        srv.close()
