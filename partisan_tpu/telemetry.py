@@ -96,3 +96,29 @@ def emit_channels_configured(bus: Bus, cfg) -> None:
         bus.execute(CHANNEL_CONFIGURED,
                     {"parallelism": ch.parallelism},
                     {"channel": ch.name, "monotonic": ch.monotonic})
+
+
+def connection_counts(cluster, state) -> dict:
+    """Connection introspection (partisan_peer_service:connections/0,
+    partisan_peer_connections:count/0-3 —
+    partisan_peer_connections.erl:107-110).  The sim's "connections" are
+    the overlay's live out-edges; per-channel counts scale each edge by
+    the channel's parallelism, mirroring conn-per-(edge × channel ×
+    lane) accounting."""
+    nbrs = np.asarray(cluster.manager.neighbors(
+        cluster.cfg, state.manager))
+    alive = np.asarray(state.faults.alive)
+    # An edge is live only if BOTH endpoints are (a crashed peer's
+    # socket is gone — the conn-count-to-zero node-down signal,
+    # reference :1489-1535).
+    live_edge = (nbrs >= 0) & alive[:, None] & alive[np.clip(nbrs, 0, None)]
+    per_node = live_edge.sum(axis=1)
+    total_edges = int(per_node.sum())
+    lanes = sum(c.parallelism for c in cluster.cfg.channels)
+    return {
+        "per_node": per_node.astype(int).tolist(),
+        "total_edges": total_edges,
+        "total_connections": total_edges * lanes,   # edges × channel lanes
+        "fully_connected": bool(
+            (per_node[alive] > 0).all()) if alive.any() else False,
+    }

@@ -69,21 +69,15 @@ def test_per_sender_fifo_ordering():
     reference's per-connection-lane-only FIFO (partisan channels with
     parallelism > 1 may reorder across lanes; partisan_peer_connections
     dispatch :897-925), so `with_partition_key` ordering holds for free."""
-    import jax.numpy as jnp
-
-    from partisan_tpu import types as T
-    from partisan_tpu.ops import exchange, msg as msg_ops
-
-    n, e, w = 4, 6, 12
+    n, e = 4, 6
     # Sender 1 emits a numbered sequence to receiver 0 across different
     # lanes/channels; sender 2 interleaves its own.
     seqs = {1: [10, 11, 12, 13], 2: [20, 21]}
-    emitted = jnp.zeros((n, e, w), jnp.int32)
+    emitted = jnp.zeros((n, e, W), jnp.int32)
     for s, vals in seqs.items():
         for i, v in enumerate(vals):
-            rec = msg_ops.build(w, T.MsgKind.APP, s, 0,
-                                channel=i % 3, lane=i % 2,
-                                payload=(jnp.int32(v),))
+            rec = build(s, 0, channel=i % 3, lane=i % 2,
+                        payload=(jnp.int32(v),))
             emitted = emitted.at[s, i].set(rec)
     inbox = exchange.route(emitted, n, cap=16)
     got = [(int(r[T.W_SRC]), int(r[T.HDR_WORDS]))

@@ -165,3 +165,20 @@ def test_orchestration_roles_artifacts_and_graph(tmp_path):
     g = orchestration.Backend.cluster_graph(cl, st)
     assert set(g) == set(range(N))
     assert all(len(v) > 0 for v in g.values())   # fullmesh converged
+
+
+def test_connection_counts_introspection():
+    """partisan_peer_connections:count / connections/0 analogue."""
+    cl, model, st = _booted()
+    c = telemetry.connection_counts(cl, st)
+    assert c["fully_connected"]
+    assert c["total_edges"] == sum(c["per_node"])
+    lanes = sum(ch.parallelism for ch in cl.cfg.channels)
+    assert c["total_connections"] == c["total_edges"] * lanes
+    # crash a node: its edges stop counting and full connectivity breaks
+    # for it (the conn-count-to-zero node-down signal, reference
+    # :1489-1535)
+    st = st._replace(faults=faults_mod.crash(st.faults, 3))
+    c2 = telemetry.connection_counts(cl, st)
+    assert c2["per_node"][3] == 0
+    assert c2["total_edges"] < c["total_edges"]
