@@ -11,7 +11,7 @@ from partisan_tpu import faults as faults_mod
 from partisan_tpu.models.anti_entropy import AntiEntropy
 from partisan_tpu.parallel import ShardedCluster, make_mesh
 
-from support import components, hv_config, staggered_join
+from support import boot_hyparview, components, hv_config, staggered_join
 
 
 def test_overlay_forms_and_is_connected():
@@ -115,3 +115,22 @@ def test_sharded_parity():
     b = run(lambda: ShardedCluster(cfg, make_mesh(8)))
     assert (a.manager.active == b.manager.active).all()
     assert (a.manager.passive == b.manager.passive).all()
+
+
+def test_rejoin_after_leave():
+    """rejoin_test analogue (partisan_SUITE.erl:287-307): a node that
+    left comes back via a scripted join and re-enters the overlay."""
+    cfg = hv_config(16, 4)
+    cl = Cluster(cfg)
+    st = cl.steps(staggered_join(cl, cl.init()), 40)
+    st = st._replace(manager=cl.manager.leave(cfg, st.manager, 5))
+    st = cl.steps(st, 10)
+    active = np.asarray(st.manager.active)
+    assert (active[5] < 0).all()
+    # rejoin via a different contact
+    st = st._replace(manager=cl.manager.join(cfg, st.manager, 5, 2))
+    st = cl.steps(st, 40)
+    active = np.asarray(st.manager.active)
+    assert (active[5] >= 0).any(), "rejoiner has no active peers"
+    # overlay is one component again including the rejoiner
+    assert len(components(active, np.ones(16, bool))) == 1
