@@ -37,8 +37,11 @@ def run(n: int, verbose: bool = False) -> dict:
     from partisan_tpu.config import Config
     from partisan_tpu.models.plumtree import Plumtree
 
+    # max_broadcasts sizes the plumtree slot table to the workload (one
+    # broadcast slot in use): [n, B] state and [n, cap, B] one-hots scale
+    # linearly in B, and the relay-attached TPU prices ops by bytes.
     cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups")
+                 msg_words=16, partition_mode="groups", max_broadcasts=8)
     model = Plumtree()
     cl = Cluster(cfg, model=model)
     st = cl.init()
@@ -65,12 +68,19 @@ def run(n: int, verbose: bool = False) -> dict:
     if conv < 0:
         raise AssertionError(f"n={n}: plumtree broadcast did not converge")
 
-    # Steady-state throughput: k rounds as one compiled lax.scan program
-    # (k large enough to sit well above dispatch/timer noise — a round
-    # runs in tens of microseconds).  k=250, not more: 500-iteration
-    # scans of this body reproducibly trip a TPU kernel fault on
-    # converged-overlay state (XLA/runtime issue; 250 is reliable).
-    k = 250
+    # Steady-state throughput.  One program execution must stay well
+    # under the runtime's per-execution wall limit (long scans of a
+    # traffic-carrying round reproducibly fault around the minute mark),
+    # so size the scan length from a WARM probe's measured per-round
+    # cost to target ~15 s per program (the convergence phase would
+    # over-estimate on a cold compile cache), then time a few.
+    st = cl.steps(st, 25)
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    st = cl.steps(st, 25)
+    jax.block_until_ready(st)
+    est_round = max((time.perf_counter() - t0) / 25, 1e-4)
+    k = int(min(250, max(25, 15.0 / est_round)))
     st = cl.steps(st, k)           # warm the k-specialized program
     jax.block_until_ready(st)
     best = float("inf")

@@ -15,14 +15,35 @@ links) and a larger PASSIVE view (healing candidates), maintained by
 - crash healing: dead active peers are pruned (the TCP-EXIT failure
   detector analogue, :1134-1186) and promotion refills the view.
 
-Tensor mapping: views are fixed-width id arrays (ops/views.py); ALL
-nodes' message handling runs as one ``vmap`` over a per-node
-``lax.scan`` across inbox slots, with ``lax.switch`` dispatch per
-message kind.  Every handled message may emit up to 2 replies into
-statically-allocated slots; the one JOIN fan-out per node per round gets
-its own A_MAX-slot block (excess JOINs re-queue to self for the next
-round).  Random-walk hops advance one virtual round per hop — the
-round→virtual-time calibration note in SURVEY.md §7 applies.
+Tensor mapping: views are fixed-width id arrays (ops/views.py); the
+whole inbox is handled BATCHED — no per-slot ``lax.scan``, no
+``lax.switch`` (the original per-slot design cost ~250 sequential
+micro-kernels per round and walled the benchmark at 8k nodes; the
+batched fold is the plumtree pattern, models/plumtree.py):
+
+  1. removals from the active view (DISCONNECT sources, X-BOT swaps),
+  2. one central ADMISSION (ops/views.admit): every inbox slot
+     contributes at most one active-view candidate (JOIN / walk-end
+     FORWARD_JOIN adoption / NEIGHBOR request / NEIGHBOR_ACCEPTED /
+     X-BOT), admitted together under drop-random-if-full semantics,
+  3. per-slot replies decided against the round-start view plus the
+     admission outcome (accepted iff the edge is really in the new
+     view — no one-way links), eviction DISCONNECTs from the
+     admission's displaced-member list,
+  4. one batched passive merge (ops/views.bucket_merge — the passive
+     view is an id-keyed bucket cache) folding every passive-bound id
+     (disconnect sources, walk deposits, shuffle samples, demotions,
+     evictees) in one shot.
+
+Within-round ordering between conflicting updates resolves as ONE
+simultaneous transition (equivalent to some arbitrary mailbox
+interleaving, which is all the reference's asynchrony guarantees — the
+same stance as the plumtree fold).  Every handled message emits at most
+1 reply; the one JOIN fan-out per node per round gets its own
+A_MAX-slot block (excess JOINs are dropped — the joiner's retry loop
+re-sends until an accept lands).  Random-walk hops advance one virtual
+round per hop — the round→virtual-time calibration note in SURVEY.md §7
+applies.
 
 X-BOT overlay optimization (:1880-2050) is config-gated
 (``HyParViewConfig.xbot``) with a synthetic latency oracle (the
@@ -64,7 +85,19 @@ _TAG_PROMOTE = 304
 _TAG_JOIN = 305
 _TAG_XBOT = 306
 _TAG_XBOT_COST = 307
-_TAG_SLOT = 1000
+_TAG_ADMIT = 308
+_TAG_PMERGE = 309
+_TAG_FJPICK = 310
+_TAG_SHPICK = 311
+_TAG_MINE = 312
+_TAG_CANDSEL = 313
+_TAG_JOINSLOT = 314
+_TAG_SHSAMP_A = 315
+_TAG_SHSAMP_P = 316
+_TAG_SHTGT = 317
+_TAG_PRTGT = 318
+_TAG_XCAND = 319
+_TAG_PSEL = 320
 
 
 def link_cost(seed: int, a, b):
@@ -120,8 +153,10 @@ class HyParView:
         hv = cfg.hyparview
         W = cfg.msg_words
         SAMPLE = _shuffle_sample(cfg)
+        A = hv.active_max
         n_local = state.active.shape[0]
         gids = comm.local_ids()
+        cap = ctx.inbox.data.shape[1]
 
         # Failure detector: prune crash-stopped AND left peers from active
         # views (connection EXIT -> on_down, reference :1489-1535: a left
@@ -136,300 +171,396 @@ class HyParView:
         passive_in = jax.vmap(views.keep_only, in_axes=(0, None))(
             state.passive, reachable)
 
-        def per_node(me, key, active, passive, join_tgt, leaving, resv,
-                     inbox_row):
-            """One node's whole round. Returns new views + emitted msgs."""
+        active0, passive0 = active, passive_in
+        me2 = gids[:, None]                                   # [n, 1]
+        asize0 = jnp.sum(active0 >= 0, axis=1)                # [n]
+        acap = jnp.int32(A) - state.reserved                  # [n]
+        join_tgt = state.join_target
 
-            def mk(kind, dst, *, ttl=0, payload=()):
-                return msg_ops.build(W, kind, me, dst, ttl=ttl, payload=payload)
+        inb = ctx.inbox.data                                  # [n, cap, W]
+        kind = inb[..., T.W_KIND]
+        src = inb[..., T.W_SRC]
+        ttl = inb[..., T.W_TTL]
+        p0 = inb[..., T.P0]
+        p1 = inb[..., T.P1]
+        is_join = kind == T.MsgKind.HPV_JOIN
+        is_fj = kind == T.MsgKind.HPV_FORWARD_JOIN
+        is_nb = kind == T.MsgKind.HPV_NEIGHBOR
+        is_acc = kind == T.MsgKind.HPV_NEIGHBOR_ACCEPTED
+        is_disc = kind == T.MsgKind.HPV_DISCONNECT
+        is_sh = kind == T.MsgKind.HPV_SHUFFLE
+        is_shr = kind == T.MsgKind.HPV_SHUFFLE_REPLY
+        is_xo = (kind == T.MsgKind.HPV_XBOT_OPT) if hv.xbot else \
+            jnp.zeros_like(is_join)
+        is_xr = (kind == T.MsgKind.HPV_XBOT_OPT_REPLY) if hv.xbot else \
+            jnp.zeros_like(is_join)
 
-            nomsg = jnp.zeros((W,), jnp.int32)
-            # Ordinary admission capacity: active slots minus reserved
-            # ones (reserve/1); scripted joins below still use the full
-            # width.
-            acap = jnp.int32(hv.active_max) - resv
+        def slot_in(view, ids):
+            """bool[n, cap]: ids[n, cap] present in view[n, K]."""
+            return jnp.any((view[:, None, :] == ids[:, :, None])
+                           & (ids >= 0)[:, :, None], axis=2)
 
-            def my_cost(ids):
-                return link_cost(cfg.seed, me, ids)
+        in_active0 = slot_in(active0, src)                    # [n, cap]
 
-            # ---- scripted join / leave (timer-ish, before the inbox) --
-            jkey = rng.subkey(key, _TAG_JOIN)
-            do_join = join_tgt >= 0
-            active, ev_j = views.add(
-                active, jnp.where(do_join, join_tgt, -1), jkey)
-            join_msg = jnp.where(do_join, mk(T.MsgKind.HPV_JOIN, join_tgt), nomsg)
-            join_ev_msg = mk(T.MsgKind.HPV_DISCONNECT, ev_j)  # -1 dst => NONE
+        # Randomness on the hot path is counter-hash ranking
+        # (ops/rng.rank32) — placement-invariant like the threefry
+        # discipline, but a few elementwise passes instead of per-site
+        # key trees + gumbel tables (the relay-attached TPU prices every
+        # op by bytes moved; see ARCHITECTURE.md performance note).
+        slot_col = jnp.arange(cap, dtype=jnp.int32)[None, :]
 
-            # ---- inbox scan ---------------------------------------...
-            def handle(carry, x):
-                active, passive, fanout_joiner = carry
-                msg, slot = x
-                k = msg[T.W_KIND]
-                src = msg[T.W_SRC]
-                ttl = msg[T.W_TTL]
-                skey = rng.subkey(key, _TAG_SLOT + slot)
-                k1 = rng.subkey(skey, 1)
-                k2 = rng.subkey(skey, 2)
-                k3 = rng.subkey(skey, 3)
+        def ranked(tag, *coords):
+            return rng.rank32(cfg.seed, ctx.rnd, tag, *coords)
 
-                def b_noop(a, p, fj):
-                    return a, p, fj, nomsg, nomsg
+        def slot_pick(view, tag, *excl):
+            """int32[n, cap]: one random member of view[n, K] per inbox
+            slot, excluding the given [n, cap] id arrays (and empties)."""
+            r = ranked(tag, gids[:, None, None], slot_col[:, :, None],
+                       jnp.arange(view.shape[1])[None, None, :])
+            okm = jnp.broadcast_to((view >= 0)[:, None, :], r.shape)
+            for e in excl:
+                okm = okm & (view[:, None, :] != e[:, :, None])
+            score = jnp.where(okm, r | jnp.uint32(1), jnp.uint32(0))
+            best = jnp.argmax(score, axis=2)
+            got = jnp.take_along_axis(view, best, axis=1)
+            return jnp.where(jnp.max(score, axis=2) > 0, got, -1)
 
-                def b_join(a, p, fj):
-                    # A JOIN from a node already in my active view is a
-                    # retry whose accept was lost: re-accept WITHOUT
-                    # consuming this round's admission slot (keeps
-                    # duplicate retries from starving fresh joiners).
-                    # Otherwise the first JOIN this round is admitted:
-                    # joiner enters my active view, gets an explicit
-                    # accept (stops its retry loop — the accept stands in
-                    # for the reference's TCP connection establishment,
-                    # which IS its join confirmation) and gets fanned out
-                    # (reference :1234); later fresh JOINs re-queue to
-                    # self for the next round.
-                    dup = views.contains(a, src)
-                    first = (fj < 0) & ~dup
-                    a2, ev = views.add_cap(a, jnp.where(first, src, -1),
-                                           k1, acap)
-                    p2 = views.remove(p, src)
-                    r0 = jnp.where(
-                        dup,
-                        mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, src),
-                        jnp.where(
-                            first,
-                            mk(T.MsgKind.HPV_DISCONNECT, ev),
-                            msg.at[T.W_DST].set(me),  # re-queue fresh JOIN
-                        ))
-                    r1 = jnp.where(
-                        first, mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, src),
-                        nomsg)
-                    return (jnp.where(first, a2, a), jnp.where(first, p2, p),
-                            jnp.where(first, src, fj), r0, r1)
+        def row_ranked(view, tag, k, exclude=None):
+            """int32[n, k]: k distinct random members per row of
+            view[n, K] (-1 padded), optionally excluding [n, E] ids."""
+            r = ranked(tag, gids[:, None],
+                       jnp.arange(view.shape[1])[None, :])
+            okv = view >= 0
+            if exclude is not None:
+                okv &= ~jnp.any(view[:, :, None] == exclude[:, None, :],
+                                axis=2)
+            sc = jnp.where(okv, r | jnp.uint32(1), jnp.uint32(0))
+            vals, t = jax.lax.top_k(sc, k)
+            got = jnp.take_along_axis(view, t, axis=1)
+            return jnp.where(vals > 0, got, -1)
 
-                def b_forward_join(a, p, fj):
-                    j = msg[T.P0]
-                    nxt = views.pick_one(
-                        a, k2, exclude=jnp.stack([src, j, me]))
-                    stop = ((ttl <= 0) | (views.size(a) <= 1) | (nxt < 0)
-                            | views.contains(a, j))
-                    stop_ok = stop & (j != me) & ~views.contains(a, j)
-                    # stop: adopt the joiner (walk end, reference :1381)
-                    a2, ev = views.add_cap(a, jnp.where(stop_ok, j, -1),
-                                           k1, acap)
-                    r0_stop = mk(T.MsgKind.HPV_DISCONNECT, ev)
-                    r1_stop = jnp.where(
-                        stop_ok, mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, j), nomsg)
-                    # continue: deposit at PRWL, forward the walk
-                    deposit = (ttl == hv.prwl) & (j != me)
-                    p2 = views.merge_sample(
-                        p, jnp.where(deposit, j, -1)[None], me, k3)
-                    fwd = msg.at[T.W_DST].set(nxt).at[T.W_SRC].set(me) \
-                             .at[T.W_TTL].set(ttl - 1)
-                    return (a2, jnp.where(stop, p, p2), fj,
-                            jnp.where(stop, r0_stop, fwd),
-                            jnp.where(stop, r1_stop, nomsg))
+        def compact(ids2d, score2d, k):
+            """Select up to k valid entries of ids2d[n, cap] by
+            descending score (uint32[n, cap]; 0 = invalid), as k
+            mask-and-argmax passes — cheaper than a cap-wide sort.
+            Returns (ids int32[n, k], picked_col int32[n, k])."""
+            sc = score2d
+            ids_out, col_out = [], []
+            for _ in range(k):
+                b = jnp.argmax(sc, axis=1)
+                v = jnp.take_along_axis(sc, b[:, None], axis=1)[:, 0]
+                got = jnp.take_along_axis(ids2d, b[:, None], axis=1)[:, 0]
+                ids_out.append(jnp.where(v > 0, got, -1))
+                col_out.append(jnp.where(v > 0, b.astype(jnp.int32), -1))
+                sc = jnp.where(slot_col == b[:, None], jnp.uint32(0), sc)
+            return jnp.stack(ids_out, 1), jnp.stack(col_out, 1)
 
-                def b_neighbor(a, p, fj):
-                    want = (msg[T.P0] == 1) | (views.size(a) < acap)
-                    a2, ev = views.add_cap(a, jnp.where(want, src, -1),
-                                           k1, acap)
-                    # Accept only what was ACTUALLY admitted: a fully
-                    # reserved view (acap <= 0) rejects even priority
-                    # requests, and claiming acceptance without the edge
-                    # would leave the requester with a one-directional
-                    # link it believes is healed.
-                    accept = views.contains(a2, src)
-                    p2 = jnp.where(accept, views.remove(p, src), p)
-                    r0 = jnp.where(
-                        accept,
-                        mk(T.MsgKind.HPV_DISCONNECT, ev),
-                        mk(T.MsgKind.HPV_NEIGHBOR_REJECTED, src))
-                    r1 = jnp.where(
-                        accept, mk(T.MsgKind.HPV_NEIGHBOR_ACCEPTED, src), nomsg)
-                    return a2, p2, fj, r0, r1
+        # ---- 1. removals ---------------------------------------------
+        disc_src = jnp.where(is_disc, src, -1)
+        removed = jnp.any(
+            (active0[:, :, None] == disc_src[:, None, :])
+            & (active0 >= 0)[:, :, None], axis=2)              # [n, A]
+        if hv.xbot:
+            costs0 = jnp.where(active0 >= 0,
+                               link_cost(cfg.seed,
+                                         jnp.broadcast_to(me2, active0.shape),
+                                         jnp.maximum(active0, 0)), -jnp.inf)
+            zslot = jnp.argmax(costs0, axis=1)
+            z = jnp.where(jnp.any(active0 >= 0, axis=1),
+                          jnp.take_along_axis(
+                              active0, zslot[:, None], axis=1)[:, 0], -1)
+            have_room = asize0 < acap
+            cost_iz = link_cost(cfg.seed, me2, jnp.maximum(src, 0))
+            cost_zz = link_cost(cfg.seed, gids, jnp.maximum(z, 0))
+            want_x = is_xo & ~in_active0 & (acap > 0)[:, None] \
+                & (have_room[:, None]
+                   | ((z >= 0)[:, None] & (cost_iz < cost_zz[:, None])))
+            evict_x = want_x & ~have_room[:, None]             # [n, cap]
+            zrem = jnp.any(evict_x, axis=1)                    # [n]
+            ok_xr = is_xr & (p1 == 1)
+            swap_xr = ok_xr & slot_in(active0, p0)             # [n, cap]
+            xr_rm = jnp.where(swap_xr, p0, -1)
+            removed |= (zrem[:, None] & (active0 == z[:, None])
+                        & (active0 >= 0))
+            removed |= jnp.any(
+                (active0[:, :, None] == xr_rm[:, None, :])
+                & (active0 >= 0)[:, :, None], axis=2)
+        active1 = jnp.where(removed, -1, active0)
 
-                def b_accepted(a, p, fj):
-                    a2, ev = views.add_cap(a, src, k1, acap)
-                    return (a2, views.remove(p, src), fj,
-                            mk(T.MsgKind.HPV_DISCONNECT, ev), nomsg)
+        # ---- 2. per-kind slot decisions (against round-start views) --
+        # forward_join walk (reference :1381): payload [joiner, contact]
+        fjj = p0
+        j_in_act = slot_in(active0, fjj)
+        nxt_fj = slot_pick(active0, _TAG_FJPICK, src, fjj,
+                           jnp.broadcast_to(me2, src.shape))
+        stop = is_fj & ((ttl <= 0) | (asize0 <= 1)[:, None]
+                        | (nxt_fj < 0) | j_in_act)
+        stop_ok = stop & (fjj != me2) & ~j_in_act
+        cont = is_fj & ~stop
+        deposit = cont & (ttl == hv.prwl) & (fjj != me2)
 
-                def b_rejected(a, p, fj):
-                    return a, p, fj, nomsg, nomsg
+        # join admission: one fresh JOIN per round fans out; the rest
+        # are dropped (the joiner's per-round retry re-sends them)
+        fresh = is_join & ~in_active0
+        slot_idx = jnp.arange(cap)[None, :]
+        first_slot = jnp.argmin(jnp.where(fresh, slot_idx, cap), axis=1)
+        has_fresh = jnp.any(fresh, axis=1)
+        first = fresh & (slot_idx == first_slot[:, None])
 
-                def b_disconnect(a, p, fj):
-                    a2 = views.remove(a, src)
-                    p2 = views.merge_sample(p, src[None], me, k1)
-                    return a2, p2, fj, nomsg, nomsg
+        # neighbor request (:1619-1746)
+        want_nb = is_nb & ((p0 == 1) | (asize0 < acap)[:, None])
 
-                def b_shuffle(a, p, fj):
-                    origin = msg[T.P0]
-                    ids = jax.lax.dynamic_slice(
-                        msg, (T.P1,), (SAMPLE,))
-                    nxt = views.pick_one(
-                        a, k2, exclude=jnp.stack([src, origin, me]))
-                    fwd_ok = (ttl - 1 > 0) & (views.size(a) > 1) & (nxt >= 0)
-                    # integrate: sample ids + origin -> passive; reply with
-                    # my own passive sample directly to origin (:1750-1795)
-                    allids = jnp.concatenate([ids, origin[None]])
-                    p2 = views.merge_sample(p, allids, me, k1)
-                    mine = views.sample(p, k3, SAMPLE)
-                    reply = mk(T.MsgKind.HPV_SHUFFLE_REPLY,
-                               jnp.where(origin == me, -1, origin),
-                               payload=(me, *jnp.unstack(mine)))
-                    fwd = msg.at[T.W_DST].set(nxt).at[T.W_SRC].set(me) \
-                             .at[T.W_TTL].set(ttl - 1)
-                    return (a, jnp.where(fwd_ok, p, p2), fj,
-                            jnp.where(fwd_ok, fwd, reply), nomsg)
+        # shuffle walk (:1750-1795): payload [origin, ids...]
+        origin = p0
+        sh_ids = inb[..., T.P1:T.P1 + SAMPLE]                  # [n, cap, S]
+        nxt_sh = slot_pick(active0, _TAG_SHPICK, src, origin,
+                           jnp.broadcast_to(me2, src.shape))
+        sh_fwd = is_sh & (ttl - 1 > 0) & (asize0 > 1)[:, None] & (nxt_sh >= 0)
+        sh_int = is_sh & ~sh_fwd                               # integrate+reply
 
-                def b_shuffle_reply(a, p, fj):
-                    ids = jax.lax.dynamic_slice(
-                        msg, (T.P1,), (SAMPLE,))
-                    return a, views.merge_sample(p, ids, me, k1), fj, nomsg, nomsg
+        # ---- 3. scripted-join pre-insert + central admission ---------
+        # The scripted join bypasses admission entirely (reference
+        # reserve/1 holds slots for orchestrated joins, and the old
+        # sequential path used a full-width views.add): first empty slot,
+        # else a hash-random occupant is displaced — ordinary inbox
+        # candidates below still compete only for acap.
+        inview_j = jnp.any((active1 == join_tgt[:, None])
+                           & (join_tgt >= 0)[:, None], axis=1)
+        has_empty = jnp.any(active1 < 0, axis=1)
+        first_empty = jnp.argmax(active1 < 0, axis=1)
+        rslot = (ranked(_TAG_JOINSLOT, gids) % jnp.uint32(A)) \
+            .astype(jnp.int32)
+        slot_j = jnp.where(has_empty, first_empty, rslot)
+        do_pre = (join_tgt >= 0) & ~inview_j
+        occupant = jnp.take_along_axis(
+            active1, slot_j[:, None], axis=1)[:, 0]
+        evicted_j = jnp.where(do_pre & ~has_empty, occupant, -1)
+        oh_j = jnp.arange(A)[None, :] == slot_j[:, None]
+        active1 = jnp.where(do_pre[:, None] & oh_j,
+                            join_tgt[:, None], active1)
 
-                def b_xbot_opt(a, p, fj):
-                    # X-BOT candidate side (:1880-2050, simplified to a
-                    # 2-party exchange): accept the initiator if I have
-                    # room or it beats my worst active peer, which is
-                    # then demoted via the standard disconnect/healing
-                    # path (the reference's 4-party replace handshake
-                    # additionally re-homes the demoted peers; the sim
-                    # relies on HyParView's isolation healing instead).
-                    i = src
-                    o = msg[T.P0]
-                    z = views.worst_by(a, my_cost)
-                    have_room = views.size(a) < acap
-                    better = my_cost(jnp.maximum(i, 0)) < \
-                        my_cost(jnp.maximum(z, 0))
-                    want = (i >= 0) & ~views.contains(a, i) & (acap > 0) \
-                        & (have_room | ((z >= 0) & better))
-                    evict = want & ~have_room
-                    a2 = jnp.where(evict, views.remove(a, z), a)
-                    a3, _ = views.add_cap(a2, jnp.where(want, i, -1),
-                                          k1, acap)
-                    # accepted only if the edge was ACTUALLY admitted —
-                    # claiming acceptance without it would hand the
-                    # initiator a one-way link (same gating as b_neighbor)
-                    accept = want & views.contains(a3, i)
-                    p2 = jnp.where(evict & accept,
-                                   views.merge_sample(p, z[None], me, k2), p)
-                    r0 = mk(T.MsgKind.HPV_XBOT_OPT_REPLY, i,
-                            payload=(o, accept.astype(jnp.int32)))
-                    r1 = jnp.where(evict & accept & (z >= 0),
-                                   mk(T.MsgKind.HPV_DISCONNECT, z), nomsg)
-                    return a3, p2, fj, r0, r1
+        # Ordinary candidates: one per inbox slot, compacted to a small
+        # fixed width (excess candidates lose this round and their
+        # senders retry — bounded intake, like every other capacity in
+        # the tensor transport).
+        cand_slot = jnp.select(
+            [first, stop_ok, want_nb, is_acc]
+            + ([want_x, ok_xr] if hv.xbot else []),
+            [src, fjj, src, src] + ([src, src] if hv.xbot else []),
+            -1)                                                # [n, cap]
+        prio_slot = jnp.where(is_acc, 2, 1)
+        CAND = min(A, cap)
+        csc = jnp.where(
+            cand_slot >= 0,
+            (prio_slot.astype(jnp.uint32) << 28)
+            | (ranked(_TAG_CANDSEL, gids[:, None], slot_col) >> 4)
+            | jnp.uint32(1),
+            jnp.uint32(0))
+        cands, cand_col = compact(cand_slot, csc, CAND)        # [n, CAND]
+        prios = jnp.where(
+            cand_col >= 0,
+            jnp.take_along_axis(prio_slot, jnp.maximum(cand_col, 0),
+                                axis=1), 0)
+        adscores = ranked(_TAG_ADMIT, gids[:, None],
+                          jnp.arange(A + CAND)[None, :])
+        new_active, _admitted, evicted = jax.vmap(views.admit)(
+            active1, cands, prios, adscores, acap)
 
-                def b_xbot_reply(a, p, fj):
-                    # initiator side: the candidate has ALREADY committed
-                    # the edge on accept, so reciprocate unconditionally
-                    # (even if the old peer o meanwhile left this view —
-                    # otherwise the candidate keeps a permanent one-way
-                    # edge); swap out o only if still present
-                    o = msg[T.P0]
-                    ok = msg[T.P1] == 1
-                    swap = ok & views.contains(a, o)
-                    a2 = jnp.where(swap, views.remove(a, o), a)
-                    a3, ev = views.add_cap(a2, jnp.where(ok, src, -1),
-                                           k1, acap)
-                    p2 = jnp.where(swap,
-                                   views.merge_sample(p, o[None], me, k2), p)
-                    r0 = jnp.where(swap & (o >= 0),
-                                   mk(T.MsgKind.HPV_DISCONNECT, o),
-                                   mk(T.MsgKind.HPV_DISCONNECT, ev))
-                    return a3, p2, fj, r0, nomsg
+        in_new = slot_in(new_active, src)                      # [n, cap]
+        j_in_new = slot_in(new_active, fjj)
 
-                branches = [b_join, b_forward_join, b_neighbor, b_accepted,
-                            b_rejected, b_disconnect, b_shuffle,
-                            b_shuffle_reply]
-                last_kind = T.MsgKind.HPV_SHUFFLE_REPLY
-                if hv.xbot:
-                    branches += [b_xbot_opt, b_xbot_reply]
-                    last_kind = T.MsgKind.HPV_XBOT_OPT_REPLY
-                branches.append(b_noop)
-                idx = jnp.where(
-                    (k >= T.MsgKind.HPV_JOIN) & (k <= last_kind),
-                    k - T.MsgKind.HPV_JOIN, len(branches) - 1)
-                a2, p2, fj2, r0, r1 = jax.lax.switch(
-                    idx, branches, active, passive, fanout_joiner)
-                return (a2, p2, fj2), jnp.stack([r0, r1])
+        # ---- 4. per-slot replies -------------------------------------
+        # ONE shuffle is answered per node per round (bounded intake —
+        # excess shuffles' ids still can't be integrated beyond the
+        # passive merge budget below, and the origin's own outgoing
+        # sample already carried our ids the other way; a missed reply
+        # just thins one round's sample).  This keeps the passive-sample
+        # table [n, SAMPLE] instead of [n, cap, passive_max].
+        sh_slot = jnp.argmax(sh_int, axis=1)                   # first hit
+        sh_any = jnp.any(sh_int, axis=1)
+        origin1 = jnp.take_along_axis(origin, sh_slot[:, None], axis=1)[:, 0]
+        ids1 = jnp.take_along_axis(
+            sh_ids, sh_slot[:, None, None], axis=1)[:, 0]      # [n, S]
+        mine1 = row_ranked(passive0, _TAG_MINE, SAMPLE)        # [n, S]
+        shreply_msgs = msg_ops.build(
+            W, T.MsgKind.HPV_SHUFFLE_REPLY, gids,
+            jnp.where(sh_any & (origin1 != gids) & (origin1 >= 0),
+                      origin1, -1),
+            payload=(gids, *jnp.unstack(mine1, axis=1)))
 
-            (active, passive, fanout_joiner), replies = jax.lax.scan(
-                handle, (active, passive, jnp.int32(-1)),
-                (inbox_row, jnp.arange(inbox_row.shape[0])))
-            replies = replies.reshape(-1, W)   # [CAP*2, W]
+        m_acc_join = is_join & in_new        # JOIN confirmed (edge exists)
+        m_acc_fj = stop_ok & j_in_new        # walk-end adoption confirmed
+        m_nb_acc = is_nb & in_new
+        m_nb_rej = is_nb & ~in_new
+        m_acc_fix = is_acc & ~in_new         # accept we could NOT honor:
+        #                                      tear down the half-open edge
+        #                                      instead of keeping a silent
+        #                                      one-way link
+        if hv.xbot:
+            # an XBOT candidate that committed its accept but lost the
+            # central admission must also be torn down (same one-way-link
+            # reasoning as m_acc_fix)
+            xr_fix = ok_xr & ~in_new
 
-            # ---- fan-out blocks: forward_join AND leave-disconnects ---
-            # (a node processing a JOIN fans the walk to every active
-            # peer; a leaving node disconnects every active peer — a
-            # leaving contact that just handled a JOIN must emit BOTH, so
-            # the joiner's walk is not silently eaten)
-            fj = fanout_joiner
-            tgt = jnp.where((active != fj) & (active >= 0) & (fj >= 0),
-                            active, -1)
-            fanout_fj = jax.vmap(
-                lambda d: mk(T.MsgKind.HPV_FORWARD_JOIN, d,
-                             ttl=hv.arwl, payload=(fj,)))(tgt)
-            fanout_lv = jax.vmap(
-                lambda d: mk(T.MsgKind.HPV_DISCONNECT,
-                             jnp.where(leaving, d, -1)))(active)
-            fanout = jnp.concatenate([fanout_fj, fanout_lv])
+        rkind = jnp.select(
+            [m_acc_join, m_acc_fj, m_nb_acc, m_nb_rej, m_acc_fix,
+             cont, sh_fwd]
+            + ([is_xo, xr_fix] if hv.xbot else []),
+            [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED)] * 2
+            + [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED),
+               jnp.int32(T.MsgKind.HPV_NEIGHBOR_REJECTED),
+               jnp.int32(T.MsgKind.HPV_DISCONNECT),
+               jnp.int32(T.MsgKind.HPV_FORWARD_JOIN),
+               jnp.int32(T.MsgKind.HPV_SHUFFLE)]
+            + ([jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
+                jnp.int32(T.MsgKind.HPV_DISCONNECT)] if hv.xbot else []),
+            0)
+        rdst = jnp.select(
+            [m_acc_fj, cont, sh_fwd]
+            + ([is_xo] if hv.xbot else []),
+            [fjj, nxt_fj, nxt_sh]
+            + ([src] if hv.xbot else []),
+            src)
+        rdst = jnp.where(rkind > 0, rdst, -1)
+        rttl = jnp.where(cont | sh_fwd, ttl - 1, 0)
+        # Payload word 0: ACCEPTED carries the JOIN's contact (the node
+        # the joiner addressed) so a pending scripted join is confirmed
+        # only by ITS contact's walk — a coincidental promotion accept
+        # can no longer cancel a join whose walk was actually lost.
+        w0 = jnp.select(
+            [m_acc_join, m_acc_fj, m_nb_acc | m_nb_rej | m_acc_fix]
+            + ([is_xo] if hv.xbot else []),
+            [jnp.broadcast_to(me2, p0.shape), p1,
+             jnp.full_like(p0, -1)]
+            + ([p0] if hv.xbot else []),
+            p0)
+        payload = [w0]
+        for wi in range(1, W - T.HDR_WORDS):
+            base = inb[..., T.HDR_WORDS + wi]
+            if hv.xbot and wi == 1:
+                base = jnp.where(
+                    is_xo, (want_x & in_new).astype(jnp.int32), base)
+            payload.append(base)
+        replies = msg_ops.build(
+            W, rkind, jnp.broadcast_to(me2, rdst.shape), rdst,
+            ttl=rttl, payload=tuple(payload))                  # [n, cap, W]
 
-            # ---- shuffle timer (:1078) --------------------------------
-            skey = rng.subkey(key, _TAG_SHUFFLE)
-            sh_fire = (ctx.rnd + me) % cfg.shuffle_every == 0
-            sh_tgt = views.pick_one(active, rng.subkey(skey, 1))
-            smp = jnp.concatenate([
-                views.sample(active, rng.subkey(skey, 2), hv.shuffle_k_active),
-                views.sample(passive, rng.subkey(skey, 3), hv.shuffle_k_passive),
-            ])[:SAMPLE]
-            shuffle_msg = jnp.where(
-                sh_fire & (sh_tgt >= 0),
-                mk(T.MsgKind.HPV_SHUFFLE, sh_tgt, ttl=hv.arwl,
-                   payload=(me, *jnp.unstack(smp))),
-                nomsg)
+        # eviction + demotion disconnects (evicted is slot-aligned [n, A])
+        ev_disc = msg_ops.build(W, T.MsgKind.HPV_DISCONNECT,
+                                jnp.broadcast_to(me2, evicted.shape), evicted)
+        if hv.xbot:
+            xdst = jnp.select(
+                [evict_x & want_x & (z >= 0)[:, None], swap_xr],
+                [jnp.broadcast_to(z[:, None], src.shape), p0], -1)
+            x_disc = msg_ops.build(W, T.MsgKind.HPV_DISCONNECT,
+                                   jnp.broadcast_to(me2, xdst.shape), xdst)
 
-            # ---- random promotion timer (:1046) -----------------------
-            pkey = rng.subkey(key, _TAG_PROMOTE)
-            pr_fire = ((ctx.rnd + me) % cfg.promotion_every == 0) & \
-                      (views.size(active) < hv.active_min)
-            pr_tgt = views.pick_one(passive, pkey, exclude=active)
-            promote_msg = jnp.where(
-                pr_fire & (pr_tgt >= 0),
-                mk(T.MsgKind.HPV_NEIGHBOR, pr_tgt,
-                   payload=(jnp.asarray(views.size(active) == 0, jnp.int32),)),
-                nomsg)
+        # ---- 5. join fan-out + leave fan-out (reference :1234) -------
+        joiner = jnp.where(
+            has_fresh,
+            jnp.take_along_axis(src, first_slot[:, None], axis=1)[:, 0], -1)
+        fj_tgt = jnp.where((active0 >= 0) & (active0 != joiner[:, None])
+                           & (joiner >= 0)[:, None], active0, -1)
+        fanout_fj = msg_ops.build(
+            W, T.MsgKind.HPV_FORWARD_JOIN,
+            jnp.broadcast_to(me2, fj_tgt.shape), fj_tgt, ttl=hv.arwl,
+            payload=(jnp.broadcast_to(joiner[:, None], fj_tgt.shape),
+                     jnp.broadcast_to(me2, fj_tgt.shape)))
+        lv_tgt = jnp.where(state.leaving[:, None], active0, -1)
+        fanout_lv = msg_ops.build(
+            W, T.MsgKind.HPV_DISCONNECT,
+            jnp.broadcast_to(me2, lv_tgt.shape), lv_tgt)
 
-            # ---- X-BOT optimization timer (:1114) ---------------------
-            if hv.xbot:
-                xkey = rng.subkey(key, _TAG_XBOT)
-                o_worst = views.worst_by(active, my_cost)
-                cand = views.pick_one(passive, rng.subkey(xkey, 1),
-                                      exclude=active)
-                x_fire = ((ctx.rnd + me) % cfg.xbot_every == 0) \
-                    & (views.size(active) >= acap) & (acap > 0) \
-                    & (cand >= 0) & (o_worst >= 0) \
-                    & (my_cost(jnp.maximum(cand, 0))
-                       < my_cost(jnp.maximum(o_worst, 0)))
-                xbot_msg = jnp.where(
-                    x_fire,
-                    mk(T.MsgKind.HPV_XBOT_OPT, cand, payload=(o_worst,)),
-                    nomsg)
-            else:
-                xbot_msg = nomsg
+        # ---- 6. passive merge (id-keyed bucket cache) ----------------
+        # Candidate budget per round: PSEL slot-borne ids (disconnect
+        # sources, walk deposits, X-BOT demotions) + one shuffle's ids +
+        # one shuffle-reply's ids + admission evictees + the scripted
+        # join's displaced occupant.  Excess candidates wait for the
+        # next shuffle/disconnect — the passive view is a healing cache,
+        # not a ledger.
+        pw0 = jnp.select(
+            [is_disc, deposit]
+            + ([evict_x & want_x & (z >= 0)[:, None], swap_xr]
+               if hv.xbot else []),
+            [src, fjj]
+            + ([jnp.broadcast_to(z[:, None], src.shape), p0]
+               if hv.xbot else []),
+            -1)                                                # [n, cap]
+        PSEL = min(A, cap)
+        psc = jnp.where(pw0 >= 0,
+                        ranked(_TAG_PSEL, gids[:, None], slot_col)
+                        | jnp.uint32(1), jnp.uint32(0))
+        p_slotborne, _ = compact(pw0, psc, PSEL)               # [n, PSEL]
+        shr_slot = jnp.argmax(is_shr, axis=1)
+        shr_any = jnp.any(is_shr, axis=1)
+        shr_ids1 = jnp.take_along_axis(
+            sh_ids, shr_slot[:, None, None], axis=1)[:, 0]     # [n, S]
+        pcands = jnp.concatenate([
+            p_slotborne,
+            jnp.where(sh_any[:, None], ids1, -1),
+            jnp.where((sh_any & (origin1 != gids))[:, None],
+                      origin1[:, None], -1),
+            jnp.where(shr_any[:, None], shr_ids1, -1),
+            evicted,
+            evicted_j[:, None],
+        ], axis=1)
+        pranks = ranked(_TAG_PMERGE, gids[:, None],
+                        jnp.arange(pcands.shape[1])[None, :])
+        # clear promoted ids out of the passive view, then merge
+        promoted = jnp.any(
+            (passive0[:, :, None] == new_active[:, None, :])
+            & (passive0 >= 0)[:, :, None], axis=2)
+        passive1 = jnp.where(promoted, -1, passive0)
+        new_passive = jax.vmap(views.bucket_merge)(
+            passive1, pcands, pranks, gids, new_active)
 
-            # leave: clear own views after disconnecting
-            active = jnp.where(leaving, -1, active)
-            passive = jnp.where(leaving, -1, passive)
+        # ---- 7. timers (scripted join, shuffle, promotion, X-BOT) ----
+        do_join = join_tgt >= 0
+        join_msgs = msg_ops.build(
+            W, T.MsgKind.HPV_JOIN, gids, jnp.where(do_join, join_tgt, -1))
+        ev_join_disc = msg_ops.build(
+            W, T.MsgKind.HPV_DISCONNECT, gids, evicted_j)
+        sh_fire = ((ctx.rnd + gids) % cfg.shuffle_every == 0)
+        sh_tgt = row_ranked(active0, _TAG_SHTGT, 1)[:, 0]
+        smp = jnp.concatenate([
+            row_ranked(active0, _TAG_SHSAMP_A, hv.shuffle_k_active),
+            row_ranked(passive0, _TAG_SHSAMP_P, hv.shuffle_k_passive),
+        ], axis=1)[:, :SAMPLE]
+        shuffle_msgs = msg_ops.build(
+            W, T.MsgKind.HPV_SHUFFLE, gids,
+            jnp.where(sh_fire & (sh_tgt >= 0), sh_tgt, -1), ttl=hv.arwl,
+            payload=(gids, *jnp.unstack(smp, axis=1)))
+        pr_fire = ((ctx.rnd + gids) % cfg.promotion_every == 0) & \
+            (asize0 < hv.active_min)
+        pr_tgt = row_ranked(passive0, _TAG_PRTGT, 1,
+                            exclude=active0)[:, 0]
+        promote_msgs = msg_ops.build(
+            W, T.MsgKind.HPV_NEIGHBOR, gids,
+            jnp.where(pr_fire & (pr_tgt >= 0), pr_tgt, -1),
+            payload=((asize0 == 0).astype(jnp.int32),))
+        if hv.xbot:
+            cand = row_ranked(passive0, _TAG_XCAND, 1,
+                              exclude=active0)[:, 0]
+            cost_cand = link_cost(cfg.seed, gids, jnp.maximum(cand, 0))
+            cost_worst = link_cost(cfg.seed, gids, jnp.maximum(z, 0))
+            x_fire = ((ctx.rnd + gids) % cfg.xbot_every == 0) \
+                & (asize0 >= acap) & (acap > 0) & (cand >= 0) & (z >= 0) \
+                & (cost_cand < cost_worst)
+            xbot_msgs = msg_ops.build(
+                W, T.MsgKind.HPV_XBOT_OPT, gids,
+                jnp.where(x_fire, cand, -1), payload=(z,))
 
-            emitted = jnp.concatenate([
-                replies, fanout,
-                jnp.stack([join_msg, join_ev_msg, shuffle_msg, promote_msg,
-                           xbot_msg]),
-            ])
-            return active, passive, emitted
+        # leave: clear own views after disconnecting
+        new_active = jnp.where(state.leaving[:, None], -1, new_active)
+        new_passive = jnp.where(state.leaving[:, None], -1, new_passive)
 
-        new_active, new_passive, emitted = jax.vmap(per_node)(
-            gids, ctx.keys, active, passive_in, state.join_target,
-            state.leaving, state.reserved, ctx.inbox.data)
+        blocks = [replies, ev_disc, fanout_fj, fanout_lv,
+                  join_msgs[:, None, :], ev_join_disc[:, None, :],
+                  shreply_msgs[:, None, :], shuffle_msgs[:, None, :],
+                  promote_msgs[:, None, :]]
+        if hv.xbot:
+            blocks += [x_disc, xbot_msgs[:, None, :]]
+        emitted = jnp.concatenate(blocks, axis=1)
 
         # Crash-stopped and left nodes are frozen and silent (a left node
         # is inert until a scripted rejoin — the reference's leaver shuts
@@ -448,11 +579,15 @@ class HyParView:
         # reliable TCP and cannot be lost; in the sim a mass-join can
         # overflow the contact's bounded inbox (SURVEY.md §7 hard-parts:
         # overflow accounting), so fire-once JOINs would orphan nodes.
-        # The contact's b_join admits one JOIN per round and re-queues
-        # the rest, so retries drain without view churn.
+        # Only an accept attributable to THIS join clears the retry: the
+        # accept's source is the contact itself, or its payload carries
+        # the contact id (walk-end adoptions echo the FORWARD_JOIN's
+        # contact word) — a coincidental promotion accept (payload -1)
+        # cannot cancel a join whose JOIN message was actually lost.
         confirmed = jnp.any(
-            ctx.inbox.data[..., T.W_KIND] == T.MsgKind.HPV_NEIGHBOR_ACCEPTED,
-            axis=1)
+            (kind == T.MsgKind.HPV_NEIGHBOR_ACCEPTED)
+            & ((src == join_tgt[:, None]) | (p0 == join_tgt[:, None])),
+            axis=1) & (join_tgt >= 0)
         new_state = HyParViewState(
             active=new_active,
             passive=new_passive,

@@ -39,6 +39,32 @@ def subkey(key: jax.Array, tag: int) -> jax.Array:
     return jax.random.fold_in(key, tag)
 
 
+def rank32(seed: int, rnd: jax.Array, tag: int, a, b=0, c=0) -> jax.Array:
+    """Deterministic uint32 ranking keys from integer coordinates.
+
+    The cheap alternative to deriving per-site threefry keys + gumbel
+    tables on the round's hot path: two murmur3 finalizer passes over a
+    multiplicative-xor combine of (node, slot, element, round, call
+    site).  Uniform ranking by these keys is equivalent to gumbel-top-k
+    sampling for uniform choice, and the keys are placement-invariant
+    (coordinates are global ids) — the same determinism contract as
+    :func:`node_keys`, at a fraction of the memory traffic.
+
+    ``tag`` namespaces call sites (use distinct small ints).  Streams are
+    independent of :func:`partisan_tpu.faults.edge_hash` by construction
+    (different combine), but keep tags distinct from fault salts anyway.
+    """
+    from partisan_tpu.faults import _mix32
+
+    site = (seed * 0x27D4EB2F + tag * 0x165667B1) & 0xFFFFFFFF
+    x = (jnp.asarray(a, jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ jnp.asarray(b, jnp.uint32) * jnp.uint32(0x85EBCA77)
+         ^ jnp.asarray(c, jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+         ^ (jnp.asarray(rnd, jnp.uint32) * jnp.uint32(0x27D4EB2F)
+            + jnp.uint32(site)))
+    return _mix32(_mix32(x))
+
+
 def choice_slots(key: jax.Array, valid: jax.Array, k: int) -> jax.Array:
     """Pick ``k`` distinct SLOT indices from a bool[v] validity mask.
 

@@ -125,6 +125,117 @@ def pick_one(view: Array, key: Array, exclude: Array | None = None) -> Array:
     return sample(view, key, 1, exclude)[0]
 
 
+def admit(view: Array, cands: Array, prio: Array, scores: Array,
+          cap) -> tuple[Array, Array, Array]:
+    """Batched multi-candidate admission with random eviction.
+
+    The tensor equivalent of folding ``add_cap`` over the valid, deduped
+    candidates (add_to_active_view drop-random-if-full semantics,
+    partisan_hyparview_peer_service_manager.erl:2344-2420) in one shot:
+
+    - candidates always enter while ``cap > 0`` (evicting RANDOM current
+      members once the view is at capacity),
+    - when more candidates arrive than ``cap`` admits, higher ``prio``
+      wins, ties break uniformly at random,
+    - a view already holding more than ``cap`` members (capacity lowered
+      by ``reserve`` after fill) shrinks toward ``cap`` whenever an
+      admission happens, instead of staying over capacity forever.
+
+    Args: view int32[A]; cands int32[C] (-1 = no candidate, duplicates
+    allowed — keep C SMALL, dedupe is pairwise O(C^2): compact wide slot
+    lists first); prio int32[C] small non-negative priorities;
+    scores: uint32[A + C] uniform ranking keys (ops/rng.rank32) — the
+    randomness source for evictions and tie-breaks; cap scalar.
+    Returns (view' int32[A], admitted bool[C], evicted int32[A]) where
+    ``evicted`` holds displaced member ids slot-aligned with ``view``
+    (-1 where the slot's occupant survived).
+    """
+    a_width = view.shape[0]
+    cap = jnp.asarray(cap, jnp.int32)
+    in_view = jax.vmap(lambda x: contains(view, x))(cands)
+    valid_c = (cands >= 0) & (cap > 0) & ~in_view
+    # Dedupe among candidates: keep the max-prio copy (first on ties).
+    idx = jnp.arange(cands.shape[0])
+    eff = jnp.where(valid_c, prio, -1)
+    same = (cands[None, :] == cands[:, None]) & valid_c[None, :] \
+        & valid_c[:, None]
+    beats = (eff[None, :] > eff[:, None]) | \
+        ((eff[None, :] == eff[:, None]) & (idx[None, :] < idx[:, None]))
+    valid_c &= ~jnp.any(same & beats, axis=1)
+
+    # Rank: candidates above members (always enter, evicting randomly),
+    # priority above random tie-break.  Random bits live in the low 27
+    # bits; prio shifts in units of 2^27; the member/candidate split in
+    # 2^30 — all inside float32-exact... integers, so use int64-free
+    # uint32 bucketed ranking.
+    g = (scores >> 5).astype(jnp.uint32)         # 27 random bits
+    rank_m = jnp.where(view >= 0, g[:a_width], jnp.uint32(0))
+    rank_c = jnp.where(
+        valid_c,
+        g[a_width:] + jnp.uint32(1 << 30)
+        + prio.astype(jnp.uint32) * jnp.uint32(1 << 27),
+        jnp.uint32(0))
+    score = jnp.concatenate([
+        jnp.where(view >= 0, rank_m + jnp.uint32(1), jnp.uint32(0)),
+        rank_c,
+    ])
+    # Only an actual admission triggers (shrink-to-cap) eviction; a
+    # quiet round must not spontaneously evict an over-capacity view.
+    n_keep = jnp.where(jnp.any(valid_c),
+                       jnp.minimum(cap, a_width), a_width)
+    vals, top = jax.lax.top_k(score, a_width)
+    keep = (vals > 0) & (jnp.arange(a_width) < n_keep)
+    ids_all = jnp.concatenate([view, cands])
+    new_view = jnp.where(keep, ids_all[top], EMPTY)
+    admitted = valid_c & jax.vmap(lambda x: contains(new_view, x))(cands)
+    survived = jax.vmap(lambda x: contains(new_view, x))(view)
+    evicted = jnp.where((view >= 0) & ~survived, view, EMPTY)
+    return new_view, admitted, evicted
+
+
+def bucket_slot(ids: Array, width: int) -> Array:
+    """Stable bucket index for an id (see :func:`bucket_merge`)."""
+    from partisan_tpu.faults import _mix32
+
+    return (_mix32(jnp.asarray(ids, jnp.uint32))
+            % jnp.uint32(width)).astype(jnp.int32)
+
+
+def bucket_merge(view: Array, cands: Array, ranks: Array, self_id: Array,
+                 exclude: Array | None = None) -> Array:
+    """Merge candidates into an id-KEYED bucket cache view.
+
+    TPU-native redesign of the passive-view merge
+    (partisan_hyparview_peer_service_manager.erl:2569 merge_exchange /
+    add_to_passive_view): instead of a set with uniform-random eviction,
+    the view is a ``P``-bucket cache where id ``x`` always lives in slot
+    ``mix32(x) % P``.  Insertion is a pure per-slot argmax — no sort, no
+    pairwise dedupe — which is what the round's hot path needs (every
+    sort costs milliseconds on the relay-attached TPU).  Semantics
+    deviations, both benign for a healing-candidate cache: colliding ids
+    evict each other deterministically instead of uniformly, and
+    expected occupancy saturates at ~(1 - 1/e)·P rather than P.  Dedupe
+    is inherent (same id → same slot).
+
+    Args: view int32[P] (slot p holds -1 or an id with bucket p);
+    cands int32[C] (-1 = none); ranks uint32[C] tie-break keys
+    (ops/rng.rank32); exclude int32[E] ids barred from entry (e.g. the
+    node's own active view).
+    """
+    p_width = view.shape[0]
+    ok = (cands >= 0) & (cands != self_id)
+    if exclude is not None:
+        ok &= ~jnp.any(cands[:, None] == exclude[None, :], axis=1)
+    slot = bucket_slot(cands, p_width)
+    hit = ok[None, :] & (slot[None, :] == jnp.arange(p_width)[:, None])
+    # `| 1` keeps a hitting candidate's rank nonzero — a rank of exactly
+    # 0 would lose the argmax to column 0 and insert the wrong id.
+    rank = jnp.where(hit, ranks[None, :] | jnp.uint32(1), jnp.uint32(0))
+    best = jnp.argmax(rank, axis=1)
+    has = jnp.any(hit, axis=1)
+    return jnp.where(has, cands[best], view)
+
+
 def merge_sample(view: Array, new_ids: Array, self_id: Array,
                  key: Array) -> Array:
     """Integrate a shuffle sample into a (passive) view: add each id not

@@ -60,9 +60,13 @@ class MsgKind(enum.IntEnum):
 
     # -- HyParView (partisan_hyparview_peer_service_manager.erl:1234-1795)
     HPV_JOIN = 10            # payload: []
-    HPV_FORWARD_JOIN = 11    # payload: [joiner]; W_TTL = remaining walk
+    HPV_FORWARD_JOIN = 11    # payload: [joiner, contact]; W_TTL = walk
     HPV_NEIGHBOR = 12        # payload: [priority]  (1 = high)
-    HPV_NEIGHBOR_ACCEPTED = 13
+    HPV_NEIGHBOR_ACCEPTED = 13  # payload: [contact | -1] — the JOIN's
+    #                             contact (echoed through the walk) so a
+    #                             pending scripted join is confirmed only
+    #                             by its own contact's walk; -1 for
+    #                             promotion accepts
     HPV_NEIGHBOR_REJECTED = 14
     HPV_DISCONNECT = 15
     HPV_SHUFFLE = 16         # payload: [origin, k_slots...]; W_TTL = walk
