@@ -125,11 +125,14 @@ def _run_one_subprocess(n: int, timeout_s: float) -> dict | None:
 
 
 def main() -> None:
-    # Size ladder, small -> large: always secure a result, then climb
-    # while the time budget lasts (compile time grows steeply with n).
+    # Size ladder: secure one safety rung, then jump straight to the
+    # largest sizes the budget allows (intermediate rungs would eat the
+    # budget a 32k+ run needs — measured: 32768 takes ~250 s end to
+    # end, 100k clears compile in ~15 s but its traffic rounds put the
+    # full run beyond this budget today).
     t_start = time.time()
     result = None
-    for n in (1_024, 4_096, 8_192, 32_768, 100_000):
+    for n in (4_096, 32_768, 100_000):
         elapsed = time.time() - t_start
         if result is not None and elapsed > TIME_BUDGET_S / 2:
             break

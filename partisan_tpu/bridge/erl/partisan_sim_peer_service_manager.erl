@@ -29,16 +29,23 @@
 
 -behaviour(gen_server).
 
-%% partisan_peer_service_manager callbacks (subset; the full contract is
-%% completed incrementally — unsupported calls return {error, notsup})
+%% The FULL partisan_peer_service_manager behaviour contract
+%% (src/partisan_peer_service_manager.erl:93-170) — every callback is
+%% implemented (no {error, notsup} stubs).
 -export([start_link/0,
          members/0,
          members_for_orchestration/0,
          myself/0,
+         update_members/1,
+         get_local_state/0,
          join/1,
          sync_join/1,
          leave/0,
          leave/1,
+         send_message/2,
+         cast_message/2,
+         cast_message/3,
+         cast_message/4,
          forward_message/2,
          forward_message/3,
          forward_message/4,
@@ -47,7 +54,9 @@
          resolve_partition/1,
          partitions/0,
          on_up/2,
+         on_up/3,
          on_down/2,
+         on_down/3,
          decode/1,
          reserve/1,
          supports_capability/1]).
@@ -79,7 +88,8 @@
                 next_sym    :: pos_integer(),
                 up_funs     :: [{node(), fun(() -> ok)}],
                 down_funs   :: [{node(), fun(() -> ok)}],
-                last_members :: [non_neg_integer()]}).
+                last_members :: [non_neg_integer()],
+                partitions = #{} :: #{reference() => term()}}).
 
 %% -----------------------------------------------------------------------
 %% API
@@ -97,17 +107,53 @@ members_for_orchestration() ->
 myself() ->
     partisan:node_spec().
 
+update_members(Members) ->
+    %% orchestration path (partisan_pluggable_peer_service_manager
+    %% update_members): join every listed spec except ourselves (the
+    %% conventional argument is the FULL desired membership, self
+    %% included — joining self would write a self-edge into the sim).
+    Self = partisan:node(),
+    [ok = join(M) || M <- Members, spec_name(M) =/= Self],
+    ok.
+
+spec_name(#{name := Name}) -> Name;
+spec_name(Name) when is_atom(Name) -> Name.
+
+get_local_state() ->
+    %% opaque local membership state; decode/1 turns it into the member
+    %% list (the reference returns its CRDT state the same way)
+    {state, members()}.
+
 join(NodeSpec) ->
     gen_server:call(?MODULE, {join, NodeSpec}, infinity).
 
 sync_join(NodeSpec) ->
-    join(NodeSpec).
+    %% reference sync_join replies only once membership reflects the
+    %% join (pluggable :2113 sync_joins); the bridge steps the simulator
+    %% until the joined node shows up (bounded).
+    gen_server:call(?MODULE, {sync_join, NodeSpec}, infinity).
 
 leave() ->
     gen_server:call(?MODULE, leave, infinity).
 
 leave(NodeSpec) ->
     gen_server:call(?MODULE, {leave, NodeSpec}, infinity).
+
+send_message(Node, Message) ->
+    %% raw manager-to-manager send (behaviour send_message/2): no
+    %% ServerRef — delivered to the manager itself on the far side
+    forward_message(Node, ?MODULE, Message, #{}).
+
+cast_message(Term, Message) ->
+    cast_message(partisan:node(), Term, Message, #{}).
+
+cast_message(Node, ServerRef, Message) ->
+    cast_message(Node, ServerRef, Message, #{}).
+
+cast_message(Node, ServerRef, Message, Options) ->
+    %% casts wrap in '$gen_cast' exactly like the reference
+    %% (partisan.erl:1470-1502)
+    forward_message(Node, ServerRef, {'$gen_cast', Message}, Options).
 
 forward_message(Term, Message) ->
     forward_message(partisan:node(), Term, Message, #{}).
@@ -118,10 +164,21 @@ forward_message(Node, Term, Message) ->
 forward_message(Node, ServerRef, Message, _Opts) ->
     gen_server:call(?MODULE, {forward, Node, ServerRef, Message}, infinity).
 
-receive_message(_Peer, _Channel, Message) ->
-    %% deliveries drained from the simulator re-enter here
-    partisan_peer_service_manager:process_forward(element(1, Message),
-                                                  element(2, Message)).
+%% Deliveries re-entering from the wire/drain path.  The reference's
+%% receive path accepts several shapes (pluggable :1696-1885); match
+%% them instead of assuming a 2-tuple.
+receive_message(_Peer, _Channel, {forward_message, ServerRef, Message}) ->
+    partisan_peer_service_manager:deliver(ServerRef, Message);
+receive_message(_Peer, _Channel, {forward_message, _From, _Clock,
+                                  _PartitionKey, ServerRef, _Opts,
+                                  Message}) ->
+    partisan_peer_service_manager:deliver(ServerRef, Message);
+receive_message(_Peer, _Channel, {ServerRef, Message}) ->
+    partisan_peer_service_manager:deliver(ServerRef, Message);
+receive_message(Peer, _Channel, Message) ->
+    %% unknown shape: hand to the manager process (never crash the
+    %% receive path on a new message family)
+    gen_server:cast(?MODULE, {unhandled, Peer, Message}).
 
 inject_partition(Origin, TTL) ->
     gen_server:call(?MODULE, {inject_partition, Origin, TTL}, infinity).
@@ -130,20 +187,30 @@ resolve_partition(Reference) ->
     gen_server:call(?MODULE, {resolve_partition, Reference}, infinity).
 
 partitions() ->
-    {error, notsup}.
+    gen_server:call(?MODULE, partitions, infinity).
 
 on_up(Node, Fun) ->
+    on_up(Node, Fun, #{}).
+
+on_up(Node, Fun, _Opts) ->
     gen_server:call(?MODULE, {on_up, Node, Fun}, infinity).
 
 on_down(Node, Fun) ->
+    on_down(Node, Fun, #{}).
+
+on_down(Node, Fun, _Opts) ->
     gen_server:call(?MODULE, {on_down, Node, Fun}, infinity).
 
+decode({state, Members}) ->
+    Members;
 decode(State) ->
     State.
 
-reserve(_Tag) ->
-    {error, no_available_slots}.
+reserve(Tag) ->
+    gen_server:call(?MODULE, {reserve, Tag}, infinity).
 
+%% The simulated transport delivers monitoring signals via membership
+%% diffs (on_up/on_down); process-level monitoring rides the OTP layer.
 supports_capability(monitoring) -> false;
 supports_capability(_) -> false.
 
@@ -176,6 +243,20 @@ handle_call({join, NodeSpec}, _From, State0) ->
     ok = rpc_port(State#state.port, {join, Id, State#state.self_id}),
     {reply, ok, State};
 
+handle_call({sync_join, NodeSpec}, _From, State0) ->
+    {Id, State} = intern_node(NodeSpec, State0),
+    P = State#state.port,
+    ok = rpc_port(P, {join, Id, State#state.self_id}),
+    Reply = wait_member(P, State#state.self_id, Id, 50),
+    {reply, Reply, State};
+
+handle_call(partitions, _From, State = #state{partitions = Ps}) ->
+    {reply, {ok, maps:to_list(Ps)}, State};
+
+handle_call({reserve, _Tag}, _From, State = #state{port = P,
+                                                   self_id = Me}) ->
+    {reply, rpc_port(P, {reserve, Me, 1}), State};
+
 handle_call(leave, _From, State = #state{port = P, self_id = Me}) ->
     ok = rpc_port(P, {leave, Me}),
     {reply, ok, State};
@@ -192,14 +273,25 @@ handle_call({forward, Node, ServerRef, Message}, _From, State0) ->
                   {forward_message, State#state.self_id, Dst, Words}),
     {reply, ok, State};
 
-handle_call({inject_partition, _Origin, _TTL}, _From, State) ->
-    ok = rpc_port(State#state.port,
-                  {inject_partition, [State#state.self_id], [1]}),
-    {reply, {ok, make_ref()}, State};
+handle_call({inject_partition, Origin, TTL}, _From,
+            State = #state{partitions = Ps, port = P, self_id = Me}) ->
+    %% Sever this node from EVERYONE else (hyparview impl pattern,
+    %% reference :1226-1232).  The empty second group is the bridge
+    %% protocol's complement form — the simulator severs [Me] from all
+    %% other sim nodes, including ones this VM never interned.
+    Ref = make_ref(),
+    ok = rpc_port(P, {inject_partition, [Me], []}),
+    {reply, {ok, Ref},
+     State#state{partitions = Ps#{Ref => {Origin, TTL}}}};
 
-handle_call({resolve_partition, _Ref}, _From, State) ->
-    ok = rpc_port(State#state.port, {resolve_partition}),
-    {reply, ok, State};
+handle_call({resolve_partition, Ref}, _From,
+            State = #state{partitions = Ps, port = P}) ->
+    Ps1 = maps:remove(Ref, Ps),
+    case maps:size(Ps1) of
+        0 -> ok = rpc_port(P, {resolve_partition});
+        _ -> ok
+    end,
+    {reply, ok, State#state{partitions = Ps1}};
 
 handle_call({on_up, Node, Fun}, _From, State = #state{up_funs = U}) ->
     {reply, ok, State#state{up_funs = [{Node, Fun} | U]}};
@@ -210,6 +302,9 @@ handle_call({on_down, Node, Fun}, _From, State = #state{down_funs = D}) ->
 handle_call(_Other, _From, State) ->
     {reply, {error, notsup}, State}.
 
+handle_cast({unhandled, _Peer, _Message}, State) ->
+    %% unknown wire shape: logged-and-dropped rather than a crash
+    {noreply, State};
 handle_cast(_Msg, State) ->
     {noreply, State}.
 
@@ -266,6 +361,23 @@ await_reply(Port, Seq) ->
             end
     after 120000 ->
         {error, bridge_timeout}
+    end.
+
+%% sync_join completion: step the simulator until the joined id appears
+%% in our member view (bounded; ~Attempts simulated rounds).
+wait_member(_P, _Me, _Id, 0) ->
+    {error, timeout};
+wait_member(P, Me, Id, Attempts) ->
+    case rpc_port(P, {members, Me}) of
+        {ok, Members} ->
+            case lists:member(Id, Members) of
+                true -> ok;
+                false ->
+                    {ok, _} = rpc_port(P, {step, 1}),
+                    wait_member(P, Me, Id, Attempts - 1)
+            end;
+        Other ->
+            Other
     end.
 
 intern_node(#{name := Name}, State) ->

@@ -88,8 +88,11 @@ class Bridge:
 
         cl, st = self.cl, self.st
         if cmd == "join":
+            node, target = int(args[0]), int(args[1])
+            if node == target:
+                return OK          # joining oneself is a no-op
             self.st = st._replace(manager=cl.manager.join(
-                cl.cfg, st.manager, int(args[0]), int(args[1])))
+                cl.cfg, st.manager, node, target))
             return OK
         if cmd == "leave":
             self.st = st._replace(manager=cl.manager.leave(
@@ -149,13 +152,31 @@ class Bridge:
             self.st = st._replace(faults=faults_mod.recover(st.faults, int(args[0])))
             return OK
         if cmd == "inject_partition":
+            a = [int(x) for x in args[0]]
+            b = [int(x) for x in args[1]]
+            if not b:
+                # Complement form: sever group A from EVERYONE else —
+                # what an Erlang node means by "partition me off" when
+                # it has not interned the whole cluster.
+                b = [i for i in range(cl.cfg.n_nodes) if i not in set(a)]
             self.st = st._replace(faults=faults_mod.inject_partition(
-                st.faults, [int(x) for x in args[0]],
-                [int(x) for x in args[1]]))
+                st.faults, a, b))
             return OK
         if cmd == "resolve_partition":
             self.st = st._replace(
                 faults=faults_mod.resolve_partition(st.faults))
+            return OK
+        if cmd == "reserve":
+            # Hold back admission slots (reserve/1).  Only overlay
+            # managers with bounded views implement it; the full-mesh
+            # manager accepts and ignores (every peer already connects).
+            node, count = int(args[0]), int(args[1]) if len(args) > 1 else 1
+            if hasattr(cl.manager, "reserve"):
+                try:
+                    self.st = st._replace(manager=cl.manager.reserve(
+                        cl.cfg, st.manager, node, count))
+                except ValueError:
+                    return (Atom("error"), Atom("no_available_slots"))
             return OK
         if cmd == "stats":
             s = self.st.stats

@@ -135,10 +135,11 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     ev_delivered = comm.allsum(jnp.sum(inbox.count, dtype=jnp.int32))
 
     causal_delivered = jnp.int32(0)
-    if wides:
-        # Causal lanes bypass route(): inbound gathers the bounded actor
-        # block itself, applies per-receiver transmission faults, and
-        # suppresses dead receivers internally.
+    if delivery_mod.needs_inbound(cfg):
+        # Causal broadcast lanes bypass route(): inbound gathers the
+        # bounded actor block itself, applies per-receiver transmission
+        # faults, and suppresses dead receivers internally.  P2p causal
+        # lanes ride route() and are re-ordered out of the inbox here.
         dstate, inbox, causal_delivered = delivery_mod.inbound(
             cfg, comm, dstate, inbox, wides, ctx)
 

@@ -126,13 +126,24 @@ class Config:
     # --- delivery semantics knobs --------------------------------------
     relay_ttl: int = 5                   # include/partisan.hrl:138
     broadcast: bool = True               # transitive tree relay enabled
-    causal_labels: tuple[str, ...] = ()  # one causality lane per label
+    causal_labels: tuple[str, ...] = ()  # one causal BROADCAST lane per
+    #                                      label (bounded actor space)
     ack_cap: int = 0                     # outstanding acked sends per node
                                          #   (0 disables the ack lane)
     causal_buf_cap: int = 8              # undelivered causal msgs buffered
     causal_emit_cap: int = 4             # causal sends per node per round
     causal_hist_cap: int = 8             # sender-side re-emission history
     causal_deliver_cap: int = 16         # causal deliveries per node/round
+    # Point-to-point causal lanes (partisan_causality_backend.erl
+    # :204-220 per-destination scheme): ANY node may send; state is
+    # O(n·const) so it scales to the full cluster.  Lane ids continue
+    # after causal_labels (see causal_lane_id).
+    causal_p2p_labels: tuple[str, ...] = ()
+    p2p_dst_cap: int = 64         # sender-side per-destination seq table
+    p2p_src_cap: int = 64         # receiver-side per-sender seq table
+    p2p_buf_cap: int = 8          # out-of-order arrivals buffered
+    p2p_hist_cap: int = 8         # sender replay ring
+    p2p_emit_cap: int = 4         # p2p causal sends per node per round
 
     # --- channels ------------------------------------------------------
     channels: tuple[ChannelSpec, ...] = DEFAULT_CHANNELS
@@ -200,6 +211,19 @@ class Config:
 
     def channel(self, name: str) -> ChannelSpec:
         return self.channels[self.channel_id(name)]
+
+    def causal_lane_id(self, label: str) -> int:
+        """Lane index for W_LANE: broadcast lanes first, then p2p lanes
+        (one shared index space, mirroring the reference's one causality
+        backend per configured label)."""
+        if label in self.causal_labels:
+            return self.causal_labels.index(label)
+        if label in self.causal_p2p_labels:
+            return len(self.causal_labels) + \
+                self.causal_p2p_labels.index(label)
+        raise KeyError(
+            f"unknown causal label {label!r}; have "
+            f"{self.causal_labels + self.causal_p2p_labels}")
 
     @property
     def resolved_partition_mode(self) -> str:

@@ -58,10 +58,18 @@ from partisan_tpu import faults as faults_mod
 from partisan_tpu import types as T
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
-from partisan_tpu.ops import exchange, vclock
+from partisan_tpu.ops import exchange, vclock, views
+from partisan_tpu.ops import rng as rng_ops
 
 CAUSAL_SWEEPS = 3     # in-round delivery passes (chain depth per round)
 _CAUSAL_SALT = 21     # fault-filter call-site salt for causal lanes
+_P2P_EPOCH_TAG = 330  # rank32 tag base for p2p stream epochs
+_P2P_REOPEN_TAG = 340  # rank32 tag base for reset-reopened epochs
+_P2P_RESET_SLOTS = 4  # pending stream-reset requests per node per lane
+_EPOCH_MASK = (1 << 22) - 1  # 22-bit stream epochs (W_LANE bits 8..29:
+#                              epoch << 8 must stay inside int32; 22
+#                              bits put an accidental old-epoch
+#                              collision after a tracking loss at ~2^-22)
 
 
 class AckState(NamedTuple):
@@ -78,20 +86,87 @@ class CausalLane(NamedTuple):
     overflow: Array   # int32 — records dropped: emit/buffer slots full
 
 
+class P2PLane(NamedTuple):
+    """Point-to-point causal lane (per-destination dependency scheme,
+    partisan_causality_backend.erl:204-220): ANY node may send.
+
+    The reference's guarantee is per-(sender → destination) FIFO — each
+    message's dependency is the sender's previous send to that same
+    destination (the filtered order buffer, :181-190) — with
+    opportunistic transitive strengthening via vclock dominance that the
+    reference itself documents as approximate.  The tensor encoding
+    implements the FIFO contract exactly with per-edge sequence numbers
+    and bounded id-keyed bucket tables on both ends (O(n·const) state,
+    so it scales to the full cluster — no bounded actor space):
+
+    - sender keeps (dst → seq, epoch) in a ``p2p_dst_cap``-bucket table;
+      a bucket collision evicts the old stream, and the NEXT send to the
+      evicted destination starts a fresh stream under a new epoch,
+    - receiver keeps (src → last-delivered seq, epoch) likewise; an
+      unknown or new-epoch stream delivers its first arrival immediately
+      (the reference's no-dependency-entry branch, :309-314) and is FIFO
+      from there,
+    - loss recovery is go-back-N: every sent record holds a slot in a
+      bounded UNACKED store replayed on the retransmit cadence until
+      the receiver's cumulative stream ack (``P2P_ACK``) covers it; a
+      full store DROPS new sends visibly (counted ``overflow``, seq not
+      advanced) instead of silently overwriting an unacked record —
+      backpressure, never a wedged stream.  Receivers re-ack on
+      duplicate arrivals, so a lost ack cannot wedge the store either.
+
+    App-visible delivery is exactly-once per stream in per-edge FIFO
+    order.  A tracking reset (bucket collision, ``resets`` counter) ends
+    a stream: its unacked records are aborted (``aborted`` counter) and
+    the next send opens a fresh epoch — the graceful-degradation
+    boundary of the bounded tables (size ``p2p_src_cap`` to the expected
+    distinct-sender working set per receiver for exact semantics).
+    """
+
+    dst_ids: Array   # int32[n, DC] — sender table: destination ids
+    dst_seq: Array   # int32[n, DC] — messages sent to that destination
+    dst_ep: Array    # int32[n, DC] — stream epoch
+    src_ids: Array   # int32[n, SC] — receiver table: sender ids
+    src_seq: Array   # int32[n, SC] — last delivered seq from that sender
+    src_ep: Array    # int32[n, SC] — stream epoch
+    src_acked: Array  # int32[n, SC] — highest seq cumulatively acked
+    reack: Array     # bool[n, SC] — duplicate seen: re-send the ack
+    reset_req: Array  # int32[n, R] — senders whose stream arrived
+    #                  mid-sequence with no tracking (receiver-side
+    #                  eviction): ask them to re-open the stream
+    reset_seq: Array  # int32[n, R] — the orphan seq observed (lets the
+    #                  sender distinguish true watermark loss from plain
+    #                  in-flight reordering and ignore stale requests)
+    buf: Array       # int32[n, B, W] — out-of-order arrivals
+    hist: Array      # int32[n, H, W] — UNACKED sent records (kind==0
+    #                  marks a free slot; freed by P2P_ACK)
+    overflow: Array  # int32 — sends dropped (unacked store full /
+    #                  emit cap) + future-buffer sheds
+    resets: Array    # int32 — bucket evictions (stream tracking resets)
+    aborted: Array   # int32 — unacked records dropped because their
+    #                  stream reset or their destination crashed
+
+
 class DeliveryState(NamedTuple):
     ack: AckState | tuple
     lanes: tuple           # one CausalLane per cfg.causal_labels entry
+    p2p: tuple             # one P2PLane per cfg.causal_p2p_labels entry
     invalid_causal: Array  # int32 — F_CAUSAL sends dropped (non-actor
                            #   sender or unconfigured lane)
 
 
 def enabled(cfg: Config) -> bool:
-    return cfg.ack_cap > 0 or bool(cfg.causal_labels)
+    return cfg.ack_cap > 0 or bool(cfg.causal_labels) \
+        or bool(cfg.causal_p2p_labels)
+
+
+def needs_inbound(cfg: Config) -> bool:
+    return bool(cfg.causal_labels) or bool(cfg.causal_p2p_labels)
 
 
 def init(cfg: Config, comm) -> DeliveryState:
     n = comm.n_local
-    WA = cfg.msg_words + cfg.n_actors
+    W = cfg.msg_words
+    WA = W + cfg.n_actors
     ack = AckState(
         outstanding=jnp.zeros((n, cfg.ack_cap, cfg.msg_words), jnp.int32),
         next_clock=jnp.ones((n,), jnp.int32),
@@ -107,8 +182,42 @@ def init(cfg: Config, comm) -> DeliveryState:
         )
         for _ in cfg.causal_labels
     )
-    return DeliveryState(ack=ack, lanes=lanes,
+    p2p = tuple(
+        P2PLane(
+            dst_ids=jnp.full((n, cfg.p2p_dst_cap), -1, jnp.int32),
+            dst_seq=jnp.zeros((n, cfg.p2p_dst_cap), jnp.int32),
+            dst_ep=jnp.zeros((n, cfg.p2p_dst_cap), jnp.int32),
+            src_ids=jnp.full((n, cfg.p2p_src_cap), -1, jnp.int32),
+            src_seq=jnp.zeros((n, cfg.p2p_src_cap), jnp.int32),
+            src_ep=jnp.zeros((n, cfg.p2p_src_cap), jnp.int32),
+            src_acked=jnp.zeros((n, cfg.p2p_src_cap), jnp.int32),
+            reack=jnp.zeros((n, cfg.p2p_src_cap), jnp.bool_),
+            reset_req=jnp.full((n, _P2P_RESET_SLOTS), -1, jnp.int32),
+            reset_seq=jnp.zeros((n, _P2P_RESET_SLOTS), jnp.int32),
+            buf=jnp.zeros((n, cfg.p2p_buf_cap, W), jnp.int32),
+            hist=jnp.zeros((n, cfg.p2p_hist_cap, W), jnp.int32),
+            overflow=jnp.int32(0),
+            resets=jnp.int32(0),
+            aborted=jnp.int32(0),
+        )
+        for _ in cfg.causal_p2p_labels
+    )
+    return DeliveryState(ack=ack, lanes=lanes, p2p=p2p,
                          invalid_causal=jnp.int32(0))
+
+
+def _free_slot_of_rank(free: Array) -> Array:
+    """Map send rank -> store slot: ``out[i, r]`` is the index of row
+    i's r-th free slot (``S`` = none).  free: bool[n, S]."""
+    n, S = free.shape
+    free_rank = jnp.cumsum(free, axis=1) - 1
+    rows_n = jnp.arange(n)[:, None]
+    out = jnp.full((n, S), S, jnp.int32)
+    return out.at[
+        jnp.broadcast_to(rows_n, free.shape),
+        jnp.where(free, free_rank, S)
+    ].set(jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
+                           free.shape), mode="drop")
 
 
 def _compact(rows: Array, mask: Array, cap: int) -> tuple[Array, Array]:
@@ -188,19 +297,10 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
         # where k is the send's order among this round's fresh sends.
         C = cfg.ack_cap
         free = out[..., T.W_KIND] == 0
-        free_rank = jnp.cumsum(free, axis=1) - 1
         rows_n = jnp.arange(n)[:, None]
-        # slot_of_rank[i, r] = index of node i's r-th free slot (C = none).
-        slot_of_rank = jnp.full((n, C), C, jnp.int32)
-        slot_of_rank = slot_of_rank.at[
-            jnp.broadcast_to(rows_n, free.shape),
-            jnp.where(free, free_rank, C)
-        ].set(jnp.broadcast_to(
-            jnp.arange(C, dtype=jnp.int32)[None, :], free.shape),
-            mode="drop")
         n_free = free.sum(axis=1)
         tgt = jnp.take_along_axis(
-            slot_of_rank, jnp.clip(rank, 0, C - 1), axis=1)
+            _free_slot_of_rank(free), jnp.clip(rank, 0, C - 1), axis=1)
         store_slot = jnp.where(fresh & (rank < n_free[:, None]), tgt, C)
         out = out.at[
             jnp.broadcast_to(rows_n, store_slot.shape), store_slot
@@ -289,13 +389,280 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
         emitted = emitted.at[..., T.W_KIND].set(
             jnp.where(is_c_all, 0, emitted[..., T.W_KIND]))
 
-    # Any message still flagged F_CAUSAL was emitted by a non-actor node
-    # or names an unconfigured lane: it must NOT leak onto the unicast
-    # path unordered.  Drop it and account for it.
+    # 6. Point-to-point causal lanes, send side (emit, causality_backend
+    #    :172-201): consume stream acks, stamp per-edge seq + epoch onto
+    #    this round's p2p sends (go-back-N: a send only goes out if the
+    #    unacked store has a slot for it), generate our own cumulative
+    #    acks as a receiver, and put everything on the event lane.
+    W = cfg.msg_words
+    p2p_out = []
+    for pi, lane in enumerate(st.p2p):
+        lid = len(cfg.causal_labels) + pi
+        DC, EC = cfg.p2p_dst_cap, cfg.p2p_emit_cap
+        H = cfg.p2p_hist_cap
+
+        # 6a. Consume arriving P2P_ACKs: free unacked records covered by
+        # the cumulative (dst, epoch, seq) ack.  A NEGATIVE ack clock is
+        # a stream-RESET request (the receiver lost its watermark): the
+        # stream reopens under a fresh epoch — its unacked records are
+        # re-stamped seq 1.. in order and replayed, so the undelivered
+        # prefix survives (records the receiver delivered but whose ack
+        # was lost re-deliver: the reset boundary is an at-least-once
+        # window, see the class docstring).
+        hist = lane.hist
+        is_ack_in = (kind_in == T.MsgKind.P2P_ACK) \
+            & ((inb[..., T.W_LANE] & 0xFF) == lid)
+        is_cum = is_ack_in & (inb[..., T.W_CLOCK] >= 0)
+        is_rst = is_ack_in & (inb[..., T.W_CLOCK] < 0)
+        h_dst = hist[..., T.W_DST]
+        h_seq = hist[..., T.W_CLOCK]
+        h_ep = (hist[..., T.W_LANE] >> 8) & _EPOCH_MASK
+        covered = (
+            is_cum[:, None, :]
+            & (h_dst[:, :, None] == inb[..., T.W_SRC][:, None, :])
+            & (h_ep[:, :, None] == ((inb[..., T.W_LANE] >> 8)
+                                    & _EPOCH_MASK)[:, None, :])
+            & (h_seq[:, :, None] <= inb[..., T.W_CLOCK][:, None, :])
+        ).any(axis=2) & (hist[..., T.W_KIND] != 0)
+        hist = hist.at[..., T.W_KIND].set(
+            jnp.where(covered, 0, hist[..., T.W_KIND]))
+
+        # Stream reopen: re-stamp every unacked record to a requesting
+        # destination and reset the dst table entry.  A request names
+        # the orphan seq k it observed (clock = -k); it acts ONLY when
+        # nothing below k is still unacked here — if it is, this was
+        # plain in-flight reordering and the ordinary go-back-N replay
+        # recovers it (reopening then would re-deliver the prefix).
+        h_dst = hist[..., T.W_DST]
+        h_seq = hist[..., T.W_CLOCK]
+        h_valid = hist[..., T.W_KIND] != 0
+        rst_k = -inb[..., T.W_CLOCK]                           # [n, cap]
+        below_unacked = (
+            h_valid[:, :, None]
+            & (h_dst[:, :, None] == inb[..., T.W_SRC][:, None, :])
+            & (h_seq[:, :, None] < rst_k[:, None, :])
+        ).any(axis=1)                                          # [n, cap]
+        is_rst = is_rst & ~below_unacked
+        rec_rst = h_valid & (
+            is_rst[:, None, :]
+            & (h_dst[:, :, None] == inb[..., T.W_SRC][:, None, :])
+        ).any(axis=2)                                          # [n, H]
+        reopen_ep = (rng_ops.rank32(cfg.seed, ctx.rnd,
+                                    _P2P_REOPEN_TAG + pi,
+                                    gids[:, None], jnp.maximum(h_dst, 0))
+                     % jnp.uint32(_EPOCH_MASK) + 1).astype(jnp.int32)
+        h_idx = jnp.arange(H)
+        same_d = (h_dst[:, :, None] == h_dst[:, None, :]) \
+            & rec_rst[:, :, None] & rec_rst[:, None, :]
+        before = same_d & (
+            (h_seq[:, None, :] < h_seq[:, :, None])
+            | ((h_seq[:, None, :] == h_seq[:, :, None])
+               & (h_idx[None, None, :] < h_idx[None, :, None])))
+        new_seq_r = jnp.sum(before, axis=2) + 1
+        hist = hist.at[..., T.W_CLOCK].set(
+            jnp.where(rec_rst, new_seq_r, hist[..., T.W_CLOCK]))
+        hist = hist.at[..., T.W_LANE].set(
+            jnp.where(rec_rst, lid | (reopen_ep << 8),
+                      hist[..., T.W_LANE]))
+        # dst-table reopen: clear every requested entry, then re-point
+        # entries that still have records at (count, fresh epoch).
+        tbl_rst = (is_rst[:, None, :]
+                   & (lane.dst_ids[:, :, None]
+                      == inb[..., T.W_SRC][:, None, :])).any(axis=2) \
+            & (lane.dst_ids >= 0)                              # [n, DC]
+        dst_ids0 = jnp.where(tbl_rst, -1, lane.dst_ids)
+        dst_seq0 = jnp.where(tbl_rst, 0, lane.dst_seq)
+        dst_ep0 = jnp.where(tbl_rst, 0, lane.dst_ep)
+        hb_r = views.bucket_slot(jnp.maximum(h_dst, 0), DC)
+        is_last_r = rec_rst & ~jnp.any(
+            same_d & (new_seq_r[:, None, :] > new_seq_r[:, :, None]),
+            axis=2)
+        hit_r = is_last_r[:, None, :] & \
+            (hb_r[:, None, :] == jnp.arange(DC)[None, :, None])
+        anyhit_r = jnp.any(hit_r, axis=2)
+        wslot_r = jnp.argmax(hit_r, axis=2)
+        dst_ids0 = jnp.where(anyhit_r,
+                             jnp.take_along_axis(h_dst, wslot_r, axis=1),
+                             dst_ids0)
+        dst_seq0 = jnp.where(anyhit_r,
+                             jnp.take_along_axis(new_seq_r, wslot_r,
+                                                 axis=1), dst_seq0)
+        dst_ep0 = jnp.where(anyhit_r,
+                            jnp.take_along_axis(reopen_ep, wslot_r,
+                                                axis=1), dst_ep0)
+
+        # A dead destination ends its streams: clear the table entries
+        # so a recovered destination gets a FRESH stream (seq 1, new
+        # epoch) instead of a watermark gap it can never fill.
+        tbl_dead = (dst_ids0 >= 0) \
+            & ~ctx.faults.alive[jnp.maximum(dst_ids0, 0)]
+        dst_ids0 = jnp.where(tbl_dead, -1, dst_ids0)
+        dst_seq0 = jnp.where(tbl_dead, 0, dst_seq0)
+        dst_ep0 = jnp.where(tbl_dead, 0, dst_ep0)
+
+        # Abort unacked records whose stream is gone: the dst table no
+        # longer tracks (dst, epoch) — bucket collision, reset, or the
+        # destination died.
+        h_ep2 = (hist[..., T.W_LANE] >> 8) & _EPOCH_MASK
+        hb = views.bucket_slot(jnp.maximum(h_dst, 0), DC)
+        hb_id = jnp.take_along_axis(dst_ids0, hb, axis=1)
+        hb_ep = jnp.take_along_axis(dst_ep0, hb, axis=1)
+        stream_live = (hb_id == h_dst) & (hb_ep == h_ep2) \
+            & ctx.faults.alive[jnp.maximum(h_dst, 0)]
+        aborted = (hist[..., T.W_KIND] != 0) & ~stream_live
+        n_aborted = comm.allsum(jnp.sum(aborted, dtype=jnp.int32))
+        hist = hist.at[..., T.W_KIND].set(
+            jnp.where(aborted, 0, hist[..., T.W_KIND]))
+
+        # Emit our own pending stream-reset requests (as a receiver).
+        rr_ids = lane.reset_req
+        rst_msgs = jnp.zeros((n, rr_ids.shape[1], W), jnp.int32)
+        rst_on = rr_ids >= 0
+        rst_msgs = rst_msgs.at[..., T.W_KIND].set(
+            jnp.where(rst_on, T.MsgKind.P2P_ACK, 0))
+        rst_msgs = rst_msgs.at[..., T.W_SRC].set(
+            jnp.where(rst_on, gids[:, None], 0))
+        rst_msgs = rst_msgs.at[..., T.W_DST].set(
+            jnp.where(rst_on, rr_ids, 0))
+        rst_msgs = rst_msgs.at[..., T.W_CLOCK].set(
+            jnp.where(rst_on, -jnp.maximum(lane.reset_seq, 1), 0))
+        rst_msgs = rst_msgs.at[..., T.W_LANE].set(
+            jnp.where(rst_on, lid, 0))
+
+        # 6b. Compact + admit this round's fresh sends against the free
+        # store slots (drop visibly when full — never wedge a stream).
+        is_p = (emitted[..., T.W_KIND] != 0) \
+            & (emitted[..., T.W_FLAGS] & T.F_CAUSAL != 0) \
+            & (emitted[..., T.W_FLAGS] & T.F_P2P_STAMPED == 0) \
+            & (emitted[..., T.W_LANE] == lid) & ctx.alive[:, None] \
+            & (emitted[..., T.W_DST] >= 0)
+        packed, cap_dropped = _compact(emitted, is_p, EC)
+        emitted = emitted.at[..., T.W_KIND].set(
+            jnp.where(is_p, 0, emitted[..., T.W_KIND]))
+        free = hist[..., T.W_KIND] == 0
+        n_free = free.sum(axis=1, dtype=jnp.int32)
+        valid0 = packed[..., T.W_KIND] != 0
+        vrank = jnp.cumsum(valid0, axis=1) - 1
+        kept = valid0 & (vrank < n_free[:, None])
+        n_backpressured = comm.allsum(jnp.sum(valid0 & ~kept,
+                                              dtype=jnp.int32))
+        packed = packed.at[..., T.W_KIND].set(
+            jnp.where(kept, packed[..., T.W_KIND], 0))
+        valid = kept
+
+        # 6c. Stamp per-edge seq + stream epoch on the kept sends.
+        d = packed[..., T.W_DST]
+        b = views.bucket_slot(jnp.maximum(d, 0), DC)           # [n, EC]
+        t_id = jnp.take_along_axis(dst_ids0, b, axis=1)
+        tracked = (t_id == d) & valid
+        cur_seq = jnp.where(tracked,
+                            jnp.take_along_axis(dst_seq0, b, axis=1), 0)
+        cur_ep = jnp.where(tracked,
+                           jnp.take_along_axis(dst_ep0, b, axis=1), 0)
+        fresh_ep = (rng_ops.rank32(cfg.seed, ctx.rnd, _P2P_EPOCH_TAG + pi,
+                                   gids[:, None], jnp.maximum(d, 0))
+                    % jnp.uint32(_EPOCH_MASK) + 1).astype(jnp.int32)
+        ep = jnp.where(tracked, cur_ep, fresh_ep)
+        # rank among same-destination sends this round (EC is tiny)
+        ec_idx = jnp.arange(EC)
+        samem = (d[:, :, None] == d[:, None, :]) \
+            & valid[:, :, None] & valid[:, None, :]
+        rank = jnp.sum(samem & (ec_idx[None, None, :] < ec_idx[None, :, None]),
+                       axis=2)
+        seq = cur_seq + rank + 1
+        packed = packed.at[..., T.W_CLOCK].set(
+            jnp.where(valid, seq, packed[..., T.W_CLOCK]))
+        packed = packed.at[..., T.W_LANE].set(
+            jnp.where(valid, lid | (ep << 8), packed[..., T.W_LANE]))
+        packed = packed.at[..., T.W_FLAGS].set(
+            jnp.where(valid, packed[..., T.W_FLAGS] | T.F_P2P_STAMPED,
+                      packed[..., T.W_FLAGS]))
+
+        # Table update: the LAST kept send per destination this round.
+        is_last = valid & ~jnp.any(
+            samem & (ec_idx[None, None, :] > ec_idx[None, :, None]), axis=2)
+        hit = is_last[:, None, :] & \
+            (b[:, None, :] == jnp.arange(DC)[None, :, None])   # [n, DC, EC]
+        anyhit = jnp.any(hit, axis=2)
+        wslot = jnp.argmax(hit, axis=2)                        # [n, DC]
+        new_id = jnp.take_along_axis(d, wslot, axis=1)
+        new_seq = jnp.take_along_axis(seq, wslot, axis=1)
+        new_ep = jnp.take_along_axis(ep, wslot, axis=1)
+        resets = comm.allsum(jnp.sum(
+            anyhit & (dst_ids0 >= 0) & (dst_ids0 != new_id),
+            dtype=jnp.int32))
+        dst_ids = jnp.where(anyhit, new_id, dst_ids0)
+        dst_seq = jnp.where(anyhit, new_seq, dst_seq0)
+        dst_ep = jnp.where(anyhit, new_ep, dst_ep0)
+
+        # 6d. Store kept sends into free slots; replay the whole unacked
+        # store on the retransmit cadence (go-back-N re-send).
+        rows_n2 = jnp.arange(n)[:, None]
+        tgt = jnp.take_along_axis(
+            _free_slot_of_rank(free), jnp.clip(vrank, 0, H - 1), axis=1)
+        store_slot = jnp.where(kept, tgt, H)
+        hist = hist.at[
+            jnp.broadcast_to(rows_n2, store_slot.shape), store_slot
+        ].set(packed, mode="drop")
+        refire = ((ctx.rnd + gids) % cfg.retransmit_every == 0) & ctx.alive
+        # Fresh records already went out this round via `packed`;
+        # replaying them same-round is harmless (receivers dedup) but
+        # wasteful, so exclude the slots just written.
+        just_written = jnp.zeros((n, H), jnp.bool_).at[
+            jnp.broadcast_to(rows_n2, store_slot.shape), store_slot
+        ].set(True, mode="drop")
+        live_slot = refire[:, None] & (hist[..., T.W_KIND] != 0) \
+            & ~just_written
+        replay = hist.at[..., T.W_FLAGS].set(
+            hist[..., T.W_FLAGS] | T.F_RETRANSMISSION)
+        replay = jnp.where(live_slot[..., None], replay, 0)
+
+        # 6e. Receiver-side cumulative acks: on the retransmit cadence
+        # (or sooner when a duplicate signalled a lost ack), ack every
+        # tracked stream with unacked progress.
+        ack_due = (lane.src_seq > lane.src_acked) & (lane.src_ids >= 0)
+        ack_now = (ack_due & refire[:, None]) | \
+            (lane.reack & (lane.src_ids >= 0))
+        ack_msgs = jnp.zeros((n, lane.src_ids.shape[1], W), jnp.int32)
+        ack_msgs = ack_msgs.at[..., T.W_KIND].set(
+            jnp.where(ack_now, T.MsgKind.P2P_ACK, 0))
+        ack_msgs = ack_msgs.at[..., T.W_SRC].set(
+            jnp.where(ack_now, gids[:, None], 0))
+        ack_msgs = ack_msgs.at[..., T.W_DST].set(
+            jnp.where(ack_now, lane.src_ids, 0))
+        ack_msgs = ack_msgs.at[..., T.W_CLOCK].set(
+            jnp.where(ack_now, lane.src_seq, 0))
+        ack_msgs = ack_msgs.at[..., T.W_LANE].set(
+            jnp.where(ack_now, lid | (lane.src_ep << 8), 0))
+        src_acked = jnp.where(ack_now, lane.src_seq, lane.src_acked)
+
+        alive1 = ctx.alive[:, None]
+        p2p_out.append(lane._replace(
+            dst_ids=jnp.where(alive1, dst_ids, lane.dst_ids),
+            dst_seq=jnp.where(alive1, dst_seq, lane.dst_seq),
+            dst_ep=jnp.where(alive1, dst_ep, lane.dst_ep),
+            src_acked=jnp.where(alive1, src_acked, lane.src_acked),
+            reack=jnp.where(alive1, lane.reack & ~ack_now, lane.reack),
+            reset_req=jnp.where(alive1, jnp.full_like(lane.reset_req, -1),
+                                lane.reset_req),
+            hist=jnp.where(alive1[..., None], hist, lane.hist),
+            overflow=lane.overflow + comm.allsum(cap_dropped)
+            + n_backpressured,
+            resets=lane.resets + resets,
+            aborted=lane.aborted + n_aborted))
+        extra.append(packed)
+        extra.append(replay)
+        extra.append(ack_msgs)
+        extra.append(rst_msgs)
+
+    # Any message still flagged F_CAUSAL (and not a stamped p2p record)
+    # was emitted by a non-actor node or names an unconfigured lane: it
+    # must NOT leak onto the unicast path unordered.  Drop + account.
     invalid = jnp.int32(0)
-    if st.lanes:
-        leak = (emitted[..., T.W_KIND] != 0) & \
-            (emitted[..., T.W_FLAGS] & T.F_CAUSAL != 0)
+    if st.lanes or st.p2p:
+        leak = (emitted[..., T.W_KIND] != 0) \
+            & (emitted[..., T.W_FLAGS] & T.F_CAUSAL != 0) \
+            & (emitted[..., T.W_FLAGS] & T.F_P2P_STAMPED == 0)
         invalid = comm.allsum(jnp.sum(leak, dtype=jnp.int32))
         emitted = emitted.at[..., T.W_KIND].set(
             jnp.where(leak, 0, emitted[..., T.W_KIND]))
@@ -303,6 +670,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
     if extra:
         emitted = jnp.concatenate([emitted] + extra, axis=1)
     return (DeliveryState(ack=ack, lanes=tuple(lanes_out),
+                          p2p=tuple(p2p_out),
                           invalid_causal=st.invalid_causal + invalid),
             emitted, tuple(wide_out))
 
@@ -501,4 +869,189 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
             overflow=lane.overflow + buf_overflow,
         ))
 
-    return st._replace(lanes=tuple(lanes_out)), inbox, n_causal
+    # ---- point-to-point lanes (receive side of the per-destination
+    # scheme, causality_backend :204-220 + :309-344): candidates = this
+    # round's routed arrivals + the out-of-order buffer; a record
+    # delivers when its stream is in order (seq == last+1), a new or
+    # re-epoched stream delivers its first (lowest-seq) arrival
+    # immediately, covered seqs drop as replay duplicates, futures
+    # re-buffer.
+    p2p_out = []
+    for pi, lane in enumerate(st.p2p):
+        lid = len(cfg.causal_labels) + pi
+        SC, B2 = cfg.p2p_src_cap, cfg.p2p_buf_cap
+        msgs = inbox.data
+        cap = msgs.shape[1]
+        flagsm = msgs[..., T.W_FLAGS]
+        is_p = (msgs[..., T.W_KIND] != 0) \
+            & (flagsm & T.F_CAUSAL != 0) \
+            & (flagsm & T.F_P2P_STAMPED != 0) \
+            & ((msgs[..., T.W_LANE] & 0xFF) == lid)
+        cmsg = jnp.concatenate(
+            [jnp.where(is_p[..., None], msgs, 0), lane.buf], axis=1)
+        C = cmsg.shape[1]
+        cvalid = cmsg[..., T.W_KIND] != 0
+        csrc = cmsg[..., T.W_SRC]
+        cseq = cmsg[..., T.W_CLOCK]
+        cep = (cmsg[..., T.W_LANE] >> 8) & _EPOCH_MASK
+        if C > 2048:
+            # Key arithmetic below packs (sweep, clamped seq, slot) into
+            # int32; C beyond this would overflow the packing silently.
+            raise ValueError(
+                f"p2p causal lanes need inbox_cap + p2p_buf_cap <= 2048 "
+                f"(got {C})")
+        sb = views.bucket_slot(jnp.maximum(csrc, 0), SC)       # [n, C]
+        c_idx = jnp.arange(C)[None, :]
+        sc_idx = jnp.arange(SC)[None, :, None]
+        hitm = (sb[:, None, :] == sc_idx)                      # [n, SC, C]
+        INF2 = jnp.int32(2**31 - 1)
+        # Sort keys clamp the (unbounded) seq so they stay below the
+        # sentinel (max okey = 2*C*(2^18+1) + ckey < 2^31 for C <=
+        # 2048); within one sender only ONE record is in-order-eligible
+        # at a time, so clamped ties cannot reorder a stream.
+        ckey = jnp.minimum(cseq, 1 << 18) * C + c_idx
+
+        # Inbox-space quota BEFORE any table advance: a record counts as
+        # delivered only if it actually reaches the app this round —
+        # winners beyond the quota stay buffered with their stream
+        # position intact (the broadcast lane's quota contract).
+        base = exchange.Inbox(
+            data=jnp.where(is_p[..., None], 0, msgs),
+            count=jnp.sum((msgs[..., T.W_KIND] != 0) & ~is_p, axis=1,
+                          dtype=jnp.int32),
+            drops=inbox.drops)
+        D2 = min(C, cfg.causal_deliver_cap)
+        quota0 = jnp.minimum(jnp.int32(D2),
+                             jnp.maximum(cfg.inbox_cap - base.count, 0))
+
+        def p2p_sweep(carry):
+            s_ids, s_seq, s_ep, avail, quota, reack = carry
+            t_id = jnp.take_along_axis(s_ids, sb, axis=1)
+            t_seq = jnp.take_along_axis(s_seq, sb, axis=1)
+            t_ep = jnp.take_along_axis(s_ep, sb, axis=1)
+            tracked = (t_id == csrc) & cvalid
+            same_ep = tracked & (t_ep == cep)
+            dup = same_ep & (cseq <= t_seq) & avail
+            inorder = same_ep & (cseq == t_seq + 1)
+            # A stream OPENS only at seq 1 (every fresh epoch starts
+            # there); an untracked mid-sequence arrival means WE lost
+            # the watermark — it buffers and triggers a stream-reset
+            # request below, never an out-of-order delivery that would
+            # strand the prefix.
+            newstream = cvalid & (~tracked | (tracked & ~same_ep)) \
+                & (cseq == 1)
+            elig = avail & (inorder | newstream) & ~dup
+            # One winner per sender bucket per sweep: lowest (seq, idx).
+            key = jnp.where(elig, ckey, INF2)
+            keymat = jnp.where(hitm, key[:, None, :], INF2)
+            best = jnp.min(keymat, axis=2)                     # [n, SC]
+            win = elig & (key == jnp.take_along_axis(best, sb, axis=1))
+            # Quota cut: rank winners by key, keep the first `quota`.
+            wrank = jnp.sum(
+                (jnp.where(win, key, INF2)[:, None, :]
+                 < jnp.where(win, key, INF2)[:, :, None]), axis=2)
+            deliver = win & (wrank < quota[:, None])
+            # Update tables only for buckets whose winner DELIVERED.
+            dkeymat = jnp.where(
+                hitm & deliver[:, None, :], key[:, None, :], INF2)
+            dbest = jnp.min(dkeymat, axis=2)
+            got = dbest < INF2
+            wslot = jnp.argmin(dkeymat, axis=2)                # [n, SC]
+            s_ids2 = jnp.where(got, jnp.take_along_axis(csrc, wslot, 1),
+                               s_ids)
+            s_seq2 = jnp.where(got, jnp.take_along_axis(cseq, wslot, 1),
+                               s_seq)
+            s_ep2 = jnp.where(got, jnp.take_along_axis(cep, wslot, 1),
+                              s_ep)
+            # A duplicate means our last ack may have been lost: re-ack.
+            dup_hit = jnp.any(hitm & dup[:, None, :], axis=2)
+            reack2 = reack | (dup_hit & (s_ids >= 0))
+            quota2 = quota - jnp.sum(deliver, axis=1, dtype=jnp.int32)
+            return (s_ids2, s_seq2, s_ep2, avail & ~deliver & ~dup,
+                    quota2, reack2), (deliver, dup)
+
+        carry = (lane.src_ids, lane.src_seq, lane.src_ep,
+                 cvalid & ctx.alive[:, None], quota0, lane.reack)
+        dels = []
+        for _ in range(CAUSAL_SWEEPS):
+            carry, d = p2p_sweep(carry)
+            dels.append(d[0])
+        s_ids_f, s_seq_f, s_ep_f, avail_f, _, reack_f = carry
+        resets = comm.allsum(jnp.sum(
+            (lane.src_ids >= 0) & (s_ids_f != lane.src_ids),
+            dtype=jnp.int32))
+
+        # Delivery order = (sweep, key); strip the epoch bits from
+        # W_LANE so apps see the plain lane id.
+        okey = jnp.full((n, C), INF2)
+        for s_i, d in enumerate(dels):
+            okey = jnp.minimum(
+                okey, jnp.where(d, s_i * (C * ((1 << 18) + 1)) + ckey,
+                                INF2))
+        topv, topi = jax.lax.top_k(-okey, D2)
+        rows2 = jnp.arange(n)[:, None]
+        drecs = jnp.where((-topv < INF2)[..., None],
+                          cmsg[rows2, topi], 0)
+        drecs = drecs.at[..., T.W_LANE].set(
+            jnp.where(drecs[..., T.W_KIND] != 0, lid,
+                      drecs[..., T.W_LANE]))
+        n_deliv = jnp.sum(okey < INF2, axis=1, dtype=jnp.int32)
+        # Stats netting: routed p2p arrivals were already counted by the
+        # event lane's delivered counter when they landed in the inbox;
+        # this lane's NET contribution is app deliveries minus the
+        # arrivals it pulled back out (buffered records count the round
+        # they finally deliver).
+        n_causal = n_causal + comm.allsum(
+            jnp.sum(n_deliv) - jnp.sum(is_p, dtype=jnp.int32))
+
+        # Rebuild the inbox: p2p slots out, deliveries (in order) in.
+        inbox = exchange.merge_inboxes(base, exchange.Inbox(
+            data=drecs, count=jnp.minimum(n_deliv, D2),
+            drops=jnp.zeros_like(inbox.drops)))
+
+        # Futures re-buffer by key order; overflow sheds (the sender's
+        # unacked store recovers them on the next replay tick).
+        fkey = jnp.where(avail_f & cvalid, ckey, INF2)
+        ftop, fidx = jax.lax.top_k(-fkey, B2)
+        new_buf = jnp.where((-ftop < INF2)[..., None],
+                            cmsg[rows2, fidx], 0)
+        n_fut = jnp.sum(fkey < INF2, axis=1, dtype=jnp.int32)
+        shed = comm.allsum(jnp.sum(jnp.maximum(n_fut - B2, 0),
+                                   dtype=jnp.int32))
+
+        # Collect stream-reset requests: candidates still pending whose
+        # stream we cannot place (untracked / re-epoched, mid-sequence).
+        ft_id = jnp.take_along_axis(s_ids_f, sb, axis=1)
+        ft_ep = jnp.take_along_axis(s_ep_f, sb, axis=1)
+        orphan = avail_f & cvalid & (cseq > 1) \
+            & ((ft_id != csrc) | (ft_ep != cep))
+        # first occurrence per sender (duplicate requests waste slots)
+        same_src = (csrc[:, :, None] == csrc[:, None, :]) \
+            & orphan[:, :, None] & orphan[:, None, :]
+        earlier = same_src & (jnp.arange(C)[None, None, :]
+                              < jnp.arange(C)[None, :, None])
+        orphan = orphan & ~jnp.any(earlier, axis=2)
+        rst_pack, _ = _compact(
+            jnp.stack([csrc + 1, cseq], axis=-1), orphan,
+            _P2P_RESET_SLOTS)
+        rst_ids = rst_pack[..., 0] - 1                         # -1 = none
+        rst_seqs = rst_pack[..., 1]
+
+        alive1 = ctx.alive[:, None]
+        # A reassigned bucket's ack watermark belongs to the OLD stream.
+        src_acked_f = jnp.where(s_ids_f != lane.src_ids, 0,
+                                lane.src_acked)
+        p2p_out.append(lane._replace(
+            src_ids=jnp.where(alive1, s_ids_f, lane.src_ids),
+            src_seq=jnp.where(alive1, s_seq_f, lane.src_seq),
+            src_ep=jnp.where(alive1, s_ep_f, lane.src_ep),
+            src_acked=jnp.where(alive1, src_acked_f, lane.src_acked),
+            reack=jnp.where(alive1, reack_f, lane.reack),
+            reset_req=jnp.where(alive1, rst_ids, lane.reset_req),
+            reset_seq=jnp.where(alive1, rst_seqs, lane.reset_seq),
+            buf=jnp.where(alive1[..., None], new_buf, lane.buf),
+            overflow=lane.overflow + shed,
+            resets=lane.resets + resets))
+
+    return st._replace(lanes=tuple(lanes_out), p2p=tuple(p2p_out)), \
+        inbox, n_causal

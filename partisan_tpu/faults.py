@@ -203,7 +203,19 @@ def inject_partition(faults: FaultState, group_a, group_b) -> FaultState:
                 f"disjointly cover all {p.shape[0]} nodes (use "
                 "partition_mode='dense' or link-level masks for "
                 "arbitrary edge cuts)")
-        p = p.at[b].set(jnp.max(p) + 1)
+        # Compose with any existing split as a REFINEMENT: the new group
+        # id pairs (old group, side of this split), so the cut-edge set
+        # is exactly the union of both splits' cuts.  (A plain
+        # `p.at[b].set(max+1)` would merge previously-separated nodes
+        # that land on the same side of the new split, silently
+        # reconnecting edges the first split cut.)
+        side = jnp.zeros_like(p).at[b].set(1)
+        p = p * 2 + side
+        # Re-densify group ids (host-side scripting path): stacked
+        # refinements would otherwise double ids per call and overflow
+        # int32 after ~31 uncomposed splits.
+        _, inv = np.unique(np.asarray(p), return_inverse=True)
+        p = jnp.asarray(inv, jnp.int32)
     return faults._replace(partition=p)
 
 

@@ -331,7 +331,7 @@ class HyParView:
         rslot = (ranked(_TAG_JOINSLOT, gids) % jnp.uint32(A)) \
             .astype(jnp.int32)
         slot_j = jnp.where(has_empty, first_empty, rslot)
-        do_pre = (join_tgt >= 0) & ~inview_j
+        do_pre = (join_tgt >= 0) & ~inview_j & (join_tgt != gids)
         occupant = jnp.take_along_axis(
             active1, slot_j[:, None], axis=1)[:, 0]
         evicted_j = jnp.where(do_pre & ~has_empty, occupant, -1)
@@ -348,7 +348,13 @@ class HyParView:
             + ([want_x, ok_xr] if hv.xbot else []),
             [src, fjj, src, src] + ([src, src] if hv.xbot else []),
             -1)                                                # [n, cap]
-        prio_slot = jnp.where(is_acc, 2, 1)
+        # Confirmations rank above requests: an ACCEPTED peer has
+        # already committed its side, and an X-BOT exchange has already
+        # demoted an edge for this candidate (phase 1) — losing either
+        # to a mere request would strand a one-way/teardown.
+        commit_prio = is_acc | ((want_x | ok_xr) if hv.xbot
+                                else jnp.zeros_like(is_acc))
+        prio_slot = jnp.where(commit_prio, 2, 1)
         CAND = min(A, cap)
         csc = jnp.where(
             cand_slot >= 0,

@@ -145,6 +145,17 @@ class Plumtree:
         stale_g = is_g & (ver <= data_b)
         win = is_g & (gver == jnp.take_along_axis(ver_max, b, axis=1)) \
             & ~stale_g
+        # Exactly ONE winner per (tree, round): under any sequential
+        # interleaving the first equal-max gossip delivers and every
+        # later one is stale (its sender gets pruned to lazy) — so
+        # demote all-but-the-first-slot winner instead of keeping every
+        # equal-version sender eager.
+        slot_c = jnp.arange(cap)[None, :]
+        first_by_b = jnp.min(
+            jnp.where(oh_b & win[:, :, None], slot_c[:, :, None], cap),
+            axis=1)                                             # [n, B]
+        win = win & (slot_c == jnp.take_along_axis(first_by_b, b, axis=1))
+        stale_g = stale_g | (is_g & ~win)
         mr_win = jnp.max(
             jnp.where(oh_b & win[:, :, None], mr[:, :, None], -1), axis=1)
         src_win = jnp.max(
@@ -157,7 +168,7 @@ class Plumtree:
         # ---- per-(tree, link) flags -------------------------------
         missing_ih = is_ih & (ver > data_b)
         prune_req = any_bk(is_pr | stale_g)
-        unprune = any_bk(is_gr | missing_ih | (is_g & ~stale_g))
+        unprune = any_bk(is_gr | missing_ih | win)
         pruned = (pruned | prune_req) & ~unprune
         lazyp = lazyp & ~any_bk(is_gr | is_ak)
 

@@ -12,16 +12,11 @@ All ops are pure and per-node (1-D); batch with jax.vmap.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 from jax import Array
 
 EMPTY = -1
-
-# merge_sample variant toggle (see its docstring)
-_BATCHED_MERGE = os.environ.get("PARTISAN_TPU_BATCHED_MERGE", "") == "1"
 
 
 def empty(k: int) -> Array:
@@ -238,38 +233,21 @@ def bucket_merge(view: Array, cands: Array, ranks: Array, self_id: Array,
 
 def merge_sample(view: Array, new_ids: Array, self_id: Array,
                  key: Array) -> Array:
-    """Integrate a shuffle sample into a (passive) view: add each id not
-    already present / not self, evicting random entries when full
+    """Integrate a small id sample into a view: add each id not already
+    present / not self, evicting random entries when full
     (merge_exchange, partisan_hyparview_peer_service_manager.erl:2569).
 
-    Default: the sequential per-id add/evict loop.  A single-shot
-    batched variant (dedupe + prioritized gumbel top-k; identical while
-    slots remain, random-eviction-equivalent when full) exists behind
-    ``PARTISAN_TPU_BATCHED_MERGE=1`` but is NOT the default because the
-    program it produces reproducibly trips a TPU kernel fault at
-    4k-node widths on the current toolchain (works on CPU)."""
-    if not _BATCHED_MERGE:
-        def body(v, x):
-            nid, k = x
-            ok = (nid >= 0) & (nid != self_id)
-            v2, _ = add(v, jnp.where(ok, nid, EMPTY), k)
-            return v2, None
+    Sequential per-id add/evict loop — fine for the FEW-id samples on
+    SCAMP's non-hot paths.  Hot paths (hyparview) use the batched
+    :func:`admit` / :func:`bucket_merge` primitives instead; the old
+    env-gated batched variant of THIS function (which tripped a TPU
+    kernel fault at 4k widths) is gone with its last hot-path caller."""
+    def body(v, x):
+        nid, k = x
+        ok = (nid >= 0) & (nid != self_id)
+        v2, _ = add(v, jnp.where(ok, nid, EMPTY), k)
+        return v2, None
 
-        keys = jax.random.split(key, new_ids.shape[0])
-        out, _ = jax.lax.scan(body, view, (new_ids, keys))
-        return out
-    k = view.shape[0]
-    m = new_ids.shape[0]
-    ok_new = (new_ids >= 0) & (new_ids != self_id) \
-        & ~jax.vmap(lambda x: contains(view, x))(new_ids)
-    cand = jnp.concatenate([view, jnp.where(ok_new, new_ids, EMPTY)])
-    # first occurrence wins (dedupes repeated incoming ids)
-    idx = jnp.arange(k + m)
-    same = (cand[None, :] == cand[:, None]) & (cand[:, None] >= 0)
-    dup = jnp.any(same & (idx[None, :] < idx[:, None]), axis=1)
-    valid = (cand >= 0) & ~dup
-    g = jax.random.gumbel(key, (k + m,))
-    score = jnp.where(valid, g + jnp.where(idx >= k, 100.0, 0.0), -jnp.inf)
-    _, top = jax.lax.top_k(score, k)
-    picked = cand[top]
-    return jnp.where(jnp.isfinite(score[top]), picked, EMPTY)
+    keys = jax.random.split(key, new_ids.shape[0])
+    out, _ = jax.lax.scan(body, view, (new_ids, keys))
+    return out

@@ -37,6 +37,9 @@ W_FLAGS = 7     # bitfield: ACK_REQUIRED etc.
 F_ACK_REQUIRED = 1 << 0     # {ack, true} forward option
 F_RETRANSMISSION = 1 << 1   # re-sent by the retransmit timer
 F_CAUSAL = 1 << 2           # routed through a causality lane
+F_P2P_STAMPED = 1 << 3      # point-to-point causal record, already
+#                             stamped (W_CLOCK = edge seq, W_LANE packs
+#                             lane | epoch << 8) — rides the event lane
 
 # Payload word indices, by message family.  Payload starts at HDR_WORDS.
 P0, P1, P2, P3 = HDR_WORDS, HDR_WORDS + 1, HDR_WORDS + 2, HDR_WORDS + 3
@@ -57,6 +60,8 @@ class MsgKind(enum.IntEnum):
 
     # -- acked delivery (partisan_acknowledgement_backend.erl:70-85)
     ACK = 3             # payload: [acked_clock]; W_CLOCK = acked msg clock
+    P2P_ACK = 4         # p2p-causal cumulative stream ack: W_CLOCK =
+    #                     highest delivered seq, W_LANE = lane | epoch<<8
 
     # -- HyParView (partisan_hyparview_peer_service_manager.erl:1234-1795)
     HPV_JOIN = 10            # payload: []

@@ -73,3 +73,23 @@ def test_groups_partition_mode():
         faults_mod.inject_partition(f, [0], [4])      # partial cut
     with pytest.raises(ValueError):
         faults_mod.inject_partition(f, [0, 4], [4, 1, 2, 3, 5, 6, 7])  # overlap
+
+
+def test_groups_partition_composes_as_refinement():
+    """Two sequential full splits cut the UNION of both edge sets: after
+    {0,1}|{2,3} then {0,2}|{1,3}, every pair is cut (4 singleton
+    groups) — a naive max+1 reassignment would silently reconnect 1-3."""
+    import itertools
+
+    import jax.numpy as jnp
+    from partisan_tpu import faults as faults_mod
+
+    f = faults_mod.none(4, partition_mode="groups")
+    f = faults_mod.inject_partition(f, [0, 1], [2, 3])
+    f = faults_mod.inject_partition(f, [0, 2], [1, 3])
+    for a, b in itertools.combinations(range(4), 2):
+        assert bool(faults_mod.edge_cut(
+            f, jnp.int32(a), jnp.int32(b), 0, jnp.int32(0), 1)), (a, b)
+    healed = faults_mod.resolve_partition(f)
+    assert not bool(faults_mod.edge_cut(
+        healed, jnp.int32(1), jnp.int32(3), 0, jnp.int32(0), 1))
