@@ -135,6 +135,48 @@ def prunable(graph: dict[str, set[str]], omitted_kind: str,
     return target_kind not in closure(graph).get(omitted_kind, set())
 
 
+def ensemble_reaction(traces) -> tuple[dict[str, set[str]], dict]:
+    """Union of reaction graphs over an ENSEMBLE of traces (multiple
+    seeds × fault settings), with a coverage report.
+
+    A single trace under-approximates the reaction structure: an edge a
+    run never exercised is invisible, so pruning against it can silently
+    skip schedules that would find bugs — whereas the reference's STATIC
+    source analysis over-approximates and is therefore sound
+    (src/partisan_analysis.erl:24-60).  Unioning over diverse traces
+    narrows (but cannot close — absence-triggered reactions never appear
+    as receipt edges in ANY trace) that gap; the coverage report makes
+    the evidence base explicit:
+
+    - ``traces``: how many executions contributed,
+    - ``edges``: total distinct causality edges,
+    - ``new_edges_per_trace``: edges first contributed by each trace in
+      order — a tail of zeros suggests (but does not prove) saturation,
+    - ``background``: union of timer/absence-driven kinds (these must
+      never justify pruning: their triggers are invisible to receipt
+      analysis).
+    """
+    graph: dict[str, set[str]] = {}
+    background: set[str] = set()
+    new_counts: list[int] = []
+    n_traces = 0
+    for tr in traces:
+        n_traces += 1
+        g = reaction_graph(tr)
+        before = sum(len(v) for v in graph.values())
+        for k, vs in g.items():
+            graph.setdefault(k, set()).update(vs)
+        background |= background_kinds(tr)
+        new_counts.append(sum(len(v) for v in graph.values()) - before)
+    coverage = {
+        "traces": n_traces,
+        "edges": sum(len(v) for v in graph.values()),
+        "new_edges_per_trace": new_counts,
+        "background": sorted(background),
+    }
+    return graph, coverage
+
+
 # ---------------------------------------------------------------------------
 # annotation persistence (annotations/partisan-annotations-* layout)
 # ---------------------------------------------------------------------------

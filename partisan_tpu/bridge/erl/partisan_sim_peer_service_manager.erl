@@ -109,12 +109,10 @@ myself() ->
 
 update_members(Members) ->
     %% orchestration path (partisan_pluggable_peer_service_manager
-    %% update_members): join every listed spec except ourselves (the
-    %% conventional argument is the FULL desired membership, self
-    %% included — joining self would write a self-edge into the sim).
-    Self = partisan:node(),
-    [ok = join(M) || M <- Members, spec_name(M) =/= Self],
-    ok.
+    %% update_members): the argument is the FULL desired membership —
+    %% join listed specs we don't have, LEAVE current members that are
+    %% no longer listed (self excluded on both sides).
+    gen_server:call(?MODULE, {update_members, Members}, infinity).
 
 spec_name(#{name := Name}) -> Name;
 spec_name(Name) when is_atom(Name) -> Name.
@@ -243,6 +241,24 @@ handle_call({join, NodeSpec}, _From, State0) ->
     ok = rpc_port(State#state.port, {join, Id, State#state.self_id}),
     {reply, ok, State};
 
+handle_call({update_members, Members}, _From,
+            State0 = #state{port = P, self_id = Me,
+                            node_ids = NodeIds0}) ->
+    Self = partisan:node(),
+    Wanted = [spec_name(M) || M <- Members, spec_name(M) =/= Self],
+    %% join the new...
+    State1 = lists:foldl(
+        fun(Name, StAcc) ->
+            {Id, StAcc1} = intern_node(Name, StAcc),
+            ok = rpc_port(P, {join, Id, Me}),
+            StAcc1
+        end, State0, Wanted),
+    %% ...and leave the de-listed (anything interned but not wanted)
+    Gone = [Id || {Name, Id} <- maps:to_list(NodeIds0),
+                  Id =/= Me, not lists:member(Name, Wanted)],
+    [ok = rpc_port(P, {leave, Id}) || Id <- Gone],
+    {reply, ok, State1};
+
 handle_call({sync_join, NodeSpec}, _From, State0) ->
     {Id, State} = intern_node(NodeSpec, State0),
     P = State#state.port,
@@ -285,11 +301,18 @@ handle_call({inject_partition, Origin, TTL}, _From,
      State#state{partitions = Ps#{Ref => {Origin, TTL}}}};
 
 handle_call({resolve_partition, Ref}, _From,
-            State = #state{partitions = Ps, port = P}) ->
+            State = #state{partitions = Ps, port = P, self_id = Me}) ->
     Ps1 = maps:remove(Ref, Ps),
     case maps:size(Ps1) of
-        0 -> ok = rpc_port(P, {resolve_partition});
-        _ -> ok
+        0 ->
+            %% Resolve only THIS node's side: other VMs may still hold
+            %% partition refs of their own in the shared simulator.
+            %% (The simulator serves the targeted form exactly in dense
+            %% partition mode; groups mode can only express full splits,
+            %% so multi-VM per-ref resolution needs dense mode.)
+            ok = rpc_port(P, {resolve_partition, [Me]});
+        _ ->
+            ok
     end,
     {reply, ok, State#state{partitions = Ps1}};
 
@@ -302,8 +325,10 @@ handle_call({on_down, Node, Fun}, _From, State = #state{down_funs = D}) ->
 handle_call(_Other, _From, State) ->
     {reply, {error, notsup}, State}.
 
-handle_cast({unhandled, _Peer, _Message}, State) ->
+handle_cast({unhandled, Peer, Message}, State) ->
     %% unknown wire shape: logged-and-dropped rather than a crash
+    logger:warning("partisan_sim bridge: unhandled message from ~p: ~p",
+                   [Peer, Message]),
     {noreply, State};
 handle_cast(_Msg, State) ->
     {noreply, State}.

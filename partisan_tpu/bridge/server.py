@@ -163,6 +163,19 @@ class Bridge:
                 st.faults, a, b))
             return OK
         if cmd == "resolve_partition":
+            if args and args[0]:
+                # Targeted form: heal only the named nodes' cuts (dense
+                # mode severs exact edges; groups mode can only express
+                # full splits, so it falls back to a full resolve —
+                # multi-VM per-ref resolution requires dense mode).
+                ids = [int(x) for x in args[0]]
+                part = self.st.faults.partition
+                if part.ndim == 2:
+                    part = part.at[jnp.asarray(ids)].set(False)
+                    part = part.at[:, jnp.asarray(ids)].set(False)
+                    self.st = self.st._replace(
+                        faults=self.st.faults._replace(partition=part))
+                    return OK
             self.st = st._replace(
                 faults=faults_mod.resolve_partition(st.faults))
             return OK

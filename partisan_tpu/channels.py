@@ -1,0 +1,109 @@
+"""Per-channel parallelism capacity: N lanes per edge, enforced.
+
+The reference opens ``parallelism`` TCP connections per (peer, channel)
+and dispatches onto them by partition key
+(partisan_peer_connections.erl:897-954); each connection is a FIFO pipe
+whose throughput bounds the edge.  The tensor transport's analogue
+(opt-in via ``Config.channel_capacity``):
+
+- a message's LANE is its partition-key affinity word modulo the
+  channel's ``parallelism`` (dispatch_pid's partition-key modulo),
+- each (edge, channel, lane) carries at most ``lane_rate`` messages per
+  round — so an edge's per-channel throughput is
+  ``parallelism × lane_rate`` per round, and raising ``parallelism``
+  measurably raises it,
+- excess sends DEFER into a bounded per-node outbox replayed first next
+  round (backpressure, per-sender FIFO preserved: outbox slots precede
+  fresh emissions and ranking is stable); outbox overflow SHEDS with
+  accounting (the load-shedding the reference only permits on monotonic
+  channels is surfaced as an explicit counter here).
+
+``is_fully_connected`` (partisan_peer_connections.erl:951-954 — conn
+count equals Σ parallelism) transposes to liveness: the tensor transport
+has no connection setup, so an edge's lanes all exist exactly when both
+endpoints are alive — see :func:`fully_connected`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+
+
+class OutboxState(NamedTuple):
+    data: Array  # int32[n_local, OB, W] — deferred sends (kind==0 free)
+    shed: Array  # int32 — deferred sends dropped (outbox overflow)
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.channel_capacity
+
+
+def init(cfg: Config, comm) -> OutboxState:
+    return OutboxState(
+        data=jnp.zeros((comm.n_local, cfg.outbox_cap, cfg.msg_words),
+                       jnp.int32),
+        shed=jnp.int32(0),
+    )
+
+
+def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array
+             ) -> tuple[OutboxState, Array]:
+    """Apply per-(edge, channel, lane) capacity to this round's sends.
+
+    Returns (outbox', emitted') where emitted' carries the outbox's
+    deferred sends first (FIFO) plus as many fresh sends as capacity
+    admits; the rest defer (or shed when the outbox is full)."""
+    par_py = [c.parallelism for c in cfg.channels]
+    par = jnp.asarray(par_py, jnp.int32)
+    maxpar = max(par_py)
+    rate = cfg.lane_rate
+    OB = cfg.outbox_cap
+    n = emitted.shape[0]
+
+    both = jnp.concatenate([ob.data, emitted], axis=1)     # [n, M, W]
+    M = both.shape[1]
+    valid = both[..., T.W_KIND] != 0
+    ch = jnp.clip(both[..., T.W_CHANNEL], 0, cfg.n_channels - 1)
+    lane = (both[..., T.W_LANE] & 0x7FFFFFFF) % par[ch]
+    dst = jnp.maximum(both[..., T.W_DST], 0)
+    key = (dst * cfg.n_channels + ch) * maxpar + lane
+    key = jnp.where(valid, key, -1)
+
+    # rank among same-key sends, stable by slot (outbox first = FIFO)
+    m_idx = jnp.arange(M)
+    same = (key[:, :, None] == key[:, None, :]) & valid[:, :, None] \
+        & valid[:, None, :]
+    rank = jnp.sum(same & (m_idx[None, None, :] < m_idx[None, :, None]),
+                   axis=2)
+    budget = rate * jnp.ones((), jnp.int32)
+    send_now = valid & (rank < budget)
+    defer = valid & ~send_now
+
+    out = both.at[..., T.W_KIND].set(
+        jnp.where(send_now, both[..., T.W_KIND], 0))
+
+    # Compact deferred sends into the outbox (slot order = FIFO).
+    drank = jnp.cumsum(defer, axis=1) - 1
+    keep = defer & (drank < OB)
+    slot = jnp.where(keep, drank, OB)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], slot.shape)
+    new_data = jnp.zeros((n, OB, both.shape[-1]), jnp.int32)
+    new_data = new_data.at[rows, slot].set(both, mode="drop")
+    shed = comm.allsum(jnp.sum(defer & ~keep, dtype=jnp.int32))
+    return OutboxState(data=new_data, shed=ob.shed + shed), out
+
+
+def fully_connected(cfg: Config, alive: Array) -> Array:
+    """bool[n, n]: every configured lane of every channel between i and
+    j is up.  In the tensor transport, lanes have no setup phase — the
+    Σ-parallelism connection count of the reference's
+    ``is_fully_connected`` holds exactly when both endpoints are alive
+    (a crash severs all of a node's connections at once, the TCP-EXIT
+    analogue)."""
+    return alive[:, None] & alive[None, :]

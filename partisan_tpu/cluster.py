@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import managers as managers_mod
@@ -54,6 +55,8 @@ class ClusterState(NamedTuple):
     delivery: Any           # delivery.DeliveryState (or () if disabled)
     stats: Stats
     interpose: Any = ()     # interposition-chain state (or () if none)
+    outbox: Any = ()        # channels.OutboxState (or () if capacity
+    #                         enforcement is off)
 
 
 class TraceRound(NamedTuple):
@@ -118,6 +121,16 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
 
     n_emitted = comm.allsum(jnp.sum(emitted[..., 0] != 0, dtype=jnp.int32))
 
+    # Channel-capacity stage (opt-in): per-(edge, channel, lane)
+    # throughput enforcement with outbox backpressure.  Runs after the
+    # emission count (a deferred send was already counted when emitted)
+    # and before the fault stage (a deferred send rides the wire — and
+    # its faults — the round it actually transmits).
+    obstate = state.outbox
+    if channels_mod.enabled(cfg):
+        obstate, emitted = channels_mod.throttle(cfg, comm, obstate,
+                                                 emitted)
+
     # Fault stage: crash/partition/omission masks between emit and deliver.
     sent = emitted
     emitted = faults_mod.filter_msgs(
@@ -152,7 +165,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     )
     out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
                        inbox=inbox, manager=mstate, model=dstate_model,
-                       delivery=dstate, stats=stats, interpose=istate)
+                       delivery=dstate, stats=stats, interpose=istate,
+                       outbox=obstate)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent,
                                dropped=fault_dropped)
@@ -210,6 +224,8 @@ class Cluster:
             stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
             interpose=(self.interpose.init(cfg, comm)
                        if self.interpose is not None else ()),
+            outbox=(channels_mod.init(cfg, comm)
+                    if channels_mod.enabled(cfg) else ()),
         )
 
     # ---- the round ----------------------------------------------------

@@ -161,6 +161,17 @@ class Config:
     n_actors: int = 64           # vclock width for causal delivery
     seed: int = 0                # deterministic seeding (partisan_config:seed/0)
 
+    # --- channel capacity enforcement ----------------------------------
+    channel_capacity: bool = False  # enforce ChannelSpec.parallelism as
+    #                                 per-(edge, channel, lane) round
+    #                                 throughput (N lanes × lane_rate
+    #                                 msgs/round); off = the default
+    #                                 infinite-parallelism transport
+    lane_rate: int = 1           # msgs per lane per (edge, channel) per
+    #                              round when channel_capacity is on
+    outbox_cap: int = 32         # deferred sends carried per node
+    #                              (backpressure buffer; overflow sheds)
+
     # --- fault-state representation ------------------------------------
     partition_mode: str = "auto"  # auto | dense | groups — dense bool[n,n]
     #                               supports arbitrary edge cuts; groups

@@ -100,10 +100,21 @@ class Checker:
     max_faults: int = 1
     max_executions: int = 200
     sched_width: int = 64   # >= emission width (OmissionSchedule clips)
-    # Optional causality-annotation pruning (analysis.reaction_graph):
-    # omissions of kinds that provably cannot affect any ``target_kinds``
-    # are skipped (the reference feeds partisan_analysis output into
-    # schedule_valid_causality the same way, filibuster_SUITE.erl:1023).
+    # OPT-IN causality-annotation pruning (analysis.reaction_graph /
+    # analysis.ensemble_reaction): omissions of kinds whose closure
+    # cannot reach any ``target_kinds`` are skipped (the reference feeds
+    # partisan_analysis output into schedule_valid_causality the same
+    # way, filibuster_SUITE.erl:1023).  SOUNDNESS CAVEAT: the reference
+    # derives its graph from STATIC source analysis, which
+    # over-approximates and is sound; trace-derived graphs
+    # UNDER-approximate — a reaction no trace exercised (in particular
+    # any ABSENCE-triggered reaction, which never appears as a receipt
+    # edge) is invisible, and pruning against it can skip the very
+    # schedule that triggers a bug.  The default (None) prunes nothing
+    # and is exhaustive within the budget; pass a graph only as a
+    # search-cost optimization, preferably an ensemble union with a
+    # saturating coverage report, and never for protocols with
+    # absence-triggered behavior outside the built-in ack lane.
     reaction: dict | None = None
     target_kinds: tuple = ()
 

@@ -47,12 +47,15 @@ applies.
 
 X-BOT overlay optimization (:1880-2050) is config-gated
 (``HyParViewConfig.xbot``) with a synthetic latency oracle (the
-reference pings over the wire, :2978-3000) and a 2-party exchange in
-place of the 4-party replace handshake (demoted peers re-home through
-standard isolation healing).  Reserved slots (reserve/1) hold active
-capacity back from ordinary admission.  Epochs are transposed away:
-reference epochs disambiguate same-name node re-incarnations
-(:249-256), but sim node ids ARE incarnation-stable identities.
+reference pings over the wire, :2978-3000) and the FULL 4-party replace
+handshake: initiator i (worst peer o) → candidate c; a full c delegates
+to its worst peer d (REPLACE); d switches to o (SWITCH) so the swap
+i-o, c-d → i-c, o-d preserves every node's degree — demoted peers are
+re-homed explicitly, one chain hop per round.  Reserved slots
+(reserve/1) hold active capacity back from ordinary admission.  Epochs
+are transposed away: reference epochs disambiguate same-name node
+re-incarnations (:249-256), but sim node ids ARE incarnation-stable
+identities.
 """
 
 from __future__ import annotations
@@ -261,6 +264,13 @@ class HyParView:
             (active0[:, :, None] == disc_src[:, None, :])
             & (active0 >= 0)[:, :, None], axis=2)              # [n, A]
         if hv.xbot:
+            p2w = inb[..., T.P2]
+            p3w = inb[..., T.P3]
+            p4w = inb[..., T.P3 + 1]
+            is_xrep = kind == T.MsgKind.HPV_XBOT_REPLACE       # at d
+            is_xsw = kind == T.MsgKind.HPV_XBOT_SWITCH         # at o
+            is_xswr = kind == T.MsgKind.HPV_XBOT_SWITCH_REPLY  # at d
+            is_xrepr = kind == T.MsgKind.HPV_XBOT_REPLACE_REPLY  # at c
             costs0 = jnp.where(active0 >= 0,
                                link_cost(cfg.seed,
                                          jnp.broadcast_to(me2, active0.shape),
@@ -269,22 +279,33 @@ class HyParView:
             z = jnp.where(jnp.any(active0 >= 0, axis=1),
                           jnp.take_along_axis(
                               active0, zslot[:, None], axis=1)[:, 0], -1)
-            have_room = asize0 < acap
-            cost_iz = link_cost(cfg.seed, me2, jnp.maximum(src, 0))
-            cost_zz = link_cost(cfg.seed, gids, jnp.maximum(z, 0))
-            want_x = is_xo & ~in_active0 & (acap > 0)[:, None] \
-                & (have_room[:, None]
-                   | ((z >= 0)[:, None] & (cost_iz < cost_zz[:, None])))
-            evict_x = want_x & ~have_room[:, None]             # [n, cap]
-            zrem = jnp.any(evict_x, axis=1)                    # [n]
+            have_room = (asize0 < acap) & (acap > 0)
+            # candidate side (OPT at c): room -> take the initiator now;
+            # full -> delegate to our worst peer d via REPLACE (4-party)
+            xo_take = is_xo & have_room[:, None] & ~in_active0
+            xo_dup = is_xo & in_active0
+            xo_full = is_xo & ~have_room[:, None] & ~in_active0 \
+                & (z >= 0)[:, None]
+            # d side (REPLACE): switch to o only if o beats c for ME
+            xrep_sw = is_xrep & (p0 >= 0) \
+                & (link_cost(cfg.seed, me2, jnp.maximum(p0, 0))
+                   < link_cost(cfg.seed, me2, jnp.maximum(p2w, 0)))
+            xrep_no = is_xrep & ~xrep_sw
+            # o side (SWITCH): accept iff the initiator really is ours
+            xsw_acc = is_xsw & slot_in(active0, p1)
+            # d side (SWITCH_REPLY) / c side (REPLACE_REPLY)
+            xswr_ok = is_xswr & (p4w == 1)
+            xrepr_ok = is_xrepr & (p4w == 1)
+            # i side (OPT_REPLY): swap out o once the candidate committed
             ok_xr = is_xr & (p1 == 1)
             swap_xr = ok_xr & slot_in(active0, p0)             # [n, cap]
-            xr_rm = jnp.where(swap_xr, p0, -1)
-            removed |= (zrem[:, None] & (active0 == z[:, None])
-                        & (active0 >= 0))
+            # Demotions: o at i, i at o, c at d, d at c.
+            xrm = jnp.select([swap_xr, xsw_acc, xswr_ok, xrepr_ok],
+                             [p0, p1, p2w, p3w], -1)
             removed |= jnp.any(
-                (active0[:, :, None] == xr_rm[:, None, :])
-                & (active0 >= 0)[:, :, None], axis=2)
+                (active0[:, :, None] == xrm[:, None, :])
+                & (active0 >= 0)[:, :, None] & (xrm >= 0)[:, None, :],
+                axis=2)
         active1 = jnp.where(removed, -1, active0)
 
         # ---- 2. per-kind slot decisions (against round-start views) --
@@ -345,15 +366,18 @@ class HyParView:
         # the tensor transport).
         cand_slot = jnp.select(
             [first, stop_ok, want_nb, is_acc]
-            + ([want_x, ok_xr] if hv.xbot else []),
-            [src, fjj, src, src] + ([src, src] if hv.xbot else []),
+            + ([xo_take, ok_xr, xsw_acc, xswr_ok, xrepr_ok]
+               if hv.xbot else []),
+            [src, fjj, src, src]
+            + ([src, src, p3w, p0, p1] if hv.xbot else []),
             -1)                                                # [n, cap]
         # Confirmations rank above requests: an ACCEPTED peer has
-        # already committed its side, and an X-BOT exchange has already
-        # demoted an edge for this candidate (phase 1) — losing either
-        # to a mere request would strand a one-way/teardown.
-        commit_prio = is_acc | ((want_x | ok_xr) if hv.xbot
-                                else jnp.zeros_like(is_acc))
+        # already committed its side, and each X-BOT chain step has
+        # already demoted an edge for its candidate (phase 1) — losing
+        # either to a mere request would strand a one-way/teardown.
+        commit_prio = is_acc | (
+            (xo_take | ok_xr | xsw_acc | xswr_ok | xrepr_ok)
+            if hv.xbot else jnp.zeros_like(is_acc))
         prio_slot = jnp.where(commit_prio, 2, 1)
         CAND = min(A, cap)
         csc = jnp.where(
@@ -407,25 +431,40 @@ class HyParView:
             # central admission must also be torn down (same one-way-link
             # reasoning as m_acc_fix)
             xr_fix = ok_xr & ~in_new
+            i_in_new = slot_in(new_active, p1)
+            o_in_new = slot_in(new_active, p0)
+            d_in_new = slot_in(new_active, p3w)
+            xo_acc = xo_take | xo_dup      # reply OPT_REPLY (flag below)
+            xbot_conds = [xo_acc, xo_full, xrep_sw, xrep_no,
+                          is_xsw, is_xswr, is_xrepr, xr_fix]
+            xbot_kinds = [jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
+                          jnp.int32(T.MsgKind.HPV_XBOT_REPLACE),
+                          jnp.int32(T.MsgKind.HPV_XBOT_SWITCH),
+                          jnp.int32(T.MsgKind.HPV_XBOT_REPLACE_REPLY),
+                          jnp.int32(T.MsgKind.HPV_XBOT_SWITCH_REPLY),
+                          jnp.int32(T.MsgKind.HPV_XBOT_REPLACE_REPLY),
+                          jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
+                          jnp.int32(T.MsgKind.HPV_DISCONNECT)]
+            xbot_dsts = [src, jnp.broadcast_to(z[:, None], src.shape),
+                         p0, src, src, p2w, p1, src]
 
         rkind = jnp.select(
             [m_acc_join, m_acc_fj, m_nb_acc, m_nb_rej, m_acc_fix,
              cont, sh_fwd]
-            + ([is_xo, xr_fix] if hv.xbot else []),
+            + (xbot_conds if hv.xbot else []),
             [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED)] * 2
             + [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED),
                jnp.int32(T.MsgKind.HPV_NEIGHBOR_REJECTED),
                jnp.int32(T.MsgKind.HPV_DISCONNECT),
                jnp.int32(T.MsgKind.HPV_FORWARD_JOIN),
                jnp.int32(T.MsgKind.HPV_SHUFFLE)]
-            + ([jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
-                jnp.int32(T.MsgKind.HPV_DISCONNECT)] if hv.xbot else []),
+            + (xbot_kinds if hv.xbot else []),
             0)
         rdst = jnp.select(
             [m_acc_fj, cont, sh_fwd]
-            + ([is_xo] if hv.xbot else []),
+            + (xbot_conds[:-1] if hv.xbot else []),
             [fjj, nxt_fj, nxt_sh]
-            + ([src] if hv.xbot else []),
+            + (xbot_dsts[:-1] if hv.xbot else []),
             src)
         rdst = jnp.where(rkind > 0, rdst, -1)
         rttl = jnp.where(cont | sh_fwd, ttl - 1, 0)
@@ -434,18 +473,37 @@ class HyParView:
         # only by ITS contact's walk — a coincidental promotion accept
         # can no longer cancel a join whose walk was actually lost.
         w0 = jnp.select(
-            [m_acc_join, m_acc_fj, m_nb_acc | m_nb_rej | m_acc_fix]
-            + ([is_xo] if hv.xbot else []),
+            [m_acc_join, m_acc_fj, m_nb_acc | m_nb_rej | m_acc_fix],
             [jnp.broadcast_to(me2, p0.shape), p1,
-             jnp.full_like(p0, -1)]
-            + ([p0] if hv.xbot else []),
+             jnp.full_like(p0, -1)],
             p0)
         payload = [w0]
         for wi in range(1, W - T.HDR_WORDS):
             base = inb[..., T.HDR_WORDS + wi]
             if hv.xbot and wi == 1:
+                # P1: accepted flag on OPT_REPLY replies; the initiator
+                # id on a delegated REPLACE; i otherwise (chain pass-
+                # through).
                 base = jnp.where(
-                    is_xo, (want_x & in_new).astype(jnp.int32), base)
+                    xo_acc, in_new.astype(jnp.int32), base)
+                base = jnp.where(xo_full, src, base)
+                base = jnp.where(
+                    is_xrepr, (xrepr_ok & i_in_new).astype(jnp.int32),
+                    base)
+            if hv.xbot and wi == 2:
+                base = jnp.where(xo_full,
+                                 jnp.broadcast_to(me2, base.shape), base)
+            if hv.xbot and wi == 3:
+                base = jnp.where(xo_full,
+                                 jnp.broadcast_to(z[:, None], base.shape),
+                                 base)
+            if hv.xbot and wi == 4:
+                # P4: the chain's commit flag
+                base = jnp.where(
+                    is_xsw, (xsw_acc & d_in_new).astype(jnp.int32), base)
+                base = jnp.where(
+                    is_xswr, (xswr_ok & o_in_new).astype(jnp.int32), base)
+                base = jnp.where(xrep_no, 0, base)
             payload.append(base)
         replies = msg_ops.build(
             W, rkind, jnp.broadcast_to(me2, rdst.shape), rdst,
@@ -455,9 +513,11 @@ class HyParView:
         ev_disc = msg_ops.build(W, T.MsgKind.HPV_DISCONNECT,
                                 jnp.broadcast_to(me2, evicted.shape), evicted)
         if hv.xbot:
+            # tear down the demoted side of each chain step: o at i,
+            # i at o, c at d, d at c (the 4-party swap's disconnects)
             xdst = jnp.select(
-                [evict_x & want_x & (z >= 0)[:, None], swap_xr],
-                [jnp.broadcast_to(z[:, None], src.shape), p0], -1)
+                [swap_xr, xsw_acc, xswr_ok, xrepr_ok],
+                [p0, p1, p2w, p3w], -1)
             x_disc = msg_ops.build(W, T.MsgKind.HPV_DISCONNECT,
                                    jnp.broadcast_to(me2, xdst.shape), xdst)
 
@@ -486,11 +546,10 @@ class HyParView:
         # not a ledger.
         pw0 = jnp.select(
             [is_disc, deposit]
-            + ([evict_x & want_x & (z >= 0)[:, None], swap_xr]
+            + ([swap_xr, xsw_acc, xswr_ok, xrepr_ok]
                if hv.xbot else []),
             [src, fjj]
-            + ([jnp.broadcast_to(z[:, None], src.shape), p0]
-               if hv.xbot else []),
+            + ([p0, p1, p2w, p3w] if hv.xbot else []),
             -1)                                                # [n, cap]
         PSEL = min(A, cap)
         psc = jnp.where(pw0 >= 0,

@@ -1,0 +1,94 @@
+"""Echo/latency benchmark workload (the ``performance_test`` harness,
+reference test/partisan_SUITE.erl:1181-1290 + bin/perf-suite.sh).
+
+Two nodes; ``concurrency`` logical sender processes on the client each
+ping-pong ``num_messages`` payloads against the server (send → wait for
+the echo → send the next), with per-sender partition keys riding the
+channel's parallelism lanes — so ``concurrency > parallelism × lane_rate``
+queues on the lane exactly like the reference's senders share TCP
+connections.
+
+Payload SIZE and link LATENCY shape the virtual clock, not the tensor
+shapes: one simulated round is one link traversal, worth
+``max(latency/2, size/bandwidth)`` milliseconds (the tc-netem delay of
+bin/perf-suite.sh:1-76 plus serialization delay) — see
+``scenarios.config6_echo`` for the CSV emission with the reference's
+column layout.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+from partisan_tpu.ops import msg as msg_ops
+
+CLIENT, SERVER = 0, 1
+
+
+class EchoState(NamedTuple):
+    to_send: Array   # int32[n, C] — messages left per sender process
+    awaiting: Array  # bool[n, C] — a ping is in flight (awaiting echo)
+    echoed: Array    # int32[n, C] — echoes received per sender
+
+
+class Echo:
+    name = "echo"
+
+    def __init__(self, concurrency: int, num_messages: int) -> None:
+        self.concurrency = concurrency
+        self.num_messages = num_messages
+
+    def init(self, cfg: Config, comm) -> EchoState:
+        n, C = comm.n_local, self.concurrency
+        to_send = jnp.zeros((n, C), jnp.int32) \
+            .at[CLIENT].set(self.num_messages)
+        return EchoState(
+            to_send=to_send,
+            awaiting=jnp.zeros((n, C), jnp.bool_),
+            echoed=jnp.zeros((n, C), jnp.int32),
+        )
+
+    def step(self, cfg: Config, comm, state: EchoState, ctx, nbrs):
+        gids = comm.local_ids()
+        n, C = state.to_send.shape
+        inb = ctx.inbox.data
+        kind = inb[..., T.W_KIND]
+        sender = inb[..., T.P0]                               # sender idx
+        is_ping = (kind == T.MsgKind.APP) & (inb[..., T.P1] == 0)
+        is_echo = (kind == T.MsgKind.APP) & (inb[..., T.P1] == 1)
+
+        # Server: echo every ping back to its origin, same lane.
+        reply_dst = jnp.where(
+            is_ping & (gids == SERVER)[:, None], inb[..., T.W_SRC], -1)
+        replies = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None], reply_dst,
+            lane=sender, payload=(sender, jnp.ones_like(sender)))
+
+        # Client: an echo frees its sender process for the next ping.
+        echo_hit = (is_echo & (gids == CLIENT)[:, None])[:, :, None] \
+            & (sender[:, :, None] == jnp.arange(C)[None, None, :])
+        got = jnp.any(echo_hit, axis=1)                       # [n, C]
+        echoed = state.echoed + got.astype(jnp.int32)
+        awaiting = state.awaiting & ~got
+        # fire: senders not awaiting with messages left (round 0 fires
+        # the initial window too).
+        fire = (gids == CLIENT)[:, None] & ~awaiting & (state.to_send > 0)
+        lanes = jnp.broadcast_to(jnp.arange(C)[None, :], (n, C))
+        pings = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            jnp.where(fire, SERVER, -1),
+            lane=lanes, payload=(lanes, jnp.zeros_like(lanes)))
+        return EchoState(
+            to_send=state.to_send - fire.astype(jnp.int32),
+            awaiting=awaiting | fire,
+            echoed=echoed,
+        ), jnp.concatenate([replies, pings], axis=1)
+
+    def done(self, state: EchoState) -> bool:
+        return bool((state.to_send[CLIENT] == 0).all()
+                    and (~state.awaiting[CLIENT]).all())

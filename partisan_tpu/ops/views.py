@@ -231,23 +231,6 @@ def bucket_merge(view: Array, cands: Array, ranks: Array, self_id: Array,
     return jnp.where(has, cands[best], view)
 
 
-def merge_sample(view: Array, new_ids: Array, self_id: Array,
-                 key: Array) -> Array:
-    """Integrate a small id sample into a view: add each id not already
-    present / not self, evicting random entries when full
-    (merge_exchange, partisan_hyparview_peer_service_manager.erl:2569).
-
-    Sequential per-id add/evict loop — fine for the FEW-id samples on
-    SCAMP's non-hot paths.  Hot paths (hyparview) use the batched
-    :func:`admit` / :func:`bucket_merge` primitives instead; the old
-    env-gated batched variant of THIS function (which tripped a TPU
-    kernel fault at 4k widths) is gone with its last hot-path caller."""
-    def body(v, x):
-        nid, k = x
-        ok = (nid >= 0) & (nid != self_id)
-        v2, _ = add(v, jnp.where(ok, nid, EMPTY), k)
-        return v2, None
-
-    keys = jax.random.split(key, new_ids.shape[0])
-    out, _ = jax.lax.scan(body, view, (new_ids, keys))
-    return out
+# (The former sequential merge_sample — and its env-gated batched
+# variant that tripped a TPU kernel fault at 4k widths — are gone with
+# their last caller: hot paths merge through admit / bucket_merge.)

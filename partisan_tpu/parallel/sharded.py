@@ -32,6 +32,7 @@ import numpy as np
 from jax import Array
 from jax.sharding import Mesh, PartitionSpec as P
 
+from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import managers as managers_mod
@@ -169,6 +170,9 @@ class ShardedCluster:
             stats=spec_like(state.stats, repl),
             interpose=(self.interpose.specs(shard, repl)
                        if self.interpose is not None else ()),
+            outbox=(() if state.outbox == () else jax.tree.map(
+                lambda x: repl if jnp.ndim(x) == 0 else shard,
+                state.outbox)),
         )
 
     # ---- state construction ------------------------------------------
@@ -186,6 +190,8 @@ class ShardedCluster:
             stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
             interpose=(self.interpose.init(cfg, self.host_comm)
                        if self.interpose is not None else ()),
+            outbox=(channels_mod.init(cfg, self.host_comm)
+                    if channels_mod.enabled(cfg) else ()),
         )
         return self.shard_state(state)
 

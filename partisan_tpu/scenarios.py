@@ -231,6 +231,76 @@ def config5_causal_crash(n=100_000, n_actors=16, crashes=16,
             "n_actors": n_actors}
 
 
+def config6_echo(n=2, sizes_kb=(1024, 2048, 4096, 8192),
+                 concurrency=(1, 2, 4, 8), latencies_ms=(1, 20, 100),
+                 parallelism=1, num_messages=1000,
+                 bandwidth_mb_s=1000.0, csv_path=None) -> dict:
+    """Echo/latency matrix (the reference's ``performance_test`` +
+    ``bin/perf-suite.sh`` sweep: SIZE × CONCURRENCY × RTT): two nodes,
+    ``concurrency`` ping-pong sender processes sharing the channel's
+    ``parallelism`` lanes under capacity enforcement, ``num_messages``
+    round trips each.
+
+    Time derivation: one simulated round is one link traversal worth
+    ``max(latency/2, size/bandwidth)`` ms (tc-netem delay on loopback +
+    serialization at ``bandwidth_mb_s``), so the reported time is
+    ``rounds × per_round_ms × 1000`` µs — the same quantity the
+    reference's ``timer:tc`` wall-clock captures, minus host scheduling
+    noise.  Emits the reference's CSV columns
+    ``backend,concurrency,parallelism,bytes,nummessages,latency,time``.
+    """
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import ChannelSpec, Config, DEFAULT_CHANNEL
+    from partisan_tpu.models.echo import CLIENT, Echo
+
+    rows = []
+    for conc in concurrency:
+        model = Echo(concurrency=conc, num_messages=num_messages)
+        cfg = Config(
+            n_nodes=n, seed=11, peer_service_manager="static",
+            channel_capacity=True, lane_rate=1,
+            outbox_cap=max(32, 2 * conc),
+            channels=(ChannelSpec(DEFAULT_CHANNEL,
+                                  parallelism=parallelism),))
+        cl = Cluster(cfg, model=model)
+        st0 = cl.init()
+        # rounds-to-completion is latency/size-independent (they only
+        # scale the virtual clock), so run the ping-pong once per
+        # concurrency level and derive every (size, latency) cell.
+        st, _ = cl.run_until(
+            st0, lambda s: model.done(s.model),
+            max_rounds=2 * num_messages
+            + 4 * num_messages * conc // max(parallelism, 1) + 50,
+            check_every=50)
+        assert model.done(st.model), "echo run did not complete"
+        rounds = int(st.rnd)
+        echoes = int(st.model.echoed[CLIENT].sum())
+        assert echoes == conc * num_messages, (echoes, conc)
+        for size_kb in sizes_kb:
+            for lat in latencies_ms:
+                per_round_ms = max(lat / 2.0,
+                                   size_kb / 1024.0 / bandwidth_mb_s
+                                   * 1000.0)
+                time_us = int(rounds * per_round_ms * 1000)
+                rows.append({
+                    "backend": "partisan_tpu", "concurrency": conc,
+                    "parallelism": parallelism,
+                    "bytes": size_kb * 1024,
+                    "nummessages": num_messages, "latency": lat,
+                    "time": time_us, "rounds": rounds,
+                })
+    if csv_path:
+        with open(csv_path, "w") as f:
+            f.write("backend,concurrency,parallelism,bytes,"
+                    "nummessages,latency,time\n")
+            for r in rows:
+                f.write(f"{r['backend']},{r['concurrency']},"
+                        f"{r['parallelism']},{r['bytes']},"
+                        f"{r['nummessages']},{r['latency']},"
+                        f"{r['time']}\n")
+    return {"config": 6, "cells": len(rows), "rows": rows}
+
+
 # ---------------------------------------------------------------------------
 
 ALL = {
@@ -239,15 +309,19 @@ ALL = {
     3: config3_plumtree_drop,
     4: config4_scamp_churn,
     5: config5_causal_crash,
+    6: config6_echo,
 }
 
-DEFAULT_SIZES = {1: 16, 2: 1000, 3: 10_000, 4: 10_000, 5: 100_000}
+DEFAULT_SIZES = {1: 16, 2: 1000, 3: 10_000, 4: 10_000, 5: 100_000, 6: 2}
 
 
 def run_all(scale: float = 1.0, only=None) -> list[dict]:
     out = []
     for i, fn in ALL.items():
         if only and i not in only:
+            continue
+        if i == 6:
+            out.append(fn(num_messages=max(50, int(1000 * scale))))
             continue
         n = max(8, int(DEFAULT_SIZES[i] * scale))
         out.append(fn(n=n))

@@ -76,8 +76,17 @@ class MsgKind(enum.IntEnum):
     HPV_DISCONNECT = 15
     HPV_SHUFFLE = 16         # payload: [origin, k_slots...]; W_TTL = walk
     HPV_SHUFFLE_REPLY = 17   # payload: [origin, k_slots...] (same layout)
-    HPV_XBOT_OPT = 18        # payload: [old_peer] — X-BOT optimization ask
-    HPV_XBOT_OPT_REPLY = 19  # payload: [old_peer, accepted]
+    # X-BOT 4-party replace handshake (reference :1880-2050): initiator
+    # i (worst peer o) asks candidate c; a full c asks ITS worst peer d
+    # to REPLACE; d asks o to SWITCH (o pairs with d so the swap
+    # preserves everyone's degree: edges i-o, c-d become i-c, o-d).
+    # Payload convention for the chain: [o, i, c, d, flag].
+    HPV_XBOT_OPT = 18            # i -> c; payload: [old_peer]
+    HPV_XBOT_OPT_REPLY = 19      # c -> i; payload: [old_peer, accepted]
+    HPV_XBOT_REPLACE = 24        # c -> d; payload: [o, i, c, d]
+    HPV_XBOT_SWITCH = 25         # d -> o; payload: [o, i, c, d]
+    HPV_XBOT_SWITCH_REPLY = 26   # o -> d; payload: [o, i, c, d, flag]
+    HPV_XBOT_REPLACE_REPLY = 27  # d -> c; payload: [o, i, c, d, flag]
 
     # -- SCAMP (partisan_scamp_v1_membership_strategy.erl:67-297, v2)
     SCAMP_SUBSCRIPTION = 20       # forward_subscription; payload: [subscriber,

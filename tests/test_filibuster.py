@@ -112,3 +112,95 @@ def test_annotation_pruning_reduces_candidates():
     pruned._closure = None
     assert not pruned._relevant_kind("PONG")
     assert pruned._relevant_kind("ACK") and pruned._relevant_kind("APP")
+
+
+def test_unsound_pruning_demo_default_still_finds_absence_bug():
+    """The soundness boundary of trace-derived pruning, demonstrated: a
+    protocol with an ABSENCE-triggered reaction (a watchdog that alarms
+    when expected data never arrives) has no APP -> RPC_CALL receipt
+    edge in ANY trace — so opt-in pruning against that graph wrongly
+    skips the one schedule that fires the alarm, while the DEFAULT
+    (reaction=None, exhaustive within budget) executes it and finds the
+    counterexample.  This is why pruning is opt-in (the reference's
+    static source analysis over-approximates and does not have this
+    hole, partisan_analysis.erl:24-60)."""
+    import jax.numpy as jnp
+    from typing import NamedTuple
+
+    from partisan_tpu import analysis, trace as trace_mod
+    from partisan_tpu import types as T
+    from partisan_tpu.ops import msg as msg_ops
+
+    SEND_R, DEADLINE = 2, 6
+
+    class WDState(NamedTuple):
+        got: jnp.ndarray         # bool[n] — node received the data
+        alarm_seen: jnp.ndarray  # bool[n] — node received an alarm
+
+    class Watchdog:
+        name = "watchdog"
+
+        def init(self, cfg, comm):
+            n = comm.n_local
+            return WDState(got=jnp.zeros((n,), jnp.bool_),
+                           alarm_seen=jnp.zeros((n,), jnp.bool_))
+
+        def step(self, cfg, comm, state, ctx, nbrs):
+            gids = comm.local_ids()
+            inb = ctx.inbox.data
+            kinds = inb[..., T.W_KIND]
+            got = state.got | (kinds == T.MsgKind.APP).any(axis=1)
+            alarm_seen = state.alarm_seen | \
+                (kinds == T.MsgKind.RPC_CALL).any(axis=1)
+            send_data = (ctx.rnd == SEND_R) & (gids == 0)
+            alarm = (ctx.rnd == DEADLINE) & (gids == 1) & ~got
+            emitted = jnp.concatenate([
+                msg_ops.build(cfg.msg_words, T.MsgKind.APP, gids,
+                              jnp.where(send_data, 1, -1))[:, None],
+                msg_ops.build(cfg.msg_words, T.MsgKind.RPC_CALL, gids,
+                              jnp.where(alarm, 0, -1))[:, None],
+            ], axis=1)
+            return WDState(got=got, alarm_seen=alarm_seen), emitted
+
+    model = Watchdog()
+
+    def build(interp):
+        cfg = fm_config(4, seed=3)
+        cl = Cluster(cfg, model=model, interpose=interp)
+        return cl, cl.init()
+
+    def assertion(cl, st):
+        return not bool(st.model.alarm_seen.any())
+
+    def cand(ev):
+        return ev.kind_name == "APP"
+
+    # The golden trace has no APP -> RPC_CALL edge (the alarm never
+    # fired), so pruning against it considers APP-omissions irrelevant
+    # to the RPC_CALL target and MISSES the bug...
+    cl, st = build(None)
+    _, cap = cl.record(st, 10)
+    g = analysis.reaction_graph(trace_mod.from_capture(cap))
+    assert "RPC_CALL" not in g.get("APP", set())
+    pruned = filibuster.Checker(
+        build=build, horizon=10, assertion=assertion, candidate=cand,
+        max_faults=1, reaction=g, target_kinds=("RPC_CALL",))
+    res_pruned = pruned.run()
+    assert res_pruned.passed, "pruning unexpectedly kept the schedule"
+
+    # ...while the DEFAULT (no pruning) executes it and fails loudly.
+    default = filibuster.Checker(
+        build=build, horizon=10, assertion=assertion, candidate=cand,
+        max_faults=1)
+    res = default.run()
+    assert not res.passed
+    assert len(res.counterexample.schedule) == 1
+    assert "APP" in res.render()
+
+    # Even an ensemble over BOTH traces can't see the absence edge —
+    # the structural reason pruning stays opt-in — but the coverage
+    # report makes the evidence base explicit.
+    g2, cov = analysis.ensemble_reaction(
+        [res.base_trace, res.counterexample.trace])
+    assert "RPC_CALL" not in g2.get("APP", set())
+    assert cov["traces"] == 2 and "RPC_CALL" in cov["background"]

@@ -77,3 +77,29 @@ def test_reserve_validation():
         cl.manager.reserve(cfg, st.manager, 0, cfg.hyparview.active_max + 1)
     with pytest.raises(ValueError):
         cl.manager.reserve(cfg, st.manager, 0, -1)
+
+
+def test_xbot_roundtrip_no_persistent_one_way_edges():
+    """The 4-party replace handshake re-homes every demoted peer (swap
+    i-o, c-d -> i-c, o-d): after optimization cycles settle, active
+    views stay (almost entirely) SYMMETRIC — no lingering one-way edges
+    — and node degrees are preserved rather than bled away."""
+    import dataclasses
+
+    cfg = hv_config(N, SEED)
+    cfg = cfg.replace(
+        hyparview=dataclasses.replace(cfg.hyparview, xbot=True))
+    cl = Cluster(cfg)
+    st = boot_hyparview(cl, settle=30)
+    pre = np.asarray(cl.manager.neighbors(cfg, st.manager))
+    pre_deg = (pre >= 0).sum(axis=1)
+    st = cl.steps(st, 150)   # ~15 optimization cycles (xbot_every = 10)
+    act = np.asarray(cl.manager.neighbors(cfg, st.manager))
+    edges = {(i, int(j)) for i in range(N) for j in act[i] if j >= 0}
+    sym = sum((b, a) in edges for (a, b) in edges) / max(len(edges), 1)
+    # mid-flight chains may hold a handful of half-built edges; anything
+    # persistent would crater this ratio
+    assert sym >= 0.9, f"one-way edges persisted: symmetry {sym:.2f}"
+    deg = (act >= 0).sum(axis=1)
+    assert deg.mean() >= pre_deg.mean() - 0.5, (pre_deg.mean(), deg.mean())
+    assert (deg >= 1).all(), f"isolated nodes: {np.where(deg == 0)[0]}"
