@@ -37,11 +37,16 @@ def run(n: int, verbose: bool = False) -> dict:
     from partisan_tpu.config import Config
     from partisan_tpu.models.plumtree import Plumtree
 
-    # max_broadcasts sizes the plumtree slot table to the workload (one
-    # broadcast slot in use): [n, B] state and [n, cap, B] one-hots scale
-    # linearly in B, and the relay-attached TPU prices ops by bytes.
+    # Capacity knobs size the tensors to the workload (the relay-attached
+    # TPU prices ops by bytes): one broadcast slot in use -> small
+    # max_broadcasts / push_slots / lazy_cap; inbox_cap=16 measured at
+    # identical convergence (58 rounds @4096, zero drops) and ~30% less
+    # per-round traffic than 32.
+    from partisan_tpu.config import PlumtreeConfig
     cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups", max_broadcasts=8)
+                 msg_words=16, partition_mode="groups", max_broadcasts=8,
+                 inbox_cap=16,
+                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
     model = Plumtree()
     cl = Cluster(cfg, model=model)
     st = cl.init()

@@ -145,6 +145,10 @@ class Bridge:
                 data=inbox.data.at[node].set(jnp.asarray(keep)),
                 count=inbox.count.at[node].add(-len(out))))
             return (OK, out)
+        if cmd == "is_alive":
+            # liveness probe (the TCP-EXIT failure-detector analogue the
+            # Erlang monitor layer polls for DOWN delivery)
+            return (OK, bool(self.st.faults.alive[int(args[0])]))
         if cmd == "crash":
             self.st = st._replace(faults=faults_mod.crash(st.faults, int(args[0])))
             return OK

@@ -235,3 +235,73 @@ class PrimaryBackupSystem:
 
     def settle_rounds(self) -> int:
         return 15
+
+
+@dataclasses.dataclass
+class AtomicCommitSystem:
+    """Application-under-test model (the role test/prop_partisan_hbbft.erl
+    :703 plays for the reference — proving the harness hosts a NON-TOY
+    system): the atomic-commit ENGINE (models/commit.py — Lampson 2PC,
+    Bernstein CTP, Skeen 3PC) under the crash fault model, checked
+    against atomic commitment's real safety properties:
+
+    - AGREEMENT: no transaction ends with both a committed and an
+      aborted participant (AC1),
+    - UNIFORMITY: a coordinator-reported ok implies every alive
+      participant delivered (the blocking hole 2PC is famous for — an
+      omission in the commit fan-out strands a prepared participant,
+      which Bernstein CTP's cooperative termination repairs and plain
+      2PC cannot).
+    """
+
+    variant: str = "bernstein_ctp"
+    n_nodes: int = 6
+    slots: int = 4
+    seed: int = 1
+    name: str = "atomic_commit"
+
+    def __post_init__(self) -> None:
+        from partisan_tpu.models.commit import CommitProtocol
+
+        self.model = CommitProtocol(self.variant, slots=self.slots,
+                                    coordinator_timeout_rounds=10,
+                                    participant_timeout_rounds=5)
+        self._next_slot = 0
+
+    def build(self):
+        self._next_slot = 0
+        return _cached_build(self, lambda: Cluster(
+            Config(n_nodes=self.n_nodes, seed=self.seed,
+                   inbox_cap=max(32, self.n_nodes + 8)),
+            model=self.model))
+
+    def begin_command(self, coord: int, slot: int, value: int) -> Command:
+        import jax.numpy as jnp
+
+        members = jnp.ones((self.n_nodes,), jnp.bool_)
+
+        def apply(c, s):
+            return s._replace(model=self.model.begin(
+                s.model, coord, slot, value, members, s.rnd))
+
+        return Command(name="begin", args=(coord, slot, value), apply=apply)
+
+    def gen_command(self, rng: random.Random, cl, st) -> Command:
+        slot = self._next_slot % self.slots
+        self._next_slot += 1
+        return self.begin_command(rng.randrange(self.n_nodes), slot,
+                                  rng.randrange(1, 1000))
+
+    def postcondition(self, cl, st, script) -> bool:
+        if not bool(self.model.agreement(st.model)):
+            return False
+        begun = {c.args[1] for c in script if c.name == "begin"}
+        return all(
+            bool(self.model.committed_implies_all(
+                st.model, slot, st.faults.alive))
+            for slot in begun)
+
+    def settle_rounds(self) -> int:
+        # covers the coordinator timeout (10) + CTP's decision-request
+        # repair cycle after the heal
+        return 30

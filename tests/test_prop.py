@@ -129,3 +129,52 @@ def test_linearizability_system_passes_and_detects():
     assert sys.postcondition(cl, st, [s1, s2])
     assert not sys.postcondition(cl, st, [s2, s1]), \
         "reordered history must violate linearizability"
+
+
+def test_atomic_commit_app_under_test_2pc_blocks_ctp_repairs():
+    """The application-under-test model (prop_partisan_hbbft role): the
+    commit engine hosted in the harness.  A commit-fanout omission
+    strands a prepared 2PC participant while the rest deliver —
+    UNIFORMITY fails and shrinks to the minimal (begin, omit) script —
+    and Bernstein CTP's cooperative termination repairs the identical
+    schedule."""
+    from partisan_tpu import faults as faults_mod
+    from partisan_tpu.prop_models import AtomicCommitSystem
+
+    def script_for(sys):
+        # begin at node 0; the omission lands AFTER the votes return but
+        # BEFORE the commit fan-out reaches node 4 (rounds_between=2
+        # puts the cut at round ~2 of the transaction, mid-handshake).
+        return [
+            sys.begin_command(0, 0, 77),
+            Command(name="omit_edge", args=(0, 4), kind="fault",
+                    apply=lambda c, s: s._replace(
+                        faults=faults_mod.inject_partition(
+                            s.faults, [0], [4]))),
+        ]
+
+    sys2pc = AtomicCommitSystem(variant="lampson_2pc")
+    h2pc = Harness(system=sys2pc, n_runs=1)
+    script = script_for(sys2pc)
+    assert not h2pc._execute(script), \
+        "2PC should strand the cut participant (blocking)"
+    shrunk = h2pc._shrink(script)
+    assert len(shrunk) == 2, shrunk     # both commands required
+
+    sysctp = AtomicCommitSystem(variant="bernstein_ctp")
+    hctp = Harness(system=sysctp, n_runs=1)
+    assert hctp._execute(script_for(sysctp)), \
+        "CTP's decision_request should repair the stranded participant"
+
+
+def test_atomic_commit_random_runs_hold_safety_for_ctp():
+    """Random command sequences under the crash fault model: CTP keeps
+    atomic-commit safety within the tolerance budget."""
+    from partisan_tpu.prop_models import AtomicCommitSystem
+
+    sys = AtomicCommitSystem(variant="bernstein_ctp", seed=3)
+    res = Harness(
+        system=sys,
+        fault_model=CrashFaultModel(tolerance=1, allow_crash=False),
+        scheduler="finite_fault", n_runs=4, n_commands=5, seed=900).run()
+    assert res.ok, res.render()
