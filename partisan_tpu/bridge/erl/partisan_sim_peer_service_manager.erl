@@ -59,6 +59,7 @@
          on_down/3,
          decode/1,
          reserve/1,
+         is_alive/1,
          supports_capability/1]).
 
 -export([init/1, handle_call/3, handle_cast/2, handle_info/2,
@@ -67,19 +68,26 @@
 -define(PORT_CMD, "python3 -m partisan_tpu.bridge.server").
 -define(TICK_MS, 100).   %% one simulated round per tick (round_ms is
                          %% virtual; the live bridge ticks faster)
+-define(TCP_OPTS, [{packet, 4}, binary, {active, false}]).
+-define(BRIDGE_TIMEOUT, 120000).
 
-%% NOTE multi-VM deployments: every participating Erlang node must talk
-%% to ONE shared simulator (each setting its own id via {set_self, Id}
-%% and draining its own deliveries with argument-less {drain}).  The
-%% stdio port transport below is the single-VM harness; for multi-VM,
-%% run `python -m partisan_tpu.bridge.socket_server --port P` once and
-%% replace open_port with
-%%   gen_tcp:connect(Host, P, [{packet, 4}, binary, {active, false}])
-%% + gen_tcp:send / {tcp, _, Bin} receives — the sequenced request/reply
-%% protocol is identical on both transports
-%% (partisan_tpu/bridge/socket_server.py).
+%% Transports (config-selected, {sim_transport, port | tcp}):
+%%
+%%   port — open_port stdio to a private simulator (single-VM harness).
+%%   tcp  — gen_tcp to a SHARED simulator started once with
+%%          `python -m partisan_tpu.bridge.socket_server --port P`
+%%          (partisan_tpu/bridge/socket_server.py): the multi-VM
+%%          deployment.  Every participating Erlang node connects to the
+%%          same simulator, sets its own id via {set_self, Id}
+%%          ({sim_self_id, Id} config) and drains its own deliveries;
+%%          exactly ONE node (config {sim_primary, true}, default) sends
+%%          {init, ...} — a second init would wipe the shared cluster.
+%%
+%% The sequenced {Seq, Req} -> {Seq, Reply} protocol is identical on
+%% both transports.
+-type bridge() :: {port, port()} | {tcp, gen_tcp:socket()}.
 
--record(state, {port        :: port(),
+-record(state, {port        :: bridge(),
                 seq = 0     :: non_neg_integer(),
                 self_id     :: non_neg_integer(),
                 node_ids    :: #{node() => non_neg_integer()},
@@ -178,6 +186,11 @@ receive_message(Peer, _Channel, Message) ->
     %% receive path on a new message family)
     gen_server:cast(?MODULE, {unhandled, Peer, Message}).
 
+is_alive(NodeSpec) ->
+    %% liveness probe behind supports_capability(monitoring): polls the
+    %% simulated failure detector for DOWN/nodedown delivery
+    gen_server:call(?MODULE, {is_alive, NodeSpec}, infinity).
+
 inject_partition(Origin, TTL) ->
     gen_server:call(?MODULE, {inject_partition, Origin, TTL}, infinity).
 
@@ -207,9 +220,13 @@ decode(State) ->
 reserve(Tag) ->
     gen_server:call(?MODULE, {reserve, Tag}, infinity).
 
-%% The simulated transport delivers monitoring signals via membership
-%% diffs (on_up/on_down); process-level monitoring rides the OTP layer.
-supports_capability(monitoring) -> false;
+%% Monitoring IS supported: node liveness rides the membership diffs
+%% (on_up/on_down fired from fire_membership_callbacks) plus the
+%% exported is_alive/1 probe ({is_alive, Id} bridge command), which is
+%% what partisan_monitor needs to deliver DOWN/nodedown signals —
+%% parity with the reference pluggable manager
+%% (src/partisan_pluggable_peer_service_manager.erl:634 returns true).
+supports_capability(monitoring) -> true;
 supports_capability(_) -> false.
 
 %% -----------------------------------------------------------------------
@@ -217,11 +234,13 @@ supports_capability(_) -> false.
 %% -----------------------------------------------------------------------
 
 init([]) ->
-    Port = open_port({spawn, ?PORT_CMD},
-                     [{packet, 4}, binary, exit_status]),
+    Port = connect_bridge(),
     N = partisan_config:get(sim_nodes, 16),
     SelfId = partisan_config:get(sim_self_id, 0),
-    ok = rpc_port(Port, {init, #{n_nodes => N}}),
+    case partisan_config:get(sim_primary, true) of
+        true -> ok = rpc_port(Port, {init, #{n_nodes => N}});
+        false -> ok           %% shared simulator already initialized
+    end,
     ok = rpc_port(Port, {set_self, SelfId}),
     Symbols = ets:new(?MODULE, [set, protected]),
     erlang:send_after(?TICK_MS, self(), tick),
@@ -289,6 +308,10 @@ handle_call({forward, Node, ServerRef, Message}, _From, State0) ->
                   {forward_message, State#state.self_id, Dst, Words}),
     {reply, ok, State};
 
+handle_call({is_alive, NodeSpec}, _From, State0) ->
+    {Id, State} = intern_node(NodeSpec, State0),
+    {reply, rpc_port(State#state.port, {is_alive, Id}), State};
+
 handle_call({inject_partition, Origin, TTL}, _From,
             State = #state{partitions = Ps, port = P, self_id = Me}) ->
     %% Sever this node from EVERYONE else (hyparview impl pattern,
@@ -341,15 +364,19 @@ handle_info(tick, State = #state{port = P, self_id = Me}) ->
     erlang:send_after(?TICK_MS, self(), tick),
     {noreply, State1};
 
-handle_info({Port, {exit_status, Status}}, State = #state{port = Port}) ->
+handle_info({Port, {exit_status, Status}},
+            State = #state{port = {port, Port}}) ->
     {stop, {port_exited, Status}, State};
 
 handle_info(_Info, State) ->
     {noreply, State}.
 
-terminate(_Reason, #state{port = P}) ->
-    catch rpc_port(P, {stop}),
-    catch port_close(P),
+terminate(_Reason, #state{port = B}) ->
+    catch rpc_port(B, {stop}),
+    case B of
+        {port, P} -> catch port_close(P);
+        {tcp, S} -> catch gen_tcp:close(S)
+    end,
     ok.
 
 code_change(_Old, State, _Extra) ->
@@ -359,33 +386,71 @@ code_change(_Old, State, _Extra) ->
 %% internals
 %% -----------------------------------------------------------------------
 
+connect_bridge() ->
+    case partisan_config:get(sim_transport, port) of
+        tcp ->
+            Host = partisan_config:get(sim_host, "127.0.0.1"),
+            TcpPort = partisan_config:get(sim_port, 4790),
+            {ok, Sock} = gen_tcp:connect(Host, TcpPort, ?TCP_OPTS, 5000),
+            {tcp, Sock};
+        _ ->
+            {port, open_port({spawn, ?PORT_CMD},
+                             [{packet, 4}, binary, exit_status])}
+    end.
+
 %% Sequenced request/reply: each request is {Seq, Req} and the bridge
 %% echoes {Seq, Reply}.  After a timeout, stale replies with older
 %% sequence numbers are discarded on the next call instead of being
 %% paired with the wrong request (the first {step, 1} can exceed the
-%% timeout while XLA compiles the round program).
-rpc_port(Port, Req) ->
+%% timeout while XLA compiles the round program).  The protocol is
+%% transport-independent; only the framing I/O differs.
+rpc_port({port, Port}, Req) ->
     Seq = erlang:unique_integer([positive, monotonic]),
     true = port_command(Port, term_to_binary({Seq, Req})),
-    await_reply(Port, Seq).
+    await_reply(Port, Seq);
+rpc_port({tcp, Sock}, Req) ->
+    Seq = erlang:unique_integer([positive, monotonic]),
+    ok = gen_tcp:send(Sock, term_to_binary({Seq, Req})),
+    await_tcp_reply(Sock, Seq).
 
 await_reply(Port, Seq) ->
     receive
         {Port, {data, Bin}} ->
-            case binary_to_term(Bin) of
-                {Seq, Reply} ->
-                    case Reply of
-                        ok -> ok;
-                        {ok, Result} -> {ok, Result};
-                        Other -> Other
-                    end;
-                {Stale, _} when is_integer(Stale), Stale < Seq ->
-                    await_reply(Port, Seq);   % drop late reply, keep waiting
-                _Unexpected ->
-                    await_reply(Port, Seq)
+            case decode_reply(Bin, Seq) of
+                retry -> await_reply(Port, Seq);
+                Reply -> Reply
             end
-    after 120000 ->
+    after ?BRIDGE_TIMEOUT ->
         {error, bridge_timeout}
+    end.
+
+await_tcp_reply(Sock, Seq) ->
+    case gen_tcp:recv(Sock, 0, ?BRIDGE_TIMEOUT) of
+        {ok, Bin} ->
+            case decode_reply(Bin, Seq) of
+                retry -> await_tcp_reply(Sock, Seq);
+                Reply -> Reply
+            end;
+        {error, Reason} ->
+            %% passive-mode sockets surface closure HERE ({error,
+            %% closed}), never as a {tcp_closed, _} message; the caller's
+            %% `ok = rpc_port(...)` badmatch stops the gen_server, which
+            %% is the intended fail-fast on a dead shared simulator
+            {error, {bridge_tcp, Reason}}
+    end.
+
+decode_reply(Bin, Seq) ->
+    case binary_to_term(Bin) of
+        {Seq, Reply} ->
+            case Reply of
+                ok -> ok;
+                {ok, Result} -> {ok, Result};
+                Other -> Other
+            end;
+        {Stale, _} when is_integer(Stale), Stale < Seq ->
+            retry;   %% drop late reply, keep waiting
+        _Unexpected ->
+            retry
     end.
 
 %% sync_join completion: step the simulator until the joined id appears

@@ -137,6 +137,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
     fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
 
+    if cfg.emit_compact:
+        emitted = exchange.compact_emissions(emitted, cfg.emit_compact)
     inbox = comm.route(emitted)
     # Crash-stopped receivers drop everything addressed to them.
     dead = ~alive_local
@@ -196,6 +198,11 @@ class Cluster:
     manager: Any = None
     model: Any = None
     interpose: Any = None   # interpose.Interposition (or a Chain), static
+    donate: bool = False    # donate the state carry to steps() — the
+    #                         caller must not reuse a donated input state
+    #                         (bench/scenario drivers thread state
+    #                         linearly; tests that fork states keep the
+    #                         default)
 
     def __post_init__(self) -> None:
         if self.manager is None:
@@ -206,11 +213,19 @@ class Cluster:
             msg_words=self.cfg.msg_words,
         )
         self._step = jax.jit(self._round)
-        self._steps = jax.jit(self._scan, static_argnums=1)
+        self._steps = jax.jit(self._scan, static_argnums=1,
+                              donate_argnums=(0,) if self.donate else ())
         self._record = jax.jit(self._scan_traced, static_argnums=1)
+        self._init = jax.jit(self._build_init)
 
     # ---- state construction ------------------------------------------
     def init(self) -> ClusterState:
+        """Initial state, built as ONE jitted program — on a relay-attached
+        device each eager allocation is a host round-trip, which made
+        eager init cost ~7 s at 32k nodes."""
+        return self._init()
+
+    def _build_init(self) -> ClusterState:
         cfg, comm = self.cfg, self.comm
         return ClusterState(
             rnd=jnp.int32(0),

@@ -67,6 +67,36 @@ class HyParViewConfig:
     random_promotion_interval_ms: int = 5_000
     xbot: bool = False                   # X-BOT overlay optimization
     xbot_interval_ms: int = 10_000       # xbot_execution timer (:1114)
+    # Liveness heartbeat + isolation detection: node 0 (the first
+    # discovery seed) bumps an epoch every heartbeat interval, propagated
+    # by scatter-max along active edges each round (the membership-layer
+    # transposition of partisan_plumtree_backend.erl's periodic heartbeat
+    # broadcasts, :22-35 "stimulate tree construction").  A node whose
+    # received epoch stalls for longer than the isolation window
+    # re-joins via a random discovery seed — scamp_v2's missed-message
+    # isolation window (?SCAMP_MESSAGE_WINDOW re-subscription,
+    # partisan_scamp_v2_membership_strategy.erl:180-222) applied to
+    # HyParView, where saturated disconnected components (full active
+    # views pointing only at each other) are otherwise unmergeable
+    # (measured: two 7-node cliques among 100k after a mass bootstrap).
+    heartbeat: bool = True
+    heartbeat_every_ms: int = 10_000     # epoch bump cadence (node 0)
+    isolation_window_ms: int = 40_000    # stale-epoch rejoin threshold
+    seed_count: int = 8                  # discovery seeds = ids [0, k)
+    auto_rejoin: bool = True             # a previously-joined node whose
+    #                                      active AND passive views empty
+    #                                      out re-joins via a random
+    #                                      contact — the discovery-agent
+    #                                      auto-join loop (partisan_peer_
+    #                                      discovery_agent.erl polls and
+    #                                      joins found peers; scamp_v2's
+    #                                      isolation re-subscription is
+    #                                      the same idea, :180-222).
+    #                                      Without it total isolation is
+    #                                      unrecoverable (measured: 14 of
+    #                                      100k nodes orphaned after a
+    #                                      mass bootstrap, capping
+    #                                      broadcast coverage at 99.986%)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +108,9 @@ class PlumtreeConfig:
     lazy_cap: int = 8     # i_have messages per node per lazy tick
     aae: bool = True      # exchange-tick handler anti-entropy
                           # (partisan_plumtree_broadcast.erl:1040-1070)
+    exchange_limit: int = 1  # exchanges started per node per tick
+                          # (broadcast_start_exchange_limit, default 1 —
+                          # partisan_config.erl:750-755); 0 disables
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,6 +189,15 @@ class Config:
     # --- tensor capacities (sim-specific) ------------------------------
     inbox_cap: int = 32          # queued event messages per node per round
     emit_cap: int = 16           # event messages a node may emit per round
+    emit_compact: int = 0        # >0: compact each node's emissions to at
+    #                              most this many live messages before the
+    #                              global route sort (the emission tensor
+    #                              is wide but sparse — hyparview+plumtree
+    #                              stack ~70 slots of which a handful are
+    #                              live; a cheap per-row compaction shrinks
+    #                              the O(n·E) global sort ~3x at 32k+).
+    #                              Overflow sheds (counted in Stats.dropped)
+    #                              — size it so steady-state sheds are zero.
     msg_words: int = 12          # int32 words per message record
     max_broadcasts: int = 64     # concurrent broadcast slots (plumtree/anti-entropy)
     n_actors: int = 64           # vclock width for causal delivery
@@ -171,6 +213,21 @@ class Config:
     #                              round when channel_capacity is on
     outbox_cap: int = 32         # deferred sends carried per node
     #                              (backpressure buffer; overflow sheds)
+
+    # --- sharded exchange (parallel/sharded.py) ------------------------
+    sharded_exchange: str = "all_gather"  # all_gather | all_to_all —
+    #                              how emissions cross shards.  all_gather
+    #                              replicates every shard's emissions
+    #                              (O(n_global·E·W) per shard, lossless);
+    #                              all_to_all sends each message only to
+    #                              its destination shard (sorted by dest
+    #                              shard + lax.all_to_all, O(n_local·S·Q))
+    #                              with a fixed per-dest-shard quota —
+    #                              overflow sheds (counted in stats).
+    a2a_factor: int = 4          # all_to_all quota = factor × ceil(M/S)
+    #                              per destination shard (M = n_local·E):
+    #                              uniform traffic fills 1/factor of it;
+    #                              size so steady-state sheds are zero
 
     # --- fault-state representation ------------------------------------
     partition_mode: str = "auto"  # auto | dense | groups — dense bool[n,n]

@@ -101,6 +101,9 @@ _TAG_SHTGT = 317
 _TAG_PRTGT = 318
 _TAG_XCAND = 319
 _TAG_PSEL = 320
+_TAG_REJOIN = 321
+_TAG_HBSEED = 322
+_TAG_HBJIT = 323
 
 
 def link_cost(seed: int, a, b):
@@ -128,6 +131,15 @@ class HyParViewState(NamedTuple):
     #                     ordinary admission (reserve/1, reference
     #                     reserved-slot map :230-243); scripted joins
     #                     may still use them
+    joined: Array       # bool[n_local] — has ever held an active edge;
+    #                     gates auto_rejoin (a never-joined node must
+    #                     stay inert until its scripted join)
+    hb_epoch: Array     # int32[n_local] — received liveness epoch
+    #                     (HyParViewConfig.heartbeat: scatter-max
+    #                     propagation of node 0's epoch counter)
+    hb_rnd: Array       # int32[n_local] — round the epoch last advanced
+    #                     (or the node joined); staleness beyond the
+    #                     isolation window triggers a discovery rejoin
 
 
 class HyParView:
@@ -148,6 +160,9 @@ class HyParView:
             leaving=jnp.zeros((n,), jnp.bool_),
             left=jnp.zeros((n,), jnp.bool_),
             reserved=jnp.zeros((n,), jnp.int32),
+            joined=jnp.zeros((n,), jnp.bool_),
+            hb_epoch=jnp.zeros((n,), jnp.int32),
+            hb_rnd=jnp.zeros((n,), jnp.int32),
         )
 
     # ------------------------------------------------------------------
@@ -580,9 +595,75 @@ class HyParView:
             passive1, pcands, pranks, gids, new_active)
 
         # ---- 7. timers (scripted join, shuffle, promotion, X-BOT) ----
-        do_join = join_tgt >= 0
+        # Liveness heartbeat: node 0's epoch (rnd // H) rides the active
+        # edges by scatter-max each round; a node whose received epoch
+        # has not advanced within the isolation window is (component-)
+        # isolated — full views pointing only at each other can make a
+        # disconnected clique no shuffle or promotion ever merges — and
+        # re-joins via a random discovery seed (see HyParViewConfig
+        # .heartbeat doc for the reference mechanisms this transposes).
+        stale_hb = jnp.zeros_like(ctx.alive)
+        hb_epoch, hb_rnd = state.hb_epoch, state.hb_rnd
+        if hv.heartbeat:
+            H = cfg.rounds(hv.heartbeat_every_ms)
+            window = cfg.rounds(hv.isolation_window_ms)
+            # The epoch root is the lowest-id ALIVE node — root duty
+            # migrates on crash (a fixed node-0 root would freeze every
+            # epoch when node 0 dies and put the whole cluster into a
+            # perpetual rejoin storm).  faults.alive is global state,
+            # replicated across shards, so the argmin needs no
+            # collective.
+            root = jnp.argmax(ctx.faults.alive).astype(jnp.int32)
+            own = jnp.where(gids == root, ctx.rnd // H, 0)
+            rows = jnp.maximum(hb_epoch, own)
+            tgts = jnp.where(active0 >= 0, active0, -1)
+            pulled = comm.push_max(rows[:, None], tgts)[:, 0]
+            new_epoch = jnp.maximum(rows, pulled)
+            # the join moment = the round the FIRST active edge lands
+            # (same signal as the `joined` flag update below)
+            first_join = ctx.alive & ~state.joined \
+                & jnp.any(new_active >= 0, axis=1)
+            # per-node jitter staggers the firing (a whole component
+            # going stale at once must not JOIN-storm the seeds in one
+            # round)
+            jit = (ranked(_TAG_HBJIT, gids, jnp.uint32(0))
+                   % jnp.uint32(max(H, 1))).astype(jnp.int32)
+            stale_hb = ctx.alive & ~state.left & state.joined \
+                & (ctx.rnd - hb_rnd > window + jit)
+            hb_epoch = new_epoch
+            # firing resets the clock: the retry cadence is one window
+            hb_rnd = jnp.where(
+                (new_epoch > state.hb_epoch) | first_join | stale_hb,
+                ctx.rnd, hb_rnd)
+
+        join_dst = join_tgt
+        if hv.auto_rejoin:
+            # Discovery-agent auto-rejoin (partisan_peer_discovery_agent
+            # .erl auto-joins found peers; scamp_v2 isolation
+            # re-subscription :180-222): a previously-joined, alive node
+            # with NO active and NO passive entries fires a JOIN at a
+            # fresh random contact each round until an accept re-admits
+            # it.  No optimistic pre-insert — the edge must be two-way
+            # to restore INBOUND delivery, so only the accept installs
+            # it.  Without this, total isolation is unrecoverable
+            # (HyParView heals from the passive view only).
+            isolated = ctx.alive & ~state.left & state.joined \
+                & (asize0 == 0) & ~jnp.any(passive0 >= 0, axis=1) \
+                & (join_tgt < 0)
+            ng = jnp.uint32(max(comm.n_global - 1, 1))
+            contact = (ranked(_TAG_REJOIN, gids) % ng).astype(jnp.int32)
+            contact = contact + (contact >= gids)
+            join_dst = jnp.where(isolated, contact, join_tgt)
+        if hv.heartbeat and comm.n_global > 1:
+            sc = min(max(hv.seed_count, 2), comm.n_global)
+            seedc = (ranked(_TAG_HBSEED, gids)
+                     % jnp.uint32(sc)).astype(jnp.int32)
+            seedc = jnp.where(seedc == gids, (seedc + 1) % sc, seedc)
+            join_dst = jnp.where(stale_hb & (join_dst < 0), seedc,
+                                 join_dst)
+        do_join = join_dst >= 0
         join_msgs = msg_ops.build(
-            W, T.MsgKind.HPV_JOIN, gids, jnp.where(do_join, join_tgt, -1))
+            W, T.MsgKind.HPV_JOIN, gids, jnp.where(do_join, join_dst, -1))
         ev_join_disc = msg_ops.build(
             W, T.MsgKind.HPV_DISCONNECT, gids, evicted_j)
         sh_fire = ((ctx.rnd + gids) % cfg.shuffle_every == 0)
@@ -662,6 +743,9 @@ class HyParView:
             left=(state.left | (state.leaving & live))
                  & ~(state.join_target >= 0),
             reserved=state.reserved,
+            joined=state.joined | (live & jnp.any(new_active >= 0, axis=1)),
+            hb_epoch=jnp.where(live, hb_epoch, state.hb_epoch),
+            hb_rnd=jnp.where(live, hb_rnd, state.hb_rnd),
         )
         return new_state, emitted
 

@@ -1,0 +1,173 @@
+"""Pluggable plumtree broadcast-handler behaviour (tensor form).
+
+The reference lets applications supply the payload semantics that ride
+the epidemic broadcast tree: a handler module implements
+``broadcast_data/1, merge/2, is_stale/1, graft/1, exchange/1``
+(partisan_plumtree_broadcast_handler.erl:47-78) and the broadcast server
+calls it at every decision point (partisan_plumtree_broadcast.erl:
+565-577 merge, :861-905 graft service, :1040-1070 exchange).
+
+The tensor transposition: a handler's payload is a fixed-width vector of
+``payload_words`` int32 words, and its ``merge`` must be a lattice join —
+associative, commutative, idempotent — so the per-round fold over inbox
+slots can run batched (a tree reduction of ``join``) instead of one
+gen_server call per message.  The behaviour maps:
+
+    broadcast_data/1 -> :meth:`payload` + ``Plumtree.broadcast`` (id is
+                        the (node, slot) pair; payload is the vector)
+    merge/2          -> :meth:`join`  (store' = join(store, incoming))
+    is_stale/1       -> :meth:`leq`   (stale iff payload <= store)
+    graft/1          -> the store row itself, served back to the grafting
+                        peer (Plumtree replies PT_GOSSIP with the store)
+    exchange/1       -> :meth:`exchange` — AAE with a random peer; the
+                        base class IGNORES exchange, exactly like the
+                        reference's default handler
+                        (partisan_plumtree_backend.erl:22-35 "no AAE,
+                        exchange -> ignore"); :class:`MaxJoinHandler`
+                        provides the scatter-max implementation valid
+                        whenever ``join`` is elementwise max.
+
+Handlers whose join IS elementwise max (version counters, G-counters,
+grow-only flag sets) inherit :class:`MaxJoinHandler` and get working AAE
+for free.  Joins that are not per-word max (:class:`LWWHandler`'s
+timestamp-ordered register) still broadcast/repair through the tree —
+eager push, i_have/graft, prune — with exchange ignored.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+class BroadcastHandler:
+    """Base behaviour: version-counter semantics hooks, exchange ignored."""
+
+    payload_words: int = 1
+    identity: int = 0        # join identity, per word
+
+    # -- lattice ops (merge / is_stale) --------------------------------
+    def join(self, a: Array, b: Array) -> Array:
+        """Elementwise lattice join of payload vectors (broadcastable
+        shapes ``[..., payload_words]``)."""
+        raise NotImplementedError
+
+    def word_leq(self, a: Array, b: Array) -> Array:
+        """Elementwise per-word order test.  Default derives it from
+        ``join`` (a <= b  iff  join(a, b) == b) — override when cheaper."""
+        return self.join(a, b) == b
+
+    def leq(self, a: Array, b: Array) -> Array:
+        """Payload order ``a <= b`` (consumes the trailing word axis).
+        ``is_stale`` is ``leq(incoming, store)``."""
+        return jnp.all(self.word_leq(a, b), axis=-1)
+
+    def present(self, store: Array) -> Array:
+        """bool[...]: slot carries data (graft can serve it)."""
+        return jnp.any(store != self.identity, axis=-1)
+
+    # -- host-side construction (broadcast_data) -----------------------
+    def payload(self, value) -> Array:
+        """Coerce a host value (int or sequence) to a payload vector."""
+        if isinstance(value, (int, float)):
+            vec = [int(value)] + [self.identity] * (self.payload_words - 1)
+        else:
+            vec = list(int(v) for v in value)
+            if len(vec) != self.payload_words:
+                raise ValueError(
+                    f"payload has {len(vec)} words, handler carries "
+                    f"{self.payload_words}")
+        return jnp.asarray(vec, jnp.int32)
+
+    # -- AAE (exchange) -------------------------------------------------
+    supports_exchange: bool = False
+
+    def exchange(self, comm, store: Array, dst: Array) -> Array | None:
+        """Push ``store [n, B, PW]`` to the peers in ``dst [n, K]`` and
+        return what arrived at each node (joined across senders), or
+        ``None`` when exchange is unsupported (the reference default
+        handler's ``exchange -> ignore``)."""
+        return None
+
+
+class MaxJoinHandler(BroadcastHandler):
+    """Handlers whose join is elementwise max: batched fold AND AAE ride
+    the scatter-max gossip lane (ops/gossip.py)."""
+
+    supports_exchange = True
+
+    def join(self, a: Array, b: Array) -> Array:
+        return jnp.maximum(a, b)
+
+    def word_leq(self, a: Array, b: Array) -> Array:
+        return a <= b
+
+    def exchange(self, comm, store: Array, dst: Array) -> Array:
+        n, B, PW = store.shape
+        pulled = comm.push_max(store.reshape(n, B * PW), dst)
+        return pulled.reshape(n, B, PW)
+
+
+class VersionHandler(MaxJoinHandler):
+    """The default handler: one monotonically-versioned word per slot —
+    the heartbeat/version semantics of partisan_plumtree_backend.erl
+    (:191-260), where a re-broadcast bumps the version and re-propagates."""
+
+    payload_words = 1
+
+
+class GCounterHandler(MaxJoinHandler):
+    """Grow-only counter CRDT: one word per actor, join = elementwise max
+    (the state_orset-family merge the reference's membership rides,
+    partisan_membership_set.erl:116-213 — transposed to its simplest
+    lattice).  ``payload({actor: count})`` builds a vector contribution."""
+
+    def __init__(self, n_actors: int):
+        self.payload_words = n_actors
+
+    def payload(self, value) -> Array:
+        if isinstance(value, dict):
+            vec = [0] * self.payload_words
+            for actor, count in value.items():
+                vec[int(actor)] = int(count)
+            return jnp.asarray(vec, jnp.int32)
+        return super().payload(value)
+
+    def total(self, store: Array) -> Array:
+        """Counter value per slot: sum over actor words."""
+        return jnp.sum(store, axis=-1)
+
+
+class LWWHandler(BroadcastHandler):
+    """Last-writer-wins register: payload = [timestamp, value]; join keeps
+    the pair with the larger (timestamp, value) — NOT a per-word max (the
+    value rides with the winning timestamp), which exercises the general
+    join path.  Exchange is ignored (base class), like the reference's
+    default handler — tree repair (i_have/graft) is the delivery path."""
+
+    payload_words = 2
+
+    def join(self, a: Array, b: Array) -> Array:
+        a_ts, b_ts = a[..., 0], b[..., 0]
+        a_v, b_v = a[..., 1], b[..., 1]
+        b_wins = (b_ts > a_ts) | ((b_ts == a_ts) & (b_v > a_v))
+        return jnp.where(b_wins[..., None], b, a)
+
+    def leq(self, a: Array, b: Array) -> Array:
+        a_ts, b_ts = a[..., 0], b[..., 0]
+        return (a_ts < b_ts) | ((a_ts == b_ts) & (a[..., 1] <= b[..., 1]))
+
+
+def tree_fold(handler: BroadcastHandler, x: Array, axis: int) -> Array:
+    """Reduce ``x`` over ``axis`` with the handler's join, as a log-depth
+    tree of batched elementwise joins (works for any lattice join; XLA
+    fuses the max case into the same code the hand-written fold had)."""
+    x = jnp.moveaxis(x, axis, 0)
+    while x.shape[0] > 1:
+        m = x.shape[0]
+        if m % 2:
+            x = jnp.concatenate(
+                [x, jnp.full((1,) + x.shape[1:], handler.identity, x.dtype)])
+            m += 1
+        x = handler.join(x[0::2], x[1::2])
+    return x[0]

@@ -6,15 +6,18 @@ duplicate moves the sender to lazy and sends PRUNE (:843-857); lazy links
 carry periodic I_HAVE adverts (flushed every lazy_tick, :990-1030); a
 receiver missing an advertised message sends GRAFT, which re-activates the
 link and re-sends the payload (:861-905); AAE exchanges with a random peer
-every exchange_tick (:1040-1070).
+every exchange_tick (:1040-1070), capped by
+``broadcast_start_exchange_limit`` (partisan_config.erl:750-755).
 
 TPU mapping (one tensor program per round, layered over ANY manager):
 
-- the handler store (partisan_plumtree_broadcast_handler behaviour) is a
-  bounded slot table ``data int32[n, B]`` merged by elementwise max — the
-  monotonic-payload semantic of the default heartbeat handler
-  (partisan_plumtree_backend.erl:191-260): a slot's payload is a version
-  counter, re-broadcasts bump it and re-propagate,
+- payload semantics are PLUGGABLE via the broadcast-handler behaviour
+  (models/handlers.py — partisan_plumtree_broadcast_handler.erl:47-78):
+  the handler store is a slot table ``data int32[n, B, PW]`` merged by
+  the handler's lattice join; ``merge``/``is_stale``/``graft``/
+  ``exchange`` all derive from the handler.  The default
+  :class:`~partisan_tpu.models.handlers.VersionHandler` is the
+  heartbeat/version semantics of partisan_plumtree_backend.erl:191-260,
 - eager/lazy sets become ``pruned bool[n, B, K]`` flags over the overlay's
   K neighbor slots: eager(b, k) = link k alive and not pruned for tree b.
   The reference keys trees by broadcast ROOT; we key by broadcast slot
@@ -42,6 +45,7 @@ from partisan_tpu import types as T
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import BROADCAST_CHANNEL, Config
 from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.models import handlers as handlers_mod
 from partisan_tpu.ops import msg as msg_ops
 from partisan_tpu.ops import rng
 
@@ -50,7 +54,7 @@ _AAE_EDGE_TAG = 402
 
 
 class PlumtreeState(NamedTuple):
-    data: Array          # int32[n, B] — handler store (version per slot)
+    data: Array          # int32[n, B, PW] — handler store per slot
     rround: Array        # int32[n, B] — tree hop distance of our copy
     pruned: Array        # bool[n, B, K] — link k demoted to lazy for tree b
     lazy_pending: Array  # bool[n, B, K] — outstanding i_have to link k
@@ -62,11 +66,22 @@ class PlumtreeState(NamedTuple):
 class Plumtree:
     name = "plumtree"
 
+    def __init__(self, handler: handlers_mod.BroadcastHandler | None = None):
+        self.handler = handler if handler is not None \
+            else handlers_mod.VersionHandler()
+
     def init(self, cfg: Config, comm: LocalComm) -> PlumtreeState:
         n, B = comm.n_local, cfg.max_broadcasts
+        PW = self.handler.payload_words
         K = managers_mod.neighbor_width(cfg)
+        # wire: gossip = [slot, payload×PW, hop]; need header + 2 + PW
+        need = T.HDR_WORDS + 2 + PW
+        if cfg.msg_words < need:
+            raise ValueError(
+                f"plumtree with a {PW}-word handler payload needs "
+                f"msg_words >= {need}, got {cfg.msg_words}")
         return PlumtreeState(
-            data=jnp.zeros((n, B), jnp.int32),
+            data=jnp.full((n, B, PW), self.handler.identity, jnp.int32),
             rround=jnp.zeros((n, B), jnp.int32),
             pruned=jnp.zeros((n, B, K), jnp.bool_),
             lazy_pending=jnp.zeros((n, B, K), jnp.bool_),
@@ -82,19 +97,22 @@ class Plumtree:
 
         The reference processes one message at a time per gen_server; a
         per-slot ``lax.scan`` mirrors that but costs hundreds of small
-        kernels per round (measured ~140 ms at 4k nodes).  Plumtree's
-        handlers are (near-)commutative, so the whole inbox folds in a
-        handful of wide ops instead — max-merges for the store, one-hot
-        matmul reductions (MXU) for the per-(tree, link) flags, and
-        elementwise per-slot replies against the ROUND-START store.
-        Within-round ordering between conflicting flag updates resolves
-        with unprune-precedence (graft/fresh-gossip/missing-ihave win
-        over prune) — equivalent to SOME sequential order, which is all
-        the reference's arbitrary mailbox interleaving guarantees.
+        kernels per round (measured ~140 ms at 4k nodes).  Handler joins
+        are (near-)commutative lattice ops, so the whole inbox folds in
+        a handful of wide ops instead — a log-depth join tree for the
+        store, one-hot matmul reductions (MXU) for the per-(tree, link)
+        flags, and elementwise per-slot replies against the ROUND-START
+        store.  Within-round ordering between conflicting flag updates
+        resolves with unprune-precedence (graft/fresh-gossip/missing-
+        ihave win over prune) — equivalent to SOME sequential order,
+        which is all the reference's arbitrary mailbox interleaving
+        guarantees.
         """
         pt = cfg.plumtree
+        hd = self.handler
         W = cfg.msg_words
-        n_local, B = state.data.shape
+        PW = hd.payload_words
+        n_local, B = state.data.shape[:2]
         K = nbrs.shape[1]
         S, L = pt.push_slots, pt.lazy_cap
         CH = cfg.channel_id(BROADCAST_CHANNEL)
@@ -113,8 +131,8 @@ class Plumtree:
         kind = inb[..., T.W_KIND]
         src = inb[..., T.W_SRC]
         b = jnp.clip(inb[..., T.P0], 0, B - 1)
-        ver = inb[..., T.P1]
-        mr = inb[..., T.P2]
+        pay = inb[..., T.P1:T.P1 + PW]                          # [n, cap, PW]
+        mr = inb[..., T.P1 + PW]
         is_g = kind == T.MsgKind.PT_GOSSIP
         is_ih = kind == T.MsgKind.PT_IHAVE
         is_gr = kind == T.MsgKind.PT_GRAFT
@@ -129,7 +147,8 @@ class Plumtree:
         oh_b = (b[:, :, None] == jnp.arange(B)[None, None, :])  # [n, cap, B]
         oh_k = ((ki[:, :, None] == jnp.arange(K)[None, None, :])
                 & ks_ok[:, :, None])                            # [n, cap, K]
-        data_b = jnp.take_along_axis(data, b, axis=1)           # [n, cap]
+        # round-start store at each slot's tree: [n, cap, PW]
+        data_b = jnp.take_along_axis(data, b[:, :, None], axis=1)
 
         def any_bk(cond):
             """[n, cap] slot mask -> bool[n, B, K] any-hit, as an MXU
@@ -138,60 +157,77 @@ class Plumtree:
             rhs = oh_k.astype(jnp.bfloat16)
             return jnp.einsum("ncb,nck->nbk", lhs, rhs) > 0.5
 
-        # ---- gossip merge (b_gossip) ------------------------------
-        gver = jnp.where(is_g, ver, 0)
-        ver_max = jnp.max(jnp.where(oh_b, gver[:, :, None], 0), axis=1)
-        fresh_any = ver_max > data                              # [n, B]
-        stale_g = is_g & (ver <= data_b)
-        win = is_g & (gver == jnp.take_along_axis(ver_max, b, axis=1)) \
-            & ~stale_g
-        # Exactly ONE winner per (tree, round): under any sequential
-        # interleaving the first equal-max gossip delivers and every
-        # later one is stale (its sender gets pruned to lazy) — so
-        # demote all-but-the-first-slot winner instead of keeping every
-        # equal-version sender eager.
+        # ---- gossip merge (handler join fold, Mod:merge :571-577) --
+        stale_g = is_g & hd.leq(pay, data_b)                    # is_stale
+        gmask = (oh_b & is_g[:, :, None])                       # [n, cap, B]
+        expanded = jnp.where(gmask[..., None], pay[:, :, None, :],
+                             jnp.int32(hd.identity))            # [n,cap,B,PW]
+        joined_in = handlers_mod.tree_fold(hd, expanded, axis=1)  # [n, B, PW]
+        fresh_any = ~hd.leq(joined_in, data)                    # [n, B]
+
+        # Winner per (tree, round): prefer the first slot whose payload
+        # EQUALS the fold (for max-joins that is the old "first slot
+        # carrying the max version"); if payloads are incomparable (no
+        # slot equals the fold) fall back to the first non-stale slot.
+        # All other gossip senders for the tree count as stale — under
+        # any sequential interleaving the first delivery wins and later
+        # ones are duplicates whose senders get pruned to lazy.
+        joined_b = jnp.take_along_axis(joined_in, b[:, :, None], axis=1)
+        eq_fold = jnp.all(pay == joined_b, axis=-1)             # [n, cap]
+        win_ns = is_g & ~stale_g
         slot_c = jnp.arange(cap)[None, :]
-        first_by_b = jnp.min(
-            jnp.where(oh_b & win[:, :, None], slot_c[:, :, None], cap),
-            axis=1)                                             # [n, B]
-        win = win & (slot_c == jnp.take_along_axis(first_by_b, b, axis=1))
+
+        def first_by_tree(cond):
+            return jnp.min(
+                jnp.where(oh_b & cond[:, :, None], slot_c[:, :, None], cap),
+                axis=1)                                         # [n, B]
+
+        first_pref = first_by_tree(win_ns & eq_fold)
+        first_ns = first_by_tree(win_ns)
+        chosen = jnp.where(first_pref < cap, first_pref, first_ns)  # [n, B]
+        win = win_ns & (slot_c == jnp.take_along_axis(chosen, b, axis=1))
         stale_g = stale_g | (is_g & ~win)
-        mr_win = jnp.max(
-            jnp.where(oh_b & win[:, :, None], mr[:, :, None], -1), axis=1)
-        src_win = jnp.max(
-            jnp.where(oh_b & win[:, :, None], src[:, :, None], -1), axis=1)
-        data = jnp.maximum(data, ver_max)
+        got = chosen < cap                                      # [n, B]
+        chosen_c = jnp.minimum(chosen, cap - 1)
+        mr_win = jnp.where(got, jnp.take_along_axis(mr, chosen_c, axis=1), -1)
+        src_win = jnp.where(got, jnp.take_along_axis(src, chosen_c, axis=1),
+                            -1)
+        data = hd.join(data, joined_in)
         rr = jnp.where(fresh_any, mr_win + 1, rr)
         npu = npu | fresh_any
         psrc = jnp.where(fresh_any, src_win, psrc)
 
         # ---- per-(tree, link) flags -------------------------------
-        missing_ih = is_ih & (ver > data_b)
+        missing_ih = is_ih & ~hd.leq(pay, data_b)
         prune_req = any_bk(is_pr | stale_g)
         unprune = any_bk(is_gr | missing_ih | win)
         pruned = (pruned | prune_req) & ~unprune
         lazyp = lazyp & ~any_bk(is_gr | is_ak)
 
         # ---- per-slot replies (against the round-start store) -----
+        present_b = hd.present(data_b)                          # [n, cap]
         rep_kind = jnp.select(
             [stale_g, missing_ih, is_ih & ~missing_ih,
-             is_gr & (data_b > 0)],
+             is_gr & present_b],
             [jnp.int32(T.MsgKind.PT_PRUNE), jnp.int32(T.MsgKind.PT_GRAFT),
              jnp.int32(T.MsgKind.PT_IHAVE_ACK),
              jnp.int32(T.MsgKind.PT_GOSSIP)], 0)
-        # graft replies serve the ROUND-START (version, hop-count) pair —
+        # graft replies serve the ROUND-START (payload, hop-count) pair —
         # data_b was gathered from the pre-merge store, so its matching
         # round stamp must come from the pre-merge rround too
         rr_b = jnp.take_along_axis(state.rround, b, axis=1)
-        p1 = jnp.select([missing_ih, is_ih & ~missing_ih], [ver, ver],
-                        data_b)
+        # payload: i_have-derived replies (graft/ack) echo the advert
+        # (Mod:graft is keyed by the advertised id); gossip replies
+        # serve the store
+        rep_pay = jnp.where(is_ih[..., None], pay, data_b)      # [n, cap, PW]
         replies = msg_ops.build(
             W, rep_kind, gids[:, None],
             jnp.where(rep_kind > 0, src, -1), channel=CH,
-            payload=(b, p1, jnp.where(is_gr, rr_b, 0)))
+            payload=(b, *jnp.unstack(rep_pay, axis=-1),
+                     jnp.where(is_gr, rr_b, 0)))
 
         # ---- eager push: up to S carried-over fresh slots ----------
-        pend = npu & (data > 0)
+        pend = npu & hd.present(data)
         prio = jnp.where(pend, B - jnp.arange(B)[None, :], 0)
         pv, sel = jax.lax.top_k(prio, S)                        # [n, S]
         sel_ok = pv > 0
@@ -202,9 +238,11 @@ class Plumtree:
         eager = live_k & ~pruned_sel & (nbrs[:, None, :]
                                         != psrc_sel[:, :, None])
         dst = jnp.where(sel_ok[:, :, None] & eager, nbrs[:, None, :], -1)
+        data_sel = data[rows, sel]                              # [n, S, PW]
         push_msgs = msg_ops.build(
             W, T.MsgKind.PT_GOSSIP, gids[:, None, None], dst, channel=CH,
-            payload=(sel[:, :, None], data[rows, sel][:, :, None],
+            payload=(sel[:, :, None],
+                     *(w[:, :, None] for w in jnp.unstack(data_sel, axis=-1)),
                      rr[rows, sel][:, :, None]),
         ).reshape(n_local, S * K, W)
         lazy_new = sel_ok[:, :, None] & live_k & pruned_sel     # [n, S, K]
@@ -222,32 +260,49 @@ class Plumtree:
                           B * K - jnp.arange(B * K)[None, :], 0)
         lv, li = jax.lax.top_k(lprio, L)                         # [n, L]
         bi, kix = li // K, li % K
+        adv = jnp.take_along_axis(data, bi[:, :, None], axis=1)  # [n, L, PW]
         ihave_msgs = msg_ops.build(
             W, T.MsgKind.PT_IHAVE, gids[:, None],
             jnp.where(lv > 0, nbrs[rows, kix], -1), channel=CH,
-            payload=(bi, jnp.take_along_axis(data, bi, axis=1)))
+            payload=(bi, *jnp.unstack(adv, axis=-1)))
 
         emitted = jnp.concatenate([replies, push_msgs, ihave_msgs], axis=1)
 
-        # ---- AAE exchange tick (handler exchange, :1040-1070): push the
-        # whole store to one random peer on the monotonic state lane.  The
-        # reference exchange is a session between two nodes; the one-way
-        # periodic push converges identically under symmetric firing.
-        if pt.aae:
+        # ---- AAE exchange tick (Mod:exchange, :1040-1070): push the
+        # whole store to up to ``exchange_limit`` random peers on the
+        # monotonic state lane (the reference caps concurrently started
+        # exchanges per node, default 1 — partisan_config.erl:750-755).
+        # Handlers that don't support exchange (non-max joins) ignore it,
+        # exactly like the reference's default backend
+        # (partisan_plumtree_backend.erl:22-35).  The reference exchange
+        # is a session between two nodes; the one-way periodic push
+        # converges identically under symmetric firing.
+        if pt.aae and hd.supports_exchange and pt.exchange_limit > 0:
             fires = ((ctx.rnd + gids) % cfg.exchange_tick_every == 0) \
                     & ctx.alive
 
             def pick(key, row, fire):
                 slots = rng.choice_slots(
-                    rng.subkey(key, _TAG_AAE), row >= 0, 1)
+                    rng.subkey(key, _TAG_AAE), row >= 0, pt.exchange_limit)
                 t = jnp.where(slots >= 0, row[slots], jnp.int32(-1))
                 return jnp.where(fire, t, jnp.int32(-1))
 
-            tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)    # [n, 1]
+            tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)    # [n, limit]
+            # Connect-time state exchange: a link slot with a NEW
+            # occupant gets the whole store pushed along it this round —
+            # the reference's anti-entropy handshake ({state, Tag,
+            # LocalState} on every fresh connection,
+            # partisan_peer_service_server.erl:150-172).  Without it a
+            # late (re)joiner waits on the random AAE walk to stumble
+            # onto it (measured ~60+ rounds for the last 14 of 100k).
+            tgt_new = jnp.where(changed & (nbrs >= 0) & ctx.alive[:, None],
+                                nbrs, -1)                  # [n, K]
+            tgt = jnp.concatenate([tgt, tgt_new], axis=1)
             tgt = faults_mod.filter_edges(
                 ctx.faults, gids, tgt, cfg.seed, ctx.rnd, _AAE_EDGE_TAG)
-            pulled = comm.push_max(data, tgt)
-            data = jnp.maximum(data, jnp.where(ctx.alive[:, None], pulled, 0))
+            pulled = hd.exchange(comm, data, tgt)
+            data = hd.join(data, jnp.where(ctx.alive[:, None, None], pulled,
+                                           jnp.int32(hd.identity)))
 
         # Crash-stopped nodes are frozen and silent.
         dead = ~ctx.alive
@@ -271,16 +326,24 @@ class Plumtree:
 
     # ---- scenario helpers (broadcast/2, partisan.erl:1556) -----------
     def broadcast(self, state: PlumtreeState, node: int, slot: int,
-                  version: int = 1) -> PlumtreeState:
+                  version=1) -> PlumtreeState:
+        """Inject a broadcast: Mod:broadcast_data — id = (node, slot),
+        payload = handler vector (``version`` may be an int for the
+        default handler or a payload sequence/dict for richer ones)."""
+        vec = self.handler.payload(version)
+        merged = self.handler.join(state.data[node, slot], vec)
         return state._replace(
-            data=state.data.at[node, slot].max(version),
+            data=state.data.at[node, slot].set(merged),
             need_push=state.need_push.at[node, slot].set(True),
             push_src=state.push_src.at[node, slot].set(-1),
         )
 
     def coverage(self, state: PlumtreeState, alive: Array, slot: int,
-                 version: int = 1) -> Array:
-        have = (state.data[:, slot] >= version) & alive
+                 version=1) -> Array:
+        """Fraction of live nodes whose store dominates the target
+        payload for ``slot``."""
+        target = self.handler.payload(version)
+        have = self.handler.leq(target, state.data[:, slot]) & alive
         return jnp.sum(have) / jnp.maximum(jnp.sum(alive), 1)
 
     def eager_degree(self, state: PlumtreeState, slot: int) -> Array:

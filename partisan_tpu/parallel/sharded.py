@@ -61,6 +61,8 @@ class ShardComm:
     inbox_cap: int
     msg_words: int
     n_shards: int
+    exchange_mode: str = "all_gather"   # Config.sharded_exchange
+    a2a_factor: int = 4                 # Config.a2a_factor
 
     @property
     def n_local(self) -> int:
@@ -74,11 +76,55 @@ class ShardComm:
         return self.node_offset + jnp.arange(self.n_local, dtype=jnp.int32)
 
     def route(self, emitted: Array) -> exchange.Inbox:
+        if self.exchange_mode == "all_to_all":
+            return self._route_a2a(emitted)
         # [n_local, E, W] -> gather every shard's emissions over ICI, then
         # keep only messages addressed to this shard's node range.
         all_emitted = jax.lax.all_gather(emitted, AXIS, axis=0, tiled=True)
         return exchange.route(all_emitted, self.n_local, self.inbox_cap,
                               node_offset=self.node_offset)
+
+    def _route_a2a(self, emitted: Array) -> exchange.Inbox:
+        """Destination-sharded exchange: stable-sort this shard's
+        emissions by destination SHARD, pack a fixed per-shard quota,
+        ``lax.all_to_all`` over ICI, then route only what arrived.
+
+        Per-shard wire volume is S·Q·W words (Q = a2a_factor·ceil(M/S))
+        versus the all_gather's n_global·E·W — at 32k nodes / 8 shards /
+        default quota this is an 8/a2a_factor = 2x reduction, growing
+        linearly with shard count.  The quota bounds worst-case skew:
+        messages beyond it shed (the caller's emitted-vs-delivered stats
+        surface the loss).  Stability preserves per-sender FIFO; within
+        a destination shard messages from different source shards arrive
+        grouped by source — a (shard-id, slot) reorder that per-sender
+        FIFO semantics permit (the reference orders only per connection,
+        partisan_peer_connections.erl:897-942)."""
+        from partisan_tpu.types import W_DST, W_KIND
+
+        S = self.n_shards
+        W = emitted.shape[-1]
+        flat = emitted.reshape(-1, W)                    # [M, W]
+        M = flat.shape[0]
+        Q = min(M, self.a2a_factor * -(-M // S))
+        kind = flat[:, W_KIND]
+        dst = flat[:, W_DST]
+        ok = (kind != 0) & (dst >= 0) & (dst < self.n_global)
+        dshard = jnp.where(ok, dst // self.n_local, S)   # sentinel S
+        order = jnp.argsort(dshard, stable=True)
+        sorted_flat = flat[order]
+        dsh_sorted = dshard[order]
+        bounds = jnp.searchsorted(
+            dsh_sorted, jnp.arange(S + 1, dtype=dshard.dtype))
+        starts = bounds[:-1]                             # [S]
+        counts = bounds[1:] - bounds[:-1]                # [S]
+        qi = jnp.arange(Q, dtype=jnp.int32)
+        pos = jnp.clip(starts[:, None] + qi[None, :], 0, max(M - 1, 0))
+        fits = qi[None, :] < counts[:, None]             # [S, Q]
+        send = jnp.where(fits[..., None], sorted_flat[pos], 0)  # [S, Q, W]
+        recv = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
+                                  tiled=True)            # [S, Q, W]
+        return exchange.route(recv.reshape(-1, W), self.n_local,
+                              self.inbox_cap, node_offset=self.node_offset)
 
     def push_max(self, rows: Array, dst: Array) -> Array:
         all_rows = jax.lax.all_gather(rows, AXIS, axis=0, tiled=True)
@@ -133,6 +179,8 @@ class ShardedCluster:
             inbox_cap=self.cfg.inbox_cap,
             msg_words=self.cfg.msg_words,
             n_shards=n_shards,
+            exchange_mode=self.cfg.sharded_exchange,
+            a2a_factor=self.cfg.a2a_factor,
         )
         # Full-size comm used for host-side init / scripting helpers.
         self.host_comm = LocalComm(

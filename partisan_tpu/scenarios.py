@@ -5,11 +5,21 @@ stand up"):
 2. 1k-node HyParView + demers_rumor_mongering (infection time vs fanout)
 3. 10k-node HyParView + Plumtree under 5% link drop (tree repair)
 4. 10k-node SCAMP v2 under 30%/min churn (partial-view distribution)
-5. 100k-node HyParView + Plumtree + causal broadcast under crash faults
+5. 100k-node HyParView + Plumtree + p2p-causal traffic under crash
+   faults — ANY node sends causally (P2P lanes, not bounded actors)
+
+plus the echo/latency matrix (config 6) mirroring the reference's
+``performance_test`` sweep.
 
 Each scenario returns a metrics dict; ``run_all`` (and the CLI) accepts
 a ``scale`` to shrink node counts for CPU smoke runs — the tests run
 scaled versions of the same code that produces the TPU numbers.
+
+Program discipline (the round-2 lesson, see bench.py): every stepping
+phase reuses ONE k=K_PROG scan per configuration — scan-length changes
+recompile the round at full width — and timing uses a scalar transfer
+barrier (block_until_ready does not reliably block on the relay-attached
+backend).
 """
 
 from __future__ import annotations
@@ -19,39 +29,76 @@ import time
 import jax
 import numpy as np
 
+K_PROG = 10
+
+
+def _sync(st) -> None:
+    """True execution barrier: jax.block_until_ready does NOT reliably
+    block on the relay-attached backend (measured: a 64-round execution
+    "completing" in 0.4 ms); a scalar device->host transfer only
+    materializes when the producing program has finished."""
+    int(jax.device_get(st.rnd))
+
 
 def _boot_fullmesh(cl, n):
     st = cl.init()
     m = st.manager
     for i in range(1, n):
         m = cl.manager.join(cl.cfg, m, i, 0)
-    return cl.steps(st._replace(manager=m), 15)
+    st = cl.steps(st._replace(manager=m), K_PROG)
+    return cl.steps(st, K_PROG)
 
 
-def _boot_overlay(cl, n, settle=30, waves=4):
+def _boot_overlay(cl, n, settle_execs=3, on_wave=None, state=None):
     """Batched staggered bootstrap (random contacts) for partial-view
-    overlays."""
+    overlays; one k=K_PROG execution per wave.  ``on_wave(hi, state)``
+    is an optional instrumentation hook and ``state`` an optional
+    pre-built (e.g. compile-warmed) initial state — bench.py uses both
+    to keep its per-phase timing."""
     rng = np.random.default_rng(7)
-    st = cl.init()
+    join = jax.jit(lambda m, nodes, tgts: cl.manager.join_many(
+        cl.cfg, m, nodes, tgts))
+    st = cl.init() if state is None else state
     base = 1
     while base < n:
-        hi = min(base * waves, n)
+        hi = min(base * 4, n)
         nodes = np.arange(base, hi, dtype=np.int32)
         targets = rng.integers(0, base, size=nodes.shape[0]).astype(np.int32)
-        st = st._replace(manager=cl.manager.join_many(
-            cl.cfg, st.manager, nodes, targets))
-        st = cl.steps(st, 3)
+        st = st._replace(manager=join(st.manager, nodes, targets))
+        st = cl.steps(st, K_PROG)
+        if on_wave is not None:
+            on_wave(hi, st)
         base = hi
-    return cl.steps(st, settle)
+    for _ in range(settle_execs):
+        st = cl.steps(st, K_PROG)
+    _sync(st)
+    return st
 
 
-def _throughput(cl, st, k=200):
-    st = cl.steps(st, k)
-    jax.block_until_ready(st)
-    t0 = time.perf_counter()
-    st = cl.steps(st, k)
-    jax.block_until_ready(st)
-    return k / (time.perf_counter() - t0)
+def _throughput(cl, st):
+    """Simulated rounds/sec from best-of-3 k=K_PROG executions.  The
+    per-execution dispatch overhead (~0.3 s on the relay) is included,
+    so this UNDER-reports at small n — bench.py's adaptive scan length
+    is the headline-number instrument."""
+    st = cl.steps(st, K_PROG)
+    _sync(st)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st = cl.steps(st, K_PROG)
+        _sync(st)
+        best = min(best, time.perf_counter() - t0)
+    return K_PROG / best
+
+
+def _converge(cl, st, coverage_fn, max_rounds):
+    """Step until jitted ``coverage_fn(state) == 1.0`` (checked every
+    K_PROG rounds).  Returns (state, converged_round|-1)."""
+    for _ in range(0, max_rounds, K_PROG):
+        if float(coverage_fn(st)) == 1.0:
+            return st, int(st.rnd)
+        st = cl.steps(st, K_PROG)
+    return (st, int(st.rnd)) if float(coverage_fn(st)) == 1.0 else (st, -1)
 
 
 # ---------------------------------------------------------------------------
@@ -66,12 +113,11 @@ def config1_anti_entropy(n=16, max_rounds=120):
     cfg = Config(n_nodes=n, seed=1, inbox_cap=max(32, n + 8))
     model = AntiEntropy()
     cl = Cluster(cfg, model=model)
+    cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
     st = _boot_fullmesh(cl, n)
     start = int(st.rnd)
     st = st._replace(model=model.broadcast(st.model, 0, 0))
-    st, conv = cl.run_until(
-        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
-        max_rounds)
+    st, conv = _converge(cl, st, cov, max_rounds)
     return {"config": 1, "n": n, "convergence_rounds": conv - start,
             "rounds_per_sec": round(_throughput(cl, st), 1)}
 
@@ -89,14 +135,14 @@ def config2_rumor(n=1000, max_rounds=200):
                  msg_words=16, partition_mode="groups")
     model = RumorMongering()
     cl = Cluster(cfg, model=model)
+    cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
     st = _boot_overlay(cl, n)
     start = int(st.rnd)
     st = st._replace(model=model.broadcast(st.model, 0, 0))
     trail = []
-    for _ in range(max_rounds // 5):
-        st = cl.steps(st, 5)
-        cov = float(model.coverage(st.model, st.faults.alive, 0))
-        trail.append((int(st.rnd), cov))
+    for _ in range(max_rounds // K_PROG):
+        st = cl.steps(st, K_PROG)
+        trail.append((int(st.rnd), float(cov(st))))
         if len(trail) >= 3 and trail[-1][1] == trail[-3][1]:
             break   # plateaued
     plateau = trail[-1][1]
@@ -118,16 +164,16 @@ def config3_plumtree_drop(n=10_000, drop=0.05, max_rounds=400):
     from partisan_tpu.models.plumtree import Plumtree
 
     cfg = Config(n_nodes=n, seed=3, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups")
+                 msg_words=16, partition_mode="groups",
+                 emit_compact=32 if n > 4096 else 0)
     model = Plumtree()
     cl = Cluster(cfg, model=model)
+    cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
     st = _boot_overlay(cl, n)
     st = st._replace(faults=st.faults._replace(link_drop=jnp.float32(drop)))
     start = int(st.rnd)
     st = st._replace(model=model.broadcast(st.model, 0, 0, start))
-    st, conv = cl.run_until(
-        st, lambda s: float(model.coverage(s.model, s.faults.alive, 0)) == 1.0,
-        max_rounds, check_every=10)
+    st, conv = _converge(cl, st, cov, max_rounds)
     return {"config": 3, "n": n, "link_drop": drop,
             "repair_rounds": (conv - start) if conv >= 0 else -1,
             "rounds_per_sec": round(_throughput(cl, st), 1)}
@@ -149,10 +195,12 @@ def config4_scamp_churn(n=10_000, churn_per_min=0.30, rounds=120):
     st = _boot_overlay(cl, n)
     # churn probability per round (round = 1s of virtual time)
     p = churn_per_min / 60.0
-    for _ in range(rounds // 10):
-        st = st._replace(faults=faults_mod.churn_step(
-            st.faults, cfg.seed, st.rnd, p, p))
-        st = cl.steps(st, 10)
+    churn = jax.jit(lambda f, rnd: faults_mod.churn_step(
+        f, cfg.seed, rnd, p, p))
+    for _ in range(max(1, rounds // K_PROG)):
+        st = st._replace(faults=churn(st.faults, st.rnd))
+        st = cl.steps(st, K_PROG)
+    _sync(st)
     sizes = np.asarray(jnp.sum(st.manager.partial >= 0, axis=1))
     alive = np.asarray(st.faults.alive)
     s = sizes[alive]
@@ -164,71 +212,95 @@ def config4_scamp_churn(n=10_000, churn_per_min=0.30, rounds=120):
             "rounds_per_sec": round(_throughput(cl, st), 1)}
 
 
-def config5_causal_crash(n=100_000, n_actors=16, crashes=16,
+def config5_causal_crash(n=100_000, senders=64, crashes=16,
                          max_rounds=400):
-    """HyParView + Plumtree + causal broadcast under scripted crash
-    faults: causal lanes deliver in order while the overlay heals around
-    the crashed nodes (the filibuster crash-fault-model shape at the
-    north-star scale)."""
-    import jax.numpy as jnp
+    """HyParView + Plumtree + POINT-TO-POINT causal traffic under
+    scripted crash faults, at the north-star scale.
 
+    The causal mode is the P2P lane (delivery.py `P2PLane`, transposing
+    partisan_causality_backend.erl:204-220's per-destination scheme):
+    ``senders`` nodes drawn uniformly from the WHOLE id space — any node
+    may send, no bounded actor set — each send two causally-ordered
+    messages to a random destination while ``crashes`` nodes are down
+    and the overlay heals around them.  Checks: per-(sender, receiver)
+    FIFO with exactly-once delivery at every receiver, and plumtree
+    broadcast convergence across the healed overlay."""
     from partisan_tpu.cluster import Cluster
-    from partisan_tpu.config import Config
-    from partisan_tpu.models.causal_chat import CausalChat
+    from partisan_tpu.config import Config, PlumtreeConfig
+    from partisan_tpu.models.p2p_chat import P2PChat
     from partisan_tpu.models.plumtree import Plumtree
     from partisan_tpu.models.stack import Stack
 
-    # Scale-down guards: keep actor/crash counts feasible at smoke sizes.
+    # Scale-down guards for smoke runs.
     n = max(n, 32)
-    n_actors = max(4, min(n_actors, n // 4))
-    crashes = min(crashes, max(1, (n - n_actors) // 4))
+    senders = min(senders, n // 4)
+    crashes = min(crashes, n // 4)
 
-    chat = CausalChat()
     plum = Plumtree()
+    chat = P2PChat()
     stack = Stack([plum, chat])
     cfg = Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
                  msg_words=16, partition_mode="groups",
-                 causal_labels=("default",), n_actors=n_actors)
+                 causal_p2p_labels=("chat",),
+                 max_broadcasts=8, inbox_cap=16,
+                 emit_compact=32 if n > 4096 else 0,
+                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
     cl = Cluster(cfg, model=stack)
+    cov = jax.jit(lambda s: plum.coverage(stack.sub(s.model, 0),
+                                          s.faults.alive, 0))
     st = _boot_overlay(cl, n)
-    # crash a batch of non-actor nodes mid-run (crash fault model)
-    rng = np.random.default_rng(11)
-    victims = rng.choice(np.arange(n_actors, n), size=crashes, replace=False)
-    alive = st.faults.alive
-    for v in victims:
-        alive = alive.at[int(v)].set(False)
-    st = st._replace(faults=st.faults._replace(alive=alive))
     start = int(st.rnd)
-    # plumtree broadcast + two causally-chained sends from actors 0, 1
+
+    # Cast: senders, receivers and crash victims, all disjoint, senders
+    # drawn from the FULL id space (the any-node-sends claim).  Node 0
+    # is excluded: it is the plumtree broadcast source below, and a
+    # crashed broadcaster can never converge.
+    rng = np.random.default_rng(11)
+    cast = 1 + rng.choice(n - 1, size=2 * senders + crashes, replace=False)
+    snd, rcv = cast[:senders], cast[senders:2 * senders]
+    victims = cast[2 * senders:]
+
+    # Two causally-ordered sends per sender (seq 1 then 2 per edge).
+    nodes = np.repeat(snd, 2)
+    rnds = np.stack([np.full(senders, start + 2),
+                     np.full(senders, start + 6)], axis=1).reshape(-1)
+    dsts = np.repeat(rcv, 2)
+    st = st._replace(model=stack.replace_sub(
+        st.model, 1, chat.schedule_many(stack.sub(st.model, 1),
+                                        nodes, rnds, dsts)))
+
+    # Crash the victims (the filibuster crash-fault-model shape).
+    alive = st.faults.alive.at[jax.numpy.asarray(victims)].set(False)
+    st = st._replace(faults=st.faults._replace(alive=alive))
+
+    # Plumtree broadcast from node 0 over the healing overlay.
     st = st._replace(model=stack.replace_sub(
         st.model, 0, plum.broadcast(stack.sub(st.model, 0), 0, 0, start)))
-    cs = stack.sub(st.model, 1)
-    cs = chat.schedule(cs, 0, start + 1)
-    # Far enough after that actor 1 has certainly DELIVERED actor 0's
-    # broadcast before sending — making the second send causally ordered
-    # (not concurrent), so every node must deliver them in order.
-    cs = chat.schedule(cs, 1, start + 15)
-    st = st._replace(model=stack.replace_sub(st.model, 1, cs))
-    st, conv = cl.run_until(
-        st, lambda s: float(plum.coverage(stack.sub(s.model, 0),
-                                          s.faults.alive, 0)) == 1.0,
-        max_rounds, check_every=10)
-    st = cl.steps(st, 20)   # let causal deliveries drain
-    logs = CausalChat.logs(
-        jax.tree.map(lambda x: x[:n_actors], stack.sub(st.model, 1)))
-    # Senders don't self-deliver (the reference's causality backend wraps
-    # REMOTE sends, partisan_causality_backend.erl:172-201): the ordering
-    # property is checked on the receiving actors (2..n_actors).
-    ordered = sum(1 for lg in logs[2:] if lg == [1, 1001])
-    rps = _throughput(cl, st, k=100)
-    wall_estimate = (round((conv - start) / rps, 3) if conv >= 0 else None)
-    return {"config": 5, "n": n, "crashes": crashes,
+    st, conv = _converge(cl, st, cov, max_rounds)
+    # let the p2p streams drain (replay cadence = retransmit timer)
+    for _ in range(max(1, (cfg.retransmit_every * 4) // K_PROG)):
+        st = cl.steps(st, K_PROG)
+    _sync(st)
+
+    # Per-edge FIFO + exactly-once at every receiver.
+    chat_state = jax.device_get(stack.sub(st.model, 1))
+    logs = P2PChat.logs(chat_state)
+    ordered = delivered = 0
+    for r in rcv:
+        log = logs[int(r)]
+        delivered += len(log)
+        ordered += P2PChat.edge_fifo_ok(log)
+    rps = _throughput(cl, st)
+    return {"config": 5, "n": n, "senders": int(senders),
+            "crashes": int(crashes),
             "convergence_rounds": (conv - start) if conv >= 0 else -1,
             "rounds_per_sec": round(rps, 1),
-            "convergence_wall_sec_est": wall_estimate,
-            "causal_ordered_actors": ordered,
-            "n_receiving_actors": n_actors - 2,
-            "n_actors": n_actors}
+            "convergence_wall_sec_est": (
+                round((conv - start) / rps, 3) if conv >= 0 else None),
+            "causal_deliveries": int(delivered),
+            "causal_expected": int(2 * senders),
+            "fifo_ok_receivers": int(ordered),
+            "n_receivers": int(senders)}
 
 
 def config6_echo(n=2, sizes_kb=(1024, 2048, 4096, 8192),
@@ -241,64 +313,77 @@ def config6_echo(n=2, sizes_kb=(1024, 2048, 4096, 8192),
     ``parallelism`` lanes under capacity enforcement, ``num_messages``
     round trips each.
 
-    Time derivation: one simulated round is one link traversal worth
-    ``max(latency/2, size/bandwidth)`` ms (tc-netem delay on loopback +
-    serialization at ``bandwidth_mb_s``), so the reported time is
-    ``rounds × per_round_ms × 1000`` µs — the same quantity the
-    reference's ``timer:tc`` wall-clock captures, minus host scheduling
-    noise.  Emits the reference's CSV columns
-    ``backend,concurrency,parallelism,bytes,nummessages,latency,time``.
+    EVERY cell is an independent simulation run.  Payload size enters
+    the simulation as bytes-weighted lane capacity: one round is one
+    link traversal worth ``per_round_ms = max(latency/2, size/bw)`` ms
+    (tc-netem delay + serialization), so a lane moves
+    ``floor(bw · per_round_ms / size)`` messages per round — large
+    payloads on fast links throttle the lane and the measured
+    rounds-to-complete grows (queueing), exactly where bandwidth binds
+    physically.  The ONLY analytic column is the final µs conversion
+    ``time = rounds × per_round_ms × 1000`` (the virtual-clock unit);
+    ``rounds`` is measured per cell.  Emits the reference's CSV columns
+    ``backend,concurrency,parallelism,bytes,nummessages,latency,time``
+    plus the measured ``rounds``.
     """
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import ChannelSpec, Config, DEFAULT_CHANNEL
     from partisan_tpu.models.echo import CLIENT, Echo
 
     rows = []
+    measured: dict[tuple[int, int], int] = {}   # (conc, lane_rate) -> rounds
     for conc in concurrency:
-        model = Echo(concurrency=conc, num_messages=num_messages)
-        cfg = Config(
-            n_nodes=n, seed=11, peer_service_manager="static",
-            channel_capacity=True, lane_rate=1,
-            outbox_cap=max(32, 2 * conc),
-            channels=(ChannelSpec(DEFAULT_CHANNEL,
-                                  parallelism=parallelism),))
-        cl = Cluster(cfg, model=model)
-        st0 = cl.init()
-        # rounds-to-completion is latency/size-independent (they only
-        # scale the virtual clock), so run the ping-pong once per
-        # concurrency level and derive every (size, latency) cell.
-        st, _ = cl.run_until(
-            st0, lambda s: model.done(s.model),
-            max_rounds=2 * num_messages
-            + 4 * num_messages * conc // max(parallelism, 1) + 50,
-            check_every=50)
-        assert model.done(st.model), "echo run did not complete"
-        rounds = int(st.rnd)
-        echoes = int(st.model.echoed[CLIENT].sum())
-        assert echoes == conc * num_messages, (echoes, conc)
         for size_kb in sizes_kb:
             for lat in latencies_ms:
-                per_round_ms = max(lat / 2.0,
-                                   size_kb / 1024.0 / bandwidth_mb_s
-                                   * 1000.0)
-                time_us = int(rounds * per_round_ms * 1000)
+                ser_ms = size_kb / 1024.0 / bandwidth_mb_s * 1000.0
+                per_round_ms = max(lat / 2.0, ser_ms)
+                lane_rate = max(1, int(
+                    bandwidth_mb_s * 1024.0 * per_round_ms / 1000.0
+                    // size_kb))
+                if (conc, lane_rate) not in measured:
+                    # the sim outcome depends only on (conc, lane_rate):
+                    # identical cells share one measured run instead of
+                    # recompiling the same program per cell
+                    model = Echo(concurrency=conc,
+                                 num_messages=num_messages)
+                    cfg = Config(
+                        n_nodes=n, seed=11, peer_service_manager="static",
+                        channel_capacity=True, lane_rate=lane_rate,
+                        outbox_cap=max(32, 2 * conc),
+                        channels=(ChannelSpec(DEFAULT_CHANNEL,
+                                              parallelism=parallelism),))
+                    cl = Cluster(cfg, model=model)
+                    st, _ = cl.run_until(
+                        cl.init(), lambda s: model.done(s.model),
+                        max_rounds=2 * num_messages
+                        + 4 * num_messages * conc
+                        // max(parallelism * lane_rate, 1) + 50,
+                        check_every=50)
+                    assert model.done(st.model), "echo run incomplete"
+                    echoes = int(st.model.echoed[CLIENT].sum())
+                    assert echoes == conc * num_messages, (echoes, conc)
+                    measured[(conc, lane_rate)] = int(st.rnd)
+                rounds = measured[(conc, lane_rate)]
                 rows.append({
                     "backend": "partisan_tpu", "concurrency": conc,
                     "parallelism": parallelism,
                     "bytes": size_kb * 1024,
                     "nummessages": num_messages, "latency": lat,
-                    "time": time_us, "rounds": rounds,
+                    "lane_rate": lane_rate,
+                    "time": int(rounds * per_round_ms * 1000),
+                    "rounds": rounds,
                 })
     if csv_path:
         with open(csv_path, "w") as f:
             f.write("backend,concurrency,parallelism,bytes,"
-                    "nummessages,latency,time\n")
+                    "nummessages,latency,time,rounds\n")
             for r in rows:
                 f.write(f"{r['backend']},{r['concurrency']},"
                         f"{r['parallelism']},{r['bytes']},"
                         f"{r['nummessages']},{r['latency']},"
-                        f"{r['time']}\n")
-    return {"config": 6, "cells": len(rows), "rows": rows}
+                        f"{r['time']},{r['rounds']}\n")
+    return {"config": 6, "cells": len(rows),
+            "measured_runs": len(measured), "rows": rows}
 
 
 # ---------------------------------------------------------------------------
@@ -340,4 +425,4 @@ if __name__ == "__main__":
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     for r in run_all(scale=args.scale, only=args.only):
-        print(json.dumps(r))
+        print(json.dumps(r), flush=True)

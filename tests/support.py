@@ -73,3 +73,89 @@ def components(active, alive):
         seen |= comp
         comps.append(comp)
     return comps
+
+
+# ---------------------------------------------------------------------------
+# Bridge-transport VM base (shared by the OTP-conformance suites): one
+# emulated BEAM node holding a TCP connection to the shared simulator
+# (bridge/socket_server.py).  See tests/test_bridge_gen_server.py for the
+# first user of this pattern.
+# ---------------------------------------------------------------------------
+
+def recv_exact(sock, k):
+    """Read exactly k bytes; a closed socket raises instead of spinning
+    (the {packet,4} framing reader shared by every bridge client)."""
+    buf = b""
+    while len(buf) < k:
+        got = sock.recv(k - len(buf))
+        if not got:
+            raise ConnectionError("bridge socket closed mid-frame")
+        buf += got
+    return buf
+
+
+def bridge_rig(n_nodes, seed=9):
+    """Start a BridgeSocketServer and init the shared simulator.  Returns
+    the server; callers attach BridgeVM instances and must close both."""
+    import socket
+    import struct
+
+    from partisan_tpu.bridge import etf
+    from partisan_tpu.bridge.etf import Atom
+    from partisan_tpu.bridge.socket_server import BridgeSocketServer
+
+    srv = BridgeSocketServer()
+    srv.serve_background()
+    boot = socket.create_connection((srv.host, srv.port))
+    payload = etf.encode((Atom("init"), {Atom("n_nodes"): n_nodes,
+                                         Atom("seed"): seed}))
+    boot.sendall(struct.pack(">I", len(payload)) + payload)
+    recv_exact(boot, struct.unpack(">I", recv_exact(boot, 4))[0])
+    boot.close()
+    return srv
+
+
+class BridgeVM:
+    """One emulated BEAM node on the shared simulator."""
+
+    def __init__(self, srv, sim_id):
+        import socket
+
+        from partisan_tpu.bridge import etf
+        from partisan_tpu.bridge.etf import Atom
+
+        self._etf = etf
+        self._Atom = Atom
+        self.id = sim_id
+        self.sock = socket.create_connection((srv.host, srv.port))
+        assert self.rpc((Atom("set_self"), sim_id)) == etf.OK
+
+    def rpc(self, term):
+        import struct
+
+        payload = self._etf.encode(term)
+        self.sock.sendall(struct.pack(">I", len(payload)) + payload)
+        (n,) = struct.unpack(">I", recv_exact(self.sock, 4))
+        return self._etf.decode(recv_exact(self.sock, n))
+
+    def forward(self, dst, words):
+        assert self.rpc((self._Atom("forward_message"), self.id, dst,
+                         list(words))) == self._etf.OK
+
+    def drain(self):
+        ok, out = self.rpc((self._Atom("drain"),))
+        assert ok == self._etf.OK
+        return out
+
+    def step(self, k=1):
+        ok, rnd = self.rpc((self._Atom("step"), k))
+        assert ok == self._etf.OK
+        return rnd
+
+    def is_alive(self, node):
+        ok, alive = self.rpc((self._Atom("is_alive"), node))
+        assert ok == self._etf.OK
+        return bool(alive)
+
+    def close(self):
+        self.sock.close()

@@ -1,0 +1,351 @@
+"""partisan_gen_supervisor restart semantics OVER THE BRIDGE.
+
+The reference ships a patched OTP supervisor
+(priv/otp/24/partisan_gen_supervisor.erl, 1850 LoC) with a conformance
+suite (test/partisan_supervisor_SUITE.erl, 3755 LoC).  This suite ports
+~9 representative behaviors at the semantics level: a supervisor process
+on one emulated BEAM node manages child processes hosted on OTHER nodes,
+with START/STOP orders and EXIT notifications riding the real bridge
+transport (the cross-node supervision partisan_gen_supervisor enables).
+
+Covered semantics (OTP supervisor reference behavior):
+- one_for_one: only the crashed child restarts,
+- rest_for_one: the crashed child and those started AFTER it restart —
+  later children stopped in reverse start order, restarted in order,
+- one_for_all: every child restarts (stop reverse, start in order),
+- maximum restart intensity (MaxR within MaxT): exceeding it makes the
+  supervisor give up — stop ALL children, terminate,
+- restart types: permanent (always), transient (only abnormal exits),
+  temporary (never — and the child spec is discarded),
+- which_children / count_children across restarts,
+- restart_child / delete_child admin API,
+- stale EXIT from a superseded incarnation is ignored.
+"""
+
+import pytest
+
+from support import BridgeVM, bridge_rig
+
+OP_START, OP_STOP, OP_EXIT = 10, 11, 12
+NORMAL, CRASH = 0, 1
+PERMANENT, TRANSIENT, TEMPORARY = 0, 1, 2
+
+ONE_FOR_ONE, REST_FOR_ONE, ONE_FOR_ALL = "one_for_one", "rest_for_one", \
+    "one_for_all"
+
+
+class HostVM(BridgeVM):
+    """A node hosting child processes: obeys START/STOP, reports EXITs."""
+
+    def __init__(self, srv, sim_id):
+        super().__init__(srv, sim_id)
+        self.running = {}          # child_id -> incarnation
+        self.log = []              # (op, child, inc) in receive order
+
+    def process(self):
+        for src, words in self.drain():
+            op, child, inc = words[0], words[1], words[2]
+            if op == OP_START:
+                self.running[child] = inc
+                self.log.append(("start", child, inc))
+            elif op == OP_STOP:
+                self.running.pop(child, None)
+                self.log.append(("stop", child, inc))
+
+    def kill(self, sup_id, child, reason=CRASH):
+        """Child dies (test-injected): report EXIT to the supervisor with
+        its incarnation — the monitor/link DOWN the reference delivers."""
+        inc = self.running.pop(child, None)
+        if inc is not None:
+            self.forward(sup_id, [OP_EXIT, child, inc, reason])
+
+
+class SupervisorVM(BridgeVM):
+    """The partisan_gen_supervisor loop (one supervisor process)."""
+
+    def __init__(self, srv, sim_id, specs, strategy=ONE_FOR_ONE,
+                 max_r=3, max_t=20):
+        """specs: ordered [(child_id, host_sim_id, restart_type)]."""
+        super().__init__(srv, sim_id)
+        self.specs = list(specs)
+        self.strategy = strategy
+        self.max_r, self.max_t = max_r, max_t
+        self.inc = {c: 0 for c, _, _ in specs}       # current incarnation
+        self.up = {c: False for c, _, _ in specs}
+        self.restarts = []                           # rounds of restarts
+        self.terminated = False
+        self.rnd = 0
+
+    # -- child plumbing -------------------------------------------------
+    def _host(self, child):
+        for c, h, _ in self.specs:
+            if c == child:
+                return h
+        return None
+
+    def _type(self, child):
+        for c, _, t in self.specs:
+            if c == child:
+                return t
+        return None
+
+    def _start(self, child):
+        self.inc[child] += 1
+        self.up[child] = True
+        self.forward(self._host(child), [OP_START, child, self.inc[child]])
+
+    def _stop(self, child):
+        self.up[child] = False
+        self.forward(self._host(child), [OP_STOP, child, self.inc[child]])
+
+    def start_all(self):
+        for c, _, _ in self.specs:           # start order = spec order
+            self._start(c)
+
+    # -- the supervisor loop --------------------------------------------
+    def process(self, rnd):
+        self.rnd = rnd
+        for _src, words in self.drain():
+            if words[0] != OP_EXIT or self.terminated:
+                continue
+            child, inc, reason = words[1], words[2], words[3]
+            if child not in self.inc or inc != self.inc[child]:
+                continue                     # stale incarnation: ignore
+            if not self.up[child]:
+                continue
+            self.up[child] = False
+            rtype = self._type(child)
+            if rtype == TEMPORARY:
+                # temporary children are never restarted and their spec
+                # is discarded (OTP supervisor reference)
+                self.specs = [s for s in self.specs if s[0] != child]
+                del self.inc[child], self.up[child]
+                continue
+            if rtype == TRANSIENT and reason == NORMAL:
+                continue                     # normal exit: no restart
+            self._restart(child)
+
+    def _restart(self, child):
+        self.restarts.append(self.rnd)
+        window = [r for r in self.restarts if r > self.rnd - self.max_t]
+        if len(window) > self.max_r:
+            # intensity exceeded: give up — stop all children (reverse
+            # start order), terminate the supervisor itself
+            for c, _, _ in reversed(self.specs):
+                if self.up[c]:
+                    self._stop(c)
+            self.terminated = True
+            return
+        order = [c for c, _, _ in self.specs]
+        if self.strategy == ONE_FOR_ONE:
+            self._start(child)
+            return
+        idx = order.index(child)
+        victims = order[idx + 1:] if self.strategy == REST_FOR_ONE \
+            else [c for c in order if c != child]
+        for c in reversed(victims):          # stop in reverse start order
+            if self.up[c]:
+                self._stop(c)
+        for c in order:                      # restart in start order
+            if c == child or c in victims:
+                self._start(c)
+
+    # -- admin API (supervisor:which_children/3 etc.) -------------------
+    def which_children(self):
+        return [(c, self.inc[c], self.up[c]) for c, _, _ in self.specs]
+
+    def count_children(self):
+        return {"specs": len(self.specs),
+                "active": sum(self.up.values())}
+
+    def restart_child(self, child):
+        if not self.up.get(child, True):
+            self._start(child)
+            return True
+        return False
+
+    def delete_child(self, child):
+        if self.up.get(child):
+            return False                     # only stopped children
+        self.specs = [s for s in self.specs if s[0] != child]
+        self.inc.pop(child, None)
+        self.up.pop(child, None)
+        return True
+
+
+def _pump(sup, host, k=4, *, hosts=None):
+    for _ in range(k):
+        rnd = sup.step(1)
+        for h in (hosts or [host]):
+            h.process()
+        sup.process(rnd)
+
+
+def _rig(strategy, types=(PERMANENT, PERMANENT, PERMANENT), **kw):
+    srv = bridge_rig(4)
+    host = HostVM(srv, 1)
+    sup = SupervisorVM(srv, 0,
+                       [(10, 1, types[0]), (11, 1, types[1]),
+                        (12, 1, types[2])],
+                       strategy=strategy, **kw)
+    sup.start_all()
+    _pump(sup, host, 4)
+    assert host.running == {10: 1, 11: 1, 12: 1}
+    return srv, sup, host
+
+
+def test_one_for_one_restarts_only_the_crashed_child():
+    srv, sup, host = _rig(ONE_FOR_ONE)
+    try:
+        host.kill(sup.id, 11)
+        _pump(sup, host, 6)
+        assert host.running == {10: 1, 11: 2, 12: 1}
+        # no STOP was ever sent; exactly one extra START (child 11 inc 2)
+        assert ("stop", 10, 1) not in host.log
+        assert host.log.count(("start", 11, 2)) == 1
+    finally:
+        srv.close()
+
+
+def test_rest_for_one_restarts_crashed_and_later_children():
+    srv, sup, host = _rig(REST_FOR_ONE)
+    try:
+        host.kill(sup.id, 11)
+        _pump(sup, host, 6)
+        assert host.running == {10: 1, 11: 2, 12: 2}    # 10 untouched
+        tail = host.log[3:]        # after the initial starts
+        # later child stopped first, then restarts in start order
+        assert tail.index(("stop", 12, 1)) < tail.index(("start", 11, 2))
+        assert tail.index(("start", 11, 2)) < tail.index(("start", 12, 2))
+    finally:
+        srv.close()
+
+
+def test_one_for_all_restarts_everyone_stop_reverse_start_in_order():
+    srv, sup, host = _rig(ONE_FOR_ALL)
+    try:
+        host.kill(sup.id, 11)
+        _pump(sup, host, 6)
+        assert host.running == {10: 2, 11: 2, 12: 2}
+        tail = host.log[3:]
+        # stops: reverse start order (12 then 10; 11 is already dead)
+        assert tail.index(("stop", 12, 1)) < tail.index(("stop", 10, 1))
+        # starts: spec order
+        s = [e for e in tail if e[0] == "start"]
+        assert s == [("start", 10, 2), ("start", 11, 2), ("start", 12, 2)]
+    finally:
+        srv.close()
+
+
+def test_max_intensity_shutdown():
+    """More than MaxR restarts within MaxT rounds: the supervisor stops
+    every child and terminates (supervisor shutdown semantics)."""
+    srv, sup, host = _rig(ONE_FOR_ONE, max_r=2, max_t=50)
+    try:
+        for _ in range(3):                   # 3 restarts > MaxR=2
+            host.kill(sup.id, 11)
+            _pump(sup, host, 4)
+        assert sup.terminated
+        assert host.running == {}            # all children stopped
+        _pump(sup, host, 3)
+        assert host.running == {}            # and nothing restarts
+    finally:
+        srv.close()
+
+
+def test_intensity_window_expires():
+    """Restarts spaced WIDER than MaxT don't accumulate: the supervisor
+    keeps healing indefinitely."""
+    srv, sup, host = _rig(ONE_FOR_ONE, max_r=1, max_t=6)
+    try:
+        for _ in range(3):
+            host.kill(sup.id, 11)
+            _pump(sup, host, 8)              # > MaxT rounds apart
+        assert not sup.terminated
+        assert host.running[11] == 4
+    finally:
+        srv.close()
+
+
+def test_transient_child_not_restarted_on_normal_exit():
+    srv, sup, host = _rig(ONE_FOR_ONE, types=(PERMANENT, TRANSIENT,
+                                              PERMANENT))
+    try:
+        host.kill(sup.id, 11, reason=NORMAL)
+        _pump(sup, host, 5)
+        assert 11 not in host.running                 # not restarted
+        assert sup.count_children() == {"specs": 3, "active": 2}
+        # …but an ABNORMAL exit of a transient child does restart it
+        assert sup.restart_child(11)
+        _pump(sup, host, 4)
+        host.kill(sup.id, 11, reason=CRASH)
+        _pump(sup, host, 5)
+        assert host.running[11] == 3
+    finally:
+        srv.close()
+
+
+def test_temporary_child_never_restarted_and_spec_discarded():
+    srv, sup, host = _rig(ONE_FOR_ONE, types=(PERMANENT, TEMPORARY,
+                                              PERMANENT))
+    try:
+        host.kill(sup.id, 11, reason=CRASH)
+        _pump(sup, host, 5)
+        assert 11 not in host.running
+        assert sup.count_children() == {"specs": 2, "active": 2}
+    finally:
+        srv.close()
+
+
+def test_which_children_and_admin_api():
+    srv, sup, host = _rig(ONE_FOR_ONE)
+    try:
+        host.kill(sup.id, 11)
+        _pump(sup, host, 5)
+        assert sup.which_children() == [(10, 1, True), (11, 2, True),
+                                        (12, 1, True)]
+        # delete refuses while running; works once stopped
+        assert not sup.delete_child(12)
+        sup._stop(12)
+        _pump(sup, host, 3)
+        assert sup.delete_child(12)
+        assert sup.count_children() == {"specs": 2, "active": 2}
+    finally:
+        srv.close()
+
+
+def test_stale_exit_from_old_incarnation_ignored():
+    """A late EXIT carrying a superseded incarnation must not trigger a
+    second restart (the Mref-generation pairing of the monitor layer)."""
+    srv, sup, host = _rig(ONE_FOR_ONE)
+    try:
+        host.kill(sup.id, 11)                # EXIT inc=1
+        _pump(sup, host, 5)
+        assert host.running[11] == 2
+        host.forward(sup.id, [OP_EXIT, 11, 1, CRASH])   # stale replay
+        _pump(sup, host, 5)
+        assert host.running[11] == 2         # unchanged
+    finally:
+        srv.close()
+
+
+def test_rest_for_one_across_two_host_nodes():
+    """Children hosted on DIFFERENT nodes: supervision orders ride the
+    bridge transport across the cluster."""
+    srv = bridge_rig(4)
+    try:
+        h1, h2 = HostVM(srv, 1), HostVM(srv, 2)
+        sup = SupervisorVM(srv, 0, [(10, 1, PERMANENT), (11, 2, PERMANENT),
+                                    (12, 1, PERMANENT)],
+                           strategy=REST_FOR_ONE)
+        sup.start_all()
+        _pump(sup, h1, 4, hosts=[h1, h2])
+        assert h1.running == {10: 1, 12: 1} and h2.running == {11: 1}
+        h2.kill(sup.id, 11)
+        _pump(sup, h1, 6, hosts=[h1, h2])
+        assert h2.running == {11: 2}
+        assert h1.running == {10: 1, 12: 2}  # 12 restarted, 10 untouched
+        for vm in (h1, h2, sup):
+            vm.close()
+    finally:
+        srv.close()

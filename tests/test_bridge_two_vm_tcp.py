@@ -1,0 +1,141 @@
+"""TWO emulated BEAM VMs on the coded TCP transport.
+
+The `.erl` manager now ships the multi-VM branch as CODE
+(partisan_sim_peer_service_manager.erl `connect_bridge/0`, selected by
+``{sim_transport, tcp}``): every node gen_tcp-connects to ONE shared
+simulator, exactly one (``{sim_primary, true}``) sends ``{init, _}``,
+each sets its own id, and each drains its own deliveries.  This suite
+drives that exact flow with BYTE-FAITHFUL BEAM frames (the
+``term_to_binary`` bytes the Erlang side puts on the socket — the
+STRING_EXT small-int-list quirk included) against the real
+``socket_server``:
+
+- VM A's ``forward_message`` arrives in VM B's drain (the single-
+  simulator multi-node topology the reference gets for free),
+- the secondary VM does NOT init (a second init would wipe the shared
+  cluster) — its first frames are ``set_self`` only,
+- A's join is visible in B's ``members`` (membership diffs reach every
+  VM → on_up/on_down),
+- the ``is_alive`` probe sees A's crash from B — the liveness signal
+  behind ``supports_capability(monitoring) -> true``.
+"""
+
+import socket
+import struct
+
+import pytest
+
+from partisan_tpu.bridge import etf
+from partisan_tpu.bridge.etf import Atom
+from partisan_tpu.bridge.socket_server import BridgeSocketServer
+
+from test_bridge_conformance import beam_frame
+
+
+class TcpVM:
+    """One Erlang node's gen_tcp connection, speaking BEAM bytes."""
+
+    def __init__(self, srv, sim_id: int, *, primary: bool,
+                 n_nodes: int = 8, seed: int = 13) -> None:
+        self.id = sim_id
+        self._seq = sim_id * 100
+        self.sock = socket.create_connection((srv.host, srv.port))
+        if primary:          # {sim_primary, true}: exactly one init
+            assert self.rpc((Atom("init"),
+                             {Atom("n_nodes"): n_nodes,
+                              Atom("seed"): seed})) == etf.OK
+        assert self.rpc((Atom("set_self"), sim_id)) == etf.OK
+
+    def rpc(self, term):
+        """Sequenced {Seq, Req} -> {Seq, Reply}, BEAM-encoded request
+        bytes (the .erl's rpc_port/2 on the tcp branch)."""
+        self._seq += 1
+        self.sock.sendall(beam_frame((self._seq, term)))
+        head = b""
+        while len(head) < 4:
+            head += self.sock.recv(4 - len(head))
+        (n,) = struct.unpack(">I", head)
+        buf = b""
+        while len(buf) < n:
+            buf += self.sock.recv(n - len(buf))
+        seq, reply = etf.decode(buf)
+        assert seq == self._seq
+        return reply
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def rig():
+    srv = BridgeSocketServer()
+    srv.serve_background()
+    vms = []
+    try:
+        a = TcpVM(srv, 0, primary=True)
+        b = TcpVM(srv, 1, primary=False)     # no init: shared cluster
+        vms = [a, b]
+        yield a, b
+    finally:
+        for vm in vms:
+            vm.close()
+        srv.close()
+
+
+def test_forward_message_crosses_vms(rig):
+    """Node A's forward_message arrives in node B's drain."""
+    a, b = rig
+    assert a.rpc((Atom("forward_message"), a.id, b.id, [7, 9])) == etf.OK
+    ok, _rnd = a.rpc((Atom("step"), 1))
+    assert ok == etf.OK
+    ok, got = b.rpc((Atom("drain"),))      # argument-less: MY inbox
+    assert ok == etf.OK
+    assert got == [(a.id, [7, 9] + [0, 0])] or \
+        (len(got) == 1 and got[0][0] == a.id and got[0][1][:2] == [7, 9])
+
+
+def test_drain_is_per_vm(rig):
+    """B's deliveries never leak into A's drain (self-id scoping)."""
+    a, b = rig
+    assert a.rpc((Atom("forward_message"), a.id, b.id, [5])) == etf.OK
+    a.rpc((Atom("step"), 1))
+    ok, got_a = a.rpc((Atom("drain"),))
+    assert ok == etf.OK and got_a == []
+    ok, got_b = b.rpc((Atom("drain"),))
+    assert ok == etf.OK and len(got_b) == 1
+
+
+def test_membership_diff_reaches_both_vms(rig):
+    """B joins the cluster via A; then node 2's join (issued by A)
+    becomes visible in B's member view via membership gossip (the on_up
+    path both VMs poll via {members, Me})."""
+    a, b = rig
+    assert b.rpc((Atom("join"), b.id, a.id)) == etf.OK
+    a.rpc((Atom("step"), 8))
+    assert a.rpc((Atom("join"), 2, a.id)) == etf.OK
+    a.rpc((Atom("step"), 12))
+    ok, members_b = b.rpc((Atom("members"), b.id))
+    assert ok == etf.OK
+    assert 2 in members_b
+
+
+def test_is_alive_probe_sees_remote_crash(rig):
+    """B observes A's crash via {is_alive, A} — the liveness signal
+    behind supports_capability(monitoring) -> true."""
+    a, b = rig
+    ok, alive = b.rpc((Atom("is_alive"), a.id))
+    assert ok == etf.OK and alive is True
+    assert b.rpc((Atom("crash"), a.id)) == etf.OK
+    ok, alive = b.rpc((Atom("is_alive"), a.id))
+    assert ok == etf.OK and alive is False
+
+
+def test_bidirectional_traffic_same_round(rig):
+    a, b = rig
+    assert a.rpc((Atom("forward_message"), a.id, b.id, [1])) == etf.OK
+    assert b.rpc((Atom("forward_message"), b.id, a.id, [2])) == etf.OK
+    a.rpc((Atom("step"), 1))
+    ok, got_b = b.rpc((Atom("drain"),))
+    assert ok == etf.OK and got_b[0][1][0] == 1
+    ok, got_a = a.rpc((Atom("drain"),))
+    assert ok == etf.OK and got_a[0][1][0] == 2

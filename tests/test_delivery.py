@@ -16,6 +16,7 @@ from partisan_tpu import types as T
 from partisan_tpu.cluster import Cluster
 from partisan_tpu.config import Config
 from partisan_tpu.models.direct_mail import DirectMail
+from partisan_tpu.models.p2p_chat import P2PChat
 from partisan_tpu.ops import msg as msg_ops
 from partisan_tpu.parallel import ShardedCluster, make_mesh
 
@@ -234,74 +235,6 @@ def test_causal_catchup_beyond_deliver_cap():
 # Point-to-point causal delivery (partisan_causality_backend.erl:204-220,
 # per-destination scheme — UNBOUNDED senders)
 # ---------------------------------------------------------------------------
-
-class P2PChatState(NamedTuple):
-    log: Array       # int32[n, L] — delivered (sender * K + seq), in order
-    log_len: Array   # int32[n]
-    seq: Array       # int32[n]
-    send_at: Array   # int32[n, S]
-    send_dst: Array  # int32[n, S]
-
-
-class P2PChat:
-    """Point-to-point causal chat: scripted sends to SPECIFIC
-    destinations; any node may send (no bounded actor space)."""
-
-    name = "p2p_chat"
-    LOG = 32
-    SLOTS = 8
-    K = 1000
-
-    def init(self, cfg: Config, comm) -> P2PChatState:
-        n = comm.n_local
-        return P2PChatState(
-            log=jnp.zeros((n, self.LOG), jnp.int32),
-            log_len=jnp.zeros((n,), jnp.int32),
-            seq=jnp.ones((n,), jnp.int32),
-            send_at=jnp.full((n, self.SLOTS), -1, jnp.int32),
-            send_dst=jnp.full((n, self.SLOTS), -1, jnp.int32),
-        )
-
-    def step(self, cfg: Config, comm, state: P2PChatState, ctx, nbrs):
-        gids = comm.local_ids()
-        n = state.log.shape[0]
-        lane = cfg.causal_lane_id("chat")
-
-        inb = ctx.inbox.data
-        is_chat = (inb[..., T.W_KIND] == T.MsgKind.APP) & \
-                  (inb[..., T.W_FLAGS] & T.F_CAUSAL != 0)
-        tok = jnp.where(is_chat,
-                        inb[..., T.W_SRC] * self.K + inb[..., T.P0], 0)
-        rank = jnp.cumsum(is_chat, axis=1) - 1
-        slot = jnp.where(is_chat, state.log_len[:, None] + rank, self.LOG)
-        rows = jnp.broadcast_to(jnp.arange(n)[:, None], slot.shape)
-        log = state.log.at[rows, slot].set(tok, mode="drop")
-        log_len = state.log_len + is_chat.sum(axis=1, dtype=jnp.int32)
-
-        fire = (state.send_at == ctx.rnd) & ctx.alive[:, None]  # [n, S]
-        dst = jnp.where(fire, state.send_dst, -1)
-        srank = jnp.cumsum(fire, axis=1)
-        emitted = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], dst,
-            flags=T.F_CAUSAL, lane=lane,
-            payload=(state.seq[:, None] + srank - 1,))
-        seq = state.seq + fire.sum(axis=1, dtype=jnp.int32)
-        return P2PChatState(log=log, log_len=log_len, seq=seq,
-                            send_at=state.send_at,
-                            send_dst=state.send_dst), emitted
-
-    def schedule(self, state: P2PChatState, node: int, rnd: int,
-                 dst: int, now: int = 0) -> P2PChatState:
-        """Schedule a send; slots whose round already passed (< now) are
-        reusable."""
-        row = np.asarray(state.send_at[node])
-        free_mask = row < now if now > 0 else row < 0
-        assert free_mask.any(), f"node {node}: all {self.SLOTS} slots used"
-        free = int(np.argmax(free_mask))
-        return state._replace(
-            send_at=state.send_at.at[node, free].set(rnd),
-            send_dst=state.send_dst.at[node, free].set(dst))
-
 
 def p2p_config(n, seed, **kw):
     return Config(n_nodes=n, seed=seed, causal_p2p_labels=("chat",),

@@ -134,3 +134,53 @@ def test_rejoin_after_leave():
     assert (active[5] >= 0).any(), "rejoiner has no active peers"
     # overlay is one component again including the rejoiner
     assert len(components(active, np.ones(16, bool))) == 1
+
+
+def test_saturated_clique_merges_via_heartbeat_isolation():
+    """A disconnected SATURATED component (7 nodes whose full active
+    views point only at each other) is unmergeable by shuffle/promotion
+    — promotion fires only under-full, shuffles walk active edges.  The
+    liveness heartbeat (node 0's epoch scatter-maxed along edges) goes
+    stale inside the clique, and the isolation window triggers a
+    discovery-seed rejoin that merges it back (HyParViewConfig.heartbeat
+    doc: the plumtree-backend heartbeat + scamp_v2 isolation window)."""
+    import jax.numpy as jnp
+
+    cfg = hv_config(24, seed=13)
+    cl = Cluster(cfg)
+    st = boot_hyparview(cl)
+    clique = np.arange(17, 24)
+    active = st.manager.active
+    passive = st.manager.passive
+    A = active.shape[1]
+    for nd in clique:
+        others = [int(x) for x in clique if x != nd][:A]
+        active = active.at[nd].set(jnp.asarray(others, jnp.int32))
+        passive = passive.at[nd].set(-1)
+    # sever the main component's links INTO the clique too
+    in_clique = jnp.isin(active, jnp.asarray(clique))
+    rows_main = jnp.arange(24)[:, None] < 17
+    active = jnp.where(in_clique & rows_main, -1, active)
+    st = st._replace(manager=st.manager._replace(
+        active=active, passive=passive,
+        joined=st.manager.joined | True,
+        hb_rnd=jnp.full((24,), int(st.rnd), jnp.int32)))
+    assert len(components(np.asarray(st.manager.active),
+                          np.ones(24, bool))) == 2
+    window = cfg.rounds(cfg.hyparview.isolation_window_ms)
+    st = cl.steps(st, window + 30)
+    comps = components(np.asarray(st.manager.active), np.ones(24, bool))
+    assert len(comps) == 1, f"clique did not merge: {comps}"
+
+
+def test_heartbeat_quiet_on_connected_overlay():
+    """On a healthy connected overlay the isolation detector must never
+    fire: every node's received epoch keeps advancing (hb_rnd within one
+    window of now)."""
+    cfg = hv_config(20, seed=17)
+    cl = Cluster(cfg)
+    st = boot_hyparview(cl)
+    st = cl.steps(st, 60)
+    window = cfg.rounds(cfg.hyparview.isolation_window_ms)
+    lag = int(st.rnd) - np.asarray(st.manager.hb_rnd)
+    assert (lag <= window).all(), f"stale heartbeat on connected overlay: {lag}"

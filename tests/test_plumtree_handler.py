@@ -1,0 +1,137 @@
+"""Pluggable plumtree broadcast-handler behaviour
+(partisan_plumtree_broadcast_handler.erl:47-78).
+
+The reference lets applications supply broadcast_data/merge/is_stale/
+graft/exchange; these tests drive application-defined payload semantics
+through the SAME epidemic tree the default version handler uses:
+
+- a G-counter CRDT handler (merge = per-actor max) converging across the
+  overlay, including concurrent increments from different actors merging
+  commutatively,
+- a last-writer-wins register handler whose join is NOT a per-word max
+  (the value rides with the winning timestamp — exercises the general
+  join path, with exchange ignored like the reference's default backend,
+  partisan_plumtree_backend.erl:22-35),
+- the exchange start cap (broadcast_start_exchange_limit,
+  partisan_config.erl:750-755).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, PlumtreeConfig
+from partisan_tpu.models.handlers import (
+    GCounterHandler, LWWHandler, VersionHandler)
+from partisan_tpu.models.plumtree import Plumtree
+
+N = 12
+
+
+def _boot(model, n=N, **kw) -> tuple[Cluster, object, Config]:
+    cfg = Config(n_nodes=n, seed=3, peer_service_manager="hyparview",
+                 msg_words=16, **kw)
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for node in range(1, n):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, node,
+                                                 target=0))
+    st = cl.steps(st, 12)
+    return cl, st, cfg
+
+
+def test_gcounter_handler_broadcasts_through_tree():
+    """A CRDT payload (G-counter) rides the same eager/lazy tree."""
+    model = Plumtree(handler=GCounterHandler(n_actors=4))
+    cl, st, cfg = _boot(model)
+    # actor 2 increments to 5 at node 3
+    st = st._replace(model=model.broadcast(st.model, 3, 0, {2: 5}))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0, {2: 5})) == 1.0, max_rounds=60)
+    assert r != -1, "g-counter broadcast did not converge"
+    assert int(model.handler.total(st.model.data[7, 0])) == 5
+
+
+def test_gcounter_concurrent_increments_merge():
+    """Concurrent increments from different actors merge commutatively
+    (merge/2 is the CRDT join, not last-write-wins)."""
+    model = Plumtree(handler=GCounterHandler(n_actors=4))
+    cl, st, cfg = _boot(model)
+    st = st._replace(model=model.broadcast(st.model, 3, 0, {0: 2}))
+    st = st._replace(model=model.broadcast(st.model, 8, 0, {1: 3}))
+    target = {0: 2, 1: 3}
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0, target)) == 1.0, max_rounds=80)
+    assert r != -1, "concurrent g-counter increments did not converge"
+    assert int(model.handler.total(st.model.data[0, 0])) == 5
+
+
+def test_lww_handler_general_join():
+    """LWW register: join is by timestamp order, not per-word max — a
+    LOWER value with a HIGHER timestamp must win everywhere."""
+    model = Plumtree(handler=LWWHandler())
+    cl, st, cfg = _boot(model)
+    st = st._replace(model=model.broadcast(st.model, 2, 0, (10, 90)))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0, (10, 90))) == 1.0, max_rounds=60)
+    assert r != -1
+    # newer timestamp, smaller value: must supersede (ts=20, v=7)
+    st = st._replace(model=model.broadcast(st.model, 5, 0, (20, 7)))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0, (20, 7))) == 1.0, max_rounds=60)
+    assert r != -1, "LWW overwrite did not converge"
+    assert st.model.data[9, 0].tolist() == [20, 7]
+
+
+def test_lww_stale_update_ignored():
+    model = Plumtree(handler=LWWHandler())
+    cl, st, cfg = _boot(model)
+    st = st._replace(model=model.broadcast(st.model, 2, 0, (50, 1)))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0, (50, 1))) == 1.0, max_rounds=60)
+    assert r != -1
+    # an OLDER timestamp is stale at injection (join keeps the winner)
+    st = st._replace(model=model.broadcast(st.model, 4, 0, (40, 99)))
+    assert st.model.data[4, 0].tolist() == [50, 1]
+
+
+def test_version_handler_unchanged_default():
+    """Plumtree() without a handler is the version semantics (the default
+    partisan_plumtree_backend), including int broadcast/coverage args."""
+    model = Plumtree()
+    assert isinstance(model.handler, VersionHandler)
+    cl, st, cfg = _boot(model)
+    st = st._replace(model=model.broadcast(st.model, 0, 0, 7))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0, 7)) == 1.0, max_rounds=60)
+    assert r != -1
+
+
+def test_exchange_limit_zero_disables_aae():
+    """With AAE off (exchange_limit=0) a payload still converges via the
+    tree; the handler exchange path never fires (parity with the
+    reference's default backend, whose exchange is ignore)."""
+    model = Plumtree(handler=GCounterHandler(n_actors=2))
+    cl, st, cfg = _boot(
+        model, plumtree=PlumtreeConfig(exchange_limit=0))
+    st = st._replace(model=model.broadcast(st.model, 1, 0, {0: 4}))
+    st, r = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0, {0: 4})) == 1.0, max_rounds=80)
+    assert r != -1, "tree-only (no AAE) convergence failed"
+
+
+def test_payload_width_validation():
+    with pytest.raises(ValueError, match="msg_words"):
+        # 8-word handler payload cannot fit msg_words=12
+        Config(n_nodes=4, msg_words=12).n_nodes  # config itself is fine
+        model = Plumtree(handler=GCounterHandler(n_actors=8))
+        Cluster(Config(n_nodes=4, msg_words=12,
+                       peer_service_manager="hyparview"),
+                model=model).init()

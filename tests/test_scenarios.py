@@ -28,7 +28,9 @@ def test_config4_scamp_churn():
 
 
 def test_config5_causal_crash():
-    r = scenarios.config5_causal_crash(n=128, n_actors=8, crashes=4)
+    r = scenarios.config5_causal_crash(n=128, senders=8, crashes=4)
     assert r["convergence_rounds"] > 0, r
-    # every receiving actor delivered both causal sends in order
-    assert r["causal_ordered_actors"] == r["n_receiving_actors"], r
+    # any-node senders: every receiver delivered its sender's two
+    # messages, per-edge FIFO, exactly once
+    assert r["causal_deliveries"] == r["causal_expected"], r
+    assert r["fifo_ok_receivers"] == r["n_receivers"], r

@@ -1,0 +1,124 @@
+"""Minimal reproducer for the per-execution wall-limit fault.
+
+Round-1/2 observation: a single XLA execution (one lax.scan program) that
+keeps the relay-attached TPU busy for longer than ~the minute mark
+reproducibly faults, poisoning the process context.  bench.py works
+around it by capping scan length so each execution stays ~15 s.
+
+This tool isolates the trigger with two self-contained programs:
+
+  pure    — a lax.scan over a bfloat16 matmul chain (no partisan code,
+            no host traffic during execution), sized by --seconds.
+  traffic — the partisan hyparview+plumtree round scan at --n nodes
+            (the bench workload), scan length --k.
+
+Usage:  python tools/minute_fault_repro.py pure --seconds 90
+        python tools/minute_fault_repro.py traffic --n 4096 --k 2500
+
+If `pure` faults at the same horizon as `traffic`, the limit is the
+runtime/relay's per-execution deadline — an environment property, not a
+formulation bug in the simulator.  Findings are recorded in
+tools/MINUTE_FAULT.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _sync_scalar(x) -> float:
+    # jax.block_until_ready does not reliably block on the relay-attached
+    # backend (see bench.py); a scalar device->host transfer is a true
+    # barrier.
+    return float(jax.device_get(jnp.ravel(x)[0]))
+
+
+def run_pure(seconds: float) -> None:
+    d = 2048
+
+    @jax.jit
+    def chain(x, k):
+        def body(c, _):
+            return jnp.tanh(c @ w), None
+        return jax.lax.scan(body, x, None, length=k)[0]
+
+    w = jax.random.normal(jax.random.key(0), (d, d), jnp.bfloat16)
+    x = jax.random.normal(jax.random.key(1), (d, d), jnp.bfloat16)
+
+    # calibrate: per-iteration cost from a short scan
+    probe_k = 200
+    chain_p = jax.jit(lambda x: jax.lax.scan(
+        lambda c, _: (jnp.tanh(c @ w), None), x, None, length=probe_k)[0])
+    _sync_scalar(chain_p(x))
+    t0 = time.perf_counter()
+    _sync_scalar(chain_p(x))
+    per = (time.perf_counter() - t0) / probe_k
+    k = int(seconds / per)
+    print(f"pure: per-iter {per*1e6:.1f} us, running ONE execution of "
+          f"k={k} (~{seconds:.0f}s)", flush=True)
+    big = jax.jit(lambda x: jax.lax.scan(
+        lambda c, _: (jnp.tanh(c @ w), None), x, None, length=k)[0])
+    t0 = time.perf_counter()
+    _sync_scalar(big(x))
+    print(f"pure: OK — single execution ran {time.perf_counter()-t0:.1f}s "
+          f"without fault", flush=True)
+
+
+def run_traffic(n: int, k: int) -> None:
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, PlumtreeConfig
+    from partisan_tpu.models.plumtree import Plumtree
+    import numpy as np
+
+    cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups", max_broadcasts=8,
+                 inbox_cap=16,
+                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    rng = np.random.default_rng(7)
+    base = 1
+    while base < n:
+        hi = min(base * 4, n)
+        nodes = np.arange(base, hi, dtype=np.int32)
+        targets = rng.integers(0, base, size=nodes.shape[0]).astype(np.int32)
+        st = st._replace(manager=cl.manager.join_many(
+            cfg, st.manager, nodes, targets))
+        st = cl.steps(st, 10)
+        base = hi
+    _sync_scalar(st.rnd)
+    # estimate per-round cost, then one LONG execution
+    t0 = time.perf_counter()
+    st = cl.steps(st, 10)
+    _sync_scalar(st.rnd)
+    per = (time.perf_counter() - t0) / 10
+    print(f"traffic: n={n} per-round {per*1e3:.1f} ms, running ONE "
+          f"execution of k={k} (~{per*k:.0f}s)", flush=True)
+    t0 = time.perf_counter()
+    st = cl.steps(st, k)
+    _sync_scalar(st.rnd)
+    print(f"traffic: OK — single {k}-round execution ran "
+          f"{time.perf_counter()-t0:.1f}s without fault; rnd={int(st.rnd)}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=["pure", "traffic"])
+    ap.add_argument("--seconds", type=float, default=90.0)
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--k", type=int, default=2500)
+    args = ap.parse_args()
+    if args.mode == "pure":
+        run_pure(args.seconds)
+    else:
+        run_traffic(args.n, args.k)
+    print("done", file=sys.stderr)
