@@ -136,6 +136,20 @@ class BridgeSocketServer:
         return buf
 
 
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Client-side frame reader: exactly ``n`` bytes, RAISING on a closed
+    socket (an unguarded ``recv`` loop busy-spins forever on b'').  The
+    canonical {packet,4} reader shared by every bridge client — the
+    trace16 harness, the emulated-VM test rigs."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("bridge socket closed mid-frame")
+        buf += chunk
+    return buf
+
+
 def main() -> None:
     import argparse
     import sys

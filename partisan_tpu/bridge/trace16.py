@@ -54,17 +54,13 @@ class _VM:
         assert self.rpc((Atom("set_self"), sim_id)) == etf.OK
 
     def rpc(self, term):
+        from partisan_tpu.bridge.socket_server import recv_exact
+
         self._seq += 1
         payload = self._etf.encode((self._seq, term))
         self.sock.sendall(struct.pack(">I", len(payload)) + payload)
-        head = b""
-        while len(head) < 4:
-            head += self.sock.recv(4 - len(head))
-        (n,) = struct.unpack(">I", head)
-        buf = b""
-        while len(buf) < n:
-            buf += self.sock.recv(n - len(buf))
-        seq, reply = self._etf.decode(buf)
+        (n,) = struct.unpack(">I", recv_exact(self.sock, 4))
+        seq, reply = self._etf.decode(recv_exact(self.sock, n))
         assert seq == self._seq
         return reply
 

@@ -277,17 +277,7 @@ class Plumtree:
         # (partisan_plumtree_backend.erl:22-35).  The reference exchange
         # is a session between two nodes; the one-way periodic push
         # converges identically under symmetric firing.
-        if pt.aae and hd.supports_exchange and pt.exchange_limit > 0:
-            fires = ((ctx.rnd + gids) % cfg.exchange_tick_every == 0) \
-                    & ctx.alive
-
-            def pick(key, row, fire):
-                slots = rng.choice_slots(
-                    rng.subkey(key, _TAG_AAE), row >= 0, pt.exchange_limit)
-                t = jnp.where(slots >= 0, row[slots], jnp.int32(-1))
-                return jnp.where(fire, t, jnp.int32(-1))
-
-            tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)    # [n, limit]
+        if pt.aae and hd.supports_exchange:
             # Connect-time state exchange: a link slot with a NEW
             # occupant gets the whole store pushed along it this round —
             # the reference's anti-entropy handshake ({state, Tag,
@@ -295,9 +285,24 @@ class Plumtree:
             # partisan_peer_service_server.erl:150-172).  Without it a
             # late (re)joiner waits on the random AAE walk to stumble
             # onto it (measured ~60+ rounds for the last 14 of 100k).
-            tgt_new = jnp.where(changed & (nbrs >= 0) & ctx.alive[:, None],
-                                nbrs, -1)                  # [n, K]
-            tgt = jnp.concatenate([tgt, tgt_new], axis=1)
+            # It is a handshake, not a periodic exchange, so it fires
+            # even when exchange_limit=0 disables the random AAE walk
+            # (the reference handshake is unconditional on connect).
+            tgt = jnp.where(changed & (nbrs >= 0) & ctx.alive[:, None],
+                            nbrs, -1)                      # [n, K]
+            if pt.exchange_limit > 0:
+                fires = ((ctx.rnd + gids) % cfg.exchange_tick_every == 0) \
+                        & ctx.alive
+
+                def pick(key, row, fire):
+                    slots = rng.choice_slots(
+                        rng.subkey(key, _TAG_AAE), row >= 0,
+                        pt.exchange_limit)
+                    t = jnp.where(slots >= 0, row[slots], jnp.int32(-1))
+                    return jnp.where(fire, t, jnp.int32(-1))
+
+                tick_tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)
+                tgt = jnp.concatenate([tick_tgt, tgt], axis=1)
             tgt = faults_mod.filter_edges(
                 ctx.faults, gids, tgt, cfg.seed, ctx.rnd, _AAE_EDGE_TAG)
             pulled = hd.exchange(comm, data, tgt)

@@ -83,15 +83,10 @@ def components(active, alive):
 # ---------------------------------------------------------------------------
 
 def recv_exact(sock, k):
-    """Read exactly k bytes; a closed socket raises instead of spinning
-    (the {packet,4} framing reader shared by every bridge client)."""
-    buf = b""
-    while len(buf) < k:
-        got = sock.recv(k - len(buf))
-        if not got:
-            raise ConnectionError("bridge socket closed mid-frame")
-        buf += got
-    return buf
+    """Canonical {packet,4} frame reader (raises on a closed socket) —
+    re-exported from the bridge package for the test rigs."""
+    from partisan_tpu.bridge.socket_server import recv_exact as rx
+    return rx(sock, k)
 
 
 def bridge_rig(n_nodes, seed=9):

@@ -49,16 +49,12 @@ class TcpVM:
     def rpc(self, term):
         """Sequenced {Seq, Req} -> {Seq, Reply}, BEAM-encoded request
         bytes (the .erl's rpc_port/2 on the tcp branch)."""
+        from partisan_tpu.bridge.socket_server import recv_exact
+
         self._seq += 1
         self.sock.sendall(beam_frame((self._seq, term)))
-        head = b""
-        while len(head) < 4:
-            head += self.sock.recv(4 - len(head))
-        (n,) = struct.unpack(">I", head)
-        buf = b""
-        while len(buf) < n:
-            buf += self.sock.recv(n - len(buf))
-        seq, reply = etf.decode(buf)
+        (n,) = struct.unpack(">I", recv_exact(self.sock, 4))
+        seq, reply = etf.decode(recv_exact(self.sock, n))
         assert seq == self._seq
         return reply
 

@@ -114,9 +114,10 @@ def test_version_handler_unchanged_default():
 
 
 def test_exchange_limit_zero_disables_aae():
-    """With AAE off (exchange_limit=0) a payload still converges via the
-    tree; the handler exchange path never fires (parity with the
-    reference's default backend, whose exchange is ignore)."""
+    """With exchange_limit=0 the periodic AAE walk is off (parity with
+    the reference's default backend, whose exchange is ignore) — the
+    connect-time handshake still fires on NEW links, and the payload
+    converges via the tree."""
     model = Plumtree(handler=GCounterHandler(n_actors=2))
     cl, st, cfg = _boot(
         model, plumtree=PlumtreeConfig(exchange_limit=0))
