@@ -163,6 +163,11 @@ def run(n: int, verbose: bool = False) -> dict:
         best10 = min(best10, time.perf_counter() - t1)
     est_round = max(best10 / K_PROG, 1e-4)
     k = int(min(1000, max(K_PROG, 15.0 / est_round)))
+    if k > 4 * K_PROG:
+        # quantize to a 50-round grid: the k-specialized program then
+        # recurs across runs and hits the persistent compile cache
+        # (est_round jitter would otherwise pick a fresh k every time)
+        k = max(50, (k // 50) * 50)
     if k <= 4 * K_PROG:
         # per-round cost already amortizes the dispatch: a second
         # compile would cost more than the precision it buys
@@ -225,9 +230,13 @@ def _run_one_subprocess(n: int, timeout_s: float) -> dict | None:
 
 
 def main() -> None:
+    # Ladder: 32k secures a scale rung, then 100k takes the rest of the
+    # budget (the 4k rung was dropped — its ~100 s starved the 100k
+    # run, which needs the full per-size cap; it remains the emergency
+    # fallback when nothing else lands).
     t_start = time.time()
     results: dict[int, dict] = {}
-    for n in (4_096, 32_768, 100_000):
+    for n in (32_768, 100_000):
         remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
         if results and remaining < 90:
             break
@@ -246,6 +255,10 @@ def main() -> None:
         if got is None:
             break                # keep the smaller sizes' results
         results[n] = got
+    if not results:
+        got = _run_one_subprocess(4_096, timeout_s=120.0)
+        if got is not None:
+            results[4_096] = got
     if not results:
         raise SystemExit("bench failed at every size")
     top = results[max(results)]

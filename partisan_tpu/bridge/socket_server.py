@@ -127,13 +127,12 @@ class BridgeSocketServer:
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
-        buf = b""
-        while len(buf) < n:
-            chunk = conn.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
+        """Server-side wrapper: a client hanging up is normal
+        (None ends the client loop) rather than an error."""
+        try:
+            return recv_exact(conn, n)
+        except ConnectionError:
+            return None
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:

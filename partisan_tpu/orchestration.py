@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Protocol, Sequence
+from typing import Callable, Protocol, Sequence
 
 import numpy as np
 
@@ -63,6 +63,67 @@ class TagStrategy:
 
     def servers(self) -> Sequence[int]:
         return list(range(self.n_servers))
+
+
+@dataclasses.dataclass
+class KubernetesStrategy:
+    """Pod discovery via the k8s API
+    (partisan_kubernetes_orchestration_strategy.erl:73-90: GET
+    /api/v1/pods?labelSelector=..., keep Running pods with an IP, read
+    the role off the pod labels).
+
+    ``api`` is the injectable pod-list call (in production a k8s client;
+    in tests a stub returning pod dicts).  A pod dict mirrors the k8s
+    shape: ``{"metadata": {"labels": {...}}, "status": {"phase":
+    "Running", "podIP": ...}, "sim_id": int}`` — ``sim_id`` is the
+    sim-side node identity (the reference derives node names from pod
+    IPs; the simulator's ids are its node names)."""
+
+    api: "Callable[[], Sequence[dict]]"
+    selector: tuple[str, str] = ("app", "partisan")
+    role_label: str = "tag"
+
+    def _pods(self) -> list[dict]:
+        key, val = self.selector
+        out = []
+        for p in self.api():
+            labels = p.get("metadata", {}).get("labels", {})
+            status = p.get("status", {})
+            if labels.get(key) != val:
+                continue             # label selector
+            if status.get("phase") != "Running" or not status.get("podIP"):
+                continue             # not schedulable yet
+            out.append(p)
+        return out
+
+    def _role(self, role: str) -> list[int]:
+        return sorted(
+            int(p["sim_id"]) for p in self._pods()
+            if p.get("metadata", {}).get("labels", {})
+                .get(self.role_label) == role)
+
+    def clients(self) -> Sequence[int]:
+        return self._role("client")
+
+    def servers(self) -> Sequence[int]:
+        return self._role("server")
+
+
+@dataclasses.dataclass
+class ComposeStrategy:
+    """Service discovery for docker-compose rigs
+    (partisan_compose_orchestration_strategy.erl): roles come from the
+    compose service a container belongs to.  ``services`` is the
+    injectable service→containers mapping (compose ps analogue); the
+    conventional service names are ``client`` and ``server``."""
+
+    services: "Callable[[], dict[str, Sequence[int]]]"
+
+    def clients(self) -> Sequence[int]:
+        return sorted(self.services().get("client", []))
+
+    def servers(self) -> Sequence[int]:
+        return sorted(self.services().get("server", []))
 
 
 @dataclasses.dataclass

@@ -182,3 +182,40 @@ def test_connection_counts_introspection():
     c2 = telemetry.connection_counts(cl, st)
     assert c2["per_node"][3] == 0
     assert c2["total_edges"] < c["total_edges"]
+
+
+def test_kubernetes_strategy_pod_discovery():
+    """k8s strategy (partisan_kubernetes_orchestration_strategy.erl
+    :73-90): label selector filters, non-Running / IP-less pods are
+    skipped, roles read off the pod labels."""
+    def pod(sim_id, role, phase="Running", ip="10.0.0.1", app="partisan"):
+        return {"metadata": {"labels": {"app": app, "tag": role}},
+                "status": {"phase": phase, "podIP": ip},
+                "sim_id": sim_id}
+
+    pods = [
+        pod(0, "server"),
+        pod(1, "server"),
+        pod(2, "client"),
+        pod(3, "client", phase="Pending"),        # not schedulable yet
+        pod(4, "client", ip=None),                # no IP assigned
+        pod(5, "client", app="other"),            # selector mismatch
+        pod(6, "client"),
+    ]
+    strat = orchestration.KubernetesStrategy(api=lambda: pods)
+    assert strat.servers() == [0, 1]
+    assert strat.clients() == [2, 6]
+    # a pod becoming Running shows up on the next poll (the reference's
+    # periodic refresh timer)
+    pods[3]["status"]["phase"] = "Running"
+    assert strat.clients() == [2, 3, 6]
+
+
+def test_compose_strategy_service_discovery(tmp_path):
+    strat = orchestration.ComposeStrategy(
+        services=lambda: {"server": [1, 0], "client": [3, 2], "db": [9]})
+    assert strat.servers() == [0, 1]
+    assert strat.clients() == [2, 3]
+    # drives the backend like any other strategy
+    be = orchestration.Backend(strat, artifact_dir=str(tmp_path))
+    assert be.servers() == [0, 1]
