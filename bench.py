@@ -256,7 +256,10 @@ def main() -> None:
             break                # keep the smaller sizes' results
         results[n] = got
     if not results:
-        got = _run_one_subprocess(4_096, timeout_s=120.0)
+        # emergency fallback, still inside the wall budget
+        remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
+        got = _run_one_subprocess(
+            4_096, timeout_s=max(60.0, min(120.0, remaining)))
         if got is not None:
             results[4_096] = got
     if not results:

@@ -96,17 +96,25 @@ class KubernetesStrategy:
             out.append(p)
         return out
 
-    def _role(self, role: str) -> list[int]:
-        return sorted(
-            int(p["sim_id"]) for p in self._pods()
-            if p.get("metadata", {}).get("labels", {})
-                .get(self.role_label) == role)
+    def roles(self) -> tuple[list[int], list[int]]:
+        """(clients, servers) from ONE pod-list call — the per-poll
+        pattern (the reference lists pods once per refresh timer; two
+        separate API calls could read torn cluster snapshots)."""
+        pods = self._pods()
+
+        def by(role: str) -> list[int]:
+            return sorted(
+                int(p["sim_id"]) for p in pods
+                if p.get("metadata", {}).get("labels", {})
+                    .get(self.role_label) == role)
+
+        return by("client"), by("server")
 
     def clients(self) -> Sequence[int]:
-        return self._role("client")
+        return self.roles()[0]
 
     def servers(self) -> Sequence[int]:
-        return self._role("server")
+        return self.roles()[1]
 
 
 @dataclasses.dataclass

@@ -1,0 +1,65 @@
+"""Ablation profile of the bench round at scale: where does the
+per-round time go?
+
+Times the steady-state round under config ablations (manager-only, AAE
+off, monotonic shed off, emission-compaction widths, inbox widths) at a
+given n.  Each variant pays its own XLA compile, so run at 32k (compile
+~40 s cold) rather than 100k.  Results guide the hot-path work; keep
+with BENCH_NOTES.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def measure(n: int, label: str, *, model: bool = True, **over) -> None:
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, PlumtreeConfig
+    from partisan_tpu.models.plumtree import Plumtree
+    from partisan_tpu.scenarios import K_PROG, _boot_overlay, _sync
+
+    kw = dict(n_nodes=n, seed=1, peer_service_manager="hyparview",
+              msg_words=16, partition_mode="groups", max_broadcasts=8,
+              inbox_cap=16, emit_compact=32,
+              plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    kw.update(over)
+    cfg = Config(**kw)
+    cl = Cluster(cfg, model=Plumtree() if model else None, donate=True)
+    t0 = time.perf_counter()
+    st = _boot_overlay(cl, n, settle_execs=2)
+    boot = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st = cl.steps(st, K_PROG)
+        _sync(st)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:34s} per-round {best / K_PROG * 1e3:7.1f} ms   "
+          f"(boot+compile {boot:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    from partisan_tpu.config import HyParViewConfig, PlumtreeConfig
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32_768
+    measure(n, "baseline (bench config)")
+    measure(n, "manager only (no plumtree)", model=False)
+    measure(n, "aae off",
+            plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4, aae=False))
+    measure(n, "heartbeat off",
+            hyparview=HyParViewConfig(heartbeat=False))
+    measure(n, "monotonic shed off", monotonic_shed=False)
+    measure(n, "emit_compact off", emit_compact=0)
+    measure(n, "emit_compact 24", emit_compact=24)
+    measure(n, "inbox_cap 12", inbox_cap=12)
