@@ -278,6 +278,13 @@ def main() -> None:
                                "convergence_rounds": v["convergence_rounds"],
                                "convergence_wall_s": v["convergence_wall_s"]}
                       for k, v in results.items()},
+        # north-star target: 100k convergence <60s (BASELINE.md); the
+        # 16-node live-BEAM validation is impossible in this image — the
+        # honest substitute is the committed bridge-path wire trace
+        "north_star": "100k convergence wall <60s",
+        "validation": ("bridge-path 16-node trace "
+                       "(tools/traces/trace16.json); no live BEAM in "
+                       "image"),
     }))
 
 

@@ -184,3 +184,27 @@ def test_heartbeat_quiet_on_connected_overlay():
     window = cfg.rounds(cfg.hyparview.isolation_window_ms)
     lag = int(st.rnd) - np.asarray(st.manager.hb_rnd)
     assert (lag <= window).all(), f"stale heartbeat on connected overlay: {lag}"
+
+
+def test_heartbeat_root_migrates_when_node0_crashes():
+    """The epoch root is the lowest ALIVE id, not a fixed node: crashing
+    nodes 0 and 1 hands root duty to node 2 — epochs keep advancing for
+    every alive node and no rejoin storm fires (the fixed-root design
+    would have put the whole cluster into a perpetual JOIN storm at the
+    seeds once node 0 died)."""
+    cfg = hv_config(24, seed=19)
+    cl = Cluster(cfg)
+    st = boot_hyparview(cl)
+    st = st._replace(faults=faults_mod.crash(
+        faults_mod.crash(st.faults, 0), 1))
+    window = cfg.rounds(cfg.hyparview.isolation_window_ms)
+    st = cl.steps(st, 2 * window + 20)
+    alive = np.asarray(st.faults.alive)
+    # epochs still advance under the migrated root: every alive node's
+    # last-advance round is within one window of now
+    lag = int(st.rnd) - np.asarray(st.manager.hb_rnd)
+    assert (lag[alive] <= window + cfg.rounds(
+        cfg.hyparview.heartbeat_every_ms)).all(), lag[alive]
+    # and the surviving overlay is still one healthy component
+    comps = components(np.asarray(st.manager.active), alive)
+    assert len(comps) == 1, [len(c) for c in comps]

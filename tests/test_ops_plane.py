@@ -219,3 +219,44 @@ def test_compose_strategy_service_discovery(tmp_path):
     # drives the backend like any other strategy
     be = orchestration.Backend(strat, artifact_dir=str(tmp_path))
     assert be.servers() == [0, 1]
+
+
+def test_checkpoint_resume_at_scale_mid_scenario(tmp_path):
+    """Checkpoint/resume on the NORTH-STAR workload shape (hyparview +
+    plumtree, partition groups, emission compaction — the 100k bench
+    config at CPU-suite scale): snapshot mid-broadcast, resume in a
+    FRESH cluster object, and the continuation is bit-identical to the
+    uninterrupted run (§5.4 at the scale path's feature set)."""
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, PlumtreeConfig
+    from partisan_tpu.models.plumtree import Plumtree
+    from support import staggered_join
+
+    def mk():
+        cfg = Config(n_nodes=96, seed=6, peer_service_manager="hyparview",
+                     msg_words=16, partition_mode="groups",
+                     max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                     plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+        return Cluster(cfg, model=Plumtree())
+
+    cl = mk()
+    st = staggered_join(cl, cl.init())
+    st = cl.steps(st, 20)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0))
+    st = cl.steps(st, 5)                      # mid-broadcast
+    p = tmp_path / "scale.npz"
+    checkpoint.save(st, p)
+
+    cont = cl.steps(st, 60)                   # uninterrupted continuation
+
+    cl2 = mk()                                # fresh process analogue
+    st2 = checkpoint.restore(p, like=cl2.init())
+    cont2 = cl2.steps(st2, 60)
+
+    import numpy as _np
+    assert int(cont.rnd) == int(cont2.rnd)
+    assert _np.array_equal(cont.manager.active, cont2.manager.active)
+    assert _np.array_equal(cont.model.data, cont2.model.data)
+    assert int(cont.stats.delivered) == int(cont2.stats.delivered)
+    cov = float(cl2.model.coverage(cont2.model, cont2.faults.alive, 0))
+    assert cov == 1.0
