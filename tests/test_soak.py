@@ -1,0 +1,97 @@
+"""Long-horizon mixed-fault soak: the system-level invariants under a
+rolling storm of every fault class the test plane models.
+
+The reference's long-running robustness evidence is its CT suites
+cycling crash/partition/churn per group (partisan_SUITE.erl groups,
+:214-315) — this is the simulator's equivalent: one 500-round run over
+repeating fault cycles (iid link drop → crash batch → full partition →
+heal → churn), asserting after EVERY heal window that
+
+- the alive overlay re-converges to ONE component (healing works
+  regardless of what the storm broke),
+- a fresh plumtree broadcast reaches every alive node (the data plane
+  recovers, not just the membership plane),
+- stats accounting stays consistent (emitted == delivered + dropped —
+  the round engine's conservation law).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.models.plumtree import Plumtree
+
+from support import boot_hyparview, components, hv_config
+
+N = 256
+
+
+def _one_component(st) -> bool:
+    alive = np.asarray(st.faults.alive)
+    comps = components(np.asarray(st.manager.active), alive)
+    return len(comps) == 1
+
+
+def test_soak_500_rounds_mixed_faults():
+    cfg = hv_config(N, seed=23, partition_mode="dense", max_broadcasts=8,
+                    inbox_cap=16)
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = boot_hyparview(cl)
+    window = cfg.rounds(cfg.hyparview.isolation_window_ms)
+    rng = np.random.default_rng(41)
+    slot = 0
+
+    def heal_and_check(st, slot, phase):
+        # clear all faults, give the heartbeat healing one window
+        st = st._replace(faults=faults_mod.none(
+            N, cfg.resolved_partition_mode)._replace(
+                alive=st.faults.alive))
+        alive_ids = np.flatnonzero(np.asarray(st.faults.alive))
+        st = cl.steps(st, window + 30)
+        assert _one_component(st), f"{phase}: overlay did not re-merge"
+        src = int(rng.choice(alive_ids))
+        ver = int(st.rnd)
+        st = st._replace(model=model.broadcast(st.model, src, slot, ver))
+        st, r = cl.run_until(
+            st, lambda s, _sl=slot, _v=ver: float(model.coverage(
+                s.model, s.faults.alive, _sl, version=_v)) >= 1.0,
+            max_rounds=150, check_every=10)
+        assert r != -1, f"{phase}: broadcast did not re-converge"
+        s = st.stats
+        assert int(s.emitted) == int(s.delivered) + int(s.dropped), phase
+        return st, (slot + 1) % cfg.max_broadcasts
+
+    # phase 1: iid link drop storm
+    st = st._replace(faults=st.faults._replace(link_drop=jnp.float32(0.3)))
+    st = cl.steps(st, 60)
+    st, slot = heal_and_check(st, slot, "after link-drop storm")
+
+    # phase 2: crash a random tenth of the cluster
+    victims = rng.choice(N, size=N // 10, replace=False)
+    alive = st.faults.alive
+    for v in victims:
+        alive = alive.at[int(v)].set(False)
+    st = st._replace(faults=st.faults._replace(alive=alive))
+    st = cl.steps(st, 60)
+    st, slot = heal_and_check(st, slot, "after crash batch")
+
+    # phase 3: full partition (two halves), then heal
+    live = np.flatnonzero(np.asarray(st.faults.alive))
+    half = live[: len(live) // 2]
+    other = live[len(live) // 2:]
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, [int(x) for x in half], [int(x) for x in other]))
+    st = cl.steps(st, 60)
+    st, slot = heal_and_check(st, slot, "after partition")
+
+    # phase 4: churn (birth/death) for 100 rounds
+    churn = lambda f, rnd: faults_mod.churn_step(  # noqa: E731
+        f, cfg.seed, rnd, 0.01, 0.01)
+    for _ in range(10):
+        st = st._replace(faults=churn(st.faults, st.rnd))
+        st = cl.steps(st, 10)
+    st, slot = heal_and_check(st, slot, "after churn")
+
+    assert int(st.rnd) >= 500, int(st.rnd)
