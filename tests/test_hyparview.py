@@ -4,6 +4,7 @@ overlay with bounded view sizes, heals around crashes, and supports
 transitive dissemination."""
 
 import jax
+import pytest
 import numpy as np
 
 from partisan_tpu.cluster import Cluster
@@ -208,3 +209,42 @@ def test_heartbeat_root_migrates_when_node0_crashes():
     # and the surviving overlay is still one healthy component
     comps = components(np.asarray(st.manager.active), alive)
     assert len(comps) == 1, [len(c) for c in comps]
+
+
+@pytest.mark.parametrize("seed", [29, 31, 37])
+def test_heartbeat_merges_random_saturated_components(seed):
+    """Property over random topologies: carve a RANDOM subset into a
+    saturated clique (full views pointing only inside, empty passive,
+    severed from outside) — whatever the cast, the heartbeat isolation
+    detector merges the overlay back into one component within ~one
+    isolation window."""
+    import jax.numpy as jnp
+
+    n = 20
+    cfg = hv_config(n, seed=seed)
+    cl = Cluster(cfg)
+    st = boot_hyparview(cl)
+    rng = np.random.default_rng(seed)
+    A = st.manager.active.shape[1]
+    size = int(rng.integers(3, A + 2))      # 3..7 members
+    clique = rng.choice(np.arange(1, n), size=size, replace=False)
+    active, passive = st.manager.active, st.manager.passive
+    for nd in clique:
+        others = [int(x) for x in clique if x != nd][:A]
+        row = jnp.full((A,), -1, jnp.int32).at[:len(others)].set(
+            jnp.asarray(others, jnp.int32))
+        active = active.at[int(nd)].set(row)
+        passive = passive.at[int(nd)].set(-1)
+    in_clique = jnp.isin(active, jnp.asarray(clique))
+    outside = ~jnp.isin(jnp.arange(n), jnp.asarray(clique))
+    active = jnp.where(in_clique & outside[:, None], -1, active)
+    st = st._replace(manager=st.manager._replace(
+        active=active, passive=passive,
+        joined=st.manager.joined | True,
+        hb_rnd=jnp.full((n,), int(st.rnd), jnp.int32)))
+    assert len(components(np.asarray(st.manager.active),
+                          np.ones(n, bool))) >= 2
+    window = cfg.rounds(cfg.hyparview.isolation_window_ms)
+    st = cl.steps(st, 2 * window + 30)
+    comps = components(np.asarray(st.manager.active), np.ones(n, bool))
+    assert len(comps) == 1, f"seed {seed}: {[len(c) for c in comps]}"
