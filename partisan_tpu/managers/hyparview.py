@@ -259,19 +259,17 @@ class HyParView:
 
         def compact(ids2d, score2d, k):
             """Select up to k valid entries of ids2d[n, cap] by
-            descending score (uint32[n, cap]; 0 = invalid), as k
-            mask-and-argmax passes — cheaper than a cap-wide sort.
+            descending score (int32[n, cap] >= 0; 0 = invalid), as ONE
+            top_k.  (The previous k mask-and-argmax passes optimized
+            bytes, but the relay runtime's round cost is per-op
+            dispatch — see BENCH_NOTES.md profile — and 2k reduction
+            passes lose to one fused sort at cap=16.)
             Returns (ids int32[n, k], picked_col int32[n, k])."""
-            sc = score2d
-            ids_out, col_out = [], []
-            for _ in range(k):
-                b = jnp.argmax(sc, axis=1)
-                v = jnp.take_along_axis(sc, b[:, None], axis=1)[:, 0]
-                got = jnp.take_along_axis(ids2d, b[:, None], axis=1)[:, 0]
-                ids_out.append(jnp.where(v > 0, got, -1))
-                col_out.append(jnp.where(v > 0, b.astype(jnp.int32), -1))
-                sc = jnp.where(slot_col == b[:, None], jnp.uint32(0), sc)
-            return jnp.stack(ids_out, 1), jnp.stack(col_out, 1)
+            v, b = jax.lax.top_k(score2d, k)
+            got = jnp.take_along_axis(ids2d, b, axis=1)
+            ids = jnp.where(v > 0, got, -1)
+            col = jnp.where(v > 0, b.astype(jnp.int32), -1)
+            return ids, col
 
         # ---- 1. removals ---------------------------------------------
         disc_src = jnp.where(is_disc, src, -1)
@@ -395,12 +393,15 @@ class HyParView:
             if hv.xbot else jnp.zeros_like(is_acc))
         prio_slot = jnp.where(commit_prio, 2, 1)
         CAND = min(A, cap)
+        # int32, non-negative (top_k-compatible): prio(<=2)<<28 + 28
+        # hash bits + the validity bit stay under 2^31
         csc = jnp.where(
             cand_slot >= 0,
-            (prio_slot.astype(jnp.uint32) << 28)
-            | (ranked(_TAG_CANDSEL, gids[:, None], slot_col) >> 4)
-            | jnp.uint32(1),
-            jnp.uint32(0))
+            (prio_slot << 28)
+            | (ranked(_TAG_CANDSEL, gids[:, None], slot_col)
+               >> jnp.uint32(4)).astype(jnp.int32)
+            | 1,
+            0)
         cands, cand_col = compact(cand_slot, csc, CAND)        # [n, CAND]
         prios = jnp.where(
             cand_col >= 0,
@@ -568,8 +569,9 @@ class HyParView:
             -1)                                                # [n, cap]
         PSEL = min(A, cap)
         psc = jnp.where(pw0 >= 0,
-                        ranked(_TAG_PSEL, gids[:, None], slot_col)
-                        | jnp.uint32(1), jnp.uint32(0))
+                        (ranked(_TAG_PSEL, gids[:, None], slot_col)
+                         >> jnp.uint32(1)).astype(jnp.int32) | 1,
+                        0)
         p_slotborne, _ = compact(pw0, psc, PSEL)               # [n, PSEL]
         shr_slot = jnp.argmax(is_shr, axis=1)
         shr_any = jnp.any(is_shr, axis=1)
