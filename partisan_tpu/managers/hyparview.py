@@ -393,8 +393,10 @@ class HyParView:
             if hv.xbot else jnp.zeros_like(is_acc))
         prio_slot = jnp.where(commit_prio, 2, 1)
         CAND = min(A, cap)
-        # int32, non-negative (top_k-compatible): prio(<=2)<<28 + 28
-        # hash bits + the validity bit stay under 2^31
+        # Built int32-non-negative: prio(<=2)<<28 + 28 hash bits + the
+        # validity bit stay under 2^31.  (lax.top_k orders uint32
+        # correctly on this backend too — row_ranked/views.admit rely on
+        # that; the int32 form here just doesn't need to.)
         csc = jnp.where(
             cand_slot >= 0,
             (prio_slot << 28)

@@ -1,0 +1,201 @@
+"""partisan_gen_fsm semantics OVER THE BRIDGE.
+
+The reference ships the (deprecated, still supported) patched OTP
+gen_fsm (priv/otp/24/partisan_gen_fsm.erl, 761 LoC).  gen_fsm is the
+simpler ancestor of gen_statem: per-state event handlers, plus
+ALL-STATE events that any state handles.  This suite ports the
+representative behaviors at the semantics level over the bridge
+transport (the tests/test_bridge_gen_statem.py pattern):
+
+- send_event (async) dispatches to the CURRENT state's handler,
+- sync_send_event replies from the handler's return,
+- events unknown to the current state are DROPPED (gen_fsm semantics —
+  unlike gen_statem there is no postpone),
+- send_all_state_event reaches the all-state handler regardless of
+  state,
+- state timeout (the {next_state, S, Data, Timeout} form): fires only
+  if NO event arrives within the timeout (any event cancels it —
+  gen_fsm timeouts are event timeouts, unlike gen_statem's
+  state_timeout),
+- two clients' sync replies pair with their own refs.
+"""
+
+import pytest
+
+from support import BridgeVM, bridge_rig
+
+OP_EVENT, OP_SYNC, OP_ALL_STATE, OP_REPLY = 1, 2, 3, 4
+EV_GO, EV_WORK, EV_WHO = 1, 2, 3     # per-state events
+IDLE, BUSY = 0, 1
+FSM_TIMEOUT = 5                      # the {next_state,...,Timeout} form
+
+
+class FsmVM(BridgeVM):
+    """The partisan_gen_fsm loop: per-state handlers + all-state."""
+
+    def __init__(self, srv, sim_id, *, timeout=None):
+        super().__init__(srv, sim_id)
+        self.state = IDLE
+        self.counter = 0
+        self.all_state_log = []
+        self.timeout = timeout
+        self.deadline = None
+        self.rnd = 0
+
+    def process(self, rnd):
+        self.rnd = rnd
+        events = self.drain()
+        # gen_fsm timeout: fires only if no event arrived in the window
+        if self.deadline is not None:
+            if events:
+                self.deadline = None             # any event cancels
+            elif rnd >= self.deadline:
+                self.deadline = None
+                self.state = IDLE                # timeout handler
+        for src, words in events:
+            op, mref, ev, arg = words[0], words[1], words[2], words[3]
+            if op == OP_ALL_STATE:
+                # handle_event/3: any state (the module-wide handler)
+                self.all_state_log.append(arg)
+                continue
+            handled, reply = self._state_handler(ev, arg)
+            if op == OP_SYNC:
+                self.forward(src, [OP_REPLY, mref,
+                                   0 if handled else 1, reply])
+
+    def _state_handler(self, ev, arg):
+        """StateName/2-3 dispatch: the CURRENT state's handler only;
+        events it doesn't know are dropped (no postpone in gen_fsm)."""
+        if self.state == IDLE:
+            if ev == EV_GO:
+                self.state = BUSY
+                if self.timeout is not None:
+                    self.deadline = self.rnd + self.timeout
+                return True, BUSY
+            if ev == EV_WHO:
+                return True, IDLE * 1000 + self.counter
+            return False, 0
+        if self.state == BUSY:
+            if ev == EV_WORK:
+                self.counter += arg
+                return True, self.counter
+            if ev == EV_WHO:
+                return True, BUSY * 1000 + self.counter
+            if ev == EV_GO:
+                self.state = IDLE
+                return True, IDLE
+            return False, 0
+        return False, 0
+
+
+class FsmClient(BridgeVM):
+    def __init__(self, srv, sim_id):
+        super().__init__(srv, sim_id)
+        self._mref = sim_id * 1000
+        self.mailbox = []
+
+    def send_event(self, dst, ev, arg=0):
+        self.forward(dst, [OP_EVENT, 0, ev, arg])
+
+    def send_all_state_event(self, dst, arg):
+        self.forward(dst, [OP_ALL_STATE, 0, 0, arg])
+
+    def sync_send_event(self, fsm, ev, arg=0, timeout_steps=12):
+        self._mref += 1
+        self.forward(fsm.id, [OP_SYNC, self._mref, ev, arg])
+        for _ in range(timeout_steps):
+            fsm.process(self.step(1))
+            self.mailbox.extend(self.drain())
+            for i, (_s, words) in enumerate(self.mailbox):
+                if words[0] == OP_REPLY and words[1] == self._mref:
+                    del self.mailbox[i]
+                    return (words[2] == 0, words[3])
+        return ("timeout", fsm.id)
+
+
+@pytest.fixture()
+def rig():
+    srv = bridge_rig(4)
+    vms = []
+    try:
+        a = FsmClient(srv, 0)
+        m = FsmVM(srv, 1)
+        c = FsmClient(srv, 2)
+        vms = [a, m, c]
+        yield a, m, c
+    finally:
+        for vm in vms:
+            vm.close()
+        srv.close()
+
+
+def _pump(a, m, k=3):
+    for _ in range(k):
+        m.process(a.step(1))
+
+
+def test_send_event_dispatches_to_current_state(rig):
+    a, m, _ = rig
+    a.send_event(m.id, EV_GO)
+    _pump(a, m)
+    assert m.state == BUSY
+    a.send_event(m.id, EV_WORK, 4)
+    _pump(a, m)
+    assert m.counter == 4
+
+
+def test_sync_send_event_replies(rig):
+    a, m, _ = rig
+    assert a.sync_send_event(m, EV_GO) == (True, BUSY)
+    assert a.sync_send_event(m, EV_WORK, 7) == (True, 7)
+    assert a.sync_send_event(m, EV_WHO) == (True, 1007)
+
+
+def test_unknown_event_dropped_no_postpone(rig):
+    """EV_WORK in IDLE is dropped — NOT replayed after entering BUSY
+    (gen_fsm has no postpone; contrast test_bridge_gen_statem)."""
+    a, m, _ = rig
+    a.send_event(m.id, EV_WORK, 9)        # unknown in IDLE: dropped
+    _pump(a, m)
+    assert a.sync_send_event(m, EV_GO) == (True, BUSY)
+    _pump(a, m, 4)
+    assert a.sync_send_event(m, EV_WHO) == (True, 1000)   # counter 0
+
+
+def test_all_state_event_reaches_any_state(rig):
+    a, m, _ = rig
+    a.send_all_state_event(m.id, 11)
+    _pump(a, m)
+    a.sync_send_event(m, EV_GO)
+    a.send_all_state_event(m.id, 22)
+    _pump(a, m)
+    assert m.all_state_log == [11, 22]
+
+
+def test_fsm_timeout_fires_only_when_idle():
+    srv = bridge_rig(4)
+    try:
+        a = FsmClient(srv, 0)
+        m = FsmVM(srv, 1, timeout=FSM_TIMEOUT)
+        assert a.sync_send_event(m, EV_GO) == (True, BUSY)
+        for _ in range(FSM_TIMEOUT + 2):      # silence
+            m.process(a.step(1))
+        assert m.state == IDLE                # timeout fired
+        # …but traffic cancels it: go BUSY, keep sending events
+        assert a.sync_send_event(m, EV_GO) == (True, BUSY)
+        for _ in range(3):
+            a.send_event(m.id, EV_WORK, 1)
+            m.process(a.step(1))
+            m.process(a.step(1))
+        assert m.state == BUSY                # events kept it alive
+        a.close()
+        m.close()
+    finally:
+        srv.close()
+
+
+def test_two_clients_sync_replies_pair(rig):
+    a, m, c = rig
+    assert a.sync_send_event(m, EV_GO) == (True, BUSY)
+    assert c.sync_send_event(m, EV_WORK, 5) == (True, 5)
+    assert a.sync_send_event(m, EV_WHO) == (True, 1005)
