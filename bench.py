@@ -38,8 +38,8 @@ import numpy as np
 jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-TIME_BUDGET_S = 520.0          # hard self-imposed wall budget
-PER_SIZE_CAP_S = 300.0         # no single rung may eat the whole budget
+TIME_BUDGET_S = 560.0          # hard self-imposed wall budget
+PER_SIZE_CAP_S = 340.0         # no single rung may eat the whole budget
 
 
 def run(n: int, verbose: bool = False) -> dict:
@@ -230,13 +230,16 @@ def _run_one_subprocess(n: int, timeout_s: float) -> dict | None:
 
 
 def main() -> None:
-    # Ladder: 32k secures a scale rung, then 100k takes the rest of the
-    # budget (the 4k rung was dropped — its ~100 s starved the 100k
-    # run, which needs the full per-size cap; it remains the emergency
-    # fallback when nothing else lands).
+    # Ladder: the HEADLINE size runs FIRST with the full per-size cap —
+    # a cold-cache 100k run needs nearly all of it (compile ~137 s +
+    # bootstrap ~108 s), and any smaller rung run before it starves it.
+    # 32k is the fallback scale rung, 4k the emergency fallback.
     t_start = time.time()
     results: dict[int, dict] = {}
-    for n in (32_768, 100_000):
+    for n in (100_000, 32_768):
+        if 100_000 in results and \
+                TIME_BUDGET_S - (time.time() - t_start) < 220:
+            break    # headline landed; 32k only if it comfortably fits
         remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
         if results and remaining < 90:
             break
