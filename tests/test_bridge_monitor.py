@@ -1,0 +1,180 @@
+"""partisan_monitor semantics OVER THE BRIDGE.
+
+The reference's monitor subsystem (src/partisan_monitor.erl, 1403 LoC;
+suite test/partisan_monitor_SUITE.erl, 1510 LoC) delivers process DOWN
+and node up/down signals built on the manager's liveness callbacks.
+This suite ports the representative behaviors at the semantics level:
+a monitor process on each emulated BEAM node watches the simulated
+failure detector ({is_alive, Id} — the on_down callback source) and
+delivers the OTP-shaped signals to local subscribers:
+
+- monitor + remote crash -> ONE {'DOWN', Ref, ...} with the caller's ref,
+- demonitor flushes: no DOWN after demonitor, even for a later crash,
+- monitoring an ALREADY-dead target delivers DOWN immediately (OTP
+  monitor-of-dead semantics),
+- independent monitors on the same target each get their own DOWN,
+- DOWN is one-shot (no duplicate on continued deadness),
+- monitor_nodes: nodedown on crash, nodeup on recovery,
+- signals survive the watcher's OWN churn of other subscriptions.
+"""
+
+import pytest
+
+from support import BridgeVM, bridge_rig
+
+
+class MonitorVM(BridgeVM):
+    """One node's partisan_monitor: liveness-driven signal delivery."""
+
+    def __init__(self, srv, sim_id):
+        super().__init__(srv, sim_id)
+        self._next_ref = sim_id * 1000
+        self.monitors = {}        # ref -> target node (process monitors)
+        self.node_subs = False    # monitor_nodes flag
+        self.known = {}           # node -> last seen aliveness
+        self.signals = []         # delivered ['DOWN'/'nodedown'/'nodeup']
+
+    def monitor(self, node):
+        """partisan:monitor(process, ...) — returns the monitor ref.
+        Monitoring an already-dead target delivers DOWN immediately."""
+        self._next_ref += 1
+        ref = self._next_ref
+        if not self.is_alive(node):
+            self.signals.append(("DOWN", ref, node))
+            return ref            # one-shot: never registered
+        self.monitors[ref] = node
+        return ref
+
+    def demonitor(self, ref):
+        """demonitor + flush: the ref can never fire afterwards."""
+        self.monitors.pop(ref, None)
+        self.signals = [s for s in self.signals
+                        if not (s[0] == "DOWN" and s[1] == ref)]
+
+    def monitor_nodes(self, on=True):
+        self.node_subs = on
+
+    def process(self):
+        """One poll of the failure detector (the on_down/on_up source)."""
+        watched = set(self.monitors.values())
+        if self.node_subs:
+            watched |= set(self.known)
+        for node in sorted(watched):
+            alive = self.is_alive(node)
+            was = self.known.get(node)
+            self.known[node] = alive
+            if was is None:
+                continue           # first observation: baseline only
+            if was and not alive:
+                for ref, tgt in list(self.monitors.items()):
+                    if tgt == node:
+                        self.signals.append(("DOWN", ref, node))
+                        del self.monitors[ref]      # one-shot
+                if self.node_subs:
+                    self.signals.append(("nodedown", node))
+            elif alive and not was and self.node_subs:
+                self.signals.append(("nodeup", node))
+
+    def watch_node(self, node):
+        """Seed the liveness baseline (nodeup/nodedown subscriptions)."""
+        self.known[node] = self.is_alive(node)
+
+
+@pytest.fixture()
+def rig():
+    srv = bridge_rig(6)
+    vms = []
+    try:
+        a = MonitorVM(srv, 0)
+        vms = [a]
+        yield srv, a
+    finally:
+        for vm in vms:
+            vm.close()
+        srv.close()
+
+
+def _crash(vm, node):
+    from partisan_tpu.bridge.etf import Atom
+    assert vm.rpc((Atom("crash"), node)) == vm._etf.OK
+
+
+def _recover(vm, node):
+    from partisan_tpu.bridge.etf import Atom
+    assert vm.rpc((Atom("recover"), node)) == vm._etf.OK
+
+
+def test_monitor_delivers_down_on_crash(rig):
+    _, a = rig
+    ref = a.monitor(3)
+    a.process()
+    assert a.signals == []
+    _crash(a, 3)
+    a.step(1)
+    a.process()
+    assert a.signals == [("DOWN", ref, 3)]
+
+
+def test_demonitor_flush_prevents_down(rig):
+    _, a = rig
+    ref = a.monitor(3)
+    a.process()
+    a.demonitor(ref)
+    _crash(a, 3)
+    a.step(1)
+    a.process()
+    assert a.signals == []
+
+
+def test_monitor_of_dead_target_fires_immediately(rig):
+    _, a = rig
+    _crash(a, 4)
+    ref = a.monitor(4)
+    assert a.signals == [("DOWN", ref, 4)]
+
+
+def test_independent_monitors_each_fire(rig):
+    _, a = rig
+    r1 = a.monitor(3)
+    r2 = a.monitor(3)
+    a.process()
+    _crash(a, 3)
+    a.step(1)
+    a.process()
+    assert sorted(a.signals) == sorted([("DOWN", r1, 3), ("DOWN", r2, 3)])
+
+
+def test_down_is_one_shot(rig):
+    _, a = rig
+    a.monitor(3)
+    a.process()
+    _crash(a, 3)
+    a.step(1)
+    for _ in range(4):
+        a.process()               # continued deadness: no duplicates
+    assert len(a.signals) == 1
+
+
+def test_monitor_nodes_down_and_up(rig):
+    _, a = rig
+    a.monitor_nodes(True)
+    a.watch_node(2)
+    _crash(a, 2)
+    a.step(1)
+    a.process()
+    assert ("nodedown", 2) in a.signals
+    _recover(a, 2)
+    a.step(1)
+    a.process()
+    assert ("nodeup", 2) in a.signals
+
+
+def test_signals_survive_other_subscription_churn(rig):
+    _, a = rig
+    refs = [a.monitor(i) for i in (2, 3, 4)]
+    a.process()
+    a.demonitor(refs[0])          # churn an unrelated subscription
+    _crash(a, 3)
+    a.step(1)
+    a.process()
+    assert a.signals == [("DOWN", refs[1], 3)]
