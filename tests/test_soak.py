@@ -95,3 +95,58 @@ def test_soak_500_rounds_mixed_faults():
     st, slot = heal_and_check(st, slot, "after churn")
 
     assert int(st.rnd) >= 500, int(st.rnd)
+
+
+def test_soak_p2p_streams_under_crash_recovery_cycles():
+    """Delivery-plane soak: long-horizon p2p-causal streams while their
+    receivers repeatedly crash and recover.  Across every cycle the
+    per-edge guarantee must hold: each receiver's log is duplicate-free
+    and per-sender FIFO (crash windows may drop in-flight sends — the
+    reference's causality backend loses what a dead node never stored —
+    but nothing may be reordered or delivered twice)."""
+    from partisan_tpu.config import Config
+    from partisan_tpu.models.p2p_chat import P2PChat
+
+    n = 32
+    cfg = Config(n_nodes=n, seed=31, causal_p2p_labels=("chat",),
+                 peer_service_manager="static")
+    model = P2PChat()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    rng = np.random.default_rng(17)
+    senders = [1, 2, 3]
+    receivers = [20, 21, 22]
+
+    for cycle in range(4):
+        # each sender fires two messages at its receiver this cycle
+        m = st.model
+        base = int(st.rnd)
+        for i, s in enumerate(senders):
+            m = model.schedule(m, node=s, rnd=base + 2, dst=receivers[i],
+                               now=base + 1)
+            m = model.schedule(m, node=s, rnd=base + 5, dst=receivers[i],
+                               now=base + 1)
+        st = st._replace(model=m)
+        # crash one receiver mid-stream, then recover it
+        victim = receivers[cycle % len(receivers)]
+        st = cl.steps(st, 3)
+        st = st._replace(faults=faults_mod.crash(st.faults, victim))
+        st = cl.steps(st, 4)
+        st = st._replace(faults=faults_mod.recover(st.faults, victim))
+        st = cl.steps(st, cfg.retransmit_every * 6 + 6)
+
+    logs = P2PChat.logs(st.model)
+    delivered = 0
+    for r in receivers:
+        log = logs[r]
+        assert len(log) == len(set(log)), f"node {r} duplicates: {log}"
+        per_src = {}
+        for t in log:
+            per_src.setdefault(t // P2PChat.K, []).append(t % P2PChat.K)
+        for src, seqs in per_src.items():
+            assert seqs == sorted(seqs), \
+                f"node {r} reordered stream from {src}: {seqs}"
+        delivered += len(log)
+    # the never-crashed cycles must deliver fully: at least half of all
+    # sends land even with one receiver down per cycle
+    assert delivered >= 12, f"only {delivered} of 24 sends delivered"
