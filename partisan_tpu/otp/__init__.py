@@ -15,6 +15,27 @@ this package provides the runtime services around them:
   subscriptions with DOWN-signal delivery (partisan_monitor)
 - :mod:`partisan_tpu.otp.remote_ref` — encoded node-qualified refs
   (partisan_remote_ref's three wire formats)
+
+and the drop-in behaviour layer (the priv/otp/24 patched-OTP family),
+usable from the bridge (any transport satisfying
+:class:`partisan_tpu.otp.gen.Port`) and in-sim:
+
+- :mod:`partisan_tpu.otp.gen`        — the partisan_gen call protocol:
+  opcodes, Mref pairing, timeout-demonitor + stale-reply discard,
+  monitor/DOWN abort (partisan_gen.erl:360-400)
+- :mod:`partisan_tpu.otp.gen_server` — the server loop + callback module
+- :mod:`partisan_tpu.otp.gen_statem` — postpone / state_timeout /
+  event-timeout event loop
+- :mod:`partisan_tpu.otp.gen_event`  — handler list, notify/sync_notify,
+  crash isolation, swap
+- :mod:`partisan_tpu.otp.gen_fsm`    — per-state dispatch, all-state
+  events, the {next_state,...,Timeout} form
+- :mod:`partisan_tpu.otp.supervisor` — cross-node supervision:
+  strategies, restart intensity, restart types, admin API
+- :mod:`partisan_tpu.otp.gen_sim`    — the call protocol vectorized on
+  the node axis (one gen_server per node inside the jitted round)
 """
 
-from partisan_tpu.otp import monitor, remote_ref, rpc  # noqa: F401
+from partisan_tpu.otp import (  # noqa: F401
+    gen, gen_event, gen_fsm, gen_server, gen_sim, gen_statem, monitor,
+    remote_ref, rpc, supervisor)

@@ -1,0 +1,233 @@
+"""The partisan_gen call protocol (reference priv/otp/24/partisan_gen.erl).
+
+The reference patches OTP's ``gen`` so every remote interaction rides
+``partisan:forward_message``: a call is ``{'$gen_call', {Self, Mref},
+Req}`` guarded by a monitor; the reply is ``{Mref, Reply}``; a timeout
+demonitors the ref and any reply that later arrives for it is silently
+discarded; a DOWN for the monitored destination aborts the call
+(partisan_gen.erl:360-400).
+
+This module is that protocol as reusable machines over any *port* — an
+endpoint with ``forward(dst, words)`` / ``drain() -> [(src, words)]`` /
+``step(k) -> round`` / ``is_alive(node)``.  The bridge's emulated-VM
+connection (tests/support.BridgeVM) is a port; so is anything else that
+can move word-vector messages between nodes.  The behaviours layered on
+top (gen_server / gen_statem / gen_event / gen_fsm / supervisor — the
+sibling modules) share this wire vocabulary; to stack several services
+on ONE node the way a BEAM node registers several processes, wrap the
+port in a :class:`Mux` and attach each behaviour with the opcodes it
+consumes (tests/test_bridge_gen_server.py::test_mux_stacks...).
+
+Wire format: word-vector control tuples ``[op, mref, a, b]`` — the
+symbol-table-free small-term encoding a bridge-attached partisan_gen
+uses for its control messages.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+
+class Port(Protocol):
+    """A node endpoint on the message transport (the process's view of
+    ``partisan:forward_message`` + its mailbox)."""
+
+    id: int
+
+    def forward(self, dst: int, words: Sequence[int]) -> None:
+        ...
+
+    def drain(self) -> list:
+        """[(src, words)] in per-sender FIFO arrival order."""
+        ...
+
+    def step(self, k: int = 1) -> int:
+        """Advance the cluster k rounds; returns the new round."""
+        ...
+
+    def is_alive(self, node: int) -> bool:
+        ...
+
+
+# -- canonical opcode registry (one vocabulary for every behaviour) -----
+OP_CALL = 1         # {'$gen_call', {Self, Mref}, Req}
+OP_REPLY = 2        # {Mref, Reply}
+OP_CAST = 3         # {'$gen_cast', Req}
+OP_EVENT = 4        # gen_statem/gen_fsm async event
+OP_ALL_STATE = 5    # gen_fsm send_all_state_event
+OP_NOTIFY = 6       # gen_event notify (fire-and-forget)
+OP_SYNC_NOTIFY = 7  # gen_event sync_notify (replies when handlers ran)
+OP_START = 10       # supervisor -> child host: start child
+OP_STOP = 11        # supervisor -> child host: stop child
+OP_EXIT = 12        # child host -> supervisor: EXIT/DOWN report
+
+
+class Mux:
+    """Demultiplex one port's mailbox across several behaviours on the
+    same node — the registered-process table of a BEAM node.
+
+    Each behaviour attaches with the opcode set it consumes
+    (:meth:`attach`); draining any sub-port pumps the shared mailbox
+    and routes each message to the FIRST attached sub-port claiming its
+    opcode (so two consumers of the same opcode on one node need their
+    own addressing, exactly as two gen_servers need distinct
+    ServerRefs).  Messages no sub-port claims are dropped, like sends
+    to an unregistered name.
+    """
+
+    def __init__(self, port: Port) -> None:
+        self.port = port
+        self._subs: list[_SubPort] = []
+
+    def attach(self, *ops: int) -> "_SubPort":
+        sub = _SubPort(self, frozenset(ops))
+        self._subs.append(sub)
+        return sub
+
+    def pump(self) -> None:
+        for src, words in self.port.drain():
+            op = words[0] if words else -1
+            for sub in self._subs:
+                if op in sub.ops:
+                    sub.buf.append((src, words))
+                    break
+
+    def close(self) -> None:
+        close = getattr(self.port, "close", None)
+        if close is not None:
+            close()
+
+
+class _SubPort:
+    """One behaviour's view of a muxed port (itself a Port)."""
+
+    def __init__(self, mux: Mux, ops: frozenset) -> None:
+        self.mux = mux
+        self.ops = ops
+        self.buf: list = []
+        self.id = mux.port.id
+
+    def forward(self, dst: int, words: Sequence[int]) -> None:
+        self.mux.port.forward(dst, list(words))
+
+    def drain(self) -> list:
+        self.mux.pump()
+        out = self.buf[:]
+        self.buf.clear()
+        return out
+
+    def step(self, k: int = 1) -> int:
+        return self.mux.port.step(k)
+
+    def is_alive(self, node: int) -> bool:
+        return self.mux.port.is_alive(node)
+
+    def close(self) -> None:
+        pass        # the Mux owner closes the underlying port
+
+
+class Proc:
+    """Base for one protocol process bound to a port."""
+
+    def __init__(self, port: Port) -> None:
+        self.port = port
+        self.id = port.id
+
+    def forward(self, dst: int, words: Sequence[int]) -> None:
+        self.port.forward(dst, list(words))
+
+    def drain(self) -> list:
+        return self.port.drain()
+
+    def step(self, k: int = 1) -> int:
+        return self.port.step(k)
+
+    def is_alive(self, node: int) -> bool:
+        return self.port.is_alive(node)
+
+    def close(self) -> None:
+        close = getattr(self.port, "close", None)
+        if close is not None:
+            close()
+
+
+def reply(proc: Proc, src: int, mref: int, ok: bool, value: int) -> None:
+    """partisan_gen:reply — ``{Mref, Reply}`` back to the caller
+    (partisan_gen.erl:475)."""
+    proc.forward(src, [OP_REPLY, mref, 0 if ok else 1, value])
+
+
+class Caller(Proc):
+    """The partisan_gen:call client loop.
+
+    Covers the remote-call path of partisan_gen.erl:360-400: per-caller
+    unique Mrefs, reply pairing, timeout-demonitor with stale-reply
+    discard, and the monitor/DOWN abort when the destination dies
+    mid-call (liveness observed through the manager, the way
+    partisan_monitor turns nodedown into DOWN signals).
+    """
+
+    def __init__(self, port: Port) -> None:
+        super().__init__(port)
+        self._mref = port.id * 1000
+        self._stale: set[int] = set()
+        self.mailbox: list = []
+
+    # -- send side ------------------------------------------------------
+    def send_call(self, dst: int, fn: int, arg: int = 0, *,
+                  op: int = OP_CALL) -> int:
+        """Emit the call message; returns its Mref (await via poll)."""
+        self._mref += 1
+        self.forward(dst, [op, self._mref, fn, arg])
+        return self._mref
+
+    def cast(self, dst: int, fn: int, arg: int = 0) -> None:
+        self.forward(dst, [OP_CAST, 0, fn, arg])
+
+    def event(self, dst: int, ev: int, arg: int = 0) -> None:
+        """gen_statem/gen_fsm fire-and-forget event."""
+        self.forward(dst, [OP_EVENT, 0, ev, arg])
+
+    # -- receive side ---------------------------------------------------
+    def poll(self, mref: int):
+        """One receive pass: (ok, value) for the ref, else None.  Replies
+        to demonitored (timed-out) refs are discarded on sight — the
+        stale-reply rule."""
+        self.mailbox.extend(self.drain())
+        for i, (_src, words) in enumerate(self.mailbox):
+            if words[0] == OP_REPLY and words[1] == mref:
+                del self.mailbox[i]
+                return (words[2] == 0, words[3])
+            if words[0] == OP_REPLY and words[1] in self._stale:
+                del self.mailbox[i]
+                return self.poll(mref)
+        return None
+
+    def call(self, dst: int, fn: int, arg: int = 0, *, pump=None,
+             timeout_steps: int = 12, monitor: bool = False,
+             op: int = OP_CALL):
+        """Send + await ``{Mref, Reply}``.
+
+        ``pump``: optional callable run after each transport step — the
+        scheduler pass that lets server processes on other VMs execute
+        (test rigs pass the server's ``process``).  A timeout demonitors
+        and marks the ref stale; with ``monitor``, destination death
+        aborts with ``("DOWN", dst)`` instead of hanging until timeout.
+        """
+        mref = self.send_call(dst, fn, arg, op=op)
+        for _ in range(timeout_steps):
+            rnd = self.step(1)
+            if pump is not None:
+                pump(rnd)
+            got = self.poll(mref)
+            if got is not None:
+                return got
+            if monitor and not self.is_alive(dst):
+                self._stale.add(mref)
+                return ("DOWN", dst)
+        self._stale.add(mref)
+        return ("timeout", dst)
+
+    def mark_stale(self, mref: int) -> None:
+        """Demonitor a ref by hand (what a caller-side timeout does)."""
+        self._stale.add(mref)

@@ -1,0 +1,108 @@
+"""partisan_gen_event: the event-manager loop (reference
+priv/otp/24/partisan_gen_event.erl, 1014 LoC).
+
+One :class:`GenEvent` process owns an ordered list of installed
+handlers (test/partisan_gen_event_SUITE.erl semantics):
+
+- handlers receive events in ADD order, each with independent state,
+- ``notify`` is fire-and-forget; ``sync_notify`` replies only after
+  every handler ran,
+- ``call`` targets ONE handler by id and returns its reply,
+- ``delete_handler`` stops delivery to that handler and returns its
+  final state (the terminate/2 result),
+- a handler that crashes on an event is removed silently; the remaining
+  handlers keep running (gen_event isolation),
+- ``swap_handler`` atomically replaces a handler, seeding the new one
+  with the old one's state.
+
+Client side: :class:`Notifier`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from partisan_tpu.otp import gen
+
+
+class Handler:
+    """One installed handler: integer state plus an event log.  Override
+    :meth:`handle` for custom behavior; raising removes the handler."""
+
+    def __init__(self, hid: int, state: int = 0) -> None:
+        self.id = hid
+        self.state = state
+        self.events: list[int] = []
+
+    def handle(self, ev: int, arg: int) -> None:
+        self.state += arg
+        self.events.append(arg)
+
+
+class GenEvent(gen.Proc):
+    """The event-manager process."""
+
+    def __init__(self, port: gen.Port) -> None:
+        super().__init__(port)
+        self.handlers: list[Handler] = []
+
+    # -- handler management (gen_event:add_handler etc.) ---------------
+    def add_handler(self, handler: Handler) -> None:
+        self.handlers.append(handler)
+
+    def delete_handler(self, hid: int) -> Optional[int]:
+        for h in list(self.handlers):
+            if h.id == hid:
+                self.handlers.remove(h)
+                return h.state          # terminate/2 returns the state
+        return None
+
+    def swap_handler(self, old_hid: int, new_handler_cls, new_hid: int
+                     ) -> bool:
+        """The new handler is seeded with the old one's terminate result
+        (OTP swap semantics), atomically in place."""
+        for i, h in enumerate(self.handlers):
+            if h.id == old_hid:
+                self.handlers[i] = new_handler_cls(new_hid, h.state)
+                return True
+        return False
+
+    # -- the manager loop ----------------------------------------------
+    def process(self, _rnd: int = 0) -> None:
+        for src, words in self.drain():
+            op, mref, ev, arg = words[0], words[1], words[2], words[3]
+            if op in (gen.OP_NOTIFY, gen.OP_SYNC_NOTIFY):
+                for h in list(self.handlers):
+                    try:
+                        h.handle(ev, arg)
+                    except Exception:
+                        # a crashing handler is removed; others continue
+                        self.handlers.remove(h)
+                if op == gen.OP_SYNC_NOTIFY:
+                    gen.reply(self, src, mref, True, 0)
+            elif op == gen.OP_CALL:
+                # call/2: ev carries the TARGET handler id
+                for h in self.handlers:
+                    if h.id == ev:
+                        gen.reply(self, src, mref, True, h.state)
+                        break
+                else:
+                    gen.reply(self, src, mref, False, 0)
+
+
+class Notifier(gen.Caller):
+    """Client API: notify / sync_notify / call against a manager."""
+
+    def notify(self, mgr_id: int, ev: int, arg: int) -> None:
+        self.forward(mgr_id, [gen.OP_NOTIFY, 0, ev, arg])
+
+    def sync_notify(self, mgr: GenEvent, ev: int, arg: int,
+                    timeout_steps: int = 12):
+        return self.call(mgr.id, ev, arg, pump=mgr.process,
+                         timeout_steps=timeout_steps,
+                         op=gen.OP_SYNC_NOTIFY)
+
+    def call_handler(self, mgr: GenEvent, hid: int,
+                     timeout_steps: int = 12):
+        return self.call(mgr.id, hid, 0, pump=mgr.process,
+                         timeout_steps=timeout_steps)

@@ -1,0 +1,109 @@
+"""partisan_gen_fsm: the deprecated-but-shipped fsm loop (reference
+priv/otp/24/partisan_gen_fsm.erl, 761 LoC).
+
+gen_fsm is gen_statem's simpler ancestor: per-state event handlers plus
+ALL-STATE events any state handles.  Loop semantics owned here:
+
+- ``send_event`` dispatches to the CURRENT state's handler,
+- ``sync_send_event`` replies from the handler's return,
+- events unknown to the current state are DROPPED (no postpone — the
+  gen_statem contrast),
+- ``send_all_state_event`` reaches the all-state handler regardless of
+  state,
+- the ``{next_state, S, Data, Timeout}`` form: an *event* timeout that
+  fires only if NO event arrives within the window (any event cancels
+  it), delivered to the module as ``EV_TIMEOUT``.
+
+The module supplies ``state_handler(state, ev, arg) -> Outcome`` and
+``handle_all_state(arg)``; client side is
+:class:`partisan_tpu.otp.gen.Caller` (``event``/``call`` with
+``op=OP_EVENT``/``OP_CALL`` replaced by the fsm opcodes below).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Protocol
+
+from partisan_tpu.otp import gen
+
+EV_TIMEOUT = -1        # internal: the {next_state,...,Timeout} firing
+
+
+class Outcome(NamedTuple):
+    """state_handler return.  ``handled=False`` drops the event (and
+    error-replies a sync call); ``timeout`` arms the event timer when
+    transitioning (the {next_state, S, D, Timeout} form)."""
+
+    handled: bool
+    reply: int = 0
+    next_state: Optional[int] = None
+    timeout: Optional[int] = None
+
+
+class Module(Protocol):
+    init_state: int
+
+    def state_handler(self, state: int, ev: int, arg: int) -> Outcome:
+        ...
+
+    def handle_all_state(self, arg: int) -> None:
+        ...
+
+
+class GenFsm(gen.Proc):
+    def __init__(self, port: gen.Port, module: Module) -> None:
+        super().__init__(port)
+        self.module = module
+        self.state = module.init_state
+        self.deadline: Optional[int] = None
+        self.rnd = 0
+
+    def process(self, rnd: int) -> None:
+        self.rnd = rnd
+        events = self.drain()
+        # gen_fsm timeout: fires only if no event arrived in the window
+        if self.deadline is not None:
+            if events:
+                self.deadline = None            # any event cancels
+            elif rnd >= self.deadline:
+                self.deadline = None
+                self._apply(self.module.state_handler(
+                    self.state, EV_TIMEOUT, 0))
+        for src, words in events:
+            # consuming ANY event cancels the pending timeout — including
+            # one armed by an earlier event of this same batch
+            self.deadline = None
+            op, mref, ev, arg = words[0], words[1], words[2], words[3]
+            if op == gen.OP_ALL_STATE:
+                # handle_event/3: any state (the module-wide handler)
+                self.module.handle_all_state(arg)
+                continue
+            if op not in (gen.OP_EVENT, gen.OP_CALL):
+                continue
+            out = self.module.state_handler(self.state, ev, arg)
+            self._apply(out)
+            if op == gen.OP_CALL:
+                gen.reply(self, src, mref, out.handled, out.reply)
+
+    def _apply(self, out: Outcome) -> None:
+        if not out.handled:
+            return                              # dropped, no postpone
+        if out.next_state is not None:
+            self.state = out.next_state
+            if out.timeout is not None:
+                self.deadline = self.rnd + out.timeout
+
+
+class FsmClient(gen.Caller):
+    """gen_fsm client API over the shared Caller machinery."""
+
+    def send_event(self, dst: int, ev: int, arg: int = 0) -> None:
+        self.event(dst, ev, arg)
+
+    def send_all_state_event(self, dst: int, arg: int) -> None:
+        self.forward(dst, [gen.OP_ALL_STATE, 0, 0, arg])
+
+    def sync_send_event(self, fsm: GenFsm, ev: int, arg: int = 0,
+                        timeout_steps: int = 12):
+        return self.call(fsm.id, ev, arg, pump=fsm.process,
+                         timeout_steps=timeout_steps)

@@ -1,0 +1,63 @@
+"""partisan_gen_server: the server-side loop (reference
+priv/otp/24/partisan_gen_server.erl, 1360 LoC).
+
+A :class:`GenServer` runs one server process on a port: it drains the
+mailbox each scheduler pass and dispatches ``{'$gen_call', {Self, Mref},
+Req}`` / ``{'$gen_cast', Req}`` control messages to a user *module* —
+the handle_call/handle_cast callback object — pairing every reply with
+its caller's Mref (the partisan_gen call protocol, partisan_gen.erl
+:360-400).  ``Stop`` from a callback terminates the server: the stop
+request itself is replied to, then all further messages are ignored
+(the dead-process behavior the suite's stopped-server case checks).
+
+The client side is :class:`partisan_tpu.otp.gen.Caller`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Protocol
+
+from partisan_tpu.otp import gen
+
+
+class Stop(NamedTuple):
+    """handle_call return: reply, then terminate the server."""
+
+    ok: bool = True
+    value: int = 0
+
+
+class Module(Protocol):
+    """The gen_server callback module."""
+
+    def handle_call(self, fn: int, arg: int, src: int):
+        """-> (ok, value) reply, or Stop(ok, value) to terminate."""
+        ...
+
+    def handle_cast(self, fn: int, arg: int, src: int) -> None:
+        ...
+
+
+class GenServer(gen.Proc):
+    def __init__(self, port: gen.Port, module: Module) -> None:
+        super().__init__(port)
+        self.module = module
+        self.stopped = False
+
+    def process(self, _rnd: int = 0) -> None:
+        """One scheduler pass of the server process."""
+        for src, words in self.drain():
+            if self.stopped:
+                continue
+            op = words[0]
+            if op == gen.OP_CALL:
+                mref, fn, arg = words[1], words[2], words[3]
+                out = self.module.handle_call(fn, arg, src)
+                if isinstance(out, Stop):
+                    self.stopped = True
+                    gen.reply(self, src, mref, out.ok, out.value)
+                else:
+                    ok, value = out
+                    gen.reply(self, src, mref, ok, value)
+            elif op == gen.OP_CAST:
+                self.module.handle_cast(words[2], words[3], src)

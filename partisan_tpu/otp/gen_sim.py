@@ -1,0 +1,210 @@
+"""In-sim vectorized gen_server: every node hosts a server process and
+a call table, all stepped under one jitted round.
+
+This is the partisan_gen call protocol (priv/otp/24/partisan_gen.erl
+:360-400) transposed onto the node axis: calls are ``GEN_CALL`` records
+``{fn, arg, mref}`` on the wire; the server side applies requests *in
+mailbox arrival order* (gen_server serialization — a prefix-scan gives
+each call the counter value as of its position in the queue); replies
+are ``GEN_REPLY {result, mref}`` paired by ref.  A caller-side timeout
+demonitors the ref (late replies no longer match a WAITING slot — the
+stale-reply discard); a WAITING call whose destination is dead aborts
+with DOWN (the monitor path: partisan_monitor turning nodedown into a
+DOWN signal).
+
+The stock server is the conformance suites' counter machine:
+``FN_INCR`` adds and replies the post-application value, ``FN_GET``
+reads, ``FN_STOP`` terminates the server (further requests are never
+answered — callers time out, the stopped-server behavior).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+# call-table slot status
+IDLE, QUEUED, WAITING, OK, TIMEOUT, DOWN = 0, 1, 2, 3, 4, 5
+# server functions
+FN_INCR, FN_GET, FN_STOP = 1, 2, 3
+
+
+class GenSimState(NamedTuple):
+    # server side (one gen_server per node)
+    counter: Array    # int32[n_local]
+    stopped: Array    # bool[n_local]
+    # caller side (per-node call table)
+    status: Array     # int32[n_local, C]
+    dst: Array        # int32[n_local, C]
+    fn: Array         # int32[n_local, C]
+    arg: Array        # int32[n_local, C]
+    ref: Array        # int32[n_local, C]
+    deadline: Array   # int32[n_local, C]
+    result: Array     # int32[n_local, C]
+    next_ref: Array   # int32[n_local]
+
+
+class GenServerService:
+    """Stackable model: the counter gen_server + its call client."""
+
+    name = "gen_server"
+
+    def __init__(self, cap: int = 8) -> None:
+        self.cap = cap
+
+    def init(self, cfg: Config, comm: LocalComm) -> GenSimState:
+        n, c = comm.n_local, self.cap
+        zi = jnp.zeros((n, c), jnp.int32)
+        return GenSimState(
+            counter=jnp.zeros((n,), jnp.int32),
+            stopped=jnp.zeros((n,), jnp.bool_),
+            status=zi, dst=zi, fn=zi, arg=zi, ref=zi, deadline=zi,
+            result=zi, next_ref=jnp.ones((n,), jnp.int32))
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, st: GenSimState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[GenSimState, Array]:
+        n, c = st.status.shape
+        gids = comm.local_ids()
+        alive = ctx.alive
+        inb = ctx.inbox.data
+
+        # ---- server: apply requests in mailbox order -------------------
+        serving = alive & ~st.stopped
+        m_call = (inb[..., T.W_KIND] == T.MsgKind.GEN_CALL) \
+            & serving[:, None]
+        m_cast = (inb[..., T.W_KIND] == T.MsgKind.GEN_CAST) \
+            & serving[:, None]
+        fn_w = inb[..., T.P0]
+        arg_w = inb[..., T.P1]
+        ref_w = inb[..., T.P2]
+
+        # A stop anywhere in the queue: requests AFTER it (inbox order)
+        # go unserved — the server is gone by the time they'd dispatch.
+        is_stop = m_call & (fn_w == FN_STOP)
+        stop_before = jnp.cumsum(is_stop, axis=1) - is_stop  # exclusive
+        served = (m_call | m_cast) & (stop_before == 0)
+        m_call = m_call & (stop_before == 0)
+
+        incr = served & (fn_w == FN_INCR)
+        inc_prefix = jnp.cumsum(jnp.where(incr, arg_w, 0), axis=1)
+        counter = st.counter + jnp.sum(
+            jnp.where(incr, arg_w, 0), axis=1, dtype=jnp.int32)
+        # reply value as of this call's queue position: incr sees the
+        # inclusive prefix, get the exclusive one
+        val_incr = st.counter[:, None] + inc_prefix
+        val_get = st.counter[:, None] + (inc_prefix
+                                         - jnp.where(incr, arg_w, 0))
+        res = jnp.where(fn_w == FN_INCR, val_incr, val_get)
+        res = jnp.where(fn_w == FN_STOP, 0, res)
+        stopped = st.stopped | (alive & is_stop.any(axis=1))
+
+        resp_dst = jnp.where(m_call & (ref_w > 0), inb[..., T.W_SRC], -1)
+        resp = msg_ops.build(
+            cfg.msg_words, T.MsgKind.GEN_REPLY, gids[:, None], resp_dst,
+            payload=(res, ref_w))
+
+        # ---- caller: pair replies with WAITING refs --------------------
+        m_resp = (inb[..., T.W_KIND] == T.MsgKind.GEN_REPLY) \
+            & alive[:, None]
+        ref_eq = (inb[..., T.P1][:, :, None] == st.ref[:, None, :]) \
+            & m_resp[:, :, None] & (st.status == WAITING)[:, None, :]
+        got = ref_eq.any(axis=1)
+        val = jnp.max(jnp.where(ref_eq, inb[..., T.P0][:, :, None],
+                                jnp.iinfo(jnp.int32).min), axis=1)
+        status = jnp.where(got, OK, st.status)
+        result = jnp.where(got, val, st.result)
+
+        # ---- monitor DOWN: destination died while WAITING --------------
+        dst_alive = ctx.faults.alive[jnp.clip(st.dst, 0,
+                                              comm.n_global - 1)]
+        died = (status == WAITING) & ~dst_alive
+        status = jnp.where(died, DOWN, status)
+
+        # ---- timeout: demonitor (stale replies can't match) ------------
+        expired = (status == WAITING) & (ctx.rnd >= st.deadline)
+        status = jnp.where(expired, TIMEOUT, status)
+
+        # ---- emit queued requests --------------------------------------
+        fire = (status == QUEUED) & alive[:, None]
+        req = msg_ops.build(
+            cfg.msg_words, jnp.where(st.ref > 0, T.MsgKind.GEN_CALL,
+                                     T.MsgKind.GEN_CAST),
+            gids[:, None], jnp.where(fire, st.dst, -1),
+            payload=(st.fn, st.arg, st.ref))
+        status = jnp.where(fire, jnp.where(st.ref > 0, WAITING, IDLE),
+                           status)
+
+        emitted = jnp.concatenate([resp, req], axis=1)
+        return st._replace(counter=counter, stopped=stopped,
+                           status=status, result=result), emitted
+
+    # ---- host-side API (the partisan_gen_server:call surface) ---------
+    @staticmethod
+    def _alloc(st: GenSimState, caller: int, dst: int, fn: int, arg: int,
+               ref: int, deadline: int) -> GenSimState:
+        import numpy as np
+
+        free = np.flatnonzero(np.asarray(st.status[caller]) == IDLE)
+        if free.size == 0:
+            raise RuntimeError(f"call table full on node {caller}")
+        slot = int(free[0])
+        return st._replace(
+            status=st.status.at[caller, slot].set(QUEUED),
+            dst=st.dst.at[caller, slot].set(dst),
+            fn=st.fn.at[caller, slot].set(fn),
+            arg=st.arg.at[caller, slot].set(arg),
+            ref=st.ref.at[caller, slot].set(ref),
+            deadline=st.deadline.at[caller, slot].set(deadline),
+            result=st.result.at[caller, slot].set(0),
+        )
+
+    def call(self, st: GenSimState, caller: int, dst: int, fn: int,
+             arg: int, timeout_rounds: int, now: int
+             ) -> tuple[GenSimState, int]:
+        ref = int(st.next_ref[caller])
+        st = self._alloc(st, caller, dst, fn, arg, ref,
+                         now + timeout_rounds)
+        return st._replace(next_ref=st.next_ref.at[caller].add(1)), ref
+
+    def cast(self, st: GenSimState, caller: int, dst: int, fn: int,
+             arg: int) -> GenSimState:
+        return self._alloc(st, caller, dst, fn, arg, 0, 0)
+
+    def response(self, st: GenSimState, caller: int, ref: int
+                 ) -> tuple[str, int | None]:
+        """('ok', value) | ('timeout', None) | ('down', None) |
+        ('waiting', None)."""
+        import numpy as np
+
+        refs = np.asarray(st.ref[caller])
+        stats = np.asarray(st.status[caller])
+        hit = np.flatnonzero((refs == ref) & (stats != IDLE))
+        if hit.size == 0:
+            return "waiting", None
+        s = int(stats[hit[0]])
+        if s == OK:
+            return "ok", int(st.result[caller, int(hit[0])])
+        if s == TIMEOUT:
+            return "timeout", None
+        if s == DOWN:
+            return "down", None
+        return "waiting", None
+
+    def free(self, st: GenSimState, caller: int, ref: int) -> GenSimState:
+        import numpy as np
+
+        refs = np.asarray(st.ref[caller])
+        hit = np.flatnonzero(refs == ref)
+        if hit.size == 0:
+            return st
+        return st._replace(
+            status=st.status.at[caller, int(hit[0])].set(IDLE))

@@ -1,0 +1,146 @@
+"""partisan_gen_statem: the statem event loop (reference
+priv/otp/24/partisan_gen_statem.erl, 3008 LoC).
+
+The package owns the loop semantics the reference suite exercises
+(test/partisan_gen_statem_SUITE.erl):
+
+- events dispatch to a user module's ``handle_event``; a call's reply
+  rides the Mref pairing of the gen protocol,
+- POSTPONE: events postponed in a state are replayed — in original
+  arrival order, ahead of newer events — when the state changes,
+- STATE timeout: armed on entering a state (module-declared per-state),
+  NOT cancelled by event arrival, cancelled by a state transition,
+- EVENT timeout: armed by an action, cancelled by ANY event arrival.
+
+Timeouts fire as *internal events* (``EV_STATE_TIMEOUT`` /
+``EV_EVENT_TIMEOUT``) delivered to the same ``handle_event`` — the OTP
+shape, where a timeout is just another event the module handles.
+
+The module returns a :class:`Result` action: transition (or keep_state),
+an optional reply for calls, postpone, and an optional event-timeout
+arm.  Client side: :class:`partisan_tpu.otp.gen.Caller` (use
+``op=gen.OP_EVENT`` via ``Caller.event`` for async events).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Protocol
+
+from partisan_tpu.otp import gen
+
+# internal events (negative so they never collide with wire event codes)
+EV_STATE_TIMEOUT = -1
+EV_EVENT_TIMEOUT = -2
+
+
+class Result(NamedTuple):
+    """Action returned by ``handle_event``.
+
+    ``next_state=None`` is keep_state; ``reply`` answers a call (with
+    ``error`` flagging an error reply); ``postpone`` re-queues the event
+    until the next state change; ``event_timeout`` arms the idle timer.
+    """
+
+    next_state: Optional[int] = None
+    reply: Optional[int] = None
+    error: bool = False
+    postpone: bool = False
+    event_timeout: Optional[int] = None
+
+
+class Module(Protocol):
+    init_state: int
+
+    def handle_event(self, state: int, ev: int, arg: int,
+                     is_call: bool) -> Result:
+        ...
+
+    def state_timeout(self, state: int) -> Optional[int]:
+        """Rounds of state_timeout armed on ENTERING ``state`` (None =
+        no timer).  Optional — absence means no state timeouts."""
+        ...
+
+
+class GenStatem(gen.Proc):
+    def __init__(self, port: gen.Port, module: Module) -> None:
+        super().__init__(port)
+        self.module = module
+        self.state = module.init_state
+        self.postponed: list = []       # [(src, words)] in arrival order
+        self.state_deadline: Optional[int] = None
+        self.event_deadline: Optional[int] = None
+        self.rnd = 0
+        self._started = False           # initial state_timeout pending
+
+    # -- the gen_statem event loop -------------------------------------
+    def process(self, rnd: int) -> None:
+        self.rnd = rnd
+        if not self._started:
+            # entering the INITIAL state arms its state_timeout too
+            self._started = True
+            self._arm_state_timeout()
+        queue = list(self.drain())
+        # Timer events fire BEFORE new external events if their deadline
+        # passed (the timer message was already "sent").
+        if self.state_deadline is not None and rnd >= self.state_deadline:
+            self.state_deadline = None
+            if self._dispatch_internal(EV_STATE_TIMEOUT):
+                queue = self.postponed + queue
+                self.postponed = []
+        if self.event_deadline is not None:
+            if queue:
+                self.event_deadline = None      # any event cancels it
+            elif rnd >= self.event_deadline:
+                self.event_deadline = None
+                if self._dispatch_internal(EV_EVENT_TIMEOUT):
+                    queue = self.postponed + queue
+                    self.postponed = []
+        while queue:
+            src, words = queue.pop(0)
+            # consuming ANY event cancels a pending event timeout —
+            # including one armed by an earlier event of this batch
+            self.event_deadline = None
+            changed = self._handle(src, words)
+            if changed:
+                # postponed events replay in original order, ahead of
+                # the not-yet-processed remainder of the queue
+                queue = self.postponed + queue
+                self.postponed = []
+
+    def _dispatch_internal(self, ev: int) -> bool:
+        res = self.module.handle_event(self.state, ev, 0, False)
+        return self._apply(res)
+
+    def _handle(self, src: int, words) -> bool:
+        op = words[0]
+        if op not in (gen.OP_CALL, gen.OP_EVENT):
+            return False
+        mref, ev, arg = words[1], words[2], words[3]
+        res = self.module.handle_event(self.state, ev, arg,
+                                       op == gen.OP_CALL)
+        if res.postpone:
+            self.postponed.append((src, words))
+            return False
+        changed = self._apply(res)
+        if op == gen.OP_CALL and res.reply is not None:
+            gen.reply(self, src, mref, not res.error, res.reply)
+        return changed
+
+    def _apply(self, res: Result) -> bool:
+        if res.event_timeout is not None:
+            self.event_deadline = self.rnd + res.event_timeout
+        if res.next_state is None:
+            return False                        # keep_state
+        changed = res.next_state != self.state
+        self.state = res.next_state
+        if changed:
+            self.state_deadline = None          # cancelled by transition
+            self._arm_state_timeout()
+        return changed
+
+    def _arm_state_timeout(self) -> None:
+        arm = getattr(self.module, "state_timeout", None)
+        if arm is not None:
+            t = arm(self.state)
+            if t is not None:
+                self.state_deadline = self.rnd + t

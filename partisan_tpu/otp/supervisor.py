@@ -1,0 +1,182 @@
+"""partisan_gen_supervisor: cross-node supervision (reference
+priv/otp/24/partisan_gen_supervisor.erl, 1850 LoC).
+
+A :class:`Supervisor` process on one node manages child processes
+hosted on OTHER nodes — START/STOP orders and EXIT notifications ride
+the transport, which is exactly what partisan_gen_supervisor enables
+over partisan (children anywhere in the cluster).  Semantics owned
+here (test/partisan_supervisor_SUITE.erl):
+
+- one_for_one: only the crashed child restarts,
+- rest_for_one: the crashed child and those started AFTER it restart —
+  later children stopped in reverse start order, restarted in order,
+- one_for_all: every child restarts (stop reverse, start in order),
+- maximum restart intensity (MaxR within MaxT rounds): exceeding it
+  stops ALL children and terminates the supervisor,
+- restart types: permanent (always), transient (only abnormal exits),
+  temporary (never — and the child spec is discarded),
+- which_children / count_children / restart_child / delete_child,
+- a stale EXIT from a superseded incarnation is ignored (the
+  Mref-generation pairing of the monitor layer).
+
+:class:`ChildHost` is the remote side: a node hosting child processes,
+obeying START/STOP and reporting EXITs with the child's incarnation.
+"""
+
+from __future__ import annotations
+
+from partisan_tpu.otp import gen
+
+# exit reasons
+NORMAL, CRASH = 0, 1
+# restart types
+PERMANENT, TRANSIENT, TEMPORARY = 0, 1, 2
+# strategies
+ONE_FOR_ONE = "one_for_one"
+REST_FOR_ONE = "rest_for_one"
+ONE_FOR_ALL = "one_for_all"
+
+
+class ChildHost(gen.Proc):
+    """A node hosting child processes: obeys START/STOP, reports EXITs."""
+
+    def __init__(self, port: gen.Port) -> None:
+        super().__init__(port)
+        self.running: dict[int, int] = {}   # child_id -> incarnation
+        self.log: list = []                 # (op, child, inc) in order
+
+    def process(self, _rnd: int = 0) -> None:
+        for _src, words in self.drain():
+            op, child, inc = words[0], words[1], words[2]
+            if op == gen.OP_START:
+                self.running[child] = inc
+                self.log.append(("start", child, inc))
+            elif op == gen.OP_STOP:
+                self.running.pop(child, None)
+                self.log.append(("stop", child, inc))
+
+    def kill(self, sup_id: int, child: int, reason: int = CRASH) -> None:
+        """Child dies (crash- or test-injected): report EXIT to the
+        supervisor with its incarnation — the monitor/link DOWN the
+        reference delivers."""
+        inc = self.running.pop(child, None)
+        if inc is not None:
+            self.forward(sup_id, [gen.OP_EXIT, child, inc, reason])
+
+
+class Supervisor(gen.Proc):
+    """The partisan_gen_supervisor loop (one supervisor process)."""
+
+    def __init__(self, port: gen.Port, specs, strategy: str = ONE_FOR_ONE,
+                 max_r: int = 3, max_t: int = 20) -> None:
+        """specs: ordered [(child_id, host_node_id, restart_type)]."""
+        super().__init__(port)
+        self.specs = list(specs)
+        self.strategy = strategy
+        self.max_r, self.max_t = max_r, max_t
+        self.inc = {c: 0 for c, _, _ in specs}      # current incarnation
+        self.up = {c: False for c, _, _ in specs}
+        self.restarts: list[int] = []               # rounds of restarts
+        self.terminated = False
+        self.rnd = 0
+
+    # -- child plumbing -------------------------------------------------
+    def _host(self, child: int):
+        for c, h, _ in self.specs:
+            if c == child:
+                return h
+        return None
+
+    def _type(self, child: int):
+        for c, _, t in self.specs:
+            if c == child:
+                return t
+        return None
+
+    def _start(self, child: int) -> None:
+        self.inc[child] += 1
+        self.up[child] = True
+        self.forward(self._host(child),
+                     [gen.OP_START, child, self.inc[child]])
+
+    def _stop(self, child: int) -> None:
+        self.up[child] = False
+        self.forward(self._host(child),
+                     [gen.OP_STOP, child, self.inc[child]])
+
+    def start_all(self) -> None:
+        for c, _, _ in self.specs:          # start order = spec order
+            self._start(c)
+
+    # -- the supervisor loop --------------------------------------------
+    def process(self, rnd: int) -> None:
+        self.rnd = rnd
+        for _src, words in self.drain():
+            if words[0] != gen.OP_EXIT or self.terminated:
+                continue
+            child, inc, reason = words[1], words[2], words[3]
+            if child not in self.inc or inc != self.inc[child]:
+                continue                    # stale incarnation: ignore
+            if not self.up[child]:
+                continue
+            self.up[child] = False
+            rtype = self._type(child)
+            if rtype == TEMPORARY:
+                # temporary children are never restarted and their spec
+                # is discarded (OTP supervisor reference)
+                self.specs = [s for s in self.specs if s[0] != child]
+                del self.inc[child], self.up[child]
+                continue
+            if rtype == TRANSIENT and reason == NORMAL:
+                continue                    # normal exit: no restart
+            self._restart(child)
+
+    def _restart(self, child: int) -> None:
+        self.restarts.append(self.rnd)
+        # prune to the intensity window: entries older than MaxT can
+        # never count again, so the history stays O(MaxR) on long soaks
+        window = [r for r in self.restarts if r > self.rnd - self.max_t]
+        self.restarts = window
+        if len(window) > self.max_r:
+            # intensity exceeded: give up — stop all children (reverse
+            # start order), terminate the supervisor itself
+            for c, _, _ in reversed(self.specs):
+                if self.up[c]:
+                    self._stop(c)
+            self.terminated = True
+            return
+        order = [c for c, _, _ in self.specs]
+        if self.strategy == ONE_FOR_ONE:
+            self._start(child)
+            return
+        idx = order.index(child)
+        victims = order[idx + 1:] if self.strategy == REST_FOR_ONE \
+            else [c for c in order if c != child]
+        for c in reversed(victims):         # stop in reverse start order
+            if self.up[c]:
+                self._stop(c)
+        for c in order:                     # restart in start order
+            if c == child or c in victims:
+                self._start(c)
+
+    # -- admin API (supervisor:which_children/3 etc.) -------------------
+    def which_children(self):
+        return [(c, self.inc[c], self.up[c]) for c, _, _ in self.specs]
+
+    def count_children(self):
+        return {"specs": len(self.specs),
+                "active": sum(self.up.values())}
+
+    def restart_child(self, child: int) -> bool:
+        if not self.up.get(child, True):
+            self._start(child)
+            return True
+        return False
+
+    def delete_child(self, child: int) -> bool:
+        if self.up.get(child):
+            return False                    # only stopped children
+        self.specs = [s for s in self.specs if s[0] != child]
+        self.inc.pop(child, None)
+        self.up.pop(child, None)
+        return True

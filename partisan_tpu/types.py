@@ -108,6 +108,11 @@ class MsgKind(enum.IntEnum):
     RPC_CALL = 41       # payload: [fn_id, arg, call_ref] (partisan_rpc.erl:69-98)
     RPC_RESPONSE = 42   # payload: [result, call_ref]
 
+    # -- vectorized gen_server call protocol (partisan_gen.erl:360-400)
+    GEN_CALL = 43       # payload: [fn_id, arg, mref]
+    GEN_REPLY = 44      # payload: [result, mref]
+    GEN_CAST = 45       # payload: [fn_id, arg]
+
 
 # Convenience: number of payload words available given msg_words.
 def payload_words(msg_words: int) -> int:

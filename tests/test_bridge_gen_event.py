@@ -2,10 +2,10 @@
 
 The reference ships a patched OTP gen_event
 (priv/otp/24/partisan_gen_event.erl, 1014 LoC) with a conformance suite
-(test/partisan_gen_event_SUITE.erl, 1520 LoC).  This suite ports ~8
-representative behaviors at the semantics level, with the event-manager
-process on one emulated BEAM node and notifiers on others (the
-tests/test_bridge_gen_server.py pattern):
+(test/partisan_gen_event_SUITE.erl, 1520 LoC).  This suite runs the
+PACKAGE manager loop (partisan_tpu.otp.gen_event) over the bridge
+transport — only the crash-on-demand handler subclass is suite-local.
+~8 representative behaviors at the semantics level:
 
 - add_handler: handlers receive events in ADD order, each with its own
   state,
@@ -24,19 +24,15 @@ import pytest
 
 from support import BridgeVM, bridge_rig
 
-OP_NOTIFY, OP_SYNC_NOTIFY, OP_CALL, OP_REPLY = 1, 2, 3, 4
+from partisan_tpu.otp.gen_event import GenEvent, Handler, Notifier
+
 EV_ADD, EV_CRASH = 1, 99           # event kinds the handlers interpret
 
 
-class Handler:
-    """One installed handler: accumulates events, can be told to crash."""
+class AddHandler(Handler):
+    """Accumulates EV_ADD args; crashes on EV_CRASH targeting its id."""
 
-    def __init__(self, hid: int, state: int = 0):
-        self.id = hid
-        self.state = state
-        self.events: list[int] = []
-
-    def handle(self, ev: int, arg: int) -> None:
+    def handle(self, ev, arg):
         if ev == EV_CRASH and arg == self.id:
             raise RuntimeError(f"handler {self.id} crashed")
         if ev == EV_ADD:
@@ -44,98 +40,19 @@ class Handler:
         self.events.append(arg)
 
 
-class EventMgrVM(BridgeVM):
-    """The partisan_gen_event manager loop."""
-
-    def __init__(self, srv, sim_id):
-        super().__init__(srv, sim_id)
-        self.handlers: list[Handler] = []
-
-    def add_handler(self, hid, state=0):
-        self.handlers.append(Handler(hid, state))
-
-    def delete_handler(self, hid):
-        for h in list(self.handlers):
-            if h.id == hid:
-                self.handlers.remove(h)
-                return h.state           # terminate/2 returns the state
-        return None
-
-    def swap_handler(self, old_hid, new_hid):
-        """swap_handler: the new handler is seeded with the old one's
-        terminate result (OTP swap semantics), atomically in place."""
-        for i, h in enumerate(self.handlers):
-            if h.id == old_hid:
-                self.handlers[i] = Handler(new_hid, h.state)
-                return True
-        return False
-
-    def process(self):
-        for src, words in self.drain():
-            op, mref, ev, arg = words[0], words[1], words[2], words[3]
-            if op in (OP_NOTIFY, OP_SYNC_NOTIFY):
-                for h in list(self.handlers):
-                    try:
-                        h.handle(ev, arg)
-                    except Exception:
-                        # a crashing handler is removed; others continue
-                        self.handlers.remove(h)
-                if op == OP_SYNC_NOTIFY:
-                    self.forward(src, [OP_REPLY, mref, 0, 0])
-            elif op == OP_CALL:
-                # call/2: ev carries the TARGET handler id
-                for h in self.handlers:
-                    if h.id == ev:
-                        self.forward(src, [OP_REPLY, mref, 0, h.state])
-                        break
-                else:
-                    self.forward(src, [OP_REPLY, mref, 1, 0])
-
-
-class NotifierVM(BridgeVM):
-    def __init__(self, srv, sim_id):
-        super().__init__(srv, sim_id)
-        self._mref = sim_id * 1000
-        self.mailbox = []
-
-    def notify(self, mgr, ev, arg):
-        self.forward(mgr, [OP_NOTIFY, 0, ev, arg])
-
-    def sync_notify(self, mgr_vm, ev, arg, timeout_steps=12):
-        self._mref += 1
-        self.forward(mgr_vm.id, [OP_SYNC_NOTIFY, self._mref, ev, arg])
-        return self._wait_reply(mgr_vm, self._mref, timeout_steps)
-
-    def call(self, mgr_vm, hid, timeout_steps=12):
-        self._mref += 1
-        self.forward(mgr_vm.id, [OP_CALL, self._mref, hid, 0])
-        return self._wait_reply(mgr_vm, self._mref, timeout_steps)
-
-    def _wait_reply(self, mgr_vm, mref, timeout_steps):
-        for _ in range(timeout_steps):
-            self.step(1)
-            mgr_vm.process()
-            self.mailbox.extend(self.drain())
-            for i, (_src, words) in enumerate(self.mailbox):
-                if words[0] == OP_REPLY and words[1] == mref:
-                    del self.mailbox[i]
-                    return (words[2] == 0, words[3])
-        return ("timeout", mgr_vm.id)
-
-
 @pytest.fixture()
 def rig():
     srv = bridge_rig(4)
-    vms = []
+    procs = []
     try:
-        mgr = EventMgrVM(srv, 0)
-        a = NotifierVM(srv, 1)
-        b = NotifierVM(srv, 2)
-        vms = [mgr, a, b]
+        mgr = GenEvent(BridgeVM(srv, 0))
+        a = Notifier(BridgeVM(srv, 1))
+        b = Notifier(BridgeVM(srv, 2))
+        procs = [mgr, a, b]
         yield mgr, a, b
     finally:
-        for vm in vms:
-            vm.close()
+        for p in procs:
+            p.close()
         srv.close()
 
 
@@ -147,8 +64,8 @@ def _pump(a, mgr, k=3):
 
 def test_all_handlers_receive_in_add_order(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1)
-    mgr.add_handler(2)
+    mgr.add_handler(AddHandler(1))
+    mgr.add_handler(AddHandler(2))
     a.notify(mgr.id, EV_ADD, 5)
     _pump(a, mgr)
     assert [h.id for h in mgr.handlers] == [1, 2]
@@ -158,68 +75,68 @@ def test_all_handlers_receive_in_add_order(rig):
 
 def test_handlers_keep_independent_state(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1, state=100)
-    mgr.add_handler(2)
+    mgr.add_handler(AddHandler(1, state=100))
+    mgr.add_handler(AddHandler(2))
     a.notify(mgr.id, EV_ADD, 3)
     _pump(a, mgr)
-    assert a.call(mgr, 1) == (True, 103)
-    assert a.call(mgr, 2) == (True, 3)
+    assert a.call_handler(mgr, 1) == (True, 103)
+    assert a.call_handler(mgr, 2) == (True, 3)
 
 
 def test_sync_notify_replies_after_handlers_ran(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1)
+    mgr.add_handler(AddHandler(1))
     assert a.sync_notify(mgr, EV_ADD, 7) == (True, 0)
     assert mgr.handlers[0].state == 7     # already applied at reply time
 
 
 def test_call_targets_one_handler(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1, state=11)
-    mgr.add_handler(2, state=22)
-    assert a.call(mgr, 2) == (True, 22)
-    ok, _ = a.call(mgr, 9)                # no such handler
+    mgr.add_handler(AddHandler(1, state=11))
+    mgr.add_handler(AddHandler(2, state=22))
+    assert a.call_handler(mgr, 2) == (True, 22)
+    ok, _ = a.call_handler(mgr, 9)        # no such handler
     assert ok is False
 
 
 def test_delete_handler_stops_delivery_and_returns_state(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1)
-    mgr.add_handler(2)
+    mgr.add_handler(AddHandler(1))
+    mgr.add_handler(AddHandler(2))
     a.notify(mgr.id, EV_ADD, 4)
     _pump(a, mgr)
     assert mgr.delete_handler(1) == 4     # terminate returns final state
     a.notify(mgr.id, EV_ADD, 6)
     _pump(a, mgr)
-    assert a.call(mgr, 2) == (True, 10)
-    assert a.call(mgr, 1)[0] is False     # deleted: no longer reachable
+    assert a.call_handler(mgr, 2) == (True, 10)
+    assert a.call_handler(mgr, 1)[0] is False   # deleted: unreachable
 
 
 def test_crashing_handler_removed_others_survive(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1)
-    mgr.add_handler(2)
+    mgr.add_handler(AddHandler(1))
+    mgr.add_handler(AddHandler(2))
     a.notify(mgr.id, EV_CRASH, 1)         # crashes handler 1 only
     _pump(a, mgr)
     assert [h.id for h in mgr.handlers] == [2]
     a.notify(mgr.id, EV_ADD, 9)
     _pump(a, mgr)
-    assert a.call(mgr, 2) == (True, 9)    # survivor still running
+    assert a.call_handler(mgr, 2) == (True, 9)  # survivor still running
 
 
 def test_swap_handler_preserves_state(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1)
+    mgr.add_handler(AddHandler(1))
     a.notify(mgr.id, EV_ADD, 8)
     _pump(a, mgr)
-    assert mgr.swap_handler(1, 3)
-    assert a.call(mgr, 3) == (True, 8)    # new handler seeded with state
-    assert a.call(mgr, 1)[0] is False
+    assert mgr.swap_handler(1, AddHandler, 3)
+    assert a.call_handler(mgr, 3) == (True, 8)  # seeded with old state
+    assert a.call_handler(mgr, 1)[0] is False
 
 
 def test_per_notifier_fifo_ordering(rig):
     mgr, a, _ = rig
-    mgr.add_handler(1)
+    mgr.add_handler(AddHandler(1))
     for arg in (1, 2, 3, 4):
         a.notify(mgr.id, EV_ADD, arg)
     _pump(a, mgr, 6)

@@ -3,9 +3,9 @@
 The reference ships the (deprecated, still supported) patched OTP
 gen_fsm (priv/otp/24/partisan_gen_fsm.erl, 761 LoC).  gen_fsm is the
 simpler ancestor of gen_statem: per-state event handlers, plus
-ALL-STATE events that any state handles.  This suite ports the
-representative behaviors at the semantics level over the bridge
-transport (the tests/test_bridge_gen_statem.py pattern):
+ALL-STATE events that any state handles.  This suite runs the PACKAGE
+loop (partisan_tpu.otp.gen_fsm) over the bridge transport — only the
+idle/busy callback module is suite-local.  Representative behaviors:
 
 - send_event (async) dispatches to the CURRENT state's handler,
 - sync_send_event replies from the handler's return,
@@ -24,108 +24,62 @@ import pytest
 
 from support import BridgeVM, bridge_rig
 
-OP_EVENT, OP_SYNC, OP_ALL_STATE, OP_REPLY = 1, 2, 3, 4
+from partisan_tpu.otp.gen_fsm import (
+    EV_TIMEOUT, FsmClient, GenFsm, Outcome)
+
 EV_GO, EV_WORK, EV_WHO = 1, 2, 3     # per-state events
 IDLE, BUSY = 0, 1
 FSM_TIMEOUT = 5                      # the {next_state,...,Timeout} form
 
 
-class FsmVM(BridgeVM):
-    """The partisan_gen_fsm loop: per-state handlers + all-state."""
+class IdleBusy:
+    """StateName/2-3 dispatch: per-state handlers + the all-state log."""
 
-    def __init__(self, srv, sim_id, *, timeout=None):
-        super().__init__(srv, sim_id)
-        self.state = IDLE
+    init_state = IDLE
+
+    def __init__(self, *, timeout=None):
         self.counter = 0
-        self.all_state_log = []
         self.timeout = timeout
-        self.deadline = None
-        self.rnd = 0
+        self.all_state_log = []
 
-    def process(self, rnd):
-        self.rnd = rnd
-        events = self.drain()
-        # gen_fsm timeout: fires only if no event arrived in the window
-        if self.deadline is not None:
-            if events:
-                self.deadline = None             # any event cancels
-            elif rnd >= self.deadline:
-                self.deadline = None
-                self.state = IDLE                # timeout handler
-        for src, words in events:
-            op, mref, ev, arg = words[0], words[1], words[2], words[3]
-            if op == OP_ALL_STATE:
-                # handle_event/3: any state (the module-wide handler)
-                self.all_state_log.append(arg)
-                continue
-            handled, reply = self._state_handler(ev, arg)
-            if op == OP_SYNC:
-                self.forward(src, [OP_REPLY, mref,
-                                   0 if handled else 1, reply])
+    def handle_all_state(self, arg):
+        self.all_state_log.append(arg)
 
-    def _state_handler(self, ev, arg):
-        """StateName/2-3 dispatch: the CURRENT state's handler only;
-        events it doesn't know are dropped (no postpone in gen_fsm)."""
-        if self.state == IDLE:
+    def state_handler(self, state, ev, arg):
+        if ev == EV_TIMEOUT:
+            return Outcome(True, 0, next_state=IDLE)
+        if state == IDLE:
             if ev == EV_GO:
-                self.state = BUSY
-                if self.timeout is not None:
-                    self.deadline = self.rnd + self.timeout
-                return True, BUSY
+                return Outcome(True, BUSY, next_state=BUSY,
+                               timeout=self.timeout)
             if ev == EV_WHO:
-                return True, IDLE * 1000 + self.counter
-            return False, 0
-        if self.state == BUSY:
+                return Outcome(True, IDLE * 1000 + self.counter)
+            return Outcome(False)
+        if state == BUSY:
             if ev == EV_WORK:
                 self.counter += arg
-                return True, self.counter
+                return Outcome(True, self.counter)
             if ev == EV_WHO:
-                return True, BUSY * 1000 + self.counter
+                return Outcome(True, BUSY * 1000 + self.counter)
             if ev == EV_GO:
-                self.state = IDLE
-                return True, IDLE
-            return False, 0
-        return False, 0
-
-
-class FsmClient(BridgeVM):
-    def __init__(self, srv, sim_id):
-        super().__init__(srv, sim_id)
-        self._mref = sim_id * 1000
-        self.mailbox = []
-
-    def send_event(self, dst, ev, arg=0):
-        self.forward(dst, [OP_EVENT, 0, ev, arg])
-
-    def send_all_state_event(self, dst, arg):
-        self.forward(dst, [OP_ALL_STATE, 0, 0, arg])
-
-    def sync_send_event(self, fsm, ev, arg=0, timeout_steps=12):
-        self._mref += 1
-        self.forward(fsm.id, [OP_SYNC, self._mref, ev, arg])
-        for _ in range(timeout_steps):
-            fsm.process(self.step(1))
-            self.mailbox.extend(self.drain())
-            for i, (_s, words) in enumerate(self.mailbox):
-                if words[0] == OP_REPLY and words[1] == self._mref:
-                    del self.mailbox[i]
-                    return (words[2] == 0, words[3])
-        return ("timeout", fsm.id)
+                return Outcome(True, IDLE, next_state=IDLE)
+            return Outcome(False)
+        return Outcome(False)
 
 
 @pytest.fixture()
 def rig():
     srv = bridge_rig(4)
-    vms = []
+    procs = []
     try:
-        a = FsmClient(srv, 0)
-        m = FsmVM(srv, 1)
-        c = FsmClient(srv, 2)
-        vms = [a, m, c]
+        a = FsmClient(BridgeVM(srv, 0))
+        m = GenFsm(BridgeVM(srv, 1), IdleBusy())
+        c = FsmClient(BridgeVM(srv, 2))
+        procs = [a, m, c]
         yield a, m, c
     finally:
-        for vm in vms:
-            vm.close()
+        for p in procs:
+            p.close()
         srv.close()
 
 
@@ -141,7 +95,7 @@ def test_send_event_dispatches_to_current_state(rig):
     assert m.state == BUSY
     a.send_event(m.id, EV_WORK, 4)
     _pump(a, m)
-    assert m.counter == 4
+    assert m.module.counter == 4
 
 
 def test_sync_send_event_replies(rig):
@@ -169,14 +123,14 @@ def test_all_state_event_reaches_any_state(rig):
     a.sync_send_event(m, EV_GO)
     a.send_all_state_event(m.id, 22)
     _pump(a, m)
-    assert m.all_state_log == [11, 22]
+    assert m.module.all_state_log == [11, 22]
 
 
 def test_fsm_timeout_fires_only_when_idle():
     srv = bridge_rig(4)
     try:
-        a = FsmClient(srv, 0)
-        m = FsmVM(srv, 1, timeout=FSM_TIMEOUT)
+        a = FsmClient(BridgeVM(srv, 0))
+        m = GenFsm(BridgeVM(srv, 1), IdleBusy(timeout=FSM_TIMEOUT))
         assert a.sync_send_event(m, EV_GO) == (True, BUSY)
         for _ in range(FSM_TIMEOUT + 2):      # silence
             m.process(a.step(1))

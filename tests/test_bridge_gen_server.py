@@ -5,192 +5,81 @@ through ``partisan:forward_message`` (priv/otp/24/partisan_gen.erl
 :360-400: monitor + ``{'$gen_call', {Self, Mref}, Req}``; reply =
 ``{Mref, Reply}``; timeout demonitors and discards late replies; a DOWN
 aborts the call).  With no BEAM in this image (see
-test_bridge_conformance), this suite runs that PROTOCOL against the
-real bridge transport: each "VM" below is an emulated BEAM node holding
-a TCP connection to the shared simulator (`socket_server`), and the
-gen_server call/cast/reply/timeout/DOWN state machines execute exactly
-the message shapes partisan_gen would put on the wire — a port of ~10
-representative behaviors of test/partisan_gen_server_SUITE.erl (2241
-LoC) at the semantics level.
+test_bridge_conformance), this suite runs the PACKAGE implementation of
+that protocol (partisan_tpu.otp.gen + otp.gen_server) against the real
+bridge transport: each "VM" is an emulated BEAM node holding a TCP
+connection to the shared simulator (`socket_server`) — a port in the
+:class:`partisan_tpu.otp.gen.Port` sense.  ~10 representative behaviors
+of test/partisan_gen_server_SUITE.erl (2241 LoC) at the semantics
+level; only the counter callback module is suite-local.
 """
-
-import socket
-import struct
 
 import pytest
 
-from partisan_tpu.bridge import etf
-from partisan_tpu.bridge.etf import Atom
-from partisan_tpu.bridge.socket_server import BridgeSocketServer
+from support import BridgeVM, bridge_rig
 
-# word-level wire ops (the symbol-table-free small-term encoding a
-# bridge-attached partisan_gen would use for its control tuples)
-OP_CALL, OP_REPLY, OP_CAST = 1, 2, 3
+from partisan_tpu.otp import gen
+from partisan_tpu.otp.gen_server import GenServer, Stop
 
-
-class VM:
-    """One emulated BEAM node on the shared simulator."""
-
-    def __init__(self, srv, sim_id: int) -> None:
-        self.id = sim_id
-        self.sock = socket.create_connection((srv.host, srv.port))
-        assert self.rpc((Atom("set_self"), sim_id)) == etf.OK
-
-    def rpc(self, term):
-        payload = etf.encode(term)
-        self.sock.sendall(struct.pack(">I", len(payload)) + payload)
-        head = b""
-        while len(head) < 4:
-            head += self.sock.recv(4 - len(head))
-        (n,) = struct.unpack(">I", head)
-        buf = b""
-        while len(buf) < n:
-            buf += self.sock.recv(n - len(buf))
-        return etf.decode(buf)
-
-    def forward(self, dst: int, words) -> None:
-        assert self.rpc((Atom("forward_message"), self.id, dst,
-                         list(words))) == etf.OK
-
-    def drain(self):
-        ok, out = self.rpc((Atom("drain"),))
-        assert ok == etf.OK
-        return out
-
-    def step(self, k: int = 1):
-        ok, rnd = self.rpc((Atom("step"), k))
-        assert ok == etf.OK
-        return rnd
-
-    def is_alive(self, node: int) -> bool:
-        ok, alive = self.rpc((Atom("is_alive"), node))
-        assert ok == etf.OK
-        return bool(alive)
-
-    def close(self):
-        self.sock.close()
+FN_INCR, FN_GET, FN_STOP = 1, 2, 3
 
 
-class GenServerVM(VM):
-    """handle_call/handle_cast over the bridge: a counter server."""
+class Counter:
+    """The suite's counter callback module (handle_call/handle_cast)."""
 
-    def __init__(self, srv, sim_id):
-        super().__init__(srv, sim_id)
-        self.counter = 0
-        self.stopped = False
+    def __init__(self):
+        self.value = 0
 
-    def process(self):
-        """Drain + serve (one scheduler pass of the server process)."""
-        for src, words in self.drain():
-            if self.stopped:
-                continue
-            op = words[0]
-            if op == OP_CALL:
-                mref, fn, arg = words[1], words[2], words[3]
-                if fn == 1:          # incr(arg) -> new value
-                    self.counter += arg
-                    self.forward(src, [OP_REPLY, mref, 0, self.counter])
-                elif fn == 2:        # get
-                    self.forward(src, [OP_REPLY, mref, 0, self.counter])
-                elif fn == 3:        # stop
-                    self.stopped = True
-                    self.forward(src, [OP_REPLY, mref, 0, 0])
-                else:                # unknown -> error reply
-                    self.forward(src, [OP_REPLY, mref, 1, 0])
-            elif op == OP_CAST:
-                self.counter += words[3]
+    def handle_call(self, fn, arg, src):
+        if fn == FN_INCR:
+            self.value += arg
+            return True, self.value
+        if fn == FN_GET:
+            return True, self.value
+        if fn == FN_STOP:
+            return Stop(True, 0)
+        return False, 0          # unknown -> error reply
 
-
-class GenClientVM(VM):
-    def __init__(self, srv, sim_id):
-        super().__init__(srv, sim_id)
-        self._mref = sim_id * 1000
-        self._stale = set()
-        self.mailbox = []
-
-    def send_call(self, dst: int, fn: int, arg: int = 0) -> int:
-        self._mref += 1
-        self.forward(dst, [OP_CALL, self._mref, fn, arg])
-        return self._mref
-
-    def cast(self, dst: int, fn: int, arg: int) -> None:
-        self.forward(dst, [OP_CAST, 0, fn, arg])
-
-    def poll(self, mref: int):
-        """One receive pass: returns (ok_flag, value) or None."""
-        self.mailbox.extend(self.drain())
-        for i, (_src, words) in enumerate(self.mailbox):
-            if words[0] == OP_REPLY and words[1] == mref:
-                del self.mailbox[i]
-                return (words[2] == 0, words[3])
-            if words[0] == OP_REPLY and words[1] in self._stale:
-                # partisan_gen discards replies after a timeout/demonitor
-                del self.mailbox[i]
-                return self.poll(mref)
-        return None
-
-    def call(self, dst: int, fn: int, arg: int = 0, *, server=None,
-             timeout_steps: int = 12, monitor: bool = False):
-        """The partisan_gen:call loop: send, await {Mref, Reply}; a
-        timeout demonitors + marks the ref stale; with ``monitor``, a
-        dead destination aborts the call with DOWN (the monitor path)."""
-        mref = self.send_call(dst, fn, arg)
-        for _ in range(timeout_steps):
-            self.step(1)
-            if server is not None:
-                server.process()
-            got = self.poll(mref)
-            if got is not None:
-                return got
-            if monitor and not self.is_alive(dst):
-                self._stale.add(mref)
-                return ("DOWN", dst)
-        self._stale.add(mref)
-        return ("timeout", dst)
+    def handle_cast(self, fn, arg, src):
+        if fn == FN_INCR:
+            self.value += arg
 
 
 @pytest.fixture()
 def rig():
-    srv = BridgeSocketServer()
-    srv.serve_background()
-    vms = []
+    srv = bridge_rig(4)
+    procs = []
     try:
-        boot = socket.create_connection((srv.host, srv.port))
-        payload = etf.encode((Atom("init"), {Atom("n_nodes"): 4,
-                                             Atom("seed"): 9}))
-        boot.sendall(struct.pack(">I", len(payload)) + payload)
-        head = boot.recv(4)
-        boot.recv(struct.unpack(">I", head)[0])
-        a = GenClientVM(srv, 0)
-        b = GenServerVM(srv, 1)
-        c = GenClientVM(srv, 2)
-        d = GenServerVM(srv, 3)
-        vms = [a, b, c, d]
+        a = gen.Caller(BridgeVM(srv, 0))
+        b = GenServer(BridgeVM(srv, 1), Counter())
+        c = gen.Caller(BridgeVM(srv, 2))
+        d = GenServer(BridgeVM(srv, 3), Counter())
+        procs = [a, b, c, d]
         yield srv, a, b, c, d
     finally:
-        for vm in vms:
-            vm.close()
+        for p in procs:
+            p.close()
         srv.close()
 
 
 def test_call_reply_and_state_across_calls(rig):
     _, a, b, _, _ = rig
-    assert a.call(b.id, 1, 5, server=b) == (True, 5)
-    assert a.call(b.id, 1, 3, server=b) == (True, 8)     # state persisted
-    assert a.call(b.id, 2, server=b) == (True, 8)        # get
+    assert a.call(b.id, FN_INCR, 5, pump=b.process) == (True, 5)
+    assert a.call(b.id, FN_INCR, 3, pump=b.process) == (True, 8)
+    assert a.call(b.id, FN_GET, pump=b.process) == (True, 8)
 
 
 def test_cast_is_async_and_observable(rig):
     _, a, b, _, _ = rig
-    a.cast(b.id, 1, 10)
+    a.cast(b.id, FN_INCR, 10)
     a.step(2)
     b.process()
-    assert a.call(b.id, 2, server=b) == (True, 10)
+    assert a.call(b.id, FN_GET, pump=b.process) == (True, 10)
 
 
 def test_unknown_request_error_reply(rig):
     _, a, b, _, _ = rig
-    ok, _ = a.call(b.id, 99, server=b)
+    ok, _ = a.call(b.id, 99, pump=b.process)
     assert ok is False
 
 
@@ -198,8 +87,8 @@ def test_concurrent_calls_get_their_own_replies(rig):
     """Two clients call simultaneously; each reply pairs with ITS ref
     (the alias/Mref pairing of partisan_gen)."""
     _, a, b, c, _ = rig
-    ra = a.send_call(b.id, 1, 100)
-    rc = c.send_call(b.id, 1, 1)
+    ra = a.send_call(b.id, FN_INCR, 100)
+    rc = c.send_call(b.id, FN_INCR, 1)
     got_a = got_c = None
     for _ in range(12):
         a.step(1)
@@ -211,14 +100,14 @@ def test_concurrent_calls_get_their_own_replies(rig):
     assert got_a is not None and got_c is not None
     # both admitted, order unspecified; final counter saw both
     assert {got_a[1], got_c[1]} <= {1, 100, 101}
-    assert a.call(b.id, 2, server=b) == (True, 101)
+    assert a.call(b.id, FN_GET, pump=b.process) == (True, 101)
 
 
 def test_pipelined_calls_reply_in_fifo_order(rig):
     """Per-sender FIFO (the transport's per-connection ordering): three
     pipelined calls reply in issue order."""
     _, a, b, _, _ = rig
-    refs = [a.send_call(b.id, 1, 1) for _ in range(3)]
+    refs = [a.send_call(b.id, FN_INCR, 1) for _ in range(3)]
     replies = []
     for _ in range(16):
         a.step(1)
@@ -234,21 +123,21 @@ def test_pipelined_calls_reply_in_fifo_order(rig):
 
 def test_call_times_out_when_server_silent(rig):
     _, a, _, _, _ = rig
-    # node 3's VM exists but never processes -> no reply -> timeout
-    assert a.call(3, 1, 1, timeout_steps=6) == ("timeout", 3)
+    # node 3's server exists but is never pumped -> no reply -> timeout
+    assert a.call(3, FN_INCR, 1, timeout_steps=6) == ("timeout", 3)
 
 
 def test_late_reply_after_timeout_is_discarded(rig):
     """partisan_gen discards a reply arriving after the caller timed
     out (the stale-ref rule) — the next call is NOT confused by it."""
     _, a, b, _, _ = rig
-    mref = a.send_call(b.id, 1, 7)
-    a._stale.add(mref)          # caller timed out: ref demonitored
+    mref = a.send_call(b.id, FN_INCR, 7)
+    a.mark_stale(mref)          # caller timed out: ref demonitored
     a.step(2)
     b.process()                 # server replies late
     a.step(2)
     # a fresh call must pair with its OWN reply, skipping the stale one
-    got = a.call(b.id, 2, server=b)
+    got = a.call(b.id, FN_GET, pump=b.process)
     assert got == (True, 7)     # late incr applied server-side; stale
     #                             reply itself never surfaced as a result
 
@@ -258,22 +147,60 @@ def test_monitor_down_aborts_call(rig):
     caller gets DOWN instead of hanging (partisan_gen monitor path over
     the manager's liveness signal)."""
     srv, a, b, _, _ = rig
-    a.send_call(b.id, 1, 1)                    # in flight...
-    assert a.rpc((Atom("crash"), b.id)) == etf.OK
-    out = a.call(b.id, 2, server=None, monitor=True, timeout_steps=20)
+    from partisan_tpu.bridge import etf
+    from partisan_tpu.bridge.etf import Atom
+
+    a.send_call(b.id, FN_INCR, 1)              # in flight...
+    assert a.port.rpc((Atom("crash"), b.id)) == etf.OK
+    out = a.call(b.id, FN_GET, monitor=True, timeout_steps=20)
     assert out == ("DOWN", b.id)
 
 
 def test_two_servers_route_independently(rig):
     _, a, b, _, d = rig
-    assert a.call(b.id, 1, 5, server=b) == (True, 5)
-    assert a.call(d.id, 1, 9, server=d) == (True, 9)
-    assert a.call(b.id, 2, server=b) == (True, 5)
-    assert a.call(d.id, 2, server=d) == (True, 9)
+    assert a.call(b.id, FN_INCR, 5, pump=b.process) == (True, 5)
+    assert a.call(d.id, FN_INCR, 9, pump=d.process) == (True, 9)
+    assert a.call(b.id, FN_GET, pump=b.process) == (True, 5)
+    assert a.call(d.id, FN_GET, pump=d.process) == (True, 9)
 
 
 def test_stopped_server_ignores_further_calls(rig):
     _, a, b, _, _ = rig
-    assert a.call(b.id, 3, server=b)[0] is True          # stop
-    assert a.call(b.id, 2, server=b, timeout_steps=6) == \
+    assert a.call(b.id, FN_STOP, pump=b.process)[0] is True
+    assert a.call(b.id, FN_GET, pump=b.process, timeout_steps=6) == \
         ("timeout", b.id)
+
+
+def test_mux_stacks_two_behaviours_on_one_node():
+    """One node runs BOTH a gen_server and a supervisor child host (the
+    registered-process table): a Mux routes each opcode to its
+    behaviour, so calls and START/STOP orders interleave on one port
+    without stealing each other's mail."""
+    from partisan_tpu.otp.supervisor import ChildHost, PERMANENT, Supervisor
+
+    srv = bridge_rig(4)
+    try:
+        mux = gen.Mux(BridgeVM(srv, 1))
+        b = GenServer(mux.attach(gen.OP_CALL, gen.OP_CAST), Counter())
+        host = ChildHost(mux.attach(gen.OP_START, gen.OP_STOP))
+        a = gen.Caller(BridgeVM(srv, 0))
+        sup = Supervisor(BridgeVM(srv, 2), [(30, 1, PERMANENT)])
+        sup.start_all()
+
+        def pump(rnd):
+            b.process()
+            host.process()
+            sup.process(rnd)
+
+        assert a.call(b.id, FN_INCR, 5, pump=pump) == (True, 5)
+        assert host.running == {30: 1}          # START wasn't stolen
+        host.kill(sup.id, 30)
+        for _ in range(6):
+            pump(a.step(1))
+        assert host.running == {30: 2}          # supervision healed it
+        assert a.call(b.id, FN_GET, pump=pump) == (True, 5)
+        a.close()
+        sup.close()
+        mux.close()
+    finally:
+        srv.close()

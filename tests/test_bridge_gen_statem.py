@@ -3,30 +3,30 @@
 The reference ships a patched OTP gen_statem
 (priv/otp/24/partisan_gen_statem.erl, 3008 LoC) with a conformance
 suite (test/partisan_gen_statem_SUITE.erl, 2773 LoC).  With no BEAM in
-this image, this suite ports ~10 representative behaviors at the
-semantics level, running the statem event loop against the real bridge
-transport (each "VM" is an emulated BEAM node on the shared simulator,
-the pattern of tests/test_bridge_gen_server.py):
+this image, this suite runs the PACKAGE event loop
+(partisan_tpu.otp.gen_statem: postpone replay order, state_timeout,
+event timeout) against the real bridge transport — only the two-state
+switch callback module is suite-local.  ~11 representative behaviors at
+the semantics level:
 
 - state-transition calls with replies from the NEW state,
 - keep_state (data updates without transition),
 - event POSTPONE: events postponed in a state are retried — in original
-  arrival order, ahead of newer events — when the state changes
-  (gen_statem postpone semantics),
+  arrival order, ahead of newer events — when the state changes,
 - STATE timeout: armed on entering a state, NOT cancelled by event
   arrival, cancelled by a state transition (OTP state_timeout),
 - EVENT timeout: cancelled by ANY event arrival (OTP event timeout),
 - ref/reply pairing across transitions with two concurrent clients.
-
-The machine under test is the two-state switch (OFF/ON) with a counter —
-the shape of the SUITE's start/stop machines.
 """
 
 import pytest
 
 from support import BridgeVM, bridge_rig
 
-OP_CALL, OP_REPLY, OP_EVENT = 1, 2, 4
+from partisan_tpu.otp import gen
+from partisan_tpu.otp.gen_statem import (
+    EV_EVENT_TIMEOUT, EV_STATE_TIMEOUT, GenStatem, Result)
+
 # events
 EV_FLIP, EV_GET, EV_WORK, EV_ARM_IDLE, EV_TICK = 1, 2, 3, 4, 5
 OFF, ON = 0, 1
@@ -34,120 +34,38 @@ STATE_TIMEOUT = 6          # rounds in ON before auto-OFF (state_timeout)
 IDLE_TIMEOUT = 5           # rounds without events after ARM_IDLE
 
 
-class StatemVM(BridgeVM):
-    """The partisan_gen_statem loop: one state machine process."""
+class Switch:
+    """The two-state switch with a counter — the shape of the SUITE's
+    start/stop machines.  All loop semantics live in the package; this
+    module only maps events to actions."""
 
-    def __init__(self, srv, sim_id, *, state_timeout=None):
-        super().__init__(srv, sim_id)
-        self.state = OFF
+    init_state = OFF
+
+    def __init__(self, *, on_timeout=None):
         self.counter = 0
-        self.postponed = []        # [(src, words)] in arrival order
-        self.state_deadline = None     # round at which state_timeout fires
-        self.state_timeout = state_timeout
-        self.idle_deadline = None      # event-timeout deadline
-        self.rnd = 0
+        self.on_timeout = on_timeout
 
-    # -- the gen_statem event loop -------------------------------------
-    def process(self, rnd):
-        self.rnd = rnd
-        queue = list(self.drain())
-        # timeouts fire as internal events BEFORE new external events if
-        # their deadline has passed (timer messages were already "sent")
-        if self.state_deadline is not None and rnd >= self.state_deadline:
-            self.state_deadline = None
-            self._transition(OFF)
-        if self.idle_deadline is not None:
-            if queue:
-                self.idle_deadline = None       # any event cancels it
-            elif rnd >= self.idle_deadline:
-                self.idle_deadline = None
-                self._transition(OFF)
-        while queue:
-            src, words = queue.pop(0)
-            consumed, changed = self._handle(src, words)
-            if not consumed:
-                self.postponed.append((src, words))
-            if changed:
-                # postponed events are retried in original order, ahead
-                # of the not-yet-processed remainder of the queue
-                queue = self.postponed + queue
-                self.postponed = []
+    def state_timeout(self, state):
+        return self.on_timeout if state == ON else None
 
-    def _transition(self, new_state):
-        changed = new_state != self.state
-        self.state = new_state
-        if changed:
-            self.state_deadline = None         # cancelled by transition
-            if new_state == ON and self.state_timeout is not None:
-                self.state_deadline = self.rnd + self.state_timeout
-        return changed
-
-    def _handle(self, src, words):
-        """Returns (consumed, state_changed)."""
-        op = words[0]
-        mref, ev, arg = words[1], words[2], words[3]
-        if op not in (OP_CALL, OP_EVENT):
-            return True, False
+    def handle_event(self, state, ev, arg, is_call):
+        if ev in (EV_STATE_TIMEOUT, EV_EVENT_TIMEOUT):
+            return Result(next_state=OFF)
         if ev == EV_FLIP:
-            changed = self._transition(ON if self.state == OFF else OFF)
-            if op == OP_CALL:
-                self.forward(src, [OP_REPLY, mref, 0, self.state])
-            return True, changed
-        if ev == EV_GET:
-            if op == OP_CALL:      # keep_state + reply
-                self.forward(src, [OP_REPLY, mref, 0,
-                                   self.state * 1000 + self.counter])
-            return True, False
+            new = ON if state == OFF else OFF
+            return Result(next_state=new, reply=new)
+        if ev == EV_GET:       # keep_state + reply
+            return Result(reply=state * 1000 + self.counter)
         if ev == EV_WORK:
-            if self.state == OFF:
-                return False, False            # postpone in OFF
+            if state == OFF:
+                return Result(postpone=True)
             self.counter = self.counter * 2 + arg   # order-sensitive op
-            if op == OP_CALL:
-                self.forward(src, [OP_REPLY, mref, 0, self.counter])
-            return True, False
+            return Result(reply=self.counter)
         if ev == EV_ARM_IDLE:
-            self.idle_deadline = self.rnd + IDLE_TIMEOUT
-            if op == OP_CALL:
-                self.forward(src, [OP_REPLY, mref, 0, 0])
-            return True, False
+            return Result(reply=0, event_timeout=IDLE_TIMEOUT)
         if ev == EV_TICK:
-            return True, False     # no-op event (cancels event timeout)
-        if op == OP_CALL:
-            self.forward(src, [OP_REPLY, mref, 1, 0])
-        return True, False
-
-
-class ClientVM(BridgeVM):
-    def __init__(self, srv, sim_id):
-        super().__init__(srv, sim_id)
-        self._mref = sim_id * 1000
-        self.mailbox = []
-
-    def send_call(self, dst, ev, arg=0):
-        self._mref += 1
-        self.forward(dst, [OP_CALL, self._mref, ev, arg])
-        return self._mref
-
-    def event(self, dst, ev, arg=0):
-        self.forward(dst, [OP_EVENT, 0, ev, arg])
-
-    def poll(self, mref):
-        self.mailbox.extend(self.drain())
-        for i, (_src, words) in enumerate(self.mailbox):
-            if words[0] == OP_REPLY and words[1] == mref:
-                del self.mailbox[i]
-                return (words[2] == 0, words[3])
-        return None
-
-    def call(self, dst, ev, arg=0, *, machine, timeout_steps=12):
-        mref = self.send_call(dst, ev, arg)
-        for _ in range(timeout_steps):
-            rnd = self.step(1)
-            machine.process(rnd)
-            got = self.poll(mref)
-            if got is not None:
-                return got
-        return ("timeout", dst)
+            return Result()    # no-op event (cancels event timeout)
+        return Result(reply=0, error=True)
 
 
 @pytest.fixture()
@@ -155,16 +73,16 @@ def rig():
     """Machine WITHOUT a state timeout (timeout behaviors get their own
     rig below — an always-armed ON timeout would fire mid-test)."""
     srv = bridge_rig(4)
-    vms = []
+    procs = []
     try:
-        a = ClientVM(srv, 0)
-        m = StatemVM(srv, 1)
-        c = ClientVM(srv, 2)
-        vms = [a, m, c]
+        a = gen.Caller(BridgeVM(srv, 0))
+        m = GenStatem(BridgeVM(srv, 1), Switch())
+        c = gen.Caller(BridgeVM(srv, 2))
+        procs = [a, m, c]
         yield srv, a, m, c
     finally:
-        for vm in vms:
-            vm.close()
+        for p in procs:
+            p.close()
         srv.close()
 
 
@@ -172,15 +90,16 @@ def rig():
 def rig_t():
     """Machine whose ON state arms a state_timeout."""
     srv = bridge_rig(4)
-    vms = []
+    procs = []
     try:
-        a = ClientVM(srv, 0)
-        m = StatemVM(srv, 1, state_timeout=STATE_TIMEOUT)
-        vms = [a, m]
+        a = gen.Caller(BridgeVM(srv, 0))
+        m = GenStatem(BridgeVM(srv, 1),
+                      Switch(on_timeout=STATE_TIMEOUT))
+        procs = [a, m]
         yield srv, a, m
     finally:
-        for vm in vms:
-            vm.close()
+        for p in procs:
+            p.close()
         srv.close()
 
 
@@ -189,19 +108,23 @@ def _settle(a, m, k):
         m.process(a.step(1))
 
 
+def _call(a, m, ev, arg=0):
+    return a.call(m.id, ev, arg, pump=m.process)
+
+
 def test_call_transitions_and_replies_from_new_state(rig):
     _, a, m, _ = rig
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, OFF)
+    assert _call(a, m, EV_FLIP) == (True, ON)
+    assert _call(a, m, EV_FLIP) == (True, OFF)
 
 
 def test_keep_state_preserves_data(rig):
     _, a, m, _ = rig
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
-    assert a.call(m.id, EV_WORK, 3, machine=m) == (True, 3)
+    assert _call(a, m, EV_FLIP) == (True, ON)
+    assert _call(a, m, EV_WORK, 3) == (True, 3)
     # get is keep_state: two reads, same state and data
-    assert a.call(m.id, EV_GET, machine=m) == (True, 1003)
-    assert a.call(m.id, EV_GET, machine=m) == (True, 1003)
+    assert _call(a, m, EV_GET) == (True, 1003)
+    assert _call(a, m, EV_GET) == (True, 1003)
 
 
 def test_postponed_events_replay_on_state_change(rig):
@@ -209,10 +132,10 @@ def test_postponed_events_replay_on_state_change(rig):
     _, a, m, _ = rig
     a.event(m.id, EV_WORK, 7)
     _settle(a, m, 3)
-    assert a.call(m.id, EV_GET, machine=m) == (True, 0)   # still OFF, idle
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
+    assert _call(a, m, EV_GET) == (True, 0)   # still OFF, idle
+    assert _call(a, m, EV_FLIP) == (True, ON)
     _settle(a, m, 2)
-    assert a.call(m.id, EV_GET, machine=m) == (True, 1007)
+    assert _call(a, m, EV_GET) == (True, 1007)
 
 
 def test_postponed_events_replay_in_arrival_order(rig):
@@ -222,9 +145,9 @@ def test_postponed_events_replay_in_arrival_order(rig):
     _settle(a, m, 2)
     a.event(m.id, EV_WORK, 3)
     _settle(a, m, 2)
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
+    assert _call(a, m, EV_FLIP) == (True, ON)
     _settle(a, m, 2)
-    assert a.call(m.id, EV_GET, machine=m) == (True, 1007)
+    assert _call(a, m, EV_GET) == (True, 1007)
 
 
 def test_postponed_replay_ahead_of_newer_events(rig):
@@ -236,62 +159,62 @@ def test_postponed_replay_ahead_of_newer_events(rig):
     a.event(m.id, EV_FLIP)                 # same-round pair: flip …
     a.event(m.id, EV_WORK, 3)              # … then new work
     _settle(a, m, 3)
-    assert a.call(m.id, EV_GET, machine=m) == (True, 1007)  # (0*2+2)*2+3
+    assert _call(a, m, EV_GET) == (True, 1007)  # (0*2+2)*2+3
 
 
 def test_state_timeout_fires_without_events(rig_t):
     _, a, m = rig_t
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
+    assert _call(a, m, EV_FLIP) == (True, ON)
     _settle(a, m, STATE_TIMEOUT + 2)
-    assert a.call(m.id, EV_GET, machine=m)[1] // 1000 == OFF
+    assert _call(a, m, EV_GET)[1] // 1000 == OFF
 
 
 def test_state_timeout_not_cancelled_by_events(rig_t):
     """OTP state_timeout survives event arrival (only a transition
     cancels it): WORK events in ON do not keep it alive."""
     _, a, m = rig_t
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
+    assert _call(a, m, EV_FLIP) == (True, ON)
     for _ in range(3):
         a.event(m.id, EV_WORK, 1)
         _settle(a, m, 2)
     _settle(a, m, STATE_TIMEOUT)
-    assert a.call(m.id, EV_GET, machine=m)[1] // 1000 == OFF
+    assert _call(a, m, EV_GET)[1] // 1000 == OFF
 
 
 def test_state_timeout_cancelled_by_transition(rig_t):
     """Flip ON->OFF before the deadline: no spurious later timeout, and
     a fresh ON arms a FRESH timer."""
     _, a, m = rig_t
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, OFF)  # cancels
+    assert _call(a, m, EV_FLIP) == (True, ON)
+    assert _call(a, m, EV_FLIP) == (True, OFF)  # cancels
     _settle(a, m, STATE_TIMEOUT + 2)
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)   # fresh timer
+    assert _call(a, m, EV_FLIP) == (True, ON)   # fresh timer
     _settle(a, m, 2)
-    assert a.call(m.id, EV_GET, machine=m)[1] // 1000 == ON
+    assert _call(a, m, EV_GET)[1] // 1000 == ON
 
 
 def test_event_timeout_cancelled_by_any_event(rig):
     _, a, m, _ = rig
-    assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
-    assert a.call(m.id, EV_ARM_IDLE, machine=m) == (True, 0)
+    assert _call(a, m, EV_FLIP) == (True, ON)
+    assert _call(a, m, EV_ARM_IDLE) == (True, 0)
     a.event(m.id, EV_TICK)          # any event cancels the idle timer
     _settle(a, m, IDLE_TIMEOUT + 3)
-    assert a.call(m.id, EV_GET, machine=m)[1] // 1000 == ON
+    assert _call(a, m, EV_GET)[1] // 1000 == ON
     # the GET above was itself an event — idle timer stays cancelled
     _settle(a, m, IDLE_TIMEOUT + 3)
-    assert a.call(m.id, EV_GET, machine=m)[1] // 1000 == ON
+    assert _call(a, m, EV_GET)[1] // 1000 == ON
 
 
 def test_event_timeout_fires_when_idle():
     srv = bridge_rig(4)
     try:
-        a = ClientVM(srv, 0)
-        m = StatemVM(srv, 1)       # no state_timeout: isolate idle timer
-        assert a.call(m.id, EV_FLIP, machine=m) == (True, ON)
-        assert a.call(m.id, EV_ARM_IDLE, machine=m) == (True, 0)
+        a = gen.Caller(BridgeVM(srv, 0))
+        m = GenStatem(BridgeVM(srv, 1), Switch())  # no state_timeout
+        assert _call(a, m, EV_FLIP) == (True, ON)
+        assert _call(a, m, EV_ARM_IDLE) == (True, 0)
         for _ in range(IDLE_TIMEOUT + 2):
             m.process(a.step(1))   # silence
-        assert a.call(m.id, EV_GET, machine=m)[1] // 1000 == OFF
+        assert _call(a, m, EV_GET)[1] // 1000 == OFF
         a.close()
         m.close()
     finally:

@@ -2,13 +2,14 @@
 
 The reference ships a patched OTP supervisor
 (priv/otp/24/partisan_gen_supervisor.erl, 1850 LoC) with a conformance
-suite (test/partisan_supervisor_SUITE.erl, 3755 LoC).  This suite ports
-~9 representative behaviors at the semantics level: a supervisor process
-on one emulated BEAM node manages child processes hosted on OTHER nodes,
-with START/STOP orders and EXIT notifications riding the real bridge
-transport (the cross-node supervision partisan_gen_supervisor enables).
+suite (test/partisan_supervisor_SUITE.erl, 3755 LoC).  This suite runs
+the PACKAGE implementation (partisan_tpu.otp.supervisor) over the
+bridge transport: a supervisor process on one emulated BEAM node
+manages child processes hosted on OTHER nodes, with START/STOP orders
+and EXIT notifications riding the real transport (the cross-node
+supervision partisan_gen_supervisor enables).  ~10 representative
+behaviors at the semantics level:
 
-Covered semantics (OTP supervisor reference behavior):
 - one_for_one: only the crashed child restarts,
 - rest_for_one: the crashed child and those started AFTER it restart —
   later children stopped in reverse start order, restarted in order,
@@ -22,155 +23,12 @@ Covered semantics (OTP supervisor reference behavior):
 - stale EXIT from a superseded incarnation is ignored.
 """
 
-import pytest
-
 from support import BridgeVM, bridge_rig
 
-OP_START, OP_STOP, OP_EXIT = 10, 11, 12
-NORMAL, CRASH = 0, 1
-PERMANENT, TRANSIENT, TEMPORARY = 0, 1, 2
-
-ONE_FOR_ONE, REST_FOR_ONE, ONE_FOR_ALL = "one_for_one", "rest_for_one", \
-    "one_for_all"
-
-
-class HostVM(BridgeVM):
-    """A node hosting child processes: obeys START/STOP, reports EXITs."""
-
-    def __init__(self, srv, sim_id):
-        super().__init__(srv, sim_id)
-        self.running = {}          # child_id -> incarnation
-        self.log = []              # (op, child, inc) in receive order
-
-    def process(self):
-        for src, words in self.drain():
-            op, child, inc = words[0], words[1], words[2]
-            if op == OP_START:
-                self.running[child] = inc
-                self.log.append(("start", child, inc))
-            elif op == OP_STOP:
-                self.running.pop(child, None)
-                self.log.append(("stop", child, inc))
-
-    def kill(self, sup_id, child, reason=CRASH):
-        """Child dies (test-injected): report EXIT to the supervisor with
-        its incarnation — the monitor/link DOWN the reference delivers."""
-        inc = self.running.pop(child, None)
-        if inc is not None:
-            self.forward(sup_id, [OP_EXIT, child, inc, reason])
-
-
-class SupervisorVM(BridgeVM):
-    """The partisan_gen_supervisor loop (one supervisor process)."""
-
-    def __init__(self, srv, sim_id, specs, strategy=ONE_FOR_ONE,
-                 max_r=3, max_t=20):
-        """specs: ordered [(child_id, host_sim_id, restart_type)]."""
-        super().__init__(srv, sim_id)
-        self.specs = list(specs)
-        self.strategy = strategy
-        self.max_r, self.max_t = max_r, max_t
-        self.inc = {c: 0 for c, _, _ in specs}       # current incarnation
-        self.up = {c: False for c, _, _ in specs}
-        self.restarts = []                           # rounds of restarts
-        self.terminated = False
-        self.rnd = 0
-
-    # -- child plumbing -------------------------------------------------
-    def _host(self, child):
-        for c, h, _ in self.specs:
-            if c == child:
-                return h
-        return None
-
-    def _type(self, child):
-        for c, _, t in self.specs:
-            if c == child:
-                return t
-        return None
-
-    def _start(self, child):
-        self.inc[child] += 1
-        self.up[child] = True
-        self.forward(self._host(child), [OP_START, child, self.inc[child]])
-
-    def _stop(self, child):
-        self.up[child] = False
-        self.forward(self._host(child), [OP_STOP, child, self.inc[child]])
-
-    def start_all(self):
-        for c, _, _ in self.specs:           # start order = spec order
-            self._start(c)
-
-    # -- the supervisor loop --------------------------------------------
-    def process(self, rnd):
-        self.rnd = rnd
-        for _src, words in self.drain():
-            if words[0] != OP_EXIT or self.terminated:
-                continue
-            child, inc, reason = words[1], words[2], words[3]
-            if child not in self.inc or inc != self.inc[child]:
-                continue                     # stale incarnation: ignore
-            if not self.up[child]:
-                continue
-            self.up[child] = False
-            rtype = self._type(child)
-            if rtype == TEMPORARY:
-                # temporary children are never restarted and their spec
-                # is discarded (OTP supervisor reference)
-                self.specs = [s for s in self.specs if s[0] != child]
-                del self.inc[child], self.up[child]
-                continue
-            if rtype == TRANSIENT and reason == NORMAL:
-                continue                     # normal exit: no restart
-            self._restart(child)
-
-    def _restart(self, child):
-        self.restarts.append(self.rnd)
-        window = [r for r in self.restarts if r > self.rnd - self.max_t]
-        if len(window) > self.max_r:
-            # intensity exceeded: give up — stop all children (reverse
-            # start order), terminate the supervisor itself
-            for c, _, _ in reversed(self.specs):
-                if self.up[c]:
-                    self._stop(c)
-            self.terminated = True
-            return
-        order = [c for c, _, _ in self.specs]
-        if self.strategy == ONE_FOR_ONE:
-            self._start(child)
-            return
-        idx = order.index(child)
-        victims = order[idx + 1:] if self.strategy == REST_FOR_ONE \
-            else [c for c in order if c != child]
-        for c in reversed(victims):          # stop in reverse start order
-            if self.up[c]:
-                self._stop(c)
-        for c in order:                      # restart in start order
-            if c == child or c in victims:
-                self._start(c)
-
-    # -- admin API (supervisor:which_children/3 etc.) -------------------
-    def which_children(self):
-        return [(c, self.inc[c], self.up[c]) for c, _, _ in self.specs]
-
-    def count_children(self):
-        return {"specs": len(self.specs),
-                "active": sum(self.up.values())}
-
-    def restart_child(self, child):
-        if not self.up.get(child, True):
-            self._start(child)
-            return True
-        return False
-
-    def delete_child(self, child):
-        if self.up.get(child):
-            return False                     # only stopped children
-        self.specs = [s for s in self.specs if s[0] != child]
-        self.inc.pop(child, None)
-        self.up.pop(child, None)
-        return True
+from partisan_tpu.otp import gen
+from partisan_tpu.otp.supervisor import (
+    CRASH, NORMAL, ONE_FOR_ALL, ONE_FOR_ONE, PERMANENT, REST_FOR_ONE,
+    TEMPORARY, TRANSIENT, ChildHost, Supervisor)
 
 
 def _pump(sup, host, k=4, *, hosts=None):
@@ -183,11 +41,11 @@ def _pump(sup, host, k=4, *, hosts=None):
 
 def _rig(strategy, types=(PERMANENT, PERMANENT, PERMANENT), **kw):
     srv = bridge_rig(4)
-    host = HostVM(srv, 1)
-    sup = SupervisorVM(srv, 0,
-                       [(10, 1, types[0]), (11, 1, types[1]),
-                        (12, 1, types[2])],
-                       strategy=strategy, **kw)
+    host = ChildHost(BridgeVM(srv, 1))
+    sup = Supervisor(BridgeVM(srv, 0),
+                     [(10, 1, types[0]), (11, 1, types[1]),
+                      (12, 1, types[2])],
+                     strategy=strategy, **kw)
     sup.start_all()
     _pump(sup, host, 4)
     assert host.running == {10: 1, 11: 1, 12: 1}
@@ -322,7 +180,7 @@ def test_stale_exit_from_old_incarnation_ignored():
         host.kill(sup.id, 11)                # EXIT inc=1
         _pump(sup, host, 5)
         assert host.running[11] == 2
-        host.forward(sup.id, [OP_EXIT, 11, 1, CRASH])   # stale replay
+        host.forward(sup.id, [gen.OP_EXIT, 11, 1, CRASH])  # stale replay
         _pump(sup, host, 5)
         assert host.running[11] == 2         # unchanged
     finally:
@@ -334,10 +192,11 @@ def test_rest_for_one_across_two_host_nodes():
     bridge transport across the cluster."""
     srv = bridge_rig(4)
     try:
-        h1, h2 = HostVM(srv, 1), HostVM(srv, 2)
-        sup = SupervisorVM(srv, 0, [(10, 1, PERMANENT), (11, 2, PERMANENT),
-                                    (12, 1, PERMANENT)],
-                           strategy=REST_FOR_ONE)
+        h1, h2 = ChildHost(BridgeVM(srv, 1)), ChildHost(BridgeVM(srv, 2))
+        sup = Supervisor(BridgeVM(srv, 0),
+                         [(10, 1, PERMANENT), (11, 2, PERMANENT),
+                          (12, 1, PERMANENT)],
+                         strategy=REST_FOR_ONE)
         sup.start_all()
         _pump(sup, h1, 4, hosts=[h1, h2])
         assert h1.running == {10: 1, 12: 1} and h2.running == {11: 1}
@@ -345,7 +204,7 @@ def test_rest_for_one_across_two_host_nodes():
         _pump(sup, h1, 6, hosts=[h1, h2])
         assert h2.running == {11: 2}
         assert h1.running == {10: 1, 12: 2}  # 12 restarted, 10 untouched
-        for vm in (h1, h2, sup):
-            vm.close()
+        for p in (h1, h2, sup):
+            p.close()
     finally:
         srv.close()

@@ -1,0 +1,153 @@
+"""In-sim vectorized gen_server (partisan_tpu.otp.gen_sim): the
+partisan_gen call protocol (priv/otp/24/partisan_gen.erl:360-400) run
+INSIDE the jitted round — one counter gen_server per node, stacked with
+the monitor service, calls riding the event exchange.
+
+Covers the call / timeout / DOWN triad the reference's call path
+implements, plus cast, server serialization order, and stop semantics.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.stack import Stack
+from partisan_tpu.otp import gen_sim
+from partisan_tpu.otp.gen_sim import (
+    FN_GET, FN_INCR, FN_STOP, GenServerService)
+
+N = 6
+
+
+def build(**cfg_kw):
+    svc = GenServerService()
+    stack = Stack([svc])
+    cfg = Config(n_nodes=N, seed=17, inbox_cap=48, **cfg_kw)
+    cl = Cluster(cfg, model=stack)
+    st = cl.init()
+    for i in range(1, N):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    st = cl.steps(st, 5)
+    return cl, stack, svc, st
+
+
+def _sub(stack, st):
+    return stack.sub(st.model, 0)
+
+
+def _put(stack, st, gs):
+    return st._replace(model=stack.replace_sub(st.model, 0, gs))
+
+
+def test_call_roundtrip_and_server_state_persists():
+    cl, stack, svc, st = build()
+    gs, r1 = svc.call(_sub(stack, st), caller=2, dst=4, fn=FN_INCR,
+                      arg=5, timeout_rounds=10, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 4)
+    assert svc.response(_sub(stack, st), 2, r1) == ("ok", 5)
+    # state persisted across calls: second incr sees the first
+    gs = svc.free(_sub(stack, st), 2, r1)
+    gs, r2 = svc.call(gs, caller=2, dst=4, fn=FN_INCR, arg=3,
+                      timeout_rounds=10, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 4)
+    assert svc.response(_sub(stack, st), 2, r2) == ("ok", 8)
+
+
+def test_same_round_calls_serialize_in_mailbox_order():
+    """Two calls landing in one round apply in inbox order; each reply
+    carries the counter as of ITS queue position (the gen_server
+    serialization the prefix-scan reproduces)."""
+    cl, stack, svc, st = build()
+    gs = _sub(stack, st)
+    gs, ra = svc.call(gs, caller=1, dst=4, fn=FN_INCR, arg=10,
+                      timeout_rounds=10, now=int(st.rnd))
+    gs, rb = svc.call(gs, caller=1, dst=4, fn=FN_INCR, arg=7,
+                      timeout_rounds=10, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 4)
+    va = svc.response(_sub(stack, st), 1, ra)[1]
+    vb = svc.response(_sub(stack, st), 1, rb)[1]
+    assert {va, vb} == {10, 17}      # distinct prefix values, total 17
+
+
+def test_get_observes_earlier_incr_same_round():
+    cl, stack, svc, st = build()
+    gs = _sub(stack, st)
+    gs, ri = svc.call(gs, caller=3, dst=5, fn=FN_INCR, arg=9,
+                      timeout_rounds=10, now=int(st.rnd))
+    gs, rg = svc.call(gs, caller=3, dst=5, fn=FN_GET, arg=0,
+                      timeout_rounds=10, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 4)
+    assert svc.response(_sub(stack, st), 3, ri) == ("ok", 9)
+    # the GET queued after the INCR (same sender FIFO) sees 9
+    assert svc.response(_sub(stack, st), 3, rg) == ("ok", 9)
+
+
+def test_cast_is_async_no_reply_slot():
+    cl, stack, svc, st = build()
+    gs = svc.cast(_sub(stack, st), caller=1, dst=4, fn=FN_INCR, arg=6)
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 3)
+    gs = _sub(stack, st)
+    assert int(gs.status[1].sum()) == 0          # slot freed, no reply
+    assert int(gs.counter[4]) == 6               # but it executed
+    gs, r = svc.call(gs, caller=1, dst=4, fn=FN_GET, arg=0,
+                     timeout_rounds=10, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 4)
+    assert svc.response(_sub(stack, st), 1, r) == ("ok", 6)
+
+
+def test_call_times_out_on_partition():
+    """No reply within the window -> timeout (the demonitor path);
+    late replies can no longer pair with the demonitored ref."""
+    cl, stack, svc, st = build()
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, [2], [4]))
+    gs, ref = svc.call(_sub(stack, st), caller=2, dst=4, fn=FN_INCR,
+                       arg=1, timeout_rounds=5, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 8)
+    assert svc.response(_sub(stack, st), 2, ref) == ("timeout", None)
+
+
+def test_call_aborts_with_down_when_destination_dies():
+    """Destination crashes mid-call -> DOWN, not a hang until timeout
+    (the partisan_gen monitor path)."""
+    cl, stack, svc, st = build()
+    st = st._replace(faults=faults_mod.crash(st.faults, 4))
+    gs, ref = svc.call(_sub(stack, st), caller=2, dst=4, fn=FN_INCR,
+                       arg=1, timeout_rounds=50, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 3)
+    assert svc.response(_sub(stack, st), 2, ref) == ("down", None)
+
+
+def test_stop_terminates_server_requests_after_unserved():
+    cl, stack, svc, st = build()
+    gs = _sub(stack, st)
+    gs, rs = svc.call(gs, caller=1, dst=4, fn=FN_STOP, arg=0,
+                      timeout_rounds=10, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 4)
+    assert svc.response(_sub(stack, st), 1, rs) == ("ok", 0)
+    # further calls to the stopped server never answer -> timeout
+    gs, r2 = svc.call(_sub(stack, st), caller=1, dst=4, fn=FN_GET,
+                      arg=0, timeout_rounds=5, now=int(st.rnd))
+    st = _put(stack, st, gs)
+    st = cl.steps(st, 8)
+    assert svc.response(_sub(stack, st), 1, r2) == ("timeout", None)
+
+
+def test_call_table_overflow_raises():
+    cl, stack, svc, st = build()
+    gs = _sub(stack, st)
+    for i in range(svc.cap):
+        gs, _ = svc.call(gs, 0, 1, FN_INCR, i, 10, int(st.rnd))
+    with pytest.raises(RuntimeError):
+        svc.call(gs, 0, 1, FN_INCR, 99, 10, int(st.rnd))
