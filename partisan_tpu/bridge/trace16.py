@@ -126,6 +126,11 @@ def run_trace16(seed: int = 16) -> dict:
                 break
         return {"n": N, "seed": seed, "fanout": FANOUT,
                 "rumor": RUMOR, "origin": ORIGIN,
+                # the byte-exact trace depends on numpy's Generator
+                # bit-stream (rng.choice), which numpy does NOT
+                # guarantee stable across releases — record the version
+                # so the exact-equality check can gate on it
+                "numpy_version": np.__version__,
                 "convergence_rounds": converged, "rows": trace}
     finally:
         for vm in vms:

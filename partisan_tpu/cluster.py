@@ -207,6 +207,12 @@ class Cluster:
     def __post_init__(self) -> None:
         if self.manager is None:
             self.manager = managers_mod.get(self.cfg.peer_service_manager)
+        # egress/ingress delay config keys install a send-path Delay
+        # stage after any user-supplied interposition chain
+        from partisan_tpu import interpose as interpose_mod
+
+        self.interpose = interpose_mod.config_delays(self.cfg,
+                                                     self.interpose)
         self.comm = LocalComm(
             n_global=self.cfg.n_nodes,
             inbox_cap=self.cfg.inbox_cap,

@@ -114,6 +114,39 @@ class PlumtreeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DistanceConfig:
+    """Distance/RTT metrics plane (reference ping/pong distance metrics:
+    partisan_pluggable_peer_service_manager.erl:1355-1378 schedules pings
+    on the ``distance`` timer; :1716-1737 folds the pong's microsecond
+    diff into a per-peer distance map).
+
+    The sim has no wire clock, so RTTs are measured THROUGH a modeled
+    link geometry: a PING's responder holds its PONG for the edge's
+    modeled round-trip (``2 x latency_rounds``) before sending, and the
+    prober records ``receive_round - send_round`` — a real message-plane
+    measurement (pongs cross the fault stage and can be lost), not an
+    analytic echo of the model.
+    """
+
+    enabled: bool = False
+    model: str = "ring"         # ring | hash — the link-latency geometry:
+    #                             ring = distance on the id circle scaled
+    #                             to max_latency_rounds (a real geometry
+    #                             X-BOT can optimize toward); hash = the
+    #                             per-edge uniform hash (matches the
+    #                             X-BOT synthetic oracle)
+    max_latency_rounds: int = 4  # one-way modeled latency ceiling
+    cache: int = 16              # RTT cache entries per node
+    #                              (direct-mapped by peer id)
+    pong_buf: int = 16           # pending delayed pongs per node
+    probe_passive: int = 2       # passive candidates probed per tick
+    #                              (hyparview — fills the cache for X-BOT)
+    xbot_oracle: bool = False    # X-BOT consults MEASURED RTTs (modeled
+    #                              expectation as fallback for unprobed
+    #                              peers) instead of the hash oracle
+
+
+@dataclasses.dataclass(frozen=True)
 class ScampConfig:
     """SCAMP parameters (include/partisan.hrl:240-241)."""
 
@@ -156,6 +189,22 @@ class Config:
     exchange_tick_ms: int = 10_000       # plumtree AAE
     distance_interval_ms: int = 10_000   # ping/pong RTT probing
 
+    # --- send/receive path delay (test plane) --------------------------
+    # First-class keys installing an interpose.Delay on every event
+    # message (reference egress_delay: partisan_peer_service_client.erl
+    # :148-153; ingress_delay: partisan_peer_service_server.erl:95-100).
+    # Both are modeled on the send path, so they compose additively into
+    # one hold of rounds(egress)+rounds(ingress) per message;
+    # transmission faults are evaluated at release round (documented
+    # timing transposition — the wire has no separate receive stage).
+    egress_delay_ms: int = 0
+    ingress_delay_ms: int = 0
+    delay_buf_cap: int = 0        # per-node hold-buffer slots for the
+    #                               delay stage (0 = auto: 2 x rounds x
+    #                               max(inbox_cap, emit_cap)); the stage
+    #                               counts overflow pass-throughs in its
+    #                               state's `missed` field
+
     # --- delivery semantics knobs --------------------------------------
     relay_ttl: int = 5                   # include/partisan.hrl:138
     broadcast: bool = True               # transitive tree relay enabled
@@ -185,6 +234,7 @@ class Config:
     hyparview: HyParViewConfig = HyParViewConfig()
     scamp: ScampConfig = ScampConfig()
     plumtree: PlumtreeConfig = PlumtreeConfig()
+    distance: DistanceConfig = DistanceConfig()
 
     # --- tensor capacities (sim-specific) ------------------------------
     inbox_cap: int = 32          # queued event messages per node per round
@@ -265,6 +315,24 @@ class Config:
             raise ValueError(
                 f"partition_mode {self.partition_mode!r} not in "
                 f"('auto', 'dense', 'groups')")
+        if self.distance.model not in ("ring", "hash"):
+            raise ValueError(
+                f"distance.model {self.distance.model!r} not in "
+                f"('ring', 'hash')")
+        if not self.channel_capacity:
+            # No silent no-op parity configs: a channel declaring
+            # parallelism > 1 without capacity enforcement would be
+            # decorative (the reference's parallelism is N real TCP
+            # conns — partisan_peer_connections.erl:897-925).
+            loud = [c.name for c in self.channels if c.parallelism > 1]
+            if loud:
+                import warnings
+
+                warnings.warn(
+                    f"channels {loud} declare parallelism > 1 but "
+                    f"channel_capacity enforcement is off — parallelism "
+                    f"is advisory (set channel_capacity=True to enforce "
+                    f"per-lane throughput)", stacklevel=2)
 
     # --- channel helpers (partisan_config:channels/0, :82-101) ---------
     @property
@@ -332,6 +400,24 @@ class Config:
     def xbot_every(self) -> int:
         return self.rounds(self.hyparview.xbot_interval_ms)
 
+    @property
+    def send_delay_rounds(self) -> int:
+        """Total send-path hold installed by the egress/ingress delay
+        keys (0 = no delay stage)."""
+        r = 0
+        if self.egress_delay_ms > 0:
+            r += self.rounds(self.egress_delay_ms)
+        if self.ingress_delay_ms > 0:
+            r += self.rounds(self.ingress_delay_ms)
+        return r
+
+    @property
+    def distance_every(self) -> int:
+        """Ping cadence of the distance metrics plane (the reference's
+        ``distance`` timer, partisan_pluggable_peer_service_manager.erl
+        :1355-1378)."""
+        return self.rounds(self.distance_interval_ms)
+
     # --- construction helpers -----------------------------------------
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -351,4 +437,6 @@ class Config:
             d["scamp"] = ScampConfig(**d["scamp"])
         if "plumtree" in d and isinstance(d["plumtree"], Mapping):
             d["plumtree"] = PlumtreeConfig(**d["plumtree"])
+        if "distance" in d and isinstance(d["distance"], Mapping):
+            d["distance"] = DistanceConfig(**d["distance"])
         return cls(**d)

@@ -1,0 +1,222 @@
+"""Distance/RTT metrics plane.
+
+Reference: the pluggable manager schedules pings on the ``distance``
+timer (partisan_pluggable_peer_service_manager.erl:1355-1378) and folds
+each pong's microsecond diff into a per-peer distance map (:1716-1737);
+HyParView's X-BOT uses live RTT comparisons as its optimization oracle
+(partisan_hyparview_peer_service_manager.erl:2978-3000).
+
+Sim transposition: RTTs are MEASURED through a modeled link geometry —
+
+1. on the ``distance_interval_ms`` cadence (Config.distance_every) a
+   node emits ``PING`` (payload: send round) to its probe targets,
+2. the responder holds the ``PONG`` for the edge's modeled round trip
+   (``2 x latency_rounds``) in a pending buffer, then sends it with the
+   echoed send round,
+3. the prober records ``receive_round - send_round`` into a
+   direct-mapped per-peer RTT cache.
+
+The pong rides the real message plane: it crosses the fault stage, a
+crashed responder never sends it, and an omitted pong simply leaves the
+cache stale — measurement, not an analytic echo of the model (the
+PERF_ECHO lesson).  Consumers: :func:`telemetry.distance_metrics`
+surfaces the cache host-side; HyParView's X-BOT consults it when
+``DistanceConfig.xbot_oracle`` is set (managers/hyparview.py).
+
+Two embeddings share this code: HyParView carries a
+:class:`DistanceState` inside its manager state (the reference keeps
+distance state in the manager), and :class:`DistanceService` is a
+stackable model for any other manager (fullmesh/static/client-server),
+probing the overlay's ``neighbors``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+_TAG_LAT = 351          # hash-model latency salt
+_TAG_PROBE = 352        # DistanceService neighbor sampling
+
+
+def latency_rounds(cfg: Config, a: Array, b: Array) -> Array:
+    """Modeled ONE-WAY latency of edge (a, b) in whole rounds, in
+    [0, max_latency_rounds].  Symmetric and stable across rounds.
+
+    - ``ring``: distance on the node-id circle, scaled so antipodal
+      pairs hit the ceiling — a real geometry an overlay optimizer can
+      converge toward.
+    - ``hash``: per-edge uniform hash — matches the spirit of X-BOT's
+      synthetic oracle (managers/hyparview.py link_cost).
+    """
+    from partisan_tpu import faults as faults_mod
+
+    d = cfg.distance
+    a = jnp.asarray(a, jnp.int32)
+    b = jnp.asarray(b, jnp.int32)
+    if d.model == "ring":
+        n = cfg.n_nodes
+        diff = jnp.abs(a - b)
+        ring = jnp.minimum(diff, n - diff)
+        # antipodal distance n//2 maps to max_latency_rounds
+        return (ring * d.max_latency_rounds * 2 + n // 2) // max(n, 1)
+    lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+    h = faults_mod.edge_hash(cfg.seed, jnp.int32(0), _TAG_LAT, lo, hi)
+    return (h % jnp.uint32(d.max_latency_rounds + 1)).astype(jnp.int32)
+
+
+def modeled_rtt(cfg: Config, a: Array, b: Array) -> Array:
+    """The RTT a measurement of edge (a, b) would find: two modeled
+    one-way hops plus the two scheduling rounds every exchange costs."""
+    return 2 * latency_rounds(cfg, a, b) + 2
+
+
+class DistanceState(NamedTuple):
+    pong_tgt: Array   # int32[n_local, B] — pending pong destination (-1)
+    pong_due: Array   # int32[n_local, B] — release round
+    pong_echo: Array  # int32[n_local, B] — echoed ping send round
+    rtt_node: Array   # int32[n_local, K] — cache key: peer id (-1 empty)
+    rtt_val: Array    # int32[n_local, K] — measured RTT in rounds
+
+
+def init(cfg: Config, comm: LocalComm) -> DistanceState:
+    n = comm.n_local
+    d = cfg.distance
+    return DistanceState(
+        pong_tgt=jnp.full((n, d.pong_buf), -1, jnp.int32),
+        pong_due=jnp.zeros((n, d.pong_buf), jnp.int32),
+        pong_echo=jnp.zeros((n, d.pong_buf), jnp.int32),
+        rtt_node=jnp.full((n, d.cache), -1, jnp.int32),
+        rtt_val=jnp.zeros((n, d.cache), jnp.int32),
+    )
+
+
+def step(cfg: Config, comm: LocalComm, st: DistanceState, ctx: RoundCtx,
+         targets: Array) -> tuple[DistanceState, Array]:
+    """One round of the metrics plane.  ``targets`` int32[n_local, P]
+    are the peers to probe when this node's distance tick fires (-1
+    pads).  Returns (state', emitted)."""
+    d = cfg.distance
+    n, B = st.pong_tgt.shape
+    K = st.rtt_node.shape[1]
+    gids = comm.local_ids()
+    inb = ctx.inbox.data
+    kind = inb[..., T.W_KIND]
+    src = inb[..., T.W_SRC]
+    echo = inb[..., T.P0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    # ---- 1. release due pongs (round-start buffers) -------------------
+    # Release BEFORE scheduling this round's arrivals, so a mature pong
+    # departs before a re-ping could claim its slot.
+    ripe = (st.pong_tgt >= 0) & (st.pong_due <= ctx.rnd) \
+        & ctx.alive[:, None]
+    pongs = msg_ops.build(
+        cfg.msg_words, T.MsgKind.PONG, gids[:, None],
+        jnp.where(ripe, st.pong_tgt, -1), payload=(st.pong_echo,))
+    pong_tgt = jnp.where(ripe, -1, st.pong_tgt)
+
+    # ---- 2. inbound PING -> schedule a delayed PONG -------------------
+    # Pending-pong slots are direct-mapped by pinger id.  A slot still
+    # holding an immature pong is NOT overwritten (a faster re-ping
+    # cadence than the edge's modeled RTT must not keep pushing the
+    # deadline out — the pending measurement completes, the re-ping is
+    # dropped and the pinger simply probes again next tick).
+    is_ping = (kind == T.MsgKind.PING) & ctx.alive[:, None]
+    cap = inb.shape[1]
+    r2 = jnp.broadcast_to(rows[:, None], (n, cap))
+    slot_free = jnp.take_along_axis(
+        pong_tgt, jnp.where(is_ping, src % B, 0), axis=1) < 0
+    take = is_ping & slot_free
+    slot = jnp.where(take, src % B, B)                 # B = discard
+    hold = ctx.rnd + 2 * latency_rounds(
+        cfg, jnp.broadcast_to(gids[:, None], src.shape), src)
+    pong_tgt = pong_tgt.at[r2, slot].set(
+        jnp.where(take, src, -1), mode="drop")
+    pong_due = st.pong_due.at[r2, slot].set(hold, mode="drop")
+    pong_echo = st.pong_echo.at[r2, slot].set(echo, mode="drop")
+
+    # ---- 3. inbound PONG -> cache the measured RTT --------------------
+    is_pong = (kind == T.MsgKind.PONG) & ctx.alive[:, None]
+    rtt = ctx.rnd - echo
+    cidx = jnp.where(is_pong, src % K, K)
+    rtt_node = st.rtt_node.at[r2, cidx].set(
+        jnp.where(is_pong, src, -1), mode="drop")
+    rtt_val = st.rtt_val.at[r2, cidx].set(rtt, mode="drop")
+
+    # ---- 4. distance tick: emit pings ---------------------------------
+    fire = ((ctx.rnd + gids) % cfg.distance_every == 0) & ctx.alive
+    ping_dst = jnp.where(fire[:, None] & (targets >= 0)
+                         & (targets != gids[:, None]), targets, -1)
+    pings = msg_ops.build(
+        cfg.msg_words, T.MsgKind.PING, gids[:, None], ping_dst,
+        payload=(jnp.broadcast_to(ctx.rnd, ping_dst.shape),))
+
+    emitted = jnp.concatenate([pongs, pings], axis=1)
+    return DistanceState(pong_tgt=pong_tgt, pong_due=pong_due,
+                         pong_echo=pong_echo, rtt_node=rtt_node,
+                         rtt_val=rtt_val), emitted
+
+
+def lookup_rows(st: DistanceState, peers: Array) -> tuple[Array, Array]:
+    """Row-aligned cache lookup: ``peers`` int32[n_local, X] ->
+    (rtt int32[n_local, X], hit bool[n_local, X])."""
+    K = st.rtt_node.shape[1]
+    idx = jnp.where(peers >= 0, peers % K, 0)
+    node_at = jnp.take_along_axis(st.rtt_node, idx, axis=1)
+    val_at = jnp.take_along_axis(st.rtt_val, idx, axis=1)
+    hit = (peers >= 0) & (node_at == peers)
+    return jnp.where(hit, val_at, 0), hit
+
+
+def measured_or_modeled(cfg: Config, st: DistanceState, me: Array,
+                        peers: Array) -> Array:
+    """X-BOT oracle cost: the measured RTT where cached, else the
+    modeled expectation (what a measurement of that edge would find —
+    the reference's is_better pings on demand, :2978-3000; the sim
+    substitutes the model it would measure).  float32, row-aligned."""
+    val, hit = lookup_rows(st, peers)
+    fb = modeled_rtt(cfg, me, jnp.maximum(peers, 0))
+    return jnp.where(hit, val, fb).astype(jnp.float32)
+
+
+class DistanceService:
+    """Stackable model embedding the metrics plane over any manager's
+    overlay (the pluggable-manager distance plane analogue): probes up
+    to ``probe_k`` of the round's ``neighbors``."""
+
+    name = "distance"
+
+    def __init__(self, probe_k: int = 8) -> None:
+        self.probe_k = probe_k
+
+    def init(self, cfg: Config, comm: LocalComm) -> DistanceState:
+        return init(cfg, comm)
+
+    def step(self, cfg: Config, comm: LocalComm, st: DistanceState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[DistanceState, Array]:
+        from partisan_tpu.ops import rng
+
+        if nbrs.shape[1] <= self.probe_k:
+            targets = nbrs
+        else:
+            # uniform sample of probe_k live neighbor slots (a fullmesh
+            # neighbor row is id-positional — a head slice would only
+            # ever probe the lowest ids)
+            gids = comm.local_ids()
+            r = rng.rank32(cfg.seed, ctx.rnd, _TAG_PROBE, gids[:, None],
+                           jnp.arange(nbrs.shape[1])[None, :])
+            sc = jnp.where(nbrs >= 0, r | jnp.uint32(1), jnp.uint32(0))
+            v, top = jax.lax.top_k(sc, self.probe_k)
+            targets = jnp.where(v > 0,
+                                jnp.take_along_axis(nbrs, top, axis=1), -1)
+        return step(cfg, comm, st, ctx, targets)

@@ -170,27 +170,35 @@ class Delay:
     pred: Callable[[Config, RoundCtx, Array], Array]
     rounds: int = 1
     cap: int = 8
+    mark_flag: int = T.F_RETRANSMISSION  # flag OR'd onto released
+    #                                      messages so preds can skip them
 
     def init(self, cfg: Config, comm: Any) -> Any:
         n = comm.n_local
         return {
             "buf": jnp.zeros((n, self.cap, cfg.msg_words), jnp.int32),
             "due": jnp.full((n, self.cap), -1, jnp.int32),  # release round
+            # overflow accounting: matching messages that passed through
+            # UNDELAYED because the hold buffer was full — a nonzero
+            # count means `cap` is undersized for the traffic (surfaced,
+            # never silent)
+            "missed": jnp.int32(0),
         }
 
     def specs(self, shard, repl):
-        return {"buf": shard, "due": shard}
+        return {"buf": shard, "due": shard, "missed": repl}
 
     def apply(self, cfg, comm, state, emitted, ctx):
         n, e, w = emitted.shape
         buf, due = state["buf"], state["due"]
+        missed0 = state.get("missed", jnp.int32(0))
 
         # 1. Release matured messages (due in (0, rnd]).
         ripe = (due >= 0) & (due <= ctx.rnd)
         released = _drop_where(buf, ~ripe)
         # Mark released as re-injected so a re-applied pred can skip them.
         released = released.at[..., T.W_FLAGS].set(jnp.where(
-            ripe, released[..., T.W_FLAGS] | T.F_RETRANSMISSION,
+            ripe, released[..., T.W_FLAGS] | self.mark_flag,
             released[..., T.W_FLAGS]))
         buf = _drop_where(buf, ripe)
         due = jnp.where(ripe, -1, due)
@@ -219,7 +227,43 @@ class Delay:
 
         # 3. Append released messages to this round's emissions.
         out = jnp.concatenate([emitted, released], axis=1)
-        return {"buf": buf, "due": due}, out
+        missed = missed0 + comm.allsum(
+            jnp.sum(hold & ~can, dtype=jnp.int32))
+        return {"buf": buf, "due": due, "missed": missed}, out
+
+
+def _not_yet_released(cfg: Config, ctx: RoundCtx, emitted: Array) -> Array:
+    """Every live message on its first send-path pass (skips messages
+    the config delay stage already released)."""
+    return (emitted[..., T.W_KIND] != 0) \
+        & ((emitted[..., T.W_FLAGS] & T.F_DELAY_RELEASED) == 0)
+
+
+def config_delays(cfg: Config, inner: Any = None) -> Any:
+    """Install the ``egress_delay_ms`` / ``ingress_delay_ms`` config keys
+    as a send-path Delay stage (reference
+    partisan_peer_service_client.erl:148-153 /
+    partisan_peer_service_server.erl:95-100 — see the key docs in
+    config.py for the composition semantics).  Returns ``inner``
+    unchanged when neither key is set; otherwise the delay runs AFTER
+    any user-supplied interposition chain, matching the reference's
+    connection-process placement (delays fire after the manager's
+    interposition funs).
+
+    The hold buffer is sized by SEND-side volume (rounds in flight x a
+    generous per-node emission bound — inbox_cap limits the receive
+    queue, not a sender's fan-out); size it explicitly with
+    ``cfg.delay_buf_cap`` for hub-heavy workloads and watch the delay
+    state's ``missed`` counter — a nonzero value means some matching
+    messages passed through undelayed because the buffer was full."""
+    rounds = cfg.send_delay_rounds
+    if rounds == 0:
+        return inner
+    cap = cfg.delay_buf_cap or max(64, 2 * rounds
+                                   * max(cfg.inbox_cap, cfg.emit_cap))
+    delay = Delay(pred=_not_yet_released, rounds=rounds, cap=cap,
+                  mark_flag=T.F_DELAY_RELEASED)
+    return delay if inner is None else Chain([inner, delay])
 
 
 @dataclasses.dataclass(frozen=True)

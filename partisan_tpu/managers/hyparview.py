@@ -60,12 +60,13 @@ identities.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import Array
 
+from partisan_tpu import distance as distance_mod
 from partisan_tpu import types as T
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
@@ -104,6 +105,8 @@ _TAG_PSEL = 320
 _TAG_REJOIN = 321
 _TAG_HBSEED = 322
 _TAG_HBJIT = 323
+_TAG_DPROBE = 324
+_TAG_HBFALL = 325
 
 
 def link_cost(seed: int, a, b):
@@ -140,6 +143,10 @@ class HyParViewState(NamedTuple):
     hb_rnd: Array       # int32[n_local] — round the epoch last advanced
     #                     (or the node joined); staleness beyond the
     #                     isolation window triggers a discovery rejoin
+    dist: Any = ()      # distance.DistanceState when the RTT metrics
+    #                     plane is enabled (Config.distance.enabled) —
+    #                     the reference keeps distance state in the
+    #                     manager (:1355-1378)
 
 
 class HyParView:
@@ -163,6 +170,8 @@ class HyParView:
             joined=jnp.zeros((n,), jnp.bool_),
             hb_epoch=jnp.zeros((n,), jnp.int32),
             hb_rnd=jnp.zeros((n,), jnp.int32),
+            dist=(distance_mod.init(cfg, comm)
+                  if cfg.distance.enabled else ()),
         )
 
     # ------------------------------------------------------------------
@@ -271,6 +280,24 @@ class HyParView:
             col = jnp.where(v > 0, b.astype(jnp.int32), -1)
             return ids, col
 
+        # X-BOT latency oracle: the synthetic per-pair hash by default;
+        # with the distance plane's xbot_oracle, MEASURED RTTs from the
+        # round-start cache (modeled expectation for unprobed peers —
+        # the reference's is_better pings on demand, :2978-3000).
+        use_measured = (hv.xbot and cfg.distance.enabled
+                        and cfg.distance.xbot_oracle)
+
+        def cost(a2, b2):
+            if not use_measured:
+                return link_cost(cfg.seed, a2, b2)
+            b_arr = jnp.asarray(b2)
+            if b_arr.ndim == 1:
+                return distance_mod.measured_or_modeled(
+                    cfg, state.dist, jnp.reshape(a2, (-1, 1)),
+                    b_arr[:, None])[:, 0]
+            return distance_mod.measured_or_modeled(cfg, state.dist, a2,
+                                                    b_arr)
+
         # ---- 1. removals ---------------------------------------------
         disc_src = jnp.where(is_disc, src, -1)
         removed = jnp.any(
@@ -285,9 +312,8 @@ class HyParView:
             is_xswr = kind == T.MsgKind.HPV_XBOT_SWITCH_REPLY  # at d
             is_xrepr = kind == T.MsgKind.HPV_XBOT_REPLACE_REPLY  # at c
             costs0 = jnp.where(active0 >= 0,
-                               link_cost(cfg.seed,
-                                         jnp.broadcast_to(me2, active0.shape),
-                                         jnp.maximum(active0, 0)), -jnp.inf)
+                               cost(jnp.broadcast_to(me2, active0.shape),
+                                    jnp.maximum(active0, 0)), -jnp.inf)
             zslot = jnp.argmax(costs0, axis=1)
             z = jnp.where(jnp.any(active0 >= 0, axis=1),
                           jnp.take_along_axis(
@@ -301,8 +327,8 @@ class HyParView:
                 & (z >= 0)[:, None]
             # d side (REPLACE): switch to o only if o beats c for ME
             xrep_sw = is_xrep & (p0 >= 0) \
-                & (link_cost(cfg.seed, me2, jnp.maximum(p0, 0))
-                   < link_cost(cfg.seed, me2, jnp.maximum(p2w, 0)))
+                & (cost(me2, jnp.maximum(p0, 0))
+                   < cost(me2, jnp.maximum(p2w, 0)))
             xrep_no = is_xrep & ~xrep_sw
             # o side (SWITCH): accept iff the initiator really is ours
             xsw_acc = is_xsw & slot_in(active0, p1)
@@ -663,6 +689,17 @@ class HyParView:
             seedc = (ranked(_TAG_HBSEED, gids)
                      % jnp.uint32(sc)).astype(jnp.int32)
             seedc = jnp.where(seedc == gids, (seedc + 1) % sc, seedc)
+            # Seed-death fallback: with every discovery seed crashed, a
+            # stale component would retry dead seeds forever — fall back
+            # to a random full-range contact (the auto_rejoin picker's
+            # range).  Liveness of the seed is ground truth the
+            # discovery agent would learn from its connection failure.
+            ng = jnp.uint32(max(comm.n_global - 1, 1))
+            fallb = (ranked(_TAG_HBFALL, gids) % ng).astype(jnp.int32)
+            fallb = fallb + (fallb >= gids)
+            seed_dead = ~ctx.faults.alive[jnp.clip(seedc, 0,
+                                                   comm.n_global - 1)]
+            seedc = jnp.where(seed_dead, fallb, seedc)
             join_dst = jnp.where(stale_hb & (join_dst < 0), seedc,
                                  join_dst)
         do_join = join_dst >= 0
@@ -691,14 +728,26 @@ class HyParView:
         if hv.xbot:
             cand = row_ranked(passive0, _TAG_XCAND, 1,
                               exclude=active0)[:, 0]
-            cost_cand = link_cost(cfg.seed, gids, jnp.maximum(cand, 0))
-            cost_worst = link_cost(cfg.seed, gids, jnp.maximum(z, 0))
+            cost_cand = cost(gids, jnp.maximum(cand, 0))
+            cost_worst = cost(gids, jnp.maximum(z, 0))
             x_fire = ((ctx.rnd + gids) % cfg.xbot_every == 0) \
                 & (asize0 >= acap) & (acap > 0) & (cand >= 0) & (z >= 0) \
                 & (cost_cand < cost_worst)
             xbot_msgs = msg_ops.build(
                 W, T.MsgKind.HPV_XBOT_OPT, gids,
                 jnp.where(x_fire, cand, -1), payload=(z,))
+
+        # ---- 8. distance/RTT metrics plane (config-gated) ------------
+        # Probe targets: the active view (the reference pings its
+        # connected peers on the distance timer) plus a passive sample
+        # so X-BOT's candidate pool accumulates measurements.
+        new_dist = state.dist
+        if cfg.distance.enabled:
+            psamp = row_ranked(passive0, _TAG_DPROBE,
+                               cfg.distance.probe_passive)
+            new_dist, dist_emit = distance_mod.step(
+                cfg, comm, state.dist, ctx,
+                jnp.concatenate([active0, psamp], axis=1))
 
         # leave: clear own views after disconnecting
         new_active = jnp.where(state.leaving[:, None], -1, new_active)
@@ -710,6 +759,8 @@ class HyParView:
                   promote_msgs[:, None, :]]
         if hv.xbot:
             blocks += [x_disc, xbot_msgs[:, None, :]]
+        if cfg.distance.enabled:
+            blocks += [dist_emit]
         emitted = jnp.concatenate(blocks, axis=1)
 
         # Crash-stopped and left nodes are frozen and silent (a left node
@@ -750,6 +801,10 @@ class HyParView:
             joined=state.joined | (live & jnp.any(new_active >= 0, axis=1)),
             hb_epoch=jnp.where(live, hb_epoch, state.hb_epoch),
             hb_rnd=jnp.where(live, hb_rnd, state.hb_rnd),
+            dist=(jax.tree.map(
+                lambda new, old: jnp.where(live[:, None], new, old),
+                new_dist, state.dist)
+                if cfg.distance.enabled else state.dist),
         )
         return new_state, emitted
 

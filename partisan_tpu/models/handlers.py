@@ -62,9 +62,18 @@ class BroadcastHandler:
         ``is_stale`` is ``leq(incoming, store)``."""
         return jnp.all(self.word_leq(a, b), axis=-1)
 
+    def bottom(self) -> Array:
+        """The TRUE least element of the payload lattice (int32[PW]) —
+        used to pad masked fold slots and for presence.  Defaults to a
+        vector of ``identity``; a handler whose payload space extends
+        BELOW that (negative timestamps or values) must override it,
+        or padding would beat real payloads in the join and
+        ``present`` would misread a legitimate payload as absent."""
+        return jnp.full((self.payload_words,), self.identity, jnp.int32)
+
     def present(self, store: Array) -> Array:
         """bool[...]: slot carries data (graft can serve it)."""
-        return jnp.any(store != self.identity, axis=-1)
+        return jnp.any(store != self.bottom(), axis=-1)
 
     # -- host-side construction (broadcast_data) -----------------------
     def payload(self, value) -> Array:
@@ -147,6 +156,12 @@ class LWWHandler(BroadcastHandler):
 
     payload_words = 2
 
+    def bottom(self) -> Array:
+        # (INT32_MIN, INT32_MIN): any real (ts, value) — including
+        # negative timestamps and [0, 0] — beats the padding and reads
+        # as present.
+        return jnp.full((2,), jnp.iinfo(jnp.int32).min, jnp.int32)
+
     def join(self, a: Array, b: Array) -> Array:
         a_ts, b_ts = a[..., 0], b[..., 0]
         a_v, b_v = a[..., 1], b[..., 1]
@@ -167,7 +182,8 @@ def tree_fold(handler: BroadcastHandler, x: Array, axis: int) -> Array:
         m = x.shape[0]
         if m % 2:
             x = jnp.concatenate(
-                [x, jnp.full((1,) + x.shape[1:], handler.identity, x.dtype)])
+                [x, jnp.broadcast_to(handler.bottom().astype(x.dtype),
+                                     (1,) + x.shape[1:])])
             m += 1
         x = handler.join(x[0::2], x[1::2])
     return x[0]

@@ -1,0 +1,365 @@
+"""Single-decree Paxos, vectorized — the in-repo consensus
+application-under-test.
+
+The reference hosts external consensus apps under its PropEr harness
+(test/prop_partisan_paxoid.erl:385 drives the paxoid app with
+ledger-convergence postconditions under the crash fault model,
+prop_partisan_crash_fault_model.erl:33-37).  Those BEAM apps cannot run
+in this image, so this model fills the role in-repo: classic
+single-decree Paxos (Synod), every node a proposer + acceptor +
+learner, stepped for all nodes at once over ``[n_local, slots]``
+decree state (one slot per independent decree, the commit-engine slot
+convention).
+
+Protocol (the Synod rules):
+
+- ``propose`` starts phase 1: the proposer picks a ballot unique to it
+  (``attempt * n + id + 1``) and fans out PREPARE,
+- an acceptor receiving PREPARE(b) with b > promised re-promises and
+  answers PROMISE(b) carrying its highest accepted (ballot, value);
+  lower ballots are ignored (the proposer's retry re-arms),
+- on a quorum of promises the proposer enters phase 2 with the value of
+  the highest accepted ballot seen (or its own if none) and fans out
+  ACCEPT(b, v),
+- an acceptor receiving ACCEPT(b, v) with b >= promised accepts
+  (promised = accepted = b) and answers ACCEPTED(b),
+- on a quorum of ACCEPTED the proposer DECIDES and fans out DECIDE(v)
+  to the learners; a proposer stuck in either phase past its (id-
+  jittered) retry window re-runs phase 1 with a higher ballot.
+
+Fan-outs are edge-triggered (emitted once per phase entry) so omission
+faults have real consequences, and acceptor state is monotonic in the
+ballot order — the safety core Paxos rests on.  ``quorum`` defaults to
+majority; passing a smaller value deliberately breaks the
+quorum-intersection property (two disjoint "quorums" can decide
+different values) — the weakened-invariant canary the property harness
+must catch and shrink (tests/test_paxos.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+# APP payload layout: [op, slot, ballot, value, aux]
+OP_PREPARE = 30
+OP_PROMISE = 31     # aux = accepted ballot; value = accepted value (-1)
+OP_ACCEPT = 32
+OP_ACCEPTED = 33
+OP_DECIDE = 34
+
+# proposer phases
+P_IDLE = 0
+P_PREPARING = 1
+P_ACCEPTING = 2
+
+
+class PaxosState(NamedTuple):
+    # acceptor [n, S]
+    a_promised: Array   # int32 — highest promised ballot (0 = none)
+    a_ballot: Array     # int32 — accepted ballot (0 = none)
+    a_value: Array      # int32 — accepted value (-1 = none)
+    # proposer [n, S]
+    p_phase: Array      # int32 — P_IDLE / P_PREPARING / P_ACCEPTING
+    p_ballot: Array     # int32 — current ballot
+    p_value: Array      # int32 — own proposed value
+    p_chosen: Array     # int32 — phase-2 value (highest-accepted or own)
+    p_nprom: Array      # int32 — promises for p_ballot
+    p_hib: Array        # int32 — highest accepted ballot among promises
+    p_hiv: Array        # int32 — its value
+    p_nacc: Array       # int32 — ACCEPTED acks for p_ballot
+    p_t0: Array         # int32 — round of phase entry (retry base)
+    p_sent: Array       # bool — current phase's fan-out already emitted
+    p_won: Array        # int32[n, S] — value this node CHOSE as the
+    #                     winning proposer (-1 = none; first win kept) —
+    #                     agreement is judged over chosen values, not
+    #                     just learned ones (a learner keeps its first
+    #                     DECIDE, which would mask a chosen-value split)
+    won_conflict: Array # bool[n, S] — sticky: this proposer won the
+    #                     same decree twice with DIFFERENT values (a
+    #                     keep-first p_won alone would mask it)
+    decided: Array      # int32[n, S] — learned decree value (-1 = none)
+
+
+class Paxos:
+    """slots independent decrees; quorum defaults to majority."""
+
+    name = "paxos"
+
+    def __init__(self, slots: int = 2, quorum: int | None = None,
+                 retry_rounds: int = 8,
+                 unsafe_adopt: bool = False) -> None:
+        self.slots = slots
+        self.quorum = quorum
+        self.retry_rounds = retry_rounds
+        # Planted bug for the property harness: ignore the
+        # highest-accepted value reported by promises and always push
+        # the proposer's own value — breaks the Synod adoption rule, so
+        # a later ballot can choose a different value than an earlier
+        # chosen one (caught + shrunk in tests/test_paxos.py).
+        self.unsafe_adopt = unsafe_adopt
+
+    def _quorum(self, cfg: Config) -> int:
+        return self.quorum if self.quorum is not None \
+            else cfg.n_nodes // 2 + 1
+
+    def init(self, cfg: Config, comm: LocalComm) -> PaxosState:
+        if T.payload_words(cfg.msg_words) < 5:
+            raise ValueError("paxos needs msg_words >= 13 "
+                             "(payload [op, slot, ballot, value, aux])")
+        n, s = comm.n_local, self.slots
+        zi = jnp.zeros((n, s), jnp.int32)
+        return PaxosState(
+            a_promised=zi, a_ballot=zi, a_value=jnp.full((n, s), -1,
+                                                         jnp.int32),
+            p_phase=zi, p_ballot=zi, p_value=zi, p_chosen=zi,
+            p_nprom=zi, p_hib=zi, p_hiv=zi, p_nacc=zi, p_t0=zi,
+            p_sent=jnp.zeros((n, s), jnp.bool_),
+            p_won=jnp.full((n, s), -1, jnp.int32),
+            won_conflict=jnp.zeros((n, s), jnp.bool_),
+            decided=jnp.full((n, s), -1, jnp.int32))
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, st: PaxosState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[PaxosState, Array]:
+        n, S = st.p_phase.shape
+        NG = comm.n_global
+        Q = self._quorum(cfg)
+        gids = comm.local_ids()
+        alive = ctx.alive
+        inb = ctx.inbox.data
+        is_app = (inb[..., T.W_KIND] == T.MsgKind.APP) & alive[:, None]
+        op = jnp.where(is_app, inb[..., T.P0], -1)          # [n, cap]
+        mslot = inb[..., T.P1]
+        mbal = inb[..., T.P2]
+        mval = inb[..., T.P3]
+        maux = inb[..., T.P3 + 1]
+        msrc = inb[..., T.W_SRC]
+        # decree-aligned masks: [n, S, cap]
+        sl = jnp.arange(S, dtype=jnp.int32)
+        on_slot = mslot[:, None, :] == sl[None, :, None]
+
+        def per_slot(opk):
+            return (op[:, None, :] == opk) & on_slot
+
+        NEG = jnp.iinfo(jnp.int32).min
+
+        # Within-round serialization: ACCEPTs are processed BEFORE
+        # PREPAREs, and each PROMISE reports the post-accept state.  A
+        # promise that omitted a same-round accept would let the new
+        # proposer choose a fresh value while this acceptor's ACCEPTED
+        # completes the old ballot's quorum — a quorum-intersection
+        # violation (the Synod promise must cover every accept the
+        # acceptor has performed).
+
+        # ---- acceptor: ACCEPT(b >= promised) -> accept + ACCEPTED -----
+        m_acc = per_slot(OP_ACCEPT) \
+            & (mbal[:, None, :] >= st.a_promised[:, :, None])
+        acc_bal = jnp.where(m_acc, mbal[:, None, :], NEG)
+        acc_max = jnp.max(acc_bal, axis=2)
+        acc_any = acc_max > NEG
+        awho = jnp.argmax(acc_bal, axis=2)
+        acc_src = jnp.take_along_axis(
+            jnp.broadcast_to(msrc[:, None, :], acc_bal.shape), awho[:, :, None],
+            axis=2)[:, :, 0]
+        acc_val = jnp.take_along_axis(
+            jnp.broadcast_to(mval[:, None, :], acc_bal.shape), awho[:, :, None],
+            axis=2)[:, :, 0]
+        accepted_msg = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            jnp.where(acc_any, acc_src, -1),
+            payload=(jnp.full((n, S), OP_ACCEPTED),
+                     jnp.broadcast_to(sl[None, :], (n, S)),
+                     jnp.maximum(acc_max, 0), acc_val, 0))
+        promised_mid = jnp.maximum(st.a_promised, jnp.maximum(acc_max, 0))
+        a_ballot = jnp.where(acc_any, acc_max, st.a_ballot)
+        a_value = jnp.where(acc_any, acc_val, st.a_value)
+
+        # ---- acceptor: PREPARE -> re-promise + PROMISE the max --------
+        m_prep = per_slot(OP_PREPARE)
+        prep_bal = jnp.where(m_prep, mbal[:, None, :], NEG)
+        prep_max = jnp.max(prep_bal, axis=2)                 # [n, S]
+        prep_win = prep_max > promised_mid
+        who = jnp.argmax(prep_bal, axis=2)                   # [n, S]
+        prep_src = jnp.take_along_axis(
+            jnp.broadcast_to(msrc[:, None, :], prep_bal.shape), who[:, :, None],
+            axis=2)[:, :, 0]
+        promise = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            jnp.where(prep_win, prep_src, -1),
+            payload=(jnp.full((n, S), OP_PROMISE),
+                     jnp.broadcast_to(sl[None, :], (n, S)),
+                     jnp.maximum(prep_max, 0), a_value, a_ballot))
+
+        a_promised = jnp.maximum(promised_mid, jnp.maximum(prep_max, 0))
+
+        # ---- proposer: collect PROMISE / ACCEPTED ---------------------
+        m_prom = per_slot(OP_PROMISE) \
+            & (mbal[:, None, :] == st.p_ballot[:, :, None]) \
+            & (st.p_phase == P_PREPARING)[:, :, None]
+        nprom = st.p_nprom + jnp.sum(m_prom, axis=2, dtype=jnp.int32)
+        # highest accepted (ballot, value) among this round's promises
+        pr_ab = jnp.where(m_prom, maux[:, None, :], NEG)
+        pr_hib = jnp.max(pr_ab, axis=2)
+        pwho = jnp.argmax(pr_ab, axis=2)
+        pr_hiv = jnp.take_along_axis(
+            jnp.broadcast_to(mval[:, None, :], pr_ab.shape), pwho[:, :, None],
+            axis=2)[:, :, 0]
+        upd = pr_hib > st.p_hib
+        p_hib = jnp.where(upd, pr_hib, st.p_hib)
+        p_hiv = jnp.where(upd, pr_hiv, st.p_hiv)
+
+        m_accd = per_slot(OP_ACCEPTED) \
+            & (mbal[:, None, :] == st.p_ballot[:, :, None]) \
+            & (st.p_phase == P_ACCEPTING)[:, :, None]
+        nacc = st.p_nacc + jnp.sum(m_accd, axis=2, dtype=jnp.int32)
+
+        # phase transitions
+        to_accept = (st.p_phase == P_PREPARING) & (nprom >= Q)
+        adopt = st.p_value if self.unsafe_adopt else \
+            jnp.where(p_hib > 0, p_hiv, st.p_value)
+        p_chosen = jnp.where(to_accept, adopt, st.p_chosen)
+        win = (st.p_phase == P_ACCEPTING) & (nacc >= Q)
+        p_phase = jnp.where(to_accept, P_ACCEPTING, st.p_phase)
+        p_phase = jnp.where(win, P_IDLE, p_phase)
+        p_sent = st.p_sent & ~to_accept                      # re-arm fan-out
+        p_t0 = jnp.where(to_accept, ctx.rnd, st.p_t0)
+
+        # ---- learner: DECIDE ------------------------------------------
+        m_dec = per_slot(OP_DECIDE)
+        dec_val = jnp.max(jnp.where(m_dec, mval[:, None, :], NEG), axis=2)
+        got_dec = dec_val > NEG
+        decided = jnp.where((st.decided < 0) & got_dec, dec_val,
+                            st.decided)
+        decided = jnp.where((st.decided < 0) & win, p_chosen, decided)
+        p_won = jnp.where((st.p_won < 0) & win, p_chosen, st.p_won)
+        won_conflict = st.won_conflict | \
+            (win & (st.p_won >= 0) & (st.p_won != p_chosen))
+
+        # ---- retry: jittered per-proposer window ----------------------
+        retry_at = self.retry_rounds + (gids % 3)[:, None]
+        stuck = (p_phase != P_IDLE) & ~win \
+            & (ctx.rnd - p_t0 >= retry_at)
+        p_ballot = jnp.where(stuck, st.p_ballot + NG, st.p_ballot)
+        p_phase = jnp.where(stuck, P_PREPARING, p_phase)
+        nprom = jnp.where(stuck | to_accept, 0, nprom)
+        nacc = jnp.where(stuck | win, 0, nacc)
+        p_hib = jnp.where(stuck, 0, p_hib)
+        p_hiv = jnp.where(stuck, 0, p_hiv)
+        p_sent = p_sent & ~stuck
+        p_t0 = jnp.where(stuck, ctx.rnd, p_t0)
+
+        # ---- edge-triggered fan-outs ----------------------------------
+        # one [n, S, NG] block; op selected by the proposer's phase.
+        # DECIDE additionally re-broadcasts from every decided node on a
+        # slow stagger — the learner anti-entropy (paxoid's ledger
+        # gossip) that heals an omitted DECIDE fan-out.
+        fan_now = (p_phase != P_IDLE) & ~p_sent & alive[:, None]
+        dec_now = win & alive[:, None]
+        dec_rebc = (decided >= 0) & ~win & alive[:, None] \
+            & ((ctx.rnd + gids[:, None]) % (2 * self.retry_rounds) == 0)
+        dec_all = dec_now | dec_rebc
+        any_fan = fan_now | dec_all
+        fan_op = jnp.where(dec_all, OP_DECIDE,
+                           jnp.where(p_phase == P_PREPARING, OP_PREPARE,
+                                     OP_ACCEPT))
+        fan_val = jnp.where(p_phase == P_ACCEPTING, p_chosen, st.p_value)
+        fan_val = jnp.where(dec_now, p_chosen, fan_val)
+        fan_val = jnp.where(dec_rebc, decided, fan_val)
+        all_ids = jnp.arange(NG, dtype=jnp.int32)
+        fan = msg_ops.build(
+            cfg.msg_words, T.MsgKind.APP, gids[:, None, None],
+            jnp.where(any_fan[:, :, None], all_ids[None, None, :], -1),
+            payload=(fan_op[:, :, None],
+                     jnp.broadcast_to(sl[None, :, None], (n, S, NG)),
+                     p_ballot[:, :, None], fan_val[:, :, None], 0))
+        p_sent = p_sent | fan_now
+
+        live = alive[:, None]
+        out = PaxosState(
+            a_promised=jnp.where(live, a_promised, st.a_promised),
+            a_ballot=jnp.where(live, a_ballot, st.a_ballot),
+            a_value=jnp.where(live, a_value, st.a_value),
+            p_phase=jnp.where(live, p_phase, st.p_phase),
+            p_ballot=jnp.where(live, p_ballot, st.p_ballot),
+            p_value=st.p_value,
+            p_chosen=jnp.where(live, p_chosen, st.p_chosen),
+            p_nprom=jnp.where(live, nprom, st.p_nprom),
+            p_hib=jnp.where(live, p_hib, st.p_hib),
+            p_hiv=jnp.where(live, p_hiv, st.p_hiv),
+            p_nacc=jnp.where(live, nacc, st.p_nacc),
+            p_t0=jnp.where(live, p_t0, st.p_t0),
+            p_sent=jnp.where(live, p_sent, st.p_sent),
+            p_won=jnp.where(live, p_won, st.p_won),
+            won_conflict=jnp.where(live, won_conflict, st.won_conflict),
+            decided=jnp.where(live, decided, st.decided))
+        emitted = jnp.concatenate(
+            [promise, accepted_msg, fan.reshape(n, S * NG, cfg.msg_words)],
+            axis=1)
+        return out, emitted
+
+    # ---- host-side API -----------------------------------------------
+    def propose(self, st: PaxosState, node: int, slot: int, value: int,
+                now: int, n_global: int) -> PaxosState:
+        """Start (or restart) a proposal.  Ballots stay unique to the
+        proposer: attempt * n + id + 1."""
+        cur = int(st.p_ballot[node, slot])
+        nxt = node + 1 if cur <= 0 else cur + n_global
+        return st._replace(
+            p_phase=st.p_phase.at[node, slot].set(P_PREPARING),
+            p_ballot=st.p_ballot.at[node, slot].set(nxt),
+            p_value=st.p_value.at[node, slot].set(value),
+            p_nprom=st.p_nprom.at[node, slot].set(0),
+            p_hib=st.p_hib.at[node, slot].set(0),
+            p_hiv=st.p_hiv.at[node, slot].set(0),
+            p_nacc=st.p_nacc.at[node, slot].set(0),
+            p_t0=st.p_t0.at[node, slot].set(now),
+            p_sent=st.p_sent.at[node, slot].set(False))
+
+    # ---- invariants (the prop-model postconditions) -------------------
+    @staticmethod
+    def _slot_values(st: PaxosState, s: int) -> set:
+        """Values observed as chosen for decree ``s``: learned
+        (decided) AND chosen-as-proposer (p_won) — the latter catches a
+        chosen-value split that first-DECIDE-wins learners would mask."""
+        import numpy as np
+
+        d = np.asarray(st.decided)[:, s]
+        w = np.asarray(st.p_won)[:, s]
+        return {int(v) for v in d if v >= 0} | \
+               {int(v) for v in w if v >= 0}
+
+    @classmethod
+    def agreement(cls, st: PaxosState) -> bool:
+        """At most one value is ever chosen per decree — checked across
+        ALL nodes (safety is global; a crashed node's pre-crash
+        learning still counts) and across both learner and proposer
+        observations."""
+        import numpy as np
+
+        if bool(np.asarray(st.won_conflict).any()):
+            return False
+        return all(len(cls._slot_values(st, s)) <= 1
+                   for s in range(st.decided.shape[1]))
+
+    @classmethod
+    def validity(cls, st: PaxosState, proposed: dict) -> bool:
+        """Every chosen value was proposed for that decree."""
+        return all(
+            cls._slot_values(st, s) <= set(proposed.get(s, ()))
+            for s in range(st.decided.shape[1]))
+
+    @staticmethod
+    def decided_nodes(st: PaxosState, slot: int):
+        import numpy as np
+
+        d = np.asarray(st.decided)[:, slot]
+        return [i for i, v in enumerate(d) if v >= 0]

@@ -61,6 +61,21 @@ class PlumtreeState(NamedTuple):
     need_push: Array     # bool[n, B] — fresh slot awaiting eager push
     push_src: Array      # int32[n, B] — eager parent (excluded from push)
     tree_nbrs: Array     # int32[n, K] — link occupants flags refer to
+    epoch: Array         # int32[n, B] — slot-recycle generation: the
+    #                      reference keys trees by broadcast ROOT
+    #                      (:118-160); slots are recycled under
+    #                      sustained load, so each recycle (broadcast
+    #                      with fresh=True) bumps the slot's epoch —
+    #                      receivers adopting a higher epoch RESET the
+    #                      slot's tree flags (the new root grows its own
+    #                      tree) and stale-epoch traffic is ignored,
+    #                      so two roots sharing a slot cannot conflate
+    #                      trees.  The handler STORE is not reset: the
+    #                      payload lattice is monotone across recycles
+    #                      (a recycled broadcast must dominate — the
+    #                      version bump / later timestamp / grown
+    #                      counter all do), which keeps AAE exchange
+    #                      epoch-oblivious and correct.
 
 
 class Plumtree:
@@ -74,20 +89,22 @@ class Plumtree:
         n, B = comm.n_local, cfg.max_broadcasts
         PW = self.handler.payload_words
         K = managers_mod.neighbor_width(cfg)
-        # wire: gossip = [slot, payload×PW, hop]; need header + 2 + PW
-        need = T.HDR_WORDS + 2 + PW
+        # wire: gossip = [slot, payload×PW, hop, epoch]; header + 3 + PW
+        need = T.HDR_WORDS + 3 + PW
         if cfg.msg_words < need:
             raise ValueError(
                 f"plumtree with a {PW}-word handler payload needs "
                 f"msg_words >= {need}, got {cfg.msg_words}")
         return PlumtreeState(
-            data=jnp.full((n, B, PW), self.handler.identity, jnp.int32),
+            data=jnp.broadcast_to(self.handler.bottom(),
+                                  (n, B, PW)).astype(jnp.int32),
             rround=jnp.zeros((n, B), jnp.int32),
             pruned=jnp.zeros((n, B, K), jnp.bool_),
             lazy_pending=jnp.zeros((n, B, K), jnp.bool_),
             need_push=jnp.zeros((n, B), jnp.bool_),
             push_src=jnp.full((n, B), -1, jnp.int32),
             tree_nbrs=jnp.full((n, K), -1, jnp.int32),
+            epoch=jnp.zeros((n, B), jnp.int32),
         )
 
     # ------------------------------------------------------------------
@@ -133,11 +150,36 @@ class Plumtree:
         b = jnp.clip(inb[..., T.P0], 0, B - 1)
         pay = inb[..., T.P1:T.P1 + PW]                          # [n, cap, PW]
         mr = inb[..., T.P1 + PW]
+        ep_w = inb[..., T.P1 + PW + 1]                          # [n, cap]
         is_g = kind == T.MsgKind.PT_GOSSIP
         is_ih = kind == T.MsgKind.PT_IHAVE
         is_gr = kind == T.MsgKind.PT_GRAFT
         is_pr = kind == T.MsgKind.PT_PRUNE
         is_ak = kind == T.MsgKind.PT_IHAVE_ACK
+
+        # ---- slot-epoch guard (per-root trees, :118-160) ----------
+        # A gossiped higher epoch re-keys the slot to its new root:
+        # adopt it, RESET the tree flags (the new root's tree forms
+        # from scratch), and ignore every message stamped with an
+        # older epoch — late traffic from the recycled tree cannot
+        # prune/graft/advertise into the new one.
+        oh_b0 = (b[:, :, None] == jnp.arange(B)[None, None, :])
+        g_ep = jnp.max(
+            jnp.where(oh_b0 & is_g[:, :, None],
+                      ep_w[:, :, None], 0), axis=1)             # [n, B]
+        tgt_ep = jnp.maximum(state.epoch, g_ep)
+        bumped = tgt_ep > state.epoch                           # [n, B]
+        pruned = pruned & ~bumped[:, :, None]
+        lazyp = lazyp & ~bumped[:, :, None]
+        rr = jnp.where(bumped, 0, rr)
+        psrc = jnp.where(bumped, -1, psrc)
+        ep_b = jnp.take_along_axis(tgt_ep, b, axis=1)           # [n, cap]
+        cur_ep = ep_w == ep_b
+        is_g = is_g & cur_ep
+        is_ih = is_ih & cur_ep
+        is_gr = is_gr & cur_ep
+        is_pr = is_pr & cur_ep
+        is_ak = is_ak & cur_ep
 
         # sender's link slot (slot_of): [n, cap]
         hit = (nbrs[:, None, :] == src[:, :, None]) & (src >= 0)[:, :, None]
@@ -161,7 +203,7 @@ class Plumtree:
         stale_g = is_g & hd.leq(pay, data_b)                    # is_stale
         gmask = (oh_b & is_g[:, :, None])                       # [n, cap, B]
         expanded = jnp.where(gmask[..., None], pay[:, :, None, :],
-                             jnp.int32(hd.identity))            # [n,cap,B,PW]
+                             hd.bottom())                       # [n,cap,B,PW]
         joined_in = handlers_mod.tree_fold(hd, expanded, axis=1)  # [n, B, PW]
         fresh_any = ~hd.leq(joined_in, data)                    # [n, B]
 
@@ -186,9 +228,22 @@ class Plumtree:
         first_ns = first_by_tree(win_ns)
         chosen = jnp.where(first_pref < cap, first_pref, first_ns)  # [n, B]
         win = win_ns & (slot_c == jnp.take_along_axis(chosen, b, axis=1))
-        stale_g = stale_g | (is_g & ~win)
         got = chosen < cap                                      # [n, B]
         chosen_c = jnp.minimum(chosen, cap - 1)
+        # Non-winners demote ONLY if stale under the "winner delivered
+        # first" interleaving: pay <= join(store, winner's payload) —
+        # a valid sequential order.  Two concurrent INCOMPARABLE
+        # payloads (e.g. distinct G-counter actors) both stay eager,
+        # matching the reference where a non-stale Mod:merge keeps the
+        # sender eager (:843-857); equal/dominated duplicates prune.
+        pay_win = jnp.where(
+            got[:, :, None],
+            jnp.take_along_axis(pay, chosen_c[:, :, None], axis=1),
+            hd.bottom())                                        # [n, B, PW]
+        after_win = hd.join(data_b,
+                            jnp.take_along_axis(pay_win, b[:, :, None],
+                                                axis=1))        # [n, cap, PW]
+        stale_g = stale_g | (is_g & ~win & hd.leq(pay, after_win))
         mr_win = jnp.where(got, jnp.take_along_axis(mr, chosen_c, axis=1), -1)
         src_win = jnp.where(got, jnp.take_along_axis(src, chosen_c, axis=1),
                             -1)
@@ -200,7 +255,7 @@ class Plumtree:
         # ---- per-(tree, link) flags -------------------------------
         missing_ih = is_ih & ~hd.leq(pay, data_b)
         prune_req = any_bk(is_pr | stale_g)
-        unprune = any_bk(is_gr | missing_ih | win)
+        unprune = any_bk(is_gr | missing_ih | (is_g & ~stale_g))
         pruned = (pruned | prune_req) & ~unprune
         lazyp = lazyp & ~any_bk(is_gr | is_ak)
 
@@ -224,7 +279,7 @@ class Plumtree:
             W, rep_kind, gids[:, None],
             jnp.where(rep_kind > 0, src, -1), channel=CH,
             payload=(b, *jnp.unstack(rep_pay, axis=-1),
-                     jnp.where(is_gr, rr_b, 0)))
+                     jnp.where(is_gr, rr_b, 0), ep_b))
 
         # ---- eager push: up to S carried-over fresh slots ----------
         pend = npu & hd.present(data)
@@ -243,7 +298,8 @@ class Plumtree:
             W, T.MsgKind.PT_GOSSIP, gids[:, None, None], dst, channel=CH,
             payload=(sel[:, :, None],
                      *(w[:, :, None] for w in jnp.unstack(data_sel, axis=-1)),
-                     rr[rows, sel][:, :, None]),
+                     rr[rows, sel][:, :, None],
+                     tgt_ep[rows, sel][:, :, None]),
         ).reshape(n_local, S * K, W)
         lazy_new = sel_ok[:, :, None] & live_k & pruned_sel     # [n, S, K]
         oh_sel = (sel[:, :, None] == jnp.arange(B)[None, None, :])
@@ -264,7 +320,9 @@ class Plumtree:
         ihave_msgs = msg_ops.build(
             W, T.MsgKind.PT_IHAVE, gids[:, None],
             jnp.where(lv > 0, nbrs[rows, kix], -1), channel=CH,
-            payload=(bi, *jnp.unstack(adv, axis=-1)))
+            payload=(bi, *jnp.unstack(adv, axis=-1),
+                     jnp.zeros_like(bi),
+                     jnp.take_along_axis(tgt_ep, bi, axis=1)))
 
         emitted = jnp.concatenate([replies, push_msgs, ihave_msgs], axis=1)
 
@@ -307,7 +365,7 @@ class Plumtree:
                 ctx.faults, gids, tgt, cfg.seed, ctx.rnd, _AAE_EDGE_TAG)
             pulled = hd.exchange(comm, data, tgt)
             data = hd.join(data, jnp.where(ctx.alive[:, None, None], pulled,
-                                           jnp.int32(hd.identity)))
+                                           hd.bottom()))
 
         # Crash-stopped nodes are frozen and silent.
         dead = ~ctx.alive
@@ -326,22 +384,41 @@ class Plumtree:
             need_push=keep(npu, state.need_push),
             push_src=keep(psrc, state.push_src),
             tree_nbrs=keep(nbrs, state.tree_nbrs),
+            epoch=keep(tgt_ep, state.epoch),
         )
         return new_state, emitted
 
     # ---- scenario helpers (broadcast/2, partisan.erl:1556) -----------
     def broadcast(self, state: PlumtreeState, node: int, slot: int,
-                  version=1) -> PlumtreeState:
+                  version=1, *, fresh: bool = False) -> PlumtreeState:
         """Inject a broadcast: Mod:broadcast_data — id = (node, slot),
         payload = handler vector (``version`` may be an int for the
-        default handler or a payload sequence/dict for richer ones)."""
+        default handler or a payload sequence/dict for richer ones).
+
+        ``fresh=True`` marks a NEW logical broadcast RECYCLING the slot
+        (a different root, or the same root starting a new message):
+        the slot's epoch bumps, so every node adopting it re-grows the
+        tree for this root instead of inheriting the previous
+        broadcast's eager/lazy shape (the reference's per-root keying,
+        partisan_plumtree_broadcast.erl:118-160).  The payload must
+        dominate the slot's previous store (monotone lattice across
+        recycles) — version bumps, later timestamps and grown counters
+        all qualify."""
         vec = self.handler.payload(version)
         merged = self.handler.join(state.data[node, slot], vec)
-        return state._replace(
+        st = state._replace(
             data=state.data.at[node, slot].set(merged),
             need_push=state.need_push.at[node, slot].set(True),
             push_src=state.push_src.at[node, slot].set(-1),
         )
+        if fresh:
+            st = st._replace(
+                epoch=st.epoch.at[node, slot].add(1),
+                pruned=st.pruned.at[node, slot].set(False),
+                lazy_pending=st.lazy_pending.at[node, slot].set(False),
+                rround=st.rround.at[node, slot].set(0),
+            )
+        return st
 
     def coverage(self, state: PlumtreeState, alive: Array, slot: int,
                  version=1) -> Array:

@@ -34,8 +34,10 @@ usable from the bridge (any transport satisfying
   strategies, restart intensity, restart types, admin API
 - :mod:`partisan_tpu.otp.gen_sim`    — the call protocol vectorized on
   the node axis (one gen_server per node inside the jitted round)
+- :mod:`partisan_tpu.otp.sys`        — sys-style live introspection:
+  get_state / replace_state / trace / statistics on node slices
 """
 
 from partisan_tpu.otp import (  # noqa: F401
     gen, gen_event, gen_fsm, gen_server, gen_sim, gen_statem, monitor,
-    remote_ref, rpc, supervisor)
+    remote_ref, rpc, supervisor, sys)

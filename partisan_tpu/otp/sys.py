@@ -1,0 +1,110 @@
+"""sys-style live introspection (reference priv/otp/24/partisan_sys.erl,
+777 LoC: ``sys:get_state/2``, ``sys:replace_state/3``, ``sys:trace/2``,
+``sys:statistics/2`` against a running process).
+
+The sim's "processes" are node slices of the cluster-state pytrees, so
+the debugger's handle is (pytree, node id) instead of a pid:
+
+- :func:`get_state`     — a node's slice of any node-axis pytree
+  (``st.manager``, a stacked model's sub-state, ...),
+- :func:`replace_state` — run ``fn`` over that slice and scatter the
+  result back (the StateFun of sys:replace_state),
+- :func:`trace`         — step k rounds capturing the wire and render
+  one node's sends/receives (sys:trace's message-event printing, built
+  on Cluster.record — the trace-orchestrator capture),
+- :func:`statistics`    — per-node message counters from a capture
+  (messages_in/messages_out of sys:statistics).
+
+Everything is host-side and needs no cooperation from the jitted round
+— the state IS inspectable data, which is the whole point of the
+tensor transposition (MIGRATING.md "Debugging" cookbook section).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+def _is_node_leaf(leaf, n: int) -> bool:
+    return hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n
+
+
+def get_state(sub: Any, node: int, n_nodes: int) -> Any:
+    """sys:get_state — ``sub``'s slice for ``node``.  Leaves whose
+    leading axis is the node axis are sliced; others (global/scalar
+    state) pass through unchanged."""
+    return jax.tree.map(
+        lambda leaf: leaf[node] if _is_node_leaf(leaf, n_nodes) else leaf,
+        sub)
+
+
+def replace_state(sub: Any, node: int, n_nodes: int,
+                  fn: Callable[[Any], Any]) -> Any:
+    """sys:replace_state — ``fn(node_slice) -> node_slice'`` applied to
+    ``node``'s slice of every node-axis leaf, scattered back.  ``fn``
+    receives and returns the same pytree structure :func:`get_state`
+    yields; non-node leaves are passed through to ``fn`` but ignored on
+    the way back (mutating global state through a per-process debugger
+    handle would be a category error)."""
+    old = get_state(sub, node, n_nodes)
+    new = fn(old)
+
+    def put(leaf, new_slice):
+        if _is_node_leaf(leaf, n_nodes):
+            return leaf.at[node].set(new_slice)
+        return leaf
+
+    return jax.tree.map(put, sub, new)
+
+
+def trace(cluster: Any, state: Any, rounds: int, node: int | None = None,
+          limit: int | None = 40) -> tuple[Any, str]:
+    """sys:trace — run ``rounds`` rounds with the wire captured and
+    return (state', rendered trace).  ``node`` filters to one node's
+    sends and receives (None = whole cluster, the orchestrator view)."""
+    from partisan_tpu import trace as trace_mod
+
+    state, cap = cluster.record(state, rounds)
+    tr = trace_mod.from_capture(cap)
+    if node is None:
+        return state, tr.render(limit=limit)
+    lines = []
+    for ev in tr.events():
+        if ev.src != node and ev.dst != node:
+            continue
+        arrow = "=>" if ev.src == node else "<="
+        tag = " DROPPED" if ev.dropped else ""
+        lines.append(f"r={ev.rnd} {node} {arrow} "
+                     f"{ev.dst if ev.src == node else ev.src} "
+                     f"{ev.kind_name}{tag} payload={list(ev.payload)}")
+        if limit is not None and len(lines) >= limit:
+            lines.append("...")
+            break
+    return state, "\n".join(lines)
+
+
+def statistics(cluster: Any, state: Any, rounds: int) -> tuple[Any, dict]:
+    """sys:statistics — step ``rounds`` with capture and return
+    (state', {node: {"messages_out", "messages_in", "dropped"}})."""
+    state, cap = cluster.record(state, rounds)
+    from partisan_tpu import trace as trace_mod
+
+    tr = trace_mod.from_capture(cap)
+    n = cluster.cfg.n_nodes
+    out = np.zeros(n, int)
+    inn = np.zeros(n, int)
+    drp = np.zeros(n, int)
+    for ev in tr.events():
+        out[ev.src] += 1
+        if ev.dropped:
+            drp[ev.src] += 1
+        elif 0 <= ev.dst < n:
+            inn[ev.dst] += 1
+    return state, {
+        i: {"messages_out": int(out[i]), "messages_in": int(inn[i]),
+            "dropped": int(drp[i])}
+        for i in range(n)
+    }

@@ -169,6 +169,10 @@ class ShardedCluster:
     def __post_init__(self) -> None:
         if self.manager is None:
             self.manager = managers_mod.get(self.cfg.peer_service_manager)
+        from partisan_tpu import interpose as interpose_mod
+
+        self.interpose = interpose_mod.config_delays(self.cfg,
+                                                     self.interpose)
         n_shards = self.mesh.devices.size
         if self.cfg.n_nodes % n_shards:
             raise ValueError(

@@ -305,3 +305,89 @@ class AtomicCommitSystem:
         # covers the coordinator timeout (10) + CTP's decision-request
         # repair cycle after the heal
         return 30
+
+
+@dataclasses.dataclass
+class PaxosSystem:
+    """Consensus application-under-test (the prop_partisan_paxoid.erl:385
+    role): vectorized single-decree Paxos (models/paxos.py) under the
+    crash fault model, with linearizability-grade postconditions:
+
+    - AGREEMENT: across every node (alive or crashed), at most one
+      value is ever learned per decree,
+    - VALIDITY: a learned value was proposed for that decree,
+    - conditional TERMINATION: with a majority alive and partitions
+      healed, a decree somebody proposed and some surviving proposer
+      still owns must be decided by settle time.
+
+    ``unsafe_adopt`` forwards to the model — it plants the broken
+    Synod adoption rule (ignore promises' highest-accepted value) the
+    harness must catch and shrink (tests/test_paxos.py).
+    """
+
+    n_nodes: int = 5
+    slots: int = 2
+    seed: int = 3
+    quorum: int | None = None
+    unsafe_adopt: bool = False
+    check_termination: bool = True
+    name: str = "paxos"
+
+    def __post_init__(self) -> None:
+        from partisan_tpu.models.paxos import Paxos
+
+        self.model = Paxos(slots=self.slots, quorum=self.quorum,
+                           retry_rounds=8,
+                           unsafe_adopt=self.unsafe_adopt)
+        self._next_val = 0
+
+    def build(self):
+        return _cached_build(self, lambda: Cluster(
+            Config(n_nodes=self.n_nodes, seed=self.seed,
+                   msg_words=13,
+                   inbox_cap=max(48, 8 * self.n_nodes),
+                   emit_cap=16),
+            model=self.model))
+
+    def propose_command(self, node: int, slot: int, value: int) -> Command:
+        def apply(c, s, _n=node, _sl=slot, _v=value):
+            return s._replace(model=self.model.propose(
+                s.model, _n, _sl, _v, int(s.rnd), self.n_nodes))
+
+        return Command(name="propose", args=(node, slot, value),
+                       apply=apply)
+
+    def gen_command(self, rng: random.Random, cl, st) -> Command:
+        self._next_val += 1
+        return self.propose_command(rng.randrange(self.n_nodes),
+                                    rng.randrange(self.slots),
+                                    100 + self._next_val)
+
+    def postcondition(self, cl, st, script) -> bool:
+        import numpy as np
+
+        proposed: dict[int, set] = {}
+        proposers: dict[int, list] = {}
+        for c in script:
+            if c.name == "propose":
+                node, slot, val = c.args
+                proposed.setdefault(slot, set()).add(val)
+                proposers.setdefault(slot, []).append(node)
+        if not self.model.agreement(st.model):
+            return False
+        if not self.model.validity(st.model, proposed):
+            return False
+        if not self.check_termination:
+            return True
+        alive = np.asarray(st.faults.alive)
+        if alive.sum() <= self.n_nodes // 2:
+            return True                    # no quorum: liveness waived
+        for slot, nodes in proposers.items():
+            if any(alive[p] for p in nodes) and \
+                    not self.model.decided_nodes(st.model, slot):
+                return False
+        return True
+
+    def settle_rounds(self) -> int:
+        # several retry windows: dueling proposers need a few ballots
+        return 60

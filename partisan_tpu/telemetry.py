@@ -98,6 +98,28 @@ def emit_channels_configured(bus: Bus, cfg) -> None:
                     {"channel": ch.name, "monotonic": ch.monotonic})
 
 
+def distance_metrics(dist_state) -> dict:
+    """Host-side view of the distance plane's measured RTT cache (the
+    reference's per-peer distance map,
+    partisan_pluggable_peer_service_manager.erl:1716-1737).  Accepts a
+    :class:`partisan_tpu.distance.DistanceState` — hyparview carries one
+    at ``state.manager.dist``; stacked :class:`DistanceService` users
+    pass their sub-state."""
+    node = np.asarray(dist_state.rtt_node)
+    val = np.asarray(dist_state.rtt_val)
+    per_node = [
+        {int(p): int(v) for p, v in zip(nr, vr) if p >= 0}
+        for nr, vr in zip(node, val)
+    ]
+    known = node >= 0
+    vals = val[known]
+    return {
+        "per_node": per_node,
+        "measured_edges": int(known.sum()),
+        "mean_rtt_rounds": float(vals.mean()) if vals.size else None,
+    }
+
+
 def connection_counts(cluster, state) -> dict:
     """Connection introspection (partisan_peer_service:connections/0,
     partisan_peer_connections:count/0-3 —

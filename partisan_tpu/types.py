@@ -40,6 +40,8 @@ F_CAUSAL = 1 << 2           # routed through a causality lane
 F_P2P_STAMPED = 1 << 3      # point-to-point causal record, already
 #                             stamped (W_CLOCK = edge seq, W_LANE packs
 #                             lane | epoch << 8) — rides the event lane
+F_DELAY_RELEASED = 1 << 4   # released by the egress/ingress config
+#                             delay stage (one-shot hold marker)
 
 # Payload word indices, by message family.  Payload starts at HDR_WORDS.
 P0, P1, P2, P3 = HDR_WORDS, HDR_WORDS + 1, HDR_WORDS + 2, HDR_WORDS + 3

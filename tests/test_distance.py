@@ -1,0 +1,199 @@
+"""Distance/RTT metrics plane (partisan_tpu.distance) + the
+egress/ingress delay config keys + the channel-capacity config audit.
+
+Reference anchors: ping/pong distance metrics on the ``distance`` timer
+(partisan_pluggable_peer_service_manager.erl:1355-1378, :1716-1737),
+X-BOT's live RTT oracle (partisan_hyparview_peer_service_manager.erl
+:2978-3000), egress/ingress delay (partisan_peer_service_client.erl
+:148-153, partisan_peer_service_server.erl:95-100), connection
+parallelism (partisan_peer_connections.erl:897-925).
+"""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from support import boot_hyparview, hv_config
+
+from partisan_tpu import distance as distance_mod
+from partisan_tpu import telemetry
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import ChannelSpec, Config, DistanceConfig, \
+    DEFAULT_CHANNELS
+from partisan_tpu.distance import DistanceService
+from partisan_tpu.models.direct_mail import DirectMail
+from partisan_tpu.models.stack import Stack
+
+
+def _boot_fullmesh_with(cfg, model):
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, cfg.n_nodes):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    return cl, cl.steps(st, 5)
+
+
+def test_measured_rtt_equals_modeled_geometry():
+    """The cache fills with EXACTLY the modeled round trip (2 x one-way
+    + 2 scheduling rounds) — measured through real pings/pongs, ring
+    geometry."""
+    cfg = Config(n_nodes=8, seed=5, inbox_cap=48,
+                 distance_interval_ms=2_000,
+                 distance=DistanceConfig(enabled=True, model="ring",
+                                         max_latency_rounds=4))
+    svc = DistanceService()
+    stack = Stack([svc])
+    cl, st = _boot_fullmesh_with(cfg, stack)
+    st = cl.steps(st, 2 * cfg.distance_every + 2 * 4 + 4)
+    ds = stack.sub(st.model, 0)
+    node = np.asarray(ds.rtt_node)
+    val = np.asarray(ds.rtt_val)
+    assert (node >= 0).sum() >= cfg.n_nodes  # plenty measured
+    for i in range(cfg.n_nodes):
+        for k in range(node.shape[1]):
+            p = int(node[i, k])
+            if p < 0:
+                continue
+            want = int(distance_mod.modeled_rtt(
+                cfg, jnp.int32(i), jnp.int32(p)))
+            assert int(val[i, k]) == want, (i, p)
+
+
+def test_distance_interval_sets_probe_cadence():
+    """distance_interval_ms is consumed (the round-3 dead knob): a huge
+    interval probes far less than a per-round cadence (the stagger
+    ``(rnd + gid) % every`` still lets the odd early node fire once)."""
+    def measured(interval_ms):
+        cfg = Config(n_nodes=6, seed=7, inbox_cap=48,
+                     distance_interval_ms=interval_ms,
+                     distance=DistanceConfig(enabled=True))
+        svc = DistanceService()
+        stack = Stack([svc])
+        cl, st = _boot_fullmesh_with(cfg, stack)
+        st = cl.steps(st, 20)
+        return telemetry.distance_metrics(
+            stack.sub(st.model, 0))["measured_edges"]
+
+    slow, fast = measured(1_000_000), measured(1_000)
+    assert fast > slow
+
+
+def test_hyparview_embeds_distance_plane_and_telemetry_surface():
+    cfg = hv_config(16, seed=11, distance_interval_ms=2_000,
+                    distance=DistanceConfig(enabled=True, model="ring"))
+    cl = Cluster(cfg)
+    st = boot_hyparview(cl)
+    st = cl.steps(st, 30)
+    m = telemetry.distance_metrics(st.manager.dist)
+    assert m["measured_edges"] > 0
+    assert m["mean_rtt_rounds"] >= 2.0      # scheduling floor
+    # every cached entry matches the ring model exactly
+    for i, row in enumerate(m["per_node"]):
+        for p, v in row.items():
+            assert v == int(distance_mod.modeled_rtt(
+                cfg, jnp.int32(i), jnp.int32(p)))
+
+
+def test_crashed_responder_never_answers():
+    cfg = Config(n_nodes=4, seed=3, inbox_cap=32,
+                 distance_interval_ms=1_000,
+                 distance=DistanceConfig(enabled=True, model="ring",
+                                         max_latency_rounds=2))
+    from partisan_tpu import faults as faults_mod
+
+    svc = DistanceService()
+    stack = Stack([svc])
+    cl, st = _boot_fullmesh_with(cfg, stack)
+    st = st._replace(faults=faults_mod.crash(st.faults, 2))
+    st = cl.steps(st, 14)
+    ds = stack.sub(st.model, 0)
+    node = np.asarray(ds.rtt_node)
+    # nobody holds a measurement OF the crashed node (its pongs never
+    # left), and the crashed node measured nothing
+    assert not (node[np.arange(4) != 2] == 2).any()
+    assert (node[2] < 0).all()
+
+
+def _overlay_mean_latency(cfg, st):
+    act = np.asarray(st.manager.active)
+    n = act.shape[0]
+    tot, cnt = 0.0, 0
+    for i in range(n):
+        for j in act[i]:
+            if j >= 0:
+                tot += float(distance_mod.latency_rounds(
+                    cfg, jnp.int32(i), jnp.int32(int(j))))
+                cnt += 1
+    return tot / max(cnt, 1)
+
+
+def test_xbot_consumes_measured_rtts_and_converges_on_geometry():
+    """With the measured oracle, X-BOT drives the overlay's mean modeled
+    link latency DOWN on the ring geometry (the optimization the
+    reference's is_better RTT oracle performs)."""
+    from partisan_tpu.config import HyParViewConfig
+
+    cfg = hv_config(
+        32, seed=19,
+        distance_interval_ms=1_000,
+        hyparview=HyParViewConfig(xbot=True, xbot_interval_ms=2_000),
+        distance=DistanceConfig(enabled=True, model="ring",
+                                max_latency_rounds=8, xbot_oracle=True))
+    cl = Cluster(cfg)
+    st = boot_hyparview(cl)
+    before = _overlay_mean_latency(cfg, st)
+    st = cl.steps(st, 150)
+    after = _overlay_mean_latency(cfg, st)
+    assert after < before, (before, after)
+
+
+# ---------------------------------------------------------------------------
+# egress/ingress delay config keys
+# ---------------------------------------------------------------------------
+
+def _coverage_round(cfg):
+    """Rounds until a direct-mail broadcast reaches everyone."""
+    model = DirectMail()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, cfg.n_nodes):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    st = cl.steps(st, 5)
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    base = int(st.rnd)
+    for r in range(1, 30):
+        st = cl.steps(st, 1)
+        if float(model.coverage(st.model, st.faults.alive, 0)) == 1.0:
+            return r
+    return -1
+
+
+def test_egress_delay_config_delays_delivery_n_rounds():
+    plain = _coverage_round(Config(n_nodes=6, seed=2, inbox_cap=48))
+    delayed = _coverage_round(Config(n_nodes=6, seed=2, inbox_cap=48,
+                                     egress_delay_ms=3_000))
+    assert plain > 0 and delayed == plain + 3
+
+
+def test_ingress_delay_composes_with_egress():
+    plain = _coverage_round(Config(n_nodes=6, seed=2, inbox_cap=48))
+    both = _coverage_round(Config(n_nodes=6, seed=2, inbox_cap=48,
+                                  egress_delay_ms=2_000,
+                                  ingress_delay_ms=1_000))
+    assert both == plain + 3
+
+
+# ---------------------------------------------------------------------------
+# channel-capacity config audit
+# ---------------------------------------------------------------------------
+
+def test_parallelism_without_enforcement_warns():
+    chans = DEFAULT_CHANNELS + (ChannelSpec("bulk", parallelism=4),)
+    with pytest.warns(UserWarning, match="parallelism"):
+        Config(n_nodes=4, channels=chans)
+    # enforcement on: no warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        Config(n_nodes=4, channels=chans, channel_capacity=True)

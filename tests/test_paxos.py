@@ -1,0 +1,160 @@
+"""Single-decree Paxos as the in-repo consensus application-under-test
+(the prop_partisan_paxoid.erl:385 role): protocol behavior, the
+property harness at the crash-fault budget, the planted
+quorum-intersection bug caught AND shrunk, and a filibuster omission
+search over the proposal exchange.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.paxos import Paxos
+from partisan_tpu.prop import CrashFaultModel, Harness
+from partisan_tpu.prop_models import PaxosSystem
+
+N = 5
+
+
+def build(slots=2, quorum=None, **kw):
+    model = Paxos(slots=slots, quorum=quorum)
+    cfg = Config(n_nodes=N, seed=7, msg_words=13, inbox_cap=64,
+                 **kw)
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, N):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    st = cl.steps(st, 5)
+    return cl, model, st
+
+
+def test_single_proposer_decides_everywhere():
+    cl, model, st = build()
+    st = st._replace(model=model.propose(st.model, 2, 0, 111,
+                                         int(st.rnd), N))
+    st = cl.steps(st, 12)
+    assert model.decided_nodes(st.model, 0) == list(range(N))
+    assert {int(v) for v in np.asarray(st.model.decided)[:, 0]} == {111}
+    assert model.agreement(st.model)
+
+
+def test_competing_proposers_agree_on_one_value():
+    cl, model, st = build()
+    m = model.propose(st.model, 1, 0, 100, int(st.rnd), N)
+    m = model.propose(m, 3, 0, 300, int(st.rnd), N)
+    st = st._replace(model=m)
+    st = cl.steps(st, 60)
+    assert model.agreement(st.model)
+    decided = {int(v) for v in np.asarray(st.model.decided)[:, 0]
+               if v >= 0}
+    assert len(decided) == 1 and decided <= {100, 300}
+    assert len(model.decided_nodes(st.model, 0)) == N
+
+
+def test_decision_survives_minority_crashes():
+    cl, model, st = build()
+    st = st._replace(model=model.propose(st.model, 0, 0, 42,
+                                         int(st.rnd), N))
+    st = cl.steps(st, 12)
+    assert 42 in np.asarray(st.model.decided)[:, 0]
+    # crash two acceptors, then a NEW proposer must still learn 42
+    st = st._replace(faults=faults_mod.crash(st.faults, 3))
+    st = st._replace(faults=faults_mod.crash(st.faults, 4))
+    st = st._replace(model=model.propose(st.model, 1, 0, 999,
+                                         int(st.rnd), N))
+    st = cl.steps(st, 40)
+    assert model.agreement(st.model)
+    vals = {int(v) for v in np.asarray(st.model.decided)[:, 0] if v >= 0}
+    assert vals == {42}           # the earlier decree wins; 999 cannot
+
+
+def test_omitted_decide_leaves_learners_undecided_but_safe():
+    """Omission of the proposer's DECIDE fan-out: nobody else learns,
+    but no disagreement appears (safety under omission)."""
+    from partisan_tpu import interpose
+    from partisan_tpu import types as T
+
+    def drop_decides(cfg, ctx, em):
+        from partisan_tpu.models.paxos import OP_DECIDE
+        return (em[..., T.W_KIND] == T.MsgKind.APP) \
+            & (em[..., T.P0] == OP_DECIDE)
+
+    model = Paxos(slots=1)
+    cfg = Config(n_nodes=N, seed=7, msg_words=13, inbox_cap=64)
+    cl = Cluster(cfg, model=model, interpose=interpose.Drop(drop_decides))
+    st = cl.init()
+    for i in range(1, N):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    st = cl.steps(st, 5)
+    st = st._replace(model=model.propose(st.model, 2, 0, 77,
+                                         int(st.rnd), N))
+    st = cl.steps(st, 20)
+    assert model.agreement(st.model)
+    assert model.decided_nodes(st.model, 0) == [2]  # only the proposer
+
+
+def test_prop_harness_passes_at_fault_budget():
+    """The reference's check-paxoid.sh run: random proposals + crash and
+    omission faults within tolerance; safety and conditional liveness
+    hold."""
+    sys = PaxosSystem(n_nodes=5, slots=2, seed=3)
+    h = Harness(system=sys,
+                fault_model=CrashFaultModel(tolerance=1),
+                scheduler="finite_fault", n_runs=4, n_commands=5,
+                seed=21)
+    res = h.run()
+    assert res.ok, res.render()
+
+
+def test_weakened_adoption_rule_is_caught_and_shrunk():
+    """unsafe_adopt breaks the Synod adoption rule: a later ballot
+    pushes its own value over an already-chosen one, so two proposals
+    on one decree choose DIFFERENT values.  The harness must FIND the
+    disagreement and SHRINK the script to the two proposals."""
+    sys = PaxosSystem(n_nodes=5, slots=1, seed=3, unsafe_adopt=True,
+                      check_termination=False)
+    h = Harness(system=sys, n_runs=8, n_commands=6, seed=5)
+    res = h.run()
+    assert not res.ok
+    assert res.shrunk is not None and len(res.shrunk) <= 3
+    assert all(c.name == "propose" for c in res.shrunk)
+    assert len(res.shrunk) >= 2          # it takes two to disagree
+
+
+def test_filibuster_omission_search_passes_on_correct_paxos():
+    """Filibuster explores single-omission schedules over the proposal
+    exchange; correct Paxos survives every one (the retry path heals)."""
+    from partisan_tpu import filibuster
+    from partisan_tpu import types as T
+
+    model = Paxos(slots=1, retry_rounds=6)
+
+    def build_fb(ip):
+        cfg = Config(n_nodes=5, seed=11, msg_words=13, inbox_cap=64)
+        cl = Cluster(cfg, model=model, interpose=ip)
+        st = cl.init()
+        for i in range(1, 5):
+            st = st._replace(manager=cl.manager.join(cfg, st.manager,
+                                                     i, 0))
+        st = cl.steps(st, 5)
+        st = st._replace(model=model.propose(st.model, 2, 0, 55,
+                                             int(st.rnd), 5))
+        return cl, st
+
+    def assertion(cl, st):
+        if not model.agreement(st.model):
+            return False
+        # liveness at the budget: the (alive) proposer re-drives the
+        # decree through retries despite any single omission
+        return len(model.decided_nodes(st.model, 0)) == 5
+
+    chk = filibuster.Checker(
+        build=build_fb, horizon=40, assertion=assertion,
+        candidate=lambda e: e.kind == T.MsgKind.APP,
+        max_faults=1, max_executions=60)
+    res = chk.run()
+    assert res.passed, res.render()
+    assert res.executions > 10           # the search actually searched

@@ -1,5 +1,20 @@
-"""Driver-config scenario tests (BASELINE.md benchmark configs 1-5),
-run at CPU-smoke scale — the same code paths the TPU benchmark runs."""
+"""Driver-config scenario tests (BASELINE.md benchmark configs 1-5) at
+CPU-smoke scale — the same code paths the TPU benchmark runs — with
+DISTRIBUTION-LEVEL conformance bands derived from the reference/papers
+(VERDICT r3: quantitative bands, not smoke bounds):
+
+- SCAMP partial-view mean vs the ideal subscription process executed
+  directly at the same n (scenarios.scamp_ideal_mean — the asymptotic
+  (c+1)·ln n law of partisan_scamp_v1_membership_strategy.erl:272-276
+  is reported beside it; the ideal process is the finite-n truth),
+- HyParView active-view sizes within [active_min, active_max] with ONE
+  connected component (include/partisan.hrl:204-217),
+- plumtree repair under 5% drop within the flood-depth + graft-cycle
+  bound AND within a grain of the no-drop baseline
+  (partisan_plumtree_broadcast.erl:861-905),
+- rumor-mongering plateau within a band of the Demers mean-field
+  infect-and-die fixed point.
+"""
 
 from partisan_tpu import scenarios
 
@@ -10,21 +25,48 @@ def test_config1_anti_entropy():
     assert r["rounds_per_sec"] > 0
 
 
-def test_config2_rumor():
-    r = scenarios.config2_rumor(n=96)
+def test_config2_rumor_plateau_band():
+    r = scenarios.config2_rumor(n=256)
+    fp = r["expected_plateau_meanfield"]
+    assert abs(fp - 0.7968) < 0.001          # the k=2 fixed point
     assert r["infection_rounds"] > 0, r
-    assert 0.5 <= r["coverage_plateau"] <= 1.0, r
+    # overlay targeting biases the plateau a few points ABOVE the
+    # complete-graph mean-field value, never an order off
+    assert fp - 0.03 <= r["coverage_plateau"] <= fp + 0.13, r
 
 
-def test_config3_plumtree_drop():
-    r = scenarios.config3_plumtree_drop(n=128)
+def test_config3_plumtree_repair_band():
+    base = scenarios.config3_plumtree_drop(n=128, drop=0.0)
+    assert base["repair_rounds"] > 0, base   # baseline must converge
+    r = scenarios.config3_plumtree_drop(n=128, drop=0.05)
     assert r["repair_rounds"] > 0, r
+    # band 1: the analytic flood + repair-cycle bound
+    assert r["repair_rounds"] <= r["expected_max_repair_rounds"], r
+    # band 2: 5% drop costs at most two measurement grains over the
+    # drop-free baseline (the lazy/graft path heals within rounds)
+    assert r["repair_rounds"] <= base["repair_rounds"] \
+        + 2 * scenarios.K_PROG, (base, r)
 
 
-def test_config4_scamp_churn():
+def test_config4_scamp_view_band():
     r = scenarios.config4_scamp_churn(n=128, rounds=60)
     assert r["alive"] > 0
-    assert r["partial_view_mean"] > 1.0, r
+    ideal = r["expected_ideal_process"]
+    stable = r["stable_partial_view_mean"]
+    # the sim's stable mean tracks the ideal subscription process at
+    # the same n within 35% (walk timing + bounded-view effects); the
+    # asymptotic law is reported for context but not asserted at
+    # smoke n (it overshoots any faithful finite-n run)
+    assert ideal * 0.65 <= stable <= ideal * 1.35, r
+    # churn thins views but must not collapse them
+    assert r["partial_view_mean"] >= 0.4 * stable, r
+
+
+def test_hyparview_views_band():
+    r = scenarios.hyparview_views(n=256)
+    assert r["size_max"] <= r["active_max"], r
+    assert r["frac_at_least_min"] >= 0.95, r
+    assert r["components"] == 1, r
 
 
 def test_config5_causal_crash():

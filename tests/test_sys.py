@@ -1,0 +1,71 @@
+"""sys-style introspection (partisan_tpu.otp.sys — the partisan_sys
+analogue: get_state / replace_state / trace / statistics on node slices
+of a running cluster).  Mirrors the MIGRATING.md "Debugging a live
+node" cookbook section."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from support import boot_hyparview, hv_config
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.direct_mail import DirectMail
+from partisan_tpu.otp import sys as psys
+
+
+def _boot():
+    cfg = Config(n_nodes=8, seed=9, inbox_cap=48)
+    model = DirectMail()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    for i in range(1, 8):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    # settle past a full membership-gossip interval so members() is
+    # complete before the broadcast fan-out
+    return cl, model, cl.steps(st, 15)
+
+
+def test_get_state_slices_node_axis_leaves():
+    cl = Cluster(hv_config(12, seed=5))
+    st = boot_hyparview(cl)
+    view = psys.get_state(st.manager, 7, 12)
+    assert view.active.shape == (cl.cfg.hyparview.active_max,)
+    assert view.passive.shape == (cl.cfg.hyparview.passive_max,)
+    # matches the raw slice
+    assert (np.asarray(view.active) ==
+            np.asarray(st.manager.active[7])).all()
+
+
+def test_replace_state_patches_one_node_only():
+    cl = Cluster(hv_config(12, seed=5))
+    st = boot_hyparview(cl)
+    before = np.asarray(st.manager.join_target).copy()
+    m2 = psys.replace_state(
+        st.manager, 3, 12,
+        lambda s: s._replace(join_target=jnp.int32(9)))
+    after = np.asarray(m2.join_target)
+    assert after[3] == 9
+    mask = np.arange(12) != 3
+    assert (after[mask] == before[mask]).all()
+    # and the patched state RUNS: the forced join target is consumed
+    st = cl.steps(st._replace(manager=m2), 10)
+    assert int(st.manager.join_target[3]) == -1     # join confirmed
+
+
+def test_trace_renders_one_nodes_traffic():
+    cl, model, st = _boot()
+    st = st._replace(model=model.broadcast(st.model, 2, 0))
+    st, log = psys.trace(cl, st, 4, node=2)
+    assert "2 =>" in log                  # node 2 sent its direct mail
+    assert "APP" in log
+
+
+def test_statistics_counts_messages_per_node():
+    cl, model, st = _boot()
+    st = st._replace(model=model.broadcast(st.model, 2, 0))
+    st, stats = psys.statistics(cl, st, 6)
+    assert set(stats) == set(range(8))
+    assert stats[2]["messages_out"] >= 7  # the broadcast fan-out
+    total_in = sum(s["messages_in"] for s in stats.values())
+    assert total_in > 0
