@@ -162,12 +162,14 @@ class Plumtree:
         # adopt it, RESET the tree flags (the new root's tree forms
         # from scratch), and ignore every message stamped with an
         # older epoch — late traffic from the recycled tree cannot
-        # prune/graft/advertise into the new one.
-        oh_b0 = (b[:, :, None] == jnp.arange(B)[None, None, :])
-        g_ep = jnp.max(
-            jnp.where(oh_b0 & is_g[:, :, None],
-                      ep_w[:, :, None], 0), axis=1)             # [n, B]
-        tgt_ep = jnp.maximum(state.epoch, g_ep)
+        # prune/graft/advertise into the new one.  One scatter-max
+        # instead of an [n, cap, B] where+reduce: epochs are the only
+        # slot-keyed MAX on the hot path and the materialized one-hot
+        # cost ~12% of the 32k round.
+        r2e = jnp.broadcast_to(
+            jnp.arange(n_local, dtype=jnp.int32)[:, None], b.shape)
+        tgt_ep = state.epoch.at[
+            r2e, jnp.where(is_g, b, B)].max(ep_w, mode="drop")
         bumped = tgt_ep > state.epoch                           # [n, B]
         pruned = pruned & ~bumped[:, :, None]
         lazyp = lazyp & ~bumped[:, :, None]

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -34,6 +35,17 @@ class MonitorState(NamedTuple):
     down_sig: Array    # bool[n_local, n_global] — pending DOWN signals
     nodedown: Array    # bool[n_local, n_global] — pending nodedown msgs
     nodeup: Array      # bool[n_local, n_global] — pending nodeup msgs
+    # Edge (channel) monitoring — the reference's channel-down
+    # machinery: a connection EXIT prunes the registry and fires
+    # channel-down callbacks once a peer's conn count hits 0 while the
+    # node may still be up (partisan_pluggable_peer_service_manager.erl
+    # :1489-1535; the on_down/3 channel variant of the behaviour).  The
+    # sim's per-edge "all channels to peer X down" signal is edge
+    # unreachability: peer crashed OR the (owner, peer) edge partitioned.
+    edge_subs: Array   # bool[n_local, n_global] — persistent edge subs
+    prev_reach: Array  # bool[n_local, n_global] — last round's edge view
+    edge_down: Array   # bool[n_local, n_global] — pending edge-down
+    edge_up: Array     # bool[n_local, n_global] — pending edge-up
 
 
 class MonitorService:
@@ -49,7 +61,9 @@ class MonitorService:
         return MonitorState(
             monitors=zb, node_subs=jnp.zeros((n,), jnp.bool_),
             prev_alive=jnp.ones((g,), jnp.bool_),
-            down_sig=zb, nodedown=zb, nodeup=zb)
+            down_sig=zb, nodedown=zb, nodeup=zb,
+            edge_subs=zb, prev_reach=jnp.ones((n, g), jnp.bool_),
+            edge_down=zb, edge_up=zb)
 
     def step(self, cfg: Config, comm: LocalComm, st: MonitorState,
              ctx: RoundCtx, nbrs: Array) -> tuple[MonitorState, Array]:
@@ -66,10 +80,35 @@ class MonitorService:
         nodeup = st.nodeup | (
             st.node_subs[:, None] & came_up[None, :] & alive_row)
 
+        # edge (channel-down) monitoring: reach(i, j) = both alive and
+        # the edge not partitioned — the sim's "some connection to j
+        # exists" ground truth (stochastic link_drop is message loss,
+        # not a connection state, so it does not enter here)
+        gids = comm.local_ids()
+        part = ctx.faults.partition
+        if part.ndim == 2:
+            cut = jax.lax.dynamic_slice(
+                part, (comm.node_offset, 0),
+                (comm.n_local, comm.n_global))
+        else:
+            cut = part[gids][:, None] != part[None, :]
+        # prev_reach tracks the PURE edge state (peer alive, edge
+        # uncut) — the owner's own liveness only gates event DELIVERY.
+        # Folding owner aliveness into the tracked state would make an
+        # owner crash+recover read as a spurious edge_up with no
+        # matching edge_down.
+        reach = galive[None, :] & ~cut
+        edge_down = st.edge_down | (
+            st.edge_subs & st.prev_reach & ~reach & alive_row)
+        edge_up = st.edge_up | (
+            st.edge_subs & ~st.prev_reach & reach & alive_row)
+
         emitted = jnp.zeros((comm.n_local, 0, cfg.msg_words), jnp.int32)
         return MonitorState(
             monitors=monitors, node_subs=st.node_subs, prev_alive=galive,
-            down_sig=down_sig, nodedown=nodedown, nodeup=nodeup), emitted
+            down_sig=down_sig, nodedown=nodedown, nodeup=nodeup,
+            edge_subs=st.edge_subs, prev_reach=reach,
+            edge_down=edge_down, edge_up=edge_up), emitted
 
     # ---- host-side API ------------------------------------------------
     def monitor(self, st: MonitorState, owner: int, target: int
@@ -82,11 +121,44 @@ class MonitorService:
                 down_sig=st.down_sig.at[owner, target].set(True))
         return st._replace(monitors=st.monitors.at[owner, target].set(True))
 
-    def demonitor(self, st: MonitorState, owner: int, target: int
-                  ) -> MonitorState:
+    def demonitor(self, st: MonitorState, owner: int, target: int,
+                  flush: bool = True, info: bool = False):
+        """erlang:demonitor options: ``flush`` also removes an
+        already-pending DOWN signal (without it, a DOWN that fired
+        before the demonitor is still delivered — the default OTP
+        behavior is flush=false; the sim's historical default flushed,
+        kept for compatibility); ``info=True`` additionally returns
+        whether a monitor was actually removed."""
+        existed = bool(st.monitors[owner, target])
+        st = st._replace(monitors=st.monitors.at[owner, target].set(False))
+        if flush:
+            st = st._replace(
+                down_sig=st.down_sig.at[owner, target].set(False))
+        return (st, existed) if info else st
+
+    # ---- edge (channel-down) subscriptions ----------------------------
+    def monitor_edge(self, st: MonitorState, owner: int, peer: int,
+                     flag: bool = True) -> MonitorState:
+        """Subscribe ``owner`` to connectivity transitions of its edge
+        to ``peer`` (the channel-down/up callback registration; the
+        reference's on_down/3 with a channel argument).  Persistent —
+        delivers both edge_down and edge_up until unsubscribed."""
         return st._replace(
-            monitors=st.monitors.at[owner, target].set(False),
-            down_sig=st.down_sig.at[owner, target].set(False))
+            edge_subs=st.edge_subs.at[owner, peer].set(flag))
+
+    @staticmethod
+    def take_edge_down(st: MonitorState, owner: int, peer: int
+                       ) -> tuple[MonitorState, bool]:
+        got = bool(st.edge_down[owner, peer])
+        return st._replace(
+            edge_down=st.edge_down.at[owner, peer].set(False)), got
+
+    @staticmethod
+    def take_edge_up(st: MonitorState, owner: int, peer: int
+                     ) -> tuple[MonitorState, bool]:
+        got = bool(st.edge_up[owner, peer])
+        return st._replace(
+            edge_up=st.edge_up.at[owner, peer].set(False)), got
 
     def monitor_nodes(self, st: MonitorState, node: int,
                       flag: bool = True) -> MonitorState:

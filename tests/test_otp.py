@@ -179,3 +179,71 @@ def test_rpc_cast_executes_without_reply():
     st = cl.steps(st, 4)
     rs = stack.sub(st.model, 0)
     assert int(rs.status[1].sum()) == 0
+
+
+def test_edge_monitor_fires_on_partition_and_heal():
+    """Channel-down machinery (reference :1489-1535 conn-EXIT pruning
+    firing channel-down callbacks; on_down/3): an edge subscription
+    delivers edge_down when the (owner, peer) edge partitions while
+    BOTH nodes stay up, and edge_up when it heals."""
+    cl, stack, st = build()
+    mon = stack.models[1]
+    ms = mon.monitor_edge(stack.sub(st.model, 1), owner=1, peer=4)
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms))
+    st = cl.steps(st, 1)
+    st = st._replace(faults=faults_mod.inject_partition(
+        st.faults, [1], [4]))
+    st = cl.steps(st, 2)
+    ms, down = mon_mod.MonitorService.take_edge_down(
+        stack.sub(st.model, 1), 1, 4)
+    assert down
+    # both endpoints are still alive — this is a CHANNEL down, not DOWN
+    assert bool(st.faults.alive[1]) and bool(st.faults.alive[4])
+    _, node_down = mon_mod.MonitorService.take_down(
+        stack.sub(st.model, 1), 1, 4)
+    assert not node_down
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms),
+                     faults=faults_mod.resolve_partition(st.faults))
+    st = cl.steps(st, 2)
+    _, up = mon_mod.MonitorService.take_edge_up(
+        stack.sub(st.model, 1), 1, 4)
+    assert up
+
+
+def test_demonitor_flush_and_info_options():
+    cl, stack, st = build()
+    mon = stack.models[1]
+    ms = mon.monitor(stack.sub(st.model, 1), owner=0, target=3)
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms))
+    st = st._replace(faults=faults_mod.crash(st.faults, 3))
+    st = cl.steps(st, 2)                      # DOWN fires, pending
+    ms = stack.sub(st.model, 1)
+    # flush=False keeps the pending DOWN (OTP default demonitor)
+    ms2, existed = mon.demonitor(ms, 0, 3, flush=False, info=True)
+    assert existed is False                   # already fired: one-shot
+    _, got = mon_mod.MonitorService.take_down(ms2, 0, 3)
+    assert got                                # signal survived
+    # flush=True removes it
+    ms3 = mon.demonitor(ms, 0, 3, flush=True)
+    _, got2 = mon_mod.MonitorService.take_down(ms3, 0, 3)
+    assert not got2
+
+
+def test_owner_crash_recover_no_spurious_edge_up():
+    """An edge subscriber that crashes and recovers must NOT receive an
+    edge_up for an edge that never changed (prev_reach tracks the pure
+    edge state; owner liveness only gates delivery)."""
+    cl, stack, st = build()
+    mon = stack.models[1]
+    ms = mon.monitor_edge(stack.sub(st.model, 1), owner=1, peer=4)
+    st = st._replace(model=stack.replace_sub(st.model, 1, ms))
+    st = cl.steps(st, 2)
+    st = st._replace(faults=faults_mod.crash(st.faults, 1))
+    st = cl.steps(st, 2)
+    st = st._replace(faults=faults_mod.recover(st.faults, 1))
+    st = cl.steps(st, 2)
+    ms, up = mon_mod.MonitorService.take_edge_up(
+        stack.sub(st.model, 1), 1, 4)
+    assert not up
+    _, down = mon_mod.MonitorService.take_edge_down(ms, 1, 4)
+    assert not down
