@@ -203,10 +203,21 @@ class Plumtree:
 
         # ---- gossip merge (handler join fold, Mod:merge :571-577) --
         stale_g = is_g & hd.leq(pay, data_b)                    # is_stale
-        gmask = (oh_b & is_g[:, :, None])                       # [n, cap, B]
-        expanded = jnp.where(gmask[..., None], pay[:, :, None, :],
-                             hd.bottom())                       # [n,cap,B,PW]
-        joined_in = handlers_mod.tree_fold(hd, expanded, axis=1)  # [n, B, PW]
+        if isinstance(hd, handlers_mod.MaxJoinHandler):
+            # Elementwise-max joins fold as ONE scatter-max instead of
+            # materializing the [n, cap, B, PW] expansion + log-depth
+            # tree (BENCH_NOTES corrected cost model; exact same
+            # result: integer max is associative/commutative).  The
+            # scatter target starts from the handler's bottom() — the
+            # same padding contract the tree_fold path honors.
+            joined_in = (jnp.broadcast_to(hd.bottom(), (n_local, B, PW))
+                         .astype(jnp.int32).at[
+                r2e, jnp.where(is_g, b, B)].max(pay, mode="drop"))
+        else:
+            gmask = (oh_b & is_g[:, :, None])                   # [n, cap, B]
+            expanded = jnp.where(gmask[..., None], pay[:, :, None, :],
+                                 hd.bottom())                   # [n,cap,B,PW]
+            joined_in = handlers_mod.tree_fold(hd, expanded, axis=1)
         fresh_any = ~hd.leq(joined_in, data)                    # [n, B]
 
         # Winner per (tree, round): prefer the first slot whose payload
@@ -222,9 +233,11 @@ class Plumtree:
         slot_c = jnp.arange(cap)[None, :]
 
         def first_by_tree(cond):
-            return jnp.min(
-                jnp.where(oh_b & cond[:, :, None], slot_c[:, :, None], cap),
-                axis=1)                                         # [n, B]
+            # scatter-min over the slot's tree index — no [n, cap, B]
+            # materialization (same HBM-traffic reasoning as joined_in)
+            return jnp.full((n_local, B), cap, jnp.int32).at[
+                r2e, jnp.where(cond, b, B)].min(
+                jnp.broadcast_to(slot_c, b.shape), mode="drop")
 
         first_pref = first_by_tree(win_ns & eq_fold)
         first_ns = first_by_tree(win_ns)
