@@ -75,7 +75,13 @@ class PlumtreeState(NamedTuple):
     #                      (a recycled broadcast must dominate — the
     #                      version bump / later timestamp / grown
     #                      counter all do), which keeps AAE exchange
-    #                      epoch-oblivious and correct.
+    #                      epoch-oblivious and correct.  Epoch ADOPTION
+    #                      rides eager/graft gossip only: a node whose
+    #                      data arrived via the epoch-less AAE lane
+    #                      adopts (and resets flags) on the next eager
+    #                      wave that reaches it — a benign lag, since
+    #                      its store is already current and stale-epoch
+    #                      traffic is rejected from the adoption round.
 
 
 class Plumtree:
