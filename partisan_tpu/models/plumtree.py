@@ -76,12 +76,10 @@ class PlumtreeState(NamedTuple):
     #                      version bump / later timestamp / grown
     #                      counter all do), which keeps AAE exchange
     #                      epoch-oblivious and correct.  Epoch ADOPTION
-    #                      rides eager/graft gossip only: a node whose
-    #                      data arrived via the epoch-less AAE lane
-    #                      adopts (and resets flags) on the next eager
-    #                      wave that reaches it — a benign lag, since
-    #                      its store is already current and stale-epoch
-    #                      traffic is rejected from the adoption round.
+    #                      rides eager/graft gossip AND a scatter-max
+    #                      on the AAE exchange lane, so AAE-satisfied
+    #                      nodes reset their flags in the same round
+    #                      they pull recycled data.
 
 
 class Plumtree:
@@ -384,9 +382,25 @@ class Plumtree:
                 tgt = jnp.concatenate([tick_tgt, tgt], axis=1)
             tgt = faults_mod.filter_edges(
                 ctx.faults, gids, tgt, cfg.seed, ctx.rnd, _AAE_EDGE_TAG)
-            pulled = hd.exchange(comm, data, tgt)
-            data = hd.join(data, jnp.where(ctx.alive[:, None, None], pulled,
-                                           hd.bottom()))
+            # Slot epochs ride the SAME exchange edges as the store
+            # (fused into one scatter for stock max-join handlers —
+            # handlers.exchange_with_epochs): a node whose data arrives
+            # via AAE adopts the recycled epoch — and resets its tree
+            # flags — in the same round instead of waiting for the next
+            # eager wave.  Safe because the store is lattice-monotone
+            # across recycles (adoption never discards data).
+            pulled, pulled_ep = hd.exchange_with_epochs(comm, data,
+                                                        tgt_ep, tgt)
+            if pulled is not None:
+                data = hd.join(data, jnp.where(ctx.alive[:, None, None],
+                                               pulled, hd.bottom()))
+            aae_bump = ctx.alive[:, None] & (pulled_ep > tgt_ep)
+            pruned = pruned & ~aae_bump[:, :, None]
+            lazyp = lazyp & ~aae_bump[:, :, None]
+            rr = jnp.where(aae_bump, 0, rr)
+            psrc = jnp.where(aae_bump, -1, psrc)
+            tgt_ep = jnp.maximum(tgt_ep, jnp.where(ctx.alive[:, None],
+                                                   pulled_ep, 0))
 
         # Crash-stopped nodes are frozen and silent.
         dead = ~ctx.alive
