@@ -76,8 +76,13 @@ def latency_rounds(cfg: Config, a: Array, b: Array) -> Array:
 
 def modeled_rtt(cfg: Config, a: Array, b: Array) -> Array:
     """The RTT a measurement of edge (a, b) would find: two modeled
-    one-way hops plus the two scheduling rounds every exchange costs."""
-    return 2 * latency_rounds(cfg, a, b) + 2
+    one-way hops plus the two scheduling rounds every exchange costs.
+
+    A lat-0 edge still pays one pong-buffer round: the responder's
+    release pass runs BEFORE the same round's scheduling pass (see
+    :func:`step`), so a pong due immediately cannot depart until the
+    next round — the measured floor is 3, not 2."""
+    return jnp.maximum(2 * latency_rounds(cfg, a, b), 1) + 2
 
 
 class DistanceState(NamedTuple):
@@ -136,7 +141,17 @@ def step(cfg: Config, comm: LocalComm, st: DistanceState, ctx: RoundCtx,
     r2 = jnp.broadcast_to(rows[:, None], (n, cap))
     slot_free = jnp.take_along_axis(
         pong_tgt, jnp.where(is_ping, src % B, 0), axis=1) < 0
-    take = is_ping & slot_free
+    cand = is_ping & slot_free
+    # Same-round PINGs colliding on one slot: the three field scatters
+    # below are independent, and XLA's duplicate-update order is
+    # unspecified PER scatter — a surviving slot could mix tgt from one
+    # ping with echo from another.  Resolve before scattering: only the
+    # first (lowest inbox index) ping per slot per row wins.
+    s_cand = jnp.where(cand, src % B, -1)
+    earlier = jnp.tril(jnp.ones((cap, cap), bool), k=-1)
+    dup = ((s_cand[:, :, None] == s_cand[:, None, :])
+           & (s_cand[:, :, None] >= 0) & earlier[None]).any(-1)
+    take = cand & ~dup
     slot = jnp.where(take, src % B, B)                 # B = discard
     hold = ctx.rnd + 2 * latency_rounds(
         cfg, jnp.broadcast_to(gids[:, None], src.shape), src)

@@ -72,10 +72,14 @@ class PaxosState(NamedTuple):
     p_ballot: Array     # int32 — current ballot
     p_value: Array      # int32 — own proposed value
     p_chosen: Array     # int32 — phase-2 value (highest-accepted or own)
-    p_nprom: Array      # int32 — promises for p_ballot
+    p_prom: Array       # bool[n, S, NG] — acceptors who PROMISEd
+    #                     p_ballot.  Per-acceptor bits, not a counter:
+    #                     a duplicated PROMISE (e.g. paxos traffic over
+    #                     the at-least-once acked lane, or a duplicating
+    #                     interposition) must not fake a quorum.
     p_hib: Array        # int32 — highest accepted ballot among promises
     p_hiv: Array        # int32 — its value
-    p_nacc: Array       # int32 — ACCEPTED acks for p_ballot
+    p_acc: Array        # bool[n, S, NG] — acceptors who ACCEPTED p_ballot
     p_t0: Array         # int32 — round of phase entry (retry base)
     p_sent: Array       # bool — current phase's fan-out already emitted
     p_won: Array        # int32[n, S] — value this node CHOSE as the
@@ -117,11 +121,12 @@ class Paxos:
                              "(payload [op, slot, ballot, value, aux])")
         n, s = comm.n_local, self.slots
         zi = jnp.zeros((n, s), jnp.int32)
+        zb = jnp.zeros((n, s, comm.n_global), jnp.bool_)
         return PaxosState(
             a_promised=zi, a_ballot=zi, a_value=jnp.full((n, s), -1,
                                                          jnp.int32),
             p_phase=zi, p_ballot=zi, p_value=zi, p_chosen=zi,
-            p_nprom=zi, p_hib=zi, p_hiv=zi, p_nacc=zi, p_t0=zi,
+            p_prom=zb, p_hib=zi, p_hiv=zi, p_acc=zb, p_t0=zi,
             p_sent=jnp.zeros((n, s), jnp.bool_),
             p_won=jnp.full((n, s), -1, jnp.int32),
             won_conflict=jnp.zeros((n, s), jnp.bool_),
@@ -202,10 +207,25 @@ class Paxos:
         a_promised = jnp.maximum(promised_mid, jnp.maximum(prep_max, 0))
 
         # ---- proposer: collect PROMISE / ACCEPTED ---------------------
+        all_ids = jnp.arange(NG, dtype=jnp.int32)
         m_prom = per_slot(OP_PROMISE) \
             & (mbal[:, None, :] == st.p_ballot[:, :, None]) \
             & (st.p_phase == P_PREPARING)[:, :, None]
-        nprom = st.p_nprom + jnp.sum(m_prom, axis=2, dtype=jnp.int32)
+        # fold message sources into per-acceptor bits (quorum counts
+        # DISTINCT acceptors — duplicate delivery cannot inflate it).
+        # One scatter per mask: no [n, S, cap, NG] one-hot expansion
+        # (duplicate .set writes all carry True — order-independent).
+        r3 = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32)[:, None, None], m_prom.shape)
+        s3 = jnp.broadcast_to(sl[None, :, None], m_prom.shape)
+        src3 = jnp.broadcast_to(msrc[:, None, :], m_prom.shape)
+
+        def fold_bits(bits, mask):
+            return bits.at[r3, s3, jnp.where(mask, src3, NG)].set(
+                True, mode="drop")
+
+        p_prom = fold_bits(st.p_prom, m_prom)
+        nprom = jnp.sum(p_prom, axis=2, dtype=jnp.int32)
         # highest accepted (ballot, value) among this round's promises
         pr_ab = jnp.where(m_prom, maux[:, None, :], NEG)
         pr_hib = jnp.max(pr_ab, axis=2)
@@ -220,7 +240,8 @@ class Paxos:
         m_accd = per_slot(OP_ACCEPTED) \
             & (mbal[:, None, :] == st.p_ballot[:, :, None]) \
             & (st.p_phase == P_ACCEPTING)[:, :, None]
-        nacc = st.p_nacc + jnp.sum(m_accd, axis=2, dtype=jnp.int32)
+        p_acc = fold_bits(st.p_acc, m_accd)
+        nacc = jnp.sum(p_acc, axis=2, dtype=jnp.int32)
 
         # phase transitions
         to_accept = (st.p_phase == P_PREPARING) & (nprom >= Q)
@@ -250,8 +271,8 @@ class Paxos:
             & (ctx.rnd - p_t0 >= retry_at)
         p_ballot = jnp.where(stuck, st.p_ballot + NG, st.p_ballot)
         p_phase = jnp.where(stuck, P_PREPARING, p_phase)
-        nprom = jnp.where(stuck | to_accept, 0, nprom)
-        nacc = jnp.where(stuck | win, 0, nacc)
+        p_prom = jnp.where((stuck | to_accept)[:, :, None], False, p_prom)
+        p_acc = jnp.where((stuck | win)[:, :, None], False, p_acc)
         p_hib = jnp.where(stuck, 0, p_hib)
         p_hiv = jnp.where(stuck, 0, p_hiv)
         p_sent = p_sent & ~stuck
@@ -274,7 +295,6 @@ class Paxos:
         fan_val = jnp.where(p_phase == P_ACCEPTING, p_chosen, st.p_value)
         fan_val = jnp.where(dec_now, p_chosen, fan_val)
         fan_val = jnp.where(dec_rebc, decided, fan_val)
-        all_ids = jnp.arange(NG, dtype=jnp.int32)
         fan = msg_ops.build(
             cfg.msg_words, T.MsgKind.APP, gids[:, None, None],
             jnp.where(any_fan[:, :, None], all_ids[None, None, :], -1),
@@ -292,10 +312,10 @@ class Paxos:
             p_ballot=jnp.where(live, p_ballot, st.p_ballot),
             p_value=st.p_value,
             p_chosen=jnp.where(live, p_chosen, st.p_chosen),
-            p_nprom=jnp.where(live, nprom, st.p_nprom),
+            p_prom=jnp.where(live[:, :, None], p_prom, st.p_prom),
             p_hib=jnp.where(live, p_hib, st.p_hib),
             p_hiv=jnp.where(live, p_hiv, st.p_hiv),
-            p_nacc=jnp.where(live, nacc, st.p_nacc),
+            p_acc=jnp.where(live[:, :, None], p_acc, st.p_acc),
             p_t0=jnp.where(live, p_t0, st.p_t0),
             p_sent=jnp.where(live, p_sent, st.p_sent),
             p_won=jnp.where(live, p_won, st.p_won),
@@ -317,10 +337,10 @@ class Paxos:
             p_phase=st.p_phase.at[node, slot].set(P_PREPARING),
             p_ballot=st.p_ballot.at[node, slot].set(nxt),
             p_value=st.p_value.at[node, slot].set(value),
-            p_nprom=st.p_nprom.at[node, slot].set(0),
+            p_prom=st.p_prom.at[node, slot].set(False),
             p_hib=st.p_hib.at[node, slot].set(0),
             p_hiv=st.p_hiv.at[node, slot].set(0),
-            p_nacc=st.p_nacc.at[node, slot].set(0),
+            p_acc=st.p_acc.at[node, slot].set(False),
             p_t0=st.p_t0.at[node, slot].set(now),
             p_sent=st.p_sent.at[node, slot].set(False))
 

@@ -76,10 +76,23 @@ class PlumtreeState(NamedTuple):
     #                      version bump / later timestamp / grown
     #                      counter all do), which keeps AAE exchange
     #                      epoch-oblivious and correct.  Epoch ADOPTION
-    #                      rides eager/graft gossip AND a scatter-max
-    #                      on the AAE exchange lane, so AAE-satisfied
-    #                      nodes reset their flags in the same round
-    #                      they pull recycled data.
+    #                      rides eager/graft gossip, I_HAVE adverts AND
+    #                      a scatter-max on the AAE exchange lane, so
+    #                      AAE-satisfied nodes reset their flags in the
+    #                      same round they pull recycled data, and a
+    #                      node whose eager links were all pruned in the
+    #                      old epoch is recruited by the first new-epoch
+    #                      I_HAVE (it adopts, then grafts) instead of
+    #                      waiting for the AAE walk.
+    nonmono: Array       # int32[n] — detections of the monotone-recycle
+    #                      constraint being VIOLATED: a new-epoch gossip
+    #                      whose payload does not dominate the
+    #                      receiver's store, or a broadcast(fresh=True)
+    #                      whose payload does not dominate the
+    #                      injecting node's slot.  The epoch design is
+    #                      sound only while recycles dominate; this
+    #                      counter turns a silent tree conflation into a
+    #                      detectable event (telemetry.plumtree_metrics).
 
 
 class Plumtree:
@@ -109,6 +122,7 @@ class Plumtree:
             push_src=jnp.full((n, B), -1, jnp.int32),
             tree_nbrs=jnp.full((n, K), -1, jnp.int32),
             epoch=jnp.zeros((n, B), jnp.int32),
+            nonmono=jnp.zeros((n,), jnp.int32),
         )
 
     # ------------------------------------------------------------------
@@ -162,19 +176,25 @@ class Plumtree:
         is_ak = kind == T.MsgKind.PT_IHAVE_ACK
 
         # ---- slot-epoch guard (per-root trees, :118-160) ----------
-        # A gossiped higher epoch re-keys the slot to its new root:
-        # adopt it, RESET the tree flags (the new root's tree forms
-        # from scratch), and ignore every message stamped with an
-        # older epoch — late traffic from the recycled tree cannot
-        # prune/graft/advertise into the new one.  One scatter-max
-        # instead of an [n, cap, B] where+reduce: epochs are the only
-        # slot-keyed MAX on the hot path and the materialized one-hot
-        # cost ~12% of the 32k round.
+        # A higher epoch on gossip OR an i_have advert re-keys the slot
+        # to its new root: adopt it, RESET the tree flags (the new
+        # root's tree forms from scratch), and ignore every message
+        # stamped with an older epoch — late traffic from the recycled
+        # tree cannot prune/graft/advertise into the new one.  I_HAVE
+        # adoption is the lazy-repair recruit path: a node whose eager
+        # links were all pruned in the OLD epoch sees only adverts, so
+        # without it the recycled slot could not graft it back in until
+        # the AAE walk found it.  One scatter-max instead of an
+        # [n, cap, B] where+reduce: epochs are the only slot-keyed MAX
+        # on the hot path and the materialized one-hot cost ~12% of the
+        # 32k round.
         r2e = jnp.broadcast_to(
             jnp.arange(n_local, dtype=jnp.int32)[:, None], b.shape)
         tgt_ep = state.epoch.at[
-            r2e, jnp.where(is_g, b, B)].max(ep_w, mode="drop")
+            r2e, jnp.where(is_g | is_ih, b, B)].max(ep_w, mode="drop")
         bumped = tgt_ep > state.epoch                           # [n, B]
+        old_ep_b = jnp.take_along_axis(state.epoch, b, axis=1)  # [n, cap]
+        bump_g = is_g & (ep_w > old_ep_b)   # raw mask, pre-epoch-filter
         pruned = pruned & ~bumped[:, :, None]
         lazyp = lazyp & ~bumped[:, :, None]
         rr = jnp.where(bumped, 0, rr)
@@ -197,6 +217,12 @@ class Plumtree:
                 & ks_ok[:, :, None])                            # [n, cap, K]
         # round-start store at each slot's tree: [n, cap, PW]
         data_b = jnp.take_along_axis(data, b[:, :, None], axis=1)
+        # Monotone-recycle constraint check: an epoch-bumping gossip
+        # whose payload does NOT dominate the receiver's store means
+        # the recycled broadcast broke the lattice contract the
+        # epoch-oblivious store depends on — count it (never silent).
+        nonmono = state.nonmono + jnp.sum(
+            bump_g & ~hd.leq(data_b, pay), axis=1, dtype=jnp.int32)
 
         def any_bk(cond):
             """[n, cap] slot mask -> bool[n, B, K] any-hit, as an MXU
@@ -420,6 +446,7 @@ class Plumtree:
             push_src=keep(psrc, state.push_src),
             tree_nbrs=keep(nbrs, state.tree_nbrs),
             epoch=keep(tgt_ep, state.epoch),
+            nonmono=keep(nonmono, state.nonmono),
         )
         return new_state, emitted
 
@@ -447,11 +474,18 @@ class Plumtree:
             push_src=state.push_src.at[node, slot].set(-1),
         )
         if fresh:
+            # Detect a recycle that breaks the monotone-lattice
+            # contract at the injection point (the payload must
+            # dominate the slot's previous store); receivers detect
+            # the same condition in-round (see ``nonmono`` in step).
+            dom = self.handler.leq(state.data[node, slot], vec)
             st = st._replace(
                 epoch=st.epoch.at[node, slot].add(1),
                 pruned=st.pruned.at[node, slot].set(False),
                 lazy_pending=st.lazy_pending.at[node, slot].set(False),
                 rround=st.rround.at[node, slot].set(0),
+                nonmono=st.nonmono.at[node].add(
+                    jnp.where(dom, 0, 1).astype(jnp.int32)),
             )
         return st
 

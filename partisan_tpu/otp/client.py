@@ -1,0 +1,107 @@
+"""The shared in-sim gen call client (partisan_gen.erl:360-400 caller
+side), used by every vectorized behaviour service (otp/gen_sim.py's
+gen_server, otp/statem_sim.py's gen_statem).
+
+One per-node call table drives the protocol: QUEUED slots emit a
+``GEN_CALL``/``GEN_CAST`` (payload ``(a, b, ref)``), WAITING slots pair
+``GEN_REPLY`` by ref, abort with DOWN when the destination dies
+(the partisan_monitor path) and TIMEOUT past the deadline (demonitor —
+stale replies can no longer match).  Extracting it keeps the two OTP
+runtimes from drifting: a fix to reply pairing or DOWN detection lands
+once.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+
+# call-table slot status
+IDLE, QUEUED, WAITING, OK, TIMEOUT, DOWN = 0, 1, 2, 3, 4, 5
+
+
+def client_round(cfg, comm: LocalComm, ctx: RoundCtx, *, status: Array,
+                 dst: Array, a: Array, b: Array, ref: Array,
+                 deadline: Array, result: Array
+                 ) -> tuple[Array, Array, Array]:
+    """One round of the caller side.  Returns (status', result',
+    request_msgs int32[n, C, W])."""
+    alive = ctx.alive
+    inb = ctx.inbox.data
+    gids = comm.local_ids()
+
+    # pair replies with WAITING refs
+    m_resp = (inb[..., T.W_KIND] == T.MsgKind.GEN_REPLY) & alive[:, None]
+    ref_eq = (inb[..., T.P1][:, :, None] == ref[:, None, :]) \
+        & m_resp[:, :, None] & (status == WAITING)[:, None, :]
+    got = ref_eq.any(axis=1)
+    val = jnp.max(jnp.where(ref_eq, inb[..., T.P0][:, :, None],
+                            jnp.iinfo(jnp.int32).min), axis=1)
+    status = jnp.where(got, OK, status)
+    result = jnp.where(got, val, result)
+
+    # monitor DOWN: destination died while WAITING
+    dst_alive = ctx.faults.alive[jnp.clip(dst, 0, comm.n_global - 1)]
+    status = jnp.where((status == WAITING) & ~dst_alive, DOWN, status)
+
+    # timeout: demonitor (stale replies can't match)
+    status = jnp.where((status == WAITING) & (ctx.rnd >= deadline),
+                       TIMEOUT, status)
+
+    # emit queued requests
+    fire = (status == QUEUED) & alive[:, None]
+    req = msg_ops.build(
+        cfg.msg_words, jnp.where(ref > 0, T.MsgKind.GEN_CALL,
+                                 T.MsgKind.GEN_CAST),
+        gids[:, None], jnp.where(fire, dst, -1), payload=(a, b, ref))
+    status = jnp.where(fire, jnp.where(ref > 0, WAITING, IDLE), status)
+    return status, result, req
+
+
+def alloc(st, caller: int, *, status_field: str = "status",
+          **fields) -> "tuple":
+    """Host-side: claim the first IDLE slot on ``caller`` and write
+    ``fields`` (each a state-field-name -> value).  Returns the updated
+    state NamedTuple."""
+    status = getattr(st, status_field)
+    free = np.flatnonzero(np.asarray(status[caller]) == IDLE)
+    if free.size == 0:
+        raise RuntimeError(f"call table full on node {caller}")
+    s = int(free[0])
+    upd = {status_field: status.at[caller, s].set(QUEUED)}
+    for name, value in fields.items():
+        arr = getattr(st, name)
+        upd[name] = arr.at[caller, s].set(value)
+    return st._replace(**upd)
+
+
+def response(st, caller: int, ref: int) -> tuple[str, int | None]:
+    """('ok', value) | ('timeout', None) | ('down', None) |
+    ('waiting', None)."""
+    refs = np.asarray(st.ref[caller])
+    stats = np.asarray(st.status[caller])
+    hit = np.flatnonzero((refs == ref) & (stats != IDLE))
+    if hit.size == 0:
+        return "waiting", None
+    s = int(stats[hit[0]])
+    if s == OK:
+        return "ok", int(st.result[caller, int(hit[0])])
+    if s == TIMEOUT:
+        return "timeout", None
+    if s == DOWN:
+        return "down", None
+    return "waiting", None
+
+
+def free(st, caller: int, ref: int):
+    refs = np.asarray(st.ref[caller])
+    hit = np.flatnonzero(refs == ref)
+    if hit.size == 0:
+        return st
+    return st._replace(status=st.status.at[caller, int(hit[0])].set(IDLE))

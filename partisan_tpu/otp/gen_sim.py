@@ -30,9 +30,10 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.otp import client as client_mod
+from partisan_tpu.otp.client import (
+    DOWN, IDLE, OK, QUEUED, TIMEOUT, WAITING)
 
-# call-table slot status
-IDLE, QUEUED, WAITING, OK, TIMEOUT, DOWN = 0, 1, 2, 3, 4, 5
 # server functions
 FN_INCR, FN_GET, FN_STOP = 1, 2, 3
 
@@ -112,99 +113,35 @@ class GenServerService:
             cfg.msg_words, T.MsgKind.GEN_REPLY, gids[:, None], resp_dst,
             payload=(res, ref_w))
 
-        # ---- caller: pair replies with WAITING refs --------------------
-        m_resp = (inb[..., T.W_KIND] == T.MsgKind.GEN_REPLY) \
-            & alive[:, None]
-        ref_eq = (inb[..., T.P1][:, :, None] == st.ref[:, None, :]) \
-            & m_resp[:, :, None] & (st.status == WAITING)[:, None, :]
-        got = ref_eq.any(axis=1)
-        val = jnp.max(jnp.where(ref_eq, inb[..., T.P0][:, :, None],
-                                jnp.iinfo(jnp.int32).min), axis=1)
-        status = jnp.where(got, OK, st.status)
-        result = jnp.where(got, val, st.result)
-
-        # ---- monitor DOWN: destination died while WAITING --------------
-        dst_alive = ctx.faults.alive[jnp.clip(st.dst, 0,
-                                              comm.n_global - 1)]
-        died = (status == WAITING) & ~dst_alive
-        status = jnp.where(died, DOWN, status)
-
-        # ---- timeout: demonitor (stale replies can't match) ------------
-        expired = (status == WAITING) & (ctx.rnd >= st.deadline)
-        status = jnp.where(expired, TIMEOUT, status)
-
-        # ---- emit queued requests --------------------------------------
-        fire = (status == QUEUED) & alive[:, None]
-        req = msg_ops.build(
-            cfg.msg_words, jnp.where(st.ref > 0, T.MsgKind.GEN_CALL,
-                                     T.MsgKind.GEN_CAST),
-            gids[:, None], jnp.where(fire, st.dst, -1),
-            payload=(st.fn, st.arg, st.ref))
-        status = jnp.where(fire, jnp.where(st.ref > 0, WAITING, IDLE),
-                           status)
+        # ---- caller side: the shared gen call client -------------------
+        status, result, req = client_mod.client_round(
+            cfg, comm, ctx, status=st.status, dst=st.dst, a=st.fn,
+            b=st.arg, ref=st.ref, deadline=st.deadline, result=st.result)
 
         emitted = jnp.concatenate([resp, req], axis=1)
         return st._replace(counter=counter, stopped=stopped,
                            status=status, result=result), emitted
 
     # ---- host-side API (the partisan_gen_server:call surface) ---------
-    @staticmethod
-    def _alloc(st: GenSimState, caller: int, dst: int, fn: int, arg: int,
-               ref: int, deadline: int) -> GenSimState:
-        import numpy as np
-
-        free = np.flatnonzero(np.asarray(st.status[caller]) == IDLE)
-        if free.size == 0:
-            raise RuntimeError(f"call table full on node {caller}")
-        slot = int(free[0])
-        return st._replace(
-            status=st.status.at[caller, slot].set(QUEUED),
-            dst=st.dst.at[caller, slot].set(dst),
-            fn=st.fn.at[caller, slot].set(fn),
-            arg=st.arg.at[caller, slot].set(arg),
-            ref=st.ref.at[caller, slot].set(ref),
-            deadline=st.deadline.at[caller, slot].set(deadline),
-            result=st.result.at[caller, slot].set(0),
-        )
-
     def call(self, st: GenSimState, caller: int, dst: int, fn: int,
              arg: int, timeout_rounds: int, now: int
              ) -> tuple[GenSimState, int]:
         ref = int(st.next_ref[caller])
-        st = self._alloc(st, caller, dst, fn, arg, ref,
-                         now + timeout_rounds)
+        st = client_mod.alloc(st, caller, dst=dst, fn=fn, arg=arg,
+                              ref=ref, deadline=now + timeout_rounds,
+                              result=0)
         return st._replace(next_ref=st.next_ref.at[caller].add(1)), ref
 
     def cast(self, st: GenSimState, caller: int, dst: int, fn: int,
              arg: int) -> GenSimState:
-        return self._alloc(st, caller, dst, fn, arg, 0, 0)
+        return client_mod.alloc(st, caller, dst=dst, fn=fn, arg=arg,
+                                ref=0, deadline=0, result=0)
 
     def response(self, st: GenSimState, caller: int, ref: int
                  ) -> tuple[str, int | None]:
         """('ok', value) | ('timeout', None) | ('down', None) |
         ('waiting', None)."""
-        import numpy as np
-
-        refs = np.asarray(st.ref[caller])
-        stats = np.asarray(st.status[caller])
-        hit = np.flatnonzero((refs == ref) & (stats != IDLE))
-        if hit.size == 0:
-            return "waiting", None
-        s = int(stats[hit[0]])
-        if s == OK:
-            return "ok", int(st.result[caller, int(hit[0])])
-        if s == TIMEOUT:
-            return "timeout", None
-        if s == DOWN:
-            return "down", None
-        return "waiting", None
+        return client_mod.response(st, caller, ref)
 
     def free(self, st: GenSimState, caller: int, ref: int) -> GenSimState:
-        import numpy as np
-
-        refs = np.asarray(st.ref[caller])
-        hit = np.flatnonzero(refs == ref)
-        if hit.size == 0:
-            return st
-        return st._replace(
-            status=st.status.at[caller, int(hit[0])].set(IDLE))
+        return client_mod.free(st, caller, ref)

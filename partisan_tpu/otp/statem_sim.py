@@ -1,0 +1,337 @@
+"""In-sim vectorized gen_statem: every node hosts a statem server whose
+full event loop — postpone replay in arrival order, state timeouts armed
+on entry, event timeouts cancelled by any event — runs ON THE NODE AXIS
+inside the jitted round.
+
+This extends the in-sim OTP runtime beyond the counter gen_server
+(otp/gen_sim.py): the reference's behaviours are first-class runtime
+citizens on every node (priv/otp/24/partisan_gen_statem.erl:1-50), so
+the sim backend must be able to run a statem's loop for all nodes at
+once, not only through the host-side port machines
+(partisan_tpu.otp.gen_statem).
+
+Design — a TABLE machine shared by both runtimes:
+
+:class:`TableStatem` encodes a statem callback module as dense arrays
+(``trans``/``reply``/``postpone``/``event_timeout`` over [state, event],
+``state_timeout`` over [state]).  The same instance serves as
+
+- a host-side :class:`partisan_tpu.otp.gen_statem.Module` (it implements
+  ``handle_event``/``state_timeout``), driven by the sequential
+  :class:`~partisan_tpu.otp.gen_statem.GenStatem` loop over any port, and
+- the interpretation tables for :class:`StatemService`, whose round step
+  replays the identical loop as a ``lax.scan`` of micro-steps over a per-
+  node event ring — which is what makes conformance checkable on
+  identical schedules (tests/test_statem_sim.py).
+
+Loop semantics transposed (mirroring gen_statem.py, which documents the
+reference anchors):
+
+1. the round's queue is [state-timer, event-timer?, external events in
+   arrival order]; the event-timer entry exists only when no external
+   event arrived (the reference cancels the event timeout the moment the
+   queue is non-empty),
+2. each micro-step consumes the queue head: external events cancel a
+   pending event timeout; a postponed event appends to the postpone
+   buffer; a handled call replies from the PRE-transition state; a state
+   change re-arms the state timeout and PREPENDS the postponed buffer
+   (original arrival order) ahead of the unprocessed remainder,
+3. timers fire as internal events through the same tables (internal
+   columns ignore postpone/reply, the _dispatch_internal contract).
+
+The queue is a ring (int arithmetic on a head index), so the prepend is
+O(postpone_cap) scatters, and every micro-step is a handful of [n]
+vector ops — the whole cluster's statems advance together.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.comm import LocalComm
+from partisan_tpu.config import Config
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.otp import client as client_mod
+from partisan_tpu.otp import gen_statem as host_statem
+
+# queue-entry types
+_ENT_EVENT, _ENT_CALL, _ENT_ST_TIMER, _ENT_EV_TIMER = 0, 1, 2, 3
+
+
+class TableStatem:
+    """A statem callback module as dense transition tables.
+
+    ``n_states`` x ``n_events`` externals plus two internal columns
+    (state timeout, event timeout).  ``trans`` -1 = keep_state;
+    ``reply`` -1 = no reply, else the call replies ``reply + arg``;
+    ``event_timeout``/``state_timeout`` -1 = don't arm.
+    """
+
+    def __init__(self, n_states: int, n_events: int, init_state: int,
+                 trans, reply, postpone, event_timeout,
+                 state_timeout) -> None:
+        self.n_states, self.n_events = n_states, n_events
+        self.init_state = init_state
+        ncol = n_events + 2
+        self.trans = np.asarray(trans, np.int32).reshape(n_states, ncol)
+        self.reply = np.asarray(reply, np.int32).reshape(n_states, ncol)
+        self.postpone = np.asarray(postpone, bool).reshape(n_states, ncol)
+        self.event_timeout = np.asarray(
+            event_timeout, np.int32).reshape(n_states, ncol)
+        self.state_timeout_tbl = np.asarray(state_timeout,
+                                            np.int32).reshape(n_states)
+
+    # -- host-side Module protocol (gen_statem.Module) ------------------
+    def _col(self, ev: int) -> int:
+        if ev == host_statem.EV_STATE_TIMEOUT:
+            return self.n_events
+        if ev == host_statem.EV_EVENT_TIMEOUT:
+            return self.n_events + 1
+        return min(max(int(ev), 0), self.n_events - 1)
+
+    def handle_event(self, state: int, ev: int, arg: int,
+                     is_call: bool) -> host_statem.Result:
+        c = self._col(ev)
+        nxt = int(self.trans[state, c])
+        rep = int(self.reply[state, c])
+        evt = int(self.event_timeout[state, c])
+        return host_statem.Result(
+            next_state=None if nxt < 0 else nxt,
+            reply=None if rep < 0 else rep + int(arg),
+            postpone=bool(self.postpone[state, c]),
+            event_timeout=None if evt < 0 else evt)
+
+    def state_timeout(self, state: int) -> Optional[int]:
+        t = int(self.state_timeout_tbl[state])
+        return None if t < 0 else t
+
+
+class StatemSimState(NamedTuple):
+    # server side (one statem per node)
+    sm: Array         # int32[n] — current state
+    started: Array    # bool[n] — initial state_timeout armed
+    st_dl: Array      # int32[n] — state-timeout deadline (-1 = none)
+    ev_dl: Array      # int32[n] — event-timeout deadline (-1 = none)
+    post: Array       # int32[n, P, 5] — postponed (typ, src, ev, arg, ref)
+    pcount: Array     # int32[n]
+    unprocessed: Array  # int32[n] — faithfulness violations: events
+    #                     still queued when the micro-step budget ran
+    #                     out, PLUS events that should have postponed
+    #                     but overflowed the postpone buffer (they
+    #                     dispatch instead of replaying — the host loop
+    #                     postpones unboundedly).  MUST stay 0 for the
+    #                     loop to conform; a nonzero count means the
+    #                     static bounds were undersized for the traffic
+    #                     — detectable, never silent.
+    # caller side (per-node call table, the gen_sim vocabulary)
+    status: Array     # int32[n, C]
+    dst: Array        # int32[n, C]
+    ev: Array         # int32[n, C]
+    arg: Array        # int32[n, C]
+    ref: Array        # int32[n, C]
+    deadline: Array   # int32[n, C]
+    result: Array     # int32[n, C]
+    next_ref: Array   # int32[n]
+
+
+class StatemService:
+    """Stackable model: one table statem per node + its call client.
+
+    ``micro_steps`` bounds the per-round event loop.  The worst case is
+    E*(P+1)+2 micro-steps for E external events in one round (every
+    event postponed and replayed on every transition); the default
+    covers E = cap (one full caller table aimed at one server) with the
+    default postpone_cap.  If the budget ever runs out anyway, the
+    shortfall lands in ``unprocessed`` — a loud conformance break, not
+    a silent drop (checked by tests/test_statem_sim.py).
+    """
+
+    name = "gen_statem"
+
+    def __init__(self, module: TableStatem, cap: int = 8,
+                 postpone_cap: int = 4,
+                 micro_steps: int | None = None) -> None:
+        self.module = module
+        self.cap = cap
+        self.postpone_cap = postpone_cap
+        self.micro_steps = micro_steps if micro_steps is not None \
+            else cap * (postpone_cap + 1) + 2
+
+    def init(self, cfg: Config, comm: LocalComm) -> StatemSimState:
+        n, c, p = comm.n_local, self.cap, self.postpone_cap
+        zi = jnp.zeros((n, c), jnp.int32)
+        return StatemSimState(
+            sm=jnp.full((n,), self.module.init_state, jnp.int32),
+            started=jnp.zeros((n,), jnp.bool_),
+            st_dl=jnp.full((n,), -1, jnp.int32),
+            ev_dl=jnp.full((n,), -1, jnp.int32),
+            post=jnp.zeros((n, p, 5), jnp.int32),
+            pcount=jnp.zeros((n,), jnp.int32),
+            unprocessed=jnp.zeros((n,), jnp.int32),
+            status=zi, dst=zi, ev=zi, arg=zi, ref=zi, deadline=zi,
+            result=zi, next_ref=jnp.ones((n,), jnp.int32))
+
+    # ------------------------------------------------------------------
+    def step(self, cfg: Config, comm: LocalComm, st: StatemSimState,
+             ctx: RoundCtx, nbrs: Array) -> tuple[StatemSimState, Array]:
+        n = st.sm.shape[0]
+        P = self.postpone_cap
+        gids = comm.local_ids()
+        alive = ctx.alive
+        rnd = ctx.rnd
+        inb = ctx.inbox.data
+        cap = inb.shape[1]
+        NE = self.module.n_events
+        trans = jnp.asarray(self.module.trans)
+        reply_t = jnp.asarray(self.module.reply)
+        post_t = jnp.asarray(self.module.postpone)
+        evtmo_t = jnp.asarray(self.module.event_timeout)
+        sttmo_t = jnp.asarray(self.module.state_timeout_tbl)
+        rows = jnp.arange(n, dtype=jnp.int32)
+
+        # ---- first step: entering the INITIAL state arms its timer ----
+        fresh = alive & ~st.started
+        t0 = sttmo_t[st.sm]
+        st_dl = jnp.where(fresh & (t0 >= 0), rnd + t0, st.st_dl)
+        started = st.started | alive
+
+        # ---- build the round's queue ----------------------------------
+        m_call = (inb[..., T.W_KIND] == T.MsgKind.GEN_CALL) & alive[:, None]
+        m_ev = (inb[..., T.W_KIND] == T.MsgKind.GEN_CAST) & alive[:, None]
+        valid = m_call | m_ev                                   # [n, cap]
+        had_ext = valid.any(axis=1)
+        entry = jnp.stack([
+            jnp.where(m_call, _ENT_CALL, _ENT_EVENT),
+            inb[..., T.W_SRC], inb[..., T.P0], inb[..., T.P1],
+            inb[..., T.P2]], axis=-1)                           # [n, cap, 5]
+        LQ = cap + P + 4
+        # ring slots 0/1 = timers; externals compact to 2.. in inbox
+        # (= arrival) order
+        queue = jnp.zeros((n, LQ, 5), jnp.int32)
+        queue = queue.at[:, 0, 0].set(_ENT_ST_TIMER)
+        queue = queue.at[:, 1, 0].set(_ENT_EV_TIMER)
+        pos = 2 + jnp.cumsum(valid, axis=1) - valid
+        r2 = jnp.broadcast_to(rows[:, None], (n, cap))
+        queue = queue.at[r2, jnp.where(valid, pos, LQ)].set(
+            entry, mode="drop")
+        count = 2 + jnp.sum(valid, axis=1, dtype=jnp.int32)
+
+        Rm = cap + 2
+        carry = (st.sm, st_dl, st.ev_dl, jnp.zeros((n,), jnp.int32),
+                 count, queue, st.post, st.pcount,
+                 jnp.zeros((n, Rm, 3), jnp.int32),
+                 jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+
+        def micro(c, _):
+            sm, sdl, edl, head, cnt, q, po, pc, reps, rc, ovf = c
+            active = (cnt > 0) & alive
+            e = q[rows, jnp.where(active, head % LQ, 0)]        # [n, 5]
+            typ, esrc, eev, earg, eref = jnp.unstack(e, axis=-1)
+            is_ext = active & (typ <= _ENT_CALL)
+            st_fire = active & (typ == _ENT_ST_TIMER) \
+                & (sdl >= 0) & (rnd >= sdl)
+            ev_fire = active & (typ == _ENT_EV_TIMER) \
+                & (edl >= 0) & (rnd >= edl) & ~had_ext
+            # consuming any external event cancels a pending event
+            # timeout (including one armed earlier this same batch)
+            edl = jnp.where(is_ext | ev_fire, -1, edl)
+            sdl = jnp.where(st_fire, -1, sdl)
+            col = jnp.where(st_fire, NE,
+                            jnp.where(ev_fire, NE + 1,
+                                      jnp.clip(eev, 0, NE - 1)))
+            nxt = trans[sm, col]
+            rep = reply_t[sm, col]
+            evt = evtmo_t[sm, col]
+            wants_pp = is_ext & post_t[sm, col]
+            do_pp = wants_pp & (pc < P)
+            # overflow: the host loop postpones unboundedly; dispatching
+            # instead is a conformance break — count it, never silent
+            ovf = ovf + (wants_pp & ~do_pp)
+            handled = (is_ext & ~do_pp) | st_fire | ev_fire
+            # postpone: append in arrival order
+            po = po.at[rows, jnp.where(do_pp, pc, P)].set(e, mode="drop")
+            pc = pc + do_pp
+            # reply from the PRE-transition state
+            do_rep = handled & (typ == _ENT_CALL) & (rep >= 0) \
+                & (eref > 0)
+            reps = reps.at[rows, jnp.where(do_rep, rc, Rm)].set(
+                jnp.stack([esrc, rep + earg, eref], -1), mode="drop")
+            rc = rc + do_rep
+            # event-timeout arm rides the action
+            edl = jnp.where(handled & (evt >= 0), rnd + evt, edl)
+            # transition: re-arm state timeout, replay postponed
+            changed = handled & (nxt >= 0) & (nxt != sm)
+            sm = jnp.where(handled & (nxt >= 0), nxt, sm)
+            tn = sttmo_t[sm]
+            sdl = jnp.where(changed, jnp.where(tn >= 0, rnd + tn, -1), sdl)
+            h2 = head + 1
+            npp = jnp.where(changed, pc, 0)
+            for i in range(P):
+                take = changed & (i < pc)
+                qpos = (h2 - npp + i) % LQ
+                q = q.at[rows, jnp.where(take, qpos, LQ)].set(
+                    po[:, i], mode="drop")
+            head = jnp.where(active, h2 - npp, head)
+            cnt = jnp.where(active, cnt - 1 + npp, cnt)
+            pc = jnp.where(changed, 0, pc)
+            return (sm, sdl, edl, head, cnt, q, po, pc, reps, rc,
+                    ovf), None
+
+        carry, _ = jax.lax.scan(micro, carry, None,
+                                length=self.micro_steps)
+        (sm, st_dl, ev_dl, _, leftover, _, post, pcount, reps, rc,
+         ovf) = carry
+
+        resp = msg_ops.build(
+            cfg.msg_words, T.MsgKind.GEN_REPLY, gids[:, None],
+            jnp.where(jnp.arange(Rm)[None, :] < rc[:, None],
+                      reps[..., 0], -1),
+            payload=(reps[..., 1], reps[..., 2]))
+
+        # ---- caller side: the shared gen call client -------------------
+        status, result, req = client_mod.client_round(
+            cfg, comm, ctx, status=st.status, dst=st.dst, a=st.ev,
+            b=st.arg, ref=st.ref, deadline=st.deadline, result=st.result)
+
+        out = st._replace(
+            sm=jnp.where(alive, sm, st.sm),
+            started=started,
+            st_dl=jnp.where(alive, st_dl, st.st_dl),
+            ev_dl=jnp.where(alive, ev_dl, st.ev_dl),
+            post=jnp.where(alive[:, None, None], post, st.post),
+            pcount=jnp.where(alive, pcount, st.pcount),
+            unprocessed=st.unprocessed
+            + jnp.where(alive, leftover + ovf, 0),
+            status=status, result=result)
+        return out, jnp.concatenate([resp, req], axis=1)
+
+    # ---- host-side API ------------------------------------------------
+    def call(self, st: StatemSimState, caller: int, dst: int, ev: int,
+             arg: int, timeout_rounds: int, now: int
+             ) -> tuple[StatemSimState, int]:
+        ref = int(st.next_ref[caller])
+        st = client_mod.alloc(st, caller, dst=dst, ev=ev, arg=arg,
+                              ref=ref, deadline=now + timeout_rounds,
+                              result=0)
+        return st._replace(next_ref=st.next_ref.at[caller].add(1)), ref
+
+    def event(self, st: StatemSimState, caller: int, dst: int, ev: int,
+              arg: int = 0) -> StatemSimState:
+        """Fire-and-forget statem event (gen_statem:cast)."""
+        return client_mod.alloc(st, caller, dst=dst, ev=ev, arg=arg,
+                                ref=0, deadline=0, result=0)
+
+    def response(self, st: StatemSimState, caller: int, ref: int
+                 ) -> tuple[str, int | None]:
+        return client_mod.response(st, caller, ref)
+
+    def free(self, st: StatemSimState, caller: int, ref: int
+             ) -> StatemSimState:
+        return client_mod.free(st, caller, ref)

@@ -120,6 +120,26 @@ def distance_metrics(dist_state) -> dict:
     }
 
 
+def plumtree_metrics(pt_state) -> dict:
+    """Host-side view of a :class:`partisan_tpu.models.plumtree
+    .PlumtreeState` (debug_get_peers/debug_get_tree analogue,
+    partisan_plumtree_broadcast.erl:179-188) plus the monotone-recycle
+    guard: ``recycle_nonmonotone`` counts detections of a slot recycle
+    whose payload failed to dominate the store — the constraint the
+    slot-epoch design depends on (models/plumtree.py epoch docs)."""
+    live = np.asarray(pt_state.tree_nbrs) >= 0
+    pruned = np.asarray(pt_state.pruned)
+    eager = live[:, None, :] & ~pruned
+    nonmono = np.asarray(pt_state.nonmono)
+    return {
+        "eager_degree_per_slot": (
+            eager.sum(axis=(0, 2)) / max(pruned.shape[0], 1)).tolist(),
+        "recycle_nonmonotone": int(nonmono.sum()),
+        "recycle_nonmonotone_nodes": np.flatnonzero(
+            nonmono).astype(int).tolist(),
+    }
+
+
 def connection_counts(cluster, state) -> dict:
     """Connection introspection (partisan_peer_service:connections/0,
     partisan_peer_connections:count/0-3 —

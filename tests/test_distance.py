@@ -35,13 +35,15 @@ def _boot_fullmesh_with(cfg, model):
     return cl, cl.steps(st, 5)
 
 
-def test_measured_rtt_equals_modeled_geometry():
-    """The cache fills with EXACTLY the modeled round trip (2 x one-way
-    + 2 scheduling rounds) — measured through real pings/pongs, ring
-    geometry."""
+@pytest.mark.parametrize("model", ["ring", "hash"])
+def test_measured_rtt_equals_modeled_geometry(model):
+    """The cache fills with EXACTLY the modeled round trip — measured
+    through real pings/pongs.  The hash model at n=8 contains lat-0
+    edges, which still pay the 1-round pong-buffer floor (release runs
+    before scheduling): modeled_rtt = max(2*lat, 1) + 2."""
     cfg = Config(n_nodes=8, seed=5, inbox_cap=48,
                  distance_interval_ms=2_000,
-                 distance=DistanceConfig(enabled=True, model="ring",
+                 distance=DistanceConfig(enabled=True, model=model,
                                          max_latency_rounds=4))
     svc = DistanceService()
     stack = Stack([svc])
@@ -51,6 +53,7 @@ def test_measured_rtt_equals_modeled_geometry():
     node = np.asarray(ds.rtt_node)
     val = np.asarray(ds.rtt_val)
     assert (node >= 0).sum() >= cfg.n_nodes  # plenty measured
+    lat0_seen = 0
     for i in range(cfg.n_nodes):
         for k in range(node.shape[1]):
             p = int(node[i, k])
@@ -59,6 +62,11 @@ def test_measured_rtt_equals_modeled_geometry():
             want = int(distance_mod.modeled_rtt(
                 cfg, jnp.int32(i), jnp.int32(p)))
             assert int(val[i, k]) == want, (i, p)
+            if int(distance_mod.latency_rounds(
+                    cfg, jnp.int32(i), jnp.int32(p))) == 0:
+                lat0_seen += 1
+    if model == "hash":
+        assert lat0_seen > 0  # the config actually exercises the floor
 
 
 def test_distance_interval_sets_probe_cadence():

@@ -147,6 +147,86 @@ def test_slot_recycling_keeps_trees_separate():
     assert (ep[:, :B] >= 1).all()
 
 
+def test_higher_epoch_ihave_recruits_pruned_node():
+    """ADVICE r4: a node whose eager links were ALL pruned in the old
+    epoch sees only i_have adverts for a recycled slot.  A strict
+    equality epoch filter would make it ignore them (heal waits on the
+    AAE walk); instead the advert's higher epoch is adopted — flags
+    reset — and the missing payload grafts in the same round."""
+    import jax.numpy as jnp
+
+    from partisan_tpu import faults as faults_mod
+    from partisan_tpu import types as T
+    from partisan_tpu.comm import LocalComm
+    from partisan_tpu.managers.base import RoundCtx
+    from partisan_tpu.ops import exchange
+    from partisan_tpu.ops import msg as msg_ops
+
+    cfg = fm_config(4, seed=3)
+    model = Plumtree()
+    comm = LocalComm(cfg.n_nodes, cfg.inbox_cap, cfg.msg_words)
+    n, K = cfg.n_nodes, cfg.n_nodes
+    nbrs = jnp.where(
+        jnp.arange(K)[None, :] != jnp.arange(n)[:, None],
+        jnp.arange(K)[None, :], -1).astype(jnp.int32)
+    st = model.init(cfg, comm)
+    # node 0: every link pruned for slot 0 under epoch 0
+    st = st._replace(tree_nbrs=nbrs,
+                     pruned=st.pruned.at[0, 0, :].set(True))
+    vec = model.handler.payload(7)
+    ih = msg_ops.build(
+        cfg.msg_words, T.MsgKind.PT_IHAVE, jnp.int32(1), jnp.int32(0),
+        payload=(jnp.int32(0), *jnp.unstack(vec),
+                 jnp.int32(0), jnp.int32(1)))   # slot, pay, hop, epoch 1
+    inbox = exchange.route(ih.reshape(1, 1, -1), n, cfg.inbox_cap)
+    ctx = RoundCtx(rnd=jnp.int32(10), alive=jnp.ones(n, bool),
+                   keys=jax.random.split(jax.random.PRNGKey(0), n),
+                   inbox=inbox, faults=faults_mod.none(n))
+    st2, emitted = model.step(cfg, comm, st, ctx, nbrs)
+    assert int(st2.epoch[0, 0]) == 1            # adopted the advert's epoch
+    assert not bool(st2.pruned[0, 0, :].any())  # flags reset for new tree
+    em = np.asarray(emitted[0])
+    grafts = em[(em[:, T.W_KIND] == T.MsgKind.PT_GRAFT)
+                & (em[:, T.W_DST] == 1)]
+    assert len(grafts) >= 1                     # grafted back in, same round
+
+
+def test_nonmonotone_recycle_detected():
+    """The slot-epoch design is sound only while a recycled broadcast's
+    payload dominates the slot's store.  A violating recycle must be
+    DETECTED (recycle_nonmonotone counter), not silently conflate
+    trees; a dominating recycle keeps the counter at zero."""
+    from partisan_tpu import telemetry
+
+    cfg = fm_config(8, seed=47, max_broadcasts=4)
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    st = st._replace(model=model.broadcast(st.model, 3, 0, version=5))
+    st = cl.steps(st, 10)
+    # dominating recycle: no detections anywhere
+    st = st._replace(model=model.broadcast(st.model, 6, 0, version=8,
+                                           fresh=True))
+    st = cl.steps(st, 10)
+    assert telemetry.plumtree_metrics(st.model)["recycle_nonmonotone"] == 0
+    # plant a higher version at ONE node only, then recycle below it:
+    # injection-site check passes (root's store is dominated) but the
+    # planted node receives new-epoch gossip that does not dominate
+    st = st._replace(model=model.broadcast(st.model, 7, 0, version=50))
+    st = st._replace(model=model.broadcast(st.model, 3, 0, version=9,
+                                           fresh=True))
+    st = cl.steps(st, 10)
+    m = telemetry.plumtree_metrics(st.model)
+    assert m["recycle_nonmonotone"] >= 1
+    assert 7 in m["recycle_nonmonotone_nodes"]
+    # host-side injection check: a recycle below the root's own store
+    before = telemetry.plumtree_metrics(st.model)["recycle_nonmonotone"]
+    st = st._replace(model=model.broadcast(st.model, 3, 0, version=1,
+                                           fresh=True))
+    after = telemetry.plumtree_metrics(st.model)["recycle_nonmonotone"]
+    assert after == before + 1
+
+
 def test_recycled_slot_regrows_tree_for_new_root():
     """After a slot's tree converged for root A, recycling it for root
     B resets the eager/lazy flags: B's first broadcast floods (degree

@@ -1,0 +1,235 @@
+"""In-sim vectorized gen_statem (partisan_tpu.otp.statem_sim): the
+statem event loop — postpone replay in arrival order, state timeouts
+armed on entry, event timeouts cancelled by any event — run on the node
+axis inside the jitted round, CONFORMANCE-CHECKED against the host-side
+sequential loop (partisan_tpu.otp.gen_statem.GenStatem) interpreting the
+SAME TableStatem on an identical schedule.
+
+Reference semantics anchors: priv/otp/24/partisan_gen_statem.erl (loop),
+test/partisan_gen_statem_SUITE.erl (behaviors under test).
+"""
+
+import numpy as np
+
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.models.stack import Stack
+from partisan_tpu.otp import gen
+from partisan_tpu.otp.gen_statem import GenStatem
+from partisan_tpu.otp.statem_sim import StatemService, TableStatem
+
+N = 6
+S0, S1, S2 = 0, 1, 2
+E_GO, E_PP, E_ARM, E_NOP = 0, 1, 2, 3
+X = -1
+
+# 3 states x (4 external + state-timeout + event-timeout) columns.
+# S1 arms a 4-round state timeout on entry (auto-revert to S0); E_ARM
+# arms a 3-round event timeout; an idle timeout sends S0/S1 to S2;
+# E_PP postpones in S0 until a transition replays it.
+MODULE = dict(
+    n_states=3, n_events=4, init_state=S0,
+    trans=[
+        # GO  PP  ARM NOP  ST  EVT
+        [S1,  X,  X,  X,   X,  S2],    # S0
+        [S2,  X,  X,  X,   S0, S2],    # S1
+        [S0, S0,  X,  X,   X,  X],     # S2
+    ],
+    reply=[
+        [100, X,  5,  1,   X,  X],
+        [200, 10, 5,  1,   X,  X],
+        [300, 20, 5,  1,   X,  X],
+    ],
+    postpone=[
+        [False, True,  False, False, False, False],
+        [False, False, False, False, False, False],
+        [False, False, False, False, False, False],
+    ],
+    event_timeout=[
+        [X, X, 3, X, X, X],
+        [X, X, 3, X, X, X],
+        [X, X, 3, X, X, X],
+    ],
+    state_timeout=[X, 4, X],
+)
+
+
+# ---------------------------------------------------------------------------
+# Host-side harness: a wire with the sim's delivery semantics (1-round
+# latency, arrival order = (sender id, emission order)), statem procs on
+# every node, OP_REPLY intercepted into a reply log.
+# ---------------------------------------------------------------------------
+
+class _MemPort:
+    def __init__(self, rig, i):
+        self.rig, self.id = rig, i
+
+    def forward(self, dst, words):
+        self.rig.pending.append((self.id, self.rig.seq(), dst,
+                                 list(words)))
+
+    def drain(self):
+        out = self.rig.inboxes[self.id]
+        self.rig.inboxes[self.id] = []
+        return out
+
+    def step(self, k=1):
+        return self.rig.rnd
+
+    def is_alive(self, node):
+        return True
+
+
+class MemRig:
+    """Iteration r mirrors the sim's round with ctx.rnd == r: messages
+    sent during r (script injections AND proc forwards) deliver at
+    r+1; procs process at rnd == r (the sim service arms its initial
+    state timeout on its first step the same way)."""
+
+    def __init__(self, n, module):
+        self.rnd = 0
+        self._seq = 0
+        self.pending = []       # (sender, seq, dst, words) sent this round
+        self.buffered = []      # script injections for this iteration
+        self.inboxes = {i: [] for i in range(n)}
+        self.replies = {}       # (caller, mref) -> (ok, value)
+        self.procs = [GenStatem(_MemPort(self, i), module)
+                      for i in range(n)]
+
+    def seq(self):
+        self._seq += 1
+        return self._seq
+
+    def inject(self, caller, dst, words):
+        self.buffered.append((caller, self.seq(), dst, list(words)))
+
+    def step(self):
+        deliver = self.pending          # sent during iteration r-1
+        self.pending = list(self.buffered)
+        self.buffered.clear()
+        for sender, _seq, dst, words in sorted(deliver):
+            if words[0] == gen.OP_REPLY:
+                self.replies[(dst, words[1])] = (words[2] == 0, words[3])
+            else:
+                self.inboxes[dst].append((sender, words))
+        for p in self.procs:
+            p.process(self.rnd)
+        self.rnd += 1
+
+    @property
+    def states(self):
+        return [p.state for p in self.procs]
+
+
+# ---------------------------------------------------------------------------
+# The shared schedule: round-offset -> [(kind, caller, dst, ev, arg)].
+# Exercises: transition calls with replies, postpone + replay on
+# transition, state timeout auto-revert, event timeout idle transition,
+# same-round serialization in arrival order, event-timeout cancellation.
+# ---------------------------------------------------------------------------
+
+SCHEDULE = {
+    0: [("event", 4, 0, E_PP, 0)],          # postponed in S0
+    2: [("call", 1, 0, E_GO, 0)],           # S0->S1 (100); replays E_PP
+    # S1 entered ~r+3; its 4-round state timeout reverts to S0 ~r+7
+    9: [("call", 2, 0, E_ARM, 0)],          # reply 5; arms event timeout
+    # idle 3 rounds -> event timeout fires, S0->S2
+    16: [("call", 1, 0, E_GO, 7)],          # S2->S0 (300 + 7)
+    # serialization: two same-round calls, arrival order = caller id
+    20: [("call", 1, 3, E_GO, 0),           # S0->S1 (100)
+         ("call", 2, 3, E_GO, 0)],          # then S1->S2 (200)
+    # cancellation: ARM then traffic before expiry -> no idle transition
+    24: [("call", 1, 5, E_ARM, 0)],
+    26: [("event", 2, 5, E_NOP, 0)],        # cancels the event timeout
+}
+ROUNDS = 34
+
+
+def _run_sim():
+    svc = StatemService(TableStatem(**MODULE))
+    stack = Stack([svc])
+    cfg = Config(n_nodes=N, seed=13, inbox_cap=48)
+    cl = Cluster(cfg, model=stack)
+    st = cl.init()
+    for i in range(1, N):
+        st = st._replace(manager=cl.manager.join(cfg, st.manager, i, 0))
+    traj, calls = [], {}
+    for r in range(ROUNDS):
+        gs = stack.sub(st.model, 0)
+        for item in SCHEDULE.get(r, ()):
+            kind, caller, dst, ev, arg = item
+            if kind == "call":
+                gs, ref = svc.call(gs, caller, dst, ev, arg,
+                                   timeout_rounds=25, now=int(st.rnd))
+                calls[(r, caller)] = (caller, ref)
+            else:
+                gs = svc.event(gs, caller, dst, ev, arg)
+        st = st._replace(model=stack.replace_sub(st.model, 0, gs))
+        st = cl.steps(st, 1)
+        traj.append(np.asarray(stack.sub(st.model, 0).sm).copy())
+    gs = stack.sub(st.model, 0)
+    # the micro-step budget never ran out (silent-drop guard)
+    assert int(np.asarray(gs.unprocessed).sum()) == 0
+    replies = {k: svc.response(gs, c, ref)
+               for k, (c, ref) in calls.items()}
+    return np.stack(traj), replies
+
+
+def _run_host():
+    rig = MemRig(N, TableStatem(**MODULE))
+    traj, calls = [], {}
+    mrefs = {i: 0 for i in range(N)}
+    for r in range(ROUNDS):
+        for item in SCHEDULE.get(r, ()):
+            kind, caller, dst, ev, arg = item
+            if kind == "call":
+                mrefs[caller] += 1
+                rig.inject(caller, dst,
+                           [gen.OP_CALL, mrefs[caller], ev, arg])
+                calls[(r, caller)] = (caller, mrefs[caller])
+            else:
+                rig.inject(caller, dst, [gen.OP_EVENT, 0, ev, arg])
+        rig.step()
+        traj.append(list(rig.states))
+    replies = {}
+    for k, (c, mref) in calls.items():
+        got = rig.replies.get((c, mref))
+        replies[k] = ("ok", got[1]) if got else ("timeout", None)
+    return np.asarray(traj), replies
+
+
+def test_sim_statem_conforms_to_host_loop_on_identical_schedule():
+    sim_traj, sim_replies = _run_sim()
+    host_traj, host_replies = _run_host()
+    assert sim_traj.shape == host_traj.shape
+    mismatch = np.argwhere(sim_traj != host_traj)
+    assert mismatch.size == 0, (
+        f"state divergence at (round, node) {mismatch[:5]}:\n"
+        f"sim:  {sim_traj[mismatch[0][0]]}\nhost: {host_traj[mismatch[0][0]]}")
+    assert sim_replies == host_replies, (sim_replies, host_replies)
+
+
+def test_sim_statem_semantics_explicitly():
+    """The behaviors themselves (not just conformance): postpone replay,
+    state timeout, event timeout + cancellation, serialization."""
+    traj, replies = _run_sim()
+    # transition call replied from the pre-transition state's table
+    assert replies[(2, 1)] == ("ok", 100)
+    # postponed E_PP replayed after the S0->S1 transition: no effect on
+    # state (handled in S1), but the machine DID pass through S1
+    assert (traj[:, 0] == S1).any()
+    # S1's 4-round state timeout reverted node 0 to S0
+    t_s1 = int(np.argmax(traj[:, 0] == S1))
+    assert traj[t_s1 + 4, 0] == S0
+    # E_ARM replied, then 3 idle rounds -> event timeout fired: S0->S2
+    assert replies[(9, 2)] == ("ok", 5)
+    assert (traj[10:16, 0] == S2).any()
+    # S2->S0 call replies 300 + arg
+    assert replies[(16, 1)] == ("ok", 307)
+    # same-round serialization on node 3: arrival order = caller id
+    assert replies[(20, 1)] == ("ok", 100)
+    assert replies[(20, 2)] == ("ok", 200)
+    assert (traj[:, 3] == S2).any()
+    # ARM on node 5 then an event before expiry: timeout cancelled,
+    # node 5 never leaves S0
+    assert (traj[:, 5] == S0).all()
