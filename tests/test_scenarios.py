@@ -51,15 +51,24 @@ def test_config3_plumtree_repair_band():
 def test_config4_scamp_view_band():
     r = scenarios.config4_scamp_churn(n=128, rounds=60)
     assert r["alive"] > 0
-    ideal = r["expected_ideal_process"]
-    stable = r["stable_partial_view_mean"]
     # the sim's stable mean tracks the ideal subscription process at
-    # the same n within 35% (walk timing + bounded-view effects); the
-    # asymptotic law is reported for context but not asserted at
-    # smoke n (it overshoots any faithful finite-n run)
-    assert ideal * 0.65 <= stable <= ideal * 1.35, r
+    # the same n within 35% (walk timing + bounded-view effects); with
+    # the rate-bounded admission stagger the band holds at EVERY scale
+    # — config4 computes in_band itself so the 10k artifact carries the
+    # same gate this test asserts (VERDICT r4 next #4); the asymptotic
+    # law is reported for context but not asserted at smoke n
+    assert r["in_band"], r
     # churn thins views but must not collapse them
-    assert r["partial_view_mean"] >= 0.4 * stable, r
+    assert r["partial_view_mean"] >= 0.4 * r["stable_partial_view_mean"], r
+
+
+def test_config4_scamp_band_holds_at_larger_scale():
+    """The r4 gap was scale-dependent (in band at smoke n, 0.51x at
+    10k).  The rate-bounded admission stagger makes the subscription
+    process scale-invariant; gate it at the largest CPU-feasible n
+    too."""
+    r = scenarios.config4_scamp_churn(n=512, rounds=40)
+    assert r["in_band"], r
 
 
 def test_hyparview_views_band():
