@@ -48,7 +48,7 @@ def run(n: int, verbose: bool = False) -> dict:
     from partisan_tpu.models.plumtree import Plumtree
     # program discipline shared with the scenario suite — ONE scan
     # length, scalar-transfer barrier (see scenarios.py module doc)
-    from partisan_tpu.scenarios import K_PROG, _boot_overlay, \
+    from partisan_tpu.scenarios import K_PROG, _boot_ladder, \
         _sync as sync
 
     phases: dict[str, float] = {}
@@ -60,17 +60,38 @@ def run(n: int, verbose: bool = False) -> dict:
             print(f"n={n} phase {name}: {phases[name]}s", file=sys.stderr,
                   flush=True)
 
+    # Backend/tunnel bring-up gets its OWN phase so per-size `init`
+    # numbers are comparable across rungs (the r4 artifact had the 32k
+    # rung absorbing backend/cache work into `init`).
+    t0 = time.perf_counter()
+    jax.devices()
+    mark("backend", t0)
+
     # Capacity knobs size the tensors to the workload (the relay-attached
     # TPU prices ops by bytes): one broadcast slot in use -> small
     # max_broadcasts / push_slots / lazy_cap; inbox_cap=16 measured at
     # identical convergence (58 rounds @4096, zero drops) and ~30% less
-    # per-round traffic than 32.
-    cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups", max_broadcasts=8,
-                 inbox_cap=16, emit_compact=32,
-                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    # per-round traffic than 32.  timer_stagger=False aligns the cadenced
+    # timers so rounds without control traffic skip the managers' heavy
+    # blocks (the r5 quiet-gate; semantics validated on CPU at 1k-8k:
+    # one component, convergence rounds unchanged).
+    def make_cfg(width):
+        return Config(n_nodes=width, seed=1,
+                      peer_service_manager="hyparview",
+                      msg_words=16, partition_mode="groups",
+                      max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                      timer_stagger=False,
+                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+
+    cfg = make_cfg(n)
     model = Plumtree()
     cl = Cluster(cfg, model=model, donate=True)
+
+    def make_cluster(width):
+        if width == n:
+            return cl
+        return Cluster(make_cfg(width), model=model, donate=True)
+
     # Every per-check host call must be ONE jitted dispatch: on the
     # relay-attached device each eager op is a host round-trip (~0.5 s),
     # which is what made the round-2 phases crawl.
@@ -81,43 +102,32 @@ def run(n: int, verbose: bool = False) -> dict:
     sync(st)
     mark("init", t0)
 
-    # Staggered bootstrap: the scenario suite's _boot_overlay (joins
-    # retry every round until accepted, one k=K_PROG exec per wave).
-    # The whole run is engineered down to ~70 useful rounds from r3's
-    # 150 (the r3 total was 102 s bootstrap + 27 s warm-up of a 169 s
-    # warm run; rounds at full width are the wall-clock currency):
-    #
-    # - the k=K_PROG program COMPILES inside wave 1 (no separate warm-up
-    #   execution burning 10 empty-overlay rounds) — the first wave's
-    #   wall is reported as `compile_wave1`,
-    # - wave factor 8 (vs the scenario default 4): 100k boots in 6
-    #   waves (50 rounds) instead of 9; validated at 8k/16k/32k on CPU
-    #   — one component at boot end, convergence rounds unchanged.
-    #   Factor 16+ or 5-round waves fragment the overlay at 16k+ (up
-    #   to 18 components, 2x the convergence rounds); 8 x 10-round
-    #   waves is the envelope,
-    # - ONE settle execution (was 4): enough for the last wave's joins
-    #   to land; the flood's own repair path (grafts, promotions, the
-    #   JOIN retry loop) heals the rest as it spreads.  settle=0 also
-    #   converges but costs +10 convergence rounds at 100k (30 vs 20)
-    #   for a net-equal total — one settle keeps the headline
-    #   convergence wall at r3 parity.
+    # Width-ladder bootstrap (scenarios._boot_ladder): the early join
+    # waves run on PREFIX-width clusters (4k, 32k) and the state grows
+    # between rungs, so only the last wave(s) + settle pay full-width
+    # rounds — the r4 bootstrap was 8 full-width waves at ~10 s each.
+    # Wave factor 8 and the join-retry/settle envelope are unchanged
+    # (validated on CPU: one component at boot end, convergence rounds
+    # unchanged); `smallw_boot` is the wall spent below full width
+    # (including the small rungs' compiles).
     t0 = time.perf_counter()
-    first_wave = {}
+    full_w = {}
 
-    def on_wave(hi, wave_st):
-        if not first_wave:
+    def on_wave(hi, wave_st, width):
+        if width < n:    # still on a sub-full-width rung: sync is cheap
             sync(wave_st)
-            first_wave["wall"] = time.perf_counter() - t0
+            full_w["smallw_end"] = time.perf_counter()
         if verbose:
             t1 = time.perf_counter()
             sync(wave_st)
-            print(f"n={n} wave ->{hi}: {time.perf_counter() - t1:.2f}s",
+            print(f"n={n} wave ->{hi} (width {width}): "
+                  f"{time.perf_counter() - t1:.2f}s",
                   file=sys.stderr, flush=True)
 
-    st = _boot_overlay(cl, n, settle_execs=1, on_wave=on_wave, state=st,
-                       wave_factor=8)
-    phases["compile_wave1"] = round(first_wave.get("wall", 0.0), 3)
+    _, st = _boot_ladder(make_cluster, n, settle_execs=1,
+                         on_wave=on_wave, final_state=st)
+    phases["smallw_boot"] = round(
+        full_w.get("smallw_end", t0) - t0, 3)
     mark("bootstrap", t0)
 
     if verbose:

@@ -137,9 +137,23 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
     fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
 
-    if cfg.emit_compact:
-        emitted = exchange.compact_emissions(emitted, cfg.emit_compact)
-    inbox = comm.route(emitted)
+    # The whole exchange (compaction sort + route) is skipped when NO
+    # message survived to the wire anywhere — common once the managers'
+    # quiet-gates leave rounds without traffic.  Cross-shard predicate:
+    # route contains collectives.
+    any_emit = comm.allsum(jnp.sum(emitted[..., 0] != 0,
+                                   dtype=jnp.int32)) > 0
+
+    def route_body(_):
+        e = exchange.compact_emissions(emitted, cfg.emit_compact) \
+            if cfg.emit_compact else emitted
+        return comm.route(e)
+
+    def route_skip(_):
+        return exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
+                                    cfg.msg_words)
+
+    inbox = jax.lax.cond(any_emit, route_body, route_skip, 0)
     # Crash-stopped receivers drop everything addressed to them.
     dead = ~alive_local
     inbox = exchange.Inbox(

@@ -188,6 +188,18 @@ class Config:
     lazy_tick_ms: int = 1_000            # plumtree i_have flush
     exchange_tick_ms: int = 10_000       # plumtree AAE
     distance_interval_ms: int = 10_000   # ping/pong RTT probing
+    timer_stagger: bool = True           # per-node timer phase offsets.
+    # The reference's wall-clock timers are per-process and drift apart,
+    # which the per-node `(rnd + id) % every` stagger models.  With
+    # False, cadenced timers (shuffle / promotion / X-BOT / AAE) fire
+    # ALIGNED (`rnd % every`): protocol semantics are identical, but a
+    # round with no cadence due and no in-flight control traffic is
+    # detectably QUIET, letting the managers skip their heavy blocks
+    # via lax.cond — the steady-state round-cost lever on the
+    # relay-attached TPU (BENCH_NOTES round 5).  Alignment trades the
+    # stagger's load smoothing for skippable rounds; the bounded-intake
+    # paths (one shuffle answered per round, admission caps) absorb the
+    # aligned bursts.
 
     # --- send/receive path delay (test plane) --------------------------
     # First-class keys installing an interpose.Delay on every event
@@ -371,6 +383,12 @@ class Config:
     def rounds(self, interval_ms: int) -> int:
         """Convert a wall-clock cadence to a whole number of rounds (>=1)."""
         return max(1, round(interval_ms / self.round_ms))
+
+    def timer_phase(self, gids):
+        """Per-node phase offset for cadenced timers: the node id under
+        ``timer_stagger`` (the reference's drifting per-process timers),
+        0 when aligned (quiet-round skipping — see timer_stagger doc)."""
+        return gids if self.timer_stagger else 0
 
     @property
     def gossip_every(self) -> int:

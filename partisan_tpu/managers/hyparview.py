@@ -107,6 +107,8 @@ _TAG_HBSEED = 322
 _TAG_HBJIT = 323
 _TAG_DPROBE = 324
 _TAG_HBFALL = 325
+_TAG_FJWALK = 330     # +hop (in-round forward_join walk, < arwl hops)
+_TAG_SHWALK = 340     # +hop (in-round shuffle walk)
 
 
 def link_cost(seed: int, a, b):
@@ -177,6 +179,26 @@ class HyParView:
     # ------------------------------------------------------------------
     def step(self, cfg: Config, comm: LocalComm, state: HyParViewState,
              ctx: RoundCtx) -> tuple[HyParViewState, Array]:
+        """One round.  The heavy protocol machinery (removals, central
+        admission, replies, passive merge, walk fan-outs, cadenced
+        sends) runs under ONE ``lax.cond`` gated on a global BUSY
+        predicate — any HyParView control message in any inbox, any
+        cadenced timer due, any pending scripted join/leave.  A quiet
+        round (steady state between cadence ticks) pays only the
+        prologue (failure-detector pruning), the liveness heartbeat and
+        the epilogue — the round-cost lever measured in BENCH_NOTES r5.
+        The predicate is a cross-shard ``allsum`` so every shard takes
+        the same branch (the busy body contains collectives).
+
+        Random walks (FORWARD_JOIN :1381, SHUFFLE :1750-1795) hop
+        IN-ROUND over a gathered snapshot of the active views: the
+        reference's TTL walk crosses ~ms TCP hops — sub-round at the
+        1 s/round calibration — so walking within the round is the
+        faithful wall-clock timing (the old one-hop-per-round walks
+        stretched a 6-hop walk to 6 virtual seconds).  The walk
+        endpoint gets the FORWARD_JOIN with TTL 0 (stop/adopt at the
+        receiver, locally re-checked); the PRWL-hop node gets a
+        deposit-marked copy (payload word 2) for its passive view."""
         hv = cfg.hyparview
         W = cfg.msg_words
         SAMPLE = _shuffle_sample(cfg)
@@ -184,6 +206,7 @@ class HyParView:
         n_local = state.active.shape[0]
         gids = comm.local_ids()
         cap = ctx.inbox.data.shape[1]
+        ph = cfg.timer_phase(gids)
 
         # Failure detector: prune crash-stopped AND left peers from active
         # views (connection EXIT -> on_down, reference :1489-1535: a left
@@ -210,6 +233,7 @@ class HyParView:
         ttl = inb[..., T.W_TTL]
         p0 = inb[..., T.P0]
         p1 = inb[..., T.P1]
+        dep_w = inb[..., T.P2]      # FORWARD_JOIN deposit marker (walk)
         is_join = kind == T.MsgKind.HPV_JOIN
         is_fj = kind == T.MsgKind.HPV_FORWARD_JOIN
         is_nb = kind == T.MsgKind.HPV_NEIGHBOR
@@ -227,8 +251,6 @@ class HyParView:
             return jnp.any((view[:, None, :] == ids[:, :, None])
                            & (ids >= 0)[:, :, None], axis=2)
 
-        in_active0 = slot_in(active0, src)                    # [n, cap]
-
         # Randomness on the hot path is counter-hash ranking
         # (ops/rng.rank32) — placement-invariant like the threefry
         # discipline, but a few elementwise passes instead of per-site
@@ -238,19 +260,6 @@ class HyParView:
 
         def ranked(tag, *coords):
             return rng.rank32(cfg.seed, ctx.rnd, tag, *coords)
-
-        def slot_pick(view, tag, *excl):
-            """int32[n, cap]: one random member of view[n, K] per inbox
-            slot, excluding the given [n, cap] id arrays (and empties)."""
-            r = ranked(tag, gids[:, None, None], slot_col[:, :, None],
-                       jnp.arange(view.shape[1])[None, None, :])
-            okm = jnp.broadcast_to((view >= 0)[:, None, :], r.shape)
-            for e in excl:
-                okm = okm & (view[:, None, :] != e[:, :, None])
-            score = jnp.where(okm, r | jnp.uint32(1), jnp.uint32(0))
-            best = jnp.argmax(score, axis=2)
-            got = jnp.take_along_axis(view, best, axis=1)
-            return jnp.where(jnp.max(score, axis=2) > 0, got, -1)
 
         def row_ranked(view, tag, k, exclude=None):
             """int32[n, k]: k distinct random members per row of
@@ -298,331 +307,525 @@ class HyParView:
             return distance_mod.measured_or_modeled(cfg, state.dist, a2,
                                                     b_arr)
 
-        # ---- 1. removals ---------------------------------------------
-        disc_src = jnp.where(is_disc, src, -1)
-        removed = jnp.any(
-            (active0[:, :, None] == disc_src[:, None, :])
-            & (active0 >= 0)[:, :, None], axis=2)              # [n, A]
+        # ---- timer fire masks + the global BUSY predicates -----------
+        # Two independent gates: message/join processing (admission,
+        # replies, passive merge) runs only when control traffic or a
+        # pending scripted join/leave exists anywhere; the cadenced
+        # sends (shuffle walk, promotion, X-BOT probes) only on their
+        # fire rounds.  Between cadence ticks of a settled overlay BOTH
+        # skip, and during a broadcast's dissemination (no membership
+        # churn) the manager stays almost entirely quiet.
+        sh_fire = ((ctx.rnd + ph) % cfg.shuffle_every == 0) & (asize0 > 0)
+        pr_fire = ((ctx.rnd + ph) % cfg.promotion_every == 0) & \
+            (asize0 < hv.active_min)
         if hv.xbot:
-            p2w = inb[..., T.P2]
-            p3w = inb[..., T.P3]
-            p4w = inb[..., T.P3 + 1]
-            is_xrep = kind == T.MsgKind.HPV_XBOT_REPLACE       # at d
-            is_xsw = kind == T.MsgKind.HPV_XBOT_SWITCH         # at o
-            is_xswr = kind == T.MsgKind.HPV_XBOT_SWITCH_REPLY  # at d
-            is_xrepr = kind == T.MsgKind.HPV_XBOT_REPLACE_REPLY  # at c
-            costs0 = jnp.where(active0 >= 0,
-                               cost(jnp.broadcast_to(me2, active0.shape),
-                                    jnp.maximum(active0, 0)), -jnp.inf)
-            zslot = jnp.argmax(costs0, axis=1)
-            z = jnp.where(jnp.any(active0 >= 0, axis=1),
-                          jnp.take_along_axis(
-                              active0, zslot[:, None], axis=1)[:, 0], -1)
-            have_room = (asize0 < acap) & (acap > 0)
-            # candidate side (OPT at c): room -> take the initiator now;
-            # full -> delegate to our worst peer d via REPLACE (4-party)
-            xo_take = is_xo & have_room[:, None] & ~in_active0
-            xo_dup = is_xo & in_active0
-            xo_full = is_xo & ~have_room[:, None] & ~in_active0 \
-                & (z >= 0)[:, None]
-            # d side (REPLACE): switch to o only if o beats c for ME
-            xrep_sw = is_xrep & (p0 >= 0) \
-                & (cost(me2, jnp.maximum(p0, 0))
-                   < cost(me2, jnp.maximum(p2w, 0)))
-            xrep_no = is_xrep & ~xrep_sw
-            # o side (SWITCH): accept iff the initiator really is ours
-            xsw_acc = is_xsw & slot_in(active0, p1)
-            # d side (SWITCH_REPLY) / c side (REPLACE_REPLY)
-            xswr_ok = is_xswr & (p4w == 1)
-            xrepr_ok = is_xrepr & (p4w == 1)
-            # i side (OPT_REPLY): swap out o once the candidate committed
-            ok_xr = is_xr & (p1 == 1)
-            swap_xr = ok_xr & slot_in(active0, p0)             # [n, cap]
-            # Demotions: o at i, i at o, c at d, d at c.
-            xrm = jnp.select([swap_xr, xsw_acc, xswr_ok, xrepr_ok],
-                             [p0, p1, p2w, p3w], -1)
-            removed |= jnp.any(
-                (active0[:, :, None] == xrm[:, None, :])
-                & (active0 >= 0)[:, :, None] & (xrm >= 0)[:, None, :],
-                axis=2)
-        active1 = jnp.where(removed, -1, active0)
-
-        # ---- 2. per-kind slot decisions (against round-start views) --
-        # forward_join walk (reference :1381): payload [joiner, contact]
-        fjj = p0
-        j_in_act = slot_in(active0, fjj)
-        nxt_fj = slot_pick(active0, _TAG_FJPICK, src, fjj,
-                           jnp.broadcast_to(me2, src.shape))
-        stop = is_fj & ((ttl <= 0) | (asize0 <= 1)[:, None]
-                        | (nxt_fj < 0) | j_in_act)
-        stop_ok = stop & (fjj != me2) & ~j_in_act
-        cont = is_fj & ~stop
-        deposit = cont & (ttl == hv.prwl) & (fjj != me2)
-
-        # join admission: one fresh JOIN per round fans out; the rest
-        # are dropped (the joiner's per-round retry re-sends them)
-        fresh = is_join & ~in_active0
-        slot_idx = jnp.arange(cap)[None, :]
-        first_slot = jnp.argmin(jnp.where(fresh, slot_idx, cap), axis=1)
-        has_fresh = jnp.any(fresh, axis=1)
-        first = fresh & (slot_idx == first_slot[:, None])
-
-        # neighbor request (:1619-1746)
-        want_nb = is_nb & ((p0 == 1) | (asize0 < acap)[:, None])
-
-        # shuffle walk (:1750-1795): payload [origin, ids...]
-        origin = p0
-        sh_ids = inb[..., T.P1:T.P1 + SAMPLE]                  # [n, cap, S]
-        nxt_sh = slot_pick(active0, _TAG_SHPICK, src, origin,
-                           jnp.broadcast_to(me2, src.shape))
-        sh_fwd = is_sh & (ttl - 1 > 0) & (asize0 > 1)[:, None] & (nxt_sh >= 0)
-        sh_int = is_sh & ~sh_fwd                               # integrate+reply
-
-        # ---- 3. scripted-join pre-insert + central admission ---------
-        # The scripted join bypasses admission entirely (reference
-        # reserve/1 holds slots for orchestrated joins, and the old
-        # sequential path used a full-width views.add): first empty slot,
-        # else a hash-random occupant is displaced — ordinary inbox
-        # candidates below still compete only for acap.
-        inview_j = jnp.any((active1 == join_tgt[:, None])
-                           & (join_tgt >= 0)[:, None], axis=1)
-        has_empty = jnp.any(active1 < 0, axis=1)
-        first_empty = jnp.argmax(active1 < 0, axis=1)
-        rslot = (ranked(_TAG_JOINSLOT, gids) % jnp.uint32(A)) \
-            .astype(jnp.int32)
-        slot_j = jnp.where(has_empty, first_empty, rslot)
-        do_pre = (join_tgt >= 0) & ~inview_j & (join_tgt != gids)
-        occupant = jnp.take_along_axis(
-            active1, slot_j[:, None], axis=1)[:, 0]
-        evicted_j = jnp.where(do_pre & ~has_empty, occupant, -1)
-        oh_j = jnp.arange(A)[None, :] == slot_j[:, None]
-        active1 = jnp.where(do_pre[:, None] & oh_j,
-                            join_tgt[:, None], active1)
-
-        # Ordinary candidates: one per inbox slot, compacted to a small
-        # fixed width (excess candidates lose this round and their
-        # senders retry — bounded intake, like every other capacity in
-        # the tensor transport).
-        cand_slot = jnp.select(
-            [first, stop_ok, want_nb, is_acc]
-            + ([xo_take, ok_xr, xsw_acc, xswr_ok, xrepr_ok]
-               if hv.xbot else []),
-            [src, fjj, src, src]
-            + ([src, src, p3w, p0, p1] if hv.xbot else []),
-            -1)                                                # [n, cap]
-        # Confirmations rank above requests: an ACCEPTED peer has
-        # already committed its side, and each X-BOT chain step has
-        # already demoted an edge for its candidate (phase 1) — losing
-        # either to a mere request would strand a one-way/teardown.
-        commit_prio = is_acc | (
-            (xo_take | ok_xr | xsw_acc | xswr_ok | xrepr_ok)
-            if hv.xbot else jnp.zeros_like(is_acc))
-        prio_slot = jnp.where(commit_prio, 2, 1)
-        CAND = min(A, cap)
-        # Built int32-non-negative: prio(<=2)<<28 + 28 hash bits + the
-        # validity bit stay under 2^31.  (lax.top_k orders uint32
-        # correctly on this backend too — row_ranked/views.admit rely on
-        # that; the int32 form here just doesn't need to.)
-        csc = jnp.where(
-            cand_slot >= 0,
-            (prio_slot << 28)
-            | (ranked(_TAG_CANDSEL, gids[:, None], slot_col)
-               >> jnp.uint32(4)).astype(jnp.int32)
-            | 1,
-            0)
-        cands, cand_col = compact(cand_slot, csc, CAND)        # [n, CAND]
-        prios = jnp.where(
-            cand_col >= 0,
-            jnp.take_along_axis(prio_slot, jnp.maximum(cand_col, 0),
-                                axis=1), 0)
-        adscores = ranked(_TAG_ADMIT, gids[:, None],
-                          jnp.arange(A + CAND)[None, :])
-        new_active, _admitted, evicted = jax.vmap(views.admit)(
-            active1, cands, prios, adscores, acap)
-
-        in_new = slot_in(new_active, src)                      # [n, cap]
-        j_in_new = slot_in(new_active, fjj)
-
-        # ---- 4. per-slot replies -------------------------------------
-        # ONE shuffle is answered per node per round (bounded intake —
-        # excess shuffles' ids still can't be integrated beyond the
-        # passive merge budget below, and the origin's own outgoing
-        # sample already carried our ids the other way; a missed reply
-        # just thins one round's sample).  This keeps the passive-sample
-        # table [n, SAMPLE] instead of [n, cap, passive_max].
-        sh_slot = jnp.argmax(sh_int, axis=1)                   # first hit
-        sh_any = jnp.any(sh_int, axis=1)
-        origin1 = jnp.take_along_axis(origin, sh_slot[:, None], axis=1)[:, 0]
-        ids1 = jnp.take_along_axis(
-            sh_ids, sh_slot[:, None, None], axis=1)[:, 0]      # [n, S]
-        mine1 = row_ranked(passive0, _TAG_MINE, SAMPLE)        # [n, S]
-        shreply_msgs = msg_ops.build(
-            W, T.MsgKind.HPV_SHUFFLE_REPLY, gids,
-            jnp.where(sh_any & (origin1 != gids) & (origin1 >= 0),
-                      origin1, -1),
-            payload=(gids, *jnp.unstack(mine1, axis=1)))
-
-        m_acc_join = is_join & in_new        # JOIN confirmed (edge exists)
-        m_acc_fj = stop_ok & j_in_new        # walk-end adoption confirmed
-        m_nb_acc = is_nb & in_new
-        m_nb_rej = is_nb & ~in_new
-        m_acc_fix = is_acc & ~in_new         # accept we could NOT honor:
-        #                                      tear down the half-open edge
-        #                                      instead of keeping a silent
-        #                                      one-way link
+            x_timer = ((ctx.rnd + ph) % cfg.xbot_every == 0) \
+                & (asize0 >= acap) & (acap > 0)
+        # built from the SAME masks the handlers consume, so the gate
+        # can never fall out of sync with a new control kind
+        is_ctl = (is_join | is_fj | is_nb | is_acc | is_disc | is_sh
+                  | is_shr | is_xo | is_xr
+                  | (kind == T.MsgKind.HPV_NEIGHBOR_REJECTED))
         if hv.xbot:
-            # an XBOT candidate that committed its accept but lost the
-            # central admission must also be torn down (same one-way-link
-            # reasoning as m_acc_fix)
-            xr_fix = ok_xr & ~in_new
-            i_in_new = slot_in(new_active, p1)
-            o_in_new = slot_in(new_active, p0)
-            d_in_new = slot_in(new_active, p3w)
-            xo_acc = xo_take | xo_dup      # reply OPT_REPLY (flag below)
-            xbot_conds = [xo_acc, xo_full, xrep_sw, xrep_no,
-                          is_xsw, is_xswr, is_xrepr, xr_fix]
-            xbot_kinds = [jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
-                          jnp.int32(T.MsgKind.HPV_XBOT_REPLACE),
-                          jnp.int32(T.MsgKind.HPV_XBOT_SWITCH),
-                          jnp.int32(T.MsgKind.HPV_XBOT_REPLACE_REPLY),
-                          jnp.int32(T.MsgKind.HPV_XBOT_SWITCH_REPLY),
-                          jnp.int32(T.MsgKind.HPV_XBOT_REPLACE_REPLY),
-                          jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
-                          jnp.int32(T.MsgKind.HPV_DISCONNECT)]
-            xbot_dsts = [src, jnp.broadcast_to(z[:, None], src.shape),
-                         p0, src, src, p2w, p1, src]
-
-        rkind = jnp.select(
-            [m_acc_join, m_acc_fj, m_nb_acc, m_nb_rej, m_acc_fix,
-             cont, sh_fwd]
-            + (xbot_conds if hv.xbot else []),
-            [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED)] * 2
-            + [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED),
-               jnp.int32(T.MsgKind.HPV_NEIGHBOR_REJECTED),
-               jnp.int32(T.MsgKind.HPV_DISCONNECT),
-               jnp.int32(T.MsgKind.HPV_FORWARD_JOIN),
-               jnp.int32(T.MsgKind.HPV_SHUFFLE)]
-            + (xbot_kinds if hv.xbot else []),
-            0)
-        rdst = jnp.select(
-            [m_acc_fj, cont, sh_fwd]
-            + (xbot_conds[:-1] if hv.xbot else []),
-            [fjj, nxt_fj, nxt_sh]
-            + (xbot_dsts[:-1] if hv.xbot else []),
-            src)
-        rdst = jnp.where(rkind > 0, rdst, -1)
-        rttl = jnp.where(cont | sh_fwd, ttl - 1, 0)
-        # Payload word 0: ACCEPTED carries the JOIN's contact (the node
-        # the joiner addressed) so a pending scripted join is confirmed
-        # only by ITS contact's walk — a coincidental promotion accept
-        # can no longer cancel a join whose walk was actually lost.
-        w0 = jnp.select(
-            [m_acc_join, m_acc_fj, m_nb_acc | m_nb_rej | m_acc_fix],
-            [jnp.broadcast_to(me2, p0.shape), p1,
-             jnp.full_like(p0, -1)],
-            p0)
-        payload = [w0]
-        for wi in range(1, W - T.HDR_WORDS):
-            base = inb[..., T.HDR_WORDS + wi]
-            if hv.xbot and wi == 1:
-                # P1: accepted flag on OPT_REPLY replies; the initiator
-                # id on a delegated REPLACE; i otherwise (chain pass-
-                # through).
-                base = jnp.where(
-                    xo_acc, in_new.astype(jnp.int32), base)
-                base = jnp.where(xo_full, src, base)
-                base = jnp.where(
-                    is_xrepr, (xrepr_ok & i_in_new).astype(jnp.int32),
-                    base)
-            if hv.xbot and wi == 2:
-                base = jnp.where(xo_full,
-                                 jnp.broadcast_to(me2, base.shape), base)
-            if hv.xbot and wi == 3:
-                base = jnp.where(xo_full,
-                                 jnp.broadcast_to(z[:, None], base.shape),
-                                 base)
-            if hv.xbot and wi == 4:
-                # P4: the chain's commit flag
-                base = jnp.where(
-                    is_xsw, (xsw_acc & d_in_new).astype(jnp.int32), base)
-                base = jnp.where(
-                    is_xswr, (xswr_ok & o_in_new).astype(jnp.int32), base)
-                base = jnp.where(xrep_no, 0, base)
-            payload.append(base)
-        replies = msg_ops.build(
-            W, rkind, jnp.broadcast_to(me2, rdst.shape), rdst,
-            ttl=rttl, payload=tuple(payload))                  # [n, cap, W]
-
-        # eviction + demotion disconnects (evicted is slot-aligned [n, A])
-        ev_disc = msg_ops.build(W, T.MsgKind.HPV_DISCONNECT,
-                                jnp.broadcast_to(me2, evicted.shape), evicted)
+            is_ctl = is_ctl | (
+                (kind >= T.MsgKind.HPV_XBOT_REPLACE)
+                & (kind <= T.MsgKind.HPV_XBOT_REPLACE_REPLY))
+        msg_busy_l = (jnp.any(is_ctl) | jnp.any(join_tgt >= 0)
+                      | jnp.any(state.leaving))
+        busy = comm.allsum(msg_busy_l.astype(jnp.int32)) > 0
+        cad_l = jnp.any(sh_fire) | jnp.any(pr_fire)
         if hv.xbot:
-            # tear down the demoted side of each chain step: o at i,
-            # i at o, c at d, d at c (the 4-party swap's disconnects)
-            xdst = jnp.select(
-                [swap_xr, xsw_acc, xswr_ok, xrepr_ok],
-                [p0, p1, p2w, p3w], -1)
-            x_disc = msg_ops.build(W, T.MsgKind.HPV_DISCONNECT,
-                                   jnp.broadcast_to(me2, xdst.shape), xdst)
+            cad_l = cad_l | jnp.any(x_timer)
+        cad_busy = comm.allsum(cad_l.astype(jnp.int32)) > 0
 
-        # ---- 5. join fan-out + leave fan-out (reference :1234) -------
-        joiner = jnp.where(
-            has_fresh,
-            jnp.take_along_axis(src, first_slot[:, None], axis=1)[:, 0], -1)
-        fj_tgt = jnp.where((active0 >= 0) & (active0 != joiner[:, None])
-                           & (joiner >= 0)[:, None], active0, -1)
-        fanout_fj = msg_ops.build(
-            W, T.MsgKind.HPV_FORWARD_JOIN,
-            jnp.broadcast_to(me2, fj_tgt.shape), fj_tgt, ttl=hv.arwl,
-            payload=(jnp.broadcast_to(joiner[:, None], fj_tgt.shape),
-                     jnp.broadcast_to(me2, fj_tgt.shape)))
-        lv_tgt = jnp.where(state.leaving[:, None], active0, -1)
-        fanout_lv = msg_ops.build(
-            W, T.MsgKind.HPV_DISCONNECT,
-            jnp.broadcast_to(me2, lv_tgt.shape), lv_tgt)
+        E_BUSY = cap + 4 * A + 2 + (cap if hv.xbot else 0)
+        E_CAD = 2 + (1 if hv.xbot else 0)
 
-        # ---- 6. passive merge (id-keyed bucket cache) ----------------
-        # Candidate budget per round: PSEL slot-borne ids (disconnect
-        # sources, walk deposits, X-BOT demotions) + one shuffle's ids +
-        # one shuffle-reply's ids + admission evictees + the scripted
-        # join's displaced occupant.  Excess candidates wait for the
-        # next shuffle/disconnect — the passive view is a healing cache,
-        # not a ledger.
-        pw0 = jnp.select(
-            [is_disc, deposit]
-            + ([swap_xr, xsw_acc, xswr_ok, xrepr_ok]
-               if hv.xbot else []),
-            [src, fjj]
-            + ([p0, p1, p2w, p3w] if hv.xbot else []),
-            -1)                                                # [n, cap]
-        PSEL = min(A, cap)
-        psc = jnp.where(pw0 >= 0,
-                        (ranked(_TAG_PSEL, gids[:, None], slot_col)
-                         >> jnp.uint32(1)).astype(jnp.int32) | 1,
-                        0)
-        p_slotborne, _ = compact(pw0, psc, PSEL)               # [n, PSEL]
-        shr_slot = jnp.argmax(is_shr, axis=1)
-        shr_any = jnp.any(is_shr, axis=1)
-        shr_ids1 = jnp.take_along_axis(
-            sh_ids, shr_slot[:, None, None], axis=1)[:, 0]     # [n, S]
-        pcands = jnp.concatenate([
-            p_slotborne,
-            jnp.where(sh_any[:, None], ids1, -1),
-            jnp.where((sh_any & (origin1 != gids))[:, None],
-                      origin1[:, None], -1),
-            jnp.where(shr_any[:, None], shr_ids1, -1),
-            evicted,
-            evicted_j[:, None],
-        ], axis=1)
-        pranks = ranked(_TAG_PMERGE, gids[:, None],
-                        jnp.arange(pcands.shape[1])[None, :])
-        # clear promoted ids out of the passive view, then merge
-        promoted = jnp.any(
-            (passive0[:, :, None] == new_active[:, None, :])
-            & (passive0 >= 0)[:, :, None], axis=2)
-        passive1 = jnp.where(promoted, -1, passive0)
-        new_passive = jax.vmap(views.bucket_merge)(
-            passive1, pcands, pranks, gids, new_active)
+        def quiet_body(_):
+            return (active0, passive0,
+                    jnp.zeros((n_local, E_BUSY, W), jnp.int32))
+
+        def busy_body(_):
+            in_active0 = slot_in(active0, src)                 # [n, cap]
+            # ---- 1. removals -----------------------------------------
+            disc_src = jnp.where(is_disc, src, -1)
+            removed = jnp.any(
+                (active0[:, :, None] == disc_src[:, None, :])
+                & (active0 >= 0)[:, :, None], axis=2)          # [n, A]
+            if hv.xbot:
+                p2w = inb[..., T.P2]
+                p3w = inb[..., T.P3]
+                p4w = inb[..., T.P3 + 1]
+                is_xrep = kind == T.MsgKind.HPV_XBOT_REPLACE       # at d
+                is_xsw = kind == T.MsgKind.HPV_XBOT_SWITCH         # at o
+                is_xswr = kind == T.MsgKind.HPV_XBOT_SWITCH_REPLY  # at d
+                is_xrepr = kind == T.MsgKind.HPV_XBOT_REPLACE_REPLY
+                costs0 = jnp.where(
+                    active0 >= 0,
+                    cost(jnp.broadcast_to(me2, active0.shape),
+                         jnp.maximum(active0, 0)), -jnp.inf)
+                zslot = jnp.argmax(costs0, axis=1)
+                z = jnp.where(jnp.any(active0 >= 0, axis=1),
+                              jnp.take_along_axis(
+                                  active0, zslot[:, None], axis=1)[:, 0],
+                              -1)
+                have_room = (asize0 < acap) & (acap > 0)
+                # candidate side (OPT at c): room -> take the initiator
+                # now; full -> delegate to worst peer d via REPLACE
+                xo_take = is_xo & have_room[:, None] & ~in_active0
+                xo_dup = is_xo & in_active0
+                xo_full = is_xo & ~have_room[:, None] & ~in_active0 \
+                    & (z >= 0)[:, None]
+                # d side (REPLACE): switch to o only if o beats c for ME
+                xrep_sw = is_xrep & (p0 >= 0) \
+                    & (cost(me2, jnp.maximum(p0, 0))
+                       < cost(me2, jnp.maximum(p2w, 0)))
+                xrep_no = is_xrep & ~xrep_sw
+                # o side (SWITCH): accept iff the initiator is ours
+                xsw_acc = is_xsw & slot_in(active0, p1)
+                # d side (SWITCH_REPLY) / c side (REPLACE_REPLY)
+                xswr_ok = is_xswr & (p4w == 1)
+                xrepr_ok = is_xrepr & (p4w == 1)
+                # i side (OPT_REPLY): swap out o once c committed
+                ok_xr = is_xr & (p1 == 1)
+                swap_xr = ok_xr & slot_in(active0, p0)         # [n, cap]
+                # Demotions: o at i, i at o, c at d, d at c.
+                xrm = jnp.select([swap_xr, xsw_acc, xswr_ok, xrepr_ok],
+                                 [p0, p1, p2w, p3w], -1)
+                removed |= jnp.any(
+                    (active0[:, :, None] == xrm[:, None, :])
+                    & (active0 >= 0)[:, :, None] & (xrm >= 0)[:, None, :],
+                    axis=2)
+            active1 = jnp.where(removed, -1, active0)
+
+            # ---- 2. per-kind slot decisions (round-start views) ------
+            # forward_join (reference :1381): payload [joiner, contact,
+            # deposit?].  The walk already ran in-round at the contact;
+            # a deposit-marked copy feeds the passive view, any other
+            # FORWARD_JOIN is a walk endpoint -> stop/adopt (re-checked
+            # locally: the walk used a snapshot).
+            fjj = p0
+            j_in_act = slot_in(active0, fjj)
+            is_dep = is_fj & (dep_w == 1)
+            stop_ok = is_fj & ~is_dep & (fjj != me2) & ~j_in_act
+            deposit = is_dep & (fjj != me2)
+
+            # join admission: one fresh JOIN per round fans out; the
+            # rest are dropped (the joiner's per-round retry re-sends)
+            fresh = is_join & ~in_active0
+            slot_idx = jnp.arange(cap)[None, :]
+            first_slot = jnp.argmin(jnp.where(fresh, slot_idx, cap),
+                                    axis=1)
+            has_fresh = jnp.any(fresh, axis=1)
+            first = fresh & (slot_idx == first_slot[:, None])
+
+            # neighbor request (:1619-1746)
+            want_nb = is_nb & ((p0 == 1) | (asize0 < acap)[:, None])
+
+            # shuffle (:1750-1795): payload [origin, ids...] — always
+            # integrate+reply (the walk happened in-round at the origin)
+            origin = p0
+            sh_ids = inb[..., T.P1:T.P1 + SAMPLE]              # [n, cap, S]
+            sh_int = is_sh
+
+            # ---- 3. scripted-join pre-insert + central admission -----
+            # The scripted join bypasses admission entirely (reference
+            # reserve/1 holds slots for orchestrated joins, and the old
+            # sequential path used a full-width views.add): first empty
+            # slot, else a hash-random occupant is displaced — ordinary
+            # inbox candidates below still compete only for acap.
+            inview_j = jnp.any((active1 == join_tgt[:, None])
+                               & (join_tgt >= 0)[:, None], axis=1)
+            has_empty = jnp.any(active1 < 0, axis=1)
+            first_empty = jnp.argmax(active1 < 0, axis=1)
+            rslot = (ranked(_TAG_JOINSLOT, gids) % jnp.uint32(A)) \
+                .astype(jnp.int32)
+            slot_j = jnp.where(has_empty, first_empty, rslot)
+            do_pre = (join_tgt >= 0) & ~inview_j & (join_tgt != gids)
+            occupant = jnp.take_along_axis(
+                active1, slot_j[:, None], axis=1)[:, 0]
+            evicted_j = jnp.where(do_pre & ~has_empty, occupant, -1)
+            oh_j = jnp.arange(A)[None, :] == slot_j[:, None]
+            active1 = jnp.where(do_pre[:, None] & oh_j,
+                                join_tgt[:, None], active1)
+
+            # Ordinary candidates: one per inbox slot, compacted to a
+            # small fixed width (excess candidates lose this round and
+            # their senders retry — bounded intake, like every other
+            # capacity in the tensor transport).
+            cand_slot = jnp.select(
+                [first, stop_ok, want_nb, is_acc]
+                + ([xo_take, ok_xr, xsw_acc, xswr_ok, xrepr_ok]
+                   if hv.xbot else []),
+                [src, fjj, src, src]
+                + ([src, src, p3w, p0, p1] if hv.xbot else []),
+                -1)                                            # [n, cap]
+            # Confirmations rank above requests: an ACCEPTED peer has
+            # already committed its side, and each X-BOT chain step has
+            # already demoted an edge for its candidate (phase 1) —
+            # losing either to a mere request would strand a
+            # one-way/teardown.
+            commit_prio = is_acc | (
+                (xo_take | ok_xr | xsw_acc | xswr_ok | xrepr_ok)
+                if hv.xbot else jnp.zeros_like(is_acc))
+            prio_slot = jnp.where(commit_prio, 2, 1)
+            CAND = min(A, cap)
+            # Built int32-non-negative: prio(<=2)<<28 + 28 hash bits +
+            # the validity bit stay under 2^31.  (lax.top_k orders
+            # uint32 correctly on this backend too — row_ranked/
+            # views.admit rely on that; the int32 form here just
+            # doesn't need to.)
+            csc = jnp.where(
+                cand_slot >= 0,
+                (prio_slot << 28)
+                | (ranked(_TAG_CANDSEL, gids[:, None], slot_col)
+                   >> jnp.uint32(4)).astype(jnp.int32)
+                | 1,
+                0)
+            cands, cand_col = compact(cand_slot, csc, CAND)    # [n, CAND]
+            prios = jnp.where(
+                cand_col >= 0,
+                jnp.take_along_axis(prio_slot, jnp.maximum(cand_col, 0),
+                                    axis=1), 0)
+            adscores = ranked(_TAG_ADMIT, gids[:, None],
+                              jnp.arange(A + CAND)[None, :])
+            new_active, _admitted, evicted = jax.vmap(views.admit)(
+                active1, cands, prios, adscores, acap)
+
+            in_new = slot_in(new_active, src)                  # [n, cap]
+            j_in_new = slot_in(new_active, fjj)
+
+            # ---- 4. per-slot replies ---------------------------------
+            # ONE shuffle is answered per node per round (bounded
+            # intake — excess shuffles' ids still can't be integrated
+            # beyond the passive merge budget below, and the origin's
+            # own outgoing sample already carried our ids the other
+            # way; a missed reply just thins one round's sample).  This
+            # keeps the passive-sample table [n, SAMPLE] instead of
+            # [n, cap, passive_max].
+            sh_slot = jnp.argmax(sh_int, axis=1)               # first hit
+            sh_any = jnp.any(sh_int, axis=1)
+            origin1 = jnp.take_along_axis(origin, sh_slot[:, None],
+                                          axis=1)[:, 0]
+            ids1 = jnp.take_along_axis(
+                sh_ids, sh_slot[:, None, None], axis=1)[:, 0]  # [n, S]
+            mine1 = row_ranked(passive0, _TAG_MINE, SAMPLE)    # [n, S]
+            shreply_msgs = msg_ops.build(
+                W, T.MsgKind.HPV_SHUFFLE_REPLY, gids,
+                jnp.where(sh_any & (origin1 != gids) & (origin1 >= 0),
+                          origin1, -1),
+                payload=(gids, *jnp.unstack(mine1, axis=1)))
+
+            m_acc_join = is_join & in_new    # JOIN confirmed (edge exists)
+            m_acc_fj = stop_ok & j_in_new    # walk-end adoption confirmed
+            m_nb_acc = is_nb & in_new
+            m_nb_rej = is_nb & ~in_new
+            m_acc_fix = is_acc & ~in_new     # accept we could NOT honor:
+            #                                  tear down the half-open
+            #                                  edge instead of keeping a
+            #                                  silent one-way link
+            if hv.xbot:
+                # an XBOT candidate that committed its accept but lost
+                # the central admission must also be torn down (same
+                # one-way-link reasoning as m_acc_fix)
+                xr_fix = ok_xr & ~in_new
+                i_in_new = slot_in(new_active, p1)
+                o_in_new = slot_in(new_active, p0)
+                d_in_new = slot_in(new_active, p3w)
+                xo_acc = xo_take | xo_dup  # reply OPT_REPLY (flag below)
+                xbot_conds = [xo_acc, xo_full, xrep_sw, xrep_no,
+                              is_xsw, is_xswr, is_xrepr, xr_fix]
+                xbot_kinds = [jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
+                              jnp.int32(T.MsgKind.HPV_XBOT_REPLACE),
+                              jnp.int32(T.MsgKind.HPV_XBOT_SWITCH),
+                              jnp.int32(T.MsgKind.HPV_XBOT_REPLACE_REPLY),
+                              jnp.int32(T.MsgKind.HPV_XBOT_SWITCH_REPLY),
+                              jnp.int32(T.MsgKind.HPV_XBOT_REPLACE_REPLY),
+                              jnp.int32(T.MsgKind.HPV_XBOT_OPT_REPLY),
+                              jnp.int32(T.MsgKind.HPV_DISCONNECT)]
+                xbot_dsts = [src,
+                             jnp.broadcast_to(z[:, None], src.shape),
+                             p0, src, src, p2w, p1, src]
+
+            rkind = jnp.select(
+                [m_acc_join, m_acc_fj, m_nb_acc, m_nb_rej, m_acc_fix]
+                + (xbot_conds if hv.xbot else []),
+                [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED)] * 2
+                + [jnp.int32(T.MsgKind.HPV_NEIGHBOR_ACCEPTED),
+                   jnp.int32(T.MsgKind.HPV_NEIGHBOR_REJECTED),
+                   jnp.int32(T.MsgKind.HPV_DISCONNECT)]
+                + (xbot_kinds if hv.xbot else []),
+                0)
+            rdst = jnp.select(
+                [m_acc_fj] + (xbot_conds[:-1] if hv.xbot else []),
+                [fjj] + (xbot_dsts[:-1] if hv.xbot else []),
+                src)
+            rdst = jnp.where(rkind > 0, rdst, -1)
+            # Payload word 0: ACCEPTED carries the JOIN's contact (the
+            # node the joiner addressed) so a pending scripted join is
+            # confirmed only by ITS contact's walk — a coincidental
+            # promotion accept can no longer cancel a join whose walk
+            # was actually lost.
+            w0 = jnp.select(
+                [m_acc_join, m_acc_fj, m_nb_acc | m_nb_rej | m_acc_fix],
+                [jnp.broadcast_to(me2, p0.shape), p1,
+                 jnp.full_like(p0, -1)],
+                p0)
+            payload = [w0]
+            for wi in range(1, W - T.HDR_WORDS):
+                base = inb[..., T.HDR_WORDS + wi]
+                if hv.xbot and wi == 1:
+                    # P1: accepted flag on OPT_REPLY replies; the
+                    # initiator id on a delegated REPLACE; i otherwise
+                    # (chain pass-through).
+                    base = jnp.where(
+                        xo_acc, in_new.astype(jnp.int32), base)
+                    base = jnp.where(xo_full, src, base)
+                    base = jnp.where(
+                        is_xrepr,
+                        (xrepr_ok & i_in_new).astype(jnp.int32), base)
+                if hv.xbot and wi == 2:
+                    base = jnp.where(
+                        xo_full, jnp.broadcast_to(me2, base.shape), base)
+                if hv.xbot and wi == 3:
+                    base = jnp.where(
+                        xo_full,
+                        jnp.broadcast_to(z[:, None], base.shape), base)
+                if hv.xbot and wi == 4:
+                    # P4: the chain's commit flag
+                    base = jnp.where(
+                        is_xsw, (xsw_acc & d_in_new).astype(jnp.int32),
+                        base)
+                    base = jnp.where(
+                        is_xswr, (xswr_ok & o_in_new).astype(jnp.int32),
+                        base)
+                    base = jnp.where(xrep_no, 0, base)
+                payload.append(base)
+            replies = msg_ops.build(
+                W, rkind, jnp.broadcast_to(me2, rdst.shape), rdst,
+                payload=tuple(payload))                        # [n, cap, W]
+
+            # eviction + demotion disconnects (slot-aligned [n, A])
+            ev_disc = msg_ops.build(
+                W, T.MsgKind.HPV_DISCONNECT,
+                jnp.broadcast_to(me2, evicted.shape), evicted)
+            if hv.xbot:
+                # tear down the demoted side of each chain step: o at
+                # i, i at o, c at d, d at c (the swap's disconnects)
+                xdst = jnp.select(
+                    [swap_xr, xsw_acc, xswr_ok, xrepr_ok],
+                    [p0, p1, p2w, p3w], -1)
+                x_disc = msg_ops.build(
+                    W, T.MsgKind.HPV_DISCONNECT,
+                    jnp.broadcast_to(me2, xdst.shape), xdst)
+
+            # ---- 5. join fan-out: IN-ROUND walks (reference :1381) ---
+            # The contact fans one FORWARD_JOIN per active member and
+            # walks each copy ARWL hops over the gathered view snapshot
+            # NOW (see step docstring); the endpoint gets the stop copy,
+            # the PRWL-hop node a deposit copy.
+            joiner = jnp.where(
+                has_fresh,
+                jnp.take_along_axis(src, first_slot[:, None],
+                                    axis=1)[:, 0], -1)
+            fj_tgt = jnp.where(
+                (active0 >= 0) & (active0 != joiner[:, None])
+                & (joiner >= 0)[:, None], active0, -1)
+            me2b = jnp.broadcast_to(me2, fj_tgt.shape)
+            arangeA = jnp.arange(A, dtype=jnp.int32)
+            # the walk (and its view-snapshot gather) only runs when a
+            # fresh JOIN exists anywhere — a further sub-gate inside
+            # the message body (joins are bootstrap-time traffic)
+            fj_go = comm.allsum(
+                jnp.any(has_fresh).astype(jnp.int32)) > 0
+
+            def fj_walk(_):
+                glob_act = comm.gather_vec(active0)        # [n_glob, A]
+                glob_asz = comm.gather_vec(asize0)         # [n_glob]
+                jb = jnp.broadcast_to(joiner[:, None], fj_tgt.shape)
+                curf = fj_tgt                              # [n, A] walkers
+                prevf = me2b
+                stopped = curf < 0
+                endpoint = jnp.full_like(curf, -1)
+                depnode = jnp.full_like(curf, -1)
+                for h in range(hv.arwl):
+                    cc = jnp.clip(curf, 0, comm.n_global - 1)
+                    vc = glob_act[cc]                      # [n, A, A]
+                    j_in = jnp.any((vc == jb[:, :, None]) & (vc >= 0),
+                                   axis=2)
+                    small = glob_asz[cc] <= 1
+                    r = ranked(_TAG_FJWALK + h, gids[:, None, None],
+                               arangeA[None, :, None],
+                               arangeA[None, None, :])
+                    okm = (vc >= 0) & (vc != jb[:, :, None]) \
+                        & (vc != prevf[:, :, None]) \
+                        & (vc != curf[:, :, None])
+                    sc = jnp.where(okm, r | jnp.uint32(1), jnp.uint32(0))
+                    bi = jnp.argmax(sc, axis=2)
+                    nxt = jnp.take_along_axis(vc, bi[:, :, None],
+                                              axis=2)[:, :, 0]
+                    has_nxt = jnp.max(sc, axis=2) > 0
+                    live_w = (curf >= 0) & ~stopped
+                    stop_here = live_w & (small | j_in | ~has_nxt)
+                    endpoint = jnp.where(stop_here, curf, endpoint)
+                    if h == hv.arwl - hv.prwl:
+                        # deposit at the receiver whose incoming TTL
+                        # would have been PRWL, iff the walk continues
+                        depnode = jnp.where(live_w & ~stop_here, curf,
+                                            depnode)
+                    stopped = stopped | stop_here
+                    prevf = jnp.where(live_w & ~stop_here, curf, prevf)
+                    curf = jnp.where(live_w & ~stop_here, nxt, curf)
+                endpoint = jnp.where(stopped, endpoint, curf)  # TTL out
+                jb2 = jnp.broadcast_to(joiner[:, None], fj_tgt.shape)
+                return (msg_ops.build(
+                            W, T.MsgKind.HPV_FORWARD_JOIN, me2b,
+                            endpoint, payload=(jb2, me2b)),
+                        msg_ops.build(
+                            W, T.MsgKind.HPV_FORWARD_JOIN, me2b,
+                            depnode,
+                            payload=(jb2, me2b, jnp.ones_like(jb2))))
+
+            def fj_none(_):
+                zf = jnp.zeros((n_local, A, W), jnp.int32)
+                return zf, zf
+
+            fanout_fj, fanout_dep = jax.lax.cond(fj_go, fj_walk,
+                                                 fj_none, 0)
+            lv_tgt = jnp.where(state.leaving[:, None], active0, -1)
+            fanout_lv = msg_ops.build(
+                W, T.MsgKind.HPV_DISCONNECT,
+                jnp.broadcast_to(me2, lv_tgt.shape), lv_tgt)
+            ev_join_disc = msg_ops.build(
+                W, T.MsgKind.HPV_DISCONNECT, gids, evicted_j)
+
+            # ---- 6. passive merge (id-keyed bucket cache) ------------
+            # Candidate budget per round: PSEL slot-borne ids
+            # (disconnect sources, walk deposits, X-BOT demotions) +
+            # one shuffle's ids + one shuffle-reply's ids + admission
+            # evictees + the scripted join's displaced occupant.
+            # Excess candidates wait for the next shuffle/disconnect —
+            # the passive view is a healing cache, not a ledger.
+            pw0 = jnp.select(
+                [is_disc, deposit]
+                + ([swap_xr, xsw_acc, xswr_ok, xrepr_ok]
+                   if hv.xbot else []),
+                [src, fjj]
+                + ([p0, p1, p2w, p3w] if hv.xbot else []),
+                -1)                                            # [n, cap]
+            PSEL = min(A, cap)
+            psc = jnp.where(pw0 >= 0,
+                            (ranked(_TAG_PSEL, gids[:, None], slot_col)
+                             >> jnp.uint32(1)).astype(jnp.int32) | 1,
+                            0)
+            p_slotborne, _ = compact(pw0, psc, PSEL)           # [n, PSEL]
+            shr_slot = jnp.argmax(is_shr, axis=1)
+            shr_any = jnp.any(is_shr, axis=1)
+            shr_ids1 = jnp.take_along_axis(
+                sh_ids, shr_slot[:, None, None], axis=1)[:, 0]  # [n, S]
+            pcands = jnp.concatenate([
+                p_slotborne,
+                jnp.where(sh_any[:, None], ids1, -1),
+                jnp.where((sh_any & (origin1 != gids))[:, None],
+                          origin1[:, None], -1),
+                jnp.where(shr_any[:, None], shr_ids1, -1),
+                evicted,
+                evicted_j[:, None],
+            ], axis=1)
+            pranks = ranked(_TAG_PMERGE, gids[:, None],
+                            jnp.arange(pcands.shape[1])[None, :])
+            # clear promoted ids out of the passive view, then merge
+            promoted = jnp.any(
+                (passive0[:, :, None] == new_active[:, None, :])
+                & (passive0 >= 0)[:, :, None], axis=2)
+            passive1 = jnp.where(promoted, -1, passive0)
+            new_passive = jax.vmap(views.bucket_merge)(
+                passive1, pcands, pranks, gids, new_active)
+
+            # leave: clear own views after disconnecting
+            new_active2 = jnp.where(state.leaving[:, None], -1,
+                                    new_active)
+            new_passive2 = jnp.where(state.leaving[:, None], -1,
+                                     new_passive)
+
+            blocks = [replies, ev_disc, fanout_fj, fanout_dep, fanout_lv,
+                      ev_join_disc[:, None, :],
+                      shreply_msgs[:, None, :]]
+            if hv.xbot:
+                blocks += [x_disc]
+            return new_active2, new_passive2, jnp.concatenate(blocks,
+                                                              axis=1)
+
+        new_active, new_passive, emitted_hv = jax.lax.cond(
+            busy, busy_body, quiet_body, 0)
+
+        # ---- cadenced sends: shuffle walk, promotion, X-BOT ----------
+        # Under timer_stagger=True some node fires every round, so this
+        # body (including the view-snapshot gather feeding the walk)
+        # runs per-round — comparable to the old per-round slot_pick
+        # forwarding it replaced.  The skip only pays off with aligned
+        # timers, which is the point of the knob.
+        def cad_body(_):
+            arangeA = jnp.arange(A, dtype=jnp.int32)
+            glob_act = comm.gather_vec(active0)                # [n_g, A]
+            sh_tgt = row_ranked(active0, _TAG_SHTGT, 1)[:, 0]
+            curs = sh_tgt
+            prevs = gids
+            for h in range(hv.arwl - 1):
+                cc = jnp.clip(curs, 0, comm.n_global - 1)
+                vc = glob_act[cc]                              # [n, A]
+                r = ranked(_TAG_SHWALK + h, gids[:, None],
+                           arangeA[None, :])
+                okm = (vc >= 0) & (vc != gids[:, None]) \
+                    & (vc != prevs[:, None]) & (vc != curs[:, None])
+                sc = jnp.where(okm, r | jnp.uint32(1), jnp.uint32(0))
+                bi = jnp.argmax(sc, axis=1)
+                nxt = jnp.take_along_axis(vc, bi[:, None], axis=1)[:, 0]
+                ok = (curs >= 0) & (jnp.max(sc, axis=1) > 0)
+                prevs = jnp.where(ok, curs, prevs)
+                curs = jnp.where(ok, nxt, curs)
+            smp = jnp.concatenate([
+                row_ranked(active0, _TAG_SHSAMP_A, hv.shuffle_k_active),
+                row_ranked(passive0, _TAG_SHSAMP_P,
+                           hv.shuffle_k_passive),
+            ], axis=1)[:, :SAMPLE]
+            shuffle_msgs = msg_ops.build(
+                W, T.MsgKind.HPV_SHUFFLE, gids,
+                jnp.where(sh_fire & (curs >= 0), curs, -1), ttl=1,
+                payload=(gids, *jnp.unstack(smp, axis=1)))
+            pr_tgt = row_ranked(passive0, _TAG_PRTGT, 1,
+                                exclude=active0)[:, 0]
+            promote_msgs = msg_ops.build(
+                W, T.MsgKind.HPV_NEIGHBOR, gids,
+                jnp.where(pr_fire & (pr_tgt >= 0), pr_tgt, -1),
+                payload=((asize0 == 0).astype(jnp.int32),))
+            cblocks = [shuffle_msgs[:, None, :],
+                       promote_msgs[:, None, :]]
+            if hv.xbot:
+                costs0 = jnp.where(
+                    active0 >= 0,
+                    cost(jnp.broadcast_to(me2, active0.shape),
+                         jnp.maximum(active0, 0)), -jnp.inf)
+                zslot = jnp.argmax(costs0, axis=1)
+                z = jnp.where(jnp.any(active0 >= 0, axis=1),
+                              jnp.take_along_axis(
+                                  active0, zslot[:, None], axis=1)[:, 0],
+                              -1)
+                cand = row_ranked(passive0, _TAG_XCAND, 1,
+                                  exclude=active0)[:, 0]
+                cost_cand = cost(gids, jnp.maximum(cand, 0))
+                cost_worst = cost(gids, jnp.maximum(z, 0))
+                x_fire = x_timer & (cand >= 0) & (z >= 0) \
+                    & (cost_cand < cost_worst)
+                cblocks.append(msg_ops.build(
+                    W, T.MsgKind.HPV_XBOT_OPT, gids,
+                    jnp.where(x_fire, cand, -1), payload=(z,))[:, None, :])
+            return jnp.concatenate(cblocks, axis=1)
+
+        def cad_quiet(_):
+            return jnp.zeros((n_local, E_CAD, W), jnp.int32)
+
+        emitted_cad = jax.lax.cond(cad_busy, cad_body, cad_quiet, 0)
 
         # ---- 7. timers (scripted join, shuffle, promotion, X-BOT) ----
         # Liveness heartbeat: node 0's epoch (rnd // H) rides the active
@@ -705,37 +908,6 @@ class HyParView:
         do_join = join_dst >= 0
         join_msgs = msg_ops.build(
             W, T.MsgKind.HPV_JOIN, gids, jnp.where(do_join, join_dst, -1))
-        ev_join_disc = msg_ops.build(
-            W, T.MsgKind.HPV_DISCONNECT, gids, evicted_j)
-        sh_fire = ((ctx.rnd + gids) % cfg.shuffle_every == 0)
-        sh_tgt = row_ranked(active0, _TAG_SHTGT, 1)[:, 0]
-        smp = jnp.concatenate([
-            row_ranked(active0, _TAG_SHSAMP_A, hv.shuffle_k_active),
-            row_ranked(passive0, _TAG_SHSAMP_P, hv.shuffle_k_passive),
-        ], axis=1)[:, :SAMPLE]
-        shuffle_msgs = msg_ops.build(
-            W, T.MsgKind.HPV_SHUFFLE, gids,
-            jnp.where(sh_fire & (sh_tgt >= 0), sh_tgt, -1), ttl=hv.arwl,
-            payload=(gids, *jnp.unstack(smp, axis=1)))
-        pr_fire = ((ctx.rnd + gids) % cfg.promotion_every == 0) & \
-            (asize0 < hv.active_min)
-        pr_tgt = row_ranked(passive0, _TAG_PRTGT, 1,
-                            exclude=active0)[:, 0]
-        promote_msgs = msg_ops.build(
-            W, T.MsgKind.HPV_NEIGHBOR, gids,
-            jnp.where(pr_fire & (pr_tgt >= 0), pr_tgt, -1),
-            payload=((asize0 == 0).astype(jnp.int32),))
-        if hv.xbot:
-            cand = row_ranked(passive0, _TAG_XCAND, 1,
-                              exclude=active0)[:, 0]
-            cost_cand = cost(gids, jnp.maximum(cand, 0))
-            cost_worst = cost(gids, jnp.maximum(z, 0))
-            x_fire = ((ctx.rnd + gids) % cfg.xbot_every == 0) \
-                & (asize0 >= acap) & (acap > 0) & (cand >= 0) & (z >= 0) \
-                & (cost_cand < cost_worst)
-            xbot_msgs = msg_ops.build(
-                W, T.MsgKind.HPV_XBOT_OPT, gids,
-                jnp.where(x_fire, cand, -1), payload=(z,))
 
         # ---- 8. distance/RTT metrics plane (config-gated) ------------
         # Probe targets: the active view (the reference pings its
@@ -749,16 +921,7 @@ class HyParView:
                 cfg, comm, state.dist, ctx,
                 jnp.concatenate([active0, psamp], axis=1))
 
-        # leave: clear own views after disconnecting
-        new_active = jnp.where(state.leaving[:, None], -1, new_active)
-        new_passive = jnp.where(state.leaving[:, None], -1, new_passive)
-
-        blocks = [replies, ev_disc, fanout_fj, fanout_lv,
-                  join_msgs[:, None, :], ev_join_disc[:, None, :],
-                  shreply_msgs[:, None, :], shuffle_msgs[:, None, :],
-                  promote_msgs[:, None, :]]
-        if hv.xbot:
-            blocks += [x_disc, xbot_msgs[:, None, :]]
+        blocks = [emitted_hv, emitted_cad, join_msgs[:, None, :]]
         if cfg.distance.enabled:
             blocks += [dist_emit]
         emitted = jnp.concatenate(blocks, axis=1)

@@ -175,201 +175,227 @@ class Plumtree:
         is_pr = kind == T.MsgKind.PT_PRUNE
         is_ak = kind == T.MsgKind.PT_IHAVE_ACK
 
-        # ---- slot-epoch guard (per-root trees, :118-160) ----------
-        # A higher epoch on gossip OR an i_have advert re-keys the slot
-        # to its new root: adopt it, RESET the tree flags (the new
-        # root's tree forms from scratch), and ignore every message
-        # stamped with an older epoch — late traffic from the recycled
-        # tree cannot prune/graft/advertise into the new one.  I_HAVE
-        # adoption is the lazy-repair recruit path: a node whose eager
-        # links were all pruned in the OLD epoch sees only adverts, so
-        # without it the recycled slot could not graft it back in until
-        # the AAE walk found it.  One scatter-max instead of an
-        # [n, cap, B] where+reduce: epochs are the only slot-keyed MAX
-        # on the hot path and the materialized one-hot cost ~12% of the
-        # 32k round.
-        r2e = jnp.broadcast_to(
-            jnp.arange(n_local, dtype=jnp.int32)[:, None], b.shape)
-        tgt_ep = state.epoch.at[
-            r2e, jnp.where(is_g | is_ih, b, B)].max(ep_w, mode="drop")
-        bumped = tgt_ep > state.epoch                           # [n, B]
-        old_ep_b = jnp.take_along_axis(state.epoch, b, axis=1)  # [n, cap]
-        bump_g = is_g & (ep_w > old_ep_b)   # raw mask, pre-epoch-filter
-        pruned = pruned & ~bumped[:, :, None]
-        lazyp = lazyp & ~bumped[:, :, None]
-        rr = jnp.where(bumped, 0, rr)
-        psrc = jnp.where(bumped, -1, psrc)
-        ep_b = jnp.take_along_axis(tgt_ep, b, axis=1)           # [n, cap]
-        cur_ep = ep_w == ep_b
-        is_g = is_g & cur_ep
-        is_ih = is_ih & cur_ep
-        is_gr = is_gr & cur_ep
-        is_pr = is_pr & cur_ep
-        is_ak = is_ak & cur_ep
+        # ---- the main-body gate: everything between here and the AAE
+        # stage (epoch guard, gossip fold, flag updates, replies, eager
+        # push, lazy flush) only matters when plumtree traffic exists or
+        # is pending somewhere — one cross-shard lax.cond skips it for
+        # rounds where the broadcast layer is idle (e.g. a settled
+        # overlay between broadcasts, or a pure-membership phase).
+        pt_go_local = (jnp.any(is_g | is_ih | is_gr | is_pr | is_ak)
+                       | jnp.any(npu)
+                       | jnp.any(lazyp & (nbrs >= 0)[:, None, :]))
+        pt_go = comm.allsum(pt_go_local.astype(jnp.int32)) > 0
+        E_PT = cap + S * K + L
 
-        # sender's link slot (slot_of): [n, cap]
-        hit = (nbrs[:, None, :] == src[:, :, None]) & (src >= 0)[:, :, None]
-        ks_ok = hit.any(-1)
-        ki = jnp.argmax(hit, -1)
+        def pt_skip(_):
+            return (data, rr, pruned, lazyp, npu, psrc, state.epoch,
+                    state.nonmono,
+                    jnp.zeros((n_local, E_PT, W), jnp.int32))
 
-        oh_b = (b[:, :, None] == jnp.arange(B)[None, None, :])  # [n, cap, B]
-        oh_k = ((ki[:, :, None] == jnp.arange(K)[None, None, :])
-                & ks_ok[:, :, None])                            # [n, cap, K]
-        # round-start store at each slot's tree: [n, cap, PW]
-        data_b = jnp.take_along_axis(data, b[:, :, None], axis=1)
-        # Monotone-recycle constraint check: an epoch-bumping gossip
-        # whose payload does NOT dominate the receiver's store means
-        # the recycled broadcast broke the lattice contract the
-        # epoch-oblivious store depends on — count it (never silent).
-        nonmono = state.nonmono + jnp.sum(
-            bump_g & ~hd.leq(data_b, pay), axis=1, dtype=jnp.int32)
+        def pt_body(_, data=data, rr=rr, pruned=pruned, lazyp=lazyp,
+                    npu=npu, psrc=psrc, is_g=is_g, is_ih=is_ih,
+                    is_gr=is_gr, is_pr=is_pr, is_ak=is_ak):
 
-        def any_bk(cond):
-            """[n, cap] slot mask -> bool[n, B, K] any-hit, as an MXU
-            matmul over the one-hot encodings."""
-            lhs = (oh_b & cond[:, :, None]).astype(jnp.bfloat16)
-            rhs = oh_k.astype(jnp.bfloat16)
-            return jnp.einsum("ncb,nck->nbk", lhs, rhs) > 0.5
+            # ---- slot-epoch guard (per-root trees, :118-160) ----------
+            # A higher epoch on gossip OR an i_have advert re-keys the slot
+            # to its new root: adopt it, RESET the tree flags (the new
+            # root's tree forms from scratch), and ignore every message
+            # stamped with an older epoch — late traffic from the recycled
+            # tree cannot prune/graft/advertise into the new one.  I_HAVE
+            # adoption is the lazy-repair recruit path: a node whose eager
+            # links were all pruned in the OLD epoch sees only adverts, so
+            # without it the recycled slot could not graft it back in until
+            # the AAE walk found it.  One scatter-max instead of an
+            # [n, cap, B] where+reduce: epochs are the only slot-keyed MAX
+            # on the hot path and the materialized one-hot cost ~12% of the
+            # 32k round.
+            r2e = jnp.broadcast_to(
+                jnp.arange(n_local, dtype=jnp.int32)[:, None], b.shape)
+            tgt_ep = state.epoch.at[
+                r2e, jnp.where(is_g | is_ih, b, B)].max(ep_w, mode="drop")
+            bumped = tgt_ep > state.epoch                           # [n, B]
+            old_ep_b = jnp.take_along_axis(state.epoch, b, axis=1)  # [n, cap]
+            bump_g = is_g & (ep_w > old_ep_b)   # raw mask, pre-epoch-filter
+            pruned = pruned & ~bumped[:, :, None]
+            lazyp = lazyp & ~bumped[:, :, None]
+            rr = jnp.where(bumped, 0, rr)
+            psrc = jnp.where(bumped, -1, psrc)
+            ep_b = jnp.take_along_axis(tgt_ep, b, axis=1)           # [n, cap]
+            cur_ep = ep_w == ep_b
+            is_g = is_g & cur_ep
+            is_ih = is_ih & cur_ep
+            is_gr = is_gr & cur_ep
+            is_pr = is_pr & cur_ep
+            is_ak = is_ak & cur_ep
 
-        # ---- gossip merge (handler join fold, Mod:merge :571-577) --
-        stale_g = is_g & hd.leq(pay, data_b)                    # is_stale
-        if isinstance(hd, handlers_mod.MaxJoinHandler):
-            # Elementwise-max joins fold as ONE scatter-max instead of
-            # materializing the [n, cap, B, PW] expansion + log-depth
-            # tree (BENCH_NOTES corrected cost model; exact same
-            # result: integer max is associative/commutative).  The
-            # scatter target starts from the handler's bottom() — the
-            # same padding contract the tree_fold path honors.
-            joined_in = (jnp.broadcast_to(hd.bottom(), (n_local, B, PW))
-                         .astype(jnp.int32).at[
-                r2e, jnp.where(is_g, b, B)].max(pay, mode="drop"))
-        else:
-            gmask = (oh_b & is_g[:, :, None])                   # [n, cap, B]
-            expanded = jnp.where(gmask[..., None], pay[:, :, None, :],
-                                 hd.bottom())                   # [n,cap,B,PW]
-            joined_in = handlers_mod.tree_fold(hd, expanded, axis=1)
-        fresh_any = ~hd.leq(joined_in, data)                    # [n, B]
+            # sender's link slot (slot_of): [n, cap]
+            hit = (nbrs[:, None, :] == src[:, :, None]) & (src >= 0)[:, :, None]
+            ks_ok = hit.any(-1)
+            ki = jnp.argmax(hit, -1)
 
-        # Winner per (tree, round): prefer the first slot whose payload
-        # EQUALS the fold (for max-joins that is the old "first slot
-        # carrying the max version"); if payloads are incomparable (no
-        # slot equals the fold) fall back to the first non-stale slot.
-        # All other gossip senders for the tree count as stale — under
-        # any sequential interleaving the first delivery wins and later
-        # ones are duplicates whose senders get pruned to lazy.
-        joined_b = jnp.take_along_axis(joined_in, b[:, :, None], axis=1)
-        eq_fold = jnp.all(pay == joined_b, axis=-1)             # [n, cap]
-        win_ns = is_g & ~stale_g
-        slot_c = jnp.arange(cap)[None, :]
+            oh_b = (b[:, :, None] == jnp.arange(B)[None, None, :])  # [n, cap, B]
+            oh_k = ((ki[:, :, None] == jnp.arange(K)[None, None, :])
+                    & ks_ok[:, :, None])                            # [n, cap, K]
+            # round-start store at each slot's tree: [n, cap, PW]
+            data_b = jnp.take_along_axis(data, b[:, :, None], axis=1)
+            # Monotone-recycle constraint check: an epoch-bumping gossip
+            # whose payload does NOT dominate the receiver's store means
+            # the recycled broadcast broke the lattice contract the
+            # epoch-oblivious store depends on — count it (never silent).
+            nonmono = state.nonmono + jnp.sum(
+                bump_g & ~hd.leq(data_b, pay), axis=1, dtype=jnp.int32)
 
-        def first_by_tree(cond):
-            # scatter-min over the slot's tree index — no [n, cap, B]
-            # materialization (same HBM-traffic reasoning as joined_in)
-            return jnp.full((n_local, B), cap, jnp.int32).at[
-                r2e, jnp.where(cond, b, B)].min(
-                jnp.broadcast_to(slot_c, b.shape), mode="drop")
+            def any_bk(cond):
+                """[n, cap] slot mask -> bool[n, B, K] any-hit, as an MXU
+                matmul over the one-hot encodings."""
+                lhs = (oh_b & cond[:, :, None]).astype(jnp.bfloat16)
+                rhs = oh_k.astype(jnp.bfloat16)
+                return jnp.einsum("ncb,nck->nbk", lhs, rhs) > 0.5
 
-        first_pref = first_by_tree(win_ns & eq_fold)
-        first_ns = first_by_tree(win_ns)
-        chosen = jnp.where(first_pref < cap, first_pref, first_ns)  # [n, B]
-        win = win_ns & (slot_c == jnp.take_along_axis(chosen, b, axis=1))
-        got = chosen < cap                                      # [n, B]
-        chosen_c = jnp.minimum(chosen, cap - 1)
-        # Non-winners demote ONLY if stale under the "winner delivered
-        # first" interleaving: pay <= join(store, winner's payload) —
-        # a valid sequential order.  Two concurrent INCOMPARABLE
-        # payloads (e.g. distinct G-counter actors) both stay eager,
-        # matching the reference where a non-stale Mod:merge keeps the
-        # sender eager (:843-857); equal/dominated duplicates prune.
-        pay_win = jnp.where(
-            got[:, :, None],
-            jnp.take_along_axis(pay, chosen_c[:, :, None], axis=1),
-            hd.bottom())                                        # [n, B, PW]
-        after_win = hd.join(data_b,
-                            jnp.take_along_axis(pay_win, b[:, :, None],
-                                                axis=1))        # [n, cap, PW]
-        stale_g = stale_g | (is_g & ~win & hd.leq(pay, after_win))
-        mr_win = jnp.where(got, jnp.take_along_axis(mr, chosen_c, axis=1), -1)
-        src_win = jnp.where(got, jnp.take_along_axis(src, chosen_c, axis=1),
-                            -1)
-        data = hd.join(data, joined_in)
-        rr = jnp.where(fresh_any, mr_win + 1, rr)
-        npu = npu | fresh_any
-        psrc = jnp.where(fresh_any, src_win, psrc)
+            # ---- gossip merge (handler join fold, Mod:merge :571-577) --
+            stale_g = is_g & hd.leq(pay, data_b)                    # is_stale
+            if isinstance(hd, handlers_mod.MaxJoinHandler):
+                # Elementwise-max joins fold as ONE scatter-max instead of
+                # materializing the [n, cap, B, PW] expansion + log-depth
+                # tree (BENCH_NOTES corrected cost model; exact same
+                # result: integer max is associative/commutative).  The
+                # scatter target starts from the handler's bottom() — the
+                # same padding contract the tree_fold path honors.
+                joined_in = (jnp.broadcast_to(hd.bottom(), (n_local, B, PW))
+                             .astype(jnp.int32).at[
+                    r2e, jnp.where(is_g, b, B)].max(pay, mode="drop"))
+            else:
+                gmask = (oh_b & is_g[:, :, None])                   # [n, cap, B]
+                expanded = jnp.where(gmask[..., None], pay[:, :, None, :],
+                                     hd.bottom())                   # [n,cap,B,PW]
+                joined_in = handlers_mod.tree_fold(hd, expanded, axis=1)
+            fresh_any = ~hd.leq(joined_in, data)                    # [n, B]
 
-        # ---- per-(tree, link) flags -------------------------------
-        missing_ih = is_ih & ~hd.leq(pay, data_b)
-        prune_req = any_bk(is_pr | stale_g)
-        unprune = any_bk(is_gr | missing_ih | (is_g & ~stale_g))
-        pruned = (pruned | prune_req) & ~unprune
-        lazyp = lazyp & ~any_bk(is_gr | is_ak)
+            # Winner per (tree, round): prefer the first slot whose payload
+            # EQUALS the fold (for max-joins that is the old "first slot
+            # carrying the max version"); if payloads are incomparable (no
+            # slot equals the fold) fall back to the first non-stale slot.
+            # All other gossip senders for the tree count as stale — under
+            # any sequential interleaving the first delivery wins and later
+            # ones are duplicates whose senders get pruned to lazy.
+            joined_b = jnp.take_along_axis(joined_in, b[:, :, None], axis=1)
+            eq_fold = jnp.all(pay == joined_b, axis=-1)             # [n, cap]
+            win_ns = is_g & ~stale_g
+            slot_c = jnp.arange(cap)[None, :]
 
-        # ---- per-slot replies (against the round-start store) -----
-        present_b = hd.present(data_b)                          # [n, cap]
-        rep_kind = jnp.select(
-            [stale_g, missing_ih, is_ih & ~missing_ih,
-             is_gr & present_b],
-            [jnp.int32(T.MsgKind.PT_PRUNE), jnp.int32(T.MsgKind.PT_GRAFT),
-             jnp.int32(T.MsgKind.PT_IHAVE_ACK),
-             jnp.int32(T.MsgKind.PT_GOSSIP)], 0)
-        # graft replies serve the ROUND-START (payload, hop-count) pair —
-        # data_b was gathered from the pre-merge store, so its matching
-        # round stamp must come from the pre-merge rround too
-        rr_b = jnp.take_along_axis(state.rround, b, axis=1)
-        # payload: i_have-derived replies (graft/ack) echo the advert
-        # (Mod:graft is keyed by the advertised id); gossip replies
-        # serve the store
-        rep_pay = jnp.where(is_ih[..., None], pay, data_b)      # [n, cap, PW]
-        replies = msg_ops.build(
-            W, rep_kind, gids[:, None],
-            jnp.where(rep_kind > 0, src, -1), channel=CH,
-            payload=(b, *jnp.unstack(rep_pay, axis=-1),
-                     jnp.where(is_gr, rr_b, 0), ep_b))
+            def first_by_tree(cond):
+                # scatter-min over the slot's tree index — no [n, cap, B]
+                # materialization (same HBM-traffic reasoning as joined_in)
+                return jnp.full((n_local, B), cap, jnp.int32).at[
+                    r2e, jnp.where(cond, b, B)].min(
+                    jnp.broadcast_to(slot_c, b.shape), mode="drop")
 
-        # ---- eager push: up to S carried-over fresh slots ----------
-        pend = npu & hd.present(data)
-        prio = jnp.where(pend, B - jnp.arange(B)[None, :], 0)
-        pv, sel = jax.lax.top_k(prio, S)                        # [n, S]
-        sel_ok = pv > 0
-        rows = jnp.arange(n_local)[:, None]
-        pruned_sel = pruned[rows, sel]                          # [n, S, K]
-        live_k = (nbrs >= 0)[:, None, :]                        # [n, 1, K]
-        psrc_sel = psrc[rows, sel]                              # [n, S]
-        eager = live_k & ~pruned_sel & (nbrs[:, None, :]
-                                        != psrc_sel[:, :, None])
-        dst = jnp.where(sel_ok[:, :, None] & eager, nbrs[:, None, :], -1)
-        data_sel = data[rows, sel]                              # [n, S, PW]
-        push_msgs = msg_ops.build(
-            W, T.MsgKind.PT_GOSSIP, gids[:, None, None], dst, channel=CH,
-            payload=(sel[:, :, None],
-                     *(w[:, :, None] for w in jnp.unstack(data_sel, axis=-1)),
-                     rr[rows, sel][:, :, None],
-                     tgt_ep[rows, sel][:, :, None]),
-        ).reshape(n_local, S * K, W)
-        lazy_new = sel_ok[:, :, None] & live_k & pruned_sel     # [n, S, K]
-        oh_sel = (sel[:, :, None] == jnp.arange(B)[None, None, :])
-        lazyp = lazyp | (jnp.einsum(
-            "nsb,nsk->nbk", oh_sel.astype(jnp.bfloat16),
-            lazy_new.astype(jnp.bfloat16)) > 0.5)
-        pushed_b = jnp.any(oh_sel & sel_ok[:, :, None], axis=1)  # [n, B]
-        npu = npu & ~pushed_b
+            first_pref = first_by_tree(win_ns & eq_fold)
+            first_ns = first_by_tree(win_ns)
+            chosen = jnp.where(first_pref < cap, first_pref, first_ns)  # [n, B]
+            win = win_ns & (slot_c == jnp.take_along_axis(chosen, b, axis=1))
+            got = chosen < cap                                      # [n, B]
+            chosen_c = jnp.minimum(chosen, cap - 1)
+            # Non-winners demote ONLY if stale under the "winner delivered
+            # first" interleaving: pay <= join(store, winner's payload) —
+            # a valid sequential order.  Two concurrent INCOMPARABLE
+            # payloads (e.g. distinct G-counter actors) both stay eager,
+            # matching the reference where a non-stale Mod:merge keeps the
+            # sender eager (:843-857); equal/dominated duplicates prune.
+            pay_win = jnp.where(
+                got[:, :, None],
+                jnp.take_along_axis(pay, chosen_c[:, :, None], axis=1),
+                hd.bottom())                                        # [n, B, PW]
+            after_win = hd.join(data_b,
+                                jnp.take_along_axis(pay_win, b[:, :, None],
+                                                    axis=1))        # [n, cap, PW]
+            stale_g = stale_g | (is_g & ~win & hd.leq(pay, after_win))
+            mr_win = jnp.where(got, jnp.take_along_axis(mr, chosen_c, axis=1), -1)
+            src_win = jnp.where(got, jnp.take_along_axis(src, chosen_c, axis=1),
+                                -1)
+            data = hd.join(data, joined_in)
+            rr = jnp.where(fresh_any, mr_win + 1, rr)
+            npu = npu | fresh_any
+            psrc = jnp.where(fresh_any, src_win, psrc)
 
-        # ---- lazy tick: flush up to L outstanding i_haves ----------
-        fire = ((ctx.rnd + gids) % cfg.lazy_tick_every == 0)     # [n]
-        flat = (lazyp & (nbrs >= 0)[:, None, :]).reshape(n_local, B * K)
-        lprio = jnp.where(flat & fire[:, None],
-                          B * K - jnp.arange(B * K)[None, :], 0)
-        lv, li = jax.lax.top_k(lprio, L)                         # [n, L]
-        bi, kix = li // K, li % K
-        adv = jnp.take_along_axis(data, bi[:, :, None], axis=1)  # [n, L, PW]
-        ihave_msgs = msg_ops.build(
-            W, T.MsgKind.PT_IHAVE, gids[:, None],
-            jnp.where(lv > 0, nbrs[rows, kix], -1), channel=CH,
-            payload=(bi, *jnp.unstack(adv, axis=-1),
-                     jnp.zeros_like(bi),
-                     jnp.take_along_axis(tgt_ep, bi, axis=1)))
+            # ---- per-(tree, link) flags -------------------------------
+            missing_ih = is_ih & ~hd.leq(pay, data_b)
+            prune_req = any_bk(is_pr | stale_g)
+            unprune = any_bk(is_gr | missing_ih | (is_g & ~stale_g))
+            pruned = (pruned | prune_req) & ~unprune
+            lazyp = lazyp & ~any_bk(is_gr | is_ak)
 
-        emitted = jnp.concatenate([replies, push_msgs, ihave_msgs], axis=1)
+            # ---- per-slot replies (against the round-start store) -----
+            present_b = hd.present(data_b)                          # [n, cap]
+            rep_kind = jnp.select(
+                [stale_g, missing_ih, is_ih & ~missing_ih,
+                 is_gr & present_b],
+                [jnp.int32(T.MsgKind.PT_PRUNE), jnp.int32(T.MsgKind.PT_GRAFT),
+                 jnp.int32(T.MsgKind.PT_IHAVE_ACK),
+                 jnp.int32(T.MsgKind.PT_GOSSIP)], 0)
+            # graft replies serve the ROUND-START (payload, hop-count) pair —
+            # data_b was gathered from the pre-merge store, so its matching
+            # round stamp must come from the pre-merge rround too
+            rr_b = jnp.take_along_axis(state.rround, b, axis=1)
+            # payload: i_have-derived replies (graft/ack) echo the advert
+            # (Mod:graft is keyed by the advertised id); gossip replies
+            # serve the store
+            rep_pay = jnp.where(is_ih[..., None], pay, data_b)      # [n, cap, PW]
+            replies = msg_ops.build(
+                W, rep_kind, gids[:, None],
+                jnp.where(rep_kind > 0, src, -1), channel=CH,
+                payload=(b, *jnp.unstack(rep_pay, axis=-1),
+                         jnp.where(is_gr, rr_b, 0), ep_b))
+
+            # ---- eager push: up to S carried-over fresh slots ----------
+            pend = npu & hd.present(data)
+            prio = jnp.where(pend, B - jnp.arange(B)[None, :], 0)
+            pv, sel = jax.lax.top_k(prio, S)                        # [n, S]
+            sel_ok = pv > 0
+            rows = jnp.arange(n_local)[:, None]
+            pruned_sel = pruned[rows, sel]                          # [n, S, K]
+            live_k = (nbrs >= 0)[:, None, :]                        # [n, 1, K]
+            psrc_sel = psrc[rows, sel]                              # [n, S]
+            eager = live_k & ~pruned_sel & (nbrs[:, None, :]
+                                            != psrc_sel[:, :, None])
+            dst = jnp.where(sel_ok[:, :, None] & eager, nbrs[:, None, :], -1)
+            data_sel = data[rows, sel]                              # [n, S, PW]
+            push_msgs = msg_ops.build(
+                W, T.MsgKind.PT_GOSSIP, gids[:, None, None], dst, channel=CH,
+                payload=(sel[:, :, None],
+                         *(w[:, :, None] for w in jnp.unstack(data_sel, axis=-1)),
+                         rr[rows, sel][:, :, None],
+                         tgt_ep[rows, sel][:, :, None]),
+            ).reshape(n_local, S * K, W)
+            lazy_new = sel_ok[:, :, None] & live_k & pruned_sel     # [n, S, K]
+            oh_sel = (sel[:, :, None] == jnp.arange(B)[None, None, :])
+            lazyp = lazyp | (jnp.einsum(
+                "nsb,nsk->nbk", oh_sel.astype(jnp.bfloat16),
+                lazy_new.astype(jnp.bfloat16)) > 0.5)
+            pushed_b = jnp.any(oh_sel & sel_ok[:, :, None], axis=1)  # [n, B]
+            npu = npu & ~pushed_b
+
+            # ---- lazy tick: flush up to L outstanding i_haves ----------
+            fire = ((ctx.rnd + gids) % cfg.lazy_tick_every == 0)     # [n]
+            flat = (lazyp & (nbrs >= 0)[:, None, :]).reshape(n_local, B * K)
+            lprio = jnp.where(flat & fire[:, None],
+                              B * K - jnp.arange(B * K)[None, :], 0)
+            lv, li = jax.lax.top_k(lprio, L)                         # [n, L]
+            bi, kix = li // K, li % K
+            adv = jnp.take_along_axis(data, bi[:, :, None], axis=1)  # [n, L, PW]
+            ihave_msgs = msg_ops.build(
+                W, T.MsgKind.PT_IHAVE, gids[:, None],
+                jnp.where(lv > 0, nbrs[rows, kix], -1), channel=CH,
+                payload=(bi, *jnp.unstack(adv, axis=-1),
+                         jnp.zeros_like(bi),
+                         jnp.take_along_axis(tgt_ep, bi, axis=1)))
+
+            return (data, rr, pruned, lazyp, npu, psrc, tgt_ep, nonmono,
+                    jnp.concatenate([replies, push_msgs, ihave_msgs],
+                                    axis=1))
+
+        (data, rr, pruned, lazyp, npu, psrc, tgt_ep, nonmono,
+         emitted) = jax.lax.cond(pt_go, pt_body, pt_skip, 0)
 
         # ---- AAE exchange tick (Mod:exchange, :1040-1070): push the
         # whole store to up to ``exchange_limit`` random peers on the
@@ -381,52 +407,80 @@ class Plumtree:
         # is a session between two nodes; the one-way periodic push
         # converges identically under symmetric firing.
         if pt.aae and hd.supports_exchange:
-            # Connect-time state exchange: a link slot with a NEW
-            # occupant gets the whole store pushed along it this round —
-            # the reference's anti-entropy handshake ({state, Tag,
-            # LocalState} on every fresh connection,
-            # partisan_peer_service_server.erl:150-172).  Without it a
-            # late (re)joiner waits on the random AAE walk to stumble
-            # onto it (measured ~60+ rounds for the last 14 of 100k).
-            # It is a handshake, not a periodic exchange, so it fires
-            # even when exchange_limit=0 disables the random AAE walk
-            # (the reference handshake is unconditional on connect).
-            tgt = jnp.where(changed & (nbrs >= 0) & ctx.alive[:, None],
-                            nbrs, -1)                      # [n, K]
+            # The whole AAE stage runs under ONE lax.cond: most rounds
+            # have no fresh links and (with aligned timers,
+            # Config.timer_stagger=False) no exchange tick due, so the
+            # exchange scatter is skipped outright.  The predicate is a
+            # cross-shard allsum — exchange_with_epochs contains
+            # collectives, so every shard must take the same branch.
+            hand_any = jnp.any(changed & (nbrs >= 0))
+            go_local = hand_any
             if pt.exchange_limit > 0:
-                fires = ((ctx.rnd + gids) % cfg.exchange_tick_every == 0) \
-                        & ctx.alive
+                fires = ((ctx.rnd + cfg.timer_phase(gids))
+                         % cfg.exchange_tick_every == 0) & ctx.alive
+                go_local = go_local | jnp.any(fires)
+            aae_go = comm.allsum(go_local.astype(jnp.int32)) > 0
 
-                def pick(key, row, fire):
-                    slots = rng.choice_slots(
-                        rng.subkey(key, _TAG_AAE), row >= 0,
-                        pt.exchange_limit)
-                    t = jnp.where(slots >= 0, row[slots], jnp.int32(-1))
-                    return jnp.where(fire, t, jnp.int32(-1))
+            def aae_body(_):
+                # Connect-time state exchange: a link slot with a NEW
+                # occupant gets the whole store pushed along it this
+                # round — the reference's anti-entropy handshake
+                # ({state, Tag, LocalState} on every fresh connection,
+                # partisan_peer_service_server.erl:150-172).  Without
+                # it a late (re)joiner waits on the random AAE walk to
+                # stumble onto it (measured ~60+ rounds for the last 14
+                # of 100k).  It is a handshake, not a periodic
+                # exchange, so it fires even when exchange_limit=0
+                # disables the random AAE walk (the reference handshake
+                # is unconditional on connect).
+                tgt = jnp.where(changed & (nbrs >= 0)
+                                & ctx.alive[:, None], nbrs, -1)  # [n, K]
+                if pt.exchange_limit > 0:
+                    def pick(key, row, fire):
+                        slots = rng.choice_slots(
+                            rng.subkey(key, _TAG_AAE), row >= 0,
+                            pt.exchange_limit)
+                        t = jnp.where(slots >= 0, row[slots],
+                                      jnp.int32(-1))
+                        return jnp.where(fire, t, jnp.int32(-1))
 
-                tick_tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)
-                tgt = jnp.concatenate([tick_tgt, tgt], axis=1)
-            tgt = faults_mod.filter_edges(
-                ctx.faults, gids, tgt, cfg.seed, ctx.rnd, _AAE_EDGE_TAG)
-            # Slot epochs ride the SAME exchange edges as the store
-            # (fused into one scatter for stock max-join handlers —
-            # handlers.exchange_with_epochs): a node whose data arrives
-            # via AAE adopts the recycled epoch — and resets its tree
-            # flags — in the same round instead of waiting for the next
-            # eager wave.  Safe because the store is lattice-monotone
-            # across recycles (adoption never discards data).
-            pulled, pulled_ep = hd.exchange_with_epochs(comm, data,
-                                                        tgt_ep, tgt)
-            if pulled is not None:
-                data = hd.join(data, jnp.where(ctx.alive[:, None, None],
-                                               pulled, hd.bottom()))
-            aae_bump = ctx.alive[:, None] & (pulled_ep > tgt_ep)
-            pruned = pruned & ~aae_bump[:, :, None]
-            lazyp = lazyp & ~aae_bump[:, :, None]
-            rr = jnp.where(aae_bump, 0, rr)
-            psrc = jnp.where(aae_bump, -1, psrc)
-            tgt_ep = jnp.maximum(tgt_ep, jnp.where(ctx.alive[:, None],
-                                                   pulled_ep, 0))
+                    tick_tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)
+                    tgt2 = jnp.concatenate([tick_tgt, tgt], axis=1)
+                else:
+                    tgt2 = tgt
+                tgt2 = faults_mod.filter_edges(
+                    ctx.faults, gids, tgt2, cfg.seed, ctx.rnd,
+                    _AAE_EDGE_TAG)
+                # Slot epochs ride the SAME exchange edges as the store
+                # (fused into one scatter for stock max-join handlers —
+                # handlers.exchange_with_epochs): a node whose data
+                # arrives via AAE adopts the recycled epoch — and
+                # resets its tree flags — in the same round instead of
+                # waiting for the next eager wave.  Safe because the
+                # store is lattice-monotone across recycles (adoption
+                # never discards data).
+                pulled, pulled_ep = hd.exchange_with_epochs(
+                    comm, data, tgt_ep, tgt2)
+                data2 = data
+                if pulled is not None:
+                    data2 = hd.join(
+                        data, jnp.where(ctx.alive[:, None, None],
+                                        pulled, hd.bottom()))
+                aae_bump = ctx.alive[:, None] & (pulled_ep > tgt_ep)
+                return (data2,
+                        pruned & ~aae_bump[:, :, None],
+                        lazyp & ~aae_bump[:, :, None],
+                        jnp.where(aae_bump, 0, rr),
+                        jnp.where(aae_bump, -1, psrc),
+                        jnp.maximum(tgt_ep,
+                                    jnp.where(ctx.alive[:, None],
+                                              pulled_ep, 0)))
+
+            def aae_skip(_):
+                return data, pruned, lazyp, rr, psrc, tgt_ep
+
+            data, pruned, lazyp, rr, psrc, tgt_ep = jax.lax.cond(
+                aae_go, aae_body, aae_skip, 0)
 
         # Crash-stopped nodes are frozen and silent.
         dead = ~ctx.alive

@@ -23,7 +23,13 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def measure(n: int, label: str, *, model: bool = True, **over) -> None:
+def measure(n: int, label: str, *, model: bool = True, active: bool = False,
+            **over) -> None:
+    """``active``: keep a broadcast disseminating during the timed
+    executions (re-inject a version bump before each), so the numbers
+    reflect the convergence-phase round rather than the idle round —
+    the distinction matters once quiet rounds are skippable
+    (timer_stagger=False)."""
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config, PlumtreeConfig
     from partisan_tpu.models.plumtree import Plumtree
@@ -35,12 +41,17 @@ def measure(n: int, label: str, *, model: bool = True, **over) -> None:
               plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
     kw.update(over)
     cfg = Config(**kw)
-    cl = Cluster(cfg, model=Plumtree() if model else None, donate=True)
+    pt = Plumtree() if model else None
+    cl = Cluster(cfg, model=pt, donate=not active)
     t0 = time.perf_counter()
     st = _boot_overlay(cl, n, settle_execs=2)
     boot = time.perf_counter() - t0
     best = float("inf")
+    ver = 1
     for _ in range(3):
+        if active and pt is not None:
+            ver += 1
+            st = st._replace(model=pt.broadcast(st.model, 0, 0, ver))
         t0 = time.perf_counter()
         st = cl.steps(st, K_PROG)
         _sync(st)
@@ -53,13 +64,24 @@ if __name__ == "__main__":
     from partisan_tpu.config import HyParViewConfig, PlumtreeConfig
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 32_768
-    measure(n, "baseline (bench config)")
-    measure(n, "manager only (no plumtree)", model=False)
-    measure(n, "aae off",
-            plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4, aae=False))
-    measure(n, "heartbeat off",
-            hyparview=HyParViewConfig(heartbeat=False))
-    measure(n, "monotonic shed off", monotonic_shed=False)
-    measure(n, "emit_compact off", emit_compact=0)
-    measure(n, "emit_compact 24", emit_compact=24)
-    measure(n, "inbox_cap 12", inbox_cap=12)
+    which = sys.argv[2] if len(sys.argv) > 2 else "r5"
+    if which == "r5":
+        measure(n, "stagger idle (r4 baseline)")
+        measure(n, "stagger active", active=True)
+        measure(n, "aligned idle", timer_stagger=False)
+        measure(n, "aligned active", timer_stagger=False, active=True)
+        measure(n, "aligned active inbox12", timer_stagger=False,
+                active=True, inbox_cap=12)
+        measure(n, "aligned manager only", timer_stagger=False,
+                model=False)
+    else:
+        measure(n, "baseline (bench config)")
+        measure(n, "manager only (no plumtree)", model=False)
+        measure(n, "aae off",
+                plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4, aae=False))
+        measure(n, "heartbeat off",
+                hyparview=HyParViewConfig(heartbeat=False))
+        measure(n, "monotonic shed off", monotonic_shed=False)
+        measure(n, "emit_compact off", emit_compact=0)
+        measure(n, "emit_compact 24", emit_compact=24)
+        measure(n, "inbox_cap 12", inbox_cap=12)
