@@ -76,11 +76,20 @@ def run(n: int, verbose: bool = False) -> dict:
     # blocks (the r5 quiet-gate; semantics validated on CPU at 1k-8k:
     # one component, convergence rounds unchanged).
     def make_cfg(width):
+        from partisan_tpu.config import HyParViewConfig
+        # isolation_window 25 s (default 40): epoch-staleness rejoin is
+        # how small components left by the 100k join storm merge into
+        # the main overlay; the worst healthy epoch gap is bump cadence
+        # (10) + overlay diameter (~7) + jitter (<10) < 25, so the
+        # tighter window is false-positive-safe and heals boot islands
+        # ~15 rounds sooner.
         return Config(n_nodes=width, seed=1,
                       peer_service_manager="hyparview",
                       msg_words=16, partition_mode="groups",
                       max_broadcasts=8, inbox_cap=16, emit_compact=32,
                       timer_stagger=False,
+                      hyparview=HyParViewConfig(
+                          isolation_window_ms=25_000),
                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
 
     cfg = make_cfg(n)
@@ -125,7 +134,8 @@ def run(n: int, verbose: bool = False) -> dict:
                   file=sys.stderr, flush=True)
 
     _, st = _boot_ladder(make_cluster, n, settle_execs=1,
-                         on_wave=on_wave, final_state=st)
+                         on_wave=on_wave, final_state=st,
+                         final_wave_factor=2)
     phases["smallw_boot"] = round(
         full_w.get("smallw_end", t0) - t0, 3)
     mark("bootstrap", t0)

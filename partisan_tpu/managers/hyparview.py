@@ -316,7 +316,13 @@ class HyParView:
         # skip, and during a broadcast's dissemination (no membership
         # churn) the manager stays almost entirely quiet.
         sh_fire = ((ctx.rnd + ph) % cfg.shuffle_every == 0) & (asize0 > 0)
-        pr_fire = ((ctx.rnd + ph) % cfg.promotion_every == 0) & \
+        # Random promotion stays PER-NODE STAGGERED even under aligned
+        # timers: it is the view-healing path broadcast stragglers
+        # depend on, and aligning it measured +18 convergence rounds at
+        # 16k (a straggler waits out the whole promotion interval).  It
+        # only fires for under-full nodes, so a settled overlay still
+        # reaches the quiet path every non-shuffle round.
+        pr_fire = ((ctx.rnd + gids) % cfg.promotion_every == 0) & \
             (asize0 < hv.active_min)
         if hv.xbot:
             x_timer = ((ctx.rnd + ph) % cfg.xbot_every == 0) \

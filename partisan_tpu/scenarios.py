@@ -133,7 +133,8 @@ def _grow_state(old_st, new_init, old_n: int, new_n: int):
 
 
 def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
-                 settle_execs=1, on_wave=None, final_state=None):
+                 settle_execs=1, on_wave=None, final_state=None,
+                 final_wave_factor=None):
     """Reduced-width bootstrap ladder: run the early join waves on
     PREFIX-width clusters, growing the state between widths
     (:func:`_grow_state`).  Every bootstrap wave costs one full-width
@@ -162,8 +163,16 @@ def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
             st = grow(st, init)
         join = jax.jit(lambda m, nodes, tgts, _cl=cl: _cl.manager.join_many(
             _cl.cfg, m, nodes, tgts))
+        # The wide rungs' join storms are the component-fragmentation
+        # risk (one 3x wave at 100k measured 14 components with aligned
+        # timers; factor 2 on the final rung alone still left 6-7);
+        # ``final_wave_factor`` therefore applies to EVERY rung above
+        # the first — the first rung's rounds are cheap and its factor-8
+        # ramp is the validated envelope.
+        factor = final_wave_factor \
+            if (final_wave_factor and w != widths[0]) else wave_factor
         while base < w:
-            hi = min(base * wave_factor, w)
+            hi = min(base * factor, w)
             nodes = np.arange(base, hi, dtype=np.int32)
             targets = rng.integers(0, base,
                                    size=nodes.shape[0]).astype(np.int32)
