@@ -134,7 +134,7 @@ def _grow_state(old_st, new_init, old_n: int, new_n: int):
 
 def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
                  settle_execs=1, on_wave=None, final_state=None,
-                 final_wave_factor=None):
+                 upper_wave_factor=2):
     """Reduced-width bootstrap ladder: run the early join waves on
     PREFIX-width clusters, growing the state between widths
     (:func:`_grow_state`).  Every bootstrap wave costs one full-width
@@ -145,9 +145,14 @@ def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
 
     ``make_cluster(width) -> Cluster`` builds one rung (same config at
     ``n_nodes=width``); ``final_state`` optionally supplies the
-    pre-built (timed) init state for the LAST width.  The wave/contact
-    schedule is identical to ``_boot_overlay`` at factor ``wave_factor``
-    — the widths only change where the inert high rows live."""
+    pre-built (timed) init state for the LAST width.  The FIRST rung
+    ramps at ``wave_factor`` (its rounds are cheap; factor 8 is the
+    validated envelope); every rung above it uses the gentler
+    ``upper_wave_factor`` — wide factor-8 join storms measured 6-14
+    disconnected components at 100k boot end under aligned timers,
+    and the stragglers' slow rejoins cost more than the saved waves.
+    The widths themselves only change where the inert high rows live
+    (ids are global, per-node hash-RNG streams are id-keyed)."""
     rng = np.random.default_rng(7)
     if widths is None:
         widths = [w for w in (4096, 32_768) if w < n] + [n]
@@ -163,14 +168,10 @@ def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
             st = grow(st, init)
         join = jax.jit(lambda m, nodes, tgts, _cl=cl: _cl.manager.join_many(
             _cl.cfg, m, nodes, tgts))
-        # The wide rungs' join storms are the component-fragmentation
-        # risk (one 3x wave at 100k measured 14 components with aligned
-        # timers; factor 2 on the final rung alone still left 6-7);
-        # ``final_wave_factor`` therefore applies to EVERY rung above
-        # the first — the first rung's rounds are cheap and its factor-8
-        # ramp is the validated envelope.
-        factor = final_wave_factor \
-            if (final_wave_factor and w != widths[0]) else wave_factor
+        # Gentle waves above the first rung (see docstring; factor 2 on
+        # the final rung alone still left 6-7 components at 100k).
+        factor = upper_wave_factor \
+            if (upper_wave_factor and w != widths[0]) else wave_factor
         while base < w:
             hi = min(base * factor, w)
             nodes = np.arange(base, hi, dtype=np.int32)
