@@ -34,10 +34,15 @@ usable from the bridge (any transport satisfying
   strategies, restart intensity, restart types, admin API
 - :mod:`partisan_tpu.otp.gen_sim`    — the call protocol vectorized on
   the node axis (one gen_server per node inside the jitted round)
+- :mod:`partisan_tpu.otp.statem_sim` — the gen_statem loop vectorized
+  on the node axis (postpone replay, state/event timeouts as a
+  lax.scan of micro-steps; table modules shared with the host loop)
+- :mod:`partisan_tpu.otp.client`     — the shared in-sim gen call
+  client (QUEUED/WAITING/OK/TIMEOUT/DOWN table) both services ride
 - :mod:`partisan_tpu.otp.sys`        — sys-style live introspection:
   get_state / replace_state / trace / statistics on node slices
 """
 
 from partisan_tpu.otp import (  # noqa: F401
-    gen, gen_event, gen_fsm, gen_server, gen_sim, gen_statem, monitor,
-    remote_ref, rpc, supervisor, sys)
+    client, gen, gen_event, gen_fsm, gen_server, gen_sim, gen_statem,
+    monitor, remote_ref, rpc, statem_sim, supervisor, sys)
