@@ -150,3 +150,37 @@ def test_soak_p2p_streams_under_crash_recovery_cycles():
     # the never-crashed cycles must deliver fully: at least half of all
     # sends land even with one receiver down per cycle
     assert delivered >= 12, f"only {delivered} of 24 sends delivered"
+
+
+def test_boot_ladder_single_component_aligned_timers():
+    """Regression guard for the r5 fragmentation fix: the width-ladder
+    bootstrap under ALIGNED timers (bench configuration) must end with
+    ONE connected component and converge a broadcast in the validated
+    ~20-round envelope.  Factor-8 waves on the upper rungs measured
+    6-14 disconnected islands at 100k (BENCH_NOTES r5); the default
+    gentle upper rungs must keep this property at CPU scale too."""
+    from partisan_tpu.config import Config, PlumtreeConfig
+    from partisan_tpu.scenarios import _boot_ladder
+
+    n = 4096
+    model = Plumtree()
+
+    def mk(width):
+        return Cluster(Config(
+            n_nodes=width, seed=1, peer_service_manager="hyparview",
+            msg_words=16, partition_mode="groups", max_broadcasts=8,
+            inbox_cap=16, emit_compact=32, timer_stagger=False,
+            plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4)),
+            model=model)
+
+    cl, st = _boot_ladder(mk, n, widths=[1024, n])
+    act = np.asarray(st.manager.active)
+    alive = np.asarray(st.faults.alive)
+    assert len(components(act, alive)) == 1
+    st = st._replace(model=model.broadcast(st.model, 0, 0, int(st.rnd)))
+    r0 = int(st.rnd)
+    st, conv = cl.run_until(
+        st, lambda s: float(model.coverage(
+            s.model, s.faults.alive, 0)) == 1.0,
+        max_rounds=60, check_every=5)
+    assert conv != -1 and conv - r0 <= 30, (conv, r0)
