@@ -98,44 +98,101 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         dstate, emitted, wides = delivery_mod.outbound(
             cfg, comm, dstate, emitted, ctx)
 
-    # Monotonic-channel load shedding: sends on a monotonic channel to a
-    # receiver whose inbox overflowed LAST round are dropped — newer
-    # state supersedes older, so shedding under backpressure is safe
-    # (partisan_peer_socket.erl:108-129 monotonic_should_send; the only
-    # drop path the reference's transport permits).
-    if cfg.monotonic_shed and any(c.monotonic for c in cfg.channels):
-        mono = jnp.asarray([c.monotonic for c in cfg.channels], jnp.bool_)
-        backed = comm.gather_vec(state.inbox.drops > 0)     # [n_global]
-        ch = jnp.clip(emitted[..., 3], 0, cfg.n_channels - 1)  # W_CHANNEL
-        dstv = jnp.clip(emitted[..., 2], 0, cfg.n_nodes - 1)   # W_DST
-        shed = mono[ch] & backed[dstv] & (emitted[..., 0] != 0)
-        emitted = emitted.at[..., 0].set(
-            jnp.where(shed, 0, emitted[..., 0]))
-
-    # Interposition chain (test plane): drop/rewrite/delay transforms on
-    # the send path, before the stochastic fault stage (mirrors the
-    # reference's interposition-before-wire placement, :58-130).
+    # ---- the wire stage: monotonic shed -> interposition -> emission
+    # count -> channel throttling -> fault masks.  Two implementations:
+    #
+    # FAST PATH (the bench/scenario hot path — no interposition chain,
+    # no channel-capacity stage, groups partition mode): every
+    # destination-side fact (alive, backpressure, partition group) is
+    # packed into ONE int32 word per node and fetched with a SINGLE
+    # gather over the emission stack; the source side is the emitting
+    # row itself (every emission's W_SRC is the row's own gid — the
+    # wire has no relays).  The generic composition below prices the
+    # same stage with ~6 independent cross-row gathers — measured
+    # ~99 ms of the 246 ms 32k round (tools/profile_phases.py), the
+    # single largest block of the round.  Fault decisions are
+    # bit-identical (same hash stream/salt) —
+    # tests/test_faults.py::test_fast_wire_path_matches_generic asserts
+    # parity against the generic path.
+    #
+    # GENERIC PATH: any interposition chain (delays, rewrites), channel
+    # capacity enforcement, or a dense partition matrix.
     istate = state.interpose
-    if interpose is not None:
-        istate, emitted = interpose.apply(cfg, comm, istate, emitted, ctx)
-
-    n_emitted = comm.allsum(jnp.sum(emitted[..., 0] != 0, dtype=jnp.int32))
-
-    # Channel-capacity stage (opt-in): per-(edge, channel, lane)
-    # throughput enforcement with outbox backpressure.  Runs after the
-    # emission count (a deferred send was already counted when emitted)
-    # and before the fault stage (a deferred send rides the wire — and
-    # its faults — the round it actually transmits).
     obstate = state.outbox
-    if channels_mod.enabled(cfg):
-        obstate, emitted = channels_mod.throttle(cfg, comm, obstate,
-                                                 emitted)
+    want_shed = cfg.monotonic_shed and any(c.monotonic
+                                           for c in cfg.channels)
+    fast_wire = (interpose is None and not channels_mod.enabled(cfg)
+                 and cfg.resolved_partition_mode == "groups")
+    if fast_wire:
+        kind_w = emitted[..., 0]
+        dst_w = emitted[..., 2]
+        backed = (comm.gather_vec(state.inbox.drops > 0)
+                  if want_shed else None)
+        info_d = faults_mod.pack_wire_info(state.faults, backed)[
+            jnp.clip(dst_w, 0, cfg.n_nodes - 1)]           # ONE gather
+        if want_shed:
+            # monotonic-channel shed (partisan_peer_socket.erl:108-129
+            # monotonic_should_send): the channel id is a static config
+            # constant per producer, so the tiny mono[ch] table lookup
+            # unrolls to fused equality tests
+            mono_m = jnp.zeros(kind_w.shape, jnp.bool_)
+            for i, c in enumerate(cfg.channels):
+                if c.monotonic:
+                    mono_m = mono_m | (emitted[..., 3] == i)
+            shed = mono_m & (((info_d >> 1) & 1) == 1) & (kind_w != 0)
+            kind_w = jnp.where(shed, 0, kind_w)
+        n_emitted = comm.allsum(jnp.sum(kind_w != 0, dtype=jnp.int32))
+        group_l = jax.lax.dynamic_slice(
+            state.faults.partition, (comm.node_offset,), (comm.n_local,))
+        cut = faults_mod.wire_cut_from_info(
+            state.faults, info_d, kind_w != 0, gids, dst_w,
+            alive_local, group_l, cfg.seed, state.rnd, _MSG_FILTER_TAG)
+        fault_dropped = (kind_w != 0) & cut
+        sent = emitted.at[..., 0].set(kind_w) if capture else emitted
+        emitted = emitted.at[..., 0].set(jnp.where(cut, 0, kind_w))
+    else:
+        # Monotonic-channel load shedding: sends on a monotonic channel
+        # to a receiver whose inbox overflowed LAST round are dropped —
+        # newer state supersedes older, so shedding under backpressure
+        # is safe (partisan_peer_socket.erl:108-129
+        # monotonic_should_send; the only drop path the reference's
+        # transport permits).
+        if want_shed:
+            mono = jnp.asarray([c.monotonic for c in cfg.channels],
+                               jnp.bool_)
+            backed = comm.gather_vec(state.inbox.drops > 0)  # [n_global]
+            ch = jnp.clip(emitted[..., 3], 0, cfg.n_channels - 1)
+            dstv = jnp.clip(emitted[..., 2], 0, cfg.n_nodes - 1)
+            shed = mono[ch] & backed[dstv] & (emitted[..., 0] != 0)
+            emitted = emitted.at[..., 0].set(
+                jnp.where(shed, 0, emitted[..., 0]))
 
-    # Fault stage: crash/partition/omission masks between emit and deliver.
-    sent = emitted
-    emitted = faults_mod.filter_msgs(
-        state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
-    fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
+        # Interposition chain (test plane): drop/rewrite/delay
+        # transforms on the send path, before the stochastic fault
+        # stage (mirrors the reference's interposition-before-wire
+        # placement, :58-130).
+        if interpose is not None:
+            istate, emitted = interpose.apply(cfg, comm, istate, emitted,
+                                              ctx)
+
+        n_emitted = comm.allsum(jnp.sum(emitted[..., 0] != 0,
+                                        dtype=jnp.int32))
+
+        # Channel-capacity stage (opt-in): per-(edge, channel, lane)
+        # throughput enforcement with outbox backpressure.  Runs after
+        # the emission count (a deferred send was already counted when
+        # emitted) and before the fault stage (a deferred send rides
+        # the wire — and its faults — the round it actually transmits).
+        if channels_mod.enabled(cfg):
+            obstate, emitted = channels_mod.throttle(cfg, comm, obstate,
+                                                     emitted)
+
+        # Fault stage: crash/partition/omission masks between emit and
+        # deliver.
+        sent = emitted
+        emitted = faults_mod.filter_msgs(
+            state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
+        fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
 
     # The whole exchange (compaction sort + route) is skipped when NO
     # message survived to the wire anywhere — common once the managers'

@@ -137,6 +137,55 @@ def filter_msgs(faults: FaultState, emitted: Array, seed: int, rnd: Array,
     )
 
 
+# Partition group labels must fit the packed word below: they are
+# partition indices (a handful per scenario), far under 2^29.
+_GROUP_BITS_MASK = 0x1FFFFFFF
+
+
+def pack_wire_info(faults: FaultState, backed: Array | None) -> Array:
+    """int32[n_global]: per-DESTINATION wire facts for the fused
+    send-path filter (cluster.round_body fast path) — bit0 = alive,
+    bit1 = inbox backpressure (monotonic shed), bits 2.. = partition
+    group label.  Groups partition mode only (dense mode needs the
+    per-(src, dst) matrix and takes the generic path).
+
+    Why: the send-path filter prices the emission stack [n, E] with
+    cross-row gathers, and gathers dominate the round on this backend
+    (~99 ms of the 246 ms 32k round was this stage,
+    tools/profile_phases.py).  Every destination-side fact packed here
+    turns 3 independent gathers (alive[d], partition[d], backed[d])
+    into one; the SOURCE side needs no gather at all because an
+    emission's W_SRC is always the emitting row's own gid (the wire
+    has no relays — every protocol emits from itself)."""
+    alive = faults.alive.astype(jnp.int32)
+    b = jnp.zeros_like(alive) if backed is None \
+        else backed.astype(jnp.int32)
+    return alive | (b << 1) | ((faults.partition & _GROUP_BITS_MASK) << 2)
+
+
+def wire_cut_from_info(faults: FaultState, info_d: Array, valid: Array,
+                       src_gid: Array, dst: Array, alive_src: Array,
+                       group_src: Array, seed: int, rnd: Array,
+                       salt: int) -> Array:
+    """The edge_cut decision evaluated against a packed info gather:
+    ``info_d = pack_wire_info(...)[dst]``.  Bit-identical to
+    ``edge_cut`` on the same (src, dst) pairs wherever ``valid`` (the
+    hash stream and the alive/partition tests are the same); invalid
+    slots report uncut, like edge_cut's dst<0 rule.
+
+    src_gid/alive_src/group_src are the EMITTING ROW's facts (shape
+    [n_local] broadcast against the slot axis)."""
+    alive_d = (info_d & 1) == 1
+    group_d = info_d >> 2
+    cut = (group_src[:, None] & _GROUP_BITS_MASK) != group_d
+    cut = cut | ~alive_d | ~alive_src[:, None]
+    d = jnp.where(valid, dst, 0)
+    drop = hash_bernoulli(
+        edge_hash(seed, rnd, salt, src_gid[:, None], d),
+        faults.link_drop)
+    return valid & (cut | drop)
+
+
 # --- churn engine (driver config #4: SCAMP v2 + churn) ------------------
 
 _CHURN_DEATH_TAG = 31

@@ -93,3 +93,57 @@ def test_groups_partition_composes_as_refinement():
     healed = faults_mod.resolve_partition(f)
     assert not bool(faults_mod.edge_cut(
         healed, jnp.int32(1), jnp.int32(3), 0, jnp.int32(0), 1))
+
+
+def test_fast_wire_path_matches_generic():
+    """The fused wire stage (cluster.round_body fast path: ONE packed
+    gather for shed + partition/crash/omission masks) must evolve the
+    cluster BIT-IDENTICALLY to the generic multi-gather composition —
+    same hash stream, same shed decisions, same stats.  A no-op Observe
+    interposition forces the generic path on an otherwise identical
+    configuration, under simultaneous crashes + a groups partition +
+    iid link drop + monotonic backpressure traffic."""
+    import jax
+
+    from partisan_tpu import interpose
+    from partisan_tpu.config import HyParViewConfig, PlumtreeConfig
+    from partisan_tpu.models.plumtree import Plumtree
+
+    def make(force_generic):
+        cfg = Config(n_nodes=96, seed=5, peer_service_manager="hyparview",
+                     msg_words=16, partition_mode="groups",
+                     max_broadcasts=4, inbox_cap=8,
+                     hyparview=HyParViewConfig(),
+                     plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+        probe = interpose.Observe(
+            fn=lambda c, x, em: jnp.int32(0),
+            combine=lambda s, a: s) if force_generic else None
+        return Cluster(cfg, model=Plumtree(), interpose=probe)
+
+    def drive(cl):
+        st = cl.init()
+        m = cl.manager.join_many(
+            cl.cfg, st.manager, list(range(1, 96)), [0] * 95)
+        st = cl.steps(st._replace(manager=m), 20)
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 7))
+        # crashes + partition + link drop, all at once
+        alive = st.faults.alive.at[jnp.asarray([5, 17, 33])].set(False)
+        part = st.faults.partition.at[jnp.arange(48)].set(1)
+        st = st._replace(faults=st.faults._replace(
+            alive=alive, partition=part,
+            link_drop=jnp.float32(0.15)))
+        return cl.steps(st, 25)
+
+    fast = drive(make(False))
+    slow = drive(make(True))
+    # the interpose leaf itself differs ((), Observe counter); every
+    # other component of the cluster state must not
+    assert int(fast.stats.emitted) == int(slow.stats.emitted)
+    assert int(fast.stats.delivered) == int(slow.stats.delivered)
+    assert int(fast.stats.dropped) == int(slow.stats.dropped)
+    for name in ("rnd", "inbox", "manager", "model", "faults"):
+        fa = jax.tree.leaves(getattr(fast, name))
+        sl = jax.tree.leaves(getattr(slow, name))
+        assert len(fa) == len(sl)
+        for x, y in zip(fa, sl):
+            assert bool(jnp.array_equal(x, y)), name

@@ -1,0 +1,244 @@
+"""Component-level timing of the bench round at scale.
+
+The ablation profiler (profile_round.py) toggles config knobs on the
+FULL round; this one times the round's pieces in ISOLATION — manager
+quiet path, plumtree body, AAE stage, route/compaction, fault filter,
+record builds — each as its own k-iteration ``lax.scan`` on a synthetic
+settled overlay (ring active views).  Costs on this backend are
+shape-determined (static shapes; only the lax.cond gates depend on
+content), so a synthetic overlay prices the ops faithfully without a
+multi-minute bootstrap.  Results drive the round-5 hot-path work; keep
+findings in BENCH_NOTES.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+K = 50
+
+
+def main(n: int) -> None:
+    from partisan_tpu import faults as faults_mod
+    from partisan_tpu.cluster import Cluster, ClusterState, Stats
+    from partisan_tpu.config import Config, HyParViewConfig, PlumtreeConfig
+    from partisan_tpu.managers.base import RoundCtx
+    from partisan_tpu.managers.hyparview import HyParViewState
+    from partisan_tpu.models.plumtree import Plumtree
+    from partisan_tpu.ops import exchange, msg as msg_ops, rng
+
+    cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                 timer_stagger=False,
+                 hyparview=HyParViewConfig(isolation_window_ms=25_000),
+                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    comm = cl.comm
+    mgr = cl.manager
+    W = cfg.msg_words
+    A = cfg.hyparview.active_max
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    # Synthetic settled overlay: ring active views (4 neighbors), a few
+    # passive entries, heartbeat clocks fresh.
+    def build_state():
+        act = jnp.stack([(ids + 1) % n, (ids - 1) % n,
+                         (ids + 2) % n, (ids - 2) % n], axis=1)
+        act = jnp.concatenate(
+            [act, jnp.full((n, A - 4), -1, jnp.int32)], axis=1)
+        P = cfg.hyparview.passive_max
+        pas = jnp.stack([(ids + 3 + i) % n for i in range(8)], axis=1)
+        pas = jnp.concatenate(
+            [pas, jnp.full((n, P - 8), -1, jnp.int32)], axis=1)
+        mstate = HyParViewState(
+            active=act, passive=pas,
+            join_target=jnp.full((n,), -1, jnp.int32),
+            leaving=jnp.zeros((n,), jnp.bool_),
+            left=jnp.zeros((n,), jnp.bool_),
+            reserved=jnp.zeros((n,), jnp.int32),
+            joined=jnp.ones((n,), jnp.bool_),
+            hb_epoch=jnp.zeros((n,), jnp.int32),
+            hb_rnd=jnp.zeros((n,), jnp.int32), dist=())
+        pstate = model.init(cfg, comm)
+        pstate = pstate._replace(tree_nbrs=act)
+        return mstate, pstate, act
+
+    mstate, pstate, act = build_state()
+    faults = faults_mod.none(n, cfg.resolved_partition_mode)
+    inbox0 = exchange.empty_inbox(n, cfg.inbox_cap, W)
+
+    def ctx_at(rnd):
+        return RoundCtx(rnd=rnd, alive=faults.alive,
+                        keys=rng.node_keys(cfg.seed, rnd, ids),
+                        inbox=inbox0, faults=faults)
+
+    only = sys.argv[2] if len(sys.argv) > 2 else None
+
+    def timed(label, fn, carry):
+        if only and only not in label.lower():
+            return
+        jfn = jax.jit(lambda c: jax.lax.scan(
+            lambda cc, _: (fn(cc), None), c, None, length=K)[0])
+        t0 = time.perf_counter()
+        out = jfn(carry)
+        s = jax.tree.leaves(out)[0]
+        jax.device_get(jnp.sum(s))
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = jfn(carry)
+            s = jax.tree.leaves(out)[0]
+            jax.device_get(jnp.sum(s))
+            best = min(best, time.perf_counter() - t0)
+        print(f"{label:34s} {best / K * 1e3:7.2f} ms/iter  "
+              f"(compile {compile_s:.0f}s)", flush=True)
+
+    # 1. manager step, quiet inbox (the convergence-phase manager cost):
+    #    consecutive rounds so the shuffle cadence fires its real 1/10.
+    def hv_quiet(c):
+        st, rnd = c
+        st2, _em = mgr.step(cfg, comm, st, ctx_at(rnd))
+        return (st2, rnd + 1)
+
+    timed("hv step quiet (cad 1/10)", hv_quiet, (mstate, jnp.int32(3)))
+
+    # 2. manager step, never-firing cadence (pure quiet floor)
+    def hv_quiet_nocad(c):
+        st, rnd = c
+        st2, _em = mgr.step(cfg, comm, st, ctx_at(rnd))
+        return (st2, rnd + 10)
+
+    timed("hv step quiet (cad never)", hv_quiet_nocad,
+          (mstate, jnp.int32(3)))
+
+    # 3. manager step with heartbeat machinery off
+    cfg_nohb = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                      msg_words=16, partition_mode="groups",
+                      max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                      timer_stagger=False,
+                      hyparview=HyParViewConfig(
+                          isolation_window_ms=25_000, heartbeat=False,
+                          auto_rejoin=False),
+                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+
+    def hv_quiet_nohb(c):
+        st, rnd = c
+        st2, _em = mgr.step(cfg_nohb, comm, st, ctx_at(rnd))
+        return (st2, rnd + 10)
+
+    timed("hv step quiet, hb+rejoin off", hv_quiet_nohb,
+          (mstate, jnp.int32(3)))
+
+    # 4. plumtree step, body active (broadcast in flight), AAE ticking
+    def pt_active(c):
+        st, rnd = c
+        st2 = st._replace(need_push=st.need_push.at[0, 0].set(True))
+        st3, _em = model.step(cfg, comm, st2, ctx_at(rnd), act)
+        return (st3, rnd + 1)
+
+    timed("pt step active (body+aae)", pt_active, (pstate, jnp.int32(3)))
+
+    # 5. plumtree step, fully idle (both gates skip)
+    def pt_idle(c):
+        st, rnd = c
+        st2, _em = model.step(cfg, comm, st, ctx_at(rnd), act)
+        return (st2, rnd + 1)
+
+    timed("pt step idle (gates skip)", pt_idle, (pstate, jnp.int32(3)))
+
+    # 6. plumtree step, body active, AAE never firing
+    cfg_noaae = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                       msg_words=16, partition_mode="groups",
+                       max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                       timer_stagger=False,
+                       hyparview=HyParViewConfig(isolation_window_ms=25_000),
+                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4,
+                                               aae=False))
+
+    def pt_active_noaae(c):
+        st, rnd = c
+        st2 = st._replace(need_push=st.need_push.at[0, 0].set(True))
+        st3, _em = model.step(cfg_noaae, comm, st2, ctx_at(rnd), act)
+        return (st3, rnd + 1)
+
+    timed("pt step active, aae off", pt_active_noaae,
+          (pstate, jnp.int32(3)))
+
+    # 7. the wire stage: emission stack -> compact -> route, ~5% fill
+    E = 71
+    fill = np.zeros((n, E), np.int32)
+    rs = np.random.RandomState(0)
+    livemask = rs.rand(n, E) < 0.05
+    fill[livemask] = 3
+    kinds = jnp.asarray(fill)
+    dsts = jnp.asarray(rs.randint(0, n, size=(n, E)), jnp.int32)
+    base_em = msg_ops.build(W, kinds, ids[:, None],
+                            jnp.where(kinds != 0, dsts, -1))
+
+    def wire(c):
+        em, acc = c
+        e = exchange.compact_emissions(em, cfg.emit_compact)
+        ib = comm.route(e)
+        return (em, acc + ib.count)
+
+    timed("compact71->32 + route", wire,
+          (base_em, jnp.zeros((n,), jnp.int32)))
+
+    def route_only(c):
+        em, acc = c
+        ib = comm.route(em)
+        return (em, acc + ib.count)
+
+    timed("route 71 (no compact)", route_only,
+          (base_em, jnp.zeros((n,), jnp.int32)))
+
+    # 8. fault filter + monotonic shed over the full stack
+    mono = jnp.asarray([c.monotonic for c in cfg.channels], jnp.bool_)
+
+    def filt(c):
+        em, rnd = c
+        backed = jnp.zeros((n,), jnp.bool_)
+        ch = jnp.clip(em[..., 3], 0, cfg.n_channels - 1)
+        dstv = jnp.clip(em[..., 2], 0, n - 1)
+        shed = mono[ch] & backed[dstv] & (em[..., 0] != 0)
+        em2 = em.at[..., 0].set(jnp.where(shed, 0, em[..., 0]))
+        em3 = faults_mod.filter_msgs(faults, em2, cfg.seed, rnd, 11)
+        return (em3, rnd + 1)
+
+    timed("shed + fault filter (71)", filt, (base_em, jnp.int32(3)))
+
+    # 9. full round for reference (active broadcast), same instrument
+    st_full = ClusterState(
+        rnd=jnp.int32(3), faults=faults, inbox=inbox0, manager=mstate,
+        model=pstate, delivery=(),
+        stats=Stats(jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        interpose=cl.interpose.init(cfg, comm) if cl.interpose else (),
+        outbox=())
+
+    def full(c):
+        st = c
+        st = st._replace(model=st.model._replace(
+            need_push=st.model.need_push.at[0, 0].set(True)))
+        from partisan_tpu.cluster import round_body
+        return round_body(cfg, mgr, model, comm, st,
+                          interpose=cl.interpose)
+
+    timed("FULL round (active)", full, st_full)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 32_768)
