@@ -214,7 +214,17 @@ class Plumtree:
             tgt_ep = state.epoch.at[
                 r2e, jnp.where(is_g | is_ih, b, B)].max(ep_w, mode="drop")
             bumped = tgt_ep > state.epoch                           # [n, B]
-            old_ep_b = jnp.take_along_axis(state.epoch, b, axis=1)  # [n, cap]
+            # ONE packed take serves every round-start B-axis read
+            # (store, rround, epoch): cross-slot gathers price the round
+            # on this backend (tools/profile_phases.py), and the three
+            # separate takes cost ~3x this fused one.
+            pre = jnp.concatenate(
+                [data, state.rround[:, :, None], state.epoch[:, :, None]],
+                axis=-1)                                    # [n, B, PW+2]
+            pre_b = jnp.take_along_axis(pre, b[:, :, None], axis=1)
+            data_b = pre_b[..., :PW]                        # [n, cap, PW]
+            rr_b = pre_b[..., PW]                           # [n, cap]
+            old_ep_b = pre_b[..., PW + 1]                   # [n, cap]
             bump_g = is_g & (ep_w > old_ep_b)   # raw mask, pre-epoch-filter
             pruned = pruned & ~bumped[:, :, None]
             lazyp = lazyp & ~bumped[:, :, None]
@@ -236,8 +246,6 @@ class Plumtree:
             oh_b = (b[:, :, None] == jnp.arange(B)[None, None, :])  # [n, cap, B]
             oh_k = ((ki[:, :, None] == jnp.arange(K)[None, None, :])
                     & ks_ok[:, :, None])                            # [n, cap, K]
-            # round-start store at each slot's tree: [n, cap, PW]
-            data_b = jnp.take_along_axis(data, b[:, :, None], axis=1)
             # Monotone-recycle constraint check: an epoch-bumping gossip
             # whose payload does NOT dominate the receiver's store means
             # the recycled broadcast broke the lattice contract the
@@ -283,32 +291,36 @@ class Plumtree:
             win_ns = is_g & ~stale_g
             slot_c = jnp.arange(cap)[None, :]
 
-            def first_by_tree(cond):
-                # scatter-min over the slot's tree index — no [n, cap, B]
-                # materialization (same HBM-traffic reasoning as joined_in)
-                return jnp.full((n_local, B), cap, jnp.int32).at[
-                    r2e, jnp.where(cond, b, B)].min(
-                    jnp.broadcast_to(slot_c, b.shape), mode="drop")
-
-            first_pref = first_by_tree(win_ns & eq_fold)
-            first_ns = first_by_tree(win_ns)
-            chosen = jnp.where(first_pref < cap, first_pref, first_ns)  # [n, B]
-            win = win_ns & (slot_c == jnp.take_along_axis(chosen, b, axis=1))
-            got = chosen < cap                                      # [n, B]
-            chosen_c = jnp.minimum(chosen, cap - 1)
+            # Winner per (tree, round) as ONE packed scatter-min: key =
+            # slot, plus ``cap`` for non-eq_fold candidates, so a slot
+            # whose payload EQUALS the fold always beats a fallback
+            # slot, and within each class the first slot wins — exactly
+            # the first_pref-else-first_ns selection the previous two
+            # scatter-mins computed, in one scatter.
+            keyp = jnp.broadcast_to(slot_c, b.shape) \
+                + jnp.where(eq_fold, 0, cap)
+            packed = jnp.full((n_local, B), 2 * cap, jnp.int32).at[
+                r2e, jnp.where(win_ns, b, B)].min(keyp, mode="drop")
+            got = packed < 2 * cap                                  # [n, B]
+            chosen_c = jnp.minimum(
+                jnp.where(packed >= cap, packed - cap, packed), cap - 1)
+            chosen = jnp.where(got, chosen_c, cap)                  # [n, B]
+            chosen_b = jnp.take_along_axis(chosen, b, axis=1)       # [n, cap]
+            win = win_ns & (slot_c == chosen_b)
             # Non-winners demote ONLY if stale under the "winner delivered
             # first" interleaving: pay <= join(store, winner's payload) —
             # a valid sequential order.  Two concurrent INCOMPARABLE
             # payloads (e.g. distinct G-counter actors) both stay eager,
             # matching the reference where a non-stale Mod:merge keeps the
             # sender eager (:843-857); equal/dominated duplicates prune.
-            pay_win = jnp.where(
-                got[:, :, None],
-                jnp.take_along_axis(pay, chosen_c[:, :, None], axis=1),
-                hd.bottom())                                        # [n, B, PW]
-            after_win = hd.join(data_b,
-                                jnp.take_along_axis(pay_win, b[:, :, None],
-                                                    axis=1))        # [n, cap, PW]
+            # The winner's payload is gathered straight at each SLOT's
+            # tree (one [n, cap, PW] take — no [n, B, PW] intermediate).
+            after_win = hd.join(data_b, jnp.where(
+                (chosen_b < cap)[:, :, None],
+                jnp.take_along_axis(
+                    pay, jnp.minimum(chosen_b, cap - 1)[:, :, None],
+                    axis=1),
+                hd.bottom()))                                  # [n, cap, PW]
             stale_g = stale_g | (is_g & ~win & hd.leq(pay, after_win))
             mr_win = jnp.where(got, jnp.take_along_axis(mr, chosen_c, axis=1), -1)
             src_win = jnp.where(got, jnp.take_along_axis(src, chosen_c, axis=1),
@@ -333,10 +345,9 @@ class Plumtree:
                 [jnp.int32(T.MsgKind.PT_PRUNE), jnp.int32(T.MsgKind.PT_GRAFT),
                  jnp.int32(T.MsgKind.PT_IHAVE_ACK),
                  jnp.int32(T.MsgKind.PT_GOSSIP)], 0)
-            # graft replies serve the ROUND-START (payload, hop-count) pair —
-            # data_b was gathered from the pre-merge store, so its matching
-            # round stamp must come from the pre-merge rround too
-            rr_b = jnp.take_along_axis(state.rround, b, axis=1)
+            # graft replies serve the ROUND-START (payload, hop-count)
+            # pair — rr_b rode the packed pre-merge take above, matching
+            # the pre-merge data_b
             # payload: i_have-derived replies (graft/ack) echo the advert
             # (Mod:graft is keyed by the advertised id); gossip replies
             # serve the store
@@ -440,8 +451,47 @@ class Plumtree:
                 # exchange, so it fires even when exchange_limit=0
                 # disables the random AAE walk (the reference handshake
                 # is unconditional on connect).
-                tgt = jnp.where(changed & (nbrs >= 0)
-                                & ctx.alive[:, None], nbrs, -1)  # [n, K]
+                #
+                # The handshake push is K links wide but fires only
+                # when some link CHANGED occupant — never on a settled
+                # overlay — so it runs under its own inner gate and the
+                # per-round cost is the tick push's [n, exchange_limit]
+                # scatter alone (~1/(K+1) of the fused-scatter
+                # traffic).  Both pulls read the same round-start
+                # store, so the split is exactly the previous single
+                # concatenated scatter when both fire.
+                def hand_pull(_):
+                    tgt = jnp.where(changed & (nbrs >= 0)
+                                    & ctx.alive[:, None], nbrs, -1)
+                    tgt = faults_mod.filter_edges(
+                        ctx.faults, gids, tgt, cfg.seed, ctx.rnd,
+                        _AAE_EDGE_TAG)
+                    return hd.exchange_with_epochs(comm, data, tgt_ep,
+                                                   tgt)
+
+                def hand_skip(_):
+                    return (jnp.broadcast_to(hd.bottom(), data.shape)
+                            .astype(data.dtype),
+                            jnp.zeros_like(tgt_ep))
+
+                if pt.exchange_limit > 0:
+                    # hand_any is the [local] predicate already computed
+                    # for the outer gate; with the walk disabled the
+                    # outer gate IS the handshake gate and the inner
+                    # cond would be always-true
+                    hand_go = comm.allsum(hand_any.astype(jnp.int32)) > 0
+                    pulled, pulled_ep = jax.lax.cond(hand_go, hand_pull,
+                                                     hand_skip, 0)
+                else:
+                    pulled, pulled_ep = hand_pull(0)
+                # Slot epochs ride the SAME exchange edges as the store
+                # (fused into one scatter for stock max-join handlers —
+                # handlers.exchange_with_epochs): a node whose data
+                # arrives via AAE adopts the recycled epoch — and
+                # resets its tree flags — in the same round instead of
+                # waiting for the next eager wave.  Safe because the
+                # store is lattice-monotone across recycles (adoption
+                # never discards data).
                 if pt.exchange_limit > 0:
                     def pick(key, row, fire):
                         slots = rng.choice_slots(
@@ -452,27 +502,16 @@ class Plumtree:
                         return jnp.where(fire, t, jnp.int32(-1))
 
                     tick_tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)
-                    tgt2 = jnp.concatenate([tick_tgt, tgt], axis=1)
-                else:
-                    tgt2 = tgt
-                tgt2 = faults_mod.filter_edges(
-                    ctx.faults, gids, tgt2, cfg.seed, ctx.rnd,
-                    _AAE_EDGE_TAG)
-                # Slot epochs ride the SAME exchange edges as the store
-                # (fused into one scatter for stock max-join handlers —
-                # handlers.exchange_with_epochs): a node whose data
-                # arrives via AAE adopts the recycled epoch — and
-                # resets its tree flags — in the same round instead of
-                # waiting for the next eager wave.  Safe because the
-                # store is lattice-monotone across recycles (adoption
-                # never discards data).
-                pulled, pulled_ep = hd.exchange_with_epochs(
-                    comm, data, tgt_ep, tgt2)
-                data2 = data
-                if pulled is not None:
-                    data2 = hd.join(
-                        data, jnp.where(ctx.alive[:, None, None],
-                                        pulled, hd.bottom()))
+                    tick_tgt = faults_mod.filter_edges(
+                        ctx.faults, gids, tick_tgt, cfg.seed, ctx.rnd,
+                        _AAE_EDGE_TAG)
+                    p_t, ep_t = hd.exchange_with_epochs(
+                        comm, data, tgt_ep, tick_tgt)
+                    pulled = hd.join(pulled, p_t)
+                    pulled_ep = jnp.maximum(pulled_ep, ep_t)
+                data2 = hd.join(
+                    data, jnp.where(ctx.alive[:, None, None],
+                                    pulled, hd.bottom()))
                 aae_bump = ctx.alive[:, None] & (pulled_ep > tgt_ep)
                 return (data2,
                         pruned & ~aae_bump[:, :, None],

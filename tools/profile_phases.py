@@ -13,6 +13,7 @@ findings in BENCH_NOTES.md.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import sys
 import time
@@ -126,14 +127,10 @@ def main(n: int) -> None:
           (mstate, jnp.int32(3)))
 
     # 3. manager step with heartbeat machinery off
-    cfg_nohb = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
-                      msg_words=16, partition_mode="groups",
-                      max_broadcasts=8, inbox_cap=16, emit_compact=32,
-                      timer_stagger=False,
-                      hyparview=HyParViewConfig(
-                          isolation_window_ms=25_000, heartbeat=False,
-                          auto_rejoin=False),
-                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    cfg_nohb = dataclasses.replace(
+        cfg, hyparview=HyParViewConfig(isolation_window_ms=25_000,
+                                       heartbeat=False,
+                                       auto_rejoin=False))
 
     def hv_quiet_nohb(c):
         st, rnd = c
@@ -161,13 +158,8 @@ def main(n: int) -> None:
     timed("pt step idle (gates skip)", pt_idle, (pstate, jnp.int32(3)))
 
     # 6. plumtree step, body active, AAE never firing
-    cfg_noaae = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
-                       msg_words=16, partition_mode="groups",
-                       max_broadcasts=8, inbox_cap=16, emit_compact=32,
-                       timer_stagger=False,
-                       hyparview=HyParViewConfig(isolation_window_ms=25_000),
-                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4,
-                                               aae=False))
+    cfg_noaae = dataclasses.replace(
+        cfg, plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4, aae=False))
 
     def pt_active_noaae(c):
         st, rnd = c
