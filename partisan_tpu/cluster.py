@@ -122,34 +122,69 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     want_shed = cfg.monotonic_shed and any(c.monotonic
                                            for c in cfg.channels)
     fast_wire = (interpose is None and not channels_mod.enabled(cfg)
-                 and cfg.resolved_partition_mode == "groups")
+                 and cfg.resolved_partition_mode == "groups"
+                 and not capture)
     if fast_wire:
-        kind_w = emitted[..., 0]
-        dst_w = emitted[..., 2]
-        backed = (comm.gather_vec(state.inbox.drops > 0)
-                  if want_shed else None)
-        info_d = faults_mod.pack_wire_info(state.faults, backed)[
-            jnp.clip(dst_w, 0, cfg.n_nodes - 1)]           # ONE gather
-        if want_shed:
-            # monotonic-channel shed (partisan_peer_socket.erl:108-129
-            # monotonic_should_send): the channel id is a static config
-            # constant per producer, so the tiny mono[ch] table lookup
-            # unrolls to fused equality tests
-            mono_m = jnp.zeros(kind_w.shape, jnp.bool_)
-            for i, c in enumerate(cfg.channels):
-                if c.monotonic:
-                    mono_m = mono_m | (emitted[..., 3] == i)
-            shed = mono_m & (((info_d >> 1) & 1) == 1) & (kind_w != 0)
-            kind_w = jnp.where(shed, 0, kind_w)
-        n_emitted = comm.allsum(jnp.sum(kind_w != 0, dtype=jnp.int32))
-        group_l = jax.lax.dynamic_slice(
-            state.faults.partition, (comm.node_offset,), (comm.n_local,))
-        cut = faults_mod.wire_cut_from_info(
-            state.faults, info_d, kind_w != 0, gids, dst_w,
-            alive_local, group_l, cfg.seed, state.rnd, _MSG_FILTER_TAG)
-        fault_dropped = (kind_w != 0) & cut
-        sent = emitted.at[..., 0].set(kind_w) if capture else emitted
-        emitted = emitted.at[..., 0].set(jnp.where(cut, 0, kind_w))
+        # Compaction runs FIRST here: code and runtime are priced per
+        # gathered scalar on this backend (tools/profile_phases.py /
+        # BENCH_NOTES r5), so shrinking the stack from E to
+        # emit_compact slots before the info gather + fault hash + kind
+        # writes cuts the whole wire stage proportionally.  Ordering
+        # note vs the generic path: a fault-cut message now still
+        # occupies a compacted slot — observable only when a node's
+        # live emissions exceed emit_compact in a faulted round (which
+        # drop counter carries the loss shifts; the delivered set under
+        # no overflow is identical).  The whole stage (compaction sort,
+        # gather, route) is skipped when no message was emitted
+        # anywhere — the quiet-round path.
+        kind_raw = emitted[..., 0]
+        n_raw = jnp.sum(kind_raw != 0, dtype=jnp.int32)
+        any_emit = comm.allsum(n_raw) > 0
+
+        def wire_body(_):
+            # compaction INSIDE the cond: a closed-over compacted stack
+            # would be a cond operand, computed on quiet rounds too
+            emc = exchange.compact_emissions(emitted, cfg.emit_compact) \
+                if cfg.emit_compact else emitted
+            kind_w = emc[..., 0]
+            dst_w = emc[..., 2]
+            backed = (comm.gather_vec(state.inbox.drops > 0)
+                      if want_shed else None)
+            info_d = faults_mod.pack_wire_info(state.faults, backed)[
+                jnp.clip(dst_w, 0, cfg.n_nodes - 1)]       # ONE gather
+            shed_n = jnp.int32(0)
+            if want_shed:
+                # monotonic-channel shed (partisan_peer_socket.erl
+                # :108-129 monotonic_should_send): the channel id is a
+                # static config constant per producer, so the tiny
+                # mono[ch] table lookup unrolls to fused equality tests
+                mono_m = jnp.zeros(kind_w.shape, jnp.bool_)
+                for i, c in enumerate(cfg.channels):
+                    if c.monotonic:
+                        mono_m = mono_m | (emc[..., 3] == i)
+                shed = mono_m & (((info_d >> 1) & 1) == 1) \
+                    & (kind_w != 0)
+                kind_w = jnp.where(shed, 0, kind_w)
+                shed_n = jnp.sum(shed, dtype=jnp.int32)
+            group_l = jax.lax.dynamic_slice(
+                state.faults.partition, (comm.node_offset,),
+                (comm.n_local,))
+            cut = faults_mod.wire_cut_from_info(
+                state.faults, info_d, kind_w != 0, gids, dst_w,
+                alive_local, group_l, cfg.seed, state.rnd,
+                _MSG_FILTER_TAG)
+            final = emc.at[..., 0].set(jnp.where(cut, 0, kind_w))
+            return comm.route(final), shed_n
+
+        def wire_skip(_):
+            return (exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
+                                         cfg.msg_words), jnp.int32(0))
+
+        inbox, shed_n = jax.lax.cond(any_emit, wire_body, wire_skip, 0)
+        # shed drops are excluded from the emitted count (same stance
+        # as the generic path); compaction/fault/overflow drops are
+        # counted emitted and surface via the emitted-delivered delta
+        n_emitted = comm.allsum(n_raw - shed_n)
     else:
         # Monotonic-channel load shedding: sends on a monotonic channel
         # to a receiver whose inbox overflowed LAST round are dropped —
@@ -194,23 +229,23 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
         fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
 
-    # The whole exchange (compaction sort + route) is skipped when NO
-    # message survived to the wire anywhere — common once the managers'
-    # quiet-gates leave rounds without traffic.  Cross-shard predicate:
-    # route contains collectives.
-    any_emit = comm.allsum(jnp.sum(emitted[..., 0] != 0,
-                                   dtype=jnp.int32)) > 0
+        # The exchange (compaction sort + route) is skipped when NO
+        # message survived to the wire anywhere — common once the
+        # managers' quiet-gates leave rounds without traffic.
+        # Cross-shard predicate: route contains collectives.
+        any_emit = comm.allsum(jnp.sum(emitted[..., 0] != 0,
+                                       dtype=jnp.int32)) > 0
 
-    def route_body(_):
-        e = exchange.compact_emissions(emitted, cfg.emit_compact) \
-            if cfg.emit_compact else emitted
-        return comm.route(e)
+        def route_body(_):
+            e = exchange.compact_emissions(emitted, cfg.emit_compact) \
+                if cfg.emit_compact else emitted
+            return comm.route(e)
 
-    def route_skip(_):
-        return exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
-                                    cfg.msg_words)
+        def route_skip(_):
+            return exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
+                                        cfg.msg_words)
 
-    inbox = jax.lax.cond(any_emit, route_body, route_skip, 0)
+        inbox = jax.lax.cond(any_emit, route_body, route_skip, 0)
     # Crash-stopped receivers drop everything addressed to them.
     dead = ~alive_local
     inbox = exchange.Inbox(

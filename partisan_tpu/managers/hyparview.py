@@ -107,8 +107,9 @@ _TAG_HBSEED = 322
 _TAG_HBJIT = 323
 _TAG_DPROBE = 324
 _TAG_HBFALL = 325
-_TAG_FJWALK = 330     # +hop (in-round forward_join walk, < arwl hops)
-_TAG_SHWALK = 340     # +hop (in-round shuffle walk)
+_TAG_FJWALK = 330     # in-round forward_join walk (hop index rides the
+#                       rank32 element coordinate: h*A + slot)
+_TAG_SHWALK = 340     # in-round shuffle walk (same hop-coordinate form)
 
 
 def link_cost(seed: int, a, b):
@@ -216,10 +217,23 @@ class HyParView:
         # next candidate (:1619-1746); eager purging collapses that retry
         # loop into one round.
         reachable = ctx.faults.alive & ~comm.gather_vec(state.left)
-        active = jax.vmap(views.keep_only, in_axes=(0, None))(
-            state.active, reachable)
-        passive_in = jax.vmap(views.keep_only, in_axes=(0, None))(
-            state.passive, reachable)
+        # The prune gathers reachable[id] per view slot — per-scalar
+        # gather cost in both runtime and generated code on this
+        # backend — but it is the IDENTITY while every node is
+        # reachable, so it runs under a cond on "anyone unreachable".
+        # The predicate reads replicated global state (alive and the
+        # gathered left mask), so every shard takes the same branch
+        # without a collective, and the branches contain none.
+        unreach = jnp.any(~reachable)
+
+        def prune(_):
+            return (jax.vmap(views.keep_only, in_axes=(0, None))(
+                        state.active, reachable),
+                    jax.vmap(views.keep_only, in_axes=(0, None))(
+                        state.passive, reachable))
+
+        active, passive_in = jax.lax.cond(
+            unreach, prune, lambda _: (state.active, state.passive), 0)
 
         active0, passive0 = active, passive_in
         me2 = gids[:, None]                                   # [n, 1]
@@ -649,20 +663,26 @@ class HyParView:
                 glob_act = comm.gather_vec(active0)        # [n_glob, A]
                 glob_asz = comm.gather_vec(asize0)         # [n_glob]
                 jb = jnp.broadcast_to(joiner[:, None], fj_tgt.shape)
-                curf = fj_tgt                              # [n, A] walkers
-                prevf = me2b
-                stopped = curf < 0
-                endpoint = jnp.full_like(curf, -1)
-                depnode = jnp.full_like(curf, -1)
-                for h in range(hv.arwl):
+
+                # One fori_loop hop body instead of an arwl-times
+                # unrolled trace: the walk's [n, A, A] gather + rank +
+                # argmax is the largest single block of the round
+                # program, and unrolling it 6x made the serialized
+                # 100k executable (and its per-process persistent-cache
+                # load, which dominates warm bootstrap) ~2x bigger.
+                # The hop index rides the rank32 ELEMENT coordinate
+                # (h*A + slot) instead of a per-hop tag — same
+                # independence guarantees, loop-carried tag.
+                def hop(h, carry):
+                    curf, prevf, stopped, endpoint, depnode = carry
                     cc = jnp.clip(curf, 0, comm.n_global - 1)
                     vc = glob_act[cc]                      # [n, A, A]
                     j_in = jnp.any((vc == jb[:, :, None]) & (vc >= 0),
                                    axis=2)
                     small = glob_asz[cc] <= 1
-                    r = ranked(_TAG_FJWALK + h, gids[:, None, None],
+                    r = ranked(_TAG_FJWALK, gids[:, None, None],
                                arangeA[None, :, None],
-                               arangeA[None, None, :])
+                               h * A + arangeA[None, None, :])
                     okm = (vc >= 0) & (vc != jb[:, :, None]) \
                         & (vc != prevf[:, :, None]) \
                         & (vc != curf[:, :, None])
@@ -674,14 +694,22 @@ class HyParView:
                     live_w = (curf >= 0) & ~stopped
                     stop_here = live_w & (small | j_in | ~has_nxt)
                     endpoint = jnp.where(stop_here, curf, endpoint)
-                    if h == hv.arwl - hv.prwl:
-                        # deposit at the receiver whose incoming TTL
-                        # would have been PRWL, iff the walk continues
-                        depnode = jnp.where(live_w & ~stop_here, curf,
-                                            depnode)
+                    # deposit at the receiver whose incoming TTL would
+                    # have been PRWL, iff the walk continues
+                    dep_h = h == hv.arwl - hv.prwl
+                    depnode = jnp.where(dep_h & live_w & ~stop_here,
+                                        curf, depnode)
                     stopped = stopped | stop_here
                     prevf = jnp.where(live_w & ~stop_here, curf, prevf)
                     curf = jnp.where(live_w & ~stop_here, nxt, curf)
+                    return curf, prevf, stopped, endpoint, depnode
+
+                curf, _prevf, stopped, endpoint, depnode = \
+                    jax.lax.fori_loop(
+                        0, hv.arwl, hop,
+                        (fj_tgt, me2b, fj_tgt < 0,
+                         jnp.full_like(fj_tgt, -1),
+                         jnp.full_like(fj_tgt, -1)))
                 endpoint = jnp.where(stopped, endpoint, curf)  # TTL out
                 jb2 = jnp.broadcast_to(joiner[:, None], fj_tgt.shape)
                 return (msg_ops.build(
@@ -775,21 +803,26 @@ class HyParView:
             arangeA = jnp.arange(A, dtype=jnp.int32)
             glob_act = comm.gather_vec(active0)                # [n_g, A]
             sh_tgt = row_ranked(active0, _TAG_SHTGT, 1)[:, 0]
-            curs = sh_tgt
-            prevs = gids
-            for h in range(hv.arwl - 1):
+
+            # fori_loop hop body (same program-size reasoning as the
+            # forward-join walk; hop index rides the rank32 coordinate)
+            def sh_hop(h, carry):
+                curs, prevs = carry
                 cc = jnp.clip(curs, 0, comm.n_global - 1)
                 vc = glob_act[cc]                              # [n, A]
-                r = ranked(_TAG_SHWALK + h, gids[:, None],
-                           arangeA[None, :])
+                r = ranked(_TAG_SHWALK, gids[:, None],
+                           h * A + arangeA[None, :])
                 okm = (vc >= 0) & (vc != gids[:, None]) \
                     & (vc != prevs[:, None]) & (vc != curs[:, None])
                 sc = jnp.where(okm, r | jnp.uint32(1), jnp.uint32(0))
                 bi = jnp.argmax(sc, axis=1)
                 nxt = jnp.take_along_axis(vc, bi[:, None], axis=1)[:, 0]
                 ok = (curs >= 0) & (jnp.max(sc, axis=1) > 0)
-                prevs = jnp.where(ok, curs, prevs)
-                curs = jnp.where(ok, nxt, curs)
+                return (jnp.where(ok, nxt, curs),
+                        jnp.where(ok, curs, prevs))
+
+            curs, _prevs = jax.lax.fori_loop(0, hv.arwl - 1, sh_hop,
+                                             (sh_tgt, gids))
             smp = jnp.concatenate([
                 row_ranked(active0, _TAG_SHSAMP_A, hv.shuffle_k_active),
                 row_ranked(passive0, _TAG_SHSAMP_P,

@@ -322,9 +322,12 @@ class Plumtree:
                     axis=1),
                 hd.bottom()))                                  # [n, cap, PW]
             stale_g = stale_g | (is_g & ~win & hd.leq(pay, after_win))
-            mr_win = jnp.where(got, jnp.take_along_axis(mr, chosen_c, axis=1), -1)
-            src_win = jnp.where(got, jnp.take_along_axis(src, chosen_c, axis=1),
-                                -1)
+            # the winner's (hop count, sender) in ONE packed take
+            ms_win = jnp.take_along_axis(
+                jnp.stack([mr, src], axis=-1), chosen_c[:, :, None],
+                axis=1)                                     # [n, B, 2]
+            mr_win = jnp.where(got, ms_win[..., 0], -1)
+            src_win = jnp.where(got, ms_win[..., 1], -1)
             data = hd.join(data, joined_in)
             rr = jnp.where(fresh_any, mr_win + 1, rr)
             npu = npu | fresh_any
@@ -366,17 +369,23 @@ class Plumtree:
             rows = jnp.arange(n_local)[:, None]
             pruned_sel = pruned[rows, sel]                          # [n, S, K]
             live_k = (nbrs >= 0)[:, None, :]                        # [n, 1, K]
-            psrc_sel = psrc[rows, sel]                              # [n, S]
+            # post-merge (store, rround, epoch, push_src) in ONE packed
+            # gather — the lazy flush below reuses the same pack
+            post = jnp.concatenate(
+                [data, rr[:, :, None], tgt_ep[:, :, None],
+                 psrc[:, :, None]], axis=-1)                # [n, B, PW+3]
+            post_sel = post[rows, sel]                      # [n, S, PW+3]
+            psrc_sel = post_sel[..., PW + 2]                # [n, S]
             eager = live_k & ~pruned_sel & (nbrs[:, None, :]
                                             != psrc_sel[:, :, None])
             dst = jnp.where(sel_ok[:, :, None] & eager, nbrs[:, None, :], -1)
-            data_sel = data[rows, sel]                              # [n, S, PW]
+            data_sel = post_sel[..., :PW]                   # [n, S, PW]
             push_msgs = msg_ops.build(
                 W, T.MsgKind.PT_GOSSIP, gids[:, None, None], dst, channel=CH,
                 payload=(sel[:, :, None],
                          *(w[:, :, None] for w in jnp.unstack(data_sel, axis=-1)),
-                         rr[rows, sel][:, :, None],
-                         tgt_ep[rows, sel][:, :, None]),
+                         post_sel[..., PW][:, :, None],
+                         post_sel[..., PW + 1][:, :, None]),
             ).reshape(n_local, S * K, W)
             lazy_new = sel_ok[:, :, None] & live_k & pruned_sel     # [n, S, K]
             oh_sel = (sel[:, :, None] == jnp.arange(B)[None, None, :])
@@ -393,13 +402,14 @@ class Plumtree:
                               B * K - jnp.arange(B * K)[None, :], 0)
             lv, li = jax.lax.top_k(lprio, L)                         # [n, L]
             bi, kix = li // K, li % K
-            adv = jnp.take_along_axis(data, bi[:, :, None], axis=1)  # [n, L, PW]
+            adv_pack = jnp.take_along_axis(post, bi[:, :, None],
+                                           axis=1)       # [n, L, PW+3]
             ihave_msgs = msg_ops.build(
                 W, T.MsgKind.PT_IHAVE, gids[:, None],
                 jnp.where(lv > 0, nbrs[rows, kix], -1), channel=CH,
-                payload=(bi, *jnp.unstack(adv, axis=-1),
+                payload=(bi, *jnp.unstack(adv_pack[..., :PW], axis=-1),
                          jnp.zeros_like(bi),
-                         jnp.take_along_axis(tgt_ep, bi, axis=1)))
+                         adv_pack[..., PW + 1]))
 
             return (data, rr, pruned, lazyp, npu, psrc, tgt_ep, nonmono,
                     jnp.concatenate([replies, push_msgs, ihave_msgs],
