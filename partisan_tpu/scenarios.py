@@ -151,11 +151,21 @@ def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
     ``upper_wave_factor`` — wide factor-8 join storms measured 6-14
     disconnected components at 100k boot end under aligned timers,
     and the stragglers' slow rejoins cost more than the saved waves.
+    Factor 4 upper waves re-measured at 100k (r5-late, post
+    walk-stream change): 3 components and 2x convergence rounds —
+    the envelope holds; keep 2.
     The widths themselves only change where the inert high rows live
     (ids are global, per-node hash-RNG streams are id-keyed)."""
     rng = np.random.default_rng(7)
     if widths is None:
-        widths = [w for w in (4096, 32_768) if w < n] + [n]
+        # ONE sub-full-width rung: every rung is a separate XLA program
+        # whose per-process load (~1-1.5 MB/s through the relay) the
+        # bootstrap pays before its first wave — the [4096, 32768]
+        # two-rung ladder spent ~20 s loading ~31 MB of small-rung
+        # programs to save ~6 s of full-width waves.  An 8k rung keeps
+        # the early factor-8 storm off the full-width program at ~1/7
+        # the load bytes of the 32k rung.
+        widths = [w for w in (8192,) if w < n] + [n]
     st, cl, prev_w, base = None, None, None, 1
     for w in widths:
         cl = make_cluster(w)
@@ -537,8 +547,7 @@ def config5_causal_crash(n=100_000, senders=64, crashes=16,
     def make_cluster(width):
         return cl if width == n else Cluster(make_cfg(width), model=stack)
 
-    _, st = _boot_ladder(make_cluster, n,
-                         widths=[w for w in (4096, 32_768) if w < n] + [n])
+    _, st = _boot_ladder(make_cluster, n)
     start = int(st.rnd)
 
     # Cast: senders, receivers and crash victims, all disjoint, senders
