@@ -42,7 +42,7 @@ TIME_BUDGET_S = 560.0          # hard self-imposed wall budget
 PER_SIZE_CAP_S = 340.0         # no single rung may eat the whole budget
 
 
-def run(n: int, verbose: bool = False) -> dict:
+def run(n: int, verbose: bool = False, metrics: bool = False) -> dict:
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config, HyParViewConfig, \
         PlumtreeConfig
@@ -93,6 +93,10 @@ def run(n: int, verbose: bool = False) -> dict:
                       msg_words=16, partition_mode="groups",
                       max_broadcasts=8, inbox_cap=16, emit_compact=32,
                       timer_stagger=False,
+                      # opt-in metrics plane (--metrics): the counter
+                      # ring rides the scan carry; series go to STDERR
+                      # only — the stdout JSON contract is unchanged
+                      metrics=metrics, metrics_ring=256,
                       hyparview=HyParViewConfig(
                           isolation_window_ms=25_000),
                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
@@ -218,6 +222,18 @@ def run(n: int, verbose: bool = False) -> dict:
               "dropped": int(st.stats.dropped),
               "emitted": int(st.stats.emitted),
               "phases": phases}
+    if metrics:
+        # Per-round series (the most recent metrics_ring rounds) to
+        # stderr as JSON lines; stdout keeps the one-line contract.
+        from partisan_tpu import metrics as metrics_mod
+
+        snap = metrics_mod.snapshot(st.metrics)
+        names = tuple(c.name for c in cfg.channels)
+        for row in metrics_mod.rows(snap, channels=names):
+            print(json.dumps({"kind": "metrics", "n": n, **row}),
+                  file=sys.stderr)
+        print(json.dumps({"kind": "metrics_totals", "n": n,
+                          **metrics_mod.totals(snap)}), file=sys.stderr)
     if verbose:
         print(f"n={n}: {rps:.1f} rounds/s, broadcast converged in "
               f"{conv_rounds} rounds ({phases['converge']:.1f}s wall), "
@@ -332,7 +348,8 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--one":
-        r = run(int(sys.argv[2]), verbose=True)
+        r = run(int(sys.argv[2]), verbose=True,
+                metrics="--metrics" in sys.argv)
         print(json.dumps({"size_phases": {str(r["n"]): r["phases"]}}),
               file=sys.stderr)
         print(json.dumps(r))

@@ -109,6 +109,17 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array
     return OutboxState(data=new_data, shed=ob.shed + shed), out
 
 
+def shed_delta(before: OutboxState, after: OutboxState) -> Array:
+    """int32: sends SHED at the outbox cut site this round (the
+    cause-tagged accounting the metrics plane records as
+    ``outbox_shed``).  ``shed`` is cumulative and already
+    ``comm.allsum``-reduced inside :func:`throttle`, so the delta is
+    replicated under sharding.  Deferred-but-kept sends are NOT drops —
+    they deliver later and surface as the metrics plane's transient
+    ``other`` residual."""
+    return after.shed - before.shed
+
+
 def fully_connected(cfg: Config, alive: Array) -> Array:
     """bool[n, n]: every configured lane of every channel between i and
     j is up.  In the tensor transport, lanes have no setup phase — the

@@ -30,6 +30,7 @@ from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import managers as managers_mod
+from partisan_tpu import metrics as metrics_mod
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
@@ -57,6 +58,8 @@ class ClusterState(NamedTuple):
     interpose: Any = ()     # interposition-chain state (or () if none)
     outbox: Any = ()        # channels.OutboxState (or () if capacity
     #                         enforcement is off)
+    metrics: Any = ()       # metrics.MetricsState ring (or () when
+    #                         Config.metrics is off — zero cost)
 
 
 class TraceRound(NamedTuple):
@@ -76,6 +79,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     single device (LocalComm) or per shard inside shard_map (ShardComm).
     Sharing this body is what guarantees single-device and sharded runs
     evolve identically (tests/test_sharded.py)."""
+    mx = metrics_mod.enabled(cfg)   # static: specializes the trace
     gids = comm.local_ids()
     keys = rng.node_keys(cfg.seed, state.rnd, gids)
     alive_local = jax.lax.dynamic_slice(
@@ -83,11 +87,17 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
                    inbox=state.inbox, faults=state.faults)
 
-    mstate, m_emit = manager.step(cfg, comm, state.manager, ctx)
+    # jax.named_scope labels each phase in the HLO, so profiler traces
+    # (tools/profile_round.py under jax.profiler) map to round phases.
+    with jax.named_scope("round.manager"):
+        mstate, m_emit = manager.step(cfg, comm, state.manager, ctx)
+    nbrs = None
     if model is not None:
-        nbrs = manager.neighbors(cfg, mstate, comm)
-        dstate_model, a_emit = model.step(cfg, comm, state.model, ctx, nbrs)
-        emitted = jnp.concatenate([m_emit, a_emit], axis=1)
+        with jax.named_scope("round.model"):
+            nbrs = manager.neighbors(cfg, mstate, comm)
+            dstate_model, a_emit = model.step(cfg, comm, state.model,
+                                              ctx, nbrs)
+            emitted = jnp.concatenate([m_emit, a_emit], axis=1)
     else:
         dstate_model, emitted = (), m_emit
 
@@ -95,8 +105,9 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     # clock stamping (pulls causal messages onto their wide side lanes).
     dstate, wides = state.delivery, ()
     if delivery_mod.enabled(cfg):
-        dstate, emitted, wides = delivery_mod.outbound(
-            cfg, comm, dstate, emitted, ctx)
+        with jax.named_scope("round.delivery_outbound"):
+            dstate, emitted, wides = delivery_mod.outbound(
+                cfg, comm, dstate, emitted, ctx)
 
     # ---- the wire stage: monotonic shed -> interposition -> emission
     # count -> channel throttling -> fault masks.  Two implementations:
@@ -144,47 +155,88 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         def wire_body(_):
             # compaction INSIDE the cond: a closed-over compacted stack
             # would be a cond operand, computed on quiet rounds too
-            emc = exchange.compact_emissions(emitted, cfg.emit_compact) \
-                if cfg.emit_compact else emitted
-            kind_w = emc[..., 0]
-            dst_w = emc[..., 2]
-            backed = (comm.gather_vec(state.inbox.drops > 0)
-                      if want_shed else None)
-            info_d = faults_mod.pack_wire_info(state.faults, backed)[
-                jnp.clip(dst_w, 0, cfg.n_nodes - 1)]       # ONE gather
-            shed_n = jnp.int32(0)
-            if want_shed:
-                # monotonic-channel shed (partisan_peer_socket.erl
-                # :108-129 monotonic_should_send): the channel id is a
-                # static config constant per producer, so the tiny
-                # mono[ch] table lookup unrolls to fused equality tests
-                mono_m = jnp.zeros(kind_w.shape, jnp.bool_)
-                for i, c in enumerate(cfg.channels):
-                    if c.monotonic:
-                        mono_m = mono_m | (emc[..., 3] == i)
-                shed = mono_m & (((info_d >> 1) & 1) == 1) \
-                    & (kind_w != 0)
-                kind_w = jnp.where(shed, 0, kind_w)
-                shed_n = jnp.sum(shed, dtype=jnp.int32)
-            group_l = jax.lax.dynamic_slice(
-                state.faults.partition, (comm.node_offset,),
-                (comm.n_local,))
-            cut = faults_mod.wire_cut_from_info(
-                state.faults, info_d, kind_w != 0, gids, dst_w,
-                alive_local, group_l, cfg.seed, state.rnd,
-                _MSG_FILTER_TAG)
-            final = emc.at[..., 0].set(jnp.where(cut, 0, kind_w))
-            return comm.route(final), shed_n
+            with jax.named_scope("round.wire_fast"):
+                emc = exchange.compact_emissions(emitted, cfg.emit_compact) \
+                    if cfg.emit_compact else emitted
+                kind_w = emc[..., 0]
+                dst_w = emc[..., 2]
+                backed = (comm.gather_vec(state.inbox.drops > 0)
+                          if want_shed else None)
+                info_d = faults_mod.pack_wire_info(state.faults, backed)[
+                    jnp.clip(dst_w, 0, cfg.n_nodes - 1)]       # ONE gather
+                shed_n = jnp.int32(0)
+                shed_m = None
+                if want_shed:
+                    # monotonic-channel shed (partisan_peer_socket.erl
+                    # :108-129 monotonic_should_send): the channel id is a
+                    # static config constant per producer, so the tiny
+                    # mono[ch] table lookup unrolls to fused equality tests
+                    mono_m = jnp.zeros(kind_w.shape, jnp.bool_)
+                    for i, c in enumerate(cfg.channels):
+                        if c.monotonic:
+                            mono_m = mono_m | (emc[..., 3] == i)
+                    shed = mono_m & (((info_d >> 1) & 1) == 1) \
+                        & (kind_w != 0)
+                    kind_w = jnp.where(shed, 0, kind_w)
+                    shed_n = jnp.sum(shed, dtype=jnp.int32)
+                    shed_m = shed
+                group_l = jax.lax.dynamic_slice(
+                    state.faults.partition, (comm.node_offset,),
+                    (comm.n_local,))
+                cut = faults_mod.wire_cut_from_info(
+                    state.faults, info_d, kind_w != 0, gids, dst_w,
+                    alive_local, group_l, cfg.seed, state.rnd,
+                    _MSG_FILTER_TAG)
+                final = emc.at[..., 0].set(jnp.where(cut, 0, kind_w))
+                out = (comm.route(final), shed_n)
+                if mx:
+                    # cause counters for the metrics ring (shard-local;
+                    # reduced outside the cond): fault cuts, and the
+                    # per-channel shed so emitted-per-channel can be
+                    # derived from the pre-wire stack
+                    fault_n = jnp.sum(cut & (kind_w != 0),
+                                      dtype=jnp.int32)
+                    # emc's kind word is still pre-shed here, so the
+                    # masked count sees the shed slots as live
+                    shed_ch = (metrics_mod.channel_counts(
+                        cfg, emc, mask=shed_m) if shed_m is not None
+                        else jnp.zeros((cfg.n_channels,), jnp.int32))
+                    out += (fault_n, shed_ch)
+                return out
 
         def wire_skip(_):
-            return (exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
-                                         cfg.msg_words), jnp.int32(0))
+            out = (exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
+                                        cfg.msg_words), jnp.int32(0))
+            if mx:
+                out += (jnp.int32(0),
+                        jnp.zeros((cfg.n_channels,), jnp.int32))
+            return out
 
-        inbox, shed_n = jax.lax.cond(any_emit, wire_body, wire_skip, 0)
+        wire_out = jax.lax.cond(any_emit, wire_body, wire_skip, 0)
+        inbox, shed_n = wire_out[0], wire_out[1]
         # shed drops are excluded from the emitted count (same stance
         # as the generic path); compaction/fault/overflow drops are
         # counted emitted and surface via the emitted-delivered delta
         n_emitted = comm.allsum(n_raw - shed_n)
+        if mx:
+            m_fault = comm.allsum(wire_out[2])
+            m_shed = comm.allsum(shed_n)
+            m_outbox = jnp.int32(0)    # no channel-capacity stage here
+            # per-channel emissions = pre-wire stack minus per-channel
+            # sheds (the only exclusion the fast path applies before
+            # the emitted count)
+            emit_ch = comm.allsum(
+                metrics_mod.channel_counts(cfg, emitted) - wire_out[3])
+            # compaction overflow: the fast path compacts the PRE-shed
+            # stack, so the per-row loss is live-beyond-cap on `emitted`
+            # (zero on quiet rounds: nothing live anywhere)
+            if cfg.emit_compact:
+                live_row = jnp.sum(kind_raw != 0, axis=1,
+                                   dtype=jnp.int32)
+                m_compact = comm.allsum(jnp.sum(jnp.maximum(
+                    live_row - cfg.emit_compact, 0), dtype=jnp.int32))
+            else:
+                m_compact = jnp.int32(0)
     else:
         # Monotonic-channel load shedding: sends on a monotonic channel
         # to a receiver whose inbox overflowed LAST round are dropped —
@@ -192,6 +244,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         # is safe (partisan_peer_socket.erl:108-129
         # monotonic_should_send; the only drop path the reference's
         # transport permits).
+        m_shed_local = jnp.int32(0)
         if want_shed:
             mono = jnp.asarray([c.monotonic for c in cfg.channels],
                                jnp.bool_)
@@ -201,17 +254,25 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             shed = mono[ch] & backed[dstv] & (emitted[..., 0] != 0)
             emitted = emitted.at[..., 0].set(
                 jnp.where(shed, 0, emitted[..., 0]))
+            if mx:
+                m_shed_local = jnp.sum(shed, dtype=jnp.int32)
 
         # Interposition chain (test plane): drop/rewrite/delay
         # transforms on the send path, before the stochastic fault
         # stage (mirrors the reference's interposition-before-wire
         # placement, :58-130).
         if interpose is not None:
-            istate, emitted = interpose.apply(cfg, comm, istate, emitted,
-                                              ctx)
+            with jax.named_scope("round.interpose"):
+                istate, emitted = interpose.apply(cfg, comm, istate,
+                                                  emitted, ctx)
 
         n_emitted = comm.allsum(jnp.sum(emitted[..., 0] != 0,
                                         dtype=jnp.int32))
+        if mx:
+            m_shed = comm.allsum(m_shed_local)
+            # per-channel emissions, counted exactly where the scalar
+            # emitted count is (post-shed, post-interposition)
+            emit_ch = comm.allsum(metrics_mod.channel_counts(cfg, emitted))
 
         # Channel-capacity stage (opt-in): per-(edge, channel, lane)
         # throughput enforcement with outbox backpressure.  Runs after
@@ -219,15 +280,33 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         # emitted) and before the fault stage (a deferred send rides
         # the wire — and its faults — the round it actually transmits).
         if channels_mod.enabled(cfg):
-            obstate, emitted = channels_mod.throttle(cfg, comm, obstate,
-                                                     emitted)
+            with jax.named_scope("round.throttle"):
+                obstate, emitted = channels_mod.throttle(cfg, comm,
+                                                         obstate, emitted)
+        if mx:
+            m_outbox = (channels_mod.shed_delta(state.outbox, obstate)
+                        if channels_mod.enabled(cfg) else jnp.int32(0))
 
         # Fault stage: crash/partition/omission masks between emit and
         # deliver.
-        sent = emitted
-        emitted = faults_mod.filter_msgs(
-            state.faults, emitted, cfg.seed, state.rnd, _MSG_FILTER_TAG)
-        fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
+        with jax.named_scope("round.fault"):
+            sent = emitted
+            emitted = faults_mod.filter_msgs(
+                state.faults, emitted, cfg.seed, state.rnd,
+                _MSG_FILTER_TAG)
+            fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
+        if mx:
+            m_fault = comm.allsum(jnp.sum(fault_dropped, dtype=jnp.int32))
+            # compaction here runs AFTER the fault stage (route_body
+            # compacts the post-fault stack), so the loss is
+            # live-beyond-cap on the post-fault rows
+            if cfg.emit_compact:
+                live_row = jnp.sum(emitted[..., 0] != 0, axis=1,
+                                   dtype=jnp.int32)
+                m_compact = comm.allsum(jnp.sum(jnp.maximum(
+                    live_row - cfg.emit_compact, 0), dtype=jnp.int32))
+            else:
+                m_compact = jnp.int32(0)
 
         # The exchange (compaction sort + route) is skipped when NO
         # message survived to the wire anywhere — common once the
@@ -237,9 +316,10 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                                        dtype=jnp.int32)) > 0
 
         def route_body(_):
-            e = exchange.compact_emissions(emitted, cfg.emit_compact) \
-                if cfg.emit_compact else emitted
-            return comm.route(e)
+            with jax.named_scope("round.route"):
+                e = exchange.compact_emissions(emitted, cfg.emit_compact) \
+                    if cfg.emit_compact else emitted
+                return comm.route(e)
 
         def route_skip(_):
             return exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
@@ -248,12 +328,24 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         inbox = jax.lax.cond(any_emit, route_body, route_skip, 0)
     # Crash-stopped receivers drop everything addressed to them.
     dead = ~alive_local
+    if mx:
+        # Inbox-overflow drops (route's counts-beyond-cap) are read
+        # BEFORE the dead-receiver stage folds its own loss into the
+        # same drops field — the two are distinct causes in the ring.
+        m_inbox_of = comm.allsum(jnp.sum(inbox.drops, dtype=jnp.int32))
+        m_dead = comm.allsum(jnp.sum(
+            jnp.where(dead, inbox.count, 0), dtype=jnp.int32))
     inbox = exchange.Inbox(
         data=jnp.where(dead[:, None, None], 0, inbox.data),
         count=jnp.where(dead, 0, inbox.count),
         drops=inbox.drops + jnp.where(dead, inbox.count, 0),
     )
     ev_delivered = comm.allsum(jnp.sum(inbox.count, dtype=jnp.int32))
+    if mx:
+        # Event-lane deliveries per channel, counted before the causal
+        # merge (causal deliveries are their own series — no channel).
+        deliver_ch = comm.allsum(
+            metrics_mod.channel_counts(cfg, inbox.data))
 
     causal_delivered = jnp.int32(0)
     if delivery_mod.needs_inbound(cfg):
@@ -261,8 +353,9 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         # bounded actor block itself, applies per-receiver transmission
         # faults, and suppresses dead receivers internally.  P2p causal
         # lanes ride route() and are re-ordered out of the inbox here.
-        dstate, inbox, causal_delivered = delivery_mod.inbound(
-            cfg, comm, dstate, inbox, wides, ctx)
+        with jax.named_scope("round.delivery_inbound"):
+            dstate, inbox, causal_delivered = delivery_mod.inbound(
+                cfg, comm, dstate, inbox, wides, ctx)
 
     # `dropped` tracks the event lane only: a causal broadcast is one
     # emission with up-to-n deliveries, so it gets its own counter.
@@ -271,10 +364,33 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         delivered=state.stats.delivered + ev_delivered + causal_delivered,
         dropped=state.stats.dropped + (n_emitted - ev_delivered),
     )
+    mets = state.metrics
+    if mx:
+        with jax.named_scope("round.metrics"):
+            # The residual cause closes the books by construction:
+            # sum(drops) == this round's legacy dropped delta exactly.
+            # It absorbs what round_body cannot see directly (a2a quota
+            # sheds inside the sharded exchange; channel-capacity
+            # defer/release churn, which makes it transiently negative).
+            m_other = (n_emitted - ev_delivered) - (
+                m_compact + m_fault + m_inbox_of + m_dead + m_outbox)
+            drops_vec = jnp.stack([m_compact, m_fault, m_inbox_of,
+                                   m_dead, m_outbox, m_other])
+            dlv_of = (delivery_mod.overflow_total(dstate)
+                      - delivery_mod.overflow_total(state.delivery))
+            nbrs_m = nbrs if nbrs is not None \
+                else manager.neighbors(cfg, mstate, comm)
+            mets = metrics_mod.record_round(
+                cfg, comm, state.metrics, rnd=state.rnd,
+                emitted_ch=emit_ch, delivered_ch=deliver_ch,
+                causal=causal_delivered, shed=m_shed, drops=drops_vec,
+                inbox_count=inbox.count, alive_local=alive_local,
+                alive_global=state.faults.alive, nbrs=nbrs_m,
+                dlv_overflow=dlv_of)
     out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
                        inbox=inbox, manager=mstate, model=dstate_model,
                        delivery=dstate, stats=stats, interpose=istate,
-                       outbox=obstate)
+                       outbox=obstate, metrics=mets)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent,
                                dropped=fault_dropped)
@@ -353,6 +469,8 @@ class Cluster:
                        if self.interpose is not None else ()),
             outbox=(channels_mod.init(cfg, comm)
                     if channels_mod.enabled(cfg) else ()),
+            metrics=(metrics_mod.init(cfg, comm)
+                     if metrics_mod.enabled(cfg) else ()),
         )
 
     # ---- the round ----------------------------------------------------

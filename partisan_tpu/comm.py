@@ -62,6 +62,11 @@ class LocalComm:
         """Sum a per-shard scalar across all shards (identity here)."""
         return x
 
+    def allmax(self, x: Array) -> Array:
+        """Max of a per-shard scalar across all shards (identity here;
+        the metrics plane's high-water-mark reduction)."""
+        return x
+
     def actor_gather(self, x: Array, a: int) -> Array:
         """Rows of ``x`` for global nodes 0..a-1 (the causal actor
         space), visible to every shard.  Requires a <= n_local so the

@@ -305,6 +305,16 @@ class Config:
     #                               masking off the round's hot path when
     #                               no model emits on monotonic channels
 
+    # --- metrics plane (metrics.py) ------------------------------------
+    metrics: bool = False        # accumulate the per-round / per-channel
+    #                              / per-cause counter ring inside the
+    #                              jitted round (device-resident, zero
+    #                              host syncs); off = the ClusterState
+    #                              leaf is an empty () pytree — no cost
+    metrics_ring: int = 128      # rounds of history kept (ring buffer;
+    #                              slot = rnd % ring, so long runs keep
+    #                              the most recent window)
+
     # --- test plane ----------------------------------------------------
     replaying: bool = False
     shrinking: bool = False
@@ -327,6 +337,9 @@ class Config:
             raise ValueError(
                 f"partition_mode {self.partition_mode!r} not in "
                 f"('auto', 'dense', 'groups')")
+        if self.metrics_ring < 1:
+            raise ValueError(
+                f"metrics_ring must be >= 1, got {self.metrics_ring}")
         if self.distance.model not in ("ring", "hash"):
             raise ValueError(
                 f"distance.model {self.distance.model!r} not in "

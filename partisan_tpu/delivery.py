@@ -1109,3 +1109,26 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
 
     return st._replace(lanes=tuple(lanes_out), p2p=tuple(p2p_out)), \
         inbox, n_causal
+
+
+# ---------------------------------------------------------------------------
+# Metrics-plane accounting
+# ---------------------------------------------------------------------------
+
+def overflow_total(st) -> Array:
+    """int32: every cumulative drop counter of the delivery plane summed
+    — ack-store overflow, causal-lane emit/buffer overflow, p2p
+    overflow + aborted records, invalid-causal sheds.  Each summand is
+    ``comm.allsum``-maintained, so the total is replicated; the metrics
+    plane records its per-round delta as the ``dlv_overflow`` series.
+    Accepts ``()`` (delivery disabled) and returns 0."""
+    if st == ():
+        return jnp.int32(0)
+    total = st.invalid_causal
+    if st.ack != ():
+        total = total + st.ack.overflow
+    for lane in st.lanes:
+        total = total + lane.overflow
+    for lane in st.p2p:
+        total = total + lane.overflow + lane.aborted
+    return total

@@ -140,6 +140,32 @@ def filter_msgs(faults: FaultState, emitted: Array, seed: int, rnd: Array,
 # Partition group labels must fit the packed word below: they are
 # partition indices (a handful per scenario), far under 2^29.
 _GROUP_BITS_MASK = 0x1FFFFFFF
+GROUP_LABEL_MAX = _GROUP_BITS_MASK   # 29 unsigned bits
+
+
+def check_group_labels(partition: Array) -> None:
+    """Host-side validation that groups-mode partition labels fit the
+    29 unsigned bits ``pack_wire_info`` packs them into.  A label
+    outside [0, 2^29) would silently alias groups in the packed word
+    and make ``wire_cut_from_info`` disagree with ``edge_cut`` —
+    breaking the fast path's bit-parity contract — so the host
+    boundaries (``inject_partition``, eager ``pack_wire_info`` calls)
+    fail loudly instead.  No-op on traced values (inside jit the labels
+    came through a validated host boundary) and on dense matrices."""
+    if getattr(partition, "ndim", None) != 1:
+        return
+    import numpy as np
+
+    try:
+        p = np.asarray(partition)
+    except Exception:
+        return   # traced inside jit: validated at the host boundary
+    if p.size and (int(p.min()) < 0 or int(p.max()) > _GROUP_BITS_MASK):
+        raise ValueError(
+            f"partition group labels must fit 29 unsigned bits "
+            f"[0, {_GROUP_BITS_MASK}]; got range "
+            f"[{int(p.min())}, {int(p.max())}] — labels outside it "
+            f"would alias groups in pack_wire_info's packed word")
 
 
 def pack_wire_info(faults: FaultState, backed: Array | None) -> Array:
@@ -157,6 +183,7 @@ def pack_wire_info(faults: FaultState, backed: Array | None) -> Array:
     into one; the SOURCE side needs no gather at all because an
     emission's W_SRC is always the emitting row's own gid (the wire
     has no relays — every protocol emits from itself)."""
+    check_group_labels(faults.partition)
     alive = faults.alive.astype(jnp.int32)
     b = jnp.zeros_like(alive) if backed is None \
         else backed.astype(jnp.int32)
@@ -265,6 +292,7 @@ def inject_partition(faults: FaultState, group_a, group_b) -> FaultState:
         # int32 after ~31 uncomposed splits.
         _, inv = np.unique(np.asarray(p), return_inverse=True)
         p = jnp.asarray(inv, jnp.int32)
+        check_group_labels(p)
     return faults._replace(partition=p)
 
 

@@ -1,0 +1,262 @@
+"""Device-resident metrics plane: per-round / per-channel / per-cause
+counters accumulated INSIDE the jitted round.
+
+The reference exposes rich runtime introspection — the telemetry event
+catalog (doc_extras/telemetry.md), per-peer connection counts
+(partisan_peer_connections.erl:107-110), the trace orchestrator's typed
+send/receive/DROPPED records — where the TPU rebuild's ``Stats``
+(cluster.py) collapses everything into three cumulative globals.  This
+module is the native equivalent of that catalog: a statically-shaped
+ring buffer of per-round counters carried in ``ClusterState`` and
+written by ``round_body`` with ZERO host syncs (the metrics state is a
+scan carry, never a callback), then decoded host-side after a batch of
+rounds.
+
+Design constraints (ARCHITECTURE.md "Observability"):
+
+- **statically shaped** — a ring of ``Config.metrics_ring`` rounds;
+  slot = ``rnd % ring`` so a long scan keeps the most recent window,
+- **replicated under sharding** — every recorded value is reduced with
+  ``comm.allsum``/``comm.allmax`` before the ring write, so sharded
+  runs record cluster-wide series bit-identical to single-device runs
+  (parallel/sharded.py replicates the metrics leaves, like Stats),
+- **free when disabled** — ``Config.metrics=False`` (the default) keeps
+  the ClusterState leaf an empty ``()`` pytree: no arrays, no ops, no
+  bytes on the hot path.
+
+Cause taxonomy (trailing axis of ``MetricsState.drops``): the event
+lane's per-round ``emitted - delivered`` delta — exactly what legacy
+``Stats.dropped`` accumulates — broken out by WHERE the message died:
+
+- ``compact_shed``   — emission-compaction overflow (``emit_compact``),
+- ``fault_cut``      — crash/partition/omission masks (faults.py),
+- ``inbox_overflow`` — receiver inbox past ``inbox_cap`` (route drops),
+- ``dead_receiver``  — addressed to a crash-stopped node,
+- ``outbox_shed``    — channel-capacity outbox overflow (channels.py),
+- ``other``          — the residual: everything the direct counters
+  cannot see from round_body (all_to_all quota sheds inside the sharded
+  exchange, and the transient defer/release imbalance of channel-
+  capacity backpressure — a deferred send counts emitted in round r but
+  delivers in round r+k, so per-round ``other`` may go NEGATIVE; it
+  telescopes to the true loss over a window).
+
+By construction ``sum(drops, axis=-1)`` equals the per-round legacy
+``Stats.dropped`` delta, so the series always reconciles exactly with
+the cumulative counters (tests/test_metrics.py gates this).
+
+Monotonic-channel sheds are a separate ``shed`` series: the reference's
+transport treats them as sanctioned load-shedding, and legacy Stats
+excludes them from ``emitted`` (so they are NOT part of ``dropped``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+
+# Drop-cause taxonomy: indices into the trailing axis of
+# ``MetricsState.drops`` (see module docstring for semantics).
+CAUSE_COMPACT = 0
+CAUSE_FAULT = 1
+CAUSE_INBOX = 2
+CAUSE_DEAD = 3
+CAUSE_OUTBOX = 4
+CAUSE_OTHER = 5
+N_CAUSES = 6
+CAUSE_NAMES = ("compact_shed", "fault_cut", "inbox_overflow",
+               "dead_receiver", "outbox_shed", "other")
+
+
+class MetricsState(NamedTuple):
+    """Ring buffer of per-round counters (all int32, all replicated).
+
+    ``R`` = Config.metrics_ring, ``C`` = Config.n_channels.  Slot
+    ``rnd % R`` holds round ``rnd``; ``rnd[slot] == -1`` marks a slot
+    never written (a run shorter than the ring)."""
+
+    rnd: Array          # int32[R] — absolute round recorded (-1 = empty)
+    emitted: Array      # int32[R, C] — counted emissions per channel
+    delivered: Array    # int32[R, C] — event-lane deliveries per channel
+    causal: Array       # int32[R] — causal-lane deliveries (no channel)
+    shed: Array         # int32[R] — monotonic-channel sheds (not drops)
+    drops: Array        # int32[R, N_CAUSES] — cause-tagged drops
+    inbox_hwm: Array    # int32[R] — max inbox occupancy over nodes
+    inbox_occ: Array    # int32[R] — total inbox occupancy (sum)
+    edges_total: Array  # int32[R] — live overlay out-edges, cluster-wide
+    edges_min: Array    # int32[R] — min live out-edges over ALIVE nodes
+    edges_max: Array    # int32[R] — max live out-edges over alive nodes
+    alive: Array        # int32[R] — alive-node count
+    dlv_overflow: Array  # int32[R] — delivery-plane drop delta
+    #                      (ack/causal/p2p overflow+aborted+invalid)
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.metrics
+
+
+def init(cfg: Config, comm) -> MetricsState:
+    R, C = cfg.metrics_ring, cfg.n_channels
+
+    def z(*shape):
+        return jnp.zeros(shape, jnp.int32)
+
+    return MetricsState(
+        rnd=jnp.full((R,), -1, jnp.int32),
+        emitted=z(R, C), delivered=z(R, C), causal=z(R), shed=z(R),
+        drops=z(R, N_CAUSES), inbox_hwm=z(R), inbox_occ=z(R),
+        edges_total=z(R), edges_min=z(R), edges_max=z(R), alive=z(R),
+        dlv_overflow=z(R),
+    )
+
+
+def channel_counts(cfg: Config, msgs: Array,
+                   mask: Array | None = None) -> Array:
+    """int32[C]: live messages in ``msgs [..., W]`` counted by channel
+    (shard-local; callers ``comm.allsum`` the vector).  ``mask``
+    optionally restricts the count to a bool subset of the slots (e.g.
+    the shed mask) — live-ness is still required."""
+    valid = msgs[..., T.W_KIND] != 0
+    if mask is not None:
+        valid = valid & mask
+    ch = jnp.clip(msgs[..., T.W_CHANNEL], 0, cfg.n_channels - 1)
+    onehot = (ch[..., None] == jnp.arange(cfg.n_channels)) \
+        & valid[..., None]
+    return jnp.sum(onehot, axis=tuple(range(onehot.ndim - 1)),
+                   dtype=jnp.int32)
+
+
+_BIG = jnp.int32(2**30)
+
+
+def record_round(cfg: Config, comm, ms: MetricsState, *, rnd: Array,
+                 emitted_ch: Array, delivered_ch: Array, causal: Array,
+                 shed: Array, drops: Array, inbox_count: Array,
+                 alive_local: Array, alive_global: Array, nbrs: Array,
+                 dlv_overflow: Array) -> MetricsState:
+    """Write one round's counters into ring slot ``rnd % R``.
+
+    ``emitted_ch``/``delivered_ch``/``causal``/``shed``/``drops``/
+    ``dlv_overflow`` arrive already globally reduced (replicated);
+    ``inbox_count`` [n_local] and ``nbrs`` [n_local, K] are shard-local
+    and reduced here.  Everything stays on device — this runs inside
+    the round's jitted scan body."""
+    slot = jnp.mod(rnd, cfg.metrics_ring)
+
+    occ = comm.allsum(jnp.sum(inbox_count, dtype=jnp.int32))
+    hwm = comm.allmax(jnp.max(inbox_count))
+
+    # Per-node live out-edges (the connection-count analogue,
+    # partisan_peer_connections.erl:107-110): an edge is live only if
+    # both endpoints are alive — a crashed peer's socket is gone.
+    live_nbr = (nbrs >= 0) \
+        & alive_global[jnp.clip(nbrs, 0, cfg.n_nodes - 1)]
+    e = jnp.sum(live_nbr, axis=1, dtype=jnp.int32)
+    e = jnp.where(alive_local, e, 0)
+    n_alive = comm.allsum(jnp.sum(alive_local, dtype=jnp.int32))
+    e_total = comm.allsum(jnp.sum(e, dtype=jnp.int32))
+    e_max = comm.allmax(jnp.max(e))
+    # min over ALIVE nodes only (dead rows are structurally 0):
+    # -max(-e) over alive rows; an all-dead cluster reports 0.
+    e_min = jnp.where(
+        n_alive > 0,
+        -comm.allmax(jnp.max(jnp.where(alive_local, -e, -_BIG))),
+        jnp.int32(0))
+
+    return MetricsState(
+        rnd=ms.rnd.at[slot].set(rnd),
+        emitted=ms.emitted.at[slot].set(emitted_ch),
+        delivered=ms.delivered.at[slot].set(delivered_ch),
+        causal=ms.causal.at[slot].set(causal),
+        shed=ms.shed.at[slot].set(shed),
+        drops=ms.drops.at[slot].set(drops),
+        inbox_hwm=ms.inbox_hwm.at[slot].set(hwm),
+        inbox_occ=ms.inbox_occ.at[slot].set(occ),
+        edges_total=ms.edges_total.at[slot].set(e_total),
+        edges_min=ms.edges_min.at[slot].set(e_min),
+        edges_max=ms.edges_max.at[slot].set(e_max),
+        alive=ms.alive.at[slot].set(n_alive),
+        dlv_overflow=ms.dlv_overflow.at[slot].set(dlv_overflow),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers
+# ---------------------------------------------------------------------------
+
+_SERIES = ("emitted", "delivered", "causal", "shed", "drops",
+           "inbox_hwm", "inbox_occ", "edges_total", "edges_min",
+           "edges_max", "alive", "dlv_overflow")
+
+
+def snapshot(ms: MetricsState) -> dict:
+    """Decode the ring into per-round series ordered by round (one
+    device->host transfer, AFTER the scan — never inside it).
+
+    Returns ``{"rounds": int array [k], <series>: array [k, ...]}``
+    where k <= metrics_ring is the number of recorded rounds (the most
+    recent window once the ring wraps)."""
+    import jax
+    import numpy as np
+
+    host = jax.device_get(ms)
+    rnd = np.asarray(host.rnd)
+    keep = np.flatnonzero(rnd >= 0)
+    idx = keep[np.argsort(rnd[keep], kind="stable")]
+    out: dict = {"rounds": rnd[idx]}
+    for name in _SERIES:
+        out[name] = np.asarray(getattr(host, name))[idx]
+    return out
+
+
+def rows(snap: dict, channels: tuple[str, ...] | None = None) -> list[dict]:
+    """JSON-lines-friendly view of a snapshot: one dict per round, with
+    channel and cause axes labeled (the ``BENCH_*.json`` idiom — every
+    row is a self-describing JSON object)."""
+    C = snap["emitted"].shape[1] if len(snap["emitted"]) else 0
+    ch_names = tuple(channels) if channels is not None \
+        else tuple(f"ch{i}" for i in range(C))
+    out = []
+    for i, r in enumerate(snap["rounds"]):
+        out.append({
+            "round": int(r),
+            "emitted": {ch_names[c]: int(snap["emitted"][i, c])
+                        for c in range(C)},
+            "delivered": {ch_names[c]: int(snap["delivered"][i, c])
+                          for c in range(C)},
+            "causal_delivered": int(snap["causal"][i]),
+            "shed": int(snap["shed"][i]),
+            "drops": {CAUSE_NAMES[j]: int(snap["drops"][i, j])
+                      for j in range(N_CAUSES)},
+            "inbox_hwm": int(snap["inbox_hwm"][i]),
+            "inbox_occupancy": int(snap["inbox_occ"][i]),
+            "edges": {"total": int(snap["edges_total"][i]),
+                      "min": int(snap["edges_min"][i]),
+                      "max": int(snap["edges_max"][i])},
+            "alive": int(snap["alive"][i]),
+            "delivery_overflow": int(snap["dlv_overflow"][i]),
+        })
+    return out
+
+
+def totals(snap: dict) -> dict:
+    """Whole-window aggregates — the reconciliation view against the
+    legacy cumulative ``Stats`` counters (equal when the run fits the
+    ring; see tests/test_metrics.py)."""
+    import numpy as np
+
+    return {
+        "rounds": int(len(snap["rounds"])),
+        "emitted": int(snap["emitted"].sum()),
+        "delivered": int(snap["delivered"].sum())
+        + int(snap["causal"].sum()),
+        "dropped": int(snap["drops"].sum()),
+        "shed": int(snap["shed"].sum()),
+        "drops_by_cause": {
+            CAUSE_NAMES[j]: int(snap["drops"][:, j].sum())
+            for j in range(N_CAUSES)},
+    }

@@ -36,6 +36,7 @@ from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import managers as managers_mod
+from partisan_tpu import metrics as metrics_mod
 from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
@@ -139,6 +140,11 @@ class ShardComm:
         """Cross-shard scalar sum (keeps Stats replicated)."""
         return jax.lax.psum(x, AXIS)
 
+    def allmax(self, x: Array) -> Array:
+        """Cross-shard scalar max (keeps metrics high-water marks
+        replicated — same discipline as allsum)."""
+        return jax.lax.pmax(x, AXIS)
+
     def gather_vec(self, x: Array) -> Array:
         return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
 
@@ -225,6 +231,9 @@ class ShardedCluster:
             outbox=(() if state.outbox == () else jax.tree.map(
                 lambda x: repl if jnp.ndim(x) == 0 else shard,
                 state.outbox)),
+            # Metrics ring: every recorded value is allsum/allmax-reduced
+            # before the write, so the ring is identical on every shard.
+            metrics=spec_like(state.metrics, repl),
         )
 
     # ---- state construction ------------------------------------------
@@ -244,6 +253,8 @@ class ShardedCluster:
                        if self.interpose is not None else ()),
             outbox=(channels_mod.init(cfg, self.host_comm)
                     if channels_mod.enabled(cfg) else ()),
+            metrics=(metrics_mod.init(cfg, self.host_comm)
+                     if metrics_mod.enabled(cfg) else ()),
         )
         return self.shard_state(state)
 

@@ -31,6 +31,36 @@ import numpy as np
 
 K_PROG = 10
 
+# Metrics-plane opt-in (the CLI's --metrics flag sets this): scenarios
+# run with the device-resident counter ring enabled and emit the
+# per-round series to STDERR as JSON lines, ALONGSIDE the existing
+# one-JSON-object-per-scenario stdout lines (which stay unchanged).
+METRICS = False
+
+
+def _metrics_cfg(cfg):
+    """Apply the module-level metrics opt-in to a scenario config."""
+    return cfg.replace(metrics=True, metrics_ring=512) if METRICS else cfg
+
+
+def _emit_metrics(cfg, st, label) -> None:
+    """Decode a run's metrics ring to stderr as JSON lines (one per
+    round + one totals line), tagged with the scenario label."""
+    if st is None or st.metrics == ():
+        return
+    import json
+    import sys
+
+    from partisan_tpu import metrics as metrics_mod
+
+    snap = metrics_mod.snapshot(st.metrics)
+    names = tuple(c.name for c in cfg.channels)
+    for row in metrics_mod.rows(snap, channels=names):
+        print(json.dumps({"kind": "metrics", "config": label, **row}),
+              file=sys.stderr)
+    print(json.dumps({"kind": "metrics_totals", "config": label,
+                      **metrics_mod.totals(snap)}), file=sys.stderr)
+
 
 def _sync(st) -> None:
     """True execution barrier: jax.block_until_ready does NOT reliably
@@ -354,7 +384,7 @@ def config1_anti_entropy(n=16, max_rounds=120):
     from partisan_tpu.config import Config
     from partisan_tpu.models.anti_entropy import AntiEntropy
 
-    cfg = Config(n_nodes=n, seed=1, inbox_cap=max(32, n + 8))
+    cfg = _metrics_cfg(Config(n_nodes=n, seed=1, inbox_cap=max(32, n + 8)))
     model = AntiEntropy()
     cl = Cluster(cfg, model=model)
     cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
@@ -362,6 +392,7 @@ def config1_anti_entropy(n=16, max_rounds=120):
     start = int(st.rnd)
     st = st._replace(model=model.broadcast(st.model, 0, 0))
     st, conv = _converge(cl, st, cov, max_rounds)
+    _emit_metrics(cfg, st, 1)
     return {"config": 1, "n": n, "convergence_rounds": conv - start,
             "rounds_per_sec": round(_throughput(cl, st), 1)}
 
@@ -375,8 +406,9 @@ def config2_rumor(n=1000, max_rounds=200):
     from partisan_tpu.config import Config
     from partisan_tpu.models.rumor_mongering import RumorMongering
 
-    cfg = Config(n_nodes=n, seed=2, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups")
+    cfg = _metrics_cfg(Config(n_nodes=n, seed=2,
+                            peer_service_manager="hyparview",
+                            msg_words=16, partition_mode="groups"))
     model = RumorMongering()
     cl = Cluster(cfg, model=model)
     cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
@@ -390,6 +422,7 @@ def config2_rumor(n=1000, max_rounds=200):
         if len(trail) >= 3 and trail[-1][1] == trail[-3][1]:
             break   # plateaued
     plateau = trail[-1][1]
+    _emit_metrics(cfg, st, 2)
     infection = next(r for (r, c) in trail if c >= 0.95 * plateau) - start
     return {"config": 2, "n": n, "fanout": 2,
             "infection_rounds": infection,
@@ -413,9 +446,10 @@ def config3_plumtree_drop(n=10_000, drop=0.05, max_rounds=400):
     from partisan_tpu.config import Config
     from partisan_tpu.models.plumtree import Plumtree
 
-    cfg = Config(n_nodes=n, seed=3, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups",
-                 emit_compact=32 if n > 4096 else 0)
+    cfg = _metrics_cfg(Config(n_nodes=n, seed=3,
+                            peer_service_manager="hyparview",
+                            msg_words=16, partition_mode="groups",
+                            emit_compact=32 if n > 4096 else 0))
     model = Plumtree()
     cl = Cluster(cfg, model=model)
     cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
@@ -424,6 +458,7 @@ def config3_plumtree_drop(n=10_000, drop=0.05, max_rounds=400):
     start = int(st.rnd)
     st = st._replace(model=model.broadcast(st.model, 0, 0, start))
     st, conv = _converge(cl, st, cov, max_rounds)
+    _emit_metrics(cfg, st, 3)
     # Repair-round bound: eager flood depth is O(log n) over the
     # HyParView overlay; each dropped edge heals within one lazy tick
     # (1 round) + a graft round trip (2 rounds), and at 5% iid drop a
@@ -454,8 +489,10 @@ def config4_scamp_churn(n=10_000, churn_per_min=0.30, rounds=120):
     # bootstrap never shed (cap 32 measured 1.4k sheds at 1k nodes,
     # costing ~2 partial-view entries per node; the capacity knobs are
     # specified to be sized for zero steady sheds)
-    cfg = Config(n_nodes=n, seed=4, peer_service_manager="scamp_v2",
-                 msg_words=16, partition_mode="groups", inbox_cap=96)
+    cfg = _metrics_cfg(Config(n_nodes=n, seed=4,
+                            peer_service_manager="scamp_v2",
+                            msg_words=16, partition_mode="groups",
+                            inbox_cap=96))
     cl = Cluster(cfg)
     # Admission stagger (join_round gating): each wave's subscriptions
     # enter spread over the wave's rounds, so fanouts land on contact
@@ -479,6 +516,7 @@ def config4_scamp_churn(n=10_000, churn_per_min=0.30, rounds=120):
         st = st._replace(faults=churn(st.faults, st.rnd))
         st = cl.steps(st, K_PROG)
     _sync(st)
+    _emit_metrics(cfg, st, 4)
     sizes = np.asarray(jnp.sum(st.manager.partial >= 0, axis=1))
     alive = np.asarray(st.faults.alive)
     s = sizes[alive]
@@ -530,14 +568,15 @@ def config5_causal_crash(n=100_000, senders=64, crashes=16,
     stack = Stack([plum, chat])
 
     def make_cfg(width):
-        return Config(n_nodes=width, seed=5,
+        return _metrics_cfg(Config(n_nodes=width, seed=5,
                       peer_service_manager="hyparview",
                       msg_words=16, partition_mode="groups",
                       causal_p2p_labels=("chat",),
                       max_broadcasts=8, inbox_cap=16,
                       emit_compact=32 if n > 4096 else 0,
                       timer_stagger=False,
-                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+                      plumtree=PlumtreeConfig(push_slots=2,
+                                              lazy_cap=4)))
 
     cfg = make_cfg(n)
     cl = Cluster(cfg, model=stack)
@@ -587,6 +626,7 @@ def config5_causal_crash(n=100_000, senders=64, crashes=16,
         st = cl.steps(st, K_PROG)
     _sync(st)
 
+    _emit_metrics(cfg, st, 5)
     # Per-edge FIFO + exactly-once at every receiver.
     chat_state = jax.device_get(stack.sub(st.model, 1))
     logs = P2PChat.logs(chat_state)
@@ -748,7 +788,12 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--only", type=int, nargs="*", default=None)
+    ap.add_argument("--metrics", action="store_true",
+                    help="run with the device-resident metrics ring on "
+                         "and emit per-round series to stderr as JSON "
+                         "lines (stdout is unchanged)")
     args = ap.parse_args()
+    METRICS = METRICS or args.metrics
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

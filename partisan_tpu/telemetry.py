@@ -26,6 +26,12 @@ PEER_UP = ("partisan", "membership", "peer", "up")
 PEER_DOWN = ("partisan", "membership", "peer", "down")
 CHANNEL_CONFIGURED = ("partisan", "channel", "configured")
 
+# Metrics-plane threshold events (metrics.py ring -> discrete events;
+# the sim extension of the reference catalog — same bus, same shape).
+METRICS_SHED_SPIKE = ("partisan", "metrics", "shed_spike")
+METRICS_DROP_SPIKE = ("partisan", "metrics", "drop_spike")
+METRICS_PARTITION = ("partisan", "metrics", "partition_detected")
+
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
 
@@ -88,6 +94,67 @@ def emit_membership_events(bus: Bus, cfg, manager, prev_state, state,
     for node in np.flatnonzero(palive & ~alive):
         bus.execute(PEER_DOWN, {"count": 1},
                     {"node": int(node), "round": rnd})
+
+
+def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
+                          shed_threshold: int = 1,
+                          drop_threshold: int = 1) -> int:
+    """Replay a metrics snapshot (``metrics.snapshot``) as discrete
+    threshold-crossing events through the bus — the host-side adapter
+    from the device-resident counter ring to the reference's
+    telemetry-event idiom (``telemetry:execute`` with measurements +
+    metadata).
+
+    Crossings are EDGE-triggered per series: an event fires on the
+    first round at-or-above the threshold after a round below it, so a
+    sustained spike is one event, not one per round.
+
+    - ``shed_spike``  — monotonic-channel sheds >= ``shed_threshold``
+    - ``drop_spike``  — cause-summed event-lane drops >= ``drop_threshold``
+    - ``partition_detected`` — an ALIVE node with zero live out-edges
+      while the cluster has >1 alive node (the conn-count-to-zero
+      node-isolation signal, partisan_peer_connections.erl:1489-1535,
+      read from the live-edge series).  Edge-LOSS gated: it only fires
+      once some round in the window showed every alive node connected
+      (edges_min > 0) — nodes that have not yet JOINED also have zero
+      out-edges, and a cold bootstrap is not a partition.
+
+    Returns the number of events emitted."""
+    import numpy as np
+
+    shed = np.asarray(snap["shed"])
+    drops = np.asarray(snap["drops"]).sum(axis=1)
+    edges_min = np.asarray(snap["edges_min"])
+    rounds = np.asarray(snap["rounds"])
+    if rounds.size and rounds[0] == 0:
+        # Window covers the run start: suppress the cold-bootstrap
+        # rounds before the overlay first fully connected.
+        was_connected = np.cumsum(edges_min > 0) > 0
+    else:
+        # Ring wrapped — the window starts mid-run, bootstrap is long
+        # past, and a zero-edge alive node is a real isolation signal
+        # (a sustained partition must not be suppressed just because
+        # the last connected round fell off the ring).
+        was_connected = np.ones(rounds.shape, bool)
+    isolated = (edges_min == 0) & (np.asarray(snap["alive"]) > 1) \
+        & was_connected
+    n_events = 0
+    prev = {"shed": False, "drop": False, "part": False}
+    for i, rnd in enumerate(rounds):
+        for key, hot, event, meas in (
+                ("shed", bool(shed[i] >= shed_threshold),
+                 METRICS_SHED_SPIKE, {"shed": int(shed[i])}),
+                ("drop", bool(drops[i] >= drop_threshold),
+                 METRICS_DROP_SPIKE, {"dropped": int(drops[i])}),
+                ("part", bool(isolated[i]),
+                 METRICS_PARTITION,
+                 {"edges_min": int(snap["edges_min"][i]),
+                  "alive": int(snap["alive"][i])})):
+            if hot and not prev[key]:
+                bus.execute(event, meas, {"round": int(rnd)})
+                n_events += 1
+            prev[key] = hot
+    return n_events
 
 
 def emit_channels_configured(bus: Bus, cfg) -> None:

@@ -320,12 +320,22 @@ def test_wide_sharded_parity_through_convergence(mesh8):
         if converge:
             # the quota soak sheds traffic by design; the invariant is
             # that repair converges within a BOUNDED extra budget, not
-            # that a fixed 30 rounds always suffice for every stream
+            # that a fixed 30 rounds always suffice for every stream.
+            # Record the extra 10-round batches actually consumed and
+            # keep the bound TIGHT (ADVICE r5 #3): the soak was
+            # measured to need <= 2 extra batches; more than 4 means
+            # shed/repair behavior regressed, even if it would still
+            # converge eventually.
+            extra = 0
             for _ in range(12):
                 if float(model.coverage(st.model, st.faults.alive,
                                         0)) == 1.0:
                     break
                 st = cl.steps(st, 10)
+                extra += 1
+            assert extra <= 4, (
+                f"quota-soak repair consumed {extra} extra 10-round "
+                f"batches (> 4): shed/repair convergence regressed")
         return jax.device_get(st), model
 
     cfg = cfg_for(4)

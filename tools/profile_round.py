@@ -6,6 +6,16 @@ off, monotonic shed off, emission-compaction widths, inbox widths) at a
 given n.  Each variant pays its own XLA compile, so run at 32k (compile
 ~40 s cold) rather than 100k.  Results guide the hot-path work; keep
 with BENCH_NOTES.md.
+
+Phase attribution: ``round_body`` wraps each round phase in
+``jax.named_scope`` (round.manager / round.model /
+round.delivery_outbound / round.wire_fast / round.interpose /
+round.throttle / round.fault / round.route / round.delivery_inbound /
+round.metrics), so ops in a profiler trace carry their phase name.
+Set ``PROFILE_TRACE_DIR=/tmp/trace`` to capture a ``jax.profiler``
+trace of the timed executions (each labeled with a
+``TraceAnnotation``), viewable in TensorBoard/Perfetto, where the
+timeline buckets map 1:1 onto those phase names.
 """
 
 from __future__ import annotations
@@ -48,14 +58,24 @@ def measure(n: int, label: str, *, model: bool = True, active: bool = False,
     boot = time.perf_counter() - t0
     best = float("inf")
     ver = 1
-    for _ in range(3):
-        if active and pt is not None:
-            ver += 1
-            st = st._replace(model=pt.broadcast(st.model, 0, 0, ver))
-        t0 = time.perf_counter()
-        st = cl.steps(st, K_PROG)
-        _sync(st)
-        best = min(best, time.perf_counter() - t0)
+    trace_dir = os.environ.get("PROFILE_TRACE_DIR")
+    import contextlib
+
+    trace_cm = (jax.profiler.trace(trace_dir) if trace_dir
+                else contextlib.nullcontext())
+    with trace_cm:
+        for i in range(3):
+            if active and pt is not None:
+                ver += 1
+                st = st._replace(model=pt.broadcast(st.model, 0, 0, ver))
+            # TraceAnnotation labels the host-side span; the device ops
+            # inside carry round_body's jax.named_scope phase names.
+            with jax.profiler.TraceAnnotation(
+                    f"steady:{label}:exec{i}"):
+                t0 = time.perf_counter()
+                st = cl.steps(st, K_PROG)
+                _sync(st)
+                best = min(best, time.perf_counter() - t0)
     print(f"{label:34s} per-round {best / K_PROG * 1e3:7.1f} ms   "
           f"(boot+compile {boot:.0f}s)", flush=True)
 
