@@ -42,7 +42,8 @@ TIME_BUDGET_S = 560.0          # hard self-imposed wall budget
 PER_SIZE_CAP_S = 340.0         # no single rung may eat the whole budget
 
 
-def run(n: int, verbose: bool = False, metrics: bool = False) -> dict:
+def run(n: int, verbose: bool = False, metrics: bool = False,
+        latency: bool = False) -> dict:
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config, HyParViewConfig, \
         PlumtreeConfig
@@ -97,6 +98,10 @@ def run(n: int, verbose: bool = False, metrics: bool = False) -> dict:
                       # ring rides the scan carry; series go to STDERR
                       # only — the stdout JSON contract is unchanged
                       metrics=metrics, metrics_ring=256,
+                      # opt-in latency plane (--latency): birth-round
+                      # threading + per-channel delivery-age histograms
+                      # in the carry; percentiles go to STDERR only
+                      latency=latency,
                       hyparview=HyParViewConfig(
                           isolation_window_ms=25_000),
                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
@@ -234,6 +239,16 @@ def run(n: int, verbose: bool = False, metrics: bool = False) -> dict:
                   file=sys.stderr)
         print(json.dumps({"kind": "metrics_totals", "n": n,
                           **metrics_mod.totals(snap)}), file=sys.stderr)
+    if latency:
+        # Per-channel delivery-age percentiles to stderr; stdout keeps
+        # the one-line contract.
+        from partisan_tpu import latency as latency_mod
+
+        names = tuple(c.name for c in cfg.channels)
+        print(json.dumps({"kind": "latency", "n": n,
+                          **latency_mod.percentiles(st.latency,
+                                                    channels=names)}),
+              file=sys.stderr)
     if verbose:
         print(f"n={n}: {rps:.1f} rounds/s, broadcast converged in "
               f"{conv_rounds} rounds ({phases['converge']:.1f}s wall), "
@@ -349,7 +364,8 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--one":
         r = run(int(sys.argv[2]), verbose=True,
-                metrics="--metrics" in sys.argv)
+                metrics="--metrics" in sys.argv,
+                latency="--latency" in sys.argv)
         print(json.dumps({"size_phases": {str(r["n"]): r["phases"]}}),
               file=sys.stderr)
         print(json.dumps(r))

@@ -108,9 +108,15 @@ class Bridge:
             src, dst, words = int(args[0]), int(args[1]), list(args[2])
             w = cl.cfg.msg_words
             pw = (words + [0] * w)[:w - T.HDR_WORDS]
-            rec = msg_ops.build(w, T.MsgKind.APP, src, dst,
-                                payload=tuple(jnp.int32(x) for x in pw))
-            self._pending.append(np.asarray(rec))
+            rec = np.asarray(msg_ops.build(
+                w, T.MsgKind.APP, src, dst,
+                payload=tuple(jnp.int32(x) for x in pw)))
+            if cl.cfg.latency:
+                # The inbox is wire_words wide under the latency plane:
+                # widen the injected record with its birth round.
+                rec = np.concatenate(
+                    [rec, np.asarray([int(self.st.rnd)], np.int32)])
+            self._pending.append(rec)
             return OK
         if cmd == "step":
             k = int(args[0]) if args else 1
@@ -133,10 +139,13 @@ class Bridge:
             data = np.asarray(self.st.inbox.data[node])
             out = []
             keep = data.copy()
+            # Payload = words after the header, excluding the latency
+            # plane's trailing birth word (never app-visible).
+            pay_end = self.cl.cfg.msg_words
             for i, rec in enumerate(data):
                 if rec[T.W_KIND] == T.MsgKind.APP:
                     out.append((int(rec[T.W_SRC]),
-                                [int(x) for x in rec[T.HDR_WORDS:]]))
+                                [int(x) for x in rec[T.HDR_WORDS:pay_end]]))
                     keep[i] = 0
             inbox = self.st.inbox
             # Keep the Inbox invariant (count == valid slots): drained

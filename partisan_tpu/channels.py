@@ -47,19 +47,23 @@ def enabled(cfg: Config) -> bool:
 
 def init(cfg: Config, comm) -> OutboxState:
     return OutboxState(
-        data=jnp.zeros((comm.n_local, cfg.outbox_cap, cfg.msg_words),
+        data=jnp.zeros((comm.n_local, cfg.outbox_cap, cfg.wire_words),
                        jnp.int32),
         shed=jnp.int32(0),
     )
 
 
-def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array
-             ) -> tuple[OutboxState, Array]:
+def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array,
+             *, birth_rnd: Array | None = None):
     """Apply per-(edge, channel, lane) capacity to this round's sends.
 
     Returns (outbox', emitted') where emitted' carries the outbox's
     deferred sends first (FIFO) plus as many fresh sends as capacity
-    admits; the rest defer (or shed when the outbox is full)."""
+    admits; the rest defer (or shed when the outbox is full).  With
+    ``birth_rnd`` set (the latency plane), a third value is returned:
+    the shard-local age histogram of the sends SHED at the outbox cut
+    (deferred-but-kept sends are not drops — their queueing time
+    surfaces in their eventual delivery age)."""
     par_py = [c.parallelism for c in cfg.channels]
     par = jnp.asarray(par_py, jnp.int32)
     maxpar = max(par_py)
@@ -106,7 +110,13 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array
     new_data = jnp.zeros((n, OB, both.shape[-1]), jnp.int32)
     new_data = new_data.at[rows, slot].set(both, mode="drop")
     shed = comm.allsum(jnp.sum(defer & ~keep, dtype=jnp.int32))
-    return OutboxState(data=new_data, shed=ob.shed + shed), out
+    ob_out = OutboxState(data=new_data, shed=ob.shed + shed)
+    if birth_rnd is None:
+        return ob_out, out
+    from partisan_tpu import latency as latency_mod
+
+    return ob_out, out, latency_mod.age_hist(both, defer & ~keep,
+                                             birth_rnd)
 
 
 def shed_delta(before: OutboxState, after: OutboxState) -> Array:

@@ -29,6 +29,7 @@ from jax import Array
 from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
+from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
 from partisan_tpu.comm import LocalComm
@@ -60,6 +61,10 @@ class ClusterState(NamedTuple):
     #                         enforcement is off)
     metrics: Any = ()       # metrics.MetricsState ring (or () when
     #                         Config.metrics is off — zero cost)
+    latency: Any = ()       # latency.LatencyState histograms (or ()
+    #                         when Config.latency is off — zero cost)
+    flight: Any = ()        # latency.FlightState wire-capture ring (or
+    #                         () when Config.flight_rounds is 0)
 
 
 class TraceRound(NamedTuple):
@@ -80,6 +85,12 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     Sharing this body is what guarantees single-device and sharded runs
     evolve identically (tests/test_sharded.py)."""
     mx = metrics_mod.enabled(cfg)   # static: specializes the trace
+    lx = latency_mod.enabled(cfg)   # static: birth-word threading
+    # Flight recording needs the generic wire path's materialized
+    # (sent, dropped) pair — same constraint as capture.  Gated on the
+    # state actually carrying a ring so shape discovery (eval_shape on
+    # a flight=() state) and latency-only runs stay recorder-free.
+    fx = latency_mod.flight_enabled(cfg) and state.flight != ()
     gids = comm.local_ids()
     keys = rng.node_keys(cfg.seed, state.rnd, gids)
     alive_local = jax.lax.dynamic_slice(
@@ -100,6 +111,12 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             emitted = jnp.concatenate([m_emit, a_emit], axis=1)
     else:
         dstate_model, emitted = (), m_emit
+    if lx:
+        # Birth-round word: widen every fresh emission to wire_words.
+        # Queued copies downstream (ack store, causal rings, outbox,
+        # delay buffer, inbox) carry the widened record verbatim, so
+        # the birth survives defers and retransmits.
+        emitted = latency_mod.stamp(emitted, state.rnd)
 
     # Delivery semantics: ack generation/consumption/retransmit + causal
     # clock stamping (pulls causal messages onto their wide side lanes).
@@ -130,11 +147,12 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     # capacity enforcement, or a dense partition matrix.
     istate = state.interpose
     obstate = state.outbox
+    fstate = state.flight
     want_shed = cfg.monotonic_shed and any(c.monotonic
                                            for c in cfg.channels)
     fast_wire = (interpose is None and not channels_mod.enabled(cfg)
                  and cfg.resolved_partition_mode == "groups"
-                 and not capture)
+                 and not capture and not fx)
     if fast_wire:
         # Compaction runs FIRST here: code and runtime are priced per
         # gathered scalar on this backend (tools/profile_phases.py /
@@ -202,18 +220,42 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                         cfg, emc, mask=shed_m) if shed_m is not None
                         else jnp.zeros((cfg.n_channels,), jnp.int32))
                     out += (fault_n, shed_ch)
+                if lx:
+                    # fault-cut + compaction-overflow ages (shard-local,
+                    # reduced in record_round) — INSIDE the cond so quiet
+                    # rounds skip the histogram work, same discipline as
+                    # the compaction itself.  The fault mask matches
+                    # m_fault; the compact mask is live-beyond-cap on the
+                    # PRE-shed stack, matching m_compact below.
+                    out += (latency_mod.age_hist(
+                        emc, cut & (kind_w != 0), state.rnd),)
+                    if cfg.emit_compact:
+                        l_rank = jnp.cumsum(kind_raw != 0, axis=1) - 1
+                        out += (latency_mod.age_hist(
+                            emitted,
+                            (kind_raw != 0) & (l_rank >= cfg.emit_compact),
+                            state.rnd),)
+                    else:
+                        out += (latency_mod.zero_hist(),)
                 return out
 
         def wire_skip(_):
             out = (exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
-                                        cfg.msg_words), jnp.int32(0))
+                                        cfg.wire_words), jnp.int32(0))
             if mx:
                 out += (jnp.int32(0),
                         jnp.zeros((cfg.n_channels,), jnp.int32))
+            if lx:
+                out += (latency_mod.zero_hist(), latency_mod.zero_hist())
             return out
 
         wire_out = jax.lax.cond(any_emit, wire_body, wire_skip, 0)
         inbox, shed_n = wire_out[0], wire_out[1]
+        if lx:
+            base_i = 4 if mx else 2
+            lat_fault = wire_out[base_i]
+            lat_compact = wire_out[base_i + 1]
+            lat_outbox = latency_mod.zero_hist()  # no channel stage here
         # shed drops are excluded from the emitted count (same stance
         # as the generic path); compaction/fault/overflow drops are
         # counted emitted and surface via the emitted-delivered delta
@@ -279,10 +321,16 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         # the emission count (a deferred send was already counted when
         # emitted) and before the fault stage (a deferred send rides
         # the wire — and its faults — the round it actually transmits).
+        if lx:
+            lat_outbox = latency_mod.zero_hist()
         if channels_mod.enabled(cfg):
             with jax.named_scope("round.throttle"):
-                obstate, emitted = channels_mod.throttle(cfg, comm,
-                                                         obstate, emitted)
+                if lx:
+                    obstate, emitted, lat_outbox = channels_mod.throttle(
+                        cfg, comm, obstate, emitted, birth_rnd=state.rnd)
+                else:
+                    obstate, emitted = channels_mod.throttle(
+                        cfg, comm, obstate, emitted)
         if mx:
             m_outbox = (channels_mod.shed_delta(state.outbox, obstate)
                         if channels_mod.enabled(cfg) else jnp.int32(0))
@@ -295,6 +343,27 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 state.faults, emitted, cfg.seed, state.rnd,
                 _MSG_FILTER_TAG)
             fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
+        if fx:
+            # Flight recorder: the same (sent, dropped) pair capture
+            # mode returns, written into the carry's K-round ring.
+            with jax.named_scope("round.flight"):
+                fstate = latency_mod.record_flight(
+                    cfg, state.flight, rnd=state.rnd, sent=sent,
+                    dropped=fault_dropped)
+        if lx:
+            lat_fault = latency_mod.age_hist(sent, fault_dropped,
+                                             state.rnd)
+            # compaction here runs AFTER the fault stage (route_body
+            # compacts the post-fault stack) — same accounting as
+            # m_compact below
+            if cfg.emit_compact:
+                l_rank = jnp.cumsum(emitted[..., 0] != 0, axis=1) - 1
+                lat_compact = latency_mod.age_hist(
+                    emitted,
+                    (emitted[..., 0] != 0) & (l_rank >= cfg.emit_compact),
+                    state.rnd)
+            else:
+                lat_compact = latency_mod.zero_hist()
         if mx:
             m_fault = comm.allsum(jnp.sum(fault_dropped, dtype=jnp.int32))
             # compaction here runs AFTER the fault stage (route_body
@@ -323,7 +392,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
 
         def route_skip(_):
             return exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
-                                        cfg.msg_words)
+                                        cfg.wire_words)
 
         inbox = jax.lax.cond(any_emit, route_body, route_skip, 0)
     # Crash-stopped receivers drop everything addressed to them.
@@ -335,6 +404,17 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         m_inbox_of = comm.allsum(jnp.sum(inbox.drops, dtype=jnp.int32))
         m_dead = comm.allsum(jnp.sum(
             jnp.where(dead, inbox.count, 0), dtype=jnp.int32))
+    lt = state.latency
+    if lx:
+        # Delivery + dead-receiver ages read the PRE-mask inbox: the
+        # delivered set here is exactly what the metrics plane counts
+        # as deliver_ch below, so per-channel histogram sums reconcile
+        # with the delivered series by construction.
+        with jax.named_scope("round.latency"):
+            lt = latency_mod.record_round(
+                cfg, comm, lt, rnd=state.rnd, inbox_data=inbox.data,
+                dead=dead, fault_hist=lat_fault,
+                compact_hist=lat_compact, outbox_hist=lat_outbox)
     inbox = exchange.Inbox(
         data=jnp.where(dead[:, None, None], 0, inbox.data),
         count=jnp.where(dead, 0, inbox.count),
@@ -390,7 +470,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
                        inbox=inbox, manager=mstate, model=dstate_model,
                        delivery=dstate, stats=stats, interpose=istate,
-                       outbox=obstate, metrics=mets)
+                       outbox=obstate, metrics=mets, latency=lt,
+                       flight=fstate)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent,
                                dropped=fault_dropped)
@@ -440,6 +521,19 @@ class Cluster:
             inbox_cap=self.cfg.inbox_cap,
             msg_words=self.cfg.msg_words,
         )
+        # Flight-recorder ring shape: the wire stack's emission width
+        # depends on manager/model/delivery extras, so it is discovered
+        # by an abstract trace of the captured round (eval_shape — no
+        # compile, no device work) before the first real init.
+        self._flight_shape = None
+        if latency_mod.flight_enabled(self.cfg):
+            base = jax.eval_shape(self._init_noflight)
+            tr = jax.eval_shape(
+                lambda s: round_body(self.cfg, self.manager, self.model,
+                                     self.comm, s,
+                                     interpose=self.interpose,
+                                     capture=True)[1], base)
+            self._flight_shape = tuple(tr.sent.shape)
         self._step = jax.jit(self._round)
         self._steps = jax.jit(self._scan, static_argnums=1,
                               donate_argnums=(0,) if self.donate else ())
@@ -453,13 +547,14 @@ class Cluster:
         eager init cost ~7 s at 32k nodes."""
         return self._init()
 
-    def _build_init(self) -> ClusterState:
+    def _init_noflight(self) -> ClusterState:
         cfg, comm = self.cfg, self.comm
         return ClusterState(
             rnd=jnp.int32(0),
             faults=faults_mod.none(cfg.n_nodes,
                                    cfg.resolved_partition_mode),
-            inbox=exchange.empty_inbox(comm.n_local, cfg.inbox_cap, cfg.msg_words),
+            inbox=exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
+                                       cfg.wire_words),
             manager=self.manager.init(cfg, comm),
             model=self.model.init(cfg, comm) if self.model is not None else (),
             delivery=(delivery_mod.init(cfg, comm)
@@ -471,7 +566,17 @@ class Cluster:
                     if channels_mod.enabled(cfg) else ()),
             metrics=(metrics_mod.init(cfg, comm)
                      if metrics_mod.enabled(cfg) else ()),
+            latency=(latency_mod.init(cfg)
+                     if latency_mod.enabled(cfg) else ()),
         )
+
+    def _build_init(self) -> ClusterState:
+        state = self._init_noflight()
+        if self._flight_shape is not None:
+            state = state._replace(
+                flight=latency_mod.flight_init(self.cfg,
+                                               self._flight_shape))
+        return state
 
     # ---- the round ----------------------------------------------------
     def _round(self, state: ClusterState) -> ClusterState:

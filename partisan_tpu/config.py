@@ -315,6 +315,19 @@ class Config:
     #                              slot = rnd % ring, so long runs keep
     #                              the most recent window)
 
+    # --- latency plane (latency.py) ------------------------------------
+    latency: bool = False        # thread a birth-round word onto every
+    #                              wire record (wire_words = msg_words+1)
+    #                              and accumulate per-channel delivery-age
+    #                              + per-cause drop-age log2 histograms in
+    #                              the carry; off = leaf is (), wire stays
+    #                              msg_words wide — no cost
+    flight_rounds: int = 0       # >0: carry a ring of the last K rounds'
+    #                              post-interposition wire tensors + drop
+    #                              masks (the flight recorder), decodable
+    #                              into a trace.Trace host-side; forces
+    #                              the generic wire path (like capture)
+
     # --- test plane ----------------------------------------------------
     replaying: bool = False
     shrinking: bool = False
@@ -340,6 +353,9 @@ class Config:
         if self.metrics_ring < 1:
             raise ValueError(
                 f"metrics_ring must be >= 1, got {self.metrics_ring}")
+        if self.flight_rounds < 0:
+            raise ValueError(
+                f"flight_rounds must be >= 0, got {self.flight_rounds}")
         if self.distance.model not in ("ring", "hash"):
             raise ValueError(
                 f"distance.model {self.distance.model!r} not in "
@@ -363,6 +379,16 @@ class Config:
     @property
     def n_channels(self) -> int:
         return len(self.channels)
+
+    @property
+    def wire_words(self) -> int:
+        """Words per QUEUED wire record: ``msg_words`` plus the latency
+        plane's trailing birth-round word when ``latency`` is on.
+        Managers/models still build ``msg_words``-wide emissions — the
+        round body appends the birth word before any queueing stage, so
+        protocol code never sees it (header/payload indices are all
+        below ``msg_words``)."""
+        return self.msg_words + 1 if self.latency else self.msg_words
 
     def channel_id(self, name: str) -> int:
         for i, c in enumerate(self.channels):

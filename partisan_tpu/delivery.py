@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from partisan_tpu import faults as faults_mod
+from partisan_tpu import latency as latency_mod
 from partisan_tpu import types as T
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
@@ -165,10 +166,10 @@ def needs_inbound(cfg: Config) -> bool:
 
 def init(cfg: Config, comm) -> DeliveryState:
     n = comm.n_local
-    W = cfg.msg_words
+    W = cfg.wire_words   # queued copies carry the birth word (latency.py)
     WA = W + cfg.n_actors
     ack = AckState(
-        outstanding=jnp.zeros((n, cfg.ack_cap, cfg.msg_words), jnp.int32),
+        outstanding=jnp.zeros((n, cfg.ack_cap, W), jnp.int32),
         next_clock=jnp.ones((n,), jnp.int32),
         overflow=jnp.int32(0),
     ) if cfg.ack_cap > 0 else ()
@@ -266,6 +267,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             jnp.where(need_ack, inb[..., T.W_SRC], 0))
         ack_msgs = ack_msgs.at[..., T.W_CLOCK].set(
             jnp.where(need_ack, inb[..., T.W_CLOCK], 0))
+        ack_msgs = latency_mod.stamp_fresh(cfg, ack_msgs, ctx.rnd)
         extra.append(ack_msgs)
 
         # 2. Consume arriving ACKs: clear matching outstanding slots
@@ -394,7 +396,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
     #    this round's p2p sends (go-back-N: a send only goes out if the
     #    unacked store has a slot for it), generate our own cumulative
     #    acks as a receiver, and put everything on the event lane.
-    W = cfg.msg_words
+    W = cfg.wire_words
     p2p_out = []
     for pi, lane in enumerate(st.p2p):
         lid = len(cfg.causal_labels) + pi
@@ -550,6 +552,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                 jnp.where(rst_on, -jnp.maximum(lane.reset_seq, 1), 0))
             rst_msgs = rst_msgs.at[..., T.W_LANE].set(
                 jnp.where(rst_on, lid, 0))
+            rst_msgs = latency_mod.stamp_fresh(cfg, rst_msgs, ctx.rnd)
 
             # 6b. Compact + admit this round's fresh sends against the free
             # store slots (drop visibly when full — never wedge a stream).
@@ -656,6 +659,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                 jnp.where(ack_now, lane.src_seq, 0))
             ack_msgs = ack_msgs.at[..., T.W_LANE].set(
                 jnp.where(ack_now, lid | (lane.src_ep << 8), 0))
+            ack_msgs = latency_mod.stamp_fresh(cfg, ack_msgs, ctx.rnd)
             src_acked = jnp.where(ack_now, lane.src_seq, lane.src_acked)
 
             alive1 = ctx.alive[:, None]
@@ -737,7 +741,7 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
     node at once, merge deliveries (in causal order) into the
     model-visible inbox, buffer out-of-order futures.  Also returns the
     global count of causal deliveries this round (for Stats)."""
-    W = cfg.msg_words
+    W = cfg.wire_words
     A = cfg.n_actors
     B = cfg.causal_buf_cap
     n = comm.n_local

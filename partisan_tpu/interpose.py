@@ -176,7 +176,9 @@ class Delay:
     def init(self, cfg: Config, comm: Any) -> Any:
         n = comm.n_local
         return {
-            "buf": jnp.zeros((n, self.cap, cfg.msg_words), jnp.int32),
+            # wire_words: held copies carry the latency plane's birth
+            # word, so a delayed release keeps its true emission round
+            "buf": jnp.zeros((n, self.cap, cfg.wire_words), jnp.int32),
             "due": jnp.full((n, self.cap), -1, jnp.int32),  # release round
             # overflow accounting: matching messages that passed through
             # UNDELAYED because the hold buffer was full — a nonzero

@@ -1,0 +1,311 @@
+"""Device-resident latency plane: per-channel delivery-age histograms,
+drop-age histograms, and an always-on flight recorder — all carried in
+``ClusterState`` as scan carries with ZERO host syncs.
+
+The reference's trace orchestrator records typed send/receive/DROPPED
+events for post-mortem replay (partisan_trace_orchestrator.erl:80-86),
+and Dapper-style tracing systems answer "how long did this message sit
+in a queue" per hop.  PR 1's metrics plane (metrics.py) restored *how
+many* messages died and why; this module restores *how long* messages
+lived — and *what exactly* crossed the wire in the last K rounds.
+
+Two independent opt-ins (both off by default, both free when off):
+
+**Latency plane** (``Config(latency=True)``).  Every event-lane message
+record grows one trailing int32 word — its **birth round**, stamped at
+emission (``stamp``) and carried verbatim through every queued copy:
+the ack store and causal history/buffer rings (delivery.py), the
+channel-capacity defer outbox (channels.py), the egress/ingress delay
+hold buffer (interpose.py), and the routed inbox itself.  A
+retransmission or deferred release keeps its original birth, so the age
+observed at delivery (``deliver_round - birth_round``) is the true
+end-to-end queueing delay.  Ages are bucketed into per-channel log2
+histograms; drops are bucketed into a drop-age histogram keyed to the
+metrics plane's cause taxonomy (how old messages were when they died).
+Design constraints are the metrics plane's (ARCHITECTURE.md
+"Observability"):
+
+- **statically shaped** — cumulative ``int32[C, N_BUCKETS]`` /
+  ``int32[N_CAUSES, N_BUCKETS]`` histograms plus an ``int32[C]``
+  delivery-age high-water mark,
+- **replicated under sharding** — every increment is
+  ``comm.allsum``-reduced (high-water marks ``comm.allmax``-reduced)
+  before the accumulate, so sharded runs record bit-identical
+  histograms to single-device runs,
+- **free when disabled** — ``Config(latency=False)`` (the default)
+  keeps the ClusterState leaf an empty ``()`` pytree and the wire
+  record at ``msg_words`` — no extra words, no ops.
+
+Age attribution coverage: the ``CAUSE_INBOX`` and ``CAUSE_OTHER`` rows
+of the drop-age histogram stay zero — an inbox-overflow victim dies
+inside route()'s gather (never materialized per-message) and the
+residual cause is by definition what round_body cannot see; their
+*counts* remain exact in the metrics plane.
+
+**Flight recorder** (``Config(flight_rounds=K)``).  A ring of the last
+K rounds' post-interposition wire tensors + fault-drop masks, kept in
+the carry and decodable host-side into a ``trace.Trace``
+(:func:`flight_trace`) after any batch — the post-mortem capture of
+``Cluster.record`` without its per-round O(rounds) device memory and
+host transfer.  Recording uses the same generic wire path as
+``capture`` mode, so the decoded trace matches ``Cluster.record``'s
+capture of the same seeded run exactly (tests/test_latency.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+from partisan_tpu.metrics import N_CAUSES
+
+# Log2 age buckets: bucket 0 holds age 0 (same-round delivery), bucket
+# k in [1, N_BUCKETS-2] holds ages [2^(k-1), 2^k - 1], and the last
+# bucket absorbs everything older (the high-water mark keeps the exact
+# maximum).  Integer-exact: bucket = #{bounds <= age}.
+N_BUCKETS = 12
+BUCKET_BOUNDS = tuple(1 << k for k in range(N_BUCKETS - 1))  # 1..1024
+
+
+class LatencyState(NamedTuple):
+    """Cumulative age histograms (all int32, all replicated).
+
+    ``C`` = Config.n_channels, ``B`` = N_BUCKETS."""
+
+    deliver: Array   # int32[C, B] — event-lane delivery ages by channel
+    drop_age: Array  # int32[N_CAUSES, B] — drop ages by cause (rows
+    #                  CAUSE_INBOX / CAUSE_OTHER structurally zero)
+    age_hwm: Array   # int32[C] — max delivery age observed per channel
+
+
+class FlightState(NamedTuple):
+    """Ring of the last ``Config.flight_rounds`` rounds' wire capture.
+
+    Slot ``rnd % K`` holds round ``rnd``; ``rnd[slot] == -1`` marks a
+    slot never written (a run shorter than the ring)."""
+
+    rnd: Array      # int32[K] — absolute round recorded (-1 = empty)
+    sent: Array     # int32[K, n_local, E, W] — post-interposition wire
+    #                 stack (pre-fault), the TraceRound.sent analogue
+    dropped: Array  # bool[K, n_local, E] — cleared by the fault stage
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.latency
+
+
+def flight_enabled(cfg: Config) -> bool:
+    return cfg.flight_rounds > 0
+
+
+def init(cfg: Config) -> LatencyState:
+    C = cfg.n_channels
+    return LatencyState(
+        deliver=jnp.zeros((C, N_BUCKETS), jnp.int32),
+        drop_age=jnp.zeros((N_CAUSES, N_BUCKETS), jnp.int32),
+        age_hwm=jnp.zeros((C,), jnp.int32),
+    )
+
+
+def flight_init(cfg: Config, sent_shape: tuple) -> FlightState:
+    """Zero ring for a wire stack of shape ``(n_local, E, W)`` —
+    callers obtain the shape via ``jax.eval_shape`` on the traced
+    round (the emission width depends on manager/model/delivery)."""
+    K = cfg.flight_rounds
+    n, E, W = sent_shape
+    return FlightState(
+        rnd=jnp.full((K,), -1, jnp.int32),
+        sent=jnp.zeros((K, n, E, W), jnp.int32),
+        dropped=jnp.zeros((K, n, E), jnp.bool_),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Birth-round threading (the parallel tensor, carried as a trailing word)
+# ---------------------------------------------------------------------------
+
+def stamp(emitted: Array, rnd: Array) -> Array:
+    """Append the birth-round word to a freshly emitted ``[..., W]``
+    stack: every record (live or empty — empty slots are never read)
+    is stamped with the current round.  Copies of the widened record
+    then carry the birth through every queue verbatim."""
+    birth = jnp.broadcast_to(jnp.int32(rnd), emitted.shape[:-1] + (1,))
+    return jnp.concatenate([emitted, birth], axis=-1)
+
+
+def stamp_fresh(cfg: Config, msgs: Array, rnd: Array) -> Array:
+    """Set the birth word on control messages BUILT mid-round from
+    zeroed wire-width records (acks, stream-reset requests): they are
+    born now.  Retransmit replays are NOT restamped — a replayed copy
+    keeps its original birth, so its delivery age is the true
+    end-to-end delay.  No-op when the latency plane is off."""
+    if not cfg.latency:
+        return msgs
+    return msgs.at[..., -1].set(
+        jnp.where(msgs[..., T.W_KIND] != 0, jnp.int32(rnd), 0))
+
+
+def ages(msgs: Array, rnd: Array) -> Array:
+    """int32[...]: ``rnd - birth`` per record (callers mask validity)."""
+    return jnp.maximum(jnp.int32(rnd) - msgs[..., -1], 0)
+
+
+def bucket(age: Array) -> Array:
+    """Log2 bucket index in [0, N_BUCKETS) — integer-exact."""
+    bounds = jnp.asarray(BUCKET_BOUNDS, jnp.int32)
+    return jnp.sum(age[..., None] >= bounds, axis=-1, dtype=jnp.int32)
+
+
+def age_hist(msgs: Array, mask: Array, rnd: Array) -> Array:
+    """int32[N_BUCKETS]: age histogram of the records selected by
+    ``mask`` (shard-local; callers ``comm.allsum`` the vector)."""
+    b = bucket(ages(msgs, rnd))
+    onehot = (b[..., None] == jnp.arange(N_BUCKETS)) & mask[..., None]
+    return jnp.sum(onehot, axis=tuple(range(onehot.ndim - 1)),
+                   dtype=jnp.int32)
+
+
+def channel_age_hist(cfg: Config, msgs: Array, mask: Array,
+                     rnd: Array) -> Array:
+    """int32[C, N_BUCKETS]: as :func:`age_hist`, split by ``W_CHANNEL``
+    (shard-local)."""
+    C = cfg.n_channels
+    ch = jnp.clip(msgs[..., T.W_CHANNEL], 0, C - 1).reshape(-1)
+    b = bucket(ages(msgs, rnd)).reshape(-1)
+    # Factored one-hots contracted on the record axis: avoids an
+    # [M, C*B] intermediate on the hot path (M = n·cap).
+    ch_oh = ((ch[:, None] == jnp.arange(C))
+             & mask.reshape(-1)[:, None]).astype(jnp.int32)
+    b_oh = (b[:, None] == jnp.arange(N_BUCKETS)).astype(jnp.int32)
+    return jnp.einsum("mc,mb->cb", ch_oh, b_oh)
+
+
+def zero_hist() -> Array:
+    return jnp.zeros((N_BUCKETS,), jnp.int32)
+
+
+def record_round(cfg: Config, comm, ls: LatencyState, *, rnd: Array,
+                 inbox_data: Array, dead: Array, fault_hist: Array,
+                 compact_hist: Array, outbox_hist: Array) -> LatencyState:
+    """Accumulate one round's ages.  ``inbox_data`` is the routed inbox
+    BEFORE the dead-receiver masking (``[n_local, cap, W]``) and
+    ``dead`` its per-node mask; the three drop histograms arrive
+    shard-local from their cut sites.  Every increment is reduced here
+    (allsum / allmax), keeping the state replicated — this runs inside
+    the jitted scan body, zero host syncs."""
+    from partisan_tpu.metrics import CAUSE_COMPACT, CAUSE_DEAD, \
+        CAUSE_FAULT, CAUSE_OUTBOX
+
+    live = inbox_data[..., T.W_KIND] != 0
+    delivered = live & ~dead[:, None]
+    dlv = comm.allsum(channel_age_hist(cfg, inbox_data, delivered, rnd))
+
+    # Per-channel delivery-age high-water mark (0 = floor: ages >= 0).
+    C = cfg.n_channels
+    ch = jnp.clip(inbox_data[..., T.W_CHANNEL], 0, C - 1)
+    a = ages(inbox_data, rnd)
+    per_ch = jnp.max(
+        jnp.where(delivered[..., None] & (ch[..., None]
+                                          == jnp.arange(C)), a[..., None], 0),
+        axis=tuple(range(a.ndim)))
+    hwm = jnp.maximum(ls.age_hwm, comm.allmax(per_ch))
+
+    dead_hist = age_hist(inbox_data, live & dead[:, None], rnd)
+    drop = ls.drop_age
+    drop = drop.at[CAUSE_FAULT].add(comm.allsum(fault_hist))
+    drop = drop.at[CAUSE_COMPACT].add(comm.allsum(compact_hist))
+    drop = drop.at[CAUSE_OUTBOX].add(comm.allsum(outbox_hist))
+    drop = drop.at[CAUSE_DEAD].add(comm.allsum(dead_hist))
+    return LatencyState(deliver=ls.deliver + dlv, drop_age=drop,
+                        age_hwm=hwm)
+
+
+def record_flight(cfg: Config, fl: FlightState, *, rnd: Array,
+                  sent: Array, dropped: Array) -> FlightState:
+    """Write one round's wire capture into ring slot ``rnd % K``."""
+    slot = jnp.mod(rnd, cfg.flight_rounds)
+    return FlightState(
+        rnd=fl.rnd.at[slot].set(rnd),
+        sent=fl.sent.at[slot].set(sent),
+        dropped=fl.dropped.at[slot].set(dropped),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers
+# ---------------------------------------------------------------------------
+
+def snapshot(ls: LatencyState) -> dict:
+    """Decode the histograms (one device->host transfer, after the
+    scan): ``{"deliver": [C, B], "drop_age": [N_CAUSES, B],
+    "age_hwm": [C], "bounds": [B-1]}``."""
+    import jax
+    import numpy as np
+
+    host = jax.device_get(ls)
+    return {
+        "deliver": np.asarray(host.deliver),
+        "drop_age": np.asarray(host.drop_age),
+        "age_hwm": np.asarray(host.age_hwm),
+        "bounds": np.asarray(BUCKET_BOUNDS),
+    }
+
+
+def _bucket_upper(k: int, hwm: int) -> int:
+    """Conservative upper age edge of bucket k, clamped to the exact
+    observed maximum (no quantile may exceed the high-water mark —
+    otherwise an SLO check against the bucket edge could false-alarm)."""
+    if k <= 0:
+        return 0
+    if k >= N_BUCKETS - 1:
+        return int(hwm)
+    return min((1 << k) - 1, int(hwm))
+
+
+def percentiles(ls_or_snap, channels: tuple[str, ...] | None = None) -> dict:
+    """p50/p95/p99/max delivery age per channel, in rounds.  Quantiles
+    are the upper edge of the bucket where the cumulative count crosses
+    the quantile (a conservative bound — log2 buckets cannot resolve
+    finer); ``max`` is the exact high-water mark."""
+    import numpy as np
+
+    snap = ls_or_snap if isinstance(ls_or_snap, dict) \
+        else snapshot(ls_or_snap)
+    dlv = np.asarray(snap["deliver"])
+    hwm = np.asarray(snap["age_hwm"])
+    C = dlv.shape[0]
+    names = tuple(channels) if channels is not None \
+        else tuple(f"ch{i}" for i in range(C))
+    out: dict = {}
+    for c in range(C):
+        counts = dlv[c]
+        total = int(counts.sum())
+        entry = {"count": total, "max": int(hwm[c])}
+        cum = counts.cumsum()
+        for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            if total == 0:
+                entry[label] = None
+                continue
+            k = int(np.searchsorted(cum, q * total))
+            entry[label] = _bucket_upper(min(k, N_BUCKETS - 1),
+                                         int(hwm[c]))
+        out[names[c]] = entry
+    return out
+
+
+def flight_trace(fl: FlightState):
+    """Decode a flight-recorder ring into a ``trace.Trace`` ordered by
+    round — the post-mortem view of the last K rounds, interchangeable
+    with ``trace.from_capture(Cluster.record(...))`` of the same run."""
+    import jax
+
+    from partisan_tpu.metrics import ring_order
+    from partisan_tpu.trace import Trace
+
+    host = jax.device_get(fl)
+    idx = ring_order(host.rnd)
+    return Trace(host.sent[idx], host.dropped[idx], host.rnd[idx])

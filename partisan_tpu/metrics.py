@@ -193,6 +193,18 @@ _SERIES = ("emitted", "delivered", "causal", "shed", "drops",
            "edges_max", "alive", "dlv_overflow")
 
 
+def ring_order(rnd) -> "np.ndarray":
+    """Decode a ring's round-label vector (-1 = slot never written)
+    into the slot order that yields rounds ascending — shared by every
+    carry-resident ring (this module's counter ring, the latency
+    plane's flight recorder)."""
+    import numpy as np
+
+    rnd = np.asarray(rnd)
+    keep = np.flatnonzero(rnd >= 0)
+    return keep[np.argsort(rnd[keep], kind="stable")]
+
+
 def snapshot(ms: MetricsState) -> dict:
     """Decode the ring into per-round series ordered by round (one
     device->host transfer, AFTER the scan — never inside it).
@@ -205,8 +217,7 @@ def snapshot(ms: MetricsState) -> dict:
 
     host = jax.device_get(ms)
     rnd = np.asarray(host.rnd)
-    keep = np.flatnonzero(rnd >= 0)
-    idx = keep[np.argsort(rnd[keep], kind="stable")]
+    idx = ring_order(rnd)
     out: dict = {"rounds": rnd[idx]}
     for name in _SERIES:
         out[name] = np.asarray(getattr(host, name))[idx]

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
+from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
 from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
@@ -234,6 +235,13 @@ class ShardedCluster:
             # Metrics ring: every recorded value is allsum/allmax-reduced
             # before the write, so the ring is identical on every shard.
             metrics=spec_like(state.metrics, repl),
+            # Latency histograms: reduced before every accumulate, so
+            # replicated like the metrics ring.
+            latency=spec_like(state.latency, repl),
+            # Flight recorder: the wire capture's node axis (axis 1,
+            # behind the ring axis) shards; round labels replicate.
+            flight=(() if state.flight == () else latency_mod.FlightState(
+                rnd=repl, sent=P(None, AXIS), dropped=P(None, AXIS))),
         )
 
     # ---- state construction ------------------------------------------
@@ -243,7 +251,8 @@ class ShardedCluster:
             rnd=jnp.int32(0),
             faults=faults_mod.none(cfg.n_nodes,
                                    cfg.resolved_partition_mode),
-            inbox=exchange.empty_inbox(cfg.n_nodes, cfg.inbox_cap, cfg.msg_words),
+            inbox=exchange.empty_inbox(cfg.n_nodes, cfg.inbox_cap,
+                                       cfg.wire_words),
             manager=self.manager.init(cfg, self.host_comm),
             model=self.model.init(cfg, self.host_comm) if self.model is not None else (),
             delivery=(delivery_mod.init(cfg, self.host_comm)
@@ -255,7 +264,22 @@ class ShardedCluster:
                     if channels_mod.enabled(cfg) else ()),
             metrics=(metrics_mod.init(cfg, self.host_comm)
                      if metrics_mod.enabled(cfg) else ()),
+            latency=(latency_mod.init(cfg)
+                     if latency_mod.enabled(cfg) else ()),
         )
+        if latency_mod.flight_enabled(cfg):
+            # Wire-stack shape discovery by abstract trace (see
+            # Cluster.__post_init__): the single-device round body on
+            # the global state yields the full (n_global, E, W) stack;
+            # shard_state then splits the node axis per the specs.
+            tr = jax.eval_shape(
+                lambda s: round_body(cfg, self.manager, self.model,
+                                     self.host_comm, s,
+                                     interpose=self.interpose,
+                                     capture=True)[1], state)
+            state = state._replace(
+                flight=latency_mod.flight_init(cfg,
+                                               tuple(tr.sent.shape)))
         return self.shard_state(state)
 
     def shard_state(self, state: ClusterState) -> ClusterState:

@@ -36,30 +36,46 @@ K_PROG = 10
 # per-round series to STDERR as JSON lines, ALONGSIDE the existing
 # one-JSON-object-per-scenario stdout lines (which stay unchanged).
 METRICS = False
+# Latency-plane opt-in (--latency): birth-round threading + delivery-
+# age histograms; percentiles emitted to stderr the same way.
+LATENCY = False
 
 
 def _metrics_cfg(cfg):
-    """Apply the module-level metrics opt-in to a scenario config."""
-    return cfg.replace(metrics=True, metrics_ring=512) if METRICS else cfg
+    """Apply the module-level metrics/latency opt-ins to a scenario
+    config."""
+    if METRICS:
+        cfg = cfg.replace(metrics=True, metrics_ring=512)
+    if LATENCY:
+        cfg = cfg.replace(latency=True)
+    return cfg
 
 
 def _emit_metrics(cfg, st, label) -> None:
-    """Decode a run's metrics ring to stderr as JSON lines (one per
-    round + one totals line), tagged with the scenario label."""
-    if st is None or st.metrics == ():
+    """Decode a run's metrics ring (and latency histograms, when on) to
+    stderr as JSON lines, tagged with the scenario label."""
+    if st is None:
         return
     import json
     import sys
 
-    from partisan_tpu import metrics as metrics_mod
-
-    snap = metrics_mod.snapshot(st.metrics)
     names = tuple(c.name for c in cfg.channels)
-    for row in metrics_mod.rows(snap, channels=names):
-        print(json.dumps({"kind": "metrics", "config": label, **row}),
+    if st.metrics != ():
+        from partisan_tpu import metrics as metrics_mod
+
+        snap = metrics_mod.snapshot(st.metrics)
+        for row in metrics_mod.rows(snap, channels=names):
+            print(json.dumps({"kind": "metrics", "config": label, **row}),
+                  file=sys.stderr)
+        print(json.dumps({"kind": "metrics_totals", "config": label,
+                          **metrics_mod.totals(snap)}), file=sys.stderr)
+    if getattr(st, "latency", ()) != ():
+        from partisan_tpu import latency as latency_mod
+
+        print(json.dumps({"kind": "latency", "config": label,
+                          **latency_mod.percentiles(st.latency,
+                                                    channels=names)}),
               file=sys.stderr)
-    print(json.dumps({"kind": "metrics_totals", "config": label,
-                      **metrics_mod.totals(snap)}), file=sys.stderr)
 
 
 def _sync(st) -> None:
@@ -792,8 +808,13 @@ if __name__ == "__main__":
                     help="run with the device-resident metrics ring on "
                          "and emit per-round series to stderr as JSON "
                          "lines (stdout is unchanged)")
+    ap.add_argument("--latency", action="store_true",
+                    help="run with the device-resident latency plane on "
+                         "and emit per-channel delivery-age percentiles "
+                         "to stderr as JSON lines (stdout is unchanged)")
     args = ap.parse_args()
     METRICS = METRICS or args.metrics
+    LATENCY = LATENCY or args.latency
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -32,6 +32,9 @@ METRICS_SHED_SPIKE = ("partisan", "metrics", "shed_spike")
 METRICS_DROP_SPIKE = ("partisan", "metrics", "drop_spike")
 METRICS_PARTITION = ("partisan", "metrics", "partition_detected")
 
+# Latency-plane SLO events (latency.py histograms -> discrete events).
+LATENCY_SLO_BREACH = ("partisan", "latency", "slo_breach")
+
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
 
@@ -120,8 +123,6 @@ def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
       out-edges, and a cold bootstrap is not a partition.
 
     Returns the number of events emitted."""
-    import numpy as np
-
     shed = np.asarray(snap["shed"])
     drops = np.asarray(snap["drops"]).sum(axis=1)
     edges_min = np.asarray(snap["edges_min"])
@@ -154,6 +155,39 @@ def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
                 bus.execute(event, meas, {"round": int(rnd)})
                 n_events += 1
             prev[key] = hot
+    return n_events
+
+
+def replay_latency_events(bus: Bus, lat_snap: Mapping[str, Any], *,
+                          slo_rounds: int, quantile: float = 0.99,
+                          channels: tuple[str, ...] | None = None) -> int:
+    """Replay a latency snapshot (``latency.snapshot`` /
+    ``latency.percentiles`` input) as SLO threshold-crossing events:
+    one ``partisan.latency.slo_breach`` per channel whose ``quantile``
+    delivery age is at or above ``slo_rounds`` rounds — the host-side
+    adapter from the device-resident age histograms to the telemetry
+    bus (same shape as :func:`replay_metrics_events`).
+
+    Returns the number of events emitted."""
+    from partisan_tpu import latency as latency_mod
+
+    if quantile not in (0.50, 0.95, 0.99):
+        raise ValueError(
+            f"quantile must be one of 0.50/0.95/0.99 (the percentiles "
+            f"the log2 histograms resolve), got {quantile}")
+    pcts = latency_mod.percentiles(dict(lat_snap), channels=channels)
+    label = f"p{int(round(quantile * 100))}"
+    n_events = 0
+    for ch_name, entry in pcts.items():
+        age = entry.get(label)
+        if age is None or age < slo_rounds:
+            continue
+        bus.execute(LATENCY_SLO_BREACH,
+                    {"age_rounds": int(age), "count": entry["count"],
+                     "max_age_rounds": entry["max"]},
+                    {"channel": ch_name, "quantile": label,
+                     "slo_rounds": int(slo_rounds)})
+        n_events += 1
     return n_events
 
 

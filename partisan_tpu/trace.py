@@ -120,6 +120,16 @@ class Trace:
                 f"payload={list(ev.payload)}")
         return "\n".join(lines)
 
+    def tail(self, k: int) -> "Trace":
+        """The last ``k`` recorded rounds as a Trace — the window a
+        flight-recorder ring of size k retains (latency.flight_trace),
+        for capture-vs-recorder equivalence checks.  ``k <= 0`` yields
+        an empty zero-round Trace (an explicit start index, not ``-k:``
+        — ``[-0:]`` would silently return everything)."""
+        k = max(0, min(k, self.n_rounds))
+        lo = self.n_rounds - k
+        return Trace(self.sent[lo:], self.dropped[lo:], self.rounds[lo:])
+
     # ---- persistence (partisan_trace_file.erl:26-61) -------------------
     def save(self, path) -> None:
         np.savez_compressed(path, version=TRACE_VERSION, sent=self.sent,

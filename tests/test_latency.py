@@ -1,0 +1,391 @@
+"""Latency-plane + flight-recorder suite (latency.py + the birth-round
+threading through cluster/delivery/channels/interpose):
+
+- the disabled default keeps ClusterState leaves empty () pytrees and
+  the wire record at msg_words — zero cost,
+- per-channel delivery-age histogram sums reconcile EXACTLY with the
+  metrics plane's per-channel delivered series (the acceptance
+  invariant), and drop-age rows with the cause taxonomy counts,
+- queued copies keep their birth: channel-capacity defers and ack
+  retransmissions measure their true end-to-end age,
+- sharded runs record bit-identical histograms (skips without
+  shard_map),
+- the flight recorder's decoded Trace matches Cluster.record's capture
+  of the same seeded run exactly, and roundtrips through the Perfetto
+  exporter with nothing lost.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import latency as latency_mod
+from partisan_tpu import metrics as metrics_mod
+from partisan_tpu import telemetry, trace
+from partisan_tpu import types as T
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, PlumtreeConfig
+from partisan_tpu.ops import msg as msg_ops
+
+
+def _faulted_hyparview_run(n=64, rounds=100, ring=256, **cfg_kw):
+    """The metrics suite's faulted hyparview+plumtree drive, with the
+    latency plane on (tight inbox so drop causes fire).  ONE scan
+    length throughout — every phase reuses the same compiled k=20
+    program (the scenarios.py program discipline)."""
+    from partisan_tpu.models.plumtree import Plumtree
+
+    assert rounds % 20 == 0
+    cfg = Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 max_broadcasts=4, inbox_cap=8,
+                 metrics=True, metrics_ring=ring, latency=True,
+                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4),
+                 **cfg_kw)
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    m = cl.manager.join_many(cfg, st.manager, list(range(1, n)),
+                             [0] * (n - 1))
+    st = cl.steps(st._replace(manager=m), 20)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 7))
+    alive = st.faults.alive.at[jnp.asarray([5, 17])].set(False)
+    st = st._replace(faults=st.faults._replace(
+        alive=alive, link_drop=jnp.float32(0.1)))
+    for _ in range((rounds - 20) // 20):
+        st = cl.steps(st, 20)
+    return cfg, cl, st
+
+
+_CACHE: dict = {}
+
+
+def _burst_state():
+    """Shared lane_rate=1 burst run (outbox-ages + SLO tests)."""
+    if "burst" not in _CACHE:
+        cfg = Config(n_nodes=4, seed=3, peer_service_manager="static",
+                     channel_capacity=True, lane_rate=1, latency=True)
+        cl = Cluster(cfg, model=_Burst())
+        _CACHE["burst"] = (cfg, cl.steps(cl.init(), 10))
+    return _CACHE["burst"]
+
+
+class _Burst:
+    """One sender fires a 4-message burst to node 0 at round 2 on the
+    default channel, one lane — the channel-capacity defer workload."""
+
+    def init(self, cfg, comm):
+        return jnp.int32(0)
+
+    def step(self, cfg, comm, state, ctx, nbrs):
+        gids = comm.local_ids()
+        fire = (ctx.rnd == 2) & (gids == 1)
+        dst = jnp.where(fire, 0, -1)
+        e = msg_ops.build(cfg.msg_words, T.MsgKind.APP, gids[:, None],
+                          jnp.broadcast_to(dst[:, None],
+                                           (comm.n_local, 4)),
+                          payload=[jnp.int32(7)])
+        e = e.at[..., T.W_KIND].set(
+            jnp.where(dst[:, None] >= 0, T.MsgKind.APP, 0))
+        return state, e
+
+
+def test_disabled_default_zero_overhead():
+    """latency=False (the default) must keep both leaves empty () and
+    the wire record exactly msg_words wide — no birth word, no arrays
+    on the hot path."""
+    cfg = Config(n_nodes=16, seed=1)
+    cl = Cluster(cfg)
+    st = cl.init()
+    assert st.latency == () and st.flight == ()
+    assert len(jax.tree.leaves(st.latency)) == 0
+    assert st.inbox.data.shape[-1] == cfg.msg_words
+    st2 = cl.steps(st, 5)
+    assert st2.latency == () and st2.flight == ()
+    assert st2.inbox.data.shape[-1] == cfg.msg_words
+    # no latency phase compiled into the default round
+    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 4))(st))
+    assert "round.latency" not in jaxpr and "round.flight" not in jaxpr
+
+
+def test_delivery_age_hist_reconciles_with_metrics():
+    """The acceptance invariant: per-channel histogram counts sum to
+    the metrics plane's deliveries per channel over the same window,
+    and age-attributable drop causes match count for count."""
+    cfg, _, st = _faulted_hyparview_run(rounds=100, ring=256)
+    assert st.inbox.data.shape[-1] == cfg.msg_words + 1
+    snap = latency_mod.snapshot(st.latency)
+    msnap = metrics_mod.snapshot(st.metrics)
+    assert (snap["deliver"].sum(axis=1)
+            == msnap["delivered"].sum(axis=0)).all()
+    tot = metrics_mod.totals(msnap)
+    assert snap["drop_age"][metrics_mod.CAUSE_FAULT].sum() \
+        == tot["drops_by_cause"]["fault_cut"]
+    assert snap["drop_age"][metrics_mod.CAUSE_DEAD].sum() \
+        == tot["drops_by_cause"]["dead_receiver"]
+    assert snap["drop_age"][metrics_mod.CAUSE_COMPACT].sum() \
+        == tot["drops_by_cause"]["compact_shed"]
+    assert snap["drop_age"][metrics_mod.CAUSE_OUTBOX].sum() \
+        == tot["drops_by_cause"]["outbox_shed"]
+    # age-unattributable rows are structurally zero (documented)
+    assert snap["drop_age"][metrics_mod.CAUSE_INBOX].sum() == 0
+    assert snap["drop_age"][metrics_mod.CAUSE_OTHER].sum() == 0
+    # the run exercised real traffic + fault-cut ages
+    assert snap["deliver"].sum() > 0
+    assert snap["drop_age"][metrics_mod.CAUSE_FAULT].sum() > 0
+    # percentile ordering is monotone and bounded by the exact maximum
+    for entry in latency_mod.percentiles(snap).values():
+        if entry["count"]:
+            assert entry["p50"] <= entry["p95"] <= entry["p99"] \
+                <= entry["max"]
+
+
+def test_outbox_defer_ages_exact():
+    """A lane_rate=1 burst of 4 same-edge sends delivers over 4 rounds
+    with ages 0,1,2,3 — deferred copies keep their birth round, so the
+    histogram and the high-water mark are exact."""
+    _, st = _burst_state()
+    snap = latency_mod.snapshot(st.latency)
+    ch0 = snap["deliver"][0]
+    assert ch0.sum() == 4
+    # ages 0,1,2,3 -> log2 buckets 0,1,2,2
+    assert ch0[0] == 1 and ch0[1] == 1 and ch0[2] == 2
+    assert snap["age_hwm"][0] == 3
+    assert snap["drop_age"].sum() == 0
+
+
+def test_compact_and_outbox_drop_ages_nonzero_reconcile():
+    """The compaction and outbox-shed age paths with REAL losses: the
+    drop-age rows must match the metrics plane's nonzero cause counts
+    (guards both cut sites, fast-path compaction + generic-path
+    throttle, against miscounting while the zero-only reconciliation
+    test stays green)."""
+    # fast wire path: 4-live burst compacted to 2 slots -> 2 compact
+    # sheds at age 0
+    cfg = Config(n_nodes=4, seed=3, peer_service_manager="static",
+                 partition_mode="groups", emit_compact=2,
+                 metrics=True, metrics_ring=32, latency=True)
+    cl = Cluster(cfg, model=_Burst())
+    st = cl.steps(cl.init(), 6)
+    snap = latency_mod.snapshot(st.latency)
+    tot = metrics_mod.totals(metrics_mod.snapshot(st.metrics))
+    assert tot["drops_by_cause"]["compact_shed"] == 2
+    assert snap["drop_age"][metrics_mod.CAUSE_COMPACT].sum() == 2
+    assert snap["deliver"].sum() == 2
+    # generic path: lane_rate=1 + outbox_cap=1 -> of 3 deferred sends
+    # 2 shed at the outbox cut
+    cfg2 = Config(n_nodes=4, seed=3, peer_service_manager="static",
+                  channel_capacity=True, lane_rate=1, outbox_cap=1,
+                  metrics=True, metrics_ring=32, latency=True)
+    cl2 = Cluster(cfg2, model=_Burst())
+    st2 = cl2.steps(cl2.init(), 6)
+    snap2 = latency_mod.snapshot(st2.latency)
+    tot2 = metrics_mod.totals(metrics_mod.snapshot(st2.metrics))
+    assert tot2["drops_by_cause"]["outbox_shed"] == 2
+    assert snap2["drop_age"][metrics_mod.CAUSE_OUTBOX].sum() == 2
+    assert snap2["deliver"].sum() == 2
+
+
+def test_retransmit_keeps_birth_round():
+    """An acked send retransmitted over a lossy link is delivered with
+    its ORIGINAL birth round: the high-water mark must exceed the
+    zero-queueing age a fresh send would record."""
+    from partisan_tpu.models.direct_mail import DirectMail
+
+    from support import boot_fullmesh
+
+    cfg = Config(n_nodes=16, seed=21, ack_cap=16, latency=True)
+    model = DirectMail(acked=True)
+    cl = Cluster(cfg, model=model)
+    st = boot_fullmesh(cl)
+    st = st._replace(
+        faults=st.faults._replace(link_drop=jnp.float32(0.5)),
+        model=model.broadcast(st.model, node=3, slot=0))
+    st = cl.steps(st, 30)
+    hwm = latency_mod.snapshot(st.latency)["age_hwm"]
+    assert int(hwm.max()) > 0
+
+
+def test_sharded_histograms_match_single_device():
+    """Latency histograms must be placement-invariant: every increment
+    is allsum/allmax-reduced before the accumulate."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map unavailable on this jax "
+                    "(parallel/sharded.py requires it)")
+    from partisan_tpu.models.anti_entropy import AntiEntropy
+    from partisan_tpu.parallel.sharded import ShardedCluster, make_mesh
+
+    cfg = Config(n_nodes=16, seed=3, latency=True, inbox_cap=24)
+
+    def drive(cl):
+        st = cl.init()
+        m = st.manager
+        for i in range(1, 16):
+            m = cl.manager.join(cfg, m, i, 0)
+        st = cl.steps(st._replace(manager=m), 10)
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0))
+        alive = st.faults.alive.at[7].set(False)
+        st = st._replace(faults=st.faults._replace(
+            alive=alive, link_drop=jnp.float32(0.2)))
+        return cl.steps(st, 30)
+
+    st_l = drive(Cluster(cfg, model=AntiEntropy()))
+    st_s = drive(ShardedCluster(cfg, make_mesh(), model=AntiEntropy()))
+    snap_l = latency_mod.snapshot(st_l.latency)
+    snap_s = latency_mod.snapshot(st_s.latency)
+    for name in ("deliver", "drop_age", "age_hwm"):
+        assert np.array_equal(snap_l[name], snap_s[name]), name
+    assert snap_l["deliver"].sum() > 0
+
+
+def _flight_run():
+    """Shared faulted hyparview run with the flight recorder on
+    (flight_rounds=8).  ONE scan length (k=10) for both the plain
+    steps path and the record path, so each compiles once; cached —
+    three tests read it.  Returns (cfg, flight_trace_of_30_more_rounds,
+    record_trace_of_same_30_rounds, base_state)."""
+    if "flight" in _CACHE:
+        return _CACHE["flight"]
+    from partisan_tpu.models.plumtree import Plumtree
+
+    cfg = Config(n_nodes=32, seed=5, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups", max_broadcasts=4,
+                 flight_rounds=8, latency=True,
+                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    m = cl.manager.join_many(cfg, st.manager, list(range(1, 32)),
+                             [0] * 31)
+    st = cl.steps(st._replace(manager=m), 10)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 7))
+    alive = st.faults.alive.at[jnp.asarray([5])].set(False)
+    base = st._replace(faults=st.faults._replace(
+        alive=alive, link_drop=jnp.float32(0.1)))
+    # path A: plain stepping, 3 x the SAME k=10 program
+    st = base
+    for _ in range(3):
+        st = cl.steps(st, 10)
+    flight = latency_mod.flight_trace(st.flight)
+    # path B: record the same 30 rounds in 3 k=10 chunks (one compile)
+    chunks, rst = [], base
+    for _ in range(3):
+        rst, traced = cl.record(rst, 10)
+        chunks.append(traced)
+    stacked = jax.tree.map(lambda *xs: np.concatenate(
+        [np.asarray(x) for x in xs], axis=0), *chunks)
+    recorded = trace.from_capture(stacked)
+    _CACHE["flight"] = (cfg, flight, recorded, base)
+    return _CACHE["flight"]
+
+
+def test_flight_recorder_matches_record_capture():
+    """The acceptance criterion: decoding the flight ring of a faulted
+    run yields a Trace identical to the last-K rounds of
+    Cluster.record's capture of the same seeded run."""
+    cfg, flight, full_record, _ = _flight_run()
+    recorded = full_record.tail(8)
+    assert np.array_equal(flight.rounds, recorded.rounds)
+    assert np.array_equal(flight.sent, recorded.sent)
+    assert np.array_equal(flight.dropped, recorded.dropped)
+    assert flight.matches(recorded)
+    # the window saw real traffic and real fault drops
+    assert sum(1 for _ in flight.events()) > 0
+    assert flight.dropped.sum() > 0
+
+
+def test_flight_shorter_than_ring_and_save_load(tmp_path):
+    """A run shorter than the ring reports only the rounds that ran,
+    and the decoded Trace persists through trace save/load."""
+    cfg = Config(n_nodes=4, seed=3, peer_service_manager="static",
+                 flight_rounds=32, latency=True)
+    cl = Cluster(cfg, model=_Burst())
+    st = cl.steps(cl.init(), 5)
+    flight = latency_mod.flight_trace(st.flight)
+    # the ring is always-on: fewer rounds than flight_rounds have run,
+    # so it holds every round so far
+    assert flight.n_rounds == 5
+    assert flight.rounds.tolist() == list(range(5))
+    # the burst (round 2) is in the window
+    assert sum(1 for _ in flight.events()) == 4
+    p = tmp_path / "flight.npz"
+    flight.save(p)
+    assert trace.Trace.load(p).matches(flight)
+
+
+def test_flight_roundtrip_perfetto_export(tmp_path):
+    """Satellite: flight dump -> Trace -> trace_export Perfetto JSON
+    validates — non-metadata event count equals Trace.events(), and
+    every fault-dropped slot becomes an instant event."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import trace_export
+
+    cfg, flight, _, _ = _flight_run()
+    out = tmp_path / "flight.json"
+    names = tuple(c.name for c in cfg.channels)
+    n = trace_export.export(flight, str(out), round_ms=cfg.round_ms,
+                            channels=names)
+    doc = json.loads(out.read_text())
+    events = doc["traceEvents"]
+    real = [e for e in events if e["ph"] != "M"]
+    assert n == len(real) == sum(1 for _ in flight.events())
+    instants = [e for e in real if e["ph"] == "i"]
+    assert len(instants) == int(flight.dropped.sum())
+    assert all(e["name"].startswith("DROPPED") for e in instants)
+    # phase named_scope names preserved as categories
+    assert {e["cat"] for e in instants} == {"round.fault"}
+    assert all(e["cat"] == "round.route"
+               for e in real if e["ph"] == "X")
+    # one track per node: every event's tid is its source node
+    for e in real:
+        assert e["tid"] == e["args"]["src"]
+
+
+def test_bridge_forward_drain_under_latency():
+    """The bridge injects msg_words-wide records and drains payloads:
+    with the latency plane on it must widen injections to wire_words
+    (stamped at the current round) and never leak the birth word as a
+    payload word to the Erlang side."""
+    from partisan_tpu.bridge import etf
+    from partisan_tpu.bridge.etf import Atom
+    from partisan_tpu.bridge.server import Bridge
+
+    br = Bridge()
+    assert br.handle((Atom("init"), {Atom("n_nodes"): 4,
+                                     Atom("latency"): True})) == etf.OK
+    assert br.handle((Atom("forward_message"), 1, 0, [42, 7])) == etf.OK
+    ok, _rnd = br.handle((Atom("step"), 1))
+    assert ok == etf.OK
+    ok, msgs = br.handle((Atom("drain"), 0))
+    assert ok == etf.OK
+    assert len(msgs) == 1
+    src, payload = msgs[0]
+    assert src == 1 and payload[:2] == [42, 7]
+    # payload words == msg_words - HDR_WORDS: the birth word is stripped
+    assert len(payload) == 12 - T.HDR_WORDS
+
+
+def test_slo_breach_events_on_bus():
+    """telemetry.replay_latency_events turns a p99 at-or-above the SLO
+    into one partisan.latency.slo_breach event per breaching channel."""
+    cfg, st = _burst_state()
+    snap = latency_mod.snapshot(st.latency)
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("slo", ("partisan", "latency"), rec)
+    n = telemetry.replay_latency_events(
+        bus, snap, slo_rounds=1,
+        channels=tuple(c.name for c in cfg.channels))
+    assert n == 1
+    event, meas, meta = rec.events[0]
+    assert event == telemetry.LATENCY_SLO_BREACH
+    assert meta["channel"] == "default"
+    assert meas["age_rounds"] >= 1 and meas["max_age_rounds"] == 3
+    # a generous SLO emits nothing
+    assert telemetry.replay_latency_events(bus, snap,
+                                           slo_rounds=100) == 0

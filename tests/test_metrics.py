@@ -135,6 +135,32 @@ def test_sharded_series_match_single_device():
     assert metrics_mod.totals(snap_l)["dropped"] > 0
 
 
+def test_cause_taxonomy_stays_in_sync():
+    """Guard: a new drop cause must update N_CAUSES, CAUSE_NAMES, the
+    rows() decoder, AND the latency plane's drop-age axis together — a
+    silent mismatch misaligns every exported column."""
+    from partisan_tpu import latency as latency_mod
+
+    assert len(metrics_mod.CAUSE_NAMES) == metrics_mod.N_CAUSES
+    # the CAUSE_* indices cover exactly [0, N_CAUSES)
+    idx = sorted(getattr(metrics_mod, k) for k in dir(metrics_mod)
+                 if k.startswith("CAUSE_") and k != "CAUSE_NAMES")
+    assert idx == list(range(metrics_mod.N_CAUSES))
+    # rows() labels the drops axis with the taxonomy, in order
+    cfg = Config(n_nodes=8, seed=1, metrics=True, metrics_ring=8)
+    cl = Cluster(cfg)
+    st = cl.steps(cl.init(), 3)
+    snap = metrics_mod.snapshot(st.metrics)
+    row = metrics_mod.rows(snap)[0]
+    assert tuple(row["drops"].keys()) == metrics_mod.CAUSE_NAMES
+    assert tuple(metrics_mod.totals(snap)["drops_by_cause"].keys()) \
+        == metrics_mod.CAUSE_NAMES
+    # the device-side drops vector and the latency drop-age axis are
+    # sized by the same constant
+    assert snap["drops"].shape[1] == metrics_mod.N_CAUSES
+    assert latency_mod.init(cfg).drop_age.shape[0] == metrics_mod.N_CAUSES
+
+
 def test_metrics_state_is_scan_carry_no_callbacks():
     """The acceptance criterion's 'no host transfer inside the scan':
     the metrics ring rides the lax.scan carry — the jitted k-round

@@ -1,0 +1,74 @@
+"""tools/metrics_report.py CLI smoke test (the exporter previously had
+zero tests): run it on a tiny cluster, parse the JSON-lines output, and
+check per-round reconciliation plus header/taxonomy sync."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from partisan_tpu import metrics as metrics_mod
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_report(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "metrics_report.py"),
+         *args],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return [json.loads(line) for line in out.stdout.strip().splitlines()]
+
+
+def test_metrics_report_cli_smoke_reconciles():
+    rows = _run_report("32", "20")
+    kinds = [r["kind"] for r in rows]
+    assert kinds[-1] == "totals"
+    rounds = [r for r in rows if r["kind"] == "round"]
+    assert rounds, "no per-round lines emitted"
+    # consecutive rounds, self-describing channel + cause axes
+    assert [r["round"] for r in rounds] == \
+        list(range(rounds[0]["round"], rounds[0]["round"] + len(rounds)))
+    for r in rounds:
+        assert tuple(r["drops"].keys()) == metrics_mod.CAUSE_NAMES
+        assert set(r["emitted"].keys()) == set(r["delivered"].keys())
+        # per-round reconciliation: the cause sum closes each round's
+        # emitted-minus-delivered delta exactly
+        assert sum(r["drops"].values()) == \
+            sum(r["emitted"].values()) - sum(r["delivered"].values())
+    # trailing totals line reconciles with the legacy cumulative Stats
+    tot = rows[-1]
+    assert tuple(tot["drops_by_cause"].keys()) == metrics_mod.CAUSE_NAMES
+    legacy = tot["legacy_stats"]
+    assert tot["emitted"] == legacy["emitted"]
+    assert tot["delivered"] == legacy["delivered"]
+    assert tot["dropped"] == legacy["dropped"]
+    assert tot["emitted"] == int(np.sum(
+        [sum(r["emitted"].values()) for r in rounds]))
+
+
+def test_metrics_report_headers_match_taxonomy():
+    """The exporter's column labels are the taxonomy itself — rows()
+    is the single source, so a new cause cannot silently misalign."""
+    snap = {
+        "rounds": np.asarray([0]),
+        "emitted": np.zeros((1, 2), np.int32),
+        "delivered": np.zeros((1, 2), np.int32),
+        "causal": np.zeros(1, np.int32),
+        "shed": np.zeros(1, np.int32),
+        "drops": np.zeros((1, metrics_mod.N_CAUSES), np.int32),
+        "inbox_hwm": np.zeros(1, np.int32),
+        "inbox_occ": np.zeros(1, np.int32),
+        "edges_total": np.zeros(1, np.int32),
+        "edges_min": np.zeros(1, np.int32),
+        "edges_max": np.zeros(1, np.int32),
+        "alive": np.zeros(1, np.int32),
+        "dlv_overflow": np.zeros(1, np.int32),
+    }
+    row = metrics_mod.rows(snap)[0]
+    assert tuple(row["drops"].keys()) == metrics_mod.CAUSE_NAMES
+    assert len(row["drops"]) == metrics_mod.N_CAUSES

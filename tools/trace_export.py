@@ -1,0 +1,124 @@
+"""Export a recorded execution to Perfetto / Chrome ``trace_event`` JSON.
+
+Converts a :class:`partisan_tpu.trace.Trace` — whether captured by
+``Cluster.record`` or decoded from the flight-recorder ring
+(``latency.flight_trace``) — into the ``trace_event`` format both
+``chrome://tracing`` and https://ui.perfetto.dev load directly:
+
+- **one track per node**: every event lands on thread ``src`` of one
+  shared process, with thread-name metadata (``node <i>``) so the UI
+  labels the tracks,
+- **sends** are complete events (``ph: "X"``) named by their
+  ``MsgKind``, spanning the round's virtual duration (``round_ms``),
+- **drop events are instants** (``ph: "i"``): a slot the fault stage
+  cleared becomes ``DROPPED <kind>`` at its send timestamp,
+- **phase named_scope names preserved**: each event's ``cat`` is the
+  ``jax.named_scope`` label of the round phase that produced it —
+  ``round.route`` for deliveries, ``round.fault`` for fault drops —
+  so Perfetto's category filter matches the profiler traces
+  (``tools/profile_round.py``) phase for phase.
+
+Usage::
+
+    python tools/trace_export.py trace.npz out.json [--round-ms 1000]
+
+Importable: ``to_trace_events(trace)`` returns the event list;
+``export(trace, path)`` writes the JSON file.  Event-count contract
+(tests/test_latency.py roundtrip): the number of non-metadata events
+equals ``sum(1 for _ in trace.events())`` — nothing recorded is lost
+in export.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PID = 1
+
+# jax.named_scope phase labels (cluster.round_body) — the category each
+# event class maps to.
+PHASE_ROUTE = "round.route"
+PHASE_FAULT = "round.fault"
+
+
+def to_trace_events(tr, *, round_ms: int = 1000,
+                    channels: tuple[str, ...] | None = None) -> list[dict]:
+    """Flatten ``tr.events()`` into trace_event dicts (plus thread/
+    process metadata rows, ``ph: "M"``)."""
+    us = round_ms * 1000
+    events: list[dict] = [{
+        "name": "process_name", "ph": "M", "pid": PID,
+        "args": {"name": "partisan_tpu"},
+    }]
+    seen_nodes: set[int] = set()
+    for ev in tr.events():
+        ts = ev.rnd * us
+        ch = (channels[ev.channel]
+              if channels is not None and 0 <= ev.channel < len(channels)
+              else ev.channel)
+        args = {"src": ev.src, "dst": ev.dst, "channel": ch,
+                "clock": ev.clock, "slot": ev.slot, "round": ev.rnd}
+        seen_nodes.add(ev.src)
+        if ev.dropped:
+            events.append({
+                "name": f"DROPPED {ev.kind_name}", "ph": "i", "ts": ts,
+                "pid": PID, "tid": ev.src, "s": "t",
+                "cat": PHASE_FAULT, "args": args,
+            })
+        else:
+            events.append({
+                "name": ev.kind_name, "ph": "X", "ts": ts, "dur": us,
+                "pid": PID, "tid": ev.src,
+                "cat": PHASE_ROUTE, "args": args,
+            })
+    for node in sorted(seen_nodes):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": PID, "tid": node,
+            "args": {"name": f"node {node}"},
+        })
+    return events
+
+
+def export(tr, path: str, *, round_ms: int = 1000,
+           channels: tuple[str, ...] | None = None) -> int:
+    """Write ``{"traceEvents": [...]}`` to ``path``; returns the number
+    of non-metadata events written."""
+    events = to_trace_events(tr, round_ms=round_ms, channels=channels)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return sum(1 for e in events if e["ph"] != "M")
+
+
+def main() -> None:
+    from partisan_tpu.trace import Trace
+
+    argv = sys.argv[1:]
+    round_ms, args, i = 1000, [], 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--round-ms"):
+            if "=" in a:
+                round_ms = int(a.split("=", 1)[1])
+            else:
+                i += 1
+                round_ms = int(argv[i])
+        else:
+            args.append(a)
+        i += 1
+    if len(args) != 2:
+        print("usage: trace_export.py <trace.npz> <out.json> "
+              "[--round-ms N]", file=sys.stderr)
+        raise SystemExit(2)
+    tr = Trace.load(args[0])
+    n = export(tr, args[1], round_ms=round_ms)
+    print(f"{n} events ({tr.n_rounds} rounds, {tr.n_nodes} nodes) "
+          f"-> {args[1]}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
