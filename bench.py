@@ -15,15 +15,26 @@ partisan_hyparview_peer_service_manager.erl:59.  No live 16-node trace
 exists to validate against — the image has no BEAM; the honest
 substitute is the bridge-path trace in tests/test_bridge_trace16.py.)
 
-Program structure (the round-2 32k wall was COMPILE count, not compute:
-five distinct scan lengths × ~45 s XLA compile each at n=32k): every
+Program structure (the round-2 32k wall was COMPILE count, not compute;
+the round-5 bootstrap wall was program LOAD — the per-rung ladder
+programs ≈ 90 MB serialized crossing the relay at ~1.5 MB/s): every
 phase — bootstrap waves, settle, convergence checks, steady-state
-timing — runs the SAME k=10 program, so each size pays exactly one
-compile, and the scan carry is donated so steady-state re-executions
-reuse the state buffers in place.
+timing — runs the SAME k=10 program, the bootstrap ladder drives its
+rung widths through the n_active WIDTH OPERAND (Config.width_operand,
+scenarios._boot_ladder) so every rung shares that one program, and the
+scan carry is donated so steady-state re-executions reuse the state
+buffers in place.  Net: ONE serialized round program per bench size.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-Per-phase wall timings go to stderr as one JSON object per size.
+Measurement protocol (VERDICT r5 weak #3/#4): each size runs WARM
+median-of-N (N>=3 budget permitting) with min/max spread and a
+relay-stall count — stalled runs are counted, not hand-filtered — plus
+one COLD run in a fresh compilation-cache dir (--cache-dir) so the
+artifact records first-execution wall and the program-build
+(cold first_exec) vs program-load (warm first_exec) split.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
+with per-size "warm"/"cold" sections.  Per-phase wall timings go to
+stderr as one JSON object per run.
 """
 
 import json
@@ -102,6 +113,10 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
                       # threading + per-channel delivery-age histograms
                       # in the carry; percentiles go to STDERR only
                       latency=latency,
+                      # ONE width-generic round program for the whole
+                      # bootstrap ladder: rung width rides the n_active
+                      # operand instead of recompiling per width
+                      width_operand=True,
                       hyparview=HyParViewConfig(
                           isolation_window_ms=25_000),
                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
@@ -131,17 +146,26 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
     mark("init", t0)
 
     # Width-ladder bootstrap (scenarios._boot_ladder): the early join
-    # waves run on PREFIX-width clusters (4k, 32k) and the state grows
-    # between rungs, so only the last wave(s) + settle pay full-width
-    # rounds — the r4 bootstrap was 8 full-width waves at ~10 s each.
-    # Wave factor 8 and the join-retry/settle envelope are unchanged
-    # (validated on CPU: one component at boot end, convergence rounds
-    # unchanged); `smallw_boot` is the wall spent below full width
-    # (including the small rungs' compiles).
+    # waves run on an ACTIVE PREFIX of the one full-width program (the
+    # n_active operand — no per-rung compile, serialize or relay load),
+    # widening between rungs in place.  Wave factors and the
+    # join-retry/settle envelope are unchanged from the validated r5
+    # schedule (one component at boot end, convergence rounds
+    # unchanged).  Phase split, all from THIS run's artifact (the r5
+    # notes/JSON divergence is closed by construction):
+    #   first_exec   — wall to the end of the FIRST ladder execution:
+    #                  jit trace + XLA build (cold cache) or serialized
+    #                  program load (warm cache) + the first K_PROG
+    #                  rounds.  The warm/cold first_exec pair IS the
+    #                  program-load vs program-build split.
+    #   smallw_boot  — wall below full activation (sub-n rung waves).
     t0 = time.perf_counter()
     full_w = {}
 
     def on_wave(hi, wave_st, width):
+        if "first_exec" not in phases:
+            sync(wave_st)
+            phases["first_exec"] = round(time.perf_counter() - t0, 3)
         if width < n:    # still on a sub-full-width rung: sync is cheap
             sync(wave_st)
             full_w["smallw_end"] = time.perf_counter()
@@ -256,13 +280,18 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
     return result
 
 
-def _run_one_subprocess(n: int, timeout_s: float) -> dict | None:
+def _run_one_subprocess(n: int, timeout_s: float,
+                        cache_dir: str | None = None) -> dict | None:
     """Run one ladder size in a FRESH interpreter: a TPU device error
     poisons the process context, so in-process retries always fail —
-    subprocess isolation makes each attempt independent."""
+    subprocess isolation makes each attempt independent.  ``cache_dir``
+    points the run at a specific compilation-cache dir (a fresh temp
+    dir = a COLD run: the program is built, not loaded)."""
     import subprocess
 
     cmd = [sys.executable, __file__, "--one", str(n)]
+    if cache_dir is not None:
+        cmd += ["--cache-dir", cache_dir]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout_s)
@@ -284,77 +313,168 @@ def _run_one_subprocess(n: int, timeout_s: float) -> dict | None:
     return None
 
 
+WARM_RUNS = 3                  # warm median-of-N target per size
+STALL_MARGIN_S = 30.0          # a run this far above the fastest run's
+#                                total is counted as relay-stalled
+#                                (BENCH_NOTES: 60-80 s session-recycle
+#                                stalls); stalls are COUNTED in the
+#                                artifact, never hand-filtered out
+
+
+def _spread(vals) -> dict:
+    import statistics
+
+    vals = sorted(vals)
+    return {"median": round(statistics.median(vals), 3),
+            "min": round(vals[0], 3), "max": round(vals[-1], 3)}
+
+
+def _aggregate_warm(runs: list[dict]) -> dict:
+    """Warm median-of-N section: spread + stall count over all retained
+    runs (every run that produced a result is retained)."""
+    totals = [r["phases"]["total"] for r in runs]
+    stalls = sum(1 for t in totals if t > min(totals) + STALL_MARGIN_S)
+    agg = {
+        "runs": len(runs),
+        "rounds_per_sec": _spread([r["rounds_per_sec"] for r in runs]),
+        "total_s": _spread(totals),
+        "bootstrap_s": _spread([r["phases"].get("bootstrap", 0.0)
+                                for r in runs]),
+        "first_exec_s": _spread([r["phases"].get("first_exec", 0.0)
+                                 for r in runs]),
+        "convergence_rounds": [r["convergence_rounds"] for r in runs],
+        "convergence_wall_s": _spread([r["convergence_wall_s"]
+                                       for r in runs]),
+        "stalls": stalls,
+        "run_phases": [r["phases"] for r in runs],
+    }
+    return agg
+
+
+def _cold_section(cold: dict | None, warm: dict | None,
+                  skipped: str | None = None) -> dict:
+    """Cold section (VERDICT next #2: the ~342 s cold start was
+    BENCH_NOTES prose only): first-execution wall from a fresh
+    compilation cache, and the program-BUILD (cold first_exec) vs
+    program-LOAD (warm median first_exec) split."""
+    if skipped:
+        return {"skipped": skipped}
+    if cold is None:
+        return {"skipped": "cold run produced no result"}
+    out = {
+        "total_s": cold["phases"]["total"],
+        "bootstrap_s": cold["phases"].get("bootstrap"),
+        "first_exec_s": cold["phases"].get("first_exec"),
+        "program_build_s": cold["phases"].get("first_exec"),
+        "phases": cold["phases"],
+    }
+    if warm is not None:
+        out["program_load_s"] = warm["first_exec_s"]["median"]
+        out["build_vs_load_s"] = [out["program_build_s"],
+                                  out["program_load_s"]]
+    return out
+
+
 def main() -> None:
     # Ladder: the HEADLINE size runs FIRST with the full per-size cap —
-    # a cold-cache 100k run needs nearly all of it (compile ~137 s +
-    # bootstrap ~108 s), and any smaller rung run before it starves it.
-    # 32k is the fallback scale rung, 4k the emergency fallback.
+    # its warm median-of-N is the artifact's core; its cold run comes
+    # after the medians (the highest-value extra), and 32k runs with
+    # whatever budget remains.  4k is the emergency fallback.
+    import tempfile
+
     t_start = time.time()
     results: dict[int, dict] = {}
+
+    def remaining() -> float:
+        return TIME_BUDGET_S - (time.time() - t_start) - 10
+
     for n in (100_000, 32_768):
-        if 100_000 in results and \
-                TIME_BUDGET_S - (time.time() - t_start) < 220:
+        if 100_000 in results and remaining() < 220:
             break    # headline landed; 32k only if it comfortably fits
-        remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
-        if results and remaining < 90:
+        if results and remaining() < 90:
             break
-        got = None
-        attempts = 2 if remaining > PER_SIZE_CAP_S + 60 else 1
-        for attempt in range(1, attempts + 1):
-            remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
-            if remaining < 60 and results:
+        runs: list[dict] = []
+        for attempt in range(1, WARM_RUNS + 1):
+            # first successful run gets the full cap (and a retry —
+            # relay session-recycle failures are intermittent, see
+            # BENCH_NOTES); once one result exists, further runs (warm
+            # target <50 s) must fit comfortably
+            if runs and remaining() < 90:
+                break
+            if not runs and remaining() < (60 if results else 120):
                 break
             got = _run_one_subprocess(
-                n, timeout_s=max(60.0, min(PER_SIZE_CAP_S, remaining)))
+                n, timeout_s=max(60.0, min(PER_SIZE_CAP_S, remaining())))
             if got is not None:
-                break
-            print(f"n={n} attempt {attempt} produced no result",
-                  file=sys.stderr)
-        if got is None:
-            break                # keep the smaller sizes' results
-        # Relay session-recycle stalls (BENCH_NOTES) intermittently
-        # inflate ONE run by 30-80 s of non-simulation wall (program
-        # load / backend bring-up); when a run looks stalled and the
-        # budget allows, take a second attempt and keep the cleaner
-        # run — this also turns a cold-cache first run (compiles
-        # dominate) into a warm measurement.
-        total = got.get("phases", {}).get("total", 0.0)
-        remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
-        if total > 85 and remaining > 140:
-            again = _run_one_subprocess(
-                n, timeout_s=max(60.0, min(PER_SIZE_CAP_S, remaining)))
-            if again is not None and \
-                    again["phases"]["total"] < total:
-                again["attempts"] = 2
-                got = again
-        results[n] = got
+                runs.append(got)
+            else:
+                print(f"n={n} warm run {attempt} produced no result",
+                      file=sys.stderr)
+        if not runs:
+            continue             # rung is failing; try the next size
+        entry = {"n": n, "warm": _aggregate_warm(runs),
+                 "rep": min(runs, key=lambda r: abs(
+                     r["phases"]["total"]
+                     - sorted(x["phases"]["total"] for x in runs)[
+                         len(runs) // 2]))}
+        results[n] = entry
+    # Cold run (fresh cache dir -> program BUILD, not load), for the
+    # headline size, LAST: it gets everything left in the budget (a
+    # full 100k cold was ~342 s in the 3-program world; one program
+    # should be well under, but capping it at PER_SIZE_CAP_S inside
+    # the size loop risked burning ~300 s to a timeout AND starving
+    # the 32k rung).  A failed/short-budget cold costs nothing but
+    # itself and is recorded as skipped.
+    if results:
+        top_n = max(results)
+        if remaining() > 240:
+            import shutil
+
+            cold_dir = tempfile.mkdtemp(prefix="ptpu_cold_cache_")
+            try:
+                cold = _run_one_subprocess(
+                    top_n, timeout_s=max(60.0, remaining()),
+                    cache_dir=cold_dir)
+            finally:
+                # the cold cache holds the full serialized round
+                # program (~60 MB at 100k) — never reused, always
+                # reaped
+                shutil.rmtree(cold_dir, ignore_errors=True)
+            results[top_n]["cold"] = _cold_section(
+                cold, results[top_n]["warm"])
+        else:
+            results[top_n]["cold"] = _cold_section(None, None,
+                                                   skipped="budget")
     if not results:
         # emergency fallback, still inside the wall budget
-        remaining = TIME_BUDGET_S - (time.time() - t_start) - 10
         got = _run_one_subprocess(
-            4_096, timeout_s=max(60.0, min(120.0, remaining)))
+            4_096, timeout_s=max(60.0, min(120.0, remaining())))
         if got is not None:
-            results[4_096] = got
+            results[4_096] = {"n": 4_096,
+                              "warm": _aggregate_warm([got]),
+                              "rep": got}
     if not results:
         raise SystemExit("bench failed at every size")
     top = results[max(results)]
+    warm = top["warm"]
     print(json.dumps({
         "metric": (f"simulated gossip rounds/sec "
                    f"({top['n']}-node hyparview+plumtree)"),
-        "value": round(top["rounds_per_sec"], 2),
+        "value": warm["rounds_per_sec"]["median"],
         "unit": "rounds/sec",
         # live system: 1 round == 1 s wall clock (round_ms = 1000)
-        "vs_baseline": round(top["rounds_per_sec"], 2),
-        "convergence_rounds": top["convergence_rounds"],
-        "convergence_wall_s": top["convergence_wall_s"],
-        "all_sizes": {str(k): {"rounds_per_sec": round(v["rounds_per_sec"], 2),
-                               "convergence_rounds": v["convergence_rounds"],
-                               "convergence_wall_s": v["convergence_wall_s"]}
-                      for k, v in results.items()},
-        # north-star target: 100k convergence <60s (BASELINE.md); the
-        # 16-node live-BEAM validation is impossible in this image — the
-        # honest substitute is the committed bridge-path wire trace
-        "north_star": "100k convergence wall <60s",
+        "vs_baseline": warm["rounds_per_sec"]["median"],
+        "convergence_rounds": top["rep"]["convergence_rounds"],
+        "convergence_wall_s": warm["convergence_wall_s"]["median"],
+        "all_sizes": {
+            str(k): {"warm": v["warm"],
+                     **({"cold": v["cold"]} if "cold" in v else {})}
+            for k, v in results.items()},
+        # run goal (VERDICT r5 next #1): 100k WARM total < 50 s,
+        # bootstrap < 35 s, convergence rounds unchanged (20), one
+        # component — with one serialized round program per size
+        "north_star": ("100k warm total <50s, bootstrap <35s, "
+                       "convergence wall <60s"),
         "validation": ("bridge-path 16-node trace "
                        "(tools/traces/trace16.json); no live BEAM in "
                        "image"),
@@ -363,6 +483,13 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        if "--cache-dir" in sys.argv:
+            # cold-start knob: point THIS run at a caller-chosen
+            # compilation-cache dir (fresh temp dir = cold: the round
+            # program is traced + XLA-built, not loaded).  Must land
+            # before the backend initializes in run().
+            cache_dir = sys.argv[sys.argv.index("--cache-dir") + 1]
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
         r = run(int(sys.argv[2]), verbose=True,
                 metrics="--metrics" in sys.argv,
                 latency="--latency" in sys.argv)

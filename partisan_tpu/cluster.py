@@ -65,6 +65,14 @@ class ClusterState(NamedTuple):
     #                         when Config.latency is off — zero cost)
     flight: Any = ()        # latency.FlightState wire-capture ring (or
     #                         () when Config.flight_rounds is 0)
+    n_active: Any = ()      # int32 scalar — active prefix width (or ()
+    #                         when Config.width_operand is off).  Rows
+    #                         with gid >= n_active are inert: dead to
+    #                         the wire, frozen in managers/models, and
+    #                         masked out of metrics/latency reductions,
+    #                         so one full-width round program serves
+    #                         every prefix width (the bootstrap ladder
+    #                         shares ONE XLA program across rungs).
 
 
 class TraceRound(NamedTuple):
@@ -91,12 +99,29 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     # state actually carrying a ring so shape discovery (eval_shape on
     # a flight=() state) and latency-only runs stay recorder-free.
     fx = latency_mod.flight_enabled(cfg) and state.flight != ()
+    wx = cfg.width_operand  # static: active-prefix masking
     gids = comm.local_ids()
     keys = rng.node_keys(cfg.seed, state.rnd, gids)
     alive_local = jax.lax.dynamic_slice(
         state.faults.alive, (comm.node_offset,), (comm.n_local,))
+    # Active-prefix masking (Config.width_operand): rows with gid >=
+    # n_active are inert — their ctx.alive reads dead (managers/models/
+    # delivery freeze and silence them exactly like crash-stopped
+    # nodes), the WIRE's destination facts mark them dead (nothing can
+    # be delivered to them), and the metrics/latency alive reductions
+    # exclude them — so the prefix [0, n_active) evolves bit-identically
+    # to a native n_nodes=n_active run while high rows keep their init
+    # values.  state.faults itself stays unmasked (see RoundCtx.faults).
+    faults_wire = state.faults
+    if wx:
+        act_g = jnp.arange(cfg.n_nodes, dtype=jnp.int32) < state.n_active
+        alive_g = state.faults.alive & act_g
+        faults_wire = state.faults._replace(alive=alive_g)
+        alive_local = jax.lax.dynamic_slice(
+            alive_g, (comm.node_offset,), (comm.n_local,))
     ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
-                   inbox=state.inbox, faults=state.faults)
+                   inbox=state.inbox, faults=state.faults,
+                   n_active=state.n_active)
 
     # jax.named_scope labels each phase in the HLO, so profiler traces
     # (tools/profile_round.py under jax.profiler) map to round phases.
@@ -180,7 +205,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 dst_w = emc[..., 2]
                 backed = (comm.gather_vec(state.inbox.drops > 0)
                           if want_shed else None)
-                info_d = faults_mod.pack_wire_info(state.faults, backed)[
+                info_d = faults_mod.pack_wire_info(faults_wire, backed)[
                     jnp.clip(dst_w, 0, cfg.n_nodes - 1)]       # ONE gather
                 shed_n = jnp.int32(0)
                 shed_m = None
@@ -202,7 +227,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                     state.faults.partition, (comm.node_offset,),
                     (comm.n_local,))
                 cut = faults_mod.wire_cut_from_info(
-                    state.faults, info_d, kind_w != 0, gids, dst_w,
+                    faults_wire, info_d, kind_w != 0, gids, dst_w,
                     alive_local, group_l, cfg.seed, state.rnd,
                     _MSG_FILTER_TAG)
                 final = emc.at[..., 0].set(jnp.where(cut, 0, kind_w))
@@ -340,7 +365,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         with jax.named_scope("round.fault"):
             sent = emitted
             emitted = faults_mod.filter_msgs(
-                state.faults, emitted, cfg.seed, state.rnd,
+                faults_wire, emitted, cfg.seed, state.rnd,
                 _MSG_FILTER_TAG)
             fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
         if fx:
@@ -465,17 +490,43 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 emitted_ch=emit_ch, delivered_ch=deliver_ch,
                 causal=causal_delivered, shed=m_shed, drops=drops_vec,
                 inbox_count=inbox.count, alive_local=alive_local,
-                alive_global=state.faults.alive, nbrs=nbrs_m,
+                alive_global=faults_wire.alive, nbrs=nbrs_m,
                 dlv_overflow=dlv_of)
     out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
                        inbox=inbox, manager=mstate, model=dstate_model,
                        delivery=dstate, stats=stats, interpose=istate,
                        outbox=obstate, metrics=mets, latency=lt,
-                       flight=fstate)
+                       flight=fstate, n_active=state.n_active)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent,
                                dropped=fault_dropped)
     return out
+
+
+def activate(state: ClusterState, width) -> ClusterState:
+    """Set the active prefix width (Config.width_operand runs): the
+    in-place successor of scenarios._grow_state — rows [old, width)
+    simply become live, their leaves already holding init values (the
+    masking above guarantees inert rows were never written).  A dynamic
+    operand change, so NO retrace/recompile: the same round program
+    serves every width."""
+    if isinstance(state.n_active, tuple):
+        raise ValueError(
+            "activate() needs Config.width_operand=True (the state "
+            "carries no n_active operand)")
+    return state._replace(n_active=jnp.asarray(width, jnp.int32))
+
+
+def active_alive(state: ClusterState) -> Array:
+    """bool[n_global]: faults.alive restricted to the active prefix —
+    what coverage/conformance reductions should use on width-operand
+    states (on a fully-activated or non-width-operand state this IS
+    faults.alive)."""
+    alive = state.faults.alive
+    if isinstance(state.n_active, tuple):
+        return alive
+    n = alive.shape[0]
+    return alive & (jnp.arange(n, dtype=jnp.int32) < state.n_active)
 
 
 def run_until(cluster: Any, state: ClusterState, pred, max_rounds: int,
@@ -568,6 +619,8 @@ class Cluster:
                      if metrics_mod.enabled(cfg) else ()),
             latency=(latency_mod.init(cfg)
                      if latency_mod.enabled(cfg) else ()),
+            n_active=(jnp.int32(cfg.n_nodes) if cfg.width_operand
+                      else ()),
         )
 
     def _build_init(self) -> ClusterState:

@@ -291,6 +291,32 @@ class Config:
     #                              uniform traffic fills 1/factor of it;
     #                              size so steady-state sheds are zero
 
+    # --- width-generic round program (bootstrap ladder) ----------------
+    width_operand: bool = False  # carry the ACTIVE PREFIX WIDTH as a
+    #                              dynamic int32 scalar in ClusterState
+    #                              (n_active): rows with gid >= n_active
+    #                              are inert — treated as dead by the
+    #                              wire/fault stage, frozen and silent in
+    #                              managers/models/delivery (their
+    #                              ctx.alive is masked), and excluded
+    #                              from metrics/latency alive reductions
+    #                              — so ONE round program compiled at
+    #                              n_nodes serves every prefix width.
+    #                              This is what lets the bootstrap
+    #                              ladder's rungs share a single XLA
+    #                              program instead of compiling (and
+    #                              relay-loading) one scan per rung.
+    #                              Off = the ClusterState leaf is () and
+    #                              the round is bit-identical to before.
+    #                              Prefix dynamics contract: a run at
+    #                              n_active=w is bit-identical on rows
+    #                              [0, w) to a native n_nodes=w run —
+    #                              ids are global, the hash-RNG streams
+    #                              are id-keyed, and every full-range
+    #                              random picker is bounded by the
+    #                              operand (tests/test_program_budget.py
+    #                              enforces this).
+
     # --- fault-state representation ------------------------------------
     partition_mode: str = "auto"  # auto | dense | groups — dense bool[n,n]
     #                               supports arbitrary edge cuts; groups

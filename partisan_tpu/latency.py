@@ -193,10 +193,13 @@ def record_round(cfg: Config, comm, ls: LatencyState, *, rnd: Array,
                  compact_hist: Array, outbox_hist: Array) -> LatencyState:
     """Accumulate one round's ages.  ``inbox_data`` is the routed inbox
     BEFORE the dead-receiver masking (``[n_local, cap, W]``) and
-    ``dead`` its per-node mask; the three drop histograms arrive
-    shard-local from their cut sites.  Every increment is reduced here
-    (allsum / allmax), keeping the state replicated — this runs inside
-    the jitted scan body, zero host syncs."""
+    ``dead`` its per-node mask (under ``Config.width_operand`` the mask
+    already includes the inactive prefix rows — whose inboxes are
+    structurally empty, so the histograms match a native-width run's);
+    the three drop histograms arrive shard-local from their cut sites.
+    Every increment is reduced here (allsum / allmax), keeping the
+    state replicated — this runs inside the jitted scan body, zero
+    host syncs."""
     from partisan_tpu.metrics import CAUSE_COMPACT, CAUSE_DEAD, \
         CAUSE_FAULT, CAUSE_OUTBOX
 

@@ -32,9 +32,22 @@ class RoundCtx(NamedTuple):
 
     rnd: Array    # int32 scalar — current round number
     alive: Array  # bool[n_local] — crash mask for THIS shard's nodes
+    #               (already AND-ed with the active-prefix mask when
+    #               Config.width_operand is on: an inactive row reads
+    #               as dead and must stay frozen and silent)
     keys: Array   # PRNGKey[n_local] — per-node round keys (ops/rng.py)
     inbox: Inbox  # last round's deliveries
-    faults: Any   # faults.FaultState (global) — for edge filtering
+    faults: Any   # faults.FaultState (global) — for edge filtering.
+    #               NOT masked by the active prefix (the managers'
+    #               cheap identity-predicates — hyparview's prune gate,
+    #               the heartbeat root argmax — must see the raw crash
+    #               mask); anything that could ADDRESS a node must use
+    #               ctx.alive / n_active instead.
+    n_active: Any = ()  # int32 scalar — active prefix width when
+    #               Config.width_operand is on ((), meaning n_global,
+    #               otherwise).  Full-range random id draws (rejoin
+    #               contacts, discovery fallbacks) MUST be bounded by
+    #               it so prefix dynamics match a native-width run.
 
 
 class Manager(Protocol):

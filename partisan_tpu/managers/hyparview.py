@@ -329,7 +329,16 @@ class HyParView:
         # fire rounds.  Between cadence ticks of a settled overlay BOTH
         # skip, and during a broadcast's dissemination (no membership
         # churn) the manager stays almost entirely quiet.
-        sh_fire = ((ctx.rnd + ph) % cfg.shuffle_every == 0) & (asize0 > 0)
+        # All cadenced timers are alive-gated: a crash-stopped (or
+        # width-operand-inactive) node must not flip a round cad-busy —
+        # its emissions would be killed by the live mask below anyway,
+        # but the cad body's view-snapshot gather + walks would still
+        # run, and the dead-slot payload residue would break the
+        # width-operand trace-parity contract (an inactive row firing
+        # pr_fire made rounds busy that a native-width run leaves
+        # quiet).
+        sh_fire = ((ctx.rnd + ph) % cfg.shuffle_every == 0) \
+            & (asize0 > 0) & ctx.alive
         # Random promotion stays PER-NODE STAGGERED even under aligned
         # timers: it is the view-healing path broadcast stragglers
         # depend on, and aligning it measured +18 convergence rounds at
@@ -337,10 +346,10 @@ class HyParView:
         # only fires for under-full nodes, so a settled overlay still
         # reaches the quiet path every non-shuffle round.
         pr_fire = ((ctx.rnd + gids) % cfg.promotion_every == 0) & \
-            (asize0 < hv.active_min)
+            (asize0 < hv.active_min) & ctx.alive
         if hv.xbot:
             x_timer = ((ctx.rnd + ph) % cfg.xbot_every == 0) \
-                & (asize0 >= acap) & (acap > 0)
+                & (asize0 >= acap) & (acap > 0) & ctx.alive
         # built from the SAME masks the handlers consume, so the gate
         # can never fall out of sync with a new control kind
         is_ctl = (is_join | is_fj | is_nb | is_acc | is_disc | is_sh
@@ -908,6 +917,15 @@ class HyParView:
                 (new_epoch > state.hb_epoch) | first_join | stale_hb,
                 ctx.rnd, hb_rnd)
 
+        # Full-range random contact draws below are bounded by the
+        # active prefix width when the width operand is on: a rejoin
+        # contact or discovery fallback landing on an inactive row
+        # would wake it (breaking the rows-are-inert contract) and
+        # diverge from a native-width run's picker distribution.
+        n_eff = (comm.n_global if isinstance(ctx.n_active, tuple)
+                 else ctx.n_active)
+        ng_eff = jnp.maximum(jnp.asarray(n_eff, jnp.int32) - 1, 1) \
+            .astype(jnp.uint32)
         join_dst = join_tgt
         if hv.auto_rejoin:
             # Discovery-agent auto-rejoin (partisan_peer_discovery_agent
@@ -922,22 +940,26 @@ class HyParView:
             isolated = ctx.alive & ~state.left & state.joined \
                 & (asize0 == 0) & ~jnp.any(passive0 >= 0, axis=1) \
                 & (join_tgt < 0)
-            ng = jnp.uint32(max(comm.n_global - 1, 1))
-            contact = (ranked(_TAG_REJOIN, gids) % ng).astype(jnp.int32)
+            contact = (ranked(_TAG_REJOIN, gids) % ng_eff) \
+                .astype(jnp.int32)
             contact = contact + (contact >= gids)
             join_dst = jnp.where(isolated, contact, join_tgt)
         if hv.heartbeat and comm.n_global > 1:
-            sc = min(max(hv.seed_count, 2), comm.n_global)
-            seedc = (ranked(_TAG_HBSEED, gids)
-                     % jnp.uint32(sc)).astype(jnp.int32)
-            seedc = jnp.where(seedc == gids, (seedc + 1) % sc, seedc)
+            # Seed pool clamped to the active prefix (a native-width run
+            # clamps to its n_global the same way).
+            sc = jnp.minimum(
+                jnp.int32(min(max(hv.seed_count, 2), comm.n_global)),
+                jnp.maximum(jnp.asarray(n_eff, jnp.int32), 2)) \
+                .astype(jnp.uint32)
+            seedc = (ranked(_TAG_HBSEED, gids) % sc).astype(jnp.int32)
+            seedc = jnp.where(seedc == gids,
+                              ((seedc + 1) % sc.astype(jnp.int32)), seedc)
             # Seed-death fallback: with every discovery seed crashed, a
             # stale component would retry dead seeds forever — fall back
             # to a random full-range contact (the auto_rejoin picker's
             # range).  Liveness of the seed is ground truth the
             # discovery agent would learn from its connection failure.
-            ng = jnp.uint32(max(comm.n_global - 1, 1))
-            fallb = (ranked(_TAG_HBFALL, gids) % ng).astype(jnp.int32)
+            fallb = (ranked(_TAG_HBFALL, gids) % ng_eff).astype(jnp.int32)
             fallb = fallb + (fallb >= gids)
             seed_dead = ~ctx.faults.alive[jnp.clip(seedc, 0,
                                                    comm.n_global - 1)]

@@ -143,8 +143,11 @@ def record_round(cfg: Config, comm, ms: MetricsState, *, rnd: Array,
     ``emitted_ch``/``delivered_ch``/``causal``/``shed``/``drops``/
     ``dlv_overflow`` arrive already globally reduced (replicated);
     ``inbox_count`` [n_local] and ``nbrs`` [n_local, K] are shard-local
-    and reduced here.  Everything stays on device — this runs inside
-    the round's jitted scan body."""
+    and reduced here.  ``alive_local``/``alive_global`` arrive
+    pre-masked by the active prefix under ``Config.width_operand``
+    (round_body passes ``alive & (gid < n_active)``), so the
+    alive/edge series match a native-width run's exactly.  Everything
+    stays on device — this runs inside the round's jitted scan body."""
     slot = jnp.mod(rnd, cfg.metrics_ring)
 
     occ = comm.allsum(jnp.sum(inbox_count, dtype=jnp.int32))

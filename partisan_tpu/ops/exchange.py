@@ -23,6 +23,13 @@ Algorithm (all static shapes, jit/TPU friendly):
 The destination id in W_DST is a GLOBAL node id; the sharded wrapper in
 parallel/ all-gathers emissions and lets each shard route only its own
 node range (see parallel/sharded.py).
+
+Width-operand note (Config.width_operand): inactive prefix rows reach
+this stage as all-zero emission rows (their ctx.alive is masked, so
+managers/models emit nothing) and nothing addresses them (the wire's
+packed destination info marks them dead), so the sort sees them as the
+same kind-0 padding it already floats to the sentinel bucket — route
+needs no dynamic-width awareness, only the static full-width cost.
 """
 
 from __future__ import annotations

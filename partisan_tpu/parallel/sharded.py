@@ -242,6 +242,9 @@ class ShardedCluster:
             # behind the ring axis) shards; round labels replicate.
             flight=(() if state.flight == () else latency_mod.FlightState(
                 rnd=repl, sent=P(None, AXIS), dropped=P(None, AXIS))),
+            # Active prefix width: a scalar operand, replicated like the
+            # round counter (every shard masks its own row range off it).
+            n_active=(() if isinstance(state.n_active, tuple) else repl),
         )
 
     # ---- state construction ------------------------------------------
@@ -266,6 +269,8 @@ class ShardedCluster:
                      if metrics_mod.enabled(cfg) else ()),
             latency=(latency_mod.init(cfg)
                      if latency_mod.enabled(cfg) else ()),
+            n_active=(jnp.int32(cfg.n_nodes) if cfg.width_operand
+                      else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

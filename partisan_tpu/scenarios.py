@@ -157,13 +157,20 @@ def _boot_overlay(cl, n, settle_execs=3, on_wave=None, state=None,
 
 
 def _grow_state(old_st, new_init, old_n: int, new_n: int):
-    """Re-embed a ``old_n``-wide cluster state into a fresh ``new_n``-wide
-    init state: every node-axis leaf prefix-copies (rows >= old_n keep
-    their init values — alive, unjoined, inert), same-shaped leaves
-    (round counter, stats, link_drop) carry over.  Node ids are global
-    and width-independent, and the per-node hash-RNG streams are keyed
-    by id, so the prefix cluster's dynamics are unchanged by the
-    re-embedding."""
+    """LEGACY re-embedding of a ``old_n``-wide cluster state into a fresh
+    ``new_n``-wide init state (the multi-program ladder): every
+    node-axis leaf prefix-copies (rows >= old_n keep their init values —
+    alive, unjoined, inert), same-shaped leaves (round counter, stats,
+    link_drop) carry over.  Node ids are global and width-independent,
+    and the per-node hash-RNG streams are keyed by id, so the prefix
+    cluster's dynamics are unchanged by the re-embedding.
+
+    The width-operand ladder (Config.width_operand — the default path
+    in :func:`_boot_ladder`) replaces this with an in-place prefix
+    activation (``cluster.activate``): the same contract, but no fresh
+    XLA program per rung and no tree-wide copy.  This function remains
+    for non-width-operand configs and as the contract's reference
+    semantics (tests/test_program_budget.py asserts the two agree)."""
     def leaf(o, ni):
         osh, nsh = getattr(o, "shape", None), getattr(ni, "shape", None)
         if osh == nsh:
@@ -181,40 +188,55 @@ def _grow_state(old_st, new_init, old_n: int, new_n: int):
 def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
                  settle_execs=1, on_wave=None, final_state=None,
                  upper_wave_factor=2):
-    """Reduced-width bootstrap ladder: run the early join waves on
-    PREFIX-width clusters, growing the state between widths
-    (:func:`_grow_state`).  Every bootstrap wave costs one full-width
-    K_PROG execution, so running the small waves at small widths cuts
-    the bootstrap's node-rounds by ~10x at 100k (VERDICT r4 next #2):
-    only the last 1-2 waves and the settle pay full width, and the
-    full-width round program is shared with the convergence phase.
+    """Reduced-width bootstrap ladder: run the early join waves on a
+    PREFIX of the cluster, widening between rungs.  Every bootstrap
+    wave costs one K_PROG execution, so ramping the join storm through
+    prefix rungs cuts the bootstrap's node-rounds (VERDICT r4 next #2)
+    while the late waves + settle pay full width.
+
+    Program discipline (the r5→r6 lesson): with ``Config.width_operand``
+    on — the default path — EVERY rung runs the SAME full-width round
+    program; the rung width is the dynamic ``n_active`` operand and a
+    rung change is an in-place prefix activation (``cluster.activate``,
+    the successor of :func:`_grow_state`).  One scan program is traced,
+    compiled, serialized and relay-loaded per bench size instead of one
+    per rung — the r5 two-rung ladder spent ~45 s loading ~90 MB of
+    per-rung programs through the relay (~1.5 MB/s) to save ~6 s of
+    full-width waves.  The trade: early waves now pay full-width
+    COMPUTE (~10 s of simulated rounds at 100k) but zero extra program
+    bytes.  Without the width operand the legacy multi-program path
+    (separate Cluster per rung + ``_grow_state``) is used.
 
     ``make_cluster(width) -> Cluster`` builds one rung (same config at
-    ``n_nodes=width``); ``final_state`` optionally supplies the
-    pre-built (timed) init state for the LAST width.  The FIRST rung
-    ramps at ``wave_factor`` (its rounds are cheap; factor 8 is the
-    validated envelope); every rung above it uses the gentler
-    ``upper_wave_factor`` — wide factor-8 join storms measured 6-14
-    disconnected components at 100k boot end under aligned timers,
-    and the stragglers' slow rejoins cost more than the saved waves.
-    Factor 4 upper waves re-measured at 100k (r5-late, post
-    walk-stream change): 3 components and 2x convergence rounds —
-    the envelope holds; keep 2.
-    The widths themselves only change where the inert high rows live
-    (ids are global, per-node hash-RNG streams are id-keyed)."""
+    ``n_nodes=width``); the width-operand path calls it ONCE, at ``n``
+    (tests/test_program_budget.py counts on this).  ``final_state``
+    optionally supplies the pre-built (timed) init state for the full
+    width.  The FIRST rung ramps at ``wave_factor`` (its rounds are
+    cheap; factor 8 is the validated envelope); every rung above it
+    uses the gentler ``upper_wave_factor`` — wide factor-8 join storms
+    measured 6-14 disconnected components at 100k boot end under
+    aligned timers, and the stragglers' slow rejoins cost more than
+    the saved waves.  Factor 4 upper waves re-measured at 100k
+    (r5-late, post walk-stream change): 3 components and 2x
+    convergence rounds — the envelope holds; keep 2.  The rung widths
+    only change where the inert high rows live (ids are global,
+    per-node hash-RNG streams are id-keyed), so the wave schedule is
+    IDENTICAL between the two paths."""
     rng = np.random.default_rng(7)
     if widths is None:
-        # ONE sub-full-width rung: every rung is a separate XLA program
-        # whose per-process load (~1-1.5 MB/s through the relay) the
-        # bootstrap pays before its first wave — the [4096, 32768]
-        # two-rung ladder spent ~20 s loading ~31 MB of small-rung
-        # programs to save ~6 s of full-width waves.  An 8k rung keeps
-        # the early factor-8 storm off the full-width program at ~1/7
-        # the load bytes of the 32k rung.
+        # ONE sub-full-width rung: under the width operand rungs are
+        # free (same program), but the wave SCHEDULE is kept identical
+        # to the validated r5 envelope — an 8k first rung ramps the
+        # factor-8 storm before the gentler upper waves.
         widths = [w for w in (8192,) if w < n] + [n]
+    cl_full = make_cluster(n)
+    if cl_full.cfg.width_operand:
+        return cl_full, _boot_ladder_width_op(
+            cl_full, n, widths, rng, wave_factor, settle_execs, on_wave,
+            final_state, upper_wave_factor)
     st, cl, prev_w, base = None, None, None, 1
     for w in widths:
-        cl = make_cluster(w)
+        cl = cl_full if w == n else make_cluster(w)
         init = final_state if (w == n and final_state is not None) \
             else cl.init()
         if st is None:
@@ -243,6 +265,36 @@ def _boot_ladder(make_cluster, n, widths=None, wave_factor=8,
         st = cl.steps(st, K_PROG)
     _sync(st)
     return cl, st
+
+
+def _boot_ladder_width_op(cl, n, widths, rng, wave_factor, settle_execs,
+                          on_wave, final_state, upper_wave_factor):
+    """Width-operand ladder body: ONE cluster, ONE round program; rungs
+    are prefix activations of the same state (see _boot_ladder doc)."""
+    from partisan_tpu import cluster as cluster_mod
+
+    st = final_state if final_state is not None else cl.init()
+    join = jax.jit(lambda m, nodes, tgts: cl.manager.join_many(
+        cl.cfg, m, nodes, tgts))
+    base = 1
+    for w in widths:
+        st = cluster_mod.activate(st, w)
+        factor = upper_wave_factor \
+            if (upper_wave_factor and w != widths[0]) else wave_factor
+        while base < w:
+            hi = min(base * factor, w)
+            nodes = np.arange(base, hi, dtype=np.int32)
+            targets = rng.integers(0, base,
+                                   size=nodes.shape[0]).astype(np.int32)
+            st = st._replace(manager=join(st.manager, nodes, targets))
+            st = cl.steps(st, K_PROG)
+            if on_wave is not None:
+                on_wave(hi, st, w)
+            base = hi
+    for _ in range(settle_execs):
+        st = cl.steps(st, K_PROG)
+    _sync(st)
+    return st
 
 
 def _throughput(cl, st):
@@ -591,6 +643,9 @@ def config5_causal_crash(n=100_000, senders=64, crashes=16,
                       max_broadcasts=8, inbox_cap=16,
                       emit_compact=32 if n > 4096 else 0,
                       timer_stagger=False,
+                      # one width-generic round program for the whole
+                      # bootstrap ladder (the n_active prefix operand)
+                      width_operand=True,
                       plumtree=PlumtreeConfig(push_slots=2,
                                               lazy_cap=4)))
 

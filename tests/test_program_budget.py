@@ -1,0 +1,243 @@
+"""Program-budget guards for the width-generic bootstrap ladder
+(ISSUE 3 tentpole; VERDICT r5 next #1).
+
+The r5 bootstrap wall was program LOAD, not simulation: the per-rung
+ladder compiled a separate scan program per width (≈90 MB serialized
+crossing the relay at ~1.5 MB/s ≈ 45 s).  The fix carries the rung
+width as a dynamic ``n_active`` operand (Config.width_operand) so ONE
+full-width round program serves every rung.  These tests pin the two
+load-bearing contracts on CPU:
+
+1. **Compile count** — the ladder path traces/compiles exactly one
+   round-scan program across all rungs (and builds exactly one
+   Cluster), so per-bench-size serialized round programs are <= 1.
+2. **Prefix dynamics** — a w-prefix run under the width operand is
+   bit-identical (state, send-path trace, coverage, convergence round)
+   to a natively-``n_nodes=w`` run: ids are global, the hash-RNG
+   streams are id-keyed, inert high rows are masked dead on the wire /
+   frozen in managers, and every full-range random picker is bounded
+   by the operand.  This is the ``_grow_state`` contract, now
+   load-bearing for the one-program ladder.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import scenarios
+from partisan_tpu.cluster import Cluster, activate, active_alive
+from partisan_tpu.config import Config, PlumtreeConfig
+from partisan_tpu.models.plumtree import Plumtree
+
+
+def _cfg(n, width_operand, **kw):
+    return Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
+                  msg_words=16, partition_mode="groups",
+                  max_broadcasts=8, inbox_cap=16, timer_stagger=False,
+                  width_operand=width_operand,
+                  plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4),
+                  **kw)
+
+
+def _drive_waves(cl, width, k_per_wave=10, factor=4):
+    """The ladder's wave schedule (same rng discipline) on ``cl``,
+    joining nodes [1, width) — activated to ``width`` first when the
+    cluster carries the operand."""
+    st = cl.init()
+    if cl.cfg.width_operand:
+        st = activate(st, width)
+    rng = np.random.default_rng(7)
+    base = 1
+    while base < width:
+        hi = min(base * factor, width)
+        nodes = np.arange(base, hi, dtype=np.int32)
+        tgts = rng.integers(0, base, size=nodes.shape[0]).astype(np.int32)
+        st = st._replace(manager=cl.manager.join_many(cl.cfg, st.manager,
+                                                      nodes, tgts))
+        st = cl.steps(st, k_per_wave)
+        base = hi
+    return cl.steps(st, k_per_wave)
+
+
+def _prefix_equal(small_tree, big_tree, w_small, w_big, label):
+    """Assert every leaf of ``big_tree`` restricted to the node-axis
+    prefix equals ``small_tree``'s leaf bit-for-bit."""
+    import jax.tree_util as jtu
+
+    ls = jtu.tree_leaves_with_path(small_tree)
+    lb = jtu.tree_leaves_with_path(big_tree)
+    assert len(ls) == len(lb), (label, len(ls), len(lb))
+    for (pa, a), (_pb, b) in zip(ls, lb):
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        where = label + jtu.keystr(pa)
+        if a.shape == b.shape:
+            pass
+        elif (a.ndim == b.ndim and a.ndim >= 1 and a.shape[0] == w_small
+              and b.shape[0] == w_big and a.shape[1:] == b.shape[1:]):
+            b = b[:w_small]
+        else:
+            raise AssertionError(
+                f"{where}: unmappable shapes {a.shape} vs {b.shape}")
+        assert np.array_equal(a, b), \
+            f"{where}: {np.sum(a != b)} of {a.size} elements differ"
+
+
+def test_ladder_compiles_one_round_program():
+    """The width-operand ladder builds ONE cluster and traces ONE
+    round-scan program across all rungs — the <=1 serialized round
+    program per bench size guard — AND lands the same final state as
+    the legacy multi-program ladder (the _grow_state reference
+    semantics)."""
+    n = 96
+    calls = []
+
+    def make_cluster(width, wo=True):
+        calls.append(width)
+        return Cluster(_cfg(width, wo))
+
+    cl, st = scenarios._boot_ladder(make_cluster, n, widths=[32, 96])
+    assert calls == [n], \
+        f"width-operand ladder must build one full-width cluster: {calls}"
+    # one (state-structure, k) entry in the scan's jit cache = one
+    # traced/compiled/serialized round program for the whole ladder
+    assert cl._steps._cache_size() == 1, cl._steps._cache_size()
+    assert int(st.n_active) == n
+    act = np.asarray(jax.device_get(st.manager.active))
+    assert float((act.max(axis=1) >= 0).mean()) == 1.0, \
+        "every node joined under the one-program ladder"
+
+    # legacy path (width_operand off -> per-rung clusters + _grow_state)
+    # must produce the bit-identical final state: prefix activation IS
+    # the grow-state re-embedding, done in place
+    legacy_calls = []
+
+    def make_legacy(width):
+        legacy_calls.append(width)
+        return Cluster(_cfg(width, False))
+
+    _, st_legacy = scenarios._boot_ladder(make_legacy, n,
+                                          widths=[32, 96])
+    assert sorted(set(legacy_calls)) == [32, 96]
+    _prefix_equal(st_legacy._replace(n_active=()),
+                  st._replace(n_active=()), n, n, "legacy_vs_width_op")
+
+
+def test_width_operand_prefix_bit_identical():
+    """A 32-prefix run of a 64-wide width-operand cluster is
+    bit-identical to a native 32-node run: full state AND the recorded
+    send-path trace; inert high rows keep their init values."""
+    w, n_big = 32, 64
+    small = Cluster(_cfg(w, False))
+    big = Cluster(_cfg(n_big, True))
+    st_s = _drive_waves(small, w)
+    st_b = _drive_waves(big, w)
+
+    _prefix_equal(st_s._replace(n_active=()),
+                  st_b._replace(n_active=()), w, n_big, "state")
+
+    # inert high rows were never written: bit-equal to a fresh init
+    init_b = big.init()
+    _prefix_equal(
+        jax.tree.map(lambda x: x[w:] if (getattr(x, "ndim", 0) >= 1 and
+                                         x.shape[0] == n_big) else x,
+                     st_b.manager),
+        jax.tree.map(lambda x: x[w:] if (getattr(x, "ndim", 0) >= 1 and
+                                         x.shape[0] == n_big) else x,
+                     init_b.manager),
+        n_big - w, n_big - w, "high_rows")
+
+    # send-path trace parity (the trace-orchestrator record mode):
+    # every post-interposition emission and fault drop, per round
+    st_s2, tr_s = small.record(st_s, 10)
+    st_b2, tr_b = big.record(st_b, 10)
+    assert np.array_equal(np.asarray(tr_s.rnd), np.asarray(tr_b.rnd))
+    assert np.array_equal(np.asarray(tr_s.sent),
+                          np.asarray(tr_b.sent)[:, :w])
+    assert np.array_equal(np.asarray(tr_s.dropped),
+                          np.asarray(tr_b.dropped)[:, :w])
+    # and the high rows emitted NOTHING
+    assert int(np.asarray(tr_b.sent)[:, w:, :, 0].max(initial=0)) == 0
+
+
+def test_width_operand_coverage_and_convergence_parity():
+    """Plumtree broadcast over a 48-prefix: coverage series and the
+    convergence round match a native 48-node run exactly (the
+    trace/coverage/convergence leg of the prefix contract)."""
+    w, n_big = 48, 96
+    model = Plumtree()
+    small = Cluster(_cfg(w, False), model=model)
+    big = Cluster(_cfg(n_big, True), model=model)
+    st_s = _drive_waves(small, w)
+    st_b = _drive_waves(big, w)
+    start = int(st_s.rnd)
+    assert start == int(st_b.rnd)
+    st_s = st_s._replace(model=model.broadcast(st_s.model, 0, 0, start))
+    st_b = st_b._replace(model=model.broadcast(st_b.model, 0, 0, start))
+
+    cov_s = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
+    # width-operand coverage MUST mask by the active prefix
+    # (cluster.active_alive) — faults.alive alone would count the
+    # inert rows as unreached
+    cov_b = jax.jit(lambda s: model.coverage(s.model, active_alive(s), 0))
+
+    conv_s = conv_b = -1
+    for _ in range(20):
+        c_s, c_b = float(cov_s(st_s)), float(cov_b(st_b))
+        assert c_s == c_b, (int(st_s.rnd), c_s, c_b)
+        if c_s == 1.0:
+            conv_s = conv_b = int(st_s.rnd)
+            break
+        st_s = small.steps(st_s, 10)
+        st_b = big.steps(st_b, 10)
+    assert conv_s > 0, "broadcast did not converge on the prefix"
+
+
+def test_width_operand_sharded_parity():
+    """The n_active operand is a replicated scalar: the sharded round
+    must evolve a width-operand state exactly like the single-device
+    round (placement invariance of the mask)."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map not available in this jax")
+    from partisan_tpu.parallel import ShardedCluster, make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 cpu devices"
+    cfg = _cfg(64, True)
+    local = Cluster(cfg)
+    shard = ShardedCluster(cfg, make_mesh(8))
+
+    def drive(cl):
+        st = activate(cl.init(), 32)
+        rng = np.random.default_rng(7)
+        base = 1
+        while base < 32:
+            hi = min(base * 4, 32)
+            nodes = np.arange(base, hi, dtype=np.int32)
+            tgts = rng.integers(0, base,
+                                size=nodes.shape[0]).astype(np.int32)
+            st = st._replace(manager=cl.manager.join_many(
+                cfg, st.manager, nodes, tgts))
+            st = cl.steps(st, 10)
+            base = hi
+        return cl.steps(st, 10)
+
+    st_l, st_s = drive(local), drive(shard)
+    _prefix_equal(st_l, st_s, 64, 64, "sharded")
+
+
+def test_activate_requires_width_operand():
+    cl = Cluster(_cfg(16, False))
+    st = cl.init()
+    with pytest.raises(ValueError, match="width_operand"):
+        activate(st, 8)
+    # active_alive on a non-operand state is just faults.alive
+    assert np.array_equal(np.asarray(active_alive(st)),
+                          np.asarray(st.faults.alive))
+
+
+def test_active_alive_masks_prefix():
+    cl = Cluster(_cfg(16, True))
+    st = activate(cl.init(), 10)
+    m = np.asarray(jax.device_get(active_alive(st)))
+    assert m[:10].all() and not m[10:].any()
