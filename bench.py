@@ -54,7 +54,7 @@ PER_SIZE_CAP_S = 340.0         # no single rung may eat the whole budget
 
 
 def run(n: int, verbose: bool = False, metrics: bool = False,
-        latency: bool = False) -> dict:
+        latency: bool = False, health: bool = False) -> dict:
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config, HyParViewConfig, \
         PlumtreeConfig
@@ -113,6 +113,11 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
                       # threading + per-channel delivery-age histograms
                       # in the carry; percentiles go to STDERR only
                       latency=latency,
+                      # opt-in health plane (--health): device topology
+                      # snapshots every K_PROG rounds (component count,
+                      # isolation, symmetry, churn) + the one-scalar
+                      # digest; series go to STDERR only
+                      health=(K_PROG if health else 0), health_ring=256,
                       # ONE width-generic round program for the whole
                       # bootstrap ladder: rung width rides the n_active
                       # operand instead of recompiling per width
@@ -223,11 +228,26 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
     conv = -1
     best = float("inf")
     for _ in range(0, max_rounds + K_PROG, K_PROG):  # + trailing check
-        cov = float(coverage(st.model, st.faults.alive))
-        if verbose:
-            print(f"n={n} rnd {int(st.rnd)}: coverage {cov:.6f}",
-                  file=sys.stderr, flush=True)
-        if cov == 1.0:
+        if health:
+            # Health plane on: the convergence poll is the packed
+            # digest — ONE int32 transfer, coverage bit folded in by
+            # the snapshot that closed the last batch (cadence ==
+            # K_PROG, so the digest describes exactly this state).
+            from partisan_tpu import health as health_mod
+
+            word = health_mod.digest(st)
+            done = health_mod.digest_converged(word)
+            if verbose:
+                print(f"n={n} rnd {int(st.rnd)}: digest "
+                      f"{health_mod.decode_digest(word)}",
+                      file=sys.stderr, flush=True)
+        else:
+            cov = float(coverage(st.model, st.faults.alive))
+            done = cov == 1.0
+            if verbose:
+                print(f"n={n} rnd {int(st.rnd)}: coverage {cov:.6f}",
+                      file=sys.stderr, flush=True)
+        if done:
             conv = int(st.rnd)
             break
         t1 = time.perf_counter()
@@ -272,6 +292,20 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
         print(json.dumps({"kind": "latency", "n": n,
                           **latency_mod.percentiles(st.latency,
                                                     channels=names)}),
+              file=sys.stderr)
+    if health:
+        # Topology-snapshot series + final digest to stderr; stdout
+        # keeps the one-line contract.  The component count here is the
+        # DEVICE counter — the same number the verbose host label
+        # propagation prints (BENCH_NOTES r6+ component counts).
+        from partisan_tpu import health as health_mod
+
+        for row in health_mod.rows(health_mod.snapshot(st.health)):
+            print(json.dumps({"kind": "health", "n": n, **row}),
+                  file=sys.stderr)
+        dig = health_mod.digest(st)
+        print(json.dumps({"kind": "health_digest", "n": n,
+                          "word": dig, **health_mod.decode_digest(dig)}),
               file=sys.stderr)
     if verbose:
         print(f"n={n}: {rps:.1f} rounds/s, broadcast converged in "
@@ -492,7 +526,8 @@ if __name__ == "__main__":
             jax.config.update("jax_compilation_cache_dir", cache_dir)
         r = run(int(sys.argv[2]), verbose=True,
                 metrics="--metrics" in sys.argv,
-                latency="--latency" in sys.argv)
+                latency="--latency" in sys.argv,
+                health="--health" in sys.argv)
         print(json.dumps({"size_phases": {str(r["n"]): r["phases"]}}),
               file=sys.stderr)
         print(json.dumps(r))

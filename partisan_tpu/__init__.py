@@ -26,9 +26,10 @@ src/partisan_peer_service.erl):
 - :mod:`partisan_tpu.otp` — RPC, monitors, remote refs
 - :mod:`partisan_tpu.checkpoint` / :mod:`partisan_tpu.telemetry` /
   :mod:`partisan_tpu.discovery` / :mod:`partisan_tpu.orchestration`
-- :mod:`partisan_tpu.metrics` / :mod:`partisan_tpu.latency` — the
-  device-resident observability planes (counter ring; delivery-age
-  histograms + flight recorder)
+- :mod:`partisan_tpu.metrics` / :mod:`partisan_tpu.latency` /
+  :mod:`partisan_tpu.health` — the device-resident observability
+  planes (counter ring; delivery-age histograms + flight recorder;
+  topology snapshots + the one-scalar health digest)
 - :mod:`partisan_tpu.parallel` — shard_map multi-device execution
 - :mod:`partisan_tpu.bridge` — Erlang port bridge (ETF + server)
 - :mod:`partisan_tpu.scenarios` — the five driver benchmark configs

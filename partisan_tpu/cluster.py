@@ -29,6 +29,7 @@ from jax import Array
 from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
+from partisan_tpu import health as health_mod
 from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
@@ -73,6 +74,9 @@ class ClusterState(NamedTuple):
     #                         so one full-width round program serves
     #                         every prefix width (the bootstrap ladder
     #                         shares ONE XLA program across rungs).
+    health: Any = ()        # health.HealthState topology-snapshot ring
+    #                         (or () when Config.health is 0 — zero
+    #                         cost, trace bit-identical to pre-health)
 
 
 class TraceRound(NamedTuple):
@@ -94,6 +98,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     evolve identically (tests/test_sharded.py)."""
     mx = metrics_mod.enabled(cfg)   # static: specializes the trace
     lx = latency_mod.enabled(cfg)   # static: birth-word threading
+    hx = health_mod.enabled(cfg)    # static: topology-snapshot cadence
     # Flight recording needs the generic wire path's materialized
     # (sent, dropped) pair — same constraint as capture.  Gated on the
     # state actually carrying a ring so shape discovery (eval_shape on
@@ -492,11 +497,48 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 inbox_count=inbox.count, alive_local=alive_local,
                 alive_global=faults_wire.alive, nbrs=nbrs_m,
                 dlv_overflow=dlv_of)
+    hstate = state.health
+    if hx:
+        # Topology snapshot every cfg.health rounds, on the POST-
+        # transition state (the state the host sees after this round),
+        # so a batch whose length is a multiple of the cadence ends
+        # with a digest describing exactly its final state — what
+        # scenarios._converge polls as ONE scalar.  All the graph work
+        # (neighbor gather, pointer-jumping components, symmetry
+        # check, coverage) lives INSIDE the cond: non-snapshot rounds
+        # pay only the predicate.
+        with jax.named_scope("round.health"):
+            due = jnp.mod(state.rnd + 1, cfg.health) == 0
+
+            def health_body(h):
+                nbrs_h = nbrs if nbrs is not None \
+                    else manager.neighbors(cfg, mstate, comm)
+                if model is not None and hasattr(model, "coverage"):
+                    # Coverage-complete, cross-shard: every shard's
+                    # alive nodes covered (d/d == 1.0 is float-exact;
+                    # an alive-EMPTY shard is vacuously complete, but
+                    # an all-dead CLUSTER is not — the legacy coverage
+                    # poll reads 0.0 there, and the digest must agree).
+                    cov_l = model.coverage(dstate_model, alive_local, 0)
+                    n_al = jnp.sum(alive_local, dtype=jnp.int32)
+                    ok_l = (n_al == 0) | (cov_l >= 1.0)
+                    cov_ok = (comm.allsum(n_al) > 0) & (comm.allsum(
+                        jnp.where(ok_l, 0, 1).astype(jnp.int32)) == 0)
+                else:
+                    cov_ok = jnp.bool_(True)
+                return health_mod.record_snapshot(
+                    cfg, comm, h, rnd=state.rnd, nbrs_local=nbrs_h,
+                    alive_global=faults_wire.alive, cov_ok=cov_ok,
+                    partition=state.faults.partition)
+
+            hstate = jax.lax.cond(due, health_body, lambda h: h,
+                                  state.health)
     out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
                        inbox=inbox, manager=mstate, model=dstate_model,
                        delivery=dstate, stats=stats, interpose=istate,
                        outbox=obstate, metrics=mets, latency=lt,
-                       flight=fstate, n_active=state.n_active)
+                       flight=fstate, n_active=state.n_active,
+                       health=hstate)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent,
                                dropped=fault_dropped)
@@ -621,6 +663,8 @@ class Cluster:
                      if latency_mod.enabled(cfg) else ()),
             n_active=(jnp.int32(cfg.n_nodes) if cfg.width_operand
                       else ()),
+            health=(health_mod.init(cfg)
+                    if health_mod.enabled(cfg) else ()),
         )
 
     def _build_init(self) -> ClusterState:

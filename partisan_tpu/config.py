@@ -354,6 +354,24 @@ class Config:
     #                              into a trace.Trace host-side; forces
     #                              the generic wire path (like capture)
 
+    # --- health plane (health.py) --------------------------------------
+    health: int = 0              # >0: every `health` rounds compute a
+    #                              device-resident topology snapshot of
+    #                              the live overlay inside the jitted
+    #                              round — component count (pointer-
+    #                              jumping min-label propagation over
+    #                              manager.neighbors), isolated-alive
+    #                              count, out-degree histogram, edge-
+    #                              symmetry violations, churn diffs —
+    #                              ring-buffered plus a packed one-scalar
+    #                              health DIGEST word (convergence polls
+    #                              transfer one int32 instead of running
+    #                              host graph walks).  0 (the default) =
+    #                              off: the ClusterState leaf is an
+    #                              empty () pytree — no cost, trace
+    #                              bit-identical to pre-health rounds
+    health_ring: int = 64        # snapshots of history kept (ring)
+
     # --- test plane ----------------------------------------------------
     replaying: bool = False
     shrinking: bool = False
@@ -382,6 +400,13 @@ class Config:
         if self.flight_rounds < 0:
             raise ValueError(
                 f"flight_rounds must be >= 0, got {self.flight_rounds}")
+        if self.health < 0:
+            raise ValueError(
+                f"health must be >= 0 (a snapshot cadence in rounds; "
+                f"0 = off), got {self.health}")
+        if self.health_ring < 1:
+            raise ValueError(
+                f"health_ring must be >= 1, got {self.health_ring}")
         if self.distance.model not in ("ring", "hash"):
             raise ValueError(
                 f"distance.model {self.distance.model!r} not in "

@@ -1,0 +1,447 @@
+"""Device-resident health plane: topology snapshots of the live overlay
+computed INSIDE the jitted round — the observatory for the one thing the
+other planes cannot see.
+
+Partisan's value proposition IS the overlay (ATC'19: pluggable
+partial-view topologies measured to 1024 nodes), yet the rebuild's only
+component counter was a host-side numpy BFS (O(n), feasible only at
+small n) and its convergence poll burned a host transfer per check.
+The metrics plane (metrics.py) counts dead messages and the latency
+plane (latency.py) times live ones; this module closes the triad by
+watching the graph they travel on, under the same discipline
+(ARCHITECTURE.md "Observability"):
+
+- **statically shaped** — every ``Config.health`` rounds (the snapshot
+  cadence; 0 = off) the round body computes one topology snapshot and
+  writes it into a ring of ``Config.health_ring`` slots,
+- **replicated under sharding** — the snapshot is computed from the
+  all-gathered global neighbor table, so every shard derives the SAME
+  values (parallel/sharded.py replicates the health leaves like the
+  metrics ring),
+- **free when disabled** — ``Config.health=0`` (the default) keeps the
+  ClusterState leaf an empty ``()`` pytree: no arrays, no ops, and the
+  round trace is bit-identical to pre-health behavior.
+
+Per snapshot:
+
+- **connected-component count** of the undirected union of live
+  overlay out-edges, via pointer-jumping min-label propagation —
+  O(log n) gather/scatter steps on device, replacing the host BFS
+  (the component count is the 100k bootstrap's key health signal:
+  BENCH_NOTES "6-14 disconnected components at boot end"),
+- **isolated-alive count** — alive nodes with zero live out-edges (the
+  conn-count-to-zero isolation signal,
+  partisan_peer_connections.erl:1489-1535),
+- **per-node out-degree histogram** (+ min/max over alive nodes),
+- **directed-edge symmetry-violation count** — live edges i->j whose
+  reverse j->i is absent (HyParView active views should be symmetric;
+  a persistent violation is a half-open connection),
+- **churn counters** — join/leave (overlay connectivity gained/lost)
+  and up/down (alive-mask flips) diffs since the previous snapshot.
+
+The headline artifact is a packed **health digest word** — one int32
+carrying (one-component | no-isolates | min-degree>=target |
+coverage-complete | valid) predicate bits plus the clamped component
+and isolate counts — so convergence checks and bench polling transfer
+ONE scalar instead of running numpy graph walks (``scenarios._converge``
+polls it when the plane is on).
+
+Host side mirrors the sibling planes: :func:`snapshot`/:func:`rows`
+decode the ring, ``telemetry.replay_health_events`` turns snapshot
+transitions into ``partisan.health.*`` bus events, and
+``tools/health_report.py`` exports JSON lines.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.config import Config
+
+# Out-degree histogram bins: degree d lands in bin min(d, DEG_BINS-1);
+# the last bin absorbs everything wider (HyParView active views are <=
+# active_max ~ 6; SCAMP partial views can exceed the bins — the min/max
+# series keep the exact extremes).
+DEG_BINS = 16
+
+# Digest word layout (int32, bit 31 unused so the word stays positive).
+DIGEST_ONE_COMPONENT = 1 << 0   # exactly one connected component
+DIGEST_NO_ISOLATES = 1 << 1     # no alive node with zero live out-edges
+DIGEST_MIN_DEGREE = 1 << 2      # min alive out-degree >= target
+DIGEST_COVERAGE = 1 << 3        # model coverage complete (slot 0)
+DIGEST_VALID = 1 << 4           # a snapshot has been recorded
+_COMP_SHIFT, _COMP_MASK = 8, 0xFFFF   # clamped component count
+_ISO_SHIFT, _ISO_MASK = 24, 0x7F      # clamped isolated-alive count
+
+
+class HealthState(NamedTuple):
+    """Ring of topology snapshots + the latest packed digest.
+
+    ``R`` = Config.health_ring; one slot per snapshot (every
+    ``Config.health`` rounds), ``rnd[slot] == -1`` marks a slot never
+    written.  ``prev_alive``/``prev_conn`` are the previous snapshot's
+    reference vectors for the churn diffs (global, replicated)."""
+
+    rnd: Array          # int32[R] — round the snapshot describes (-1 = empty)
+    components: Array   # int32[R] — connected components of the live overlay
+    isolated: Array     # int32[R] — alive nodes with zero live out-edges
+    deg_hist: Array     # int32[R, DEG_BINS] — alive out-degree histogram
+    deg_min: Array      # int32[R] — min live out-degree over alive nodes
+    deg_max: Array      # int32[R] — max live out-degree over alive nodes
+    sym_violations: Array  # int32[R] — live edges whose reverse is absent
+    joins: Array        # int32[R] — nodes newly overlay-connected this window
+    leaves: Array       # int32[R] — nodes that lost all overlay edges
+    ups: Array          # int32[R] — dead->alive flips this window
+    downs: Array        # int32[R] — alive->dead flips this window
+    digests: Array      # int32[R] — the packed digest word per snapshot
+    digest: Array       # int32 scalar — LATEST digest (the one-scalar poll)
+    prev_alive: Array   # bool[n_global] — alive mask at the last snapshot
+    prev_conn: Array    # bool[n_global] — alive & degree>0 at last snapshot
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.health > 0
+
+
+def min_degree_target(cfg: Config) -> int:
+    """Degree floor the digest's MIN_DEGREE bit asserts: HyParView's
+    active_min (include/partisan.hrl:204-217) under the hyparview
+    manager, else 1 (any overlay member should keep an edge)."""
+    if cfg.peer_service_manager == "hyparview":
+        return cfg.hyparview.active_min
+    return 1
+
+
+def init(cfg: Config) -> HealthState:
+    R = cfg.health_ring
+
+    def z(*shape):
+        return jnp.zeros(shape, jnp.int32)
+
+    return HealthState(
+        rnd=jnp.full((R,), -1, jnp.int32),
+        components=z(R), isolated=z(R), deg_hist=z(R, DEG_BINS),
+        deg_min=z(R), deg_max=z(R), sym_violations=z(R),
+        joins=z(R), leaves=z(R), ups=z(R), downs=z(R), digests=z(R),
+        digest=jnp.int32(0),
+        prev_alive=jnp.zeros((cfg.n_nodes,), jnp.bool_),
+        prev_conn=jnp.zeros((cfg.n_nodes,), jnp.bool_),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pure graph kernels (global arrays; shard-agnostic — callers gather)
+# ---------------------------------------------------------------------------
+
+def live_edges(nbrs: Array, alive: Array,
+               partition: Array | None = None) -> Array:
+    """bool[n, K]: out-edge slots that are live — a valid neighbor id,
+    BOTH endpoints alive (a crashed peer's socket is gone), and the
+    edge not severed by a partition (``partition`` is faults.py's
+    groups vector int32[n] or dense matrix bool[n, n]; None = no
+    partition).  The stochastic link_drop is NOT applied — it models
+    per-message loss, not a severed connection."""
+    n = alive.shape[0]
+    nc = jnp.clip(nbrs, 0, n - 1)
+    live = (nbrs >= 0) & alive[:, None] & alive[nc]
+    if partition is not None and getattr(partition, "ndim", 0) > 0:
+        if partition.ndim == 2:
+            live = live & ~partition[
+                jnp.arange(n, dtype=jnp.int32)[:, None], nc]
+        else:
+            live = live & (partition[:, None] == partition[nc])
+    return live
+
+
+def component_count(nbrs: Array, alive: Array,
+                    partition: Array | None = None) -> tuple[Array, Array]:
+    """Connected components of the undirected union of live out-edges.
+
+    Pointer-jumping min-label propagation, FastSV-style (Zhang/Azad/Hu
+    2020's linear-algebraic Shiloach-Vishkin): each node carries a
+    parent pointer ``f`` into a min-forest; one iteration shortcuts
+    (``f[f]``), aggressively hooks each endpoint onto the other's
+    GRANDPARENT, and stochastically hooks each endpoint's PARENT onto
+    the other's grandparent — hooking whole trees, not single nodes,
+    which is what makes ceil(log2 n)+4 iterations converge on ANY
+    topology (a naive relax-and-jump creeps O(n) on a permuted path —
+    measured 24k iterations at n=100k where this update takes 17).
+    Isolated alive nodes are singleton components; dead and
+    partition-severed edges are excluded — exactly the host BFS
+    oracle's semantics (tests/support.components).
+
+    Returns ``(labels int32[n], count int32)``: ``labels[i]`` is the
+    minimum alive id in i's component (own id for dead nodes)."""
+    n = alive.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    if nbrs.shape[1] == 0 or n == 1:
+        return ids, jnp.sum(alive, dtype=jnp.int32)
+    nc = jnp.clip(nbrs, 0, n - 1)
+    live = live_edges(nbrs, alive, partition)
+    # per-edge endpoint target slots; index n = out-of-range: dropped
+    tgt_v = jnp.where(live, nc, n).reshape(-1)
+
+    def body(_, f):
+        g = f[f]                                        # grandparent
+        m = jnp.minimum(f, g)                           # shortcut
+        gv = jnp.where(live, g[nc], n)                  # nbr grandparents
+        gb = jnp.broadcast_to(g[:, None], live.shape)
+        # aggressive hooking, both edge directions
+        m = jnp.minimum(m, jnp.min(gv, axis=1))
+        m = m.at[tgt_v].min(gb.reshape(-1), mode="drop")
+        # stochastic hooking: my PARENT adopts their grandparent (and
+        # symmetrically) — the tree-onto-tree step
+        fu = jnp.where(live, jnp.broadcast_to(f[:, None], live.shape),
+                       n).reshape(-1)
+        m = m.at[fu].min(gv.reshape(-1), mode="drop")
+        fv = jnp.where(live, f[nc], n).reshape(-1)
+        m = m.at[fv].min(gb.reshape(-1), mode="drop")
+        return m
+
+    iters = int(math.ceil(math.log2(max(n, 2)))) + 4
+    lbl = jax.lax.fori_loop(0, iters, body, ids)
+    count = jnp.sum((lbl == ids) & alive, dtype=jnp.int32)
+    return lbl, count
+
+
+def out_degrees(nbrs: Array, alive: Array,
+                partition: Array | None = None) -> Array:
+    """int32[n]: live out-degree per node (0 for dead nodes)."""
+    return jnp.sum(live_edges(nbrs, alive, partition), axis=1,
+                   dtype=jnp.int32)
+
+
+# Above this many [n, K, K] elements the symmetry check runs slot-wise
+# (O(n·K) memory per step instead of one O(n·K²) gather): partial-view
+# overlays (hyparview K ~ 6 at 100k = 4.9M) take the one-shot; wide
+# views (scamp partial_max 64 at 100k = 410M, fullmesh K = n) must not
+# materialize the cube.
+SYM_ONESHOT_ELEMS = 1 << 24
+
+
+def symmetry_violations(nbrs: Array, alive: Array,
+                        partition: Array | None = None) -> Array:
+    """int32: live directed edges i->j with no j->i entry in j's view
+    (HyParView active views should be symmetric — a violation is a
+    half-open connection one side will eventually disconnect)."""
+    n = alive.shape[0]
+    K = nbrs.shape[1]
+    if K == 0:
+        return jnp.int32(0)
+    nc = jnp.clip(nbrs, 0, n - 1)
+    live = live_edges(nbrs, alive, partition)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    if n * K * K <= SYM_ONESHOT_ELEMS:
+        back = nbrs[nc]                              # [n, K, K]
+        has_back = jnp.any(back == ids[:, None, None], axis=-1)
+        return jnp.sum(live & ~has_back, dtype=jnp.int32)
+
+    def slot(s, acc):
+        back_s = nbrs[nc[:, s]]                      # [n, K]
+        has = jnp.any(back_s == ids[:, None], axis=1)
+        return acc + jnp.sum(live[:, s] & ~has, dtype=jnp.int32)
+
+    return jax.lax.fori_loop(0, K, slot, jnp.int32(0))
+
+
+def degree_histogram(deg: Array, alive: Array) -> Array:
+    """int32[DEG_BINS]: alive nodes' out-degrees, last bin absorbing
+    degrees >= DEG_BINS-1."""
+    b = jnp.clip(deg, 0, DEG_BINS - 1)
+    onehot = (b[:, None] == jnp.arange(DEG_BINS)) & alive[:, None]
+    return jnp.sum(onehot, axis=0, dtype=jnp.int32)
+
+
+_BIG = jnp.int32(2**30)
+
+
+def pack_digest(components: Array, isolated: Array, deg_min: Array,
+                n_alive: Array, min_deg_target: int,
+                cov_ok: Array) -> Array:
+    """The packed one-scalar health word (see module doc for layout).
+    An all-dead overlay reports unhealthy (zero components, degree
+    floor unmet) but still VALID — the snapshot ran."""
+    one = (components == 1).astype(jnp.int32)
+    noiso = (isolated == 0).astype(jnp.int32)
+    degok = ((deg_min >= min_deg_target) & (n_alive > 0)).astype(jnp.int32)
+    cov = jnp.asarray(cov_ok).astype(jnp.int32)
+    word = (one * DIGEST_ONE_COMPONENT
+            | noiso * DIGEST_NO_ISOLATES
+            | degok * DIGEST_MIN_DEGREE
+            | cov * DIGEST_COVERAGE
+            | DIGEST_VALID
+            | jnp.clip(components, 0, _COMP_MASK) << _COMP_SHIFT
+            | jnp.clip(isolated, 0, _ISO_MASK) << _ISO_SHIFT)
+    return word.astype(jnp.int32)
+
+
+def decode_digest(word: int) -> dict:
+    """Host-side view of a packed digest word."""
+    word = int(word)
+    return {
+        "valid": bool(word & DIGEST_VALID),
+        "one_component": bool(word & DIGEST_ONE_COMPONENT),
+        "no_isolates": bool(word & DIGEST_NO_ISOLATES),
+        "min_degree_ok": bool(word & DIGEST_MIN_DEGREE),
+        "coverage_complete": bool(word & DIGEST_COVERAGE),
+        "components": (word >> _COMP_SHIFT) & _COMP_MASK,
+        "isolated": (word >> _ISO_SHIFT) & _ISO_MASK,
+    }
+
+
+def healthy(word: int) -> bool:
+    """All four predicate bits set on a valid digest."""
+    bits = (DIGEST_VALID | DIGEST_ONE_COMPONENT | DIGEST_NO_ISOLATES
+            | DIGEST_MIN_DEGREE | DIGEST_COVERAGE)
+    return (int(word) & bits) == bits
+
+
+def digest_converged(word: int) -> bool:
+    """The convergence predicate ``_converge`` polls: a recorded
+    snapshot whose coverage bit is set."""
+    bits = DIGEST_VALID | DIGEST_COVERAGE
+    return (int(word) & bits) == bits
+
+
+def digest_components(word: int) -> int:
+    """Component count carried in the digest (clamped at 0xFFFF)."""
+    return (int(word) >> _COMP_SHIFT) & _COMP_MASK
+
+
+def digest(state) -> int:
+    """ONE scalar device->host transfer: the latest packed digest word
+    of a health-carrying ClusterState (0 = plane off or no snapshot
+    yet)."""
+    hs = getattr(state, "health", ())
+    if hs == ():
+        return 0
+    return int(jax.device_get(hs.digest))
+
+
+# ---------------------------------------------------------------------------
+# The snapshot writer (runs inside the jitted round, behind a lax.cond)
+# ---------------------------------------------------------------------------
+
+def record_snapshot(cfg: Config, comm, hs: HealthState, *, rnd: Array,
+                    nbrs_local: Array, alive_global: Array,
+                    cov_ok: Array,
+                    partition: Array | None = None) -> HealthState:
+    """Compute one topology snapshot and write it into the ring.
+
+    ``nbrs_local`` is this shard's neighbor rows ([n_local, K], global
+    ids); it is all-gathered here so every shard derives identical
+    (replicated) values from the identical global graph — the health
+    analogue of the metrics plane's allsum-before-write discipline.
+    ``alive_global`` arrives pre-masked by the active prefix under
+    ``Config.width_operand`` (round_body passes the wire-stage alive),
+    so snapshots match a native-width run's.  ``cov_ok`` is the
+    cross-shard coverage-complete predicate round_body derives from the
+    model (True when no model carries a coverage notion).  Runs behind
+    a ``lax.cond`` in round_body — non-snapshot rounds pay nothing."""
+    R = cfg.health_ring
+    nbrs = comm.gather_vec(nbrs_local)              # [n_global, K]
+    alive = alive_global
+
+    _, comps = component_count(nbrs, alive, partition)
+    deg = out_degrees(nbrs, alive, partition)
+    n_alive = jnp.sum(alive, dtype=jnp.int32)
+    iso = jnp.sum(alive & (deg == 0), dtype=jnp.int32)
+    hist = degree_histogram(deg, alive)
+    # min over ALIVE nodes only; an all-dead overlay reports 0/0
+    dmin = jnp.where(n_alive > 0,
+                     jnp.min(jnp.where(alive, deg, _BIG)), jnp.int32(0))
+    dmax = jnp.max(jnp.where(alive, deg, 0))
+    sym = symmetry_violations(nbrs, alive, partition)
+
+    # Churn = diffs BETWEEN snapshots; the FIRST snapshot has no
+    # predecessor window, so it only establishes the baseline (zero
+    # churn) — otherwise every run's first window would report
+    # spurious ups/joins against the zero-initialized reference
+    # vectors (and fire a bogus churn bus event on a fault-free run).
+    first = (hs.digest & DIGEST_VALID) == 0
+    conn = alive & (deg > 0)
+
+    def window(prev, now):
+        return jnp.where(
+            first, 0, jnp.sum(prev & now, dtype=jnp.int32))
+
+    ups = window(~hs.prev_alive, alive)
+    downs = window(hs.prev_alive, ~alive)
+    joins = window(~hs.prev_conn, conn)
+    leaves = window(hs.prev_conn, ~conn)
+
+    word = pack_digest(comps, iso, dmin, n_alive,
+                       min_degree_target(cfg), cov_ok)
+
+    # Snapshot index: snapshots fire where (rnd+1) % health == 0, so
+    # consecutive snapshots get consecutive slots regardless of cadence.
+    idx = (rnd + 1) // cfg.health - 1
+    slot = jnp.mod(idx, R)
+    return HealthState(
+        rnd=hs.rnd.at[slot].set(rnd),
+        components=hs.components.at[slot].set(comps),
+        isolated=hs.isolated.at[slot].set(iso),
+        deg_hist=hs.deg_hist.at[slot].set(hist),
+        deg_min=hs.deg_min.at[slot].set(dmin),
+        deg_max=hs.deg_max.at[slot].set(dmax),
+        sym_violations=hs.sym_violations.at[slot].set(sym),
+        joins=hs.joins.at[slot].set(joins),
+        leaves=hs.leaves.at[slot].set(leaves),
+        ups=hs.ups.at[slot].set(ups),
+        downs=hs.downs.at[slot].set(downs),
+        digests=hs.digests.at[slot].set(word),
+        digest=word,
+        prev_alive=alive,
+        prev_conn=conn,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers (the metrics.snapshot/rows idiom)
+# ---------------------------------------------------------------------------
+
+_SERIES = ("components", "isolated", "deg_hist", "deg_min", "deg_max",
+           "sym_violations", "joins", "leaves", "ups", "downs", "digests")
+
+
+def snapshot(hs: HealthState) -> dict:
+    """Decode the ring into per-snapshot series ordered by round (one
+    device->host transfer, AFTER the scan — never inside it)."""
+    import numpy as np
+
+    from partisan_tpu.metrics import ring_order
+
+    host = jax.device_get(hs)
+    rnd = np.asarray(host.rnd)
+    idx = ring_order(rnd)
+    out: dict = {"rounds": rnd[idx]}
+    for name in _SERIES:
+        out[name] = np.asarray(getattr(host, name))[idx]
+    return out
+
+
+def rows(snap: dict) -> list[dict]:
+    """JSON-lines-friendly view: one self-describing dict per snapshot
+    (the ``BENCH_*.json`` idiom)."""
+    out = []
+    for i, r in enumerate(snap["rounds"]):
+        out.append({
+            "round": int(r),
+            "components": int(snap["components"][i]),
+            "isolated": int(snap["isolated"][i]),
+            "degree": {"min": int(snap["deg_min"][i]),
+                       "max": int(snap["deg_max"][i]),
+                       "hist": snap["deg_hist"][i].astype(int).tolist()},
+            "symmetry_violations": int(snap["sym_violations"][i]),
+            "churn": {"joins": int(snap["joins"][i]),
+                      "leaves": int(snap["leaves"][i]),
+                      "ups": int(snap["ups"][i]),
+                      "downs": int(snap["downs"][i])},
+            "digest": decode_digest(snap["digests"][i]),
+        })
+    return out

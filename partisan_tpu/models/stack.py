@@ -40,6 +40,15 @@ class Stack:
             emits.append(e)
         return tuple(outs), jnp.concatenate(emits, axis=1)
 
+    def coverage(self, state: tuple, alive: Array, slot: int = 0) -> Array:
+        """Coverage of the FIRST sub-model that defines one (the
+        broadcast layer in the bench/scenario stacks) — what the health
+        plane's digest coverage bit folds in; 1.0 when none does."""
+        for m, s in zip(self.models, state):
+            if hasattr(m, "coverage"):
+                return m.coverage(s, alive, slot)
+        return jnp.float32(1.0)
+
     # Host-side helpers address sub-models by index.
     def sub(self, state: tuple, i: int):
         return state[i]

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from partisan_tpu import channels as channels_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
+from partisan_tpu import health as health_mod
 from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
@@ -245,6 +246,10 @@ class ShardedCluster:
             # Active prefix width: a scalar operand, replicated like the
             # round counter (every shard masks its own row range off it).
             n_active=(() if isinstance(state.n_active, tuple) else repl),
+            # Health ring: snapshots are derived from the all-gathered
+            # global graph, so every shard computes identical values —
+            # replicated like the metrics ring.
+            health=spec_like(state.health, repl),
         )
 
     # ---- state construction ------------------------------------------
@@ -271,6 +276,8 @@ class ShardedCluster:
                      if latency_mod.enabled(cfg) else ()),
             n_active=(jnp.int32(cfg.n_nodes) if cfg.width_operand
                       else ()),
+            health=(health_mod.init(cfg)
+                    if health_mod.enabled(cfg) else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

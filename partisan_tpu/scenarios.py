@@ -39,15 +39,22 @@ METRICS = False
 # Latency-plane opt-in (--latency): birth-round threading + delivery-
 # age histograms; percentiles emitted to stderr the same way.
 LATENCY = False
+# Health-plane opt-in (--health): device-resident topology snapshots
+# every K_PROG rounds (cadence == the batch grain, so each batch ends
+# with a digest describing exactly its final state) emitted to stderr;
+# _converge polls the packed digest word — ONE scalar per check.
+HEALTH = False
 
 
 def _metrics_cfg(cfg):
-    """Apply the module-level metrics/latency opt-ins to a scenario
-    config."""
+    """Apply the module-level metrics/latency/health opt-ins to a
+    scenario config."""
     if METRICS:
         cfg = cfg.replace(metrics=True, metrics_ring=512)
     if LATENCY:
         cfg = cfg.replace(latency=True)
+    if HEALTH:
+        cfg = cfg.replace(health=K_PROG, health_ring=512)
     return cfg
 
 
@@ -76,6 +83,12 @@ def _emit_metrics(cfg, st, label) -> None:
                           **latency_mod.percentiles(st.latency,
                                                     channels=names)}),
               file=sys.stderr)
+    if getattr(st, "health", ()) != ():
+        from partisan_tpu import health as health_mod
+
+        for row in health_mod.rows(health_mod.snapshot(st.health)):
+            print(json.dumps({"kind": "health", "config": label, **row}),
+                  file=sys.stderr)
 
 
 def _sync(st) -> None:
@@ -313,9 +326,35 @@ def _throughput(cl, st):
     return K_PROG / best
 
 
-def _converge(cl, st, coverage_fn, max_rounds):
-    """Step until jitted ``coverage_fn(state) == 1.0`` (checked every
-    K_PROG rounds).  Returns (state, converged_round|-1)."""
+def _converge(cl, st, coverage_fn, max_rounds, use_digest=True):
+    """Step until converged (checked every K_PROG rounds).  Returns
+    (state, converged_round|-1).
+
+    With the health plane on at an ALIGNED cadence (``Config.health``
+    dividing K_PROG — the --health opt-in sets K_PROG itself), each
+    check transfers ONE packed int32: the health digest's coverage bit,
+    folded in by the device snapshot that closed the last batch, so the
+    digest describes exactly the state being checked.  CONTRACT: the
+    digest's coverage predicate is the model's SLOT-0 coverage (first
+    coverage-bearing sub-model of a Stack) — exactly what every current
+    scenario's ``coverage_fn`` polls; a caller whose predicate targets
+    a different slot or sub-model must pass ``use_digest=False``.  A
+    non-dividing cadence would leave the digest up to health-1 rounds
+    stale at the batch boundary, so it falls back to — and the plane
+    off runs bit-identically on — the legacy jitted
+    ``coverage_fn(state) == 1.0`` poll."""
+    if use_digest and getattr(st, "health", ()) != () \
+            and K_PROG % cl.cfg.health == 0:
+        from partisan_tpu import health as health_mod
+
+        def done(s):
+            return health_mod.digest_converged(health_mod.digest(s))
+
+        for _ in range(0, max_rounds, K_PROG):
+            if done(st):
+                return st, int(st.rnd)
+            st = cl.steps(st, K_PROG)
+        return (st, int(st.rnd)) if done(st) else (st, -1)
     for _ in range(0, max_rounds, K_PROG):
         if float(coverage_fn(st)) == 1.0:
             return st, int(st.rnd)
@@ -400,41 +439,28 @@ def hyparview_views(n=1000, settle_execs=6):
     """HyParView view-size conformance (include/partisan.hrl:204-217):
     after bootstrap, every active view holds within
     [active_min, active_max] and the overlay is ONE connected
-    component.  Returns the size distribution + component count."""
-    import collections
+    component.  Returns the size distribution + component count.
 
+    The component count comes from the DEVICE health plane (health.py
+    pointer-jumping counter — O(log n) gather steps inside the jitted
+    round), not a host BFS: the boot's final round computes the
+    snapshot, so reading it here is one packed-scalar transfer.  The
+    numpy BFS lives on as the test oracle (tests/support.components;
+    tests/test_health.py gates device==oracle on randomized, faulted
+    and partitioned overlays)."""
+    from partisan_tpu import health as health_mod
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config
 
     cfg = Config(n_nodes=n, seed=2, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups")
+                 msg_words=16, partition_mode="groups",
+                 health=K_PROG, health_ring=64)
     cl = Cluster(cfg)
     st = _boot_overlay(cl, n, settle_execs=settle_execs)
     act = np.asarray(st.manager.active)
     alive = np.asarray(st.faults.alive)
     sizes = (act >= 0).sum(axis=1)[alive]
-    # connected components of the undirected union of active views
-    adj = collections.defaultdict(set)
-    for i in range(n):
-        if not alive[i]:
-            continue
-        for j in act[i]:
-            if j >= 0 and alive[int(j)]:
-                adj[i].add(int(j))
-                adj[int(j)].add(i)
-    seen: set = set()
-    comps = 0
-    for s0 in range(n):
-        if not alive[s0] or s0 in seen:
-            continue
-        comps += 1
-        stack = [s0]
-        while stack:
-            x = stack.pop()
-            if x in seen:
-                continue
-            seen.add(x)
-            stack.extend(adj[x] - seen)
+    digest = health_mod.digest(st)
     return {"config": "hyparview_views", "n": n,
             "active_min": cfg.hyparview.active_min,
             "active_max": cfg.hyparview.active_max,
@@ -442,7 +468,8 @@ def hyparview_views(n=1000, settle_execs=6):
             "size_min": int(sizes.min()), "size_max": int(sizes.max()),
             "frac_at_least_min": round(
                 float((sizes >= cfg.hyparview.active_min).mean()), 4),
-            "components": comps}
+            "components": health_mod.digest_components(digest),
+            "healthy": health_mod.healthy(digest)}
 
 
 def config1_anti_entropy(n=16, max_rounds=120):
@@ -867,9 +894,16 @@ if __name__ == "__main__":
                     help="run with the device-resident latency plane on "
                          "and emit per-channel delivery-age percentiles "
                          "to stderr as JSON lines (stdout is unchanged)")
+    ap.add_argument("--health", action="store_true",
+                    help="run with the device-resident health plane on "
+                         "(topology snapshots every K_PROG rounds; "
+                         "convergence polls the one-scalar digest) and "
+                         "emit the snapshot series to stderr as JSON "
+                         "lines (stdout is unchanged)")
     args = ap.parse_args()
     METRICS = METRICS or args.metrics
     LATENCY = LATENCY or args.latency
+    HEALTH = HEALTH or args.health
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

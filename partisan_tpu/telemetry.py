@@ -35,6 +35,13 @@ METRICS_PARTITION = ("partisan", "metrics", "partition_detected")
 # Latency-plane SLO events (latency.py histograms -> discrete events).
 LATENCY_SLO_BREACH = ("partisan", "latency", "slo_breach")
 
+# Health-plane overlay events (health.py snapshot ring -> discrete
+# events): partition split / heal transitions of the device component
+# counter, plus windowed churn.
+HEALTH_PARTITION = ("partisan", "health", "partition_detected")
+HEALTH_HEALED = ("partisan", "health", "overlay_healed")
+HEALTH_CHURN = ("partisan", "health", "churn")
+
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
 
@@ -191,6 +198,60 @@ def replay_latency_events(bus: Bus, lat_snap: Mapping[str, Any], *,
     return n_events
 
 
+def replay_health_events(bus: Bus, snap: Mapping[str, Any], *,
+                         churn_threshold: int = 1) -> int:
+    """Replay a health snapshot (``health.snapshot``) as discrete
+    overlay events through the bus — the host-side adapter from the
+    device-resident topology ring to the telemetry idiom (same shape as
+    :func:`replay_metrics_events`).
+
+    - ``partition_detected`` — the component count rises above 1 AFTER
+      some snapshot in the window showed one component (a cold
+      bootstrap's many half-built components are not a partition; a
+      split of a previously-whole overlay is).  Edge-triggered: a
+      sustained split is one event.
+    - ``overlay_healed`` — the count returns to 1 after a detected
+      split.
+    - ``churn`` — windowed join/leave/up/down totals at or above
+      ``churn_threshold``; edge-triggered like the metrics spikes.
+
+    Returns the number of events emitted."""
+    comps = np.asarray(snap["components"])
+    rounds = np.asarray(snap["rounds"])
+    churn_total = (np.asarray(snap["joins"]) + np.asarray(snap["leaves"])
+                   + np.asarray(snap["ups"]) + np.asarray(snap["downs"]))
+    n_events = 0
+    was_one = False
+    split = False
+    churn_hot = False
+    for i, rnd in enumerate(rounds):
+        c = int(comps[i])
+        if split and c == 1:
+            bus.execute(HEALTH_HEALED, {"components": c},
+                        {"round": int(rnd)})
+            n_events += 1
+            split = False
+        if was_one and not split and c > 1:
+            bus.execute(HEALTH_PARTITION,
+                        {"components": c,
+                         "isolated": int(snap["isolated"][i])},
+                        {"round": int(rnd)})
+            n_events += 1
+            split = True
+        was_one = was_one or c == 1
+        hot = int(churn_total[i]) >= churn_threshold
+        if hot and not churn_hot:
+            bus.execute(HEALTH_CHURN,
+                        {"joins": int(snap["joins"][i]),
+                         "leaves": int(snap["leaves"][i]),
+                         "ups": int(snap["ups"][i]),
+                         "downs": int(snap["downs"][i])},
+                        {"round": int(rnd)})
+            n_events += 1
+        churn_hot = hot
+    return n_events
+
+
 def emit_channels_configured(bus: Bus, cfg) -> None:
     """partisan_config.erl:834-843's channel-configured event."""
     for ch in cfg.channels:
@@ -241,13 +302,28 @@ def plumtree_metrics(pt_state) -> dict:
     }
 
 
-def connection_counts(cluster, state) -> dict:
+# Above this node count, connection_counts defaults to the summarized
+# view: the full per_node list is O(n) JSON — ~2 MB of text at 100k —
+# where the summary (min/mean/max + degree histogram) is O(1).
+CONNECTION_COUNTS_FULL_MAX = 4096
+
+
+def connection_counts(cluster, state, mode: str = "auto") -> dict:
     """Connection introspection (partisan_peer_service:connections/0,
     partisan_peer_connections:count/0-3 —
     partisan_peer_connections.erl:107-110).  The sim's "connections" are
     the overlay's live out-edges; per-channel counts scale each edge by
     the channel's parallelism, mirroring conn-per-(edge × channel ×
-    lane) accounting."""
+    lane) accounting.
+
+    ``mode``: ``"full"`` includes the O(n) ``per_node`` list,
+    ``"summary"`` replaces it with min/mean/max + a degree histogram
+    (the health plane's binning, health.DEG_BINS), and ``"auto"`` (the
+    default) picks full below :data:`CONNECTION_COUNTS_FULL_MAX` nodes
+    and summary above — a 100k-node poll stays O(1) JSON."""
+    if mode not in ("auto", "full", "summary"):
+        raise ValueError(
+            f"mode {mode!r} not in ('auto', 'full', 'summary')")
     nbrs = np.asarray(cluster.manager.neighbors(
         cluster.cfg, state.manager))
     alive = np.asarray(state.faults.alive)
@@ -258,10 +334,26 @@ def connection_counts(cluster, state) -> dict:
     per_node = live_edge.sum(axis=1)
     total_edges = int(per_node.sum())
     lanes = sum(c.parallelism for c in cluster.cfg.channels)
-    return {
-        "per_node": per_node.astype(int).tolist(),
+    out = {
         "total_edges": total_edges,
         "total_connections": total_edges * lanes,   # edges × channel lanes
         "fully_connected": bool(
             (per_node[alive] > 0).all()) if alive.any() else False,
     }
+    full = mode == "full" or (mode == "auto"
+                              and alive.shape[0] <= CONNECTION_COUNTS_FULL_MAX)
+    if full:
+        out["per_node"] = per_node.astype(int).tolist()
+    else:
+        from partisan_tpu.health import DEG_BINS
+
+        deg_alive = per_node[alive]
+        hist = np.bincount(np.clip(deg_alive, 0, DEG_BINS - 1),
+                           minlength=DEG_BINS)
+        out["degrees"] = {
+            "min": int(deg_alive.min()) if deg_alive.size else 0,
+            "mean": float(deg_alive.mean()) if deg_alive.size else 0.0,
+            "max": int(deg_alive.max()) if deg_alive.size else 0,
+            "hist": hist.astype(int).tolist(),
+        }
+    return out

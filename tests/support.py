@@ -46,17 +46,29 @@ def boot_hyparview(cl, settle=40):
     return cl.steps(staggered_join(cl, cl.init()), settle)
 
 
-def components(active, alive):
+def components(active, alive, partition=None):
     """Connected components of the overlay (undirected union of active
-    views), host-side."""
+    views), host-side — the numpy BFS the device health plane's
+    pointer-jumping counter (partisan_tpu/health.py) is gated against.
+    ``partition`` optionally severs edges the way faults.py does:
+    a 1-D groups vector cuts edges between differing labels, a 2-D
+    dense matrix cuts where True."""
     n = active.shape[0]
+
+    def cut(i, j):
+        if partition is None:
+            return False
+        p = partition
+        return bool(p[i, j]) if getattr(p, "ndim", 1) == 2 \
+            else p[i] != p[j]
+
     adj = collections.defaultdict(set)
     for i in range(n):
         if not alive[i]:
             continue
         for j in active[i]:
             j = int(j)
-            if j >= 0 and alive[j]:
+            if j >= 0 and alive[j] and not cut(i, j):
                 adj[i].add(j)
                 adj[j].add(i)
     seen, comps = set(), []

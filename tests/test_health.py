@@ -1,0 +1,426 @@
+"""Health-plane suite (health.py + cluster.round_body snapshots):
+
+- the device pointer-jumping component counter matches the numpy BFS
+  oracle (tests/support.components) on >= 50 randomized overlays,
+  including faulted (crashed nodes) and group-partitioned ones — the
+  acceptance invariant,
+- symmetry-violation and isolation counts match brute-force numpy,
+- churn counters reconcile with telemetry.emit_membership_events'
+  up/down diffs over the same window,
+- the disabled flag keeps the ClusterState leaf an empty pytree and an
+  enabled plane is READ-ONLY (identical non-health evolution),
+- digest bit packing roundtrips,
+- sharded runs record bit-identical rings (skips on jax<shard_map).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import health as health_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from tests import support
+
+
+_N, _K = 200, 7   # ONE padded device shape for every random overlay —
+#                   55+ trials share two compiled programs; variation
+#                   rides the content (dead pad rows, -1 pad slots)
+
+
+def _random_overlay(rng, n, k):
+    """Random directed neighbor table and alive mask at logical size
+    (n, k), PADDED to the fixed device shape (_N, _K): rows >= n are
+    dead, slots >= k are -1 — identical component structure, no
+    per-trial recompile."""
+    nbrs = np.full((_N, _K), -1, np.int32)
+    nbrs[:n, :k] = rng.integers(-1, n, size=(n, k))
+    # no self edges (managers never hold their own id)
+    ids = np.arange(_N, dtype=np.int32)[:, None]
+    nbrs = np.where(nbrs == ids, -1, nbrs)
+    alive = np.zeros(_N, bool)
+    alive[:n] = rng.random(n) > rng.uniform(0.0, 0.4)
+    return nbrs, alive
+
+
+def test_component_count_matches_bfs_oracle_on_random_overlays():
+    """>= 50 randomized overlays — sparse, dense, heavily faulted and
+    group-partitioned — must agree EXACTLY with the host BFS oracle."""
+    rng = np.random.default_rng(42)
+    count = jax.jit(lambda nb, al: health_mod.component_count(nb, al)[1])
+    count_p = jax.jit(
+        lambda nb, al, p: health_mod.component_count(nb, al, p)[1])
+    checked = 0
+    for trial in range(40):
+        n = int(rng.integers(2, _N + 1))
+        k = int(rng.integers(1, _K + 1))
+        nbrs, alive = _random_overlay(rng, n, k)
+        got = int(count(jnp.asarray(nbrs), jnp.asarray(alive)))
+        want = len(support.components(nbrs, alive))
+        assert got == want, (trial, n, k, got, want)
+        checked += 1
+    # group-partitioned overlays: the partition severs cross-group
+    # edges exactly like faults.edge_cut's static component
+    for trial in range(15):
+        n = int(rng.integers(4, 128))
+        k = int(rng.integers(1, 6))
+        nbrs, alive = _random_overlay(rng, n, k)
+        part = rng.integers(0, int(rng.integers(2, 5)),
+                            size=_N).astype(np.int32)
+        got = int(count_p(jnp.asarray(nbrs), jnp.asarray(alive),
+                          jnp.asarray(part)))
+        want = len(support.components(nbrs, alive, partition=part))
+        assert got == want, (trial, n, k, got, want)
+        checked += 1
+    # adversarial worst case for label propagation: a path graph (the
+    # min label must travel the full diameter — naive relax-and-jump
+    # creeps O(n) here; FastSV hooking converges in O(log n))
+    for n in (2, 63, _N):
+        nbrs = np.full((_N, _K), -1, np.int32)
+        nbrs[1:n, 0] = np.arange(n - 1)
+        alive = np.zeros(_N, bool)
+        alive[:n] = True
+        assert int(count(jnp.asarray(nbrs), jnp.asarray(alive))) == 1
+        # cut the middle: two components
+        alive[n // 2] = False
+        got = int(count(jnp.asarray(nbrs), jnp.asarray(alive)))
+        assert got == len(support.components(nbrs, alive)), n
+        checked += 1
+    assert checked >= 50
+
+
+def test_symmetry_and_isolation_brute_force_parity():
+    rng = np.random.default_rng(7)
+    sym = jax.jit(lambda nb, al: health_mod.symmetry_violations(nb, al))
+    deg = jax.jit(lambda nb, al: health_mod.out_degrees(nb, al))
+    for trial in range(20):
+        n = int(rng.integers(2, 96))
+        k = int(rng.integers(1, 6))
+        nbrs, alive = _random_overlay(rng, n, k)
+        # brute force
+        want_sym = 0
+        want_deg = np.zeros(_N, int)
+        for i in range(_N):
+            if not alive[i]:
+                continue
+            for j in nbrs[i]:
+                j = int(j)
+                if j < 0 or not alive[j]:
+                    continue
+                want_deg[i] += 1
+                if i not in set(int(x) for x in nbrs[j]):
+                    want_sym += 1
+        assert int(sym(jnp.asarray(nbrs), jnp.asarray(alive))) \
+            == want_sym, trial
+        got_deg = np.asarray(deg(jnp.asarray(nbrs), jnp.asarray(alive)))
+        assert (got_deg == want_deg).all(), trial
+        want_iso = int((alive & (want_deg == 0)).sum())
+        hist = np.asarray(health_mod.degree_histogram(
+            jnp.asarray(got_deg), jnp.asarray(alive)))
+        assert hist[0] == want_iso, trial
+        assert hist.sum() == alive.sum(), trial
+
+
+def _hv_health_run(n=48, health=5, seed=3):
+    cfg = support.hv_config(n, seed=seed, health=health, health_ring=64)
+    cl = Cluster(cfg)
+    return cfg, cl, support.boot_hyparview(cl)
+
+
+def test_end_to_end_snapshot_matches_oracle_on_booted_overlay():
+    """The in-round snapshot (gathered manager.neighbors + wire-stage
+    alive) agrees with the oracle on the final state, including after
+    crashes.  Stepping is aligned so the LAST snapshot (taken at round
+    r with (r+1) % health == 0, on the post-transition state) describes
+    exactly the final visible state."""
+    cfg, cl, st = _hv_health_run()              # rnd 64 after boot
+    st = cl.steps(st, 6)                        # rnd 70; snapshot at 69
+    snap = health_mod.snapshot(st.health)
+    act = np.asarray(st.manager.active)
+    alive = np.asarray(st.faults.alive)
+    assert snap["rounds"][-1] == int(st.rnd) - 1
+    assert snap["components"][-1] == len(support.components(act, alive))
+    # crash a third of the overlay and re-align one cadence
+    victims = np.arange(3, 48, 3)
+    al = st.faults.alive.at[jnp.asarray(victims)].set(False)
+    st = st._replace(faults=st.faults._replace(alive=al))
+    st = cl.steps(st, cfg.health)               # rnd 75; snapshot at 74
+    snap = health_mod.snapshot(st.health)
+    act = np.asarray(st.manager.active)
+    alive = np.asarray(st.faults.alive)
+    assert snap["components"][-1] == len(support.components(act, alive))
+    # the dead third shows up as downs in the last churn window
+    assert snap["downs"][-1] == len(victims)
+
+
+def test_digest_pack_roundtrip():
+    rng = np.random.default_rng(5)
+    for _ in range(64):
+        comps = int(rng.integers(0, 1 << 18))
+        iso = int(rng.integers(0, 300))
+        dmin = int(rng.integers(0, 9))
+        n_alive = int(rng.integers(0, 1000))
+        target = int(rng.integers(1, 5))
+        cov = bool(rng.integers(0, 2))
+        w = int(health_mod.pack_digest(
+            jnp.int32(comps), jnp.int32(iso), jnp.int32(dmin),
+            jnp.int32(n_alive), target, jnp.bool_(cov)))
+        assert w > 0                      # int32-positive (bit 31 free)
+        d = health_mod.decode_digest(w)
+        assert d["valid"]
+        assert d["one_component"] == (comps == 1)
+        assert d["no_isolates"] == (iso == 0)
+        assert d["min_degree_ok"] == (dmin >= target and n_alive > 0)
+        assert d["coverage_complete"] == cov
+        assert d["components"] == min(comps, 0xFFFF)
+        assert d["isolated"] == min(iso, 0x7F)
+        assert health_mod.healthy(w) == (
+            d["one_component"] and d["no_isolates"]
+            and d["min_degree_ok"] and cov)
+        assert health_mod.digest_converged(w) == cov
+        assert health_mod.digest_components(w) == min(comps, 0xFFFF)
+    assert health_mod.decode_digest(0)["valid"] is False
+    assert not health_mod.digest_converged(0)
+
+
+def test_disabled_flag_zero_overhead_pytree():
+    """health=0 (the default) must keep the state leaf an empty () —
+    no arrays, no ring, no digest."""
+    cl = Cluster(Config(n_nodes=16, seed=1))
+    st = cl.init()
+    assert st.health == ()
+    assert len(jax.tree.leaves(st.health)) == 0
+    st2 = cl.steps(st, 5)
+    assert st2.health == ()
+    assert health_mod.digest(st2) == 0
+
+
+def test_health_plane_is_read_only():
+    """Enabling the plane must not perturb the simulation: every
+    non-health leaf of a health=K run equals the health=0 run's, bit
+    for bit (the Config(health=0) bit-identity acceptance criterion's
+    converse — the observatory only watches)."""
+    def drive(health):
+        cfg = support.hv_config(32, seed=11, health=health)
+        cl = Cluster(cfg)
+        st = support.boot_hyparview(cl, settle=20)
+        al = st.faults.alive.at[5].set(False)
+        st = st._replace(faults=st.faults._replace(alive=al))
+        return cl.steps(st, 10)
+
+    st_off = drive(0)
+    st_on = drive(5)
+    assert st_off.health == ()
+    assert st_on.health != ()
+    for name in ("rnd", "manager", "model", "inbox", "stats", "faults"):
+        a = jax.tree.leaves(getattr(st_off, name))
+        b = jax.tree.leaves(getattr(st_on, name))
+        assert len(a) == len(b), name
+        for x, y in zip(a, b):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+def test_churn_reconciles_with_membership_events():
+    """The device up/down window counters equal the host-side
+    telemetry.emit_membership_events up/down event counts over the same
+    window (both diff the alive mask at the window edges)."""
+    from partisan_tpu import telemetry
+
+    cfg, cl, st = _hv_health_run(health=10)
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "membership", "peer"), rec)
+    prev = st
+    # window 1: two crashes; window 2: one recovery
+    al = st.faults.alive.at[jnp.asarray([4, 9])].set(False)
+    st = cl.steps(st._replace(faults=st.faults._replace(alive=al)), 10)
+    telemetry.emit_membership_events(bus, cfg, cl.manager, prev, st)
+    prev = st
+    al = st.faults.alive.at[4].set(True)
+    st = cl.steps(st._replace(faults=st.faults._replace(alive=al)), 10)
+    telemetry.emit_membership_events(bus, cfg, cl.manager, prev, st)
+    snap = health_mod.snapshot(st.health)
+    assert snap["downs"][-2] == len(rec.of(telemetry.PEER_DOWN)) == 2
+    assert snap["ups"][-1] == len(rec.of(telemetry.PEER_UP)) == 1
+    assert snap["downs"][-1] == 0 and snap["ups"][-2] == 0
+
+
+def test_first_snapshot_reports_zero_churn():
+    """Churn is a BETWEEN-snapshots diff: the first snapshot only
+    establishes the baseline, so a fault-free run never reports
+    spurious ups/joins (and replay_health_events never fires a bogus
+    churn event) for nodes alive since round 0."""
+    from partisan_tpu import telemetry
+
+    cfg = support.hv_config(24, seed=4, health=5)
+    cl = Cluster(cfg)
+    st = cl.steps(cl.init(), 10)        # no joins yet: nothing changes
+    snap = health_mod.snapshot(st.health)
+    for name in ("ups", "downs", "joins", "leaves"):
+        assert snap[name][0] == 0, (name, snap[name])
+    assert (snap["ups"] == 0).all() and (snap["downs"] == 0).all()
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "health", "churn"), rec)
+    assert telemetry.replay_health_events(bus, snap) == 0
+    assert rec.events == []
+
+
+def test_all_dead_cluster_digest_not_converged():
+    """The digest's coverage bit must agree with the legacy poll on a
+    fully-crashed cluster: coverage reads 0.0 there, not vacuous
+    success."""
+    from partisan_tpu.models.anti_entropy import AntiEntropy
+
+    cfg = Config(n_nodes=8, seed=2, inbox_cap=32, health=5,
+                 health_ring=16)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    st = st._replace(faults=st.faults._replace(
+        alive=jnp.zeros(8, jnp.bool_)))
+    st = cl.steps(st, 10)
+    w = health_mod.digest(st)
+    assert health_mod.decode_digest(w)["valid"]
+    assert not health_mod.digest_converged(w)
+    assert float(model.coverage(st.model, st.faults.alive, 0)) == 0.0
+
+
+def test_symmetry_slotwise_path_matches_oneshot():
+    """Wide neighbor tables (scamp/fullmesh) take the O(n·K)-memory
+    slot-wise path; it must agree exactly with the one-shot gather."""
+    rng = np.random.default_rng(3)
+    nbrs, alive = _random_overlay(rng, 96, 6)
+    want = int(health_mod.symmetry_violations(
+        jnp.asarray(nbrs), jnp.asarray(alive)))
+    orig = health_mod.SYM_ONESHOT_ELEMS
+    try:
+        health_mod.SYM_ONESHOT_ELEMS = 1     # force the fori_loop path
+        got = int(health_mod.symmetry_violations(
+            jnp.asarray(nbrs), jnp.asarray(alive)))
+    finally:
+        health_mod.SYM_ONESHOT_ELEMS = orig
+    assert got == want
+
+
+def test_digest_coverage_bit_tracks_model_coverage():
+    """The digest folds the model's slot-0 coverage in: set once every
+    alive node holds the broadcast — what scenarios._converge polls."""
+    from partisan_tpu.models.anti_entropy import AntiEntropy
+
+    cfg = Config(n_nodes=16, seed=1, inbox_cap=32, health=5,
+                 health_ring=32)
+    model = AntiEntropy()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    m = st.manager
+    for i in range(1, 16):
+        m = cl.manager.join(cfg, m, i, 0)
+    st = cl.steps(st._replace(manager=m), 20)
+    w = health_mod.digest(st)
+    assert not health_mod.digest_converged(w)     # nothing broadcast
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    for _ in range(12):                           # poll like _converge
+        st = cl.steps(st, 10)
+        if health_mod.digest_converged(health_mod.digest(st)):
+            break
+    assert health_mod.digest_converged(health_mod.digest(st))
+    cov = float(model.coverage(st.model, st.faults.alive, 0))
+    assert cov == 1.0
+
+
+def test_snapshot_cadence_and_ring_wraparound():
+    """Snapshots land every `health` rounds at (rnd+1) % health == 0
+    and the ring keeps the most recent window once it wraps."""
+    cfg = support.hv_config(24, seed=2, health=4, health_ring=6)
+    cl = Cluster(cfg)
+    st = support.boot_hyparview(cl, settle=40)   # rnd = 12*2 + 40 = 52
+    snap = health_mod.snapshot(st.health)
+    rnds = snap["rounds"].tolist()
+    assert len(rnds) == 6                        # ring full
+    assert rnds == [31, 35, 39, 43, 47, 51]     # last 6 cadence points
+    # latest digest scalar equals the last ring entry
+    assert health_mod.digest(st) == int(snap["digests"][-1])
+
+
+def test_health_state_is_scan_carry_no_callbacks():
+    """No host transfer inside the scan: the health ring rides the
+    lax.scan carry."""
+    cfg = support.hv_config(16, seed=1, health=2, health_ring=8)
+    cl = Cluster(cfg)
+    st = cl.init()
+    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 8))(st))
+    for prim in ("callback", "io_effect", "outfeed"):
+        assert prim not in jaxpr, prim
+    out = cl.steps(st, 8)
+    assert health_mod.snapshot(out.health)["rounds"].tolist() == [1, 3, 5, 7]
+
+
+def test_sharded_health_ring_matches_single_device():
+    """Placement invariance: the same run on 1 device and on a mesh
+    records bit-identical health rings (snapshots derive from the
+    all-gathered global graph on every shard)."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map unavailable on this jax "
+                    "(parallel/sharded.py requires it)")
+    from partisan_tpu.models.anti_entropy import AntiEntropy
+    from partisan_tpu.parallel.sharded import ShardedCluster, make_mesh
+
+    cfg = Config(n_nodes=16, seed=3, inbox_cap=24, health=3,
+                 health_ring=32)
+
+    def drive(cl):
+        st = cl.init()
+        m = st.manager
+        for i in range(1, 16):
+            m = cl.manager.join(cfg, m, i, 0)
+        st = cl.steps(st._replace(manager=m), 10)
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0))
+        alive = st.faults.alive.at[7].set(False)
+        st = st._replace(faults=st.faults._replace(alive=alive))
+        return cl.steps(st, 30)
+
+    st_l = drive(Cluster(cfg, model=AntiEntropy()))
+    st_s = drive(ShardedCluster(cfg, make_mesh(), model=AntiEntropy()))
+    snap_l = health_mod.snapshot(st_l.health)
+    snap_s = health_mod.snapshot(st_s.health)
+    for name, series in snap_l.items():
+        assert np.array_equal(series, snap_s[name]), name
+    assert health_mod.digest(st_l) == health_mod.digest(st_s)
+    # and the run recorded real snapshots with the crash visible
+    assert snap_l["rounds"].size > 0
+    assert snap_l["downs"].sum() == 1
+
+
+def test_width_operand_masks_inactive_prefix_rows():
+    """Under Config.width_operand, inactive rows are invisible to the
+    observatory: a prefix-activated run snapshots the same topology
+    series as a native-width run (the prefix-dynamics contract of
+    tests/test_program_budget.py, extended to the health plane)."""
+    from partisan_tpu import cluster as cluster_mod
+
+    def boot(cl, n):
+        st = cl.init()
+        if cl.cfg.width_operand:
+            st = cluster_mod.activate(st, n)
+        for base in range(1, n, 4):
+            m = st.manager
+            for i in range(base, min(base + 4, n)):
+                m = cl.manager.join(cl.cfg, m, i, 0)
+            st = cl.steps(st._replace(manager=m), 2)
+        return cl.steps(st, 20)
+
+    n = 24
+    cfg_n = support.hv_config(n, seed=6, health=4, health_ring=16)
+    st_n = boot(Cluster(cfg_n), n)
+    cfg_w = support.hv_config(2 * n, seed=6, health=4, health_ring=16,
+                              width_operand=True)
+    st_w = boot(Cluster(cfg_w), n)
+    snap_n = health_mod.snapshot(st_n.health)
+    snap_w = health_mod.snapshot(st_w.health)
+    for name in ("rounds", "components", "isolated", "deg_min",
+                 "deg_max", "sym_violations", "joins", "leaves", "ups",
+                 "downs", "deg_hist"):
+        assert np.array_equal(snap_n[name], snap_w[name]), name

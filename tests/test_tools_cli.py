@@ -1,0 +1,78 @@
+"""CLI smoke tests for the profiling/observability tools (the
+tests/test_pallas_probe.py pattern: run the real entrypoint off-TPU in
+a subprocess, demand an honest exit code and parseable output).
+
+The profile tools previously had zero tests — a bitrotted import or a
+renamed config knob only surfaced on the next TPU session.  Each smoke
+runs the tool's full path (cluster build, bootstrap, timed executions)
+at a tiny n on CPU.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(tool, *args, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", tool), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO)
+
+
+def test_profile_phases_cli_smoke():
+    """Component-level phase timer: the `only` filter keeps the smoke
+    to the route/compaction blocks (one compile each)."""
+    out = _run("profile_phases.py", "128", "route")
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if "ms/iter" in ln]
+    assert any("route" in ln for ln in lines), out.stdout
+    # honest exit code: bad input must FAIL, not print-and-exit-0
+    bad = _run("profile_phases.py", "not_a_number")
+    assert bad.returncode != 0
+
+
+def test_profile_round_cli_smoke():
+    """Ablation profiler, smoke mode: one variant end-to-end (bootstrap
+    + timed executions) at a tiny n."""
+    out = _run("profile_round.py", "64", "smoke")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "per-round" in out.stdout, out.stdout
+    bad = _run("profile_round.py", "not_a_number")
+    assert bad.returncode != 0
+
+
+def test_health_report_cli_smoke():
+    """Health-plane exporter: JSON lines with snapshot rows, replayed
+    partisan.health.* events, and a trailing digest summary; the
+    --partition run must show the detected/healed pair."""
+    out = _run("health_report.py", "96", "40", "--partition")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    kinds = [r["kind"] for r in rows]
+    assert kinds[-1] == "summary"
+    snaps = [r for r in rows if r["kind"] == "snapshot"]
+    assert snaps, "no snapshot lines emitted"
+    for s in snaps:
+        assert {"components", "isolated", "degree", "churn",
+                "symmetry_violations", "digest"} <= set(s)
+        assert s["digest"]["valid"]
+        assert len(s["degree"]["hist"]) > 0
+    # the scripted split shows up in the component series and as the
+    # partition_detected / overlay_healed event pair
+    comps = [s["components"] for s in snaps]
+    assert max(comps) > 1 and comps[-1] == 1, comps
+    events = [tuple(r["event"]) for r in rows if r["kind"] == "event"]
+    assert ("partisan", "health", "partition_detected") in events
+    assert ("partisan", "health", "overlay_healed") in events
+    summary = rows[-1]
+    assert summary["digest"]["one_component"]
+    assert summary["healthy"] == (
+        summary["digest"]["one_component"]
+        and summary["digest"]["no_isolates"]
+        and summary["digest"]["min_degree_ok"]
+        and summary["digest"]["coverage_complete"])

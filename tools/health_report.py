@@ -1,0 +1,97 @@
+"""Health-plane JSON-lines exporter (the ``BENCH_*.json`` idiom: one
+self-describing JSON object per line).
+
+Runs a HyParView bootstrap with ``Config.health`` enabled, then prints
+the decoded per-snapshot topology series — component count (the device
+pointer-jumping counter), isolated-alive count, out-degree histogram,
+edge-symmetry violations, windowed churn — one line per snapshot, the
+``partisan.health.*`` bus events replayed from the ring, and a trailing
+summary line with the decoded one-scalar digest::
+
+    python tools/health_report.py [n] [rounds] [--partition]
+
+``--partition`` splits the overlay into two groups halfway through and
+heals it for the final quarter, so the event stream shows a real
+``partition_detected`` / ``overlay_healed`` pair and the component
+series shows the split.  Importable: ``report(state)`` renders any
+health-carrying state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def report(state, out=sys.stdout) -> dict:
+    """Dump ``state``'s health ring as JSON lines; returns the decoded
+    digest dict (also printed as the last line)."""
+    from partisan_tpu import health, telemetry
+
+    if state.health == ():
+        raise ValueError("state carries no health ring — build the "
+                         "cluster with Config(health=K)")
+    snap = health.snapshot(state.health)
+    for row in health.rows(snap):
+        print(json.dumps({"kind": "snapshot", **row}), file=out)
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("report", ("partisan", "health"), rec)
+    telemetry.replay_health_events(bus, snap)
+    for event, meas, meta in rec.events:
+        print(json.dumps({"kind": "event", "event": list(event),
+                          **meas, **meta}), file=out)
+    dig = health.digest(state)
+    summary = {"kind": "summary", "snapshots": int(len(snap["rounds"])),
+               "digest_word": dig, "digest": health.decode_digest(dig),
+               "healthy": health.healthy(dig)}
+    print(json.dumps(summary), file=out)
+    return summary["digest"]
+
+
+def main() -> None:
+    import numpy as np
+
+    from partisan_tpu import faults as faults_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config
+
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if args else 256
+    rounds = int(args[1]) if len(args) > 1 else 80
+    partition = "--partition" in sys.argv
+
+    cfg = Config(n_nodes=n, seed=9, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 health=5, health_ring=max(64, rounds))
+    cl = Cluster(cfg)
+    st = cl.init()
+    rng = np.random.default_rng(7)
+    base = 1
+    while base < n:
+        hi = min(base * 4, n)
+        nodes = np.arange(base, hi, dtype=np.int32)
+        tgts = rng.integers(0, base, size=nodes.shape[0]).astype(np.int32)
+        st = st._replace(manager=cl.manager.join_many(
+            cfg, st.manager, nodes, tgts))
+        st = cl.steps(st, 10)
+        base = hi
+    q = max(5, rounds // 4)
+    st = cl.steps(st, 2 * q)
+    if partition:
+        # Full split (groups mode expresses only full splits), held for
+        # a quarter of the run, then healed — the detected/healed pair.
+        half = np.arange(n // 2), np.arange(n // 2, n)
+        st = st._replace(faults=faults_mod.inject_partition(
+            st.faults, half[0], half[1]))
+        st = cl.steps(st, q)
+        st = st._replace(faults=faults_mod.resolve_partition(st.faults))
+    st = cl.steps(st, q)
+    report(st)
+
+
+if __name__ == "__main__":
+    main()

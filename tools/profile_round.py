@@ -11,7 +11,8 @@ Phase attribution: ``round_body`` wraps each round phase in
 ``jax.named_scope`` (round.manager / round.model /
 round.delivery_outbound / round.wire_fast / round.interpose /
 round.throttle / round.fault / round.route / round.delivery_inbound /
-round.metrics), so ops in a profiler trace carry their phase name.
+round.metrics / round.health), so ops in a profiler trace carry their
+phase name.
 Set ``PROFILE_TRACE_DIR=/tmp/trace`` to capture a ``jax.profiler``
 trace of the timed executions (each labeled with a
 ``TraceAnnotation``), viewable in TensorBoard/Perfetto, where the
@@ -85,7 +86,12 @@ if __name__ == "__main__":
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 32_768
     which = sys.argv[2] if len(sys.argv) > 2 else "r5"
-    if which == "r5":
+    if which == "smoke":
+        # CI smoke (tests/test_tools_cli.py): one variant at a tiny n so
+        # the tool's full path — bootstrap, timed executions, profiler
+        # annotations — runs end-to-end off-TPU in seconds.
+        measure(n, "baseline (bench config)")
+    elif which == "r5":
         measure(n, "stagger idle (r4 baseline)")
         measure(n, "stagger active", active=True)
         measure(n, "aligned idle", timer_stagger=False)
