@@ -54,7 +54,8 @@ PER_SIZE_CAP_S = 340.0         # no single rung may eat the whole budget
 
 
 def run(n: int, verbose: bool = False, metrics: bool = False,
-        latency: bool = False, health: bool = False) -> dict:
+        latency: bool = False, health: bool = False,
+        provenance: bool = False) -> dict:
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config, HyParViewConfig, \
         PlumtreeConfig
@@ -118,6 +119,12 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
                       # isolation, symmetry, churn) + the one-scalar
                       # digest; series go to STDERR only
                       health=(K_PROG if health else 0), health_ring=256,
+                      # opt-in provenance plane (--provenance): the
+                      # (emitter gid, hop) wire pair + dissemination
+                      # forest/redundancy rings in the carry (zero host
+                      # syncs inside the scan); redundancy ratio + tree
+                      # depth + coverage round go to STDERR only
+                      provenance=provenance, provenance_ring=256,
                       # ONE width-generic round program for the whole
                       # bootstrap ladder: rung width rides the n_active
                       # operand instead of recompiling per width
@@ -222,6 +229,18 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
     # Dispatch overhead is INCLUDED here and convergence-phase rounds
     # carry the live broadcast front, so rps reads conservative.)
     start_rnd = int(st.rnd)
+    if provenance:
+        # Origin mark for (node 0, slot 0) — the injection point the
+        # device cannot see; one jitted dispatch (eager .at[].set would
+        # be host round-trips on the relay-attached device).  Before t0:
+        # the mark program's one-off trace/compile/relay-load must not
+        # inflate the reported convergence wall.
+        from partisan_tpu import provenance as provenance_mod
+
+        mark_src = jax.jit(lambda pv, r: provenance_mod.mark_origin(
+            pv, 0, 0, rnd=r), static_argnums=1)
+        st = st._replace(provenance=mark_src(st.provenance, start_rnd))
+        sync(st)
     t0 = time.perf_counter()
     st = st._replace(model=inject(st.model, start_rnd))
     max_rounds = max(300, 2 * int(np.log2(n)) * 20)
@@ -307,6 +326,25 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
         print(json.dumps({"kind": "health_digest", "n": n,
                           "word": dig, **health_mod.decode_digest(dig)}),
               file=sys.stderr)
+    if provenance:
+        # Broadcast-provenance headline to stderr: whole-run redundancy
+        # ratio (the traffic PRUNE exists to remove), the delivered
+        # tree's depth/branching, and the round full coverage was
+        # reached — all decoded AFTER the run from the scan carry
+        # (stdout keeps the one-line contract).
+        from partisan_tpu import provenance as provenance_mod
+
+        snap = provenance_mod.snapshot(st.provenance)
+        tr = provenance_mod.tree(snap, 0)
+        print(json.dumps({
+            "kind": "provenance", "n": n,
+            **provenance_mod.redundancy(snap),
+            "tree_depth_mean": tr["depth_mean"],
+            "tree_depth_max": tr["depth_max"],
+            "branching_mean": tr["branching_mean"],
+            "branching_max": tr["branching_max"],
+            "claimed": tr["claimed"],
+            "coverage_round": tr["cover_round"]}), file=sys.stderr)
     if verbose:
         print(f"n={n}: {rps:.1f} rounds/s, broadcast converged in "
               f"{conv_rounds} rounds ({phases['converge']:.1f}s wall), "
@@ -527,7 +565,8 @@ if __name__ == "__main__":
         r = run(int(sys.argv[2]), verbose=True,
                 metrics="--metrics" in sys.argv,
                 latency="--latency" in sys.argv,
-                health="--health" in sys.argv)
+                health="--health" in sys.argv,
+                provenance="--provenance" in sys.argv)
         print(json.dumps({"size_phases": {str(r["n"]): r["phases"]}}),
               file=sys.stderr)
         print(json.dumps(r))

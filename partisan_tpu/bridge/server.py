@@ -111,9 +111,15 @@ class Bridge:
             rec = np.asarray(msg_ops.build(
                 w, T.MsgKind.APP, src, dst,
                 payload=tuple(jnp.int32(x) for x in pw)))
+            if cl.cfg.provenance:
+                # The inbox is wire_words wide under the provenance
+                # plane: widen with the pair (emitter gid, hop 0).
+                rec = np.concatenate(
+                    [rec, np.asarray([src, 0], np.int32)])
             if cl.cfg.latency:
                 # The inbox is wire_words wide under the latency plane:
-                # widen the injected record with its birth round.
+                # widen the injected record with its birth round (the
+                # birth word is always LAST — after the provenance pair).
                 rec = np.concatenate(
                     [rec, np.asarray([int(self.st.rnd)], np.int32)])
             self._pending.append(rec)

@@ -37,7 +37,10 @@ from partisan_tpu.config import Config
 
 
 class OutboxState(NamedTuple):
-    data: Array  # int32[n_local, OB, W] — deferred sends (kind==0 free)
+    data: Array  # int32[n_local, OB, W] — deferred sends (kind==0 free;
+    #              W = wire_words: deferred copies carry the provenance
+    #              pair and birth word verbatim, so a release names its
+    #              true origin/hop and keeps its emission round)
     shed: Array  # int32 — deferred sends dropped (outbox overflow)
 
 

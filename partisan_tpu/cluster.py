@@ -33,6 +33,7 @@ from partisan_tpu import health as health_mod
 from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
+from partisan_tpu import provenance as provenance_mod
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
@@ -77,6 +78,10 @@ class ClusterState(NamedTuple):
     health: Any = ()        # health.HealthState topology-snapshot ring
     #                         (or () when Config.health is 0 — zero
     #                         cost, trace bit-identical to pre-health)
+    provenance: Any = ()    # provenance.ProvenanceState dissemination
+    #                         forest + redundancy rings (or () when
+    #                         Config.provenance is off — zero cost,
+    #                         wire width and trace bit-identical)
 
 
 class TraceRound(NamedTuple):
@@ -99,6 +104,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     mx = metrics_mod.enabled(cfg)   # static: specializes the trace
     lx = latency_mod.enabled(cfg)   # static: birth-word threading
     hx = health_mod.enabled(cfg)    # static: topology-snapshot cadence
+    px = provenance_mod.enabled(cfg)  # static: provenance-pair threading
+    pspec = provenance_mod.spec_of(model) if px else None
     # Flight recording needs the generic wire path's materialized
     # (sent, dropped) pair — same constraint as capture.  Gated on the
     # state actually carrying a ring so shape discovery (eval_shape on
@@ -141,6 +148,11 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             emitted = jnp.concatenate([m_emit, a_emit], axis=1)
     else:
         dstate_model, emitted = (), m_emit
+    if px:
+        # Provenance pair: widen every fresh emission by (emitter gid,
+        # sender tree hop).  Appended BEFORE the birth word so the
+        # latency plane's [..., -1] indexing still reads the birth.
+        emitted = provenance_mod.stamp(cfg, pspec, emitted, gids)
     if lx:
         # Birth-round word: widen every fresh emission to wire_words.
         # Queued copies downstream (ack store, causal rings, outbox,
@@ -155,6 +167,12 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         with jax.named_scope("round.delivery_outbound"):
             dstate, emitted, wides = delivery_mod.outbound(
                 cfg, comm, dstate, emitted, ctx)
+    # Provenance reads the post-outbound PRE-WIRE stack for its control
+    # EMITTED counts (what the protocol built this round — retransmit
+    # replays included, shed/interposition/fault cuts not yet applied);
+    # the generic path reassigns `emitted` through the wire stages, so
+    # the reference is taken here.
+    prov_stack = emitted if px else None
 
     # ---- the wire stage: monotonic shed -> interposition -> emission
     # count -> channel throttling -> fault masks.  Two implementations:
@@ -445,6 +463,17 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 cfg, comm, lt, rnd=state.rnd, inbox_data=inbox.data,
                 dead=dead, fault_hist=lat_fault,
                 compact_hist=lat_compact, outbox_hist=lat_outbox)
+    pv = state.provenance
+    if px:
+        # Same delivered set as the metrics/latency planes (the routed
+        # inbox before dead-receiver masking, `dead` covering crashed
+        # and — under width_operand — inactive rows), so the redundancy
+        # ring reconciles with the delivered series by construction.
+        with jax.named_scope("round.provenance"):
+            pv = provenance_mod.record_round(
+                cfg, comm, pspec, pv, rnd=state.rnd, emitted=prov_stack,
+                inbox_data=inbox.data, dead=dead,
+                alive_local=alive_local)
     inbox = exchange.Inbox(
         data=jnp.where(dead[:, None, None], 0, inbox.data),
         count=jnp.where(dead, 0, inbox.count),
@@ -538,7 +567,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                        delivery=dstate, stats=stats, interpose=istate,
                        outbox=obstate, metrics=mets, latency=lt,
                        flight=fstate, n_active=state.n_active,
-                       health=hstate)
+                       health=hstate, provenance=pv)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent,
                                dropped=fault_dropped)
@@ -665,6 +694,8 @@ class Cluster:
                       else ()),
             health=(health_mod.init(cfg)
                     if health_mod.enabled(cfg) else ()),
+            provenance=(provenance_mod.init(cfg, comm)
+                        if provenance_mod.enabled(cfg) else ()),
         )
 
     def _build_init(self) -> ClusterState:

@@ -354,6 +354,18 @@ class Config:
     #                              into a trace.Trace host-side; forces
     #                              the generic wire path (like capture)
 
+    # --- provenance plane (provenance.py) ------------------------------
+    provenance: bool = False     # thread a provenance word pair (true
+    #                              emitter gid, sender tree hop) onto
+    #                              every wire record (wire_words grows
+    #                              by 2) and accumulate the broadcast
+    #                              dissemination forest + redundancy /
+    #                              control-plane counters in the carry;
+    #                              off = leaf is (), wire unchanged —
+    #                              no cost, trace bit-identical
+    provenance_ring: int = 128   # rounds of redundancy/control history
+    #                              (ring buffer, slot = rnd % ring)
+
     # --- health plane (health.py) --------------------------------------
     health: int = 0              # >0: every `health` rounds compute a
     #                              device-resident topology snapshot of
@@ -400,6 +412,9 @@ class Config:
         if self.flight_rounds < 0:
             raise ValueError(
                 f"flight_rounds must be >= 0, got {self.flight_rounds}")
+        if self.provenance_ring < 1:
+            raise ValueError(
+                f"provenance_ring must be >= 1, got {self.provenance_ring}")
         if self.health < 0:
             raise ValueError(
                 f"health must be >= 0 (a snapshot cadence in rounds; "
@@ -433,13 +448,23 @@ class Config:
 
     @property
     def wire_words(self) -> int:
-        """Words per QUEUED wire record: ``msg_words`` plus the latency
-        plane's trailing birth-round word when ``latency`` is on.
-        Managers/models still build ``msg_words``-wide emissions — the
-        round body appends the birth word before any queueing stage, so
-        protocol code never sees it (header/payload indices are all
-        below ``msg_words``)."""
-        return self.msg_words + 1 if self.latency else self.msg_words
+        """Words per QUEUED wire record: ``msg_words`` plus the
+        provenance plane's word pair (emitter gid, sender hop) when
+        ``provenance`` is on, plus the latency plane's trailing
+        birth-round word when ``latency`` is on.  The birth word is
+        always LAST (latency.py indexes ``[..., -1]``); the provenance
+        pair sits at ``msg_words``/``msg_words + 1`` (provenance.py
+        ``src_word``/``hop_word``).  Managers/models still build
+        ``msg_words``-wide emissions — the round body appends the
+        trailing words before any queueing stage, so protocol code
+        never sees them (header/payload indices are all below
+        ``msg_words``)."""
+        w = self.msg_words
+        if self.provenance:
+            w += 2
+        if self.latency:
+            w += 1
+        return w
 
     def channel_id(self, name: str) -> int:
         for i, c in enumerate(self.channels):

@@ -56,6 +56,7 @@ from jax import Array
 
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import latency as latency_mod
+from partisan_tpu import provenance as provenance_mod
 from partisan_tpu import types as T
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
@@ -166,7 +167,9 @@ def needs_inbound(cfg: Config) -> bool:
 
 def init(cfg: Config, comm) -> DeliveryState:
     n = comm.n_local
-    W = cfg.wire_words   # queued copies carry the birth word (latency.py)
+    W = cfg.wire_words   # queued copies carry the trailing provenance
+    #                      pair (provenance.py) and birth word
+    #                      (latency.py) verbatim
     WA = W + cfg.n_actors
     ack = AckState(
         outstanding=jnp.zeros((n, cfg.ack_cap, W), jnp.int32),
@@ -267,6 +270,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             jnp.where(need_ack, inb[..., T.W_SRC], 0))
         ack_msgs = ack_msgs.at[..., T.W_CLOCK].set(
             jnp.where(need_ack, inb[..., T.W_CLOCK], 0))
+        ack_msgs = provenance_mod.stamp_fresh(cfg, ack_msgs)
         ack_msgs = latency_mod.stamp_fresh(cfg, ack_msgs, ctx.rnd)
         extra.append(ack_msgs)
 
@@ -552,6 +556,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                 jnp.where(rst_on, -jnp.maximum(lane.reset_seq, 1), 0))
             rst_msgs = rst_msgs.at[..., T.W_LANE].set(
                 jnp.where(rst_on, lid, 0))
+            rst_msgs = provenance_mod.stamp_fresh(cfg, rst_msgs)
             rst_msgs = latency_mod.stamp_fresh(cfg, rst_msgs, ctx.rnd)
 
             # 6b. Compact + admit this round's fresh sends against the free
@@ -659,6 +664,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                 jnp.where(ack_now, lane.src_seq, 0))
             ack_msgs = ack_msgs.at[..., T.W_LANE].set(
                 jnp.where(ack_now, lid | (lane.src_ep << 8), 0))
+            ack_msgs = provenance_mod.stamp_fresh(cfg, ack_msgs)
             ack_msgs = latency_mod.stamp_fresh(cfg, ack_msgs, ctx.rnd)
             src_acked = jnp.where(ack_now, lane.src_seq, lane.src_acked)
 

@@ -176,8 +176,10 @@ class Delay:
     def init(self, cfg: Config, comm: Any) -> Any:
         n = comm.n_local
         return {
-            # wire_words: held copies carry the latency plane's birth
-            # word, so a delayed release keeps its true emission round
+            # wire_words: held copies carry the provenance plane's
+            # (emitter, hop) pair and the latency plane's birth word
+            # verbatim, so a delayed release keeps its true origin,
+            # tree depth and emission round
             "buf": jnp.zeros((n, self.cap, cfg.wire_words), jnp.int32),
             "due": jnp.full((n, self.cap), -1, jnp.int32),  # release round
             # overflow accounting: matching messages that passed through

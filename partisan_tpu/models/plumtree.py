@@ -102,6 +102,19 @@ class Plumtree:
         self.handler = handler if handler is not None \
             else handlers_mod.VersionHandler()
 
+    @property
+    def prov_spec(self):
+        """Provenance descriptor (provenance.py): PT_GOSSIP records
+        carry [slot, payload×PW, hop, epoch] after the header — the hop
+        word is the sender's tree depth (``rround``), the epoch word
+        the slot-recycle generation the accumulator's reset tracks."""
+        from partisan_tpu import provenance as provenance_mod
+
+        PW = self.handler.payload_words
+        return provenance_mod.ProvSpec(
+            kind=int(T.MsgKind.PT_GOSSIP), slot_word=T.P0,
+            hop_word=T.P1 + PW, epoch_word=T.P1 + PW + 1)
+
     def init(self, cfg: Config, comm: LocalComm) -> PlumtreeState:
         n, B = comm.n_local, cfg.max_broadcasts
         PW = self.handler.payload_words

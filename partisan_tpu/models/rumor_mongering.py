@@ -43,6 +43,19 @@ class RumorState(NamedTuple):
 class RumorMongering:
     name = "demers_rumor_mongering"
 
+    @property
+    def prov_spec(self):
+        """Provenance descriptor (provenance.py): rumor copies are APP
+        records with payload [OP_RUMOR, slot].  Infect-and-die carries
+        no depth counter, so there is no hop word — every claim lands
+        at hop 1 (the parent forest and redundancy accounting stay
+        exact; only depth stats are flat)."""
+        from partisan_tpu import provenance as provenance_mod
+
+        return provenance_mod.ProvSpec(
+            kind=int(T.MsgKind.APP), slot_word=T.P1,
+            match_word=T.P0, match_val=OP_RUMOR)
+
     def init(self, cfg: Config, comm: LocalComm) -> RumorState:
         z = jnp.zeros((comm.n_local, cfg.max_broadcasts), jnp.bool_)
         return RumorState(store=z, pending=z)

@@ -49,6 +49,18 @@ class Stack:
                 return m.coverage(s, alive, slot)
         return jnp.float32(1.0)
 
+    @property
+    def prov_spec(self):
+        """Provenance descriptor of the FIRST sub-model that defines
+        one (the broadcast layer in the bench/scenario stacks) — the
+        same first-wins rule as ``coverage``.  Message kinds are
+        globally unique, so the accumulator's kind filter cannot
+        confuse another sub-model's traffic."""
+        for m in self.models:
+            if hasattr(m, "prov_spec"):
+                return m.prov_spec
+        return None
+
     # Host-side helpers address sub-models by index.
     def sub(self, state: tuple, i: int):
         return state[i]

@@ -39,6 +39,7 @@ from partisan_tpu import health as health_mod
 from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
+from partisan_tpu import provenance as provenance_mod
 from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
@@ -250,6 +251,17 @@ class ShardedCluster:
             # global graph, so every shard computes identical values —
             # replicated like the metrics ring.
             health=spec_like(state.health, repl),
+            # Provenance: the dissemination-forest tables are per-node
+            # rows (shard them on the node axis, like the model state
+            # they describe); rings/marks/totals are reduced before
+            # every write — replicated.
+            provenance=(() if state.provenance == ()
+                        else provenance_mod.ProvenanceState(
+                            parent=shard, hop=shard, claim_rnd=shard,
+                            epoch=shard, rnd=repl, dup=repl,
+                            gossip=repl, claims=repl, ctl=repl,
+                            depth_hwm=repl, cover_rnd=repl,
+                            dup_cum=repl, gossip_cum=repl)),
         )
 
     # ---- state construction ------------------------------------------
@@ -278,6 +290,8 @@ class ShardedCluster:
                       else ()),
             health=(health_mod.init(cfg)
                     if health_mod.enabled(cfg) else ()),
+            provenance=(provenance_mod.init(cfg, self.host_comm)
+                        if provenance_mod.enabled(cfg) else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

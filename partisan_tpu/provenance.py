@@ -1,0 +1,483 @@
+"""Device-resident broadcast provenance plane: who delivered each
+broadcast copy, along what tree, at what hop depth — and how much of
+the gossip traffic was redundant duplicates.
+
+Plumtree's whole contribution (Leitão et al., "Epidemic Broadcast
+Trees", SRDS 2007 — the reference's partisan_plumtree_broadcast.erl) is
+trading redundancy for tree repair: eager links carve a spanning tree,
+duplicates demote links to lazy, I_HAVE/GRAFT re-activate them.  PR 1
+restored *how many* messages died, PR 2 *how long* they lived, PR 4
+*what the overlay looks like*; this plane restores *why* — the
+dissemination structure itself.  It is the Dapper span-parent idea
+(Sigelman et al. 2010, already the model for latency.py) applied to
+epidemic broadcast: every wire record carries its span context, and the
+collection infrastructure is a scan carry.
+
+**Wire mechanism** (``Config(provenance=True)``): every event-lane
+record grows TWO trailing int32 words — the **provenance pair**
+``(prov_src, prov_hop)`` — via the latency plane's trailing-word
+mechanism (``Config.wire_words`` grows by 2; managers/models still emit
+``msg_words``-wide and the round body appends, so protocol code never
+sees the words).  ``prov_src`` is the EMITTING ROW's global id, stamped
+by round_body from ``comm.local_ids()`` — ground truth that survives
+any ``W_SRC`` rewrite an interposition chain might apply.  ``prov_hop``
+is the sender's tree depth for the copy, read at stamp time from the
+model's :class:`ProvSpec` hop word (plumtree's gossip hop counter; 0
+for models without one).  Queued copies — the ack store and causal
+rings (delivery.py), the channel-capacity outbox (channels.py), the
+egress/ingress delay hold buffer (interpose.py), the routed inbox —
+carry the widened record VERBATIM, so a retransmission or deferred
+release still names its true origin and depth.  Word layout::
+
+    [0, msg_words)            protocol record (unchanged)
+    msg_words                 prov_src   (when provenance)
+    msg_words + 1             prov_hop   (when provenance)
+    wire_words - 1            birth round (when latency — always LAST,
+                              so latency.py's [..., -1] indexing holds)
+
+**Accumulation** (inside the jitted scan, zero host syncs):
+
+- ``parent/hop/claim_rnd/epoch int32[n_local, B]`` — the spanning
+  FOREST: per (node, broadcast slot), the first-delivery parent, its
+  claimed depth (sender hop + 1), the claim round, and the slot epoch
+  the claim belongs to.  A delivered gossip copy with a HIGHER epoch
+  (a recycled slot — models/plumtree.py epoch docs) resets the entry;
+  within a round, the winning copy is the minimum ``(hop, sender)``
+  pair (order-independent, so sharded routing order cannot matter).
+  Node-sharded on axis 0 under parallel/sharded.py — each shard owns
+  its rows, exactly like the model state the forest describes.
+- ``dup int32[R, C]`` / ``gossip int32[R]`` / ``claims int32[R]`` —
+  the REDUNDANCY accounting ring (R = ``Config.provenance_ring``,
+  shared ring decoder ``metrics.ring_order``): every delivered gossip
+  copy that did not claim a first delivery is a duplicate — the
+  traffic Plumtree's PRUNE exists to eliminate — split per channel
+  like PR 1's counters.  ``dup_cum``/``gossip_cum`` keep whole-run
+  totals past ring wraparound.
+- ``ctl int32[R, N_CTL, 2]`` — control-plane counters: PRUNE / GRAFT /
+  I_HAVE / IGNORED_I_HAVE (PT_IHAVE_ACK), emitted and delivered per
+  round.  Emitted counts read the post-outbound pre-wire stack (what
+  the protocol built this round); delivered counts read the routed
+  inbox before dead-receiver masking — the same delivered set the
+  metrics/latency planes count.
+- ``depth_hwm int32[B]`` — per-slot tree-depth high-water mark,
+  ``comm.allmax``-reduced.
+- ``cover_rnd int32[B]`` — first round the slot reached FULL coverage
+  (every active alive node holds a claim; origins are marked via
+  :func:`mark_origin` with ``parent == self``).  -1 until reached.
+
+All counters/rings are ``comm.allsum``/``comm.allmax``-reduced before
+the write (replicated, like the metrics ring); the forest tables stay
+shard-local.  ``Config(provenance=False)`` (the default) keeps the
+ClusterState leaf an empty ``()`` pytree and the wire at its previous
+width — the send-path trace is bit-identical to a pre-provenance build
+(tests/test_provenance.py gates read-only-ness and the host
+trace-replay oracle).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+
+# Control-plane taxonomy (partisan_plumtree_broadcast.erl:843-905): the
+# tree-maintenance vocabulary, counted emitted+delivered per round.
+CTL_KINDS = (int(T.MsgKind.PT_PRUNE), int(T.MsgKind.PT_GRAFT),
+             int(T.MsgKind.PT_IHAVE), int(T.MsgKind.PT_IHAVE_ACK))
+CTL_NAMES = ("prune", "graft", "i_have", "ignored_i_have")
+N_CTL = len(CTL_KINDS)
+
+_BIG = jnp.int32(2**30)
+
+
+class ProvSpec(NamedTuple):
+    """Static wire-layout descriptor a broadcast model exposes via
+    ``prov_spec(cfg)`` so the accumulator can read its gossip records
+    without knowing the model.  All fields are Python statics — they
+    specialize the traced round, costing nothing at run time.
+
+    ``kind``: the MsgKind of data-bearing broadcast copies.
+    ``slot_word``: record index of the broadcast slot id.
+    ``hop_word``: record index of the SENDER's tree depth (stamped into
+    ``prov_hop``), or None — models without one (rumor mongering's
+    infect-and-die has no depth counter) claim every delivery at hop 1;
+    the parent forest stays exact.
+    ``epoch_word``: record index of the slot-recycle epoch, or None.
+    ``match_word``/``match_val``: optional extra payload filter for
+    models that multiplex a kind (rumor's APP + opcode)."""
+
+    kind: int
+    slot_word: int
+    hop_word: int | None = None
+    epoch_word: int | None = None
+    match_word: int | None = None
+    match_val: int = 0
+
+
+class ProvenanceState(NamedTuple):
+    """Spanning forest + redundancy rings (forest shard-local on axis
+    0; rings/marks replicated).  ``B`` = Config.max_broadcasts, ``R`` =
+    Config.provenance_ring, ``C`` = Config.n_channels."""
+
+    parent: Array     # int32[n_local, B] — first-delivery parent gid (-1)
+    hop: Array        # int32[n_local, B] — claimed depth (sender hop + 1)
+    claim_rnd: Array  # int32[n_local, B] — round of the claim (-1)
+    epoch: Array      # int32[n_local, B] — epoch the claim belongs to
+    rnd: Array        # int32[R] — ring round labels (-1 = never written)
+    dup: Array        # int32[R, C] — duplicate gossip deliveries
+    gossip: Array     # int32[R] — gossip copies delivered
+    claims: Array     # int32[R] — first-delivery claims
+    ctl: Array        # int32[R, N_CTL, 2] — control (emitted, delivered)
+    depth_hwm: Array  # int32[B] — max claimed depth per slot
+    cover_rnd: Array  # int32[B] — first full-coverage round (-1)
+    dup_cum: Array    # int32 — duplicates, whole run
+    gossip_cum: Array  # int32 — gossip deliveries, whole run
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.provenance
+
+
+def spec_of(model) -> ProvSpec | None:
+    """The model's provenance descriptor, or None (no accumulation —
+    the wire pair is still threaded, for exporters and the oracle)."""
+    if model is None or not hasattr(model, "prov_spec"):
+        return None
+    return model.prov_spec
+
+
+def src_word(cfg: Config) -> int:
+    """Wire index of ``prov_src`` (only meaningful when provenance)."""
+    return cfg.msg_words
+
+
+def hop_word(cfg: Config) -> int:
+    """Wire index of ``prov_hop``."""
+    return cfg.msg_words + 1
+
+
+def _gid_bits(n_nodes: int) -> int:
+    """Bits needed for a global id — sizes the packed (hop, src) claim
+    key: hop rides the high bits, so the minimum is lexicographic
+    (min hop, then min sender).  Hops are clamped to the remaining
+    30 - bits budget (2^14 at 100k nodes — far past any real tree)."""
+    return max(1, (n_nodes - 1).bit_length())
+
+
+def init(cfg: Config, comm) -> ProvenanceState:
+    B, R, C = cfg.max_broadcasts, cfg.provenance_ring, cfg.n_channels
+    n = comm.n_local
+
+    def z(*shape):
+        return jnp.zeros(shape, jnp.int32)
+
+    return ProvenanceState(
+        parent=jnp.full((n, B), -1, jnp.int32),
+        hop=z(n, B),
+        claim_rnd=jnp.full((n, B), -1, jnp.int32),
+        epoch=z(n, B),
+        rnd=jnp.full((R,), -1, jnp.int32),
+        dup=z(R, C), gossip=z(R), claims=z(R), ctl=z(R, N_CTL, 2),
+        depth_hwm=z(B),
+        cover_rnd=jnp.full((B,), -1, jnp.int32),
+        dup_cum=jnp.int32(0), gossip_cum=jnp.int32(0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wire-pair threading (round_body appends; queues carry verbatim)
+# ---------------------------------------------------------------------------
+
+def _match(spec: ProvSpec, msgs: Array) -> Array:
+    """bool[...]: records that are data-bearing broadcast copies."""
+    m = msgs[..., T.W_KIND] == spec.kind
+    if spec.match_word is not None:
+        m = m & (msgs[..., spec.match_word] == spec.match_val)
+    return m
+
+
+def stamp(cfg: Config, spec: ProvSpec | None, emitted: Array,
+          gids: Array) -> Array:
+    """Append the provenance pair to a freshly emitted ``[n, E, W]``
+    stack: ``prov_src`` = the emitting row's gid (every slot — empty
+    slots are never read), ``prov_hop`` = the model's hop word for
+    matching gossip records (0 otherwise).  Downstream queues copy the
+    widened record verbatim, so the pair survives defers, delays and
+    retransmissions."""
+    src = jnp.broadcast_to(gids.reshape(
+        (-1,) + (1,) * (emitted.ndim - 2)).astype(jnp.int32),
+        emitted.shape[:-1])
+    if spec is not None and spec.hop_word is not None:
+        hop = jnp.where(_match(spec, emitted),
+                        emitted[..., spec.hop_word], 0)
+    else:
+        hop = jnp.zeros(emitted.shape[:-1], jnp.int32)
+    return jnp.concatenate(
+        [emitted, src[..., None], hop[..., None]], axis=-1)
+
+
+def stamp_fresh(cfg: Config, msgs: Array) -> Array:
+    """Set the provenance pair on control messages BUILT mid-round from
+    zeroed wire-width records (acks, stream resets): the builder is the
+    sender, so ``prov_src`` copies ``W_SRC`` and ``prov_hop`` is 0.
+    Retransmit replays are NOT restamped — a replayed copy keeps its
+    original pair.  No-op when the plane is off."""
+    if not cfg.provenance:
+        return msgs
+    live = msgs[..., T.W_KIND] != 0
+    ps = src_word(cfg)
+    return msgs.at[..., ps].set(jnp.where(live, msgs[..., T.W_SRC], 0))
+
+
+# ---------------------------------------------------------------------------
+# In-scan accumulation
+# ---------------------------------------------------------------------------
+
+def _ctl_counts(msgs: Array, valid: Array) -> Array:
+    """int32[N_CTL]: control-kind counts among ``valid`` slots
+    (shard-local; callers allsum)."""
+    kind = msgs[..., T.W_KIND]
+    rows = [jnp.sum((kind == k) & valid, dtype=jnp.int32)
+            for k in CTL_KINDS]
+    return jnp.stack(rows)
+
+
+def record_round(cfg: Config, comm, spec: ProvSpec | None,
+                 ps: ProvenanceState, *, rnd: Array, emitted: Array,
+                 inbox_data: Array, dead: Array,
+                 alive_local: Array) -> ProvenanceState:
+    """Accumulate one round.  ``emitted`` is the post-outbound pre-wire
+    stack (control EMITTED counts — what the protocol built this
+    round, before shed/interposition/faults); ``inbox_data`` the routed
+    inbox BEFORE dead-receiver masking and ``dead`` its per-node mask
+    (under ``Config.width_operand`` both masks already include the
+    inactive prefix, whose inboxes are structurally empty).  Runs
+    inside the jitted scan body — zero host syncs; every ring write is
+    reduced here, the forest tables stay shard-local."""
+    from partisan_tpu import metrics as metrics_mod
+
+    R = cfg.provenance_ring
+    slot = jnp.mod(rnd, R)
+    live_in = inbox_data[..., T.W_KIND] != 0
+    delivered = live_in & ~dead[:, None]
+
+    # ---- control-plane counters (emitted, delivered) ------------------
+    ctl_e = comm.allsum(_ctl_counts(emitted, emitted[..., T.W_KIND] != 0))
+    ctl_d = comm.allsum(_ctl_counts(inbox_data, delivered))
+    ctl_row = jnp.stack([ctl_e, ctl_d], axis=-1)        # [N_CTL, 2]
+
+    parent, hop, crnd, epoch = ps.parent, ps.hop, ps.claim_rnd, ps.epoch
+    dup_ch = jnp.zeros((cfg.n_channels,), jnp.int32)
+    n_g = jnp.int32(0)
+    n_claims = jnp.int32(0)
+
+    if spec is not None:
+        B = cfg.max_broadcasts
+        n_local, cap = inbox_data.shape[:2]
+        bits = _gid_bits(cfg.n_nodes)
+        hop_max = (1 << (30 - bits)) - 1
+
+        g = delivered & _match(spec, inbox_data)                # [n, cap]
+        b = jnp.clip(inbox_data[..., spec.slot_word], 0, B - 1)
+        r2e = jnp.broadcast_to(
+            jnp.arange(n_local, dtype=jnp.int32)[:, None], b.shape)
+        b_or_pad = jnp.where(g, b, B)
+
+        # ---- slot-epoch guard: a recycled slot's higher epoch resets
+        # the entry (the new root grows its own tree — models/plumtree
+        # epoch semantics); stale-epoch copies still count as
+        # duplicates (they are redundant traffic).
+        if spec.epoch_word is not None:
+            e = inbox_data[..., spec.epoch_word]
+            ep_tab = epoch.at[r2e, b_or_pad].max(e, mode="drop")
+            bumped = ep_tab > epoch
+            parent = jnp.where(bumped, -1, parent)
+            hop = jnp.where(bumped, 0, hop)
+            crnd = jnp.where(bumped, -1, crnd)
+            epoch = ep_tab
+            cur = g & (e == jnp.take_along_axis(ep_tab, b, axis=1))
+        else:
+            cur = g
+
+        # ---- first-delivery claims: min (hop, sender) packed key -----
+        par_b = jnp.take_along_axis(parent, b, axis=1)          # [n, cap]
+        claimable = cur & (par_b < 0)
+        ph = jnp.clip(inbox_data[..., hop_word(cfg)], 0, hop_max)
+        psrc = jnp.clip(inbox_data[..., src_word(cfg)], 0,
+                        cfg.n_nodes - 1)
+        key = (ph << bits) | psrc
+        kmin = jnp.full((n_local, B), _BIG, jnp.int32).at[
+            r2e, jnp.where(claimable, b, B)].min(key, mode="drop")
+        won = kmin < _BIG
+        parent = jnp.where(won, kmin & ((1 << bits) - 1), parent)
+        hop = jnp.where(won, (kmin >> bits) + 1, hop)
+        crnd = jnp.where(won, rnd, crnd)
+
+        # the winning COPY (min inbox slot among key-minimal copies) —
+        # unique per claim, for per-channel attribution of the rest
+        winner = claimable & (key == jnp.take_along_axis(kmin, b, axis=1))
+        slot_c = jnp.broadcast_to(
+            jnp.arange(cap, dtype=jnp.int32)[None, :], b.shape)
+        smin = jnp.full((n_local, B), cap, jnp.int32).at[
+            r2e, jnp.where(winner, b, B)].min(slot_c, mode="drop")
+        claim_copy = winner & (slot_c == jnp.take_along_axis(smin, b,
+                                                             axis=1))
+        dup_ch = comm.allsum(metrics_mod.channel_counts(
+            cfg, inbox_data, mask=g & ~claim_copy))
+        n_g = comm.allsum(jnp.sum(g, dtype=jnp.int32))
+        n_claims = comm.allsum(jnp.sum(claim_copy, dtype=jnp.int32))
+
+    # ---- depth high-water mark + time-to-coverage ---------------------
+    depth_hwm = jnp.maximum(ps.depth_hwm, comm.allmax(
+        jnp.max(jnp.where(parent >= 0, hop, 0), axis=0)))
+    covered = (parent >= 0) & alive_local[:, None]
+    cnt = comm.allsum(jnp.sum(covered, axis=0, dtype=jnp.int32))  # [B]
+    n_alive = comm.allsum(jnp.sum(alive_local, dtype=jnp.int32))
+    full = (n_alive > 0) & (cnt == n_alive)
+    cover_rnd = jnp.where((ps.cover_rnd < 0) & full, rnd, ps.cover_rnd)
+
+    return ProvenanceState(
+        parent=parent, hop=hop, claim_rnd=crnd, epoch=epoch,
+        rnd=ps.rnd.at[slot].set(rnd),
+        dup=ps.dup.at[slot].set(dup_ch),
+        gossip=ps.gossip.at[slot].set(n_g),
+        claims=ps.claims.at[slot].set(n_claims),
+        ctl=ps.ctl.at[slot].set(ctl_row),
+        depth_hwm=depth_hwm, cover_rnd=cover_rnd,
+        dup_cum=ps.dup_cum + jnp.sum(dup_ch, dtype=jnp.int32),
+        gossip_cum=ps.gossip_cum + n_g,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Scenario helpers
+# ---------------------------------------------------------------------------
+
+def mark_origin(ps: ProvenanceState, node: int, slot: int, *, rnd=0,
+                epoch: int | None = None) -> ProvenanceState:
+    """Mark ``node`` as the ROOT of broadcast ``slot``: parent = self,
+    hop 0 — the injection point the device cannot see (scenario
+    ``broadcast()`` calls write the model store directly).  Coverage
+    then counts the origin as covered, so ``cover_rnd`` means "every
+    active alive node holds the broadcast".  Re-mark after a
+    ``fresh=True`` recycle, passing the slot's new ``epoch``, so the
+    origin's entry survives the epoch reset."""
+    return ps._replace(
+        parent=ps.parent.at[node, slot].set(node),
+        hop=ps.hop.at[node, slot].set(0),
+        claim_rnd=ps.claim_rnd.at[node, slot].set(rnd),
+        epoch=(ps.epoch if epoch is None
+               else ps.epoch.at[node, slot].max(epoch)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers
+# ---------------------------------------------------------------------------
+
+_RING = ("dup", "gossip", "claims", "ctl")
+
+
+def snapshot(ps: ProvenanceState) -> dict:
+    """Decode the plane (one device->host transfer, after the scan):
+    forest tables as-is, ring series ordered by round (shared
+    ``metrics.ring_order`` decoder), cumulative totals."""
+    import jax
+    import numpy as np
+
+    from partisan_tpu.metrics import ring_order
+
+    host = jax.device_get(ps)
+    rnd = np.asarray(host.rnd)
+    idx = ring_order(rnd)
+    out: dict = {
+        "parent": np.asarray(host.parent),
+        "hop": np.asarray(host.hop),
+        "claim_rnd": np.asarray(host.claim_rnd),
+        "epoch": np.asarray(host.epoch),
+        "rounds": rnd[idx],
+        "depth_hwm": np.asarray(host.depth_hwm),
+        "cover_rnd": np.asarray(host.cover_rnd),
+        "dup_total": int(host.dup_cum),
+        "gossip_total": int(host.gossip_cum),
+    }
+    for name in _RING:
+        out[name] = np.asarray(getattr(host, name))[idx]
+    return out
+
+
+def redundancy(snap_or_ps) -> dict:
+    """Whole-run redundancy headline: duplicates / gossip deliveries
+    (the traffic PRUNE exists to remove), from the cumulative counters
+    so ring wraparound cannot under-report."""
+    snap = snap_or_ps if isinstance(snap_or_ps, dict) \
+        else snapshot(snap_or_ps)
+    g, d = snap["gossip_total"], snap["dup_total"]
+    return {
+        "gossip_delivered": int(g),
+        "duplicates": int(d),
+        "redundancy_ratio": round(d / g, 4) if g else None,
+    }
+
+
+def tree(snap_or_ps, slot: int) -> dict:
+    """Reconstruct broadcast ``slot``'s dissemination tree from the
+    forest tables: parent/hop arrays plus depth & branching stats —
+    the debug_get_tree analogue (partisan_plumtree_broadcast.erl
+    :179-188), for the tree that ACTUALLY delivered, not the current
+    eager-link shape."""
+    import numpy as np
+
+    snap = snap_or_ps if isinstance(snap_or_ps, dict) \
+        else snapshot(snap_or_ps)
+    parent = np.asarray(snap["parent"])[:, slot]
+    hop = np.asarray(snap["hop"])[:, slot]
+    claimed = parent >= 0
+    n = parent.shape[0]
+    roots = np.flatnonzero(claimed & (parent == np.arange(n)))
+    kids = np.bincount(parent[claimed & (parent != np.arange(n))],
+                       minlength=n)
+    inner = kids[kids > 0]
+    depths = hop[claimed]
+    return {
+        "slot": int(slot),
+        "parent": parent, "hop": hop,
+        "claimed": int(claimed.sum()),
+        "roots": roots.astype(int).tolist(),
+        "depth_max": int(depths.max()) if depths.size else 0,
+        "depth_mean": round(float(depths.mean()), 3) if depths.size
+        else 0.0,
+        "branching_max": int(inner.max()) if inner.size else 0,
+        "branching_mean": round(float(inner.mean()), 3) if inner.size
+        else 0.0,
+        "cover_round": int(np.asarray(snap["cover_rnd"])[slot]),
+    }
+
+
+def rows(snap: dict, channels: tuple[str, ...] | None = None) -> list[dict]:
+    """JSON-lines-friendly per-round view of the redundancy/control
+    rings (the metrics.rows idiom)."""
+    C = snap["dup"].shape[1] if len(snap["dup"]) else 0
+    names = tuple(channels) if channels is not None \
+        else tuple(f"ch{i}" for i in range(C))
+    out = []
+    for i, r in enumerate(snap["rounds"]):
+        g = int(snap["gossip"][i])
+        d = int(snap["dup"][i].sum())
+        out.append({
+            "round": int(r),
+            "gossip_delivered": g,
+            "first_deliveries": int(snap["claims"][i]),
+            "duplicates": {names[c]: int(snap["dup"][i, c])
+                           for c in range(C)},
+            "redundancy_ratio": round(d / g, 4) if g else None,
+            "control": {
+                CTL_NAMES[j]: {"emitted": int(snap["ctl"][i, j, 0]),
+                               "delivered": int(snap["ctl"][i, j, 1])}
+                for j in range(N_CTL)},
+        })
+    return out

@@ -44,18 +44,36 @@ LATENCY = False
 # with a digest describing exactly its final state) emitted to stderr;
 # _converge polls the packed digest word — ONE scalar per check.
 HEALTH = False
+# Provenance-plane opt-in (--provenance): the (emitter gid, hop) wire
+# pair + dissemination-forest/redundancy accumulation in the carry;
+# redundancy ratio / tree depth / coverage round emitted to stderr.
+PROVENANCE = False
 
 
 def _metrics_cfg(cfg):
-    """Apply the module-level metrics/latency/health opt-ins to a
-    scenario config."""
+    """Apply the module-level metrics/latency/health/provenance
+    opt-ins to a scenario config."""
     if METRICS:
         cfg = cfg.replace(metrics=True, metrics_ring=512)
     if LATENCY:
         cfg = cfg.replace(latency=True)
     if HEALTH:
         cfg = cfg.replace(health=K_PROG, health_ring=512)
+    if PROVENANCE:
+        cfg = cfg.replace(provenance=True, provenance_ring=512)
     return cfg
+
+
+def _mark_bcast(st, node, slot):
+    """Mark a scenario broadcast's origin in the provenance forest
+    (provenance.mark_origin) — a no-op when the plane is off, so the
+    injection sites stay one-liners."""
+    if getattr(st, "provenance", ()) == ():
+        return st
+    from partisan_tpu import provenance as prov_mod
+
+    return st._replace(provenance=prov_mod.mark_origin(
+        st.provenance, node, slot, rnd=int(jax.device_get(st.rnd))))
 
 
 def _emit_metrics(cfg, st, label) -> None:
@@ -89,6 +107,17 @@ def _emit_metrics(cfg, st, label) -> None:
         for row in health_mod.rows(health_mod.snapshot(st.health)):
             print(json.dumps({"kind": "health", "config": label, **row}),
                   file=sys.stderr)
+    if getattr(st, "provenance", ()) != ():
+        from partisan_tpu import provenance as prov_mod
+
+        snap = prov_mod.snapshot(st.provenance)
+        t = prov_mod.tree(snap, 0)
+        print(json.dumps({"kind": "provenance", "config": label,
+                          **prov_mod.redundancy(snap),
+                          "tree_depth_mean": t["depth_mean"],
+                          "tree_depth_max": t["depth_max"],
+                          "coverage_round": t["cover_round"]}),
+              file=sys.stderr)
 
 
 def _sync(st) -> None:
@@ -485,7 +514,8 @@ def config1_anti_entropy(n=16, max_rounds=120):
     cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
     st = _boot_fullmesh(cl, n)
     start = int(st.rnd)
-    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    st = _mark_bcast(st._replace(model=model.broadcast(st.model, 0, 0)),
+                     0, 0)
     st, conv = _converge(cl, st, cov, max_rounds)
     _emit_metrics(cfg, st, 1)
     return {"config": 1, "n": n, "convergence_rounds": conv - start,
@@ -509,7 +539,8 @@ def config2_rumor(n=1000, max_rounds=200):
     cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
     st = _boot_overlay(cl, n)
     start = int(st.rnd)
-    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    st = _mark_bcast(st._replace(model=model.broadcast(st.model, 0, 0)),
+                     0, 0)
     trail = []
     for _ in range(max_rounds // K_PROG):
         st = cl.steps(st, K_PROG)
@@ -551,7 +582,8 @@ def config3_plumtree_drop(n=10_000, drop=0.05, max_rounds=400):
     st = _boot_overlay(cl, n)
     st = st._replace(faults=st.faults._replace(link_drop=jnp.float32(drop)))
     start = int(st.rnd)
-    st = st._replace(model=model.broadcast(st.model, 0, 0, start))
+    st = _mark_bcast(st._replace(
+        model=model.broadcast(st.model, 0, 0, start)), 0, 0)
     st, conv = _converge(cl, st, cov, max_rounds)
     _emit_metrics(cfg, st, 3)
     # Repair-round bound: eager flood depth is O(log n) over the
@@ -712,8 +744,9 @@ def config5_causal_crash(n=100_000, senders=64, crashes=16,
     # Plumtree broadcast from node 0 over the healing overlay.  The
     # convergence wall is MEASURED (wall clock around the stepped loop,
     # as bench.py does — r4's artifact derived it from rounds/rps).
-    st = st._replace(model=stack.replace_sub(
-        st.model, 0, plum.broadcast(stack.sub(st.model, 0), 0, 0, start)))
+    st = _mark_bcast(st._replace(model=stack.replace_sub(
+        st.model, 0,
+        plum.broadcast(stack.sub(st.model, 0), 0, 0, start))), 0, 0)
     _sync(st)
     t_conv = time.perf_counter()
     st, conv = _converge(cl, st, cov, max_rounds)
@@ -900,10 +933,17 @@ if __name__ == "__main__":
                          "convergence polls the one-scalar digest) and "
                          "emit the snapshot series to stderr as JSON "
                          "lines (stdout is unchanged)")
+    ap.add_argument("--provenance", action="store_true",
+                    help="run with the device-resident provenance plane "
+                         "on (dissemination forest + redundancy rings "
+                         "in the scan carry) and emit redundancy ratio "
+                         "/ tree depth / coverage round to stderr as "
+                         "JSON lines (stdout is unchanged)")
     args = ap.parse_args()
     METRICS = METRICS or args.metrics
     LATENCY = LATENCY or args.latency
     HEALTH = HEALTH or args.health
+    PROVENANCE = PROVENANCE or args.provenance
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -42,6 +42,12 @@ HEALTH_PARTITION = ("partisan", "health", "partition_detected")
 HEALTH_HEALED = ("partisan", "health", "overlay_healed")
 HEALTH_CHURN = ("partisan", "health", "churn")
 
+# Provenance-plane broadcast events (provenance.py rings -> discrete
+# events): redundant-duplicate spikes, graft storms and their repair.
+BROADCAST_REDUNDANCY = ("partisan", "broadcast", "redundancy_spike")
+BROADCAST_GRAFT_STORM = ("partisan", "broadcast", "graft_storm")
+BROADCAST_TREE_REPAIRED = ("partisan", "broadcast", "tree_repaired")
+
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
 
@@ -252,6 +258,64 @@ def replay_health_events(bus: Bus, snap: Mapping[str, Any], *,
     return n_events
 
 
+def replay_broadcast_events(bus: Bus, snap: Mapping[str, Any], *,
+                            redundancy_ratio: float = 0.5,
+                            redundancy_min: int = 4,
+                            graft_threshold: int = 1) -> int:
+    """Replay a provenance snapshot (``provenance.snapshot``) as
+    discrete broadcast-plane events through the bus — the host-side
+    adapter from the dissemination rings to the telemetry idiom (same
+    shape as :func:`replay_metrics_events`).
+
+    - ``redundancy_spike`` — a round whose duplicate-delivery fraction
+      (``dup / gossip_delivered``) is at or above ``redundancy_ratio``
+      with at least ``redundancy_min`` gossip deliveries (small rounds
+      are noise: one duplicate of two deliveries is not a spike).
+      Edge-triggered: a sustained flood is one event — the state
+      Plumtree's PRUNE exists to collapse.
+    - ``graft_storm`` — grafts DELIVERED in a round at or above
+      ``graft_threshold``: lazy repair is re-activating pruned links
+      (partisan_plumtree_broadcast.erl:861-905).  Edge-triggered.
+    - ``tree_repaired`` — the first graft-free round after a storm:
+      the grafted links carried the payload and the repair traffic
+      subsided, with the storm's span in the measurements.
+
+    Returns the number of events emitted."""
+    from partisan_tpu.provenance import CTL_NAMES
+
+    gi = CTL_NAMES.index("graft")
+    rounds = np.asarray(snap["rounds"])
+    dup = np.asarray(snap["dup"]).sum(axis=1)
+    gossip = np.asarray(snap["gossip"])
+    grafts = np.asarray(snap["ctl"])[:, gi, 1]
+    n_events = 0
+    red_hot = False
+    storm_start: int | None = None
+    for i, rnd in enumerate(rounds):
+        g = int(gossip[i])
+        hot = g >= redundancy_min and dup[i] / g >= redundancy_ratio
+        if hot and not red_hot:
+            bus.execute(BROADCAST_REDUNDANCY,
+                        {"duplicates": int(dup[i]), "gossip": g,
+                         "ratio": round(float(dup[i]) / g, 4)},
+                        {"round": int(rnd)})
+            n_events += 1
+        red_hot = hot
+        storming = int(grafts[i]) >= graft_threshold
+        if storming and storm_start is None:
+            bus.execute(BROADCAST_GRAFT_STORM,
+                        {"grafts": int(grafts[i])}, {"round": int(rnd)})
+            n_events += 1
+            storm_start = int(rnd)
+        elif storm_start is not None and int(grafts[i]) == 0:
+            bus.execute(BROADCAST_TREE_REPAIRED,
+                        {"storm_rounds": int(rnd) - storm_start},
+                        {"round": int(rnd)})
+            n_events += 1
+            storm_start = None
+    return n_events
+
+
 def emit_channels_configured(bus: Bus, cfg) -> None:
     """partisan_config.erl:834-843's channel-configured event."""
     for ch in cfg.channels:
@@ -282,24 +346,43 @@ def distance_metrics(dist_state) -> dict:
     }
 
 
-def plumtree_metrics(pt_state) -> dict:
+def plumtree_metrics(pt_state, mode: str = "auto") -> dict:
     """Host-side view of a :class:`partisan_tpu.models.plumtree
     .PlumtreeState` (debug_get_peers/debug_get_tree analogue,
     partisan_plumtree_broadcast.erl:179-188) plus the monotone-recycle
     guard: ``recycle_nonmonotone`` counts detections of a slot recycle
     whose payload failed to dominate the store — the constraint the
-    slot-epoch design depends on (models/plumtree.py epoch docs)."""
+    slot-epoch design depends on (models/plumtree.py epoch docs).
+
+    ``mode`` follows :func:`connection_counts`: ``"full"`` includes the
+    O(n) ``recycle_nonmonotone_nodes`` id list, ``"summary"`` replaces
+    it with the flagged-node count plus the first few ids (O(1) JSON),
+    and ``"auto"`` (the default) picks full below
+    :data:`CONNECTION_COUNTS_FULL_MAX` nodes and summary above — a
+    100k-node poll stays O(1)."""
+    if mode not in ("auto", "full", "summary"):
+        raise ValueError(
+            f"mode {mode!r} not in ('auto', 'full', 'summary')")
     live = np.asarray(pt_state.tree_nbrs) >= 0
     pruned = np.asarray(pt_state.pruned)
     eager = live[:, None, :] & ~pruned
     nonmono = np.asarray(pt_state.nonmono)
-    return {
+    flagged = np.flatnonzero(nonmono)
+    out = {
         "eager_degree_per_slot": (
             eager.sum(axis=(0, 2)) / max(pruned.shape[0], 1)).tolist(),
         "recycle_nonmonotone": int(nonmono.sum()),
-        "recycle_nonmonotone_nodes": np.flatnonzero(
-            nonmono).astype(int).tolist(),
     }
+    full = mode == "full" or (mode == "auto" and nonmono.shape[0]
+                              <= CONNECTION_COUNTS_FULL_MAX)
+    if full:
+        out["recycle_nonmonotone_nodes"] = flagged.astype(int).tolist()
+    else:
+        out["recycle_nonmonotone_summary"] = {
+            "nodes": int(flagged.size),
+            "first": flagged[:16].astype(int).tolist(),
+        }
+    return out
 
 
 # Above this node count, connection_counts defaults to the summarized

@@ -88,6 +88,177 @@ def components(active, alive, partition=None):
 
 
 # ---------------------------------------------------------------------------
+# Provenance-plane trace-replay oracle (tests/test_provenance.py): replay
+# a captured send-path trace into parent/hop/duplicate tables, host-side
+# and loop-based — the independent implementation the device accumulator
+# (partisan_tpu/provenance.py record_round) is gated against.
+# ---------------------------------------------------------------------------
+
+class ProvenanceOracle:
+    """Replays ``Cluster.record`` captures ((sent, dropped) per round)
+    through the generic wire path's delivery semantics — post-fault
+    stack, optional emission compaction, route()'s src-major stable
+    order with inbox_cap truncation, dead-receiver masking — and
+    accumulates the provenance tables with plain Python loops.
+
+    Constraints the caller's Config must satisfy for ctl EMITTED parity
+    (the captured ``sent`` must equal the accumulator's pre-wire stack):
+    no interposition chain, no channel-capacity stage, and
+    ``monotonic_shed=False`` — the wire stages between the two
+    reference points must be kind-preserving.  The forest/redundancy
+    tables have no such constraint: both sides read the delivered set.
+
+    ``alive`` is per replay() call — the fault mask is host-set between
+    recorded batches and constant within one (round_body never writes
+    ``state.faults``)."""
+
+    def __init__(self, cfg, spec):
+        import numpy as np
+
+        from partisan_tpu import provenance as prov_mod
+
+        self.cfg, self.spec = cfg, spec
+        n, B, C = cfg.n_nodes, cfg.max_broadcasts, cfg.n_channels
+        self.parent = np.full((n, B), -1, np.int64)
+        self.hop = np.zeros((n, B), np.int64)
+        self.claim_rnd = np.full((n, B), -1, np.int64)
+        self.epoch = np.zeros((n, B), np.int64)
+        self.depth_hwm = np.zeros(B, np.int64)
+        self.cover_rnd = np.full(B, -1, np.int64)
+        self.rows = {}        # rnd -> {dup[C], gossip, claims, ctl}
+        self.dup_total = 0
+        self.gossip_total = 0
+        self.n_ch = C
+        self.bits = max(1, (n - 1).bit_length())
+        self.hop_max = (1 << (30 - self.bits)) - 1
+        self.ctl_kinds = prov_mod.CTL_KINDS
+
+    def mark_origin(self, node, slot, rnd=0, epoch=None):
+        self.parent[node, slot] = node
+        self.hop[node, slot] = 0
+        self.claim_rnd[node, slot] = rnd
+        if epoch is not None:
+            self.epoch[node, slot] = max(self.epoch[node, slot], epoch)
+
+    def replay(self, sent, dropped, rounds, alive):
+        """Replay one recorded batch: sent int32[T, n, E, W], dropped
+        bool[T, n, E], rounds int[T], alive bool[n] (constant over the
+        batch)."""
+        import numpy as np
+
+        sent = np.asarray(sent)
+        dropped = np.asarray(dropped)
+        alive = np.asarray(alive)
+        for t in range(sent.shape[0]):
+            self._one_round(sent[t], dropped[t], int(rounds[t]), alive)
+
+    def _one_round(self, sent, dropped, rnd, alive):
+        import numpy as np
+
+        from partisan_tpu import types as T
+
+        cfg, spec = self.cfg, self.spec
+        n, E, _W = sent.shape
+        B = cfg.max_broadcasts
+        ps_w, ph_w = cfg.msg_words, cfg.msg_words + 1
+
+        # ctl EMITTED: every live slot of the pre-fault stack
+        kind_all = sent[..., T.W_KIND]
+        ctl_e = [int((kind_all == k).sum()) for k in self.ctl_kinds]
+
+        # post-fault stack -> optional compaction -> route (src-major
+        # stable order, first inbox_cap per destination)
+        kind = np.where(dropped, 0, kind_all)
+        live = kind != 0
+        if cfg.emit_compact and cfg.emit_compact < E:
+            rank = np.cumsum(live, axis=1) - 1
+            live = live & (rank < cfg.emit_compact)
+        inbox = [[] for _ in range(n)]
+        for s, e in zip(*np.nonzero(live)):
+            d = int(sent[s, e, T.W_DST])
+            if 0 <= d < n and len(inbox[d]) < cfg.inbox_cap:
+                inbox[d].append(sent[s, e])
+
+        # delivered set: routed AND receiver alive (the pre-dead-mask
+        # inbox with the dead rows excluded — provenance.record_round's
+        # `delivered`)
+        ctl_d = [0] * len(self.ctl_kinds)
+        copies = []      # (i, b, epoch, hop, src, pos, channel)
+        for i in range(n):
+            if not alive[i]:
+                continue
+            for pos, m in enumerate(inbox[i]):
+                k = int(m[T.W_KIND])
+                for j, ck in enumerate(self.ctl_kinds):
+                    if k == ck:
+                        ctl_d[j] += 1
+                if spec is None or k != spec.kind:
+                    continue
+                if spec.match_word is not None and \
+                        int(m[spec.match_word]) != spec.match_val:
+                    continue
+                b = min(max(int(m[spec.slot_word]), 0), B - 1)
+                ep = (int(m[spec.epoch_word])
+                      if spec.epoch_word is not None else 0)
+                hp = min(max(int(m[ph_w]), 0), self.hop_max)
+                src = min(max(int(m[ps_w]), 0), cfg.n_nodes - 1)
+                ch = min(max(int(m[T.W_CHANNEL]), 0), self.n_ch - 1)
+                copies.append((i, b, ep, hp, src, pos, ch))
+
+        # slot-epoch guard: a higher delivered epoch resets the entry;
+        # stale-epoch copies stay in the duplicate count
+        if spec is not None and spec.epoch_word is not None:
+            ep_new = self.epoch.copy()
+            for (i, b, ep, _hp, _src, _pos, _ch) in copies:
+                ep_new[i, b] = max(ep_new[i, b], ep)
+            bumped = ep_new > self.epoch
+            self.parent[bumped] = -1
+            self.hop[bumped] = 0
+            self.claim_rnd[bumped] = -1
+            self.epoch = ep_new
+            cur = [c for c in copies if c[2] == self.epoch[c[0], c[1]]]
+        else:
+            cur = copies
+
+        # first-delivery claims: min (hop, src) key, min inbox slot
+        # among key-minimal copies is THE claim copy
+        best = {}
+        for (i, b, _ep, hp, src, pos, ch) in cur:
+            if self.parent[i, b] >= 0:
+                continue
+            cand = ((hp, src), pos)
+            if (i, b) not in best or cand < best[(i, b)]:
+                best[(i, b)] = cand
+        for (i, b), ((hp, src), _pos) in best.items():
+            self.parent[i, b] = src
+            self.hop[i, b] = hp + 1
+            self.claim_rnd[i, b] = rnd
+        claim_pos = {(i, b): pos for (i, b), (_k, pos) in best.items()}
+        dup_ch = np.zeros(self.n_ch, np.int64)
+        for (i, b, _ep, _hp, _src, pos, ch) in copies:
+            if claim_pos.get((i, b)) != pos:
+                dup_ch[ch] += 1
+
+        # depth high-water mark + time-to-coverage
+        claimed = self.parent >= 0
+        self.depth_hwm = np.maximum(
+            self.depth_hwm, np.where(claimed, self.hop, 0).max(axis=0))
+        n_alive = int(alive.sum())
+        cnt = (claimed & alive[:, None]).sum(axis=0)
+        full = (n_alive > 0) & (cnt == n_alive)
+        newly = (self.cover_rnd < 0) & full
+        self.cover_rnd[newly] = rnd
+
+        self.rows[rnd] = {
+            "dup": dup_ch, "gossip": len(copies), "claims": len(best),
+            "ctl": np.stack([np.asarray(ctl_e), np.asarray(ctl_d)],
+                            axis=-1),
+        }
+        self.dup_total += int(dup_ch.sum())
+        self.gossip_total += len(copies)
+
+
+# ---------------------------------------------------------------------------
 # Bridge-transport VM base (shared by the OTP-conformance suites): one
 # emulated BEAM node holding a TCP connection to the shared simulator
 # (bridge/socket_server.py).  See tests/test_bridge_gen_server.py for the

@@ -1,0 +1,649 @@
+"""Provenance-plane suite (provenance.py + the word-pair threading
+through cluster/delivery/channels/interpose):
+
+- the disabled default keeps the ClusterState leaf an empty () and the
+  wire at its pre-provenance width — and enabling the plane must not
+  perturb the simulation (read-only plane, bit-for-bit),
+- the ACCEPTANCE gate: the device-accumulated dissemination forest and
+  redundancy/control rings match the host trace-replay oracle
+  (tests/support.py ProvenanceOracle) EXACTLY on >= 50 randomized,
+  faulted and churned overlays, for both the plumtree spec (hop +
+  epoch words) and the hop-less rumor-mongering spec,
+- slot recycles (epoch bumps) reset the forest entry on both sides,
+- sharded runs record identical tables (skips without shard_map), and
+  width-operand prefix runs match native-width runs,
+- host-side readers (tree/redundancy/rows), the partisan.broadcast.*
+  bus events, the Perfetto flow-event export, and the bridge's widened
+  injection path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import provenance as prov_mod
+from partisan_tpu import telemetry
+from partisan_tpu import types as T
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, PlumtreeConfig
+from partisan_tpu.models.plumtree import Plumtree
+from partisan_tpu.models.rumor_mongering import RumorMongering
+
+from tests import support
+
+K = 6           # record-batch grain: ONE compiled capture program
+
+
+def _pt_cfg(n=14, **kw):
+    kw.setdefault("seed", 13)
+    kw.setdefault("provenance_ring", 128)
+    kw.setdefault("plumtree", PlumtreeConfig(push_slots=2, lazy_cap=4))
+    # monotonic_shed=False: the oracle's ctl EMITTED parity needs the
+    # captured pre-fault stack to equal the accumulator's pre-wire
+    # reference point (support.ProvenanceOracle docstring)
+    return Config(n_nodes=n, peer_service_manager="hyparview",
+                  msg_words=16, partition_mode="groups",
+                  max_broadcasts=4, inbox_cap=16, provenance=True,
+                  monotonic_shed=False, **kw)
+
+
+_CACHE: dict = {}
+
+
+def _cluster(key, make):
+    if key not in _CACHE:
+        _CACHE[key] = make()
+    return _CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# Randomized trial driver (shared by the plumtree / rumor parity gates)
+# ---------------------------------------------------------------------------
+
+def _record(cl, st, oracle, batches=1):
+    """Record `batches` K-round batches, replaying each into the
+    oracle with the batch's (host-set, hence constant) alive mask."""
+    for _ in range(batches):
+        alive = np.asarray(jax.device_get(st.faults.alive)).copy()
+        st, tr = cl.record(st, K)
+        oracle.replay(np.asarray(tr.sent), np.asarray(tr.dropped),
+                      np.asarray(tr.rnd), alive)
+    return st
+
+
+def _random_overlay_trial(cl, cfg, rng, *, inject):
+    """One randomized/faulted/churned overlay: random join topology,
+    random broadcast origins/slots, random crashes (and a recovery),
+    random iid link drop — everything the wire can throw at the
+    accumulator.  Returns (final state, replayed oracle)."""
+    n = cfg.n_nodes
+    st = cl.init()
+    oracle = support.ProvenanceOracle(cfg, cl.model.prov_spec)
+
+    # random join DAG: every node joins via a random already-joined node
+    m = st.manager
+    joined = [0]
+    for i in rng.permutation(np.arange(1, n)):
+        m = cl.manager.join(cfg, m, int(i), int(rng.choice(joined)))
+        joined.append(int(i))
+    st = _record(cl, st._replace(manager=m), oracle, 3)
+
+    # 1-2 broadcasts from random origins into random distinct slots
+    slots = rng.choice(cfg.max_broadcasts, size=int(rng.integers(1, 3)),
+                       replace=False)
+    for b in slots:
+        node = int(rng.integers(0, n))
+        start = int(jax.device_get(st.rnd))
+        st = st._replace(
+            model=inject(cl, st.model, node, int(b), start),
+            provenance=prov_mod.mark_origin(st.provenance, node, int(b),
+                                            rnd=start))
+        oracle.mark_origin(node, int(b), rnd=start)
+        st = _record(cl, st, oracle, 1)
+
+    # faults: iid link drop, then up to 2 crashes, then one recovery
+    if rng.random() < 0.5:
+        st = st._replace(faults=st.faults._replace(
+            link_drop=jnp.float32(float(rng.uniform(0.05, 0.2)))))
+    victims = rng.choice(n, size=int(rng.integers(0, 3)), replace=False)
+    if victims.size:
+        alive = st.faults.alive
+        for v in victims:
+            alive = alive.at[int(v)].set(False)
+        st = st._replace(faults=st.faults._replace(alive=alive))
+    st = _record(cl, st, oracle, 2)
+    if victims.size and rng.random() < 0.5:
+        alive = st.faults.alive.at[int(victims[0])].set(True)
+        st = st._replace(faults=st.faults._replace(alive=alive))
+        st = _record(cl, st, oracle, 1)
+    return st, oracle
+
+
+def _assert_matches_oracle(cfg, st, oracle, trial):
+    snap = prov_mod.snapshot(st.provenance)
+    for name in ("parent", "hop", "claim_rnd", "epoch"):
+        assert np.array_equal(snap[name], getattr(oracle, name)), \
+            (trial, name, snap[name], getattr(oracle, name))
+    assert np.array_equal(snap["depth_hwm"], oracle.depth_hwm), trial
+    assert np.array_equal(snap["cover_rnd"], oracle.cover_rnd), trial
+    assert snap["dup_total"] == oracle.dup_total, trial
+    assert snap["gossip_total"] == oracle.gossip_total, trial
+    # per-round rings (ring > total rounds here: zero wraparound loss)
+    for i, rnd in enumerate(snap["rounds"]):
+        want = oracle.rows[int(rnd)]
+        assert np.array_equal(snap["dup"][i], want["dup"]), (trial, rnd)
+        assert snap["gossip"][i] == want["gossip"], (trial, rnd)
+        assert snap["claims"][i] == want["claims"], (trial, rnd)
+        assert np.array_equal(snap["ctl"][i], want["ctl"]), (trial, rnd)
+
+
+def test_plumtree_parity_with_oracle_on_randomized_overlays():
+    """The acceptance gate: >= 40 plumtree overlays (randomized join
+    topology, random origins, crashes, recovery, iid link drop) — the
+    device plane must equal the host trace-replay oracle EXACTLY:
+    forest tables, per-round redundancy/control rings, depth high-water
+    marks, time-to-coverage, cumulative totals."""
+    cfg = _pt_cfg()
+    cl = _cluster("pt", lambda: Cluster(cfg, model=Plumtree()))
+    rng = np.random.default_rng(42)
+    gossip_seen = dup_seen = 0
+    for trial in range(40):
+        st, oracle = _random_overlay_trial(
+            cl, cfg, rng,
+            inject=lambda cl, m, node, b, start:
+                cl.model.broadcast(m, node, b, start))
+        _assert_matches_oracle(cfg, st, oracle, trial)
+        gossip_seen += oracle.gossip_total
+        dup_seen += oracle.dup_total
+    # the trials exercised real dissemination AND real redundancy
+    assert gossip_seen > 0 and dup_seen > 0
+
+
+def test_rumor_parity_with_oracle_on_randomized_overlays():
+    """The hop-less spec (no hop word, no epoch word, APP-kind payload
+    filter): >= 10 randomized rumor-mongering overlays against the same
+    oracle — every claim lands at hop 1, the forest stays exact."""
+    cfg = Config(n_nodes=12, seed=7, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 max_broadcasts=4, inbox_cap=16, provenance=True,
+                 provenance_ring=128, monotonic_shed=False)
+    cl = _cluster("rumor", lambda: Cluster(cfg, model=RumorMongering()))
+    rng = np.random.default_rng(11)
+    gossip_seen = 0
+    for trial in range(10):
+        st, oracle = _random_overlay_trial(
+            cl, cfg, rng,
+            inject=lambda cl, m, node, b, start:
+                cl.model.broadcast(m, node, b))
+        _assert_matches_oracle(cfg, st, oracle, trial)
+        gossip_seen += oracle.gossip_total
+        snap = prov_mod.snapshot(st.provenance)
+        claimed = snap["parent"] >= 0
+        own = snap["parent"] == np.arange(cfg.n_nodes)[:, None]
+        assert (snap["hop"][claimed & ~own] == 1).all()
+    assert gossip_seen > 0
+
+
+def test_slot_recycle_epoch_resets_forest_entry():
+    """A fresh=True recycle bumps the slot epoch: receivers adopting
+    the higher epoch RESET their forest entry and re-grow the tree for
+    the new root — stale-epoch copies stay in the duplicate count
+    (both sides, oracle-gated)."""
+    cfg = _pt_cfg()
+    cl = _cluster("pt", lambda: Cluster(cfg, model=Plumtree()))
+    rng = np.random.default_rng(3)
+    st = cl.init()
+    oracle = support.ProvenanceOracle(cfg, cl.model.prov_spec)
+    m = st.manager
+    for i in range(1, cfg.n_nodes):
+        m = cl.manager.join(cfg, m, i, 0)
+    st = _record(cl, st._replace(manager=m), oracle, 3)
+
+    start = int(jax.device_get(st.rnd))
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, start),
+                     provenance=prov_mod.mark_origin(st.provenance, 0, 0,
+                                                     rnd=start))
+    oracle.mark_origin(0, 0, rnd=start)
+    st = _record(cl, st, oracle, 3)
+    first_parent = prov_mod.snapshot(st.provenance)["parent"][:, 0].copy()
+    assert (first_parent >= 0).sum() > 1
+
+    # recycle slot 0 from a DIFFERENT root with a dominating version
+    start = int(jax.device_get(st.rnd))
+    st = st._replace(model=cl.model.broadcast(st.model, 5, 0, start + 1,
+                                              fresh=True))
+    ep = int(jax.device_get(st.model.epoch)[5, 0])
+    st = st._replace(provenance=prov_mod.mark_origin(
+        st.provenance, 5, 0, rnd=start, epoch=ep))
+    oracle.mark_origin(5, 0, rnd=start, epoch=ep)
+    assert ep > 0
+    st = _record(cl, st, oracle, 3)
+    _assert_matches_oracle(cfg, st, oracle, "recycle")
+    snap = prov_mod.snapshot(st.provenance)
+    recycled = snap["epoch"][:, 0] == ep
+    assert recycled.sum() > 1
+    # re-grown entries claim within the new epoch; node 5 is the root
+    assert snap["parent"][5, 0] == 5 and snap["hop"][5, 0] == 0
+    _ = rng  # (kept for symmetry with the other drivers)
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost default + read-only plane
+# ---------------------------------------------------------------------------
+
+def test_disabled_default_zero_overhead():
+    """provenance=False (the default) keeps the state leaf an empty ()
+    and the wire at its previous width; no provenance phase is compiled
+    into the round."""
+    cfg = Config(n_nodes=16, seed=1)
+    cl = Cluster(cfg)
+    st = cl.init()
+    assert st.provenance == ()
+    assert len(jax.tree.leaves(st.provenance)) == 0
+    assert st.inbox.data.shape[-1] == cfg.msg_words
+    st2 = cl.steps(st, 5)
+    assert st2.provenance == ()
+    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 4))(st))
+    assert "round.provenance" not in jaxpr
+
+
+def test_wire_layout_with_latency_plane():
+    """Both planes on: wire = msg_words + 3, provenance pair at
+    msg_words/msg_words+1, birth round LAST (latency.py's [..., -1]
+    indexing holds — its histograms still reconcile)."""
+    from partisan_tpu import latency as latency_mod
+    from partisan_tpu import metrics as metrics_mod
+
+    cfg = _pt_cfg(latency=True, metrics=True, metrics_ring=64)
+    assert cfg.wire_words == cfg.msg_words + 3
+    assert prov_mod.src_word(cfg) == cfg.msg_words
+    assert prov_mod.hop_word(cfg) == cfg.msg_words + 1
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    m = st.manager
+    for i in range(1, cfg.n_nodes):
+        m = cl.manager.join(cfg, m, i, 0)
+    st = cl.steps(st._replace(manager=m), 12)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 12),
+                     provenance=prov_mod.mark_origin(st.provenance, 0, 0,
+                                                     rnd=12))
+    st = cl.steps(st, 12)
+    assert st.inbox.data.shape[-1] == cfg.msg_words + 3
+    lsnap = latency_mod.snapshot(st.latency)
+    msnap = metrics_mod.snapshot(st.metrics)
+    assert (lsnap["deliver"].sum(axis=1)
+            == msnap["delivered"].sum(axis=0)).all()
+    assert prov_mod.snapshot(st.provenance)["gossip_total"] > 0
+
+
+def test_provenance_plane_is_read_only():
+    """Enabling the plane must not perturb the simulation: every
+    protocol leaf of a provenance run equals the off run's bit for bit,
+    and the inbox's first msg_words words agree (the widened wire
+    carries the pair strictly OUTSIDE the protocol record)."""
+    def drive(on):
+        cfg = _pt_cfg(18).replace(provenance=on)
+        cl = Cluster(cfg, model=Plumtree())
+        st = cl.init()
+        m = st.manager
+        for i in range(1, 18):
+            m = cl.manager.join(cfg, m, i, 0)
+        st = cl.steps(st._replace(manager=m), 12)
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 12))
+        al = st.faults.alive.at[5].set(False)
+        st = st._replace(faults=st.faults._replace(
+            alive=al, link_drop=jnp.float32(0.1)))
+        return cl.steps(st, 12)
+
+    st_off = drive(False)
+    st_on = drive(True)
+    assert st_off.provenance == () and st_on.provenance != ()
+    for name in ("rnd", "manager", "model", "stats", "faults",
+                 "delivery"):
+        a = jax.tree.leaves(getattr(st_off, name))
+        b = jax.tree.leaves(getattr(st_on, name))
+        assert len(a) == len(b), name
+        for x, y in zip(a, b):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), name
+    off_w = st_off.inbox.data.shape[-1]
+    assert np.array_equal(np.asarray(st_on.inbox.data)[..., :off_w],
+                          np.asarray(st_off.inbox.data))
+    assert np.array_equal(np.asarray(st_on.inbox.count),
+                          np.asarray(st_off.inbox.count))
+
+
+def test_provenance_state_is_scan_carry_no_callbacks():
+    """No host transfer inside the scan: the forest + rings ride the
+    lax.scan carry."""
+    cfg = _pt_cfg(8, provenance_ring=8)
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 6))(st))
+    for prim in ("callback", "io_effect", "outfeed"):
+        assert prim not in jaxpr, prim
+    out = cl.steps(st, 6)
+    assert prov_mod.snapshot(out.provenance)["rounds"].tolist() \
+        == [0, 1, 2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers + ring semantics
+# ---------------------------------------------------------------------------
+
+def _tree_run():
+    """Shared aae=False plumtree run with one fully-disseminated
+    broadcast (aae off: the state-scatter walk bypasses the wire, and
+    this run exists to read a complete WIRE tree)."""
+    if "tree" in _CACHE:
+        return _CACHE["tree"]
+    cfg = _pt_cfg(16, plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4,
+                                              aae=False))
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    m = st.manager
+    for i in range(1, 16):
+        m = cl.manager.join(cfg, m, i, 0)
+    st = cl.steps(st._replace(manager=m), 20)
+    start = int(jax.device_get(st.rnd))
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, start),
+                     provenance=prov_mod.mark_origin(st.provenance, 0, 0,
+                                                     rnd=start))
+    st = cl.steps(st, 30)
+    _CACHE["tree"] = (cfg, st)
+    return _CACHE["tree"]
+
+
+def test_tree_reconstruction_and_redundancy_readers():
+    """provenance.tree(slot) reconstructs the spanning tree that
+    ACTUALLY delivered: one root (the marked origin), every claimed
+    node reachable root-ward, depth stats consistent with the hop
+    table; redundancy() reports the duplicate fraction."""
+    cfg, st = _tree_run()
+    snap = prov_mod.snapshot(st.provenance)
+    t = prov_mod.tree(snap, 0)
+    assert t["roots"] == [0]
+    assert t["claimed"] == 16                   # full wire coverage
+    assert t["cover_round"] >= 0
+    assert snap["cover_rnd"][0] == t["cover_round"]
+    parent, hop = t["parent"], t["hop"]
+    assert t["depth_max"] == hop.max() == snap["depth_hwm"][0]
+    # every non-root claim walks to the root with hops DESCENDING by 1
+    for i in range(16):
+        if i == 0:
+            continue
+        j, steps = i, 0
+        while j != 0 and steps <= 16:
+            assert hop[parent[j]] == hop[j] - 1
+            j, steps = parent[j], steps + 1
+        assert j == 0
+    red = prov_mod.redundancy(snap)
+    assert red["gossip_delivered"] == snap["gossip_total"]
+    assert red["duplicates"] == snap["dup_total"]
+    if red["gossip_delivered"]:
+        assert red["redundancy_ratio"] == pytest.approx(
+            red["duplicates"] / red["gossip_delivered"], abs=1e-4)
+    rows = prov_mod.rows(snap, channels=tuple(
+        c.name for c in cfg.channels))
+    assert sum(r["gossip_delivered"] for r in rows) \
+        == snap["gossip_total"]
+    assert sum(r["first_deliveries"] for r in rows) == 15  # non-origins
+
+
+def test_ring_wraparound_keeps_cumulative_totals():
+    """A ring smaller than the run: snapshot returns the most recent
+    window (labels ascending), while dup_cum/gossip_cum keep the
+    whole-run totals."""
+    cfg = Config(n_nodes=8, seed=5, inbox_cap=32, provenance=True,
+                 provenance_ring=8, monotonic_shed=False)
+    cl = Cluster(cfg, model=RumorMongering())
+    st = cl.init()
+    m = st.manager
+    for i in range(1, 8):
+        m = cl.manager.join(cfg, m, i, 0)
+    st = cl.steps(st._replace(manager=m), 4)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0),
+                     provenance=prov_mod.mark_origin(st.provenance, 0, 0,
+                                                     rnd=4))
+    st = cl.steps(st, 26)
+    snap = prov_mod.snapshot(st.provenance)
+    assert len(snap["rounds"]) == 8
+    assert snap["rounds"].tolist() == list(range(22, 30))
+    assert snap["gossip_total"] >= snap["gossip"].sum()
+    assert snap["dup_total"] >= snap["dup"].sum()
+    assert snap["gossip_total"] > 0
+
+
+def test_stack_exposes_first_submodel_spec():
+    """Stack resolves prov_spec to the FIRST sub-model that defines one
+    (the coverage first-wins rule)."""
+    from partisan_tpu.models.p2p_chat import P2PChat
+    from partisan_tpu.models.stack import Stack
+
+    st = Stack([Plumtree(), P2PChat()])
+    assert st.prov_spec == Plumtree().prov_spec
+    assert Stack([P2PChat()]).prov_spec is None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry events + plumtree_metrics summarization (satellite)
+# ---------------------------------------------------------------------------
+
+def _synthetic_snap():
+    R, C = 8, 2
+    gi = prov_mod.CTL_NAMES.index("graft")
+    snap = {
+        "rounds": np.arange(R),
+        "dup": np.zeros((R, C), np.int64),
+        "gossip": np.zeros(R, np.int64),
+        "claims": np.zeros(R, np.int64),
+        "ctl": np.zeros((R, prov_mod.N_CTL, 2), np.int64),
+    }
+    # rounds 1-2: sustained redundancy flood (one edge-triggered event)
+    snap["gossip"][1:3] = 10
+    snap["dup"][1, 0] = 6
+    snap["dup"][2, 1] = 7
+    # round 3: small round — 1 dup of 2 deliveries is NOT a spike
+    snap["gossip"][3] = 2
+    snap["dup"][3, 0] = 1
+    # rounds 4-5: graft storm; round 6: first graft-free round
+    snap["ctl"][4, gi, 1] = 3
+    snap["ctl"][5, gi, 1] = 1
+    return snap
+
+
+def test_replay_broadcast_events_on_bus():
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "broadcast"), rec)
+    n = telemetry.replay_broadcast_events(bus, _synthetic_snap())
+    assert n == 3
+    events = [e for (e, _m, _meta) in rec.events]
+    assert events == [telemetry.BROADCAST_REDUNDANCY,
+                      telemetry.BROADCAST_GRAFT_STORM,
+                      telemetry.BROADCAST_TREE_REPAIRED]
+    spike = rec.of(telemetry.BROADCAST_REDUNDANCY)[0]
+    assert spike[1]["ratio"] == pytest.approx(0.6)
+    assert spike[2]["round"] == 1
+    storm = rec.of(telemetry.BROADCAST_GRAFT_STORM)[0]
+    assert storm[1]["grafts"] == 3 and storm[2]["round"] == 4
+    healed = rec.of(telemetry.BROADCAST_TREE_REPAIRED)[0]
+    assert healed[1]["storm_rounds"] == 2 and healed[2]["round"] == 6
+
+
+def test_plumtree_metrics_summarized_above_threshold(monkeypatch):
+    """The satellite: recycle_nonmonotone_nodes must not ship an O(n)
+    id list for a 100k-node poll — above CONNECTION_COUNTS_FULL_MAX the
+    auto mode summarizes (count + first ids), below it stays full."""
+    import types as pytypes
+
+    n, B, KK = 12, 2, 3
+    nonmono = np.zeros(n, bool)
+    nonmono[[3, 7]] = True
+    pt = pytypes.SimpleNamespace(
+        tree_nbrs=np.full((n, KK), -1, np.int64),
+        pruned=np.zeros((n, B, KK), bool),
+        nonmono=nonmono)
+    full = telemetry.plumtree_metrics(pt)          # auto, small n
+    assert full["recycle_nonmonotone"] == 2
+    assert full["recycle_nonmonotone_nodes"] == [3, 7]
+    assert "recycle_nonmonotone_summary" not in full
+    monkeypatch.setattr(telemetry, "CONNECTION_COUNTS_FULL_MAX", 8)
+    summ = telemetry.plumtree_metrics(pt)          # auto, "large" n
+    assert "recycle_nonmonotone_nodes" not in summ
+    assert summ["recycle_nonmonotone_summary"]["nodes"] == 2
+    assert summ["recycle_nonmonotone_summary"]["first"] == [3, 7]
+    # explicit modes override auto
+    assert "recycle_nonmonotone_nodes" in telemetry.plumtree_metrics(
+        pt, mode="full")
+    monkeypatch.setattr(telemetry, "CONNECTION_COUNTS_FULL_MAX", 4096)
+    assert "recycle_nonmonotone_summary" in telemetry.plumtree_metrics(
+        pt, mode="summary")
+    with pytest.raises(ValueError):
+        telemetry.plumtree_metrics(pt, mode="bogus")
+
+
+def test_perfetto_export_grows_dissemination_flow_events(tmp_path):
+    """trace_export grows parent-linked flow events: every non-root
+    claim becomes an s->f flow arrow from the parent's track at the
+    parent's claim round to the child's track at its claim round — the
+    dissemination tree as Perfetto renders it."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import trace_export
+
+    _cfg, st = _tree_run()
+    snap = prov_mod.snapshot(st.provenance)
+    flows = trace_export.to_flow_events(snap, slots=(0,))
+    starts = [e for e in flows if e["ph"] == "s"]
+    ends = [e for e in flows if e["ph"] == "f"]
+    assert len(starts) == len(ends) == 15      # one arrow per non-root
+    by_id = {e["id"]: e for e in starts}
+    parent = snap["parent"][:, 0]
+    claim = snap["claim_rnd"][:, 0]
+    for e in ends:
+        s = by_id[e["id"]]
+        child = e["tid"]
+        assert s["tid"] == parent[child]
+        assert s["ts"] <= e["ts"]
+        assert e["ts"] == claim[child] * 1000 * 1000
+    # export() merges the flows into the trace file
+    out = tmp_path / "prov.json"
+    from partisan_tpu.trace import Trace
+
+    tr = Trace(np.zeros((1, 16, 1, 16), np.int32),
+               np.zeros((1, 16, 1), bool))
+    n = trace_export.export(tr, str(out), provenance=snap)
+    data = json.loads(out.read_text())
+    kinds = {e["ph"] for e in data["traceEvents"]}
+    assert {"s", "f"} <= kinds
+    assert n == 30      # 15 flow arrows x (s + f), nothing else live
+
+
+# ---------------------------------------------------------------------------
+# Sharded + width-operand parity
+# ---------------------------------------------------------------------------
+
+def test_sharded_forest_and_rings_match_single_device():
+    """Placement invariance: the same run on 1 device and on the 8-way
+    mesh records identical forest tables (node-sharded on axis 0) and
+    redundancy/control rings (reduced before every write)."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map unavailable on this jax "
+                    "(parallel/sharded.py requires it)")
+    from partisan_tpu.parallel.sharded import ShardedCluster, make_mesh
+
+    cfg = Config(n_nodes=16, seed=3, inbox_cap=24, provenance=True,
+                 provenance_ring=64, monotonic_shed=False)
+
+    def drive(cl):
+        st = cl.init()
+        m = st.manager
+        for i in range(1, 16):
+            m = cl.manager.join(cfg, m, i, 0)
+        st = cl.steps(st._replace(manager=m), 4)
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0),
+                         provenance=prov_mod.mark_origin(
+                             st.provenance, 0, 0, rnd=4))
+        alive = st.faults.alive.at[7].set(False)
+        st = st._replace(faults=st.faults._replace(alive=alive))
+        return cl.steps(st, 20)
+
+    st_l = drive(Cluster(cfg, model=RumorMongering()))
+    st_s = drive(ShardedCluster(cfg, make_mesh(), model=RumorMongering()))
+    snap_l = prov_mod.snapshot(st_l.provenance)
+    snap_s = prov_mod.snapshot(st_s.provenance)
+    for name, series in snap_l.items():
+        assert np.array_equal(series, snap_s[name]), name
+    assert snap_l["gossip_total"] > 0
+    assert (snap_l["parent"][:, 0] >= 0).sum() > 1
+
+
+def test_width_operand_masks_inactive_prefix_rows():
+    """Under Config.width_operand, inactive rows are invisible: a
+    prefix-activated run accumulates the same forest prefix and the
+    same redundancy rings as a native-width run, and the inactive rows
+    keep their init values."""
+    from partisan_tpu import cluster as cluster_mod
+
+    def boot(cl, n):
+        st = cl.init()
+        if cl.cfg.width_operand:
+            st = cluster_mod.activate(st, n)
+        m = st.manager
+        for i in range(1, n):
+            m = cl.manager.join(cl.cfg, m, i, 0)
+        st = cl.steps(st._replace(manager=m), 12)
+        start = int(jax.device_get(st.rnd))
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0, start),
+                         provenance=prov_mod.mark_origin(
+                             st.provenance, 0, 0, rnd=start))
+        return cl.steps(st, 16)
+
+    n = 12
+    st_n = boot(Cluster(_pt_cfg(n, seed=6), model=Plumtree()), n)
+    st_w = boot(Cluster(_pt_cfg(2 * n, seed=6, width_operand=True),
+                        model=Plumtree()), n)
+    snap_n = prov_mod.snapshot(st_n.provenance)
+    snap_w = prov_mod.snapshot(st_w.provenance)
+    for name in ("parent", "hop", "claim_rnd", "epoch"):
+        assert np.array_equal(snap_w[name][:n], snap_n[name]), name
+        init = -1 if name in ("parent", "claim_rnd") else 0
+        assert (snap_w[name][n:] == init).all(), name
+    for name in ("rounds", "dup", "gossip", "claims", "ctl",
+                 "depth_hwm", "cover_rnd"):
+        assert np.array_equal(snap_w[name], snap_n[name]), name
+    assert snap_w["gossip_total"] == snap_n["gossip_total"]
+    assert snap_w["dup_total"] == snap_n["dup_total"]
+    assert snap_n["gossip_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Bridge injection path
+# ---------------------------------------------------------------------------
+
+def test_bridge_forward_drain_under_provenance():
+    """The bridge widens injected records with the (emitter gid, hop 0)
+    pair — and drains payloads WITHOUT leaking the pair (or the birth
+    word when both planes are on) to the Erlang side."""
+    from partisan_tpu.bridge import etf
+    from partisan_tpu.bridge.etf import Atom
+    from partisan_tpu.bridge.server import Bridge
+
+    br = Bridge()
+    assert br.handle((Atom("init"), {Atom("n_nodes"): 4,
+                                     Atom("provenance"): True,
+                                     Atom("latency"): True})) == etf.OK
+    assert br.handle((Atom("forward_message"), 1, 0, [42, 7])) == etf.OK
+    ok, _rnd = br.handle((Atom("step"), 1))
+    assert ok == etf.OK
+    ok, msgs = br.handle((Atom("drain"), 0))
+    assert ok == etf.OK
+    assert len(msgs) == 1
+    src, payload = msgs[0]
+    assert src == 1 and payload[:2] == [42, 7]
+    assert len(payload) == 12 - T.HDR_WORDS

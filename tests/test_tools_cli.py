@@ -76,3 +76,103 @@ def test_health_report_cli_smoke():
         and summary["digest"]["no_isolates"]
         and summary["digest"]["min_degree_ok"]
         and summary["digest"]["coverage_complete"])
+
+
+def test_trace_export_cli_smoke(tmp_path):
+    """Argv-level smoke for the Perfetto exporter (test_latency only
+    calls ``export()`` directly): record a short run, save the npz, run
+    the real CLI — with a ``--provenance`` snapshot so the
+    dissemination-tree flow arrows go through the argv path too."""
+    import numpy as np
+
+    from partisan_tpu import trace as trace_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.models.direct_mail import DirectMail
+    from tests.support import boot_fullmesh, fm_config
+
+    n = 8
+    cl = Cluster(fm_config(n, seed=5), model=DirectMail())
+    st = boot_fullmesh(cl)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0))
+    st, cap = cl.record(st, 6)
+    tr = trace_mod.from_capture(cap)
+    trace_path = tmp_path / "trace.npz"
+    tr.save(trace_path)
+    n_trace = sum(1 for _ in tr.events())
+    assert n_trace > 0
+
+    # a synthetic 3-claim forest: root 0, children 1 and 2, grandchild 3
+    parent = np.full((n, 1), -1, np.int32)
+    claim = np.full((n, 1), -1, np.int32)
+    parent[0, 0], claim[0, 0] = 0, 0          # root (no inbound arrow)
+    parent[1, 0], claim[1, 0] = 0, 1
+    parent[2, 0], claim[2, 0] = 0, 1
+    parent[3, 0], claim[3, 0] = 1, 2
+    prov_path = tmp_path / "prov.npz"
+    np.savez(prov_path, parent=parent, claim_rnd=claim)
+
+    out_path = tmp_path / "out.json"
+    out = _run("trace_export.py", str(trace_path), str(out_path),
+               "--round-ms", "500", "--provenance", str(prov_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    with open(out_path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    real = [e for e in events if e["ph"] != "M"]
+    flows = [e for e in events if e.get("cat") == "round.provenance"]
+    # event-count contract: everything recorded + one s/f pair per
+    # non-root claim, nothing lost in export
+    assert len(flows) == 2 * 3
+    assert len(real) == n_trace + len(flows)
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert str(len(real)) in out.stderr, out.stderr
+    # honest exit code: missing operands must FAIL, not print-and-exit-0
+    bad = _run("trace_export.py", str(trace_path))
+    assert bad.returncode != 0
+
+
+def test_broadcast_report_cli_smoke():
+    """Provenance-plane exporter end-to-end: JSON lines with redundancy
+    rounds, a reconstructed dissemination tree, and a trailing summary
+    whose redundancy ratio reconciles with its own counters."""
+    out = _run("broadcast_report.py", "64", "48")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    kinds = [r["kind"] for r in rows]
+    assert kinds[-1] == "summary"
+    assert "round" in kinds and "tree" in kinds
+    tree = next(r for r in rows if r["kind"] == "tree")
+    assert tree["roots"] == [0]               # the marked origin
+    assert tree["claimed"] > 1                # the broadcast spread
+    assert tree["depth_max"] >= 1
+    summary = rows[-1]
+    assert summary["gossip_delivered"] > 0
+    assert summary["duplicates"] >= 0
+    if summary["redundancy_ratio"] is not None:
+        assert summary["redundancy_ratio"] == round(
+            summary["duplicates"] / summary["gossip_delivered"], 4)
+
+
+def test_tools_cli_completeness():
+    """Completeness guard: EVERY tools/*.py exposes a ``main()`` and
+    survives a ``--help`` smoke with an honest zero exit — so a future
+    exporter can't ship without at least this much CLI coverage.  The
+    smokes run concurrently: interpreter startup dominates each one."""
+    tools_dir = os.path.join(_REPO, "tools")
+    tools = sorted(f for f in os.listdir(tools_dir)
+                   if f.endswith(".py"))
+    assert len(tools) >= 8, tools
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = {}
+    for tool in tools:
+        with open(os.path.join(tools_dir, tool)) as f:
+            src = f.read()
+        assert "def main(" in src, f"{tool} does not expose a main()"
+        procs[tool] = subprocess.Popen(
+            [sys.executable, os.path.join(tools_dir, tool), "--help"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=_REPO)
+    for tool, p in procs.items():
+        stdout, stderr = p.communicate(timeout=120)
+        assert p.returncode == 0, (tool, stderr[-2000:])
+        assert stdout.strip(), f"{tool} --help printed nothing"

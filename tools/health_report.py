@@ -52,7 +52,14 @@ def report(state, out=sys.stdout) -> dict:
     return summary["digest"]
 
 
+USAGE = "usage: health_report.py [n] [rounds] [--partition]"
+
+
 def main() -> None:
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
     import numpy as np
 
     from partisan_tpu import faults as faults_mod

@@ -54,7 +54,14 @@ def report(cfg, state, out=sys.stdout) -> dict:
     return tot
 
 
+USAGE = "usage: metrics_report.py [n] [rounds] [--fault]"
+
+
 def main() -> None:
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
     import jax.numpy as jnp
     import numpy as np
 

@@ -113,7 +113,7 @@ def run_traffic(n: int, k: int) -> None:
           flush=True)
 
 
-if __name__ == "__main__":
+def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("mode", choices=["pure", "traffic"])
     ap.add_argument("--seconds", type=float, default=90.0)
@@ -125,3 +125,7 @@ if __name__ == "__main__":
     else:
         run_traffic(args.n, args.k)
     print("done", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

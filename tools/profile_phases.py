@@ -232,5 +232,12 @@ def main(n: int) -> None:
     timed("FULL round (active)", full, st_full)
 
 
+USAGE = "usage: profile_phases.py [n] [only]"
+
+
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 32_768)
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+    else:
+        main(int(sys.argv[1]) if len(sys.argv) > 1 else 32_768)

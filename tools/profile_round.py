@@ -81,9 +81,16 @@ def measure(n: int, label: str, *, model: bool = True, active: bool = False,
           f"(boot+compile {boot:.0f}s)", flush=True)
 
 
-if __name__ == "__main__":
+USAGE = "usage: profile_round.py [n] [smoke|r5|ablations]"
+
+
+def main() -> None:
     from partisan_tpu.config import HyParViewConfig, PlumtreeConfig
 
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 32_768
     which = sys.argv[2] if len(sys.argv) > 2 else "r5"
     if which == "smoke":
@@ -111,3 +118,7 @@ if __name__ == "__main__":
         measure(n, "emit_compact off", emit_compact=0)
         measure(n, "emit_compact 24", emit_compact=24)
         measure(n, "inbox_cap 12", inbox_cap=12)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,17 +16,28 @@ Converts a :class:`partisan_tpu.trace.Trace` — whether captured by
   ``jax.named_scope`` label of the round phase that produced it —
   ``round.route`` for deliveries, ``round.fault`` for fault drops —
   so Perfetto's category filter matches the profiler traces
-  (``tools/profile_round.py``) phase for phase.
+  (``tools/profile_round.py``) phase for phase,
+- **dissemination trees as flow events**: given a provenance snapshot
+  (``provenance.snapshot``, the forest the provenance plane
+  accumulated on-device), every non-root first-delivery claim becomes
+  a parent-linked flow arrow (``ph: "s"`` on the parent's track at the
+  parent's claim round -> ``ph: "f"`` on the child's track at its
+  claim round, category ``round.provenance``) — Perfetto renders the
+  tree that ACTUALLY delivered each broadcast, Dapper-style.
 
 Usage::
 
     python tools/trace_export.py trace.npz out.json [--round-ms 1000]
+        [--provenance prov.npz]
 
-Importable: ``to_trace_events(trace)`` returns the event list;
-``export(trace, path)`` writes the JSON file.  Event-count contract
+``--provenance`` takes a snapshot saved with ``np.savez(path,
+**provenance.snapshot(state.provenance))``.  Importable:
+``to_trace_events(trace)`` returns the event list;
+``to_flow_events(snap)`` the dissemination arrows; ``export(trace,
+path)`` writes the JSON file.  Event-count contract
 (tests/test_latency.py roundtrip): the number of non-metadata events
-equals ``sum(1 for _ in trace.events())`` — nothing recorded is lost
-in export.
+equals ``sum(1 for _ in trace.events())`` plus two per flow arrow —
+nothing recorded is lost in export.
 """
 
 from __future__ import annotations
@@ -43,6 +54,7 @@ PID = 1
 # event class maps to.
 PHASE_ROUTE = "round.route"
 PHASE_FAULT = "round.fault"
+PHASE_PROVENANCE = "round.provenance"
 
 
 def to_trace_events(tr, *, round_ms: int = 1000,
@@ -83,22 +95,70 @@ def to_trace_events(tr, *, round_ms: int = 1000,
     return events
 
 
+def to_flow_events(snap, *, slots=None, round_ms: int = 1000) -> list[dict]:
+    """Parent-linked dissemination-tree arrows from a provenance
+    snapshot (``provenance.snapshot``): one ``s``/``f`` flow pair per
+    non-root first-delivery claim, from the parent's track at the
+    parent's claim round to the child's track at the child's claim
+    round.  ``slots=None`` renders every slot with at least one claim;
+    flow ids are unique per (slot, child) so concurrent broadcasts
+    stay separate trees in the UI."""
+    import numpy as np
+
+    us = round_ms * 1000
+    parent = np.asarray(snap["parent"])
+    claim = np.asarray(snap["claim_rnd"])
+    n, B = parent.shape
+    if slots is None:
+        slots = [b for b in range(B) if (parent[:, b] >= 0).any()]
+    events: list[dict] = []
+    for b in slots:
+        for child in np.flatnonzero(parent[:, b] >= 0):
+            p = int(parent[child, b])
+            if p == int(child):
+                continue             # the root has no inbound arrow
+            fid = int(b) * n + int(child)
+            name = f"broadcast {int(b)}"
+            common = {"name": name, "cat": PHASE_PROVENANCE, "pid": PID,
+                      "id": fid}
+            events.append({**common, "ph": "s", "tid": p,
+                           "ts": max(int(claim[p, b]), 0) * us})
+            events.append({**common, "ph": "f", "bp": "e",
+                           "tid": int(child),
+                           "ts": max(int(claim[child, b]), 0) * us})
+    return events
+
+
 def export(tr, path: str, *, round_ms: int = 1000,
-           channels: tuple[str, ...] | None = None) -> int:
+           channels: tuple[str, ...] | None = None,
+           provenance=None, slots=None) -> int:
     """Write ``{"traceEvents": [...]}`` to ``path``; returns the number
-    of non-metadata events written."""
+    of non-metadata events written.  ``provenance`` optionally merges a
+    provenance snapshot's dissemination-tree flow arrows
+    (:func:`to_flow_events`) into the same file."""
     events = to_trace_events(tr, round_ms=round_ms, channels=channels)
+    if provenance is not None:
+        events += to_flow_events(provenance, slots=slots,
+                                 round_ms=round_ms)
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)
     return sum(1 for e in events if e["ph"] != "M")
 
 
+USAGE = ("usage: trace_export.py <trace.npz> <out.json> [--round-ms N] "
+         "[--provenance prov.npz]")
+
+
 def main() -> None:
     from partisan_tpu.trace import Trace
 
     argv = sys.argv[1:]
-    round_ms, args, i = 1000, [], 0
+    if "--help" in argv or "-h" in argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
+    round_ms, prov_path, args, i = 1000, None, [], 0
     while i < len(argv):
         a = argv[i]
         if a.startswith("--round-ms"):
@@ -107,15 +167,26 @@ def main() -> None:
             else:
                 i += 1
                 round_ms = int(argv[i])
+        elif a.startswith("--provenance"):
+            if "=" in a:
+                prov_path = a.split("=", 1)[1]
+            else:
+                i += 1
+                prov_path = argv[i]
         else:
             args.append(a)
         i += 1
     if len(args) != 2:
-        print("usage: trace_export.py <trace.npz> <out.json> "
-              "[--round-ms N]", file=sys.stderr)
+        print(USAGE, file=sys.stderr)
         raise SystemExit(2)
+    snap = None
+    if prov_path is not None:
+        import numpy as np
+
+        with np.load(prov_path) as z:
+            snap = {k: z[k] for k in z.files}
     tr = Trace.load(args[0])
-    n = export(tr, args[1], round_ms=round_ms)
+    n = export(tr, args[1], round_ms=round_ms, provenance=snap)
     print(f"{n} events ({tr.n_rounds} rounds, {tr.n_nodes} nodes) "
           f"-> {args[1]}", file=sys.stderr)
 
