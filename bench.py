@@ -38,6 +38,7 @@ stderr as one JSON object per run.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -447,6 +448,34 @@ def _cold_section(cold: dict | None, warm: dict | None,
     return out
 
 
+def _pallas_verdict(budget_s: float) -> dict:
+    """Fold the standing tools/pallas_probe.py PASS/BLOCKED verdict
+    into the artifact, so "is Pallas-level fusion still blocked" lives
+    next to the round numbers it would unblock (BENCH_NOTES r6).  Runs
+    the probe as a subprocess on whatever wall budget is left; the
+    probe's own 8k shape is the cheap one, and a BLOCKED outcome
+    returns quickly (the scoped-VMEM failure is at compile time)."""
+    import subprocess
+
+    if budget_s < 45:
+        return {"verdict": "SKIP", "reason": "bench budget exhausted"}
+    try:
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "pallas_probe.py"),
+             "--shapes", "8192"],
+            capture_output=True, text=True,
+            timeout=max(45.0, min(180.0, budget_s)))
+        last = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        out = json.loads(last)
+        return {k: out[k] for k in ("verdict", "backend", "note")
+                if k in out}
+    except Exception as exc:  # probe failure must never sink the bench
+        return {"verdict": "SKIP", "reason": repr(exc)[:200]}
+
+
 def main() -> None:
     # Ladder: the HEADLINE size runs FIRST with the full per-size cap —
     # its warm median-of-N is the artifact's core; its cold run comes
@@ -530,6 +559,7 @@ def main() -> None:
     top = results[max(results)]
     warm = top["warm"]
     print(json.dumps({
+        "pallas_probe": _pallas_verdict(remaining()),
         "metric": (f"simulated gossip rounds/sec "
                    f"({top['n']}-node hyparview+plumtree)"),
         "value": warm["rounds_per_sec"]["median"],

@@ -34,13 +34,20 @@ from jax import Array
 
 from partisan_tpu import types as T
 from partisan_tpu.config import Config
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 
 class OutboxState(NamedTuple):
-    data: Array  # int32[n_local, OB, W] — deferred sends (kind==0 free;
-    #              W = wire_words: deferred copies carry the provenance
-    #              pair and birth word verbatim, so a release names its
-    #              true origin/hop and keeps its emission round)
+    data: Array  # [n_local, OB, W] records — deferred sends (kind==0
+    #              free; W = wire_words: deferred copies carry the
+    #              provenance pair and birth word verbatim, so a release
+    #              names its true origin/hop and keeps its emission
+    #              round).  Queued-copy invariant ("planes in queues,
+    #              wire at the boundary"): under Config.plane_major the
+    #              outbox holds the emission's Planes struct at storage
+    #              dtypes — deferred records are never interleaved or
+    #              re-widened while queued.
     shed: Array  # int32 — deferred sends dropped (outbox overflow)
 
 
@@ -50,13 +57,12 @@ def enabled(cfg: Config) -> bool:
 
 def init(cfg: Config, comm) -> OutboxState:
     return OutboxState(
-        data=jnp.zeros((comm.n_local, cfg.outbox_cap, cfg.wire_words),
-                       jnp.int32),
+        data=msg_ops.zero_wire(cfg, (comm.n_local, cfg.outbox_cap)),
         shed=jnp.int32(0),
     )
 
 
-def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array,
+def throttle(cfg: Config, comm, ob: OutboxState, emitted,
              *, birth_rnd: Array | None = None):
     """Apply per-(edge, channel, lane) capacity to this round's sends.
 
@@ -74,10 +80,11 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array,
     OB = cfg.outbox_cap
     n = emitted.shape[0]
 
-    both = jnp.concatenate([ob.data, emitted], axis=1)     # [n, M, W]
+    both = plane_ops.concat([ob.data, emitted], axis=1)    # [n, M, W]
     M = both.shape[1]
     valid = both[..., T.W_KIND] != 0
-    ch = jnp.clip(both[..., T.W_CHANNEL], 0, cfg.n_channels - 1)
+    ch = jnp.clip(both[..., T.W_CHANNEL].astype(jnp.int32), 0,
+                  cfg.n_channels - 1)
     lane = (both[..., T.W_LANE] & 0x7FFFFFFF) % par[ch]
     dst = jnp.maximum(both[..., T.W_DST], 0)
     key = (dst * cfg.n_channels + ch) * maxpar + lane
@@ -110,7 +117,7 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted: Array,
     keep = defer & (drank < OB)
     slot = jnp.where(keep, drank, OB)
     rows = jnp.broadcast_to(jnp.arange(n)[:, None], slot.shape)
-    new_data = jnp.zeros((n, OB, both.shape[-1]), jnp.int32)
+    new_data = plane_ops.zeros_like(ob.data)
     new_data = new_data.at[rows, slot].set(both, mode="drop")
     shed = comm.allsum(jnp.sum(defer & ~keep, dtype=jnp.int32))
     ob_out = OutboxState(data=new_data, shed=ob.shed + shed)

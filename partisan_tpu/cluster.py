@@ -38,6 +38,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import exchange, rng
+from partisan_tpu.ops import plane as plane_ops
 
 _MSG_FILTER_TAG = 11
 
@@ -145,7 +146,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             nbrs = manager.neighbors(cfg, mstate, comm)
             dstate_model, a_emit = model.step(cfg, comm, state.model,
                                               ctx, nbrs)
-            emitted = jnp.concatenate([m_emit, a_emit], axis=1)
+            emitted = plane_ops.concat([m_emit, a_emit], axis=1)
     else:
         dstate_model, emitted = (), m_emit
     if px:
@@ -289,7 +290,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
 
         def wire_skip(_):
             out = (exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
-                                        cfg.wire_words), jnp.int32(0))
+                                        cfg.wire_layout), jnp.int32(0))
             if mx:
                 out += (jnp.int32(0),
                         jnp.zeros((cfg.n_channels,), jnp.int32))
@@ -391,12 +392,19 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 faults_wire, emitted, cfg.seed, state.rnd,
                 _MSG_FILTER_TAG)
             fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
+        # THE plane->wire interleave: capture/flight need the trace's
+        # interleaved int32 [n, E, W] tensor (TraceRound.sent is the
+        # layout-stable contract), and it is the ONLY interleave the
+        # round program may contain (tests/test_program_budget.py counts
+        # them at the jaxpr level; the plain round traces zero — the
+        # exchange ships packed planes).
+        sent_wire = plane_ops.interleave(sent) if (capture or fx) else None
         if fx:
             # Flight recorder: the same (sent, dropped) pair capture
             # mode returns, written into the carry's K-round ring.
             with jax.named_scope("round.flight"):
                 fstate = latency_mod.record_flight(
-                    cfg, state.flight, rnd=state.rnd, sent=sent,
+                    cfg, state.flight, rnd=state.rnd, sent=sent_wire,
                     dropped=fault_dropped)
         if lx:
             lat_fault = latency_mod.age_hist(sent, fault_dropped,
@@ -440,7 +448,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
 
         def route_skip(_):
             return exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
-                                        cfg.wire_words)
+                                        cfg.wire_layout)
 
         inbox = jax.lax.cond(any_emit, route_body, route_skip, 0)
     # Crash-stopped receivers drop everything addressed to them.
@@ -475,7 +483,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 inbox_data=inbox.data, dead=dead,
                 alive_local=alive_local)
     inbox = exchange.Inbox(
-        data=jnp.where(dead[:, None, None], 0, inbox.data),
+        data=plane_ops.where(dead[:, None], 0, inbox.data),
         count=jnp.where(dead, 0, inbox.count),
         drops=inbox.drops + jnp.where(dead, inbox.count, 0),
     )
@@ -569,7 +577,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                        flight=fstate, n_active=state.n_active,
                        health=hstate, provenance=pv)
     if capture:
-        return out, TraceRound(rnd=state.rnd, sent=sent,
+        return out, TraceRound(rnd=state.rnd, sent=sent_wire,
                                dropped=fault_dropped)
     return out
 
@@ -676,7 +684,7 @@ class Cluster:
             faults=faults_mod.none(cfg.n_nodes,
                                    cfg.resolved_partition_mode),
             inbox=exchange.empty_inbox(comm.n_local, cfg.inbox_cap,
-                                       cfg.wire_words),
+                                       cfg.wire_layout),
             manager=self.manager.init(cfg, comm),
             model=self.model.init(cfg, comm) if self.model is not None else (),
             delivery=(delivery_mod.init(cfg, comm)

@@ -384,6 +384,33 @@ class Config:
     #                              bit-identical to pre-health rounds
     health_ring: int = 64        # snapshots of history kept (ring)
 
+    # --- plane-major round pipeline (ops/plane.py) ---------------------
+    plane_major: bool = True     # carry message records as a STRUCT OF
+    #                              WORD PLANES (W separate [n, slots]
+    #                              tensors, ops/plane.Planes) from
+    #                              emission through the outbound stack,
+    #                              compaction, the shed/fault filter and
+    #                              the route sort, interleaving to the
+    #                              [n, slots, W] wire layout at most once
+    #                              per round (capture/flight/a2a
+    #                              boundaries) — and pack narrow-range
+    #                              planes below int32 (types.py
+    #                              NARROW_WIRE_DTYPES: kind/channel/flags
+    #                              int8, ttl + provenance hop int16),
+    #                              widening only at that boundary.
+    #                              BENCH_NOTES' corrected cost model:
+    #                              msg build's plane-interleave alone was
+    #                              ~25% of the 32k round, and the wire
+    #                              stage's strided minor-axis gathers the
+    #                              largest block — layout, not op flavor,
+    #                              is the lever (ROADMAP open item 1).
+    #                              False = the legacy interleaved int32
+    #                              path (the A/B baseline for
+    #                              tools/profile_phases.py --layout and
+    #                              the bit-parity tests).  Both paths
+    #                              are bit-identical in state, trace,
+    #                              coverage and convergence round.
+
     # --- test plane ----------------------------------------------------
     replaying: bool = False
     shrinking: bool = False
@@ -465,6 +492,27 @@ class Config:
         if self.latency:
             w += 1
         return w
+
+    @property
+    def wire_dtypes(self) -> tuple:
+        """Storage dtype per wire word under ``plane_major`` (the
+        bytes-first packing map — types.NARROW_WIRE_DTYPES resolved
+        against this config's trailing-word layout).  Values widen to
+        int32 exactly at the plane->wire interleave boundary, so a
+        widened record is bit-identical to the legacy path."""
+        from partisan_tpu import types as _T
+
+        return tuple(
+            _T.wire_dtype(i, msg_words=self.msg_words,
+                          provenance=self.provenance)
+            for i in range(self.wire_words))
+
+    @property
+    def wire_layout(self):
+        """What ``exchange.empty_inbox`` (and every wire-width buffer
+        constructor) needs: the per-word dtype tuple under
+        ``plane_major``, else the legacy interleaved word count."""
+        return self.wire_dtypes if self.plane_major else self.wire_words
 
     def channel_id(self, name: str) -> int:
         for i, c in enumerate(self.channels):

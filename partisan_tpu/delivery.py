@@ -61,6 +61,8 @@ from partisan_tpu import types as T
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import exchange, vclock, views
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.ops import rng as rng_ops
 
 CAUSAL_SWEEPS = 3     # in-round delivery passes (chain depth per round)
@@ -75,15 +77,23 @@ _EPOCH_MASK = (1 << 22) - 1  # 22-bit stream epochs (W_LANE bits 8..29:
 
 
 class AckState(NamedTuple):
-    outstanding: Array  # int32[n_local, ack_cap, W] — kind==NONE = free slot
+    # Queued-copy invariant ("planes in queues, wire at the boundary"):
+    # under Config.plane_major every record buffer below — the ack
+    # store, the causal history/arrival rings, the p2p unacked store and
+    # future buffer — holds the Planes struct at storage dtypes; queued
+    # records are never interleaved or re-widened between emission and
+    # the exchange boundary.
+    outstanding: Array  # [n_local, ack_cap, W] records — kind==NONE = free slot
     next_clock: Array   # int32[n_local] — next per-sender message clock
     overflow: Array     # int32 — acked sends dropped: store was full
 
 
 class CausalLane(NamedTuple):
     clock: Array      # uint32[n_local, A] — delivered-state vclock
-    buf: Array        # int32[n_local, B, W+A] — out-of-order arrivals
-    hist: Array       # int32[n_local, H, W+A] — sent-record replay ring
+    buf: Array        # [n_local, B, W+A] records — out-of-order arrivals
+    #                   (wide records: W wire words + A clock words; the
+    #                   clock words ride as extra int32 planes)
+    hist: Array       # [n_local, H, W+A] records — sent-record replay ring
     hist_ptr: Array   # int32[n_local] — ring write position
     overflow: Array   # int32 — records dropped: emit/buffer slots full
 
@@ -165,22 +175,30 @@ def needs_inbound(cfg: Config) -> bool:
     return bool(cfg.causal_labels) or bool(cfg.causal_p2p_labels)
 
 
+def _zero_wide(cfg: Config, shape: tuple):
+    """All-empty wide causal records (wire words + A clock words): the
+    clock block rides as A extra int32 planes under plane_major."""
+    if cfg.plane_major:
+        return plane_ops.zero_planes(
+            tuple(shape), cfg.wire_dtypes + (jnp.int32,) * cfg.n_actors)
+    return jnp.zeros(tuple(shape) + (cfg.wire_words + cfg.n_actors,),
+                     jnp.int32)
+
+
 def init(cfg: Config, comm) -> DeliveryState:
     n = comm.n_local
-    W = cfg.wire_words   # queued copies carry the trailing provenance
-    #                      pair (provenance.py) and birth word
-    #                      (latency.py) verbatim
-    WA = W + cfg.n_actors
+    # wire-width queued copies carry the trailing provenance pair
+    # (provenance.py) and birth word (latency.py) verbatim
     ack = AckState(
-        outstanding=jnp.zeros((n, cfg.ack_cap, W), jnp.int32),
+        outstanding=msg_ops.zero_wire(cfg, (n, cfg.ack_cap)),
         next_clock=jnp.ones((n,), jnp.int32),
         overflow=jnp.int32(0),
     ) if cfg.ack_cap > 0 else ()
     lanes = tuple(
         CausalLane(
             clock=vclock.fresh_matrix(n, cfg.n_actors),
-            buf=jnp.zeros((n, cfg.causal_buf_cap, WA), jnp.int32),
-            hist=jnp.zeros((n, cfg.causal_hist_cap, WA), jnp.int32),
+            buf=_zero_wide(cfg, (n, cfg.causal_buf_cap)),
+            hist=_zero_wide(cfg, (n, cfg.causal_hist_cap)),
             hist_ptr=jnp.zeros((n,), jnp.int32),
             overflow=jnp.int32(0),
         )
@@ -198,8 +216,8 @@ def init(cfg: Config, comm) -> DeliveryState:
             reack=jnp.zeros((n, cfg.p2p_src_cap), jnp.bool_),
             reset_req=jnp.full((n, _P2P_RESET_SLOTS), -1, jnp.int32),
             reset_seq=jnp.zeros((n, _P2P_RESET_SLOTS), jnp.int32),
-            buf=jnp.zeros((n, cfg.p2p_buf_cap, W), jnp.int32),
-            hist=jnp.zeros((n, cfg.p2p_hist_cap, W), jnp.int32),
+            buf=msg_ops.zero_wire(cfg, (n, cfg.p2p_buf_cap)),
+            hist=msg_ops.zero_wire(cfg, (n, cfg.p2p_hist_cap)),
             overflow=jnp.int32(0),
             resets=jnp.int32(0),
             aborted=jnp.int32(0),
@@ -224,13 +242,19 @@ def _free_slot_of_rank(free: Array) -> Array:
                            free.shape), mode="drop")
 
 
-def _compact(rows: Array, mask: Array, cap: int) -> tuple[Array, Array]:
+def _compact(rows, mask: Array, cap: int) -> tuple[Array, Array]:
     """Per-node: gather ``rows[i, e]`` where ``mask`` into ``cap`` slots,
-    preserving slot order.  Returns (packed [n, cap, w], n_dropped)."""
-    n, e, w = rows.shape
+    preserving slot order.  Returns (packed [n, cap, w], n_dropped).
+    Layout-agnostic: Planes records compact per-plane off the same slot
+    map (no interleave)."""
+    n, e = mask.shape
     rank = jnp.cumsum(mask, axis=1) - 1
     slot = jnp.where(mask, rank, e + cap)
-    out = jnp.zeros((n, cap, w), rows.dtype)
+    if plane_ops.is_planes(rows):
+        out = plane_ops.zero_planes((n, cap),
+                                    tuple(w.dtype for w in rows.ws))
+    else:
+        out = jnp.zeros((n, cap, rows.shape[-1]), rows.dtype)
     rows_idx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
     out = out.at[rows_idx, slot].set(rows, mode="drop")
     dropped = jnp.sum(jnp.maximum(
@@ -261,7 +285,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
         #    acks retransmissions too.
         need_ack = (kind_in != 0) & (flags_in & T.F_ACK_REQUIRED != 0) \
             & ctx.alive[:, None]
-        ack_msgs = jnp.zeros_like(inb)
+        ack_msgs = plane_ops.zeros_like(inb)
         ack_msgs = ack_msgs.at[..., T.W_KIND].set(
             jnp.where(need_ack, T.MsgKind.ACK, 0))
         ack_msgs = ack_msgs.at[..., T.W_SRC].set(
@@ -326,7 +350,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
         extra.append(re)
 
         # Crashed senders freeze their store (their gen_server is dead).
-        out = jnp.where(ctx.alive[:, None, None], out, ack.outstanding)
+        out = plane_ops.where(ctx.alive[:, None], out, ack.outstanding)
         next_clock = jnp.where(ctx.alive, next_clock, ack.next_clock)
         ack = AckState(outstanding=out, next_clock=next_clock,
                        overflow=ack.overflow + overflow)
@@ -361,8 +385,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             onehot[:, None, :] * rank1[:, :, None].astype(vclock.DTYPE)
         new_clock = lane.clock + onehot * n_kept[:, None]
 
-        wide = jnp.concatenate(
-            [emitted, msg_clocks.astype(jnp.int32)], axis=-1)
+        wide = plane_ops.append_tail(emitted, msg_clocks)
         packed, _ = _compact(wide, is_c, cfg.causal_emit_cap)
         dropped = jnp.sum(n_sent - n_kept, dtype=jnp.int32)
 
@@ -382,12 +405,12 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             hist[..., T.W_FLAGS] | T.F_RETRANSMISSION)
         # Whole-record zeroing keeps off-actor/idle rows all-zero — the
         # invariant ShardComm.actor_gather's psum reconstruction needs.
-        replay = jnp.where(live_slot[..., None], replay, 0)
+        replay = plane_ops.where(live_slot, replay, 0)
 
-        wide_out.append(jnp.concatenate([packed, replay], axis=1))
+        wide_out.append(plane_ops.concat([packed, replay], axis=1))
         lanes_out.append(lane._replace(
             clock=jnp.where(ctx.alive[:, None], new_clock, lane.clock),
-            hist=jnp.where(ctx.alive[:, None, None], hist, lane.hist),
+            hist=plane_ops.where(ctx.alive[:, None], hist, lane.hist),
             hist_ptr=jnp.where(ctx.alive, hist_ptr, lane.hist_ptr),
             overflow=lane.overflow + comm.allsum(dropped)))
         # Remove from the event lane (overflow tail included: it was a
@@ -544,7 +567,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
 
             # Emit our own pending stream-reset requests (as a receiver).
             rr_ids = lane.reset_req
-            rst_msgs = jnp.zeros((n, rr_ids.shape[1], W), jnp.int32)
+            rst_msgs = msg_ops.zero_wire(cfg, (n, rr_ids.shape[1]))
             rst_on = rr_ids >= 0
             rst_msgs = rst_msgs.at[..., T.W_KIND].set(
                 jnp.where(rst_on, T.MsgKind.P2P_ACK, 0))
@@ -645,7 +668,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                 & ~just_written
             replay = hist.at[..., T.W_FLAGS].set(
                 hist[..., T.W_FLAGS] | T.F_RETRANSMISSION)
-            replay = jnp.where(live_slot[..., None], replay, 0)
+            replay = plane_ops.where(live_slot, replay, 0)
 
             # 6e. Receiver-side cumulative acks: on the retransmit cadence
             # (or sooner when a duplicate signalled a lost ack), ack every
@@ -653,7 +676,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             ack_due = (lane.src_seq > lane.src_acked) & (lane.src_ids >= 0)
             ack_now = (ack_due & refire[:, None]) | \
                 (lane.reack & (lane.src_ids >= 0))
-            ack_msgs = jnp.zeros((n, lane.src_ids.shape[1], W), jnp.int32)
+            ack_msgs = msg_ops.zero_wire(cfg, (n, lane.src_ids.shape[1]))
             ack_msgs = ack_msgs.at[..., T.W_KIND].set(
                 jnp.where(ack_now, T.MsgKind.P2P_ACK, 0))
             ack_msgs = ack_msgs.at[..., T.W_SRC].set(
@@ -679,7 +702,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                 reset_req=jnp.where(alive1,
                                     jnp.full_like(lane.reset_req, -1),
                                     lane.reset_req),
-                hist=jnp.where(alive1[..., None], hist, lane.hist),
+                hist=plane_ops.where(alive1, hist, lane.hist),
                 overflow=lane.overflow + comm.allsum(cap_dropped)
                 + n_backpressured,
                 resets=lane.resets + resets,
@@ -688,10 +711,10 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
 
         def p2p_send_skip(_, lane=lane):
             return (lane,
-                    jnp.zeros((n, EC, W), jnp.int32),
-                    jnp.zeros((n, H, W), jnp.int32),
-                    jnp.zeros((n, lane.src_ids.shape[1], W), jnp.int32),
-                    jnp.zeros((n, lane.reset_req.shape[1], W), jnp.int32),
+                    msg_ops.zero_wire(cfg, (n, EC)),
+                    msg_ops.zero_wire(cfg, (n, H)),
+                    msg_ops.zero_wire(cfg, (n, lane.src_ids.shape[1])),
+                    msg_ops.zero_wire(cfg, (n, lane.reset_req.shape[1])),
                     emitted)
 
         lane_f, packed, replay, ack_msgs, rst_msgs, emitted = \
@@ -715,7 +738,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             jnp.where(leak, 0, emitted[..., T.W_KIND]))
 
     if extra:
-        emitted = jnp.concatenate([emitted] + extra, axis=1)
+        emitted = plane_ops.concat([emitted] + extra, axis=1)
     return (DeliveryState(ack=ack, lanes=tuple(lanes_out),
                           p2p=tuple(p2p_out),
                           invalid_causal=st.invalid_causal + invalid),
@@ -726,15 +749,24 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
 # Inbound: dense vectorized causal delivery
 # ---------------------------------------------------------------------------
 
-def _fetch(buf: Array, shared: Array, idx: Array) -> Array:
+def _fetch(buf, shared, idx: Array):
     """Per-node record fetch over the combined candidate index space:
     ``idx < B`` reads the node's buffer row, else the shared table.
-    buf [n, B, w], shared [G, w], idx [n, D] -> [n, D, w]."""
-    n, B, w = buf.shape
+    buf [n, B, w], shared [G, w], idx [n, D] -> [n, D, w].
+    Layout-agnostic: Planes fetch per plane off the same index map."""
+    B = buf.shape[1]
     G = shared.shape[0]
-    from_buf = jnp.take_along_axis(
-        buf, jnp.clip(idx, 0, B - 1)[..., None], axis=1)
-    from_shared = shared[jnp.clip(idx - B, 0, G - 1)]
+    ib = jnp.clip(idx, 0, B - 1)
+    is_ = jnp.clip(idx - B, 0, G - 1)
+    if plane_ops.is_planes(buf):
+        return plane_ops.Planes(tuple(
+            jnp.where((idx < B + G),
+                      jnp.where(idx < B,
+                                jnp.take_along_axis(wb, ib, axis=1),
+                                ws[is_]), 0)
+            for wb, ws in zip(buf.ws, shared.ws)))
+    from_buf = jnp.take_along_axis(buf, ib[..., None], axis=1)
+    from_shared = shared[is_]
     out = jnp.where((idx < B)[..., None], from_buf, from_shared)
     return jnp.where((idx < B + G)[..., None], out, 0)
 
@@ -761,7 +793,8 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
         shared = comm.actor_gather(payload, A)      # [A, Ec+H, W+A]
         shared = shared.reshape(-1, W + A)
         G = shared.shape[0]
-        s_msg, s_clk = shared[:, :W], shared[:, W:].astype(vclock.DTYPE)
+        s_msg = shared[:, :W]
+        s_clk = plane_ops.stack_words(shared, W).astype(vclock.DTYPE)
         s_src = jnp.minimum(jnp.maximum(s_msg[:, T.W_SRC], 0), A - 1)
         s_cnt = s_clk[jnp.arange(G), s_src]
         s_dep = s_clk.at[jnp.arange(G), s_src].set(0)   # deps w/o sender
@@ -778,8 +811,8 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
         arr_ok = s_valid[None, :] & ~cut & ctx.alive[:, None]
 
         # Buffered candidates (already arrived in earlier rounds).
-        b_msg, b_clk = lane.buf[..., :W], \
-            lane.buf[..., W:].astype(vclock.DTYPE)
+        b_msg = lane.buf[..., :W]
+        b_clk = plane_ops.stack_words(lane.buf, W).astype(vclock.DTYPE)
         b_src = jnp.minimum(jnp.maximum(b_msg[..., T.W_SRC], 0), A - 1)
         b_cnt = jnp.take_along_axis(b_clk, b_src[..., None], axis=2)[..., 0]
         b_dep = jnp.where(
@@ -909,7 +942,7 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
         buf_overflow = comm.allsum(jnp.sum(
             jnp.maximum(n_fut - B, 0), dtype=jnp.int32))
 
-        new_buf = jnp.where(ctx.alive[:, None, None], new_buf, lane.buf)
+        new_buf = plane_ops.where(ctx.alive[:, None], new_buf, lane.buf)
         lanes_out.append(lane._replace(
             clock=clock_f,
             buf=new_buf,
@@ -943,8 +976,8 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
 
         def p2p_recv_body(_, lane=lane, lid=lid, pi=pi, is_p=is_p,
                           msgs=msgs, inbox=inbox, n_causal=n_causal):
-            cmsg = jnp.concatenate(
-                [jnp.where(is_p[..., None], msgs, 0), lane.buf], axis=1)
+            cmsg = plane_ops.concat(
+                [plane_ops.where(is_p, msgs, 0), lane.buf], axis=1)
             C = cmsg.shape[1]
             cvalid = cmsg[..., T.W_KIND] != 0
             csrc = cmsg[..., T.W_SRC]
@@ -972,7 +1005,7 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
             # winners beyond the quota stay buffered with their stream
             # position intact (the broadcast lane's quota contract).
             base = exchange.Inbox(
-                data=jnp.where(is_p[..., None], 0, msgs),
+                data=plane_ops.where(is_p, 0, msgs),
                 count=jnp.sum((msgs[..., T.W_KIND] != 0) & ~is_p, axis=1,
                               dtype=jnp.int32),
                 drops=inbox.drops)
@@ -1046,8 +1079,9 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
                                     INF2))
             topv, topi = jax.lax.top_k(-okey, D2)
             rows2 = jnp.arange(n)[:, None]
-            drecs = jnp.where((-topv < INF2)[..., None],
-                              cmsg[rows2, topi], 0)
+            drecs = plane_ops.where(
+                -topv < INF2, plane_ops.take_records(cmsg, (rows2, topi)),
+                0)
             drecs = drecs.at[..., T.W_LANE].set(
                 jnp.where(drecs[..., T.W_KIND] != 0, lid,
                           drecs[..., T.W_LANE]))
@@ -1069,8 +1103,9 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
             # unacked store recovers them on the next replay tick).
             fkey = jnp.where(avail_f & cvalid, ckey, INF2)
             ftop, fidx = jax.lax.top_k(-fkey, B2)
-            new_buf = jnp.where((-ftop < INF2)[..., None],
-                                cmsg[rows2, fidx], 0)
+            new_buf = plane_ops.where(
+                -ftop < INF2, plane_ops.take_records(cmsg, (rows2, fidx)),
+                0)
             n_fut = jnp.sum(fkey < INF2, axis=1, dtype=jnp.int32)
             shed = comm.allsum(jnp.sum(jnp.maximum(n_fut - B2, 0),
                                        dtype=jnp.int32))
@@ -1105,7 +1140,7 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
                 reack=jnp.where(alive1, reack_f, lane.reack),
                 reset_req=jnp.where(alive1, rst_ids, lane.reset_req),
                 reset_seq=jnp.where(alive1, rst_seqs, lane.reset_seq),
-                buf=jnp.where(alive1[..., None], new_buf, lane.buf),
+                buf=plane_ops.where(alive1, new_buf, lane.buf),
                 overflow=lane.overflow + shed,
                 resets=lane.resets + resets)
             return new_lane, inbox, n_causal

@@ -43,6 +43,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 _TAG_LAT = 351          # hash-model latency salt
 _TAG_PROBE = 352        # DistanceService neighbor sampling
@@ -126,7 +127,7 @@ def step(cfg: Config, comm: LocalComm, st: DistanceState, ctx: RoundCtx,
     ripe = (st.pong_tgt >= 0) & (st.pong_due <= ctx.rnd) \
         & ctx.alive[:, None]
     pongs = msg_ops.build(
-        cfg.msg_words, T.MsgKind.PONG, gids[:, None],
+        cfg, T.MsgKind.PONG, gids[:, None],
         jnp.where(ripe, st.pong_tgt, -1), payload=(st.pong_echo,))
     pong_tgt = jnp.where(ripe, -1, st.pong_tgt)
 
@@ -173,10 +174,10 @@ def step(cfg: Config, comm: LocalComm, st: DistanceState, ctx: RoundCtx,
     ping_dst = jnp.where(fire[:, None] & (targets >= 0)
                          & (targets != gids[:, None]), targets, -1)
     pings = msg_ops.build(
-        cfg.msg_words, T.MsgKind.PING, gids[:, None], ping_dst,
+        cfg, T.MsgKind.PING, gids[:, None], ping_dst,
         payload=(jnp.broadcast_to(ctx.rnd, ping_dst.shape),))
 
-    emitted = jnp.concatenate([pongs, pings], axis=1)
+    emitted = plane_ops.concat([pongs, pings], axis=1)
     return DistanceState(pong_tgt=pong_tgt, pong_due=pong_due,
                          pong_echo=pong_echo, rtt_node=rtt_node,
                          rtt_val=rtt_val), emitted

@@ -32,6 +32,8 @@ from jax import Array
 from partisan_tpu import types as T
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 
 class Interposition(Protocol):
@@ -179,8 +181,12 @@ class Delay:
             # wire_words: held copies carry the provenance plane's
             # (emitter, hop) pair and the latency plane's birth word
             # verbatim, so a delayed release keeps its true origin,
-            # tree depth and emission round
-            "buf": jnp.zeros((n, self.cap, cfg.wire_words), jnp.int32),
+            # tree depth and emission round.  Queued-copy invariant
+            # ("planes in queues, wire at the boundary"): under
+            # Config.plane_major the hold buffer stores the Planes
+            # struct at storage dtypes — held records are never
+            # interleaved or re-widened while queued.
+            "buf": msg_ops.zero_wire(cfg, (n, self.cap)),
             "due": jnp.full((n, self.cap), -1, jnp.int32),  # release round
             # overflow accounting: matching messages that passed through
             # UNDELAYED because the hold buffer was full — a nonzero
@@ -193,7 +199,7 @@ class Delay:
         return {"buf": shard, "due": shard, "missed": repl}
 
     def apply(self, cfg, comm, state, emitted, ctx):
-        n, e, w = emitted.shape
+        n, e, _w = emitted.shape
         buf, due = state["buf"], state["due"]
         missed0 = state.get("missed", jnp.int32(0))
 
@@ -230,7 +236,7 @@ class Delay:
         emitted = _drop_where(emitted, can)
 
         # 3. Append released messages to this round's emissions.
-        out = jnp.concatenate([emitted, released], axis=1)
+        out = plane_ops.concat([emitted, released], axis=1)
         missed = missed0 + comm.allsum(
             jnp.sum(hold & ~can, dtype=jnp.int32))
         return {"buf": buf, "due": due, "missed": missed}, out

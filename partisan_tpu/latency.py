@@ -128,13 +128,17 @@ def flight_init(cfg: Config, sent_shape: tuple) -> FlightState:
 # Birth-round threading (the parallel tensor, carried as a trailing word)
 # ---------------------------------------------------------------------------
 
-def stamp(emitted: Array, rnd: Array) -> Array:
+def stamp(emitted, rnd: Array):
     """Append the birth-round word to a freshly emitted ``[..., W]``
     stack: every record (live or empty — empty slots are never read)
     is stamped with the current round.  Copies of the widened record
-    then carry the birth through every queue verbatim."""
-    birth = jnp.broadcast_to(jnp.int32(rnd), emitted.shape[:-1] + (1,))
-    return jnp.concatenate([emitted, birth], axis=-1)
+    then carry the birth through every queue verbatim.  Plane-major
+    stacks grow a plane (O(0) layout work — no minor-axis
+    concatenate); the birth word itself stays int32 (a round counter
+    is unbounded — never packed narrower)."""
+    from partisan_tpu.ops import plane as plane_ops
+
+    return plane_ops.append_words(emitted, jnp.int32(rnd))
 
 
 def stamp_fresh(cfg: Config, msgs: Array, rnd: Array) -> Array:

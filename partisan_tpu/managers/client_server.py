@@ -29,6 +29,7 @@ from partisan_tpu import faults as faults_mod
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
 
 _GOSSIP_EDGE_TAG = 111
 
@@ -66,7 +67,7 @@ class ClientServer:
         known = state.known | (pushed & ctx.alive[:, None])
         known = jnp.where(ctx.alive[:, None], known, state.known)
 
-        emitted = jnp.zeros((n_local, 0, cfg.msg_words), jnp.int32)
+        emitted = msg_ops.zero_stack(cfg, (n_local, 0))
         return ClientServerState(joined=state.joined, known=known), emitted
 
     # ---- views -------------------------------------------------------

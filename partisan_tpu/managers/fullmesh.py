@@ -33,6 +33,7 @@ from partisan_tpu import faults as faults_mod
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
 from partisan_tpu.ops import orset
 
 _GOSSIP_EDGE_TAG = 101  # fault-hash call-site salt for gossip edges
@@ -81,7 +82,7 @@ class FullMesh:
         view = jnp.where(ctx.alive[:, None, None], merged, state.view)
         urgent = jnp.where(ctx.alive, False, state.urgent)
 
-        emitted = jnp.zeros((n_local, 0, cfg.msg_words), jnp.int32)
+        emitted = msg_ops.zero_stack(cfg, (n_local, 0))
         return FullMeshState(view=view, urgent=urgent), emitted
 
     # ---- views -------------------------------------------------------

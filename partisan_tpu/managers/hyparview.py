@@ -72,6 +72,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.ops import rng, views
 
 # Shuffle wire format: payload[0] = origin, payload[1:1+S] = ids, where
@@ -372,7 +373,7 @@ class HyParView:
 
         def quiet_body(_):
             return (active0, passive0,
-                    jnp.zeros((n_local, E_BUSY, W), jnp.int32))
+                    msg_ops.zero_stack(cfg, (n_local, E_BUSY)))
 
         def busy_body(_):
             in_active0 = slot_in(active0, src)                 # [n, cap]
@@ -536,11 +537,11 @@ class HyParView:
             sh_any = jnp.any(sh_int, axis=1)
             origin1 = jnp.take_along_axis(origin, sh_slot[:, None],
                                           axis=1)[:, 0]
-            ids1 = jnp.take_along_axis(
-                sh_ids, sh_slot[:, None, None], axis=1)[:, 0]  # [n, S]
+            ids1 = plane_ops.stack_words(plane_ops.take_along(
+                sh_ids, sh_slot[:, None], axis=1))[:, 0]       # [n, S]
             mine1 = row_ranked(passive0, _TAG_MINE, SAMPLE)    # [n, S]
             shreply_msgs = msg_ops.build(
-                W, T.MsgKind.HPV_SHUFFLE_REPLY, gids,
+                cfg, T.MsgKind.HPV_SHUFFLE_REPLY, gids,
                 jnp.where(sh_any & (origin1 != gids) & (origin1 >= 0),
                           origin1, -1),
                 payload=(gids, *jnp.unstack(mine1, axis=1)))
@@ -631,12 +632,12 @@ class HyParView:
                     base = jnp.where(xrep_no, 0, base)
                 payload.append(base)
             replies = msg_ops.build(
-                W, rkind, jnp.broadcast_to(me2, rdst.shape), rdst,
+                cfg, rkind, jnp.broadcast_to(me2, rdst.shape), rdst,
                 payload=tuple(payload))                        # [n, cap, W]
 
             # eviction + demotion disconnects (slot-aligned [n, A])
             ev_disc = msg_ops.build(
-                W, T.MsgKind.HPV_DISCONNECT,
+                cfg, T.MsgKind.HPV_DISCONNECT,
                 jnp.broadcast_to(me2, evicted.shape), evicted)
             if hv.xbot:
                 # tear down the demoted side of each chain step: o at
@@ -645,7 +646,7 @@ class HyParView:
                     [swap_xr, xsw_acc, xswr_ok, xrepr_ok],
                     [p0, p1, p2w, p3w], -1)
                 x_disc = msg_ops.build(
-                    W, T.MsgKind.HPV_DISCONNECT,
+                    cfg, T.MsgKind.HPV_DISCONNECT,
                     jnp.broadcast_to(me2, xdst.shape), xdst)
 
             # ---- 5. join fan-out: IN-ROUND walks (reference :1381) ---
@@ -722,25 +723,25 @@ class HyParView:
                 endpoint = jnp.where(stopped, endpoint, curf)  # TTL out
                 jb2 = jnp.broadcast_to(joiner[:, None], fj_tgt.shape)
                 return (msg_ops.build(
-                            W, T.MsgKind.HPV_FORWARD_JOIN, me2b,
+                            cfg, T.MsgKind.HPV_FORWARD_JOIN, me2b,
                             endpoint, payload=(jb2, me2b)),
                         msg_ops.build(
-                            W, T.MsgKind.HPV_FORWARD_JOIN, me2b,
+                            cfg, T.MsgKind.HPV_FORWARD_JOIN, me2b,
                             depnode,
                             payload=(jb2, me2b, jnp.ones_like(jb2))))
 
             def fj_none(_):
-                zf = jnp.zeros((n_local, A, W), jnp.int32)
-                return zf, zf
+                return (msg_ops.zero_stack(cfg, (n_local, A)),
+                        msg_ops.zero_stack(cfg, (n_local, A)))
 
             fanout_fj, fanout_dep = jax.lax.cond(fj_go, fj_walk,
                                                  fj_none, 0)
             lv_tgt = jnp.where(state.leaving[:, None], active0, -1)
             fanout_lv = msg_ops.build(
-                W, T.MsgKind.HPV_DISCONNECT,
+                cfg, T.MsgKind.HPV_DISCONNECT,
                 jnp.broadcast_to(me2, lv_tgt.shape), lv_tgt)
             ev_join_disc = msg_ops.build(
-                W, T.MsgKind.HPV_DISCONNECT, gids, evicted_j)
+                cfg, T.MsgKind.HPV_DISCONNECT, gids, evicted_j)
 
             # ---- 6. passive merge (id-keyed bucket cache) ------------
             # Candidate budget per round: PSEL slot-borne ids
@@ -764,8 +765,8 @@ class HyParView:
             p_slotborne, _ = compact(pw0, psc, PSEL)           # [n, PSEL]
             shr_slot = jnp.argmax(is_shr, axis=1)
             shr_any = jnp.any(is_shr, axis=1)
-            shr_ids1 = jnp.take_along_axis(
-                sh_ids, shr_slot[:, None, None], axis=1)[:, 0]  # [n, S]
+            shr_ids1 = plane_ops.stack_words(plane_ops.take_along(
+                sh_ids, shr_slot[:, None], axis=1))[:, 0]       # [n, S]
             pcands = jnp.concatenate([
                 p_slotborne,
                 jnp.where(sh_any[:, None], ids1, -1),
@@ -796,8 +797,8 @@ class HyParView:
                       shreply_msgs[:, None, :]]
             if hv.xbot:
                 blocks += [x_disc]
-            return new_active2, new_passive2, jnp.concatenate(blocks,
-                                                              axis=1)
+            return new_active2, new_passive2, plane_ops.concat(blocks,
+                                                               axis=1)
 
         new_active, new_passive, emitted_hv = jax.lax.cond(
             busy, busy_body, quiet_body, 0)
@@ -838,13 +839,13 @@ class HyParView:
                            hv.shuffle_k_passive),
             ], axis=1)[:, :SAMPLE]
             shuffle_msgs = msg_ops.build(
-                W, T.MsgKind.HPV_SHUFFLE, gids,
+                cfg, T.MsgKind.HPV_SHUFFLE, gids,
                 jnp.where(sh_fire & (curs >= 0), curs, -1), ttl=1,
                 payload=(gids, *jnp.unstack(smp, axis=1)))
             pr_tgt = row_ranked(passive0, _TAG_PRTGT, 1,
                                 exclude=active0)[:, 0]
             promote_msgs = msg_ops.build(
-                W, T.MsgKind.HPV_NEIGHBOR, gids,
+                cfg, T.MsgKind.HPV_NEIGHBOR, gids,
                 jnp.where(pr_fire & (pr_tgt >= 0), pr_tgt, -1),
                 payload=((asize0 == 0).astype(jnp.int32),))
             cblocks = [shuffle_msgs[:, None, :],
@@ -866,12 +867,12 @@ class HyParView:
                 x_fire = x_timer & (cand >= 0) & (z >= 0) \
                     & (cost_cand < cost_worst)
                 cblocks.append(msg_ops.build(
-                    W, T.MsgKind.HPV_XBOT_OPT, gids,
+                    cfg, T.MsgKind.HPV_XBOT_OPT, gids,
                     jnp.where(x_fire, cand, -1), payload=(z,))[:, None, :])
-            return jnp.concatenate(cblocks, axis=1)
+            return plane_ops.concat(cblocks, axis=1)
 
         def cad_quiet(_):
-            return jnp.zeros((n_local, E_CAD, W), jnp.int32)
+            return msg_ops.zero_stack(cfg, (n_local, E_CAD))
 
         emitted_cad = jax.lax.cond(cad_busy, cad_body, cad_quiet, 0)
 
@@ -968,7 +969,7 @@ class HyParView:
                                  join_dst)
         do_join = join_dst >= 0
         join_msgs = msg_ops.build(
-            W, T.MsgKind.HPV_JOIN, gids, jnp.where(do_join, join_dst, -1))
+            cfg, T.MsgKind.HPV_JOIN, gids, jnp.where(do_join, join_dst, -1))
 
         # ---- 8. distance/RTT metrics plane (config-gated) ------------
         # Probe targets: the active view (the reference pings its
@@ -985,7 +986,7 @@ class HyParView:
         blocks = [emitted_hv, emitted_cad, join_msgs[:, None, :]]
         if cfg.distance.enabled:
             blocks += [dist_emit]
-        emitted = jnp.concatenate(blocks, axis=1)
+        emitted = plane_ops.concat(blocks, axis=1)
 
         # Crash-stopped and left nodes are frozen and silent (a left node
         # is inert until a scripted rejoin — the reference's leaver shuts

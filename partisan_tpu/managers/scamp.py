@@ -63,6 +63,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.ops import rng, views
 
 # rng subkey tags: 42x — distinct from hyparview (30x) AND the model
@@ -133,9 +134,10 @@ class Scamp:
         def per_node(me, key, partial, in_view, join_tgt, do_join,
                      leaving, inbox_row):
             def mk(kind, dst, *, ttl=0, payload=()):
-                return msg_ops.build(W, kind, me, dst, ttl=ttl, payload=payload)
+                return msg_ops.build(cfg, kind, me, dst, ttl=ttl,
+                                     payload=payload)
 
-            nomsg = jnp.zeros((W,), jnp.int32)
+            nomsg = msg_ops.zero_stack(cfg, ())
 
             # ---- scripted join (scamp_v1 :69-119 step 1-2), gated on
             # the admission round (join_round stagger) ------------------
@@ -143,7 +145,7 @@ class Scamp:
                 do_join,
                 views.add(partial, join_tgt, rng.subkey(key, _TAG_JOIN))[0],
                 partial)
-            join_msg = jnp.where(
+            join_msg = plane_ops.where(
                 do_join,
                 mk(T.MsgKind.SCAMP_SUBSCRIPTION, join_tgt, ttl=_WALK_TTL,
                    payload=(me, jnp.int32(1))),     # direct: contact fans out
@@ -151,7 +153,7 @@ class Scamp:
             # v2: the joiner holds the contact as an out-edge, so the
             # contact gains an in-edge (closes the reference's open
             # "@todo Join of InView", scamp_v2 :32).
-            join_keep = jnp.where(
+            join_keep = plane_ops.where(
                 do_join & jnp.bool_(v2),
                 mk(T.MsgKind.SCAMP_KEEP, join_tgt), nomsg)
 
@@ -197,14 +199,14 @@ class Scamp:
                     fwd_ok = ~direct & ~keep & ~requeue & ~expired & (nxt >= 0)
 
                     p2, _ = views.add(p, jnp.where(keep, sub, -1), k3)
-                    keep_note = jnp.where(
+                    keep_note = plane_ops.where(
                         keep & jnp.bool_(v2),
                         mk(T.MsgKind.SCAMP_KEEP, sub), nomsg)
                     fwd = msg.at[T.W_DST].set(nxt).at[T.W_SRC].set(me) \
                              .at[T.W_TTL].set(ttl - 1)
-                    reply = jnp.where(
+                    reply = plane_ops.where(
                         requeue, self_requeue,
-                        jnp.where(fwd_ok, fwd, keep_note))
+                        plane_ops.where(fwd_ok, fwd, keep_note))
                     return (p2, iv, jnp.where(take_fan, sub, fs), gr, reply)
 
                 def b_unsubscribe(p, iv, fs, gr):
@@ -214,7 +216,7 @@ class Scamp:
                     requeue = present & (gr >= 0)
                     p2 = jnp.where(take, views.remove(p, node), p)
                     iv2 = views.remove(iv, node) if v2 else iv
-                    reply = jnp.where(requeue, self_requeue, nomsg)
+                    reply = plane_ops.where(requeue, self_requeue, nomsg)
                     return (p2, jnp.where(present, iv2, iv),
                             fs, jnp.where(take, node, gr), reply)
 
@@ -239,7 +241,7 @@ class Scamp:
                     # reference leaves in-views stale here — its own
                     # open question at scamp_v2 :281-283; we close it so
                     # the rebalance invariant holds transitively).
-                    reply = jnp.where(
+                    reply = plane_ops.where(
                         did, mk(T.MsgKind.SCAMP_KEEP, repl), nomsg)
                     return p2, iv, fs, gr, reply
 
@@ -296,7 +298,7 @@ class Scamp:
                                     T.MsgKind.SCAMP_UNSUBSCRIBE)
                 fanout_lv = jax.vmap(
                     lambda kd, d, r: msg_ops.build(
-                        W, kd, me, jnp.where(leaving, d, -1),
+                        cfg, kd, me, jnp.where(leaving, d, -1),
                         payload=(me, r)))(kind_lv, in_view2, repl)
             else:
                 # v1 leave (:122-142): gossip remove_subscription(self).
@@ -311,9 +313,10 @@ class Scamp:
             # ---- periodic timer phase (v1 :173-216); the ping/isolation
             # work is vectorized below, outside the per-node scan --------
             fires = (ctx.rnd + me) % cfg.gossip_every == 0
-            return partial2, in_view2, jnp.concatenate([
+            return partial2, in_view2, plane_ops.concat([
                 replies, fanout_sub, fanout_rm, fanout_lv,
-                jnp.stack([join_msg, join_keep])]), fires
+                plane_ops.stack_records([join_msg, join_keep])],
+                axis=0), fires
 
         partial2, in_view2, emitted, fires = jax.vmap(per_node)(
             gids, ctx.keys, state.partial, state.in_view,
@@ -343,10 +346,10 @@ class Scamp:
         iso_tgt = jax.vmap(views.pick_one)(partial2, iso_keys)
         iso_msg = jax.vmap(
             lambda m, d, ok: msg_ops.build(
-                cfg.msg_words, T.MsgKind.SCAMP_SUBSCRIPTION, m,
+                cfg, T.MsgKind.SCAMP_SUBSCRIPTION, m,
                 jnp.where(ok, d, -1), ttl=_WALK_TTL,
                 payload=(m, jnp.int32(0))))(gids, iso_tgt, isolated)
-        emitted = jnp.concatenate([emitted, iso_msg[:, None, :]], axis=1)
+        emitted = plane_ops.concat([emitted, iso_msg[:, None, :]], axis=1)
 
         # Crash-stopped and left nodes are frozen and silent.
         live = ctx.alive & (~state.left | admitted)

@@ -21,6 +21,7 @@ from jax import Array
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
 
 
 class StaticState(NamedTuple):
@@ -36,7 +37,7 @@ class Static:
 
     def step(self, cfg: Config, comm: LocalComm, state: StaticState,
              ctx: RoundCtx) -> tuple[StaticState, Array]:
-        emitted = jnp.zeros((comm.n_local, 0, cfg.msg_words), jnp.int32)
+        emitted = msg_ops.zero_stack(cfg, (comm.n_local, 0))
         return state, emitted
 
     def neighbors(self, cfg: Config, state: StaticState,

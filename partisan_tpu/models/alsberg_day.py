@@ -31,6 +31,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 # APP payload layout: [op, key, value, aux]
 OP_WRITE = 30        # client -> primary
@@ -202,7 +203,7 @@ class AlsbergDay:
         fire = st.req_pending & alive[:, None]
         kid = jnp.arange(k, dtype=jnp.int32)
         blocks.append(msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            cfg, T.MsgKind.APP, gids[:, None],
             jnp.where(fire, PRIMARY, -1), flags=flags,
             payload=(jnp.int32(OP_WRITE), kid[None, :], st.req_value,
                      jnp.int32(0))))
@@ -214,7 +215,7 @@ class AlsbergDay:
         aux_client = jnp.where(restart, gen * GEN_BASE + incoming, 0)
         col_dst = jnp.where(restart[..., None] & new_mask, pid, -1)  # [n,K,P]
         blocks.append(msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None, None], col_dst,
+            cfg, T.MsgKind.APP, gids[:, None, None], col_dst,
             flags=flags,
             payload=(jnp.int32(OP_COLLABORATE), kid[None, :, None],
                      store[..., None], aux_client[..., None]),
@@ -230,12 +231,12 @@ class AlsbergDay:
                              jnp.int32(OP_WRITE_OK)], 0)
         rep_dst = jnp.where((rep_op > 0) & alive[:, None], src, -1)
         blocks.append(msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], rep_dst,
+            cfg, T.MsgKind.APP, gids[:, None], rep_dst,
             flags=flags, payload=(rep_op, key, val, aux)))
 
         # (4) primary ok replies (completed + displaced-by-newer-write)
         blocks.append(msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], ok_dst,
+            cfg, T.MsgKind.APP, gids[:, None], ok_dst,
             flags=flags,
             payload=(jnp.int32(OP_WRITE_OK), kid[None, :], store,
                      jnp.int32(0))))
@@ -243,12 +244,12 @@ class AlsbergDay:
         # store), not the displacing one's
         disp_dst = jnp.where(displaced & alive[:, None], st.out_client, -1)
         blocks.append(msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], disp_dst,
+            cfg, T.MsgKind.APP, gids[:, None], disp_dst,
             flags=flags,
             payload=(jnp.int32(OP_WRITE_OK), kid[None, :], st.store,
                      jnp.int32(0))))
 
-        emitted = jnp.concatenate(blocks, axis=1)
+        emitted = plane_ops.concat(blocks, axis=1)
         new = AlsbergDayState(
             store=store, written=written,
             req_pending=req_pending, req_value=st.req_value, req_ok=req_ok,

@@ -86,7 +86,7 @@ class AntiEntropy:
 
         # Emit this round's pull requests (answered next round).
         emitted = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], targets,
+            cfg, T.MsgKind.APP, gids[:, None], targets,
             payload=(jnp.int32(OP_PULL),),
         )
         return AntiEntropyState(store=store), emitted

@@ -71,7 +71,7 @@ class CausalChat:
         fire = (state.send_at == ctx.rnd).any(axis=1) & ctx.alive
         dst = jnp.where(fire, gids, -1)
         emitted = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], dst[:, None],
+            cfg, T.MsgKind.APP, gids[:, None], dst[:, None],
             flags=T.F_CAUSAL, payload=(state.seq[:, None],))
         seq = state.seq + fire.astype(jnp.int32)
         return ChatState(log=log, log_len=log_len, seq=seq,

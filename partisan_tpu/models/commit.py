@@ -54,6 +54,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 # APP payload layout: [op, slot, value, aux]
 OP_PREPARE = 10
@@ -326,7 +327,7 @@ class CommitProtocol:
         pid = jnp.arange(p, dtype=jnp.int32)
         fan_dst = jnp.where(do_fan[..., None] & st.c_mask, pid, -1)  # [n,S,P]
         blocks.append(msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None, None], fan_dst,
+            cfg, T.MsgKind.APP, gids[:, None, None], fan_dst,
             payload=(fan_op[..., None],
                      jnp.arange(s, dtype=jnp.int32)[None, :, None],
                      st.c_value[..., None], jnp.int32(0)),
@@ -379,7 +380,7 @@ class CommitProtocol:
             rep_aux = jnp.where(m_req, dec, rep_aux)
         rep_dst = jnp.where((rep_op > 0) & alive[:, None], src, -1)
         blocks.append(msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], rep_dst,
+            cfg, T.MsgKind.APP, gids[:, None], rep_dst,
             payload=(rep_op, slot, val, rep_aux)))
 
         if self.ctp:
@@ -388,7 +389,7 @@ class CommitProtocol:
             req_dst = jnp.where(dreq_fire[:, None], nbrs, -1)
             dreq_coord = p_coord[rows, dreq_slot]          # [n]
             blocks.append(msg_ops.build(
-                cfg.msg_words, T.MsgKind.APP, gids[:, None], req_dst,
+                cfg, T.MsgKind.APP, gids[:, None], req_dst,
                 payload=(jnp.int32(OP_DECISION_REQ), dreq_slot[:, None],
                          dreq_coord[:, None], jnp.int32(0))))
             # (4) notify peers that answered uncertain once decided
@@ -398,14 +399,14 @@ class CommitProtocol:
             note_dst = jnp.where(note, pid, -1)
             note_dec = jnp.where(p_status == P_COMMIT, DEC_COMMIT, DEC_ABORT)
             blocks.append(msg_ops.build(
-                cfg.msg_words, T.MsgKind.APP, gids[:, None, None], note_dst,
+                cfg, T.MsgKind.APP, gids[:, None, None], note_dst,
                 payload=(jnp.int32(OP_DECISION),
                          jnp.arange(s, dtype=jnp.int32)[None, :, None],
                          p_coord[..., None], note_dec[..., None]),
             ).reshape(n, s * p, cfg.msg_words))
             p_uncertain = jnp.where(decided_now[..., None], False, p_uncertain)
 
-        emitted = jnp.concatenate(blocks, axis=1)
+        emitted = plane_ops.concat(blocks, axis=1)
         new = CommitState(
             c_phase=c_phase, c_sent=c_sent, c_mask=st.c_mask, c_acks=c_acks,
             c_t0=c_t0, c_value=st.c_value, c_outcome=c_outcome,

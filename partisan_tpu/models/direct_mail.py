@@ -72,7 +72,7 @@ class DirectMail:
         flags = T.F_ACK_REQUIRED if self.acked else 0
         dst = jnp.where(any_p[:, None], nbrs, -1)
         emitted = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], dst,
+            cfg, T.MsgKind.APP, gids[:, None], dst,
             flags=flags, payload=(jnp.int32(OP_MAIL), slot[:, None]))
         pending = state.pending & ~(
             (jnp.arange(cfg.max_broadcasts)[None, :] == slot[:, None])

@@ -26,6 +26,7 @@ from jax import Array
 from partisan_tpu import types as T
 from partisan_tpu.config import Config
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 CLIENT, SERVER = 0, 1
 
@@ -66,7 +67,7 @@ class Echo:
         reply_dst = jnp.where(
             is_ping & (gids == SERVER)[:, None], inb[..., T.W_SRC], -1)
         replies = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], reply_dst,
+            cfg, T.MsgKind.APP, gids[:, None], reply_dst,
             lane=sender, payload=(sender, jnp.ones_like(sender)))
 
         # Client: an echo frees its sender process for the next ping.
@@ -80,14 +81,14 @@ class Echo:
         fire = (gids == CLIENT)[:, None] & ~awaiting & (state.to_send > 0)
         lanes = jnp.broadcast_to(jnp.arange(C)[None, :], (n, C))
         pings = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            cfg, T.MsgKind.APP, gids[:, None],
             jnp.where(fire, SERVER, -1),
             lane=lanes, payload=(lanes, jnp.zeros_like(lanes)))
         return EchoState(
             to_send=state.to_send - fire.astype(jnp.int32),
             awaiting=awaiting | fire,
             echoed=echoed,
-        ), jnp.concatenate([replies, pings], axis=1)
+        ), plane_ops.concat([replies, pings], axis=1)
 
     def done(self, state: EchoState) -> bool:
         return bool((state.to_send[CLIENT] == 0).all()

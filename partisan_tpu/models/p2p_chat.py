@@ -72,7 +72,7 @@ class P2PChat:
         dst = jnp.where(fire, state.send_dst, -1)
         srank = jnp.cumsum(fire, axis=1)
         emitted = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None], dst,
+            cfg, T.MsgKind.APP, gids[:, None], dst,
             flags=T.F_CAUSAL, lane=lane,
             payload=(state.seq[:, None] + srank - 1,))
         seq = state.seq + fire.sum(axis=1, dtype=jnp.int32)

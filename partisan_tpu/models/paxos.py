@@ -48,6 +48,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 # APP payload layout: [op, slot, ballot, value, aux]
 OP_PREPARE = 30
@@ -179,7 +180,7 @@ class Paxos:
             jnp.broadcast_to(mval[:, None, :], acc_bal.shape), awho[:, :, None],
             axis=2)[:, :, 0]
         accepted_msg = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            cfg, T.MsgKind.APP, gids[:, None],
             jnp.where(acc_any, acc_src, -1),
             payload=(jnp.full((n, S), OP_ACCEPTED),
                      jnp.broadcast_to(sl[None, :], (n, S)),
@@ -198,7 +199,7 @@ class Paxos:
             jnp.broadcast_to(msrc[:, None, :], prep_bal.shape), who[:, :, None],
             axis=2)[:, :, 0]
         promise = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None],
+            cfg, T.MsgKind.APP, gids[:, None],
             jnp.where(prep_win, prep_src, -1),
             payload=(jnp.full((n, S), OP_PROMISE),
                      jnp.broadcast_to(sl[None, :], (n, S)),
@@ -296,7 +297,7 @@ class Paxos:
         fan_val = jnp.where(dec_now, p_chosen, fan_val)
         fan_val = jnp.where(dec_rebc, decided, fan_val)
         fan = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None, None],
+            cfg, T.MsgKind.APP, gids[:, None, None],
             jnp.where(any_fan[:, :, None], all_ids[None, None, :], -1),
             payload=(fan_op[:, :, None],
                      jnp.broadcast_to(sl[None, :, None], (n, S, NG)),
@@ -321,7 +322,7 @@ class Paxos:
             p_won=jnp.where(live, p_won, st.p_won),
             won_conflict=jnp.where(live, won_conflict, st.won_conflict),
             decided=jnp.where(live, decided, st.decided))
-        emitted = jnp.concatenate(
+        emitted = plane_ops.concat(
             [promise, accepted_msg, fan.reshape(n, S * NG, cfg.msg_words)],
             axis=1)
         return out, emitted

@@ -47,6 +47,7 @@ from partisan_tpu.config import BROADCAST_CHANNEL, Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.models import handlers as handlers_mod
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.ops import rng
 
 _TAG_AAE = 401
@@ -179,7 +180,12 @@ class Plumtree:
         kind = inb[..., T.W_KIND]
         src = inb[..., T.W_SRC]
         b = jnp.clip(inb[..., T.P0], 0, B - 1)
-        pay = inb[..., T.P1:T.P1 + PW]                          # [n, cap, PW]
+        # Handler payload block as ONE dense [n, cap, PW] array: the
+        # lattice joins/leq genuinely need the minor axis, and PW is a
+        # couple of words — far below the record width, so this small
+        # stack is not a wire interleave (the jaxpr budget guard keys
+        # on full-record-width concatenates).
+        pay = plane_ops.stack_words(inb, T.P1, T.P1 + PW)       # [n, cap, PW]
         mr = inb[..., T.P1 + PW]
         ep_w = inb[..., T.P1 + PW + 1]                          # [n, cap]
         is_g = kind == T.MsgKind.PT_GOSSIP
@@ -203,7 +209,7 @@ class Plumtree:
         def pt_skip(_):
             return (data, rr, pruned, lazyp, npu, psrc, state.epoch,
                     state.nonmono,
-                    jnp.zeros((n_local, E_PT, W), jnp.int32))
+                    msg_ops.zero_stack(cfg, (n_local, E_PT)))
 
         def pt_body(_, data=data, rr=rr, pruned=pruned, lazyp=lazyp,
                     npu=npu, psrc=psrc, is_g=is_g, is_ih=is_ih,
@@ -369,7 +375,7 @@ class Plumtree:
             # serve the store
             rep_pay = jnp.where(is_ih[..., None], pay, data_b)      # [n, cap, PW]
             replies = msg_ops.build(
-                W, rep_kind, gids[:, None],
+                cfg, rep_kind, gids[:, None],
                 jnp.where(rep_kind > 0, src, -1), channel=CH,
                 payload=(b, *jnp.unstack(rep_pay, axis=-1),
                          jnp.where(is_gr, rr_b, 0), ep_b))
@@ -394,7 +400,7 @@ class Plumtree:
             dst = jnp.where(sel_ok[:, :, None] & eager, nbrs[:, None, :], -1)
             data_sel = post_sel[..., :PW]                   # [n, S, PW]
             push_msgs = msg_ops.build(
-                W, T.MsgKind.PT_GOSSIP, gids[:, None, None], dst, channel=CH,
+                cfg, T.MsgKind.PT_GOSSIP, gids[:, None, None], dst, channel=CH,
                 payload=(sel[:, :, None],
                          *(w[:, :, None] for w in jnp.unstack(data_sel, axis=-1)),
                          post_sel[..., PW][:, :, None],
@@ -418,15 +424,15 @@ class Plumtree:
             adv_pack = jnp.take_along_axis(post, bi[:, :, None],
                                            axis=1)       # [n, L, PW+3]
             ihave_msgs = msg_ops.build(
-                W, T.MsgKind.PT_IHAVE, gids[:, None],
+                cfg, T.MsgKind.PT_IHAVE, gids[:, None],
                 jnp.where(lv > 0, nbrs[rows, kix], -1), channel=CH,
                 payload=(bi, *jnp.unstack(adv_pack[..., :PW], axis=-1),
                          jnp.zeros_like(bi),
                          adv_pack[..., PW + 1]))
 
             return (data, rr, pruned, lazyp, npu, psrc, tgt_ep, nonmono,
-                    jnp.concatenate([replies, push_msgs, ihave_msgs],
-                                    axis=1))
+                    plane_ops.concat([replies, push_msgs, ihave_msgs],
+                                     axis=1))
 
         (data, rr, pruned, lazyp, npu, psrc, tgt_ep, nonmono,
          emitted) = jax.lax.cond(pt_go, pt_body, pt_skip, 0)

@@ -102,7 +102,7 @@ class RumorMongering:
             ctx.keys, pending, nbrs, ctx.alive)
 
         emitted = msg_ops.build(
-            cfg.msg_words, T.MsgKind.APP, gids[:, None, None], tgts,
+            cfg, T.MsgKind.APP, gids[:, None, None], tgts,
             payload=(jnp.int32(OP_RUMOR), slots[:, :, None]),
         ).reshape(n, PER_ROUND * FANOUT, cfg.msg_words)
 

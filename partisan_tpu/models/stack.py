@@ -18,6 +18,7 @@ from jax import Array
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import plane as plane_ops
 
 
 class Stack:
@@ -38,7 +39,7 @@ class Stack:
             s2, e = m.step(cfg, comm, s, ctx, nbrs)
             outs.append(s2)
             emits.append(e)
-        return tuple(outs), jnp.concatenate(emits, axis=1)
+        return tuple(outs), plane_ops.concat(emits, axis=1)
 
     def coverage(self, state: tuple, alive: Array, slot: int = 0) -> Array:
         """Coverage of the FIRST sub-model that defines one (the

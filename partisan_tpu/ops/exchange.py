@@ -34,43 +34,69 @@ needs no dynamic-width awareness, only the static full-width cost.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Sequence
 
 import jax.numpy as jnp
 from jax import Array
 
+from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.types import W_DST, W_KIND
 
 
 class Inbox(NamedTuple):
-    """One round's deliveries. data[i, s] is the s-th message for node i."""
+    """One round's deliveries. data[i, s] is the s-th message for node i.
 
-    data: Array   # int32[n, cap, W]; kind==NONE marks empty slots
+    Layout invariant ("planes in queues, wire at the boundary"): under
+    ``Config.plane_major`` the routed inbox — a queued copy every
+    manager/model/delivery stage re-reads next round — stores a
+    ``plane.Planes`` struct at the narrow storage dtypes; the route
+    itself ships packed planes (one destination sort, per-plane
+    gathers), so NO [n, cap, W] interleave exists on this path at all.
+    Word values are identical to the legacy interleaved ``int32`` data
+    in either layout."""
+
+    data: Array   # [n, cap, W] records (Planes or int32 array); kind==
+    #               NONE marks empty slots
     count: Array  # int32[n] — valid slots per node
     drops: Array  # int32[n] — messages dropped for this node (overflow)
 
 
-def empty_inbox(n: int, cap: int, msg_words: int) -> Inbox:
+def empty_inbox(n: int, cap: int, layout: int | Sequence) -> Inbox:
+    """``layout``: the wire word count (legacy interleaved int32) or a
+    per-word dtype tuple (plane-major — ``Config.wire_layout``)."""
+    if isinstance(layout, int):
+        data = jnp.zeros((n, cap, layout), jnp.int32)
+    else:
+        data = plane_ops.zero_planes((n, cap), tuple(layout))
     return Inbox(
-        data=jnp.zeros((n, cap, msg_words), jnp.int32),
+        data=data,
         count=jnp.zeros((n,), jnp.int32),
         drops=jnp.zeros((n,), jnp.int32),
     )
 
 
-def route(emitted: Array, n: int, cap: int, *, node_offset: int | Array = 0) -> Inbox:
-    """Route ``emitted`` int32[m, E, W] (or [m*E, W]) into an n-node inbox.
+def route(emitted, n: int, cap: int, *, node_offset: int | Array = 0) -> Inbox:
+    """Route ``emitted`` [m, E, W] records (or [m*E, W]; Planes or int32
+    array) into an n-node inbox.
 
     ``node_offset``: the global id of local node 0 — destinations outside
     [node_offset, node_offset+n) are ignored (used by the sharded exchange,
     where each shard routes the globally-gathered emissions into its own
     node range).
+
+    Plane-major records route WITHOUT interleaving: the destination sort
+    runs once on the (int32) dst plane and every plane rides its own
+    uniform gather at its narrow storage dtype — the "ship the wire as
+    packed planes" case of ARCHITECTURE.md's bytes-first model.
     """
-    flat = emitted.reshape(-1, emitted.shape[-1])
+    W = emitted.shape[-1]
+    flat = emitted.reshape(-1, W)
     if flat.shape[0] == 0:   # a manager with no event lane (state-gossip only)
-        return empty_inbox(n, cap, emitted.shape[-1])
-    kind = flat[:, W_KIND]
-    dst = flat[:, W_DST] - node_offset
+        if plane_ops.is_planes(emitted):
+            return empty_inbox(n, cap, tuple(w.dtype for w in emitted.ws))
+        return empty_inbox(n, cap, W)
+    kind = flat[..., W_KIND]
+    dst = flat[..., W_DST] - node_offset
     # Empty slots and out-of-range destinations -> sentinel bucket n.
     local = (kind != 0) & (dst >= 0) & (dst < n)
     dst = jnp.where(local, dst, n)
@@ -93,13 +119,13 @@ def route(emitted: Array, n: int, cap: int, *, node_offset: int | Array = 0) -> 
     valid = cap_idx[None, :] < counts[:n, None]
     src_pos = jnp.clip(src_pos, 0, dst.shape[0] - 1)
     take = order[src_pos]                                  # flat msg index
-    data = jnp.where(valid[..., None], flat[take], 0)
+    data = plane_ops.where(valid, plane_ops.take_records(flat, take), 0)
 
     delivered = jnp.minimum(counts[:n], cap)
     return Inbox(data=data, count=delivered, drops=counts[:n] - delivered)
 
 
-def compact_emissions(emitted: Array, cap: int) -> Array:
+def compact_emissions(emitted, cap: int):
     """Shrink ``emitted [n, E, W]`` to ``[n, cap, W]``: the emission stack
     is wide but sparse (managers+models concatenate fixed-width blocks of
     which a handful are live per round), and the global route() sort pays
@@ -107,7 +133,8 @@ def compact_emissions(emitted: Array, cap: int) -> Array:
     71 elements per row is far cheaper than 71·n globally) keeps up to
     ``cap`` live messages per sender in emission order — per-sender FIFO
     is preserved.  Overflow sheds; callers surface the loss via the
-    emitted-vs-delivered stats delta."""
+    emitted-vs-delivered stats delta.  Plane-major stacks compact
+    per-plane off ONE order (no interleave)."""
     n, E, _w = emitted.shape
     if cap >= E:
         return emitted
@@ -117,16 +144,17 @@ def compact_emissions(emitted: Array, cap: int) -> Array:
     rows = jnp.arange(n)[:, None]
     keep = jnp.arange(cap, dtype=jnp.int32)[None, :] < \
         valid.sum(axis=1, dtype=jnp.int32)[:, None]
-    return jnp.where(keep[..., None], emitted[rows, take], 0)
+    return plane_ops.where(keep, plane_ops.take_records(emitted, (rows, take)),
+                           0)
 
 
 def merge_inboxes(a: Inbox, b: Inbox) -> Inbox:
     """Append b's messages after a's (capacity permitting) — used to merge
     locally-routed and remotely-routed traffic or delayed re-deliveries.
     ``b`` may have any slot count (and need not be compacted); the result
-    keeps a's capacity."""
+    keeps a's capacity (and a's layout — both must share it)."""
     n, cap, w = a.data.shape
-    both = jnp.concatenate(
+    both = plane_ops.concat(
         [a.data, b.data], axis=1
     )  # [n, cap + bcap, w] — a's slots first
     # Gather-based compaction (see route() on TPU scatter cost): stable
@@ -138,7 +166,8 @@ def merge_inboxes(a: Inbox, b: Inbox) -> Inbox:
     vcount = valid.sum(axis=1, dtype=jnp.int32)
     keep = jnp.arange(cap, dtype=jnp.int32)[None, :] < \
         jnp.minimum(vcount, cap)[:, None]
-    data = jnp.where(keep[..., None], both[rows, take], 0)
+    data = plane_ops.where(keep, plane_ops.take_records(both, (rows, take)),
+                           0)
     total = a.count + b.count
     delivered = jnp.minimum(total, cap)
     return Inbox(

@@ -1,4 +1,17 @@
-"""Building fixed-width message records (see types.py for the layout)."""
+"""Building fixed-width message records (see types.py for the layout).
+
+Layout: with ``Config.plane_major`` (the default) a freshly built stack
+is a :class:`partisan_tpu.ops.plane.Planes` struct — one ``[...,]``
+tensor per wire word, each stored at its narrowest documented dtype
+(types.NARROW_WIRE_DTYPES) — and NO minor-axis interleave happens here
+at all.  BENCH_NOTES' corrected cost model measured ``build``'s
+plane-interleave alone at ~25% of the 32k round (~14 calls × ~4.7 ms on
+the TPU relay); the plane-major pipeline defers the interleave to the
+single wire boundary in ``cluster.round_body`` (or eliminates it where
+the exchange ships planes).  Callers pass the ``Config`` as the first
+argument; passing a bare ``msg_words`` int keeps the legacy interleaved
+int32 stack (the A/B baseline and the layout the bit-parity tests pin).
+"""
 
 from __future__ import annotations
 
@@ -6,22 +19,32 @@ import jax.numpy as jnp
 from jax import Array
 
 from partisan_tpu import types as T
+from partisan_tpu.ops import plane as plane_ops
 
 
-def build(msg_words: int, kind: Array | int, src: Array, dst: Array, *,
+def _layout(cfg_or_words) -> tuple[int, bool]:
+    """(msg_words, plane_major) from a Config or a bare word count."""
+    if isinstance(cfg_or_words, int):
+        return cfg_or_words, False
+    return cfg_or_words.msg_words, cfg_or_words.plane_major
+
+
+def build(cfg_or_words, kind: Array | int, src: Array, dst: Array, *,
           channel: Array | int = 0, ttl: Array | int = 0,
           clock: Array | int = 0, lane: Array | int = 0,
-          flags: Array | int = 0, payload: tuple = ()) -> Array:
+          flags: Array | int = 0, payload: tuple = ()):
     """Build message records of shape broadcast(src, dst, ...) + [msg_words].
 
     A record whose ``dst`` is negative is marked empty (kind NONE) so
     callers can pass -1 destinations from unused sampling slots directly.
 
-    Assembled as ONE ``stack`` of word planes: the previous
-    zeros-then-12-sequential-``.at[].set`` form cost ~4.7 ms per call at
-    32k x 16 slots on the TPU relay, and a round makes ~14 build calls
-    (~25% of the round) — see BENCH_NOTES "corrected cost model".
+    ``cfg_or_words``: the ``Config`` (preferred — selects the layout per
+    ``cfg.plane_major``) or a bare ``msg_words`` int (legacy interleaved
+    int32 stack).  Plane-major output is a :class:`plane.Planes`; the
+    word values are identical either way (narrow planes widen back to
+    the same int32 at the wire boundary).
     """
+    msg_words, planes = _layout(cfg_or_words)
     shape = jnp.broadcast_shapes(
         jnp.shape(kind), jnp.shape(src), jnp.shape(dst),
         jnp.shape(channel), jnp.shape(ttl), jnp.shape(clock),
@@ -37,18 +60,48 @@ def build(msg_words: int, kind: Array | int, src: Array, dst: Array, *,
         raise ValueError(
             f"{len(payload)} payload words exceed msg_words={msg_words}")
 
-    def w(x):
-        return jnp.broadcast_to(jnp.asarray(x, jnp.int32), shape)
+    def w(x, i):
+        dt = T.wire_dtype(i) if planes else jnp.int32
+        return jnp.broadcast_to(jnp.asarray(x).astype(dt), shape)
 
-    zero = jnp.zeros(shape, jnp.int32)
-    words = [jnp.where(valid, w(kind), 0), w(src),
-             jnp.where(valid, dst, 0), w(channel), w(ttl), w(clock),
-             w(lane), w(flags)]
-    words += [w(p) for p in payload]
-    words += [zero] * (msg_words - len(words))
+    words = [jnp.where(valid, w(kind, T.W_KIND), 0), w(src, T.W_SRC),
+             jnp.where(valid, dst, 0), w(channel, T.W_CHANNEL),
+             w(ttl, T.W_TTL), w(clock, T.W_CLOCK),
+             w(lane, T.W_LANE), w(flags, T.W_FLAGS)]
+    words += [w(p, T.HDR_WORDS + i) for i, p in enumerate(payload)]
+    words += [jnp.zeros(shape, T.wire_dtype(i) if planes else jnp.int32)
+              for i in range(len(words), msg_words)]
+    if planes:
+        return plane_ops.Planes(words)
+    # Legacy layout: assembled as ONE stack of word planes (the previous
+    # zeros-then-12-sequential-.at[].set form cost ~4.7 ms per call at
+    # 32k x 16 slots on the TPU relay — BENCH_NOTES "corrected cost
+    # model").
     return jnp.stack(words, axis=-1)
 
 
-def is_kind(msgs: Array, kind: int) -> Array:
-    """bool mask over [..., W] records."""
+def zero_stack(cfg_or_words, shape: tuple):
+    """An all-empty ``msg_words``-wide emission block of record shape
+    ``shape`` (no word axis) — the layout-aware successor of
+    ``jnp.zeros(shape + (msg_words,), jnp.int32)`` used for quiet
+    lax.cond branches and fixed-width padding blocks."""
+    msg_words, planes = _layout(cfg_or_words)
+    if planes:
+        return plane_ops.zero_planes(
+            tuple(shape), tuple(T.wire_dtype(i) for i in range(msg_words)))
+    return jnp.zeros(tuple(shape) + (msg_words,), jnp.int32)
+
+
+def zero_wire(cfg, shape: tuple):
+    """An all-empty ``wire_words``-wide record block (trailing
+    provenance/latency words included) — for mid-round control-message
+    builders (acks, resets) and queued-copy buffers, which hold
+    FULL-width records."""
+    if cfg.plane_major:
+        return plane_ops.zero_planes(tuple(shape), cfg.wire_dtypes)
+    return jnp.zeros(tuple(shape) + (cfg.wire_words,), jnp.int32)
+
+
+def is_kind(msgs, kind: int) -> Array:
+    """bool mask over [..., W] records (either layout)."""
     return msgs[..., T.W_KIND] == kind

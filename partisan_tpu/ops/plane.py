@@ -1,0 +1,378 @@
+"""Struct-of-planes message records: the plane-major wire layout.
+
+BENCH_NOTES' corrected cost model showed the 32k round paying HBM
+round-trips on materialized ``[n, slots, W]`` intermediates: with the
+record word on the MINOR axis, every per-word read is a strided gather
+over a 12-wide (lane-padded) dimension, and ``ops/msg.py:build``'s
+plane-interleave alone was ~25% of the round.  The fix is layout, not
+op flavor (ROADMAP open item 1: "the lever is FUSION and LAYOUT
+TOGETHER"): carry a round's messages as a **struct of word planes** —
+``W`` separate ``[n, slots]`` tensors — from emission through the
+outbound stack, compaction, the fused shed/fault filter and the route
+sort, and interleave to the ``[n, slots, W]`` wire layout exactly ONCE
+per round, at the exchange boundary (``tests/test_program_budget.py``
+guards the one-interleave budget at the jaxpr level).
+
+:class:`Planes` is a registered pytree that quacks like the interleaved
+``int32[..., W]`` record tensor for the operations the round pipeline
+actually uses — last-axis word reads (``p[..., W_KIND]``), word writes
+(``p.at[..., W_KIND].set(v)``), row/slot gathers and scatters — so the
+fault filter, the monotonic shed, metrics/latency/provenance readers
+and the interposition hooks run unchanged on either layout.  Whole-
+tensor jnp calls (``concatenate``/``where``/``zeros_like``) cannot
+dispatch on a custom class; the layout-agnostic helpers below
+(:func:`concat`, :func:`where`, :func:`zeros_like`) accept both.
+
+**Bytes-first packing**: each plane is stored at the narrowest dtype
+its word's value range permits (types.wire_dtype: kind/channel/flags
+int8, ttl int16, the provenance hop int16), widening back to int32 only
+at the interleave boundary — a pure-bandwidth cut on the dominant
+``[n, cap, ·]`` traffic (~23% of record bytes at msg_words=12), and the
+narrow planes ride the sharded all_gather exchange as-is (the "ship the
+wire as packed planes" case).  Words whose values are unbounded or
+id-sized (src/dst/clock/lane/payload, the provenance src, the latency
+birth round) stay int32 so widened records are bit-identical to the
+legacy path at ANY horizon — the parity contract in
+tests/test_faults.py/test_latency.py/test_provenance.py.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+__all__ = [
+    "Planes", "is_planes", "concat", "where", "zeros_like",
+    "zero_planes", "interleave", "deinterleave",
+    "append_words", "append_tail", "stack_words", "stack_records",
+    "take_records", "take_along",
+]
+
+
+class Planes:
+    """A ``[..., W]`` message-record tensor stored as W word planes.
+
+    All planes share one shape (the logical shape minus the word axis);
+    ``shape``/``ndim`` report the LOGICAL interleaved shape, so shape-
+    driven code (``emitted.shape[1]``, broadcasting ranks) is layout-
+    agnostic.  Supported indexing mirrors the pipeline's usage:
+
+    - ``p[..., i]``            -> word plane i (an Array, storage dtype)
+    - ``p[..., a:b]``          -> Planes over the word subset
+    - ``p[idx]`` (no word axis)-> per-plane fancy/basic indexing
+    - ``p.at[..., i].set(v)``  -> replace word plane i
+    - ``p.at[rows, slot].set(q, mode=...)`` -> per-plane scatter
+      (``q`` a matching Planes or a scalar)
+    """
+
+    __slots__ = ("ws",)
+
+    def __init__(self, ws: Sequence[Array]):
+        self.ws = tuple(ws)
+
+    # ---- pytree ------------------------------------------------------
+    def tree_flatten(self):
+        return self.ws, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, ws):
+        del aux
+        return cls(ws)
+
+    # ---- shape protocol ---------------------------------------------
+    @property
+    def n_words(self) -> int:
+        return len(self.ws)
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(jnp.shape(self.ws[0])) + (len(self.ws),)
+
+    @property
+    def ndim(self) -> int:
+        return jnp.ndim(self.ws[0]) + 1
+
+    def __repr__(self) -> str:
+        return (f"Planes(shape={self.shape}, "
+                f"dtypes={[str(w.dtype) for w in self.ws]})")
+
+    def __array__(self, dtype=None, copy=None):
+        """Host-side ``np.asarray(planes)`` materializes the interleaved
+        int32 wire tensor — test oracles and exporters read records
+        layout-agnostically.  (Never hit inside jit: tracers reject
+        __array__ exactly as they do for ordinary Arrays.)"""
+        import numpy as np
+
+        del copy
+        arr = np.asarray(self.interleave())
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # ---- maps --------------------------------------------------------
+    def map(self, fn) -> "Planes":
+        """Apply ``fn`` to every plane (shape-preserving transforms)."""
+        return Planes(tuple(fn(w) for w in self.ws))
+
+    def reshape(self, *shape) -> "Planes":
+        """Reshape by LOGICAL shape; the last dim must stay the word
+        count (plumtree's ``build(...).reshape(n, S*K, W)`` idiom)."""
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if shape[-1] != len(self.ws):
+            raise ValueError(
+                f"last dim {shape[-1]} != word count {len(self.ws)}")
+        return self.map(lambda w: w.reshape(shape[:-1]))
+
+    # ---- indexing ----------------------------------------------------
+    def _split_word_axis(self, idx):
+        """Normalize ``idx`` -> (plane_idx, word_sel) where word_sel is
+        None (word axis untouched), an int, or a slice."""
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        if any(i is Ellipsis for i in idx):
+            pos = idx.index(Ellipsis)
+            explicit = len(idx) - 1 - sum(i is None for i in idx)
+            fill = self.ndim - explicit
+            idx = idx[:pos] + (slice(None),) * fill + idx[pos + 1:]
+        n_axes = sum(i is not None for i in idx)
+        if n_axes == self.ndim:
+            # the last non-None entry addresses the word axis
+            last = idx[-1]
+            if last is None:
+                raise IndexError(f"unsupported Planes index {idx!r}")
+            return idx[:-1], last
+        if n_axes > self.ndim:
+            raise IndexError(f"too many indices for Planes: {idx!r}")
+        return idx, None
+
+    def __getitem__(self, idx):
+        plane_idx, wsel = self._split_word_axis(idx)
+        if isinstance(wsel, int):
+            w = self.ws[wsel]
+            return w[plane_idx] if plane_idx else w
+        ws = self.ws if wsel is None or wsel == slice(None) \
+            else self.ws[wsel]
+        if not isinstance(ws, tuple):
+            ws = (ws,)
+        if plane_idx:
+            ws = tuple(w[plane_idx] for w in ws)
+        return Planes(ws)
+
+    @property
+    def at(self):
+        return _PlanesAt(self)
+
+    def interleave(self) -> Array:
+        """THE plane->wire boundary: widen every plane to int32 and
+        stack on a new minor axis.  Call sites are budgeted — the round
+        program may contain exactly one such stack (the jaxpr guard in
+        tests/test_program_budget.py counts them)."""
+        return jnp.stack([w.astype(jnp.int32) for w in self.ws],
+                         axis=-1)
+
+
+class _PlanesAt:
+    __slots__ = ("p",)
+
+    def __init__(self, p: Planes):
+        self.p = p
+
+    def __getitem__(self, idx):
+        return _PlanesAtRef(self.p, idx)
+
+
+class _PlanesAtRef:
+    __slots__ = ("p", "idx")
+
+    def __init__(self, p: Planes, idx):
+        self.p = p
+        self.idx = idx
+
+    def set(self, val, **kw):
+        plane_idx, wsel = self.p._split_word_axis(self.idx)
+        if isinstance(wsel, int):
+            w = self.p.ws[wsel]
+            v = jnp.asarray(val).astype(w.dtype)
+            if plane_idx:
+                new = w.at[plane_idx].set(v, **kw)
+            else:
+                new = jnp.broadcast_to(v, jnp.shape(w))
+            ws = list(self.p.ws)
+            ws[wsel] = new
+            return Planes(ws)
+        if wsel is not None:
+            raise IndexError(
+                f"unsupported Planes.at word selector {self.idx!r}")
+        if is_planes(val):
+            return Planes(tuple(
+                w.at[plane_idx].set(v.astype(w.dtype), **kw)
+                for w, v in zip(self.p.ws, val.ws)))
+        v = jnp.asarray(val)
+        if v.ndim >= 1 and v.shape[-1] == len(self.p.ws):
+            # An interleaved record block: split it back into planes
+            # (host-side injectors like bridge/server.py hand whole
+            # int32 records to a plane buffer).
+            return Planes(tuple(
+                w.at[plane_idx].set(v[..., i].astype(w.dtype), **kw)
+                for i, w in enumerate(self.p.ws)))
+        return Planes(tuple(
+            w.at[plane_idx].set(v.astype(w.dtype), **kw)
+            for w in self.p.ws))
+
+
+jax.tree_util.register_pytree_node(
+    Planes,
+    lambda p: p.tree_flatten(),
+    Planes.tree_unflatten,
+)
+
+
+def is_planes(x) -> bool:
+    return isinstance(x, Planes)
+
+
+# ---------------------------------------------------------------------------
+# Layout-agnostic helpers (Array | Planes)
+# ---------------------------------------------------------------------------
+
+def concat(blocks: Sequence, axis: int = 1):
+    """Concatenate emission blocks on a record axis (NOT the word
+    axis).  All-Planes blocks concatenate per plane; all-Array blocks
+    fall through to ``jnp.concatenate`` — so manager/model assembly
+    code is layout-agnostic.  A mixed list coerces the interleaved
+    blocks into the Planes layout (third-party models may still build
+    legacy int32 stacks; their word values must respect the documented
+    ranges of types.NARROW_WIRE_DTYPES, like every wire record)."""
+    blocks = list(blocks)
+    if not any(is_planes(b) for b in blocks):
+        return jnp.concatenate(blocks, axis=axis)
+    nw = {b.n_words if is_planes(b) else b.shape[-1] for b in blocks}
+    if len(nw) != 1:
+        raise ValueError(
+            f"cannot concat mixed widths: "
+            f"{[getattr(b, 'shape', None) for b in blocks]}")
+    k = nw.pop()
+    dtypes = next(tuple(w.dtype for w in b.ws)
+                  for b in blocks if is_planes(b))
+    blocks = [b if is_planes(b) else deinterleave(b, dtypes)
+              for b in blocks]
+    return Planes(tuple(
+        jnp.concatenate([b.ws[i] for b in blocks], axis=axis)
+        for i in range(k)))
+
+
+def append_words(p, *words):
+    """Widen a record stack with trailing words (the latency birth /
+    provenance pair stamps).  Planes: O(0) — the new planes join the
+    struct.  Arrays: the legacy minor-axis concatenate."""
+    if is_planes(p):
+        shape = jnp.shape(p.ws[0])
+        return Planes(p.ws + tuple(jnp.broadcast_to(w, shape)
+                                   for w in words))
+    return jnp.concatenate(
+        [p] + [jnp.broadcast_to(w, p.shape[:-1])[..., None]
+               for w in words], axis=-1)
+
+
+def where(mask, a, b):
+    """Record-granular select: ``mask`` has the record shape (no word
+    axis).  Arrays get the legacy ``mask[..., None]`` broadcast."""
+    if is_planes(a):
+        bw = b.ws if is_planes(b) else [b] * a.n_words
+        return Planes(tuple(
+            jnp.where(mask, w, jnp.asarray(x).astype(w.dtype))
+            for w, x in zip(a.ws, bw)))
+    if is_planes(b):
+        return Planes(tuple(
+            jnp.where(mask, jnp.asarray(a).astype(w.dtype), w)
+            for w in b.ws))
+    return jnp.where(mask[..., None], a, b)
+
+
+def append_tail(p, arr, dtype=jnp.int32):
+    """Append ``arr [..., K]``'s minor-axis slices as K trailing word
+    planes (the causal lanes' vector-clock block).  Arrays: the legacy
+    minor-axis concatenate."""
+    if is_planes(p):
+        k = arr.shape[-1]
+        return Planes(p.ws + tuple(arr[..., i].astype(dtype)
+                                   for i in range(k)))
+    return jnp.concatenate([p, arr.astype(p.dtype)], axis=-1)
+
+
+def stack_words(p, lo: int = 0, hi: int | None = None) -> Array:
+    """Materialize a CONTIGUOUS word block as one int32 array
+    ``[..., hi-lo]`` — for payload-block math that genuinely needs a
+    dense minor axis (plumtree handler payloads, shuffle samples, the
+    causal clock block).  These blocks are a few words wide, far below
+    the full record, so the stack is cheap and does NOT count against
+    the one-wire-interleave budget (the jaxpr guard keys on the full
+    record width).  Identity slice for interleaved arrays."""
+    if is_planes(p):
+        ws = p.ws[lo:hi] if hi is not None else p.ws[lo:]
+        return jnp.stack([w.astype(jnp.int32) for w in ws], axis=-1)
+    return p[..., lo:hi] if hi is not None else p[..., lo:]
+
+
+def stack_records(blocks: Sequence, axis: int = 0):
+    """``jnp.stack`` analogue over whole records (a NEW record axis, not
+    the word axis) — e.g. scamp's two per-node control messages."""
+    blocks = list(blocks)
+    if not any(is_planes(b) for b in blocks):
+        return jnp.stack(blocks, axis=axis)
+    if not all(is_planes(b) for b in blocks):
+        raise ValueError("cannot stack mixed layouts")
+    k = blocks[0].n_words
+    return Planes(tuple(
+        jnp.stack([b.ws[i] for b in blocks], axis=axis)
+        for i in range(k)))
+
+
+def take_along(p, idx: Array, axis: int):
+    """Per-plane ``take_along_axis`` over a RECORD axis: ``idx`` has the
+    record shape (no trailing word-axis ``[..., None]`` — each plane
+    already lacks the word axis).  Arrays get the legacy broadcast."""
+    if is_planes(p):
+        return Planes(tuple(
+            jnp.take_along_axis(w, idx, axis=axis) for w in p.ws))
+    return jnp.take_along_axis(p, idx[..., None], axis=axis)
+
+
+def zeros_like(p):
+    if is_planes(p):
+        return p.map(jnp.zeros_like)
+    return jnp.zeros_like(p)
+
+
+def zero_planes(shape: tuple, dtypes: Sequence) -> Planes:
+    """All-empty records: one zero plane per wire word at its storage
+    dtype (``shape`` is the record shape, without the word axis)."""
+    return Planes(tuple(jnp.zeros(shape, dt) for dt in dtypes))
+
+
+def take_records(p, plane_idx):
+    """Gather whole records: ``p[plane_idx]`` per plane (compaction /
+    route-sort gathers)."""
+    if is_planes(p):
+        return Planes(tuple(w[plane_idx] for w in p.ws))
+    return p[plane_idx]
+
+
+def interleave(p):
+    """Array | Planes -> interleaved int32 wire tensor (identity for
+    arrays)."""
+    return p.interleave() if is_planes(p) else p
+
+
+def deinterleave(arr: Array, dtypes: Sequence | None = None) -> Planes:
+    """Wire tensor -> Planes (the routed-inbox/un-interleave direction,
+    and the coercion path for callers handing legacy arrays to a
+    plane-layout stage).  ``dtypes`` narrows each plane to its storage
+    dtype; None keeps int32."""
+    k = arr.shape[-1]
+    if dtypes is None:
+        return Planes(tuple(arr[..., i] for i in range(k)))
+    return Planes(tuple(
+        arr[..., i].astype(dt) for i, dt in zip(range(k), dtypes)))

@@ -57,7 +57,7 @@ def client_round(cfg, comm: LocalComm, ctx: RoundCtx, *, status: Array,
     # emit queued requests
     fire = (status == QUEUED) & alive[:, None]
     req = msg_ops.build(
-        cfg.msg_words, jnp.where(ref > 0, T.MsgKind.GEN_CALL,
+        cfg, jnp.where(ref > 0, T.MsgKind.GEN_CALL,
                                  T.MsgKind.GEN_CAST),
         gids[:, None], jnp.where(fire, dst, -1), payload=(a, b, ref))
     status = jnp.where(fire, jnp.where(ref > 0, WAITING, IDLE), status)

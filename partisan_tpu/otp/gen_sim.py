@@ -30,6 +30,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.otp import client as client_mod
 from partisan_tpu.otp.client import (
     DOWN, IDLE, OK, QUEUED, TIMEOUT, WAITING)
@@ -110,7 +111,7 @@ class GenServerService:
 
         resp_dst = jnp.where(m_call & (ref_w > 0), inb[..., T.W_SRC], -1)
         resp = msg_ops.build(
-            cfg.msg_words, T.MsgKind.GEN_REPLY, gids[:, None], resp_dst,
+            cfg, T.MsgKind.GEN_REPLY, gids[:, None], resp_dst,
             payload=(res, ref_w))
 
         # ---- caller side: the shared gen call client -------------------
@@ -118,7 +119,7 @@ class GenServerService:
             cfg, comm, ctx, status=st.status, dst=st.dst, a=st.fn,
             b=st.arg, ref=st.ref, deadline=st.deadline, result=st.result)
 
-        emitted = jnp.concatenate([resp, req], axis=1)
+        emitted = plane_ops.concat([resp, req], axis=1)
         return st._replace(counter=counter, stopped=stopped,
                            status=status, result=result), emitted
 

@@ -26,6 +26,7 @@ from jax import Array
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import msg as msg_ops
 
 
 class MonitorState(NamedTuple):
@@ -103,7 +104,7 @@ class MonitorService:
         edge_up = st.edge_up | (
             st.edge_subs & ~st.prev_reach & reach & alive_row)
 
-        emitted = jnp.zeros((comm.n_local, 0, cfg.msg_words), jnp.int32)
+        emitted = msg_ops.zero_stack(cfg, (comm.n_local, 0))
         return MonitorState(
             monitors=monitors, node_subs=st.node_subs, prev_alive=galive,
             down_sig=down_sig, nodedown=nodedown, nodeup=nodeup,

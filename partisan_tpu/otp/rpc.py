@@ -32,6 +32,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 
 # slot status
 IDLE = 0
@@ -100,7 +101,7 @@ class RpcService:
         resp_dst = jnp.where(m_call & (call_ref > 0),
                              inb[..., T.W_SRC], -1)
         resp = msg_ops.build(
-            cfg.msg_words, T.MsgKind.RPC_RESPONSE, gids[:, None], resp_dst,
+            cfg, T.MsgKind.RPC_RESPONSE, gids[:, None], resp_dst,
             channel=rpc_ch, payload=(res, call_ref))
 
         # ---- caller: match responses to waiting slots ------------------
@@ -123,14 +124,14 @@ class RpcService:
         # ---- emit queued requests --------------------------------------
         fire = (status == QUEUED) & alive[:, None]
         req = msg_ops.build(
-            cfg.msg_words, T.MsgKind.RPC_CALL, gids[:, None],
+            cfg, T.MsgKind.RPC_CALL, gids[:, None],
             jnp.where(fire, st.dst, -1), channel=rpc_ch,
             payload=(st.fn, st.arg, st.ref))
         # a fired cast slot (ref 0) frees immediately — nothing to await
         status = jnp.where(fire, jnp.where(st.ref > 0, WAITING, IDLE),
                            status)
 
-        emitted = jnp.concatenate([resp, req], axis=1)
+        emitted = plane_ops.concat([resp, req], axis=1)
         return st._replace(status=status, result=result), emitted
 
     # ---- host-side API (partisan_rpc:call/5) --------------------------

@@ -58,6 +58,7 @@ from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
+from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.otp import client as client_mod
 from partisan_tpu.otp import gen_statem as host_statem
 
@@ -290,7 +291,7 @@ class StatemService:
          ovf) = carry
 
         resp = msg_ops.build(
-            cfg.msg_words, T.MsgKind.GEN_REPLY, gids[:, None],
+            cfg, T.MsgKind.GEN_REPLY, gids[:, None],
             jnp.where(jnp.arange(Rm)[None, :] < rc[:, None],
                       reps[..., 0], -1),
             payload=(reps[..., 1], reps[..., 2]))
@@ -310,7 +311,7 @@ class StatemService:
             unprocessed=st.unprocessed
             + jnp.where(alive, leftover + ovf, 0),
             status=status, result=result)
-        return out, jnp.concatenate([resp, req], axis=1)
+        return out, plane_ops.concat([resp, req], axis=1)
 
     # ---- host-side API ------------------------------------------------
     def call(self, st: StatemSimState, caller: int, dst: int, ev: int,

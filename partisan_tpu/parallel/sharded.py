@@ -49,6 +49,18 @@ from partisan_tpu.ops import exchange, gossip, rng
 AXIS = "nodes"
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the stable API (>= 0.6, with
+    check_vma) when present, else the experimental one (check_rep)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def make_mesh(n_devices: int | None = None) -> Mesh:
     """A 1-D device mesh over the node axis."""
     devs = jax.devices()
@@ -79,16 +91,22 @@ class ShardComm:
     def local_ids(self) -> Array:
         return self.node_offset + jnp.arange(self.n_local, dtype=jnp.int32)
 
-    def route(self, emitted: Array) -> exchange.Inbox:
+    def route(self, emitted) -> exchange.Inbox:
         if self.exchange_mode == "all_to_all":
             return self._route_a2a(emitted)
         # [n_local, E, W] -> gather every shard's emissions over ICI, then
         # keep only messages addressed to this shard's node range.
-        all_emitted = jax.lax.all_gather(emitted, AXIS, axis=0, tiled=True)
+        # Plane-major stacks gather PER PLANE at their narrow storage
+        # dtypes (a pytree all_gather) — the int8/int16 planes cut the
+        # dominant n_global·E·W wire volume directly (the "ship the wire
+        # as packed planes" case; no interleave ever materializes).
+        all_emitted = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, AXIS, axis=0, tiled=True),
+            emitted)
         return exchange.route(all_emitted, self.n_local, self.inbox_cap,
                               node_offset=self.node_offset)
 
-    def _route_a2a(self, emitted: Array) -> exchange.Inbox:
+    def _route_a2a(self, emitted) -> exchange.Inbox:
         """Destination-sharded exchange: stable-sort this shard's
         emissions by destination SHARD, pack a fixed per-shard quota,
         ``lax.all_to_all`` over ICI, then route only what arrived.
@@ -103,6 +121,7 @@ class ShardComm:
         grouped by source — a (shard-id, slot) reorder that per-sender
         FIFO semantics permit (the reference orders only per connection,
         partisan_peer_connections.erl:897-942)."""
+        from partisan_tpu.ops import plane as plane_ops
         from partisan_tpu.types import W_DST, W_KIND
 
         S = self.n_shards
@@ -110,12 +129,11 @@ class ShardComm:
         flat = emitted.reshape(-1, W)                    # [M, W]
         M = flat.shape[0]
         Q = min(M, self.a2a_factor * -(-M // S))
-        kind = flat[:, W_KIND]
-        dst = flat[:, W_DST]
+        kind = flat[..., W_KIND]
+        dst = flat[..., W_DST]
         ok = (kind != 0) & (dst >= 0) & (dst < self.n_global)
         dshard = jnp.where(ok, dst // self.n_local, S)   # sentinel S
         order = jnp.argsort(dshard, stable=True)
-        sorted_flat = flat[order]
         dsh_sorted = dshard[order]
         bounds = jnp.searchsorted(
             dsh_sorted, jnp.arange(S + 1, dtype=dshard.dtype))
@@ -124,9 +142,17 @@ class ShardComm:
         qi = jnp.arange(Q, dtype=jnp.int32)
         pos = jnp.clip(starts[:, None] + qi[None, :], 0, max(M - 1, 0))
         fits = qi[None, :] < counts[:, None]             # [S, Q]
-        send = jnp.where(fits[..., None], sorted_flat[pos], 0)  # [S, Q, W]
-        recv = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
-                                  tiled=True)            # [S, Q, W]
+        # ONE destination-shard sort keys every plane's pack; planes ride
+        # the all_to_all at their narrow storage dtypes (pytree lowering),
+        # so the quota'd per-shard wire volume S·Q·Σdtype_bytes drops by
+        # the packing ratio on top of the all_gather->a2a reduction.
+        taken = plane_ops.take_records(
+            plane_ops.take_records(flat, order), pos)    # [S, Q, W]
+        send = plane_ops.where(fits, taken, 0)
+        recv = jax.tree.map(
+            lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0,
+                                         concat_axis=0, tiled=True),
+            send)                                        # [S, Q, W]
         return exchange.route(recv.reshape(-1, W), self.n_local,
                               self.inbox_cap, node_offset=self.node_offset)
 
@@ -272,7 +298,7 @@ class ShardedCluster:
             faults=faults_mod.none(cfg.n_nodes,
                                    cfg.resolved_partition_mode),
             inbox=exchange.empty_inbox(cfg.n_nodes, cfg.inbox_cap,
-                                       cfg.wire_words),
+                                       cfg.wire_layout),
             manager=self.manager.init(cfg, self.host_comm),
             model=self.model.init(cfg, self.host_comm) if self.model is not None else (),
             delivery=(delivery_mod.init(cfg, self.host_comm)
@@ -332,10 +358,8 @@ class ShardedCluster:
         from partisan_tpu.cluster import TraceRound
 
         specs = self._state_specs(state)
-        body = jax.shard_map(
-            self._round_shard, mesh=self.mesh,
-            in_specs=(specs,), out_specs=specs, check_vma=False,
-        )
+        body = _shard_map(self._round_shard, self.mesh,
+                          in_specs=(specs,), out_specs=specs)
         self._round_sharded = body
         self._step = jax.jit(body)
         self._steps = jax.jit(
@@ -343,11 +367,9 @@ class ShardedCluster:
                 lambda c, _: (body(c), None), s, None, length=k)[0],
             static_argnums=1)
         trace_specs = TraceRound(rnd=P(), sent=P(AXIS), dropped=P(AXIS))
-        tbody = jax.shard_map(
-            self._round_shard_traced, mesh=self.mesh,
-            in_specs=(specs,), out_specs=(specs, trace_specs),
-            check_vma=False,
-        )
+        tbody = _shard_map(self._round_shard_traced, self.mesh,
+                           in_specs=(specs,),
+                           out_specs=(specs, trace_specs))
         self._record = jax.jit(
             lambda s, k: jax.lax.scan(
                 lambda c, _: tbody(c), s, None, length=k),

@@ -200,22 +200,32 @@ def _match(spec: ProvSpec, msgs: Array) -> Array:
     return m
 
 
-def stamp(cfg: Config, spec: ProvSpec | None, emitted: Array,
-          gids: Array) -> Array:
+def stamp(cfg: Config, spec: ProvSpec | None, emitted,
+          gids: Array):
     """Append the provenance pair to a freshly emitted ``[n, E, W]``
     stack: ``prov_src`` = the emitting row's gid (every slot — empty
     slots are never read), ``prov_hop`` = the model's hop word for
     matching gossip records (0 otherwise).  Downstream queues copy the
     widened record verbatim, so the pair survives defers, delays and
-    retransmissions."""
+    retransmissions.  Plane-major stacks grow two planes (no minor-axis
+    concatenate); ``prov_src`` stays int32 (node ids), ``prov_hop``
+    stores int16 (the claim accumulator clamps depth far below 2^15 —
+    see types.NARROW_WIRE_DTYPES)."""
+    from partisan_tpu.ops import plane as plane_ops
+
     src = jnp.broadcast_to(gids.reshape(
         (-1,) + (1,) * (emitted.ndim - 2)).astype(jnp.int32),
         emitted.shape[:-1])
     if spec is not None and spec.hop_word is not None:
         hop = jnp.where(_match(spec, emitted),
-                        emitted[..., spec.hop_word], 0)
+                        emitted[..., spec.hop_word].astype(jnp.int32), 0)
     else:
         hop = jnp.zeros(emitted.shape[:-1], jnp.int32)
+    if plane_ops.is_planes(emitted):
+        return plane_ops.Planes(
+            emitted.ws + (src, hop.astype(
+                T.wire_dtype(cfg.msg_words + 1, msg_words=cfg.msg_words,
+                             provenance=True))))
     return jnp.concatenate(
         [emitted, src[..., None], hop[..., None]], axis=-1)
 
@@ -306,7 +316,11 @@ def record_round(cfg: Config, comm, spec: ProvSpec | None,
         # ---- first-delivery claims: min (hop, sender) packed key -----
         par_b = jnp.take_along_axis(parent, b, axis=1)          # [n, cap]
         claimable = cur & (par_b < 0)
-        ph = jnp.clip(inbox_data[..., hop_word(cfg)], 0, hop_max)
+        # hop rides an int16 plane under plane_major: widen BEFORE the
+        # clip — hop_max (2^26) wraps negative as int16 and clip(x, 0,
+        # -1) pins every hop to -1.
+        ph = jnp.clip(inbox_data[..., hop_word(cfg)].astype(jnp.int32),
+                      0, hop_max)
         psrc = jnp.clip(inbox_data[..., src_word(cfg)], 0,
                         cfg.n_nodes - 1)
         key = (ph << bits) | psrc

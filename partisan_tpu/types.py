@@ -119,3 +119,47 @@ class MsgKind(enum.IntEnum):
 # Convenience: number of payload words available given msg_words.
 def payload_words(msg_words: int) -> int:
     return msg_words - HDR_WORDS
+
+
+# ---------------------------------------------------------------------------
+# Bytes-first wire packing: per-word storage dtypes (ops/plane.py).
+# ---------------------------------------------------------------------------
+# In the plane-major layout each word plane is stored at the narrowest
+# dtype its documented value range permits, widening to int32 only at
+# the plane->wire interleave boundary — a pure-bandwidth cut on the
+# dominant [n, cap, ·] traffic.  Ranges (all asserted by construction):
+#
+# - W_KIND:    MsgKind values, max 45            -> int8
+# - W_CHANNEL: index into Config.channels (few)  -> int8
+# - W_TTL:     walk/relay hop budgets (arwl 6,
+#              relay_ttl 5; any sane config <2^15)-> int16
+# - W_FLAGS:   5 defined bits                    -> int8
+# - provenance hop word (msg_words + 1): tree depth; the claim
+#   accumulator already clamps depth to 2^(30 - gid_bits) (~2^13 at
+#   100k nodes), far under int16                 -> int16
+#
+# Words that carry node ids, unbounded counters or model payloads
+# (W_SRC, W_DST, W_CLOCK, W_LANE — packs lane | 22-bit epoch << 8 —
+# payload words, the provenance src, the latency birth round) stay
+# int32, so a widened record is bit-identical to the legacy int32 path
+# at ANY horizon.  The map is data, not code: narrowing another word is
+# a one-line change here, gated by the parity matrix in
+# tests/test_faults.py / test_latency.py / test_provenance.py.
+NARROW_WIRE_DTYPES = {
+    W_KIND: "int8",
+    W_CHANNEL: "int8",
+    W_TTL: "int16",
+    W_FLAGS: "int8",
+}
+
+
+def wire_dtype(i: int, msg_words: int | None = None,
+               provenance: bool = False):
+    """Storage dtype for wire word ``i`` (see NARROW_WIRE_DTYPES).
+    ``msg_words``/``provenance`` locate the trailing provenance hop
+    word, which narrows to int16."""
+    import numpy as np
+
+    if provenance and msg_words is not None and i == msg_words + 1:
+        return np.dtype("int16")
+    return np.dtype(NARROW_WIRE_DTYPES.get(i, "int32"))

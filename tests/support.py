@@ -46,6 +46,91 @@ def boot_hyparview(cl, settle=40):
     return cl.steps(staggered_join(cl, cl.init()), settle)
 
 
+def normalize_wire(tree):
+    """Map every plane-major record buffer (ops/plane.Planes pytree
+    node) in a state tree to its interleaved int32 wire tensor, leaving
+    everything else untouched — the layout normalizer the plane-vs-
+    legacy bit-parity tests compare through (word VALUES are the
+    contract; the storage layout is not)."""
+    import jax
+
+    from partisan_tpu.ops import plane as plane_ops
+
+    return jax.tree.map(
+        lambda x: plane_ops.interleave(x) if plane_ops.is_planes(x)
+        else x,
+        tree, is_leaf=plane_ops.is_planes)
+
+
+def assert_states_bitidentical(a, b, label=""):
+    """Every leaf of two (layout-normalized) state trees equal
+    bit-for-bit."""
+    import jax
+    import jax.tree_util as jtu
+    import numpy as np
+
+    la = jtu.tree_leaves_with_path(normalize_wire(a))
+    lb = jtu.tree_leaves_with_path(normalize_wire(b))
+    assert len(la) == len(lb), (label, len(la), len(lb))
+    for (pa, xa), (_pb, xb) in zip(la, lb):
+        xa = np.asarray(jax.device_get(xa))
+        xb = np.asarray(jax.device_get(xb))
+        where = label + jtu.keystr(pa)
+        assert xa.shape == xb.shape, (where, xa.shape, xb.shape)
+        assert np.array_equal(xa, xb), \
+            f"{where}: {np.sum(xa != xb)} of {xa.size} elements differ"
+
+
+def plane_parity_case(mk_cfg, *, drive=None, record_k=8, label=""):
+    """The plane-major <-> legacy-interleaved bit-parity harness: build
+    two clusters differing ONLY in ``Config.plane_major``, drive the
+    same scenario, and assert state (layout-normalized), send-path
+    trace, coverage and convergence are bit-identical.  ``mk_cfg(pm)``
+    returns the Config for one layout; ``drive(cl)`` runs the scenario
+    and returns the final state (default: hyparview bootstrap +
+    plumtree broadcast + crash/partition/link-drop mix)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.models.plumtree import Plumtree
+
+    def default_drive(cl):
+        n = cl.cfg.n_nodes
+        st = cl.init()
+        m = cl.manager.join_many(
+            cl.cfg, st.manager, list(range(1, n)), [0] * (n - 1))
+        st = cl.steps(st._replace(manager=m), 20)
+        st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 7))
+        alive = st.faults.alive.at[jnp.asarray([3, 11])].set(False)
+        part = st.faults.partition.at[jnp.arange(n // 2)].set(1)
+        st = st._replace(faults=st.faults._replace(
+            alive=alive, partition=part, link_drop=jnp.float32(0.1)))
+        st = cl.steps(st, 15)
+        st = st._replace(faults=st.faults._replace(
+            partition=jnp.zeros_like(part), link_drop=jnp.float32(0.0)))
+        return cl.steps(st, 10)
+
+    drive = drive or default_drive
+    outs = {}
+    for pm in (True, False):
+        cl = Cluster(mk_cfg(pm), model=Plumtree())
+        st = drive(cl)
+        st2, tr = cl.record(st, record_k)
+        cov = float(cl.model.coverage(st2.model, st2.faults.alive, 0))
+        outs[pm] = (st2, tr, cov)
+    st_p, tr_p, cov_p = outs[True]
+    st_l, tr_l, cov_l = outs[False]
+    assert_states_bitidentical(st_p, st_l, label or "plane_vs_legacy")
+    assert np.array_equal(np.asarray(tr_p.rnd), np.asarray(tr_l.rnd))
+    assert np.array_equal(np.asarray(tr_p.sent), np.asarray(tr_l.sent)), \
+        "send-path traces diverge between wire layouts"
+    assert np.array_equal(np.asarray(tr_p.dropped),
+                          np.asarray(tr_l.dropped))
+    assert cov_p == cov_l
+    return st_p, st_l
+
+
 def components(active, alive, partition=None):
     """Connected components of the overlay (undirected union of active
     views), host-side — the numpy BFS the device health plane's

@@ -264,3 +264,45 @@ def test_group_labels_out_of_range_raises():
     # inject_partition's groups path re-densifies and validates.
     f2 = faults_mod.inject_partition(f, list(range(4)), list(range(4, 8)))
     assert int(f2.partition.max()) <= faults_mod.GROUP_LABEL_MAX
+
+
+# ---------------------------------------------------------------------------
+# Plane-major <-> legacy-interleaved bit-parity (ISSUE 6): the narrow-
+# packed struct-of-planes pipeline must be indistinguishable from the
+# int32 interleaved layout in everything observable — state, send-path
+# trace, coverage — under the full fault mix.  Base wire width here;
+# tests/test_latency.py / test_provenance.py extend the matrix over the
+# trailing-word combos.
+# ---------------------------------------------------------------------------
+
+def _parity_cfg(pm, **kw):
+    from partisan_tpu.config import HyParViewConfig, PlumtreeConfig
+
+    kw.setdefault("partition_mode", "groups")
+    kw.setdefault("inbox_cap", 8)
+    return Config(n_nodes=64, seed=5, peer_service_manager="hyparview",
+                  msg_words=16, max_broadcasts=4,
+                  plane_major=pm, hyparview=HyParViewConfig(),
+                  plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4),
+                  **kw)
+
+
+def test_plane_parity_base_wire_fast_path():
+    """wire_words == msg_words, fast wire path (the bench hot path):
+    crashes + groups partition + link drop."""
+    from support import plane_parity_case
+
+    plane_parity_case(_parity_cfg, label="base_fast")
+
+
+def test_plane_parity_base_wire_generic_path():
+    """The generic wire path (interposition chain forces it) with
+    monotonic-shed backpressure traffic: queued-copy planes (delay
+    buffer) and the shed/fault composition stay bit-identical."""
+    from support import plane_parity_case
+
+    def mk(pm):
+        return _parity_cfg(pm, monotonic_shed=True, inbox_cap=4,
+                           egress_delay_ms=1_000)
+
+    plane_parity_case(mk, label="base_generic")

@@ -25,6 +25,7 @@ from partisan_tpu import metrics as metrics_mod
 from partisan_tpu import telemetry, trace
 from partisan_tpu import types as T
 from partisan_tpu.cluster import Cluster
+from partisan_tpu.models.anti_entropy import AntiEntropy
 from partisan_tpu.config import Config, PlumtreeConfig
 from partisan_tpu.ops import msg as msg_ops
 
@@ -389,3 +390,48 @@ def test_slo_breach_events_on_bus():
     # a generous SLO emits nothing
     assert telemetry.replay_latency_events(bus, snap,
                                            slo_rounds=100) == 0
+
+
+def test_plane_parity_latency_birth_word():
+    """Narrow-packing parity with the latency plane's trailing birth
+    word (wire_words = msg_words + 1): state, trace, histograms (state
+    leaves) bit-identical across the layouts, faults included."""
+    from support import plane_parity_case
+
+    def mk(pm):
+        return Config(n_nodes=64, seed=5, peer_service_manager="hyparview",
+                      msg_words=16, partition_mode="groups",
+                      max_broadcasts=4, inbox_cap=8, latency=True,
+                      plane_major=pm,
+                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+
+    plane_parity_case(mk, label="latency_word")
+
+
+def test_plane_parity_flight_recorder():
+    """The flight ring records the SAME interleaved wire tensors in
+    both layouts (the ring itself stores int32 — the one budgeted
+    interleave feeds it)."""
+    import numpy as np
+
+    from partisan_tpu import latency as latency_mod
+
+    def run(pm):
+        cfg = Config(n_nodes=24, seed=3, msg_words=12,
+                     peer_service_manager="fullmesh", latency=True,
+                     flight_rounds=4, plane_major=pm,
+                     inbox_cap=max(32, 24 + 8))
+        model = AntiEntropy()
+        cl = Cluster(cfg, model=model)
+        st = cl.init()
+        m = st.manager
+        for i in range(1, 24):
+            m = cl.manager.join(cfg, m, i, 0)
+        st = st._replace(manager=m,
+                         model=model.broadcast(st.model, 0, 0))
+        return latency_mod.flight_trace(cl.steps(st, 12).flight)
+
+    a, b = run(True), run(False)
+    assert np.array_equal(np.asarray(a.sent), np.asarray(b.sent))
+    assert np.array_equal(np.asarray(a.dropped), np.asarray(b.dropped))
+    assert np.array_equal(np.asarray(a.rounds), np.asarray(b.rounds))

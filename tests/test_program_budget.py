@@ -32,8 +32,9 @@ from partisan_tpu.models.plumtree import Plumtree
 
 
 def _cfg(n, width_operand, **kw):
+    kw.setdefault("msg_words", 16)
     return Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
-                  msg_words=16, partition_mode="groups",
+                  partition_mode="groups",
                   max_broadcasts=8, inbox_cap=16, timer_stagger=False,
                   width_operand=width_operand,
                   plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4),
@@ -224,6 +225,168 @@ def test_width_operand_sharded_parity():
 
     st_l, st_s = drive(local), drive(shard)
     _prefix_equal(st_l, st_s, 64, 64, "sharded")
+
+
+# ---------------------------------------------------------------------------
+# Fusion-regression guard: the one-interleave-per-round budget (ISSUE 6).
+# The plane-major pipeline carries message words as a struct of planes
+# end to end and ships the exchange as packed planes, so the round
+# program contains ZERO plane->wire interleaves (capture mode: exactly
+# ONE, for the layout-stable TraceRound.sent).  The legacy interleaved
+# layout re-stacks record minors throughout (every msg build + the
+# latency/provenance stamps).  Counting at the jaxpr level keeps the
+# layout win pinned on CPU between on-chip bench rounds.
+# ---------------------------------------------------------------------------
+
+def _iter_sub_jaxprs(params):
+    import jax.extend.core as jex_core
+
+    for v in params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if isinstance(x, jax.extend.core.ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, jex_core.Jaxpr):
+                yield x
+
+
+def count_wire_interleaves(jaxpr, widths) -> tuple[int, int]:
+    """(interleave_count, total_equations), recursing into cond/scan/
+    while sub-jaxprs.  An interleave is a concatenate or transpose
+    whose OUTPUT carries a record-width minor axis on an [n, slots, W]
+    (ndim >= 3) tensor — the wire-layout materialization signature.
+    ``widths`` covers msg_words..wire_words so pre- and post-stamp
+    stacks both count."""
+    n_int = 0
+    n_eqns = 0
+    for eqn in jaxpr.eqns:
+        n_eqns += 1
+        out = eqn.outvars[0].aval
+        if (eqn.primitive.name in ("concatenate", "transpose")
+                and getattr(out, "ndim", 0) >= 3
+                and out.shape[-1] in widths):
+            if eqn.primitive.name == "concatenate":
+                if eqn.params["dimension"] == out.ndim - 1:
+                    n_int += 1
+            else:
+                perm = eqn.params["permutation"]
+                if perm[-1] != len(perm) - 1:   # minor axis moved
+                    n_int += 1
+        for sub in _iter_sub_jaxprs(eqn.params):
+            si, se = count_wire_interleaves(sub, widths)
+            n_int += si
+            n_eqns += se
+    return n_int, n_eqns
+
+
+def _interleave_counts(cfg, capture=False):
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = cl.init()
+    fn = cl._round_traced if capture else cl._round
+    jaxpr = jax.make_jaxpr(fn)(st).jaxpr
+    widths = set(range(cfg.msg_words, cfg.wire_words + 1))
+    return count_wire_interleaves(jaxpr, widths)
+
+
+def test_one_interleave_per_round_budget():
+    """Plane-major plain round: ZERO wire interleaves (the exchange
+    ships packed planes); capture round: exactly ONE (TraceRound.sent).
+    The legacy layout visibly exceeds the budget, so the guard really
+    keys on the layout.
+
+    msg_words=17 keeps the guard's width window {17..wire_words}
+    disjoint from every other trailing dimension in the round
+    (inbox_cap=16 would alias msg_words=16 and false-positive on
+    unrelated [n, cap]-trailing transposes)."""
+    cfg = _cfg(64, True, msg_words=17)
+    n_plain, eq_plain = _interleave_counts(cfg)
+    assert n_plain == 0, \
+        f"plane-major round traces {n_plain} wire interleaves " \
+        f"(budget 0 outside capture; {eq_plain} equations total)"
+    n_cap, _ = _interleave_counts(cfg, capture=True)
+    assert n_cap == 1, \
+        f"capture round must interleave exactly once, got {n_cap}"
+
+    import dataclasses
+    legacy = dataclasses.replace(cfg, plane_major=False)
+    n_leg, eq_leg = _interleave_counts(legacy)
+    assert n_leg > 5, \
+        f"legacy layout should re-stack record minors throughout " \
+        f"(got {n_leg}; the guard is not keying on the layout)"
+
+
+def test_one_interleave_budget_with_trailing_words():
+    """The budget holds with the latency birth word and provenance pair
+    widening the wire (plane-major appends PLANES, never a minor-axis
+    concatenate)."""
+    cfg = _cfg(64, True, msg_words=17, latency=True, provenance=True)
+    n_plain, _ = _interleave_counts(cfg)
+    assert n_plain == 0, n_plain
+
+
+def test_one_interleave_budget_otp_stack():
+    """The budget holds for the OTP service stack too (rpc + monitor
+    over fullmesh): every record-emitting module must build through the
+    layout dispatch, not raw interleaved stacks — a single legacy
+    ``msg_ops.build(msg_words, ...)`` call site would show up here as a
+    minor-axis concatenate."""
+    from partisan_tpu.models.stack import Stack
+    from partisan_tpu.otp import monitor as mon_mod
+    from partisan_tpu.otp import rpc as rpc_mod
+
+    stack = Stack([rpc_mod.RpcService((lambda x: x + 1,)),
+                   mon_mod.MonitorService()])
+    cfg = Config(n_nodes=8, seed=13, msg_words=17, inbox_cap=48,
+                 timer_stagger=False)
+    cl = Cluster(cfg, model=stack)
+    st = cl.init()
+    jaxpr = jax.make_jaxpr(cl._round)(st).jaxpr
+    widths = set(range(cfg.msg_words, cfg.wire_words + 1))
+    n_int, _ = count_wire_interleaves(jaxpr, widths)
+    assert n_int == 0, \
+        f"OTP stack round traces {n_int} wire interleaves (budget 0)"
+
+
+def test_plane_major_width_operand_cross_parity():
+    """Layout x width-operand parity: a 32-prefix run of a PLANE-MAJOR
+    width-operand cluster is bit-identical (normalized state + trace)
+    to a native 32-node LEGACY-interleaved run — the two layout axes
+    compose."""
+    from support import assert_states_bitidentical
+
+    w, n_big = 32, 64
+    small = Cluster(_cfg(w, False, plane_major=False))
+    big = Cluster(_cfg(n_big, True, plane_major=True))
+    st_s = _drive_waves(small, w)
+    st_b = _drive_waves(big, w)
+
+    import jax.tree_util as jtu
+    from support import normalize_wire
+
+    ls = jtu.tree_leaves_with_path(normalize_wire(
+        st_s._replace(n_active=())))
+    lb = jtu.tree_leaves_with_path(normalize_wire(
+        st_b._replace(n_active=())))
+    assert len(ls) == len(lb)
+    for (pa, a), (_pb, b) in zip(ls, lb):
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        if a.shape != b.shape and a.ndim == b.ndim and a.ndim >= 1 \
+                and a.shape[0] == w and b.shape[0] == n_big:
+            b = b[:w]
+        assert np.array_equal(a, b), jtu.keystr(pa)
+
+    st_s2, tr_s = small.record(st_s, 8)
+    st_b2, tr_b = big.record(st_b, 8)
+    assert np.array_equal(np.asarray(tr_s.sent),
+                          np.asarray(tr_b.sent)[:, :w])
+    assert_states_bitidentical(
+        st_s2._replace(n_active=()),
+        jax.tree.map(lambda x: x[:w] if (getattr(x, "ndim", 0) >= 1 and
+                                         x.shape[0] == n_big) else x,
+                     normalize_wire(st_b2._replace(n_active=()))),
+        "post_record")
 
 
 def test_activate_requires_width_operand():

@@ -647,3 +647,33 @@ def test_bridge_forward_drain_under_provenance():
     src, payload = msgs[0]
     assert src == 1 and payload[:2] == [42, 7]
     assert len(payload) == 12 - T.HDR_WORDS
+
+
+def test_plane_parity_provenance_pair():
+    """Narrow-packing parity with the provenance pair (wire_words =
+    msg_words + 2; the hop word stores int16)."""
+    from support import plane_parity_case
+
+    def mk(pm):
+        return Config(n_nodes=64, seed=5, peer_service_manager="hyparview",
+                      msg_words=16, partition_mode="groups",
+                      max_broadcasts=4, inbox_cap=8, provenance=True,
+                      plane_major=pm,
+                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+
+    plane_parity_case(mk, label="prov_pair")
+
+
+def test_plane_parity_full_wire():
+    """Provenance pair + latency birth word together (wire_words =
+    msg_words + 3) — the widest wire the planes carry."""
+    from support import plane_parity_case
+
+    def mk(pm):
+        return Config(n_nodes=64, seed=5, peer_service_manager="hyparview",
+                      msg_words=16, partition_mode="groups",
+                      max_broadcasts=4, inbox_cap=8, provenance=True,
+                      latency=True, plane_major=pm,
+                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+
+    plane_parity_case(mk, label="full_wire")

@@ -36,6 +36,24 @@ def test_profile_phases_cli_smoke():
     assert bad.returncode != 0
 
 
+def test_profile_phases_layout_ab_smoke():
+    """--layout A/B (interleaved legacy vs plane-major): both layouts'
+    phase series run and the machine-readable stderr lines carry one
+    entry per (layout, phase)."""
+    out = _run("profile_phases.py", "--layout", "128", "route")
+    assert out.returncode == 0, out.stderr[-2000:]
+    series = [ln for ln in out.stderr.splitlines()
+              if ln.startswith("profile_phases,layout=")]
+    layouts = {ln.split(",")[1].split("=")[1] for ln in series}
+    assert layouts == {"interleaved", "plane"}, (layouts, out.stderr)
+    assert all("ms_per_iter=" in ln for ln in series)
+    # same phase set on both sides — the A/B is comparable
+    def phases(tag):
+        return {ln.split("phase=")[1].split(",")[0] for ln in series
+                if f"layout={tag}" in ln}
+    assert phases("plane") == phases("interleaved")
+
+
 def test_profile_round_cli_smoke():
     """Ablation profiler, smoke mode: one variant end-to-end (bootstrap
     + timed executions) at a tiny n."""

@@ -30,7 +30,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 K = 50
 
 
-def main(n: int) -> None:
+def main(n: int, plane_major: bool = True, tag: str = "") -> None:
     from partisan_tpu import faults as faults_mod
     from partisan_tpu.cluster import Cluster, ClusterState, Stats
     from partisan_tpu.config import Config, HyParViewConfig, PlumtreeConfig
@@ -42,7 +42,7 @@ def main(n: int) -> None:
     cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
                  msg_words=16, partition_mode="groups",
                  max_broadcasts=8, inbox_cap=16, emit_compact=32,
-                 timer_stagger=False,
+                 timer_stagger=False, plane_major=plane_major,
                  hyparview=HyParViewConfig(isolation_window_ms=25_000),
                  plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
     model = Plumtree()
@@ -79,14 +79,15 @@ def main(n: int) -> None:
 
     mstate, pstate, act = build_state()
     faults = faults_mod.none(n, cfg.resolved_partition_mode)
-    inbox0 = exchange.empty_inbox(n, cfg.inbox_cap, W)
+    inbox0 = exchange.empty_inbox(n, cfg.inbox_cap, cfg.wire_layout)
 
     def ctx_at(rnd):
         return RoundCtx(rnd=rnd, alive=faults.alive,
                         keys=rng.node_keys(cfg.seed, rnd, ids),
                         inbox=inbox0, faults=faults)
 
-    only = sys.argv[2] if len(sys.argv) > 2 else None
+    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
+    only = argv[1] if len(argv) > 1 else None
 
     def timed(label, fn, carry):
         if only and only not in label.lower():
@@ -107,6 +108,12 @@ def main(n: int) -> None:
             best = min(best, time.perf_counter() - t0)
         print(f"{label:34s} {best / K * 1e3:7.2f} ms/iter  "
               f"(compile {compile_s:.0f}s)", flush=True)
+        if tag:
+            # --layout A/B series: machine-readable per-phase line
+            print(f"profile_phases,layout={tag},n={n},"
+                  f"phase={label.replace(' ', '_')},"
+                  f"ms_per_iter={best / K * 1e3:.3f}",
+                  file=sys.stderr, flush=True)
 
     # 1. manager step, quiet inbox (the convergence-phase manager cost):
     #    consecutive rounds so the shuffle cadence fires its real 1/10.
@@ -178,8 +185,8 @@ def main(n: int) -> None:
     fill[livemask] = 3
     kinds = jnp.asarray(fill)
     dsts = jnp.asarray(rs.randint(0, n, size=(n, E)), jnp.int32)
-    base_em = msg_ops.build(W, kinds, ids[:, None],
-                            jnp.where(kinds != 0, dsts, -1))
+    base_em = msg_ops.build(cfg if cfg.plane_major else W, kinds,
+                            ids[:, None], jnp.where(kinds != 0, dsts, -1))
 
     def wire(c):
         em, acc = c
@@ -232,7 +239,12 @@ def main(n: int) -> None:
     timed("FULL round (active)", full, st_full)
 
 
-USAGE = "usage: profile_phases.py [n] [only]"
+USAGE = """usage: profile_phases.py [--layout] [n] [only]
+
+--layout: A/B the two wire layouts — interleaved legacy
+(Config.plane_major=False) vs plane-major — over every phase, emitting
+a machine-readable per-phase series on stderr
+(`profile_phases,layout=...,phase=...,ms_per_iter=...`)."""
 
 
 if __name__ == "__main__":
@@ -240,4 +252,11 @@ if __name__ == "__main__":
         print(USAGE)
         print(__doc__.strip())
     else:
-        main(int(sys.argv[1]) if len(sys.argv) > 1 else 32_768)
+        argv = [a for a in sys.argv[1:] if a != "--layout"]
+        layout_ab = "--layout" in sys.argv
+        size = int(argv[0]) if argv else 32_768
+        if layout_ab:
+            main(size, plane_major=False, tag="interleaved")
+            main(size, plane_major=True, tag="plane")
+        else:
+            main(size)
