@@ -30,6 +30,8 @@ src/partisan_peer_service.erl):
   :mod:`partisan_tpu.health` — the device-resident observability
   planes (counter ring; delivery-age histograms + flight recorder;
   topology snapshots + the one-scalar health digest)
+- :mod:`partisan_tpu.soak` — chunked long-horizon soak engine
+  (crash-safe checkpoint/resume + fault-storm timelines)
 - :mod:`partisan_tpu.parallel` — shard_map multi-device execution
 - :mod:`partisan_tpu.bridge` — Erlang port bridge (ETF + server)
 - :mod:`partisan_tpu.scenarios` — the five driver benchmark configs

@@ -17,63 +17,211 @@ Format: one ``.npz`` per checkpoint (leaf arrays + round number), plus
 ``latest``-by-round discovery over a directory, supporting the
 crash/restart cycle the reference's re-join path exercises
 (partisan_full_membership_strategy.erl load-from-disk at init).
+
+Crash-safety hardening (the soak engine's contract, soak.py):
+
+- **atomic writes** — every save lands in a same-directory temp file
+  first and is published with ``os.replace``, so a writer killed
+  mid-checkpoint can never leave a half-written ``.npz`` under the
+  canonical name (the reference's dets files get the same guarantee
+  from dets repair; an interrupted sim save must not poison the resume
+  path the minute-mark fault relies on, tools/MINUTE_FAULT.md),
+- **config fingerprint** — ``save(..., cfg=...)`` stores a digest of
+  the full Config (including the wire-word layout and storage dtypes,
+  which PR 6 made config-dependent) so a restore against a drifted
+  configuration fails loudly even when the leaf shapes happen to agree,
+- **round validation** — the state's round counter is stored beside the
+  leaves; ``restore`` cross-checks it against the restored ``rnd`` leaf
+  and (optionally) a caller-expected round,
+- **corruption detection** — a truncated or bit-flipped file raises
+  :class:`CheckpointError` with a clear message instead of a bare
+  zipfile/zlib traceback (numpy's zip container CRC-checks each member;
+  we surface those failures and the missing-member case uniformly).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import re
+import tempfile
+import zipfile
+import zlib
 
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+# Version 2 adds the fingerprint/round/wire-layout metadata; version 1
+# files (leaves only) remain restorable — their extra validation is
+# simply unavailable.
+FORMAT_VERSION = 2
+_COMPAT_VERSIONS = (1, 2)
 _NAME = re.compile(r"^ckpt_(\d+)\.npz$")
 
 
-def save(state, path: str | os.PathLike) -> None:
-    """Snapshot a state pytree to ``path`` (.npz)."""
+class CheckpointError(ValueError):
+    """A checkpoint could not be restored: corrupt/truncated file,
+    configuration drift, or a round/template mismatch."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The file itself is damaged (torn write, bit flip, not a zip).
+    Distinct from drift/mismatch because ``restore_latest`` may fall
+    back to an OLDER intact checkpoint on corruption — but never
+    across config drift (older files would mask the real problem)."""
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable digest of a Config — including the resolved wire layout
+    (word count + per-word storage dtypes), which determines every wire
+    buffer's shape and dtype.  Two configs with equal fingerprints
+    produce structurally interchangeable states; a mismatch means the
+    checkpoint was written under a different configuration and must not
+    be silently restored (the drift ``restore``'s shape check alone can
+    miss: e.g. a seed or cadence change keeps all shapes)."""
+    wire = cfg.wire_layout
+    if isinstance(wire, tuple):
+        wire_desc = ",".join(str(np.dtype(d)) for d in wire)
+    else:
+        wire_desc = f"int32x{wire}"
+    blob = f"{cfg!r}|wire={wire_desc}".encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def save(state, path: str | os.PathLike, cfg=None) -> None:
+    """Snapshot a state pytree to ``path`` (.npz), atomically.
+
+    The write goes to a same-directory temp file and is published with
+    ``os.replace``, so a crash mid-write never leaves a torn file at
+    ``path``.  Pass ``cfg`` to stamp the checkpoint with the config
+    fingerprint (validated by ``restore`` when it, too, is given the
+    config)."""
+    path = os.fspath(path)
     leaves = jax.tree.leaves(state)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
-    np.savez_compressed(path, version=FORMAT_VERSION,
-                        n_leaves=len(leaves), **arrays)
+    meta = {"version": FORMAT_VERSION, "n_leaves": len(leaves)}
+    rnd = getattr(state, "rnd", None)
+    if rnd is not None:
+        meta["rnd"] = np.int64(int(np.asarray(rnd)))
+    if cfg is not None:
+        meta["fingerprint"] = np.str_(config_fingerprint(cfg))
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".tmp.",
+        dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **meta, **arrays)
+            # Flush to stable storage BEFORE publishing: os.replace is
+            # atomic in the namespace, but an OS crash could otherwise
+            # still publish a name pointing at torn contents.
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
-def restore(path: str | os.PathLike, like):
+def _open_checked(path):
+    """np.load with the corruption cases mapped to CheckpointError."""
+    try:
+        return np.load(path)
+    except (OSError, ValueError, zipfile.BadZipFile, zlib.error) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is corrupt or truncated: {e}") from e
+
+
+def restore(path: str | os.PathLike, like, cfg=None,
+            expect_rnd: int | None = None):
     """Rebuild a checkpoint against the structural template ``like``
     (same treedef — e.g. ``cluster.init()``).  Shape/dtype mismatches
-    raise, catching config drift between save and restore."""
+    raise, catching config drift between save and restore; ``cfg``
+    additionally validates the stored config fingerprint, and
+    ``expect_rnd`` the stored round number.  Corrupt or truncated files
+    raise :class:`CheckpointError` (reading decompresses every member,
+    so a torn tail or bit flip surfaces here, not later)."""
     import jax.numpy as jnp
 
+    path = os.fspath(path)
     treedef = jax.tree.structure(like)
     tmpl = jax.tree.leaves(like)
-    with np.load(path) as z:
-        if int(z["version"]) != FORMAT_VERSION:
-            raise ValueError(f"checkpoint version {int(z['version'])} != "
-                             f"{FORMAT_VERSION}")
-        n = int(z["n_leaves"])
+    with _open_checked(path) as z:
+        if "version" not in z.files:
+            raise CheckpointError(
+                f"checkpoint {path!r} has no version field "
+                "(not a partisan_tpu checkpoint?)")
+        # Metadata members decompress on read: a bit flip confined to
+        # one of them must still surface as CheckpointError, not a raw
+        # zlib/zip traceback.
+        try:
+            version = int(z["version"])
+            stored_fp = (str(z["fingerprint"])
+                         if "fingerprint" in z.files else None)
+            n = int(z["n_leaves"])
+            stored_rnd = int(z["rnd"]) if "rnd" in z.files else None
+        except (KeyError, OSError, ValueError, zipfile.BadZipFile,
+                zlib.error) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r} is corrupt or truncated in its "
+                f"metadata: {e}") from e
+        if version not in _COMPAT_VERSIONS:
+            raise CheckpointError(
+                f"checkpoint version {version} not supported "
+                f"(expected one of {_COMPAT_VERSIONS})")
+        if cfg is not None and stored_fp is not None:
+            want = config_fingerprint(cfg)
+            if stored_fp != want:
+                raise CheckpointError(
+                    f"checkpoint {path!r} was written under a different "
+                    f"configuration (fingerprint {stored_fp[:12]}… != "
+                    f"{want[:12]}…) — refusing to restore across config "
+                    "drift")
         if n != len(tmpl):
-            raise ValueError(
+            raise CheckpointError(
                 f"checkpoint has {n} leaves, template has {len(tmpl)} "
                 f"(configuration changed since save?)")
         leaves = []
-        for i, t in enumerate(tmpl):
-            a = z[f"leaf_{i}"]
-            if a.shape != np.shape(t) or a.dtype != np.asarray(t).dtype:
-                raise ValueError(
-                    f"leaf {i}: checkpoint {a.shape}/{a.dtype} != template "
-                    f"{np.shape(t)}/{np.asarray(t).dtype}")
-            leaves.append(jnp.asarray(a))
-    return jax.tree.unflatten(treedef, leaves)
+        try:
+            for i, t in enumerate(tmpl):
+                a = z[f"leaf_{i}"]
+                if a.shape != np.shape(t) or a.dtype != np.asarray(t).dtype:
+                    raise CheckpointError(
+                        f"leaf {i}: checkpoint {a.shape}/{a.dtype} != "
+                        f"template {np.shape(t)}/{np.asarray(t).dtype}")
+                leaves.append(jnp.asarray(a))
+        except (KeyError, OSError, ValueError, zipfile.BadZipFile,
+                zlib.error) as e:
+            if isinstance(e, CheckpointError):
+                raise
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r} is corrupt or truncated while "
+                f"reading leaf {i}: {e}") from e
+    out = jax.tree.unflatten(treedef, leaves)
+    got_rnd = getattr(out, "rnd", None)
+    if got_rnd is not None:
+        got = int(np.asarray(got_rnd))
+        if stored_rnd is not None and stored_rnd != got:
+            raise CheckpointError(
+                f"checkpoint {path!r} round metadata {stored_rnd} "
+                f"disagrees with its rnd leaf {got} — file corrupt?")
+        if expect_rnd is not None and got != int(expect_rnd):
+            raise CheckpointError(
+                f"checkpoint {path!r} holds round {got}, caller "
+                f"expected round {int(expect_rnd)}")
+    return out
 
 
 # ---- step-numbered checkpoint directories ------------------------------
 
-def save_step(state, ckpt_dir: str | os.PathLike, rnd: int) -> str:
-    """Save as ``<dir>/ckpt_<round>.npz``; returns the path."""
+def save_step(state, ckpt_dir: str | os.PathLike, rnd: int,
+              cfg=None) -> str:
+    """Save as ``<dir>/ckpt_<round>.npz`` (atomic); returns the path."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(os.fspath(ckpt_dir), f"ckpt_{int(rnd)}.npz")
-    save(state, path)
+    save(state, path, cfg=cfg)
     return path
 
 
@@ -89,13 +237,28 @@ def steps(ckpt_dir: str | os.PathLike) -> list[int]:
     return sorted(out)
 
 
-def restore_latest(ckpt_dir: str | os.PathLike, like):
-    """Load the newest checkpoint, or None if the directory is empty —
-    the load-or-bootstrap decision of the reference's init
-    (partisan_full_membership_strategy.erl:289-330)."""
+def restore_latest(ckpt_dir: str | os.PathLike, like, cfg=None):
+    """Load the newest INTACT checkpoint, or None if the directory is
+    empty — the load-or-bootstrap decision of the reference's init
+    (partisan_full_membership_strategy.erl:289-330).
+
+    A corrupt newest file (a torn write published by an OS crash at
+    exactly the wrong moment) falls back to the next-older checkpoint
+    instead of permanently blocking resume; config drift or a round
+    mismatch still raises — every older file would carry the same
+    problem, and silently restoring stale pre-drift state would mask
+    it."""
     all_steps = steps(ckpt_dir)
     if not all_steps:
         return None
-    return restore(
-        os.path.join(os.fspath(ckpt_dir), f"ckpt_{all_steps[-1]}.npz"),
-        like)
+    last_err: CheckpointCorruptError | None = None
+    for rnd in reversed(all_steps):
+        try:
+            return restore(
+                os.path.join(os.fspath(ckpt_dir), f"ckpt_{rnd}.npz"),
+                like, cfg=cfg, expect_rnd=rnd)
+        except CheckpointCorruptError as e:
+            last_err = e
+    raise CheckpointCorruptError(
+        f"every checkpoint in {os.fspath(ckpt_dir)!r} is corrupt "
+        f"(newest failure: {last_err})")

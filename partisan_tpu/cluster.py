@@ -641,9 +641,12 @@ class Cluster:
         if self.manager is None:
             self.manager = managers_mod.get(self.cfg.peer_service_manager)
         # egress/ingress delay config keys install a send-path Delay
-        # stage after any user-supplied interposition chain
+        # stage after any user-supplied interposition chain.  The
+        # pre-wrap interposition is kept so rebuild() can reconstruct
+        # without double-wrapping the delay stage.
         from partisan_tpu import interpose as interpose_mod
 
+        self._user_interpose = self.interpose
         self.interpose = interpose_mod.config_delays(self.cfg,
                                                      self.interpose)
         self.comm = LocalComm(
@@ -746,6 +749,31 @@ class Cluster:
         round axis — the trace-orchestrator record mode (SURVEY.md §5.1:
         "trace = the per-round message tensor itself")."""
         return self._record(state, k)
+
+    def rebuild(self) -> "Cluster":
+        """A functionally identical Cluster with FRESH jitted programs
+        — the fresh-context factory for soak crash recovery: after a
+        worker crash the old executables keep failing (the poisoned
+        process context, tools/MINUTE_FAULT.md), so retries must
+        dispatch against newly built ones."""
+        return Cluster(self.cfg, manager=self.manager, model=self.model,
+                       interpose=self._user_interpose,
+                       donate=self.donate)
+
+    def run_chunked(self, state: ClusterState, k: int,
+                    chunk: int = 0) -> ClusterState:
+        """Run k rounds as a sequence of bounded scan executions with
+        the carry device-resident between them (soak.run) — the
+        long-horizon driver for relay-attached devices, where a single
+        execution past the ~60 s wall deadline kills the TPU worker
+        (tools/MINUTE_FAULT.md).  ``chunk=0`` sizes chunks adaptively
+        against the soak engine's wall budget; bit-identical to
+        ``steps(state, k)`` (tests/test_soak.py chunking parity).  For
+        crash retries, checkpoints and fault storms, drive a
+        ``soak.Soak`` directly."""
+        from partisan_tpu import soak as soak_mod
+
+        return soak_mod.run(self, state, k, chunk=chunk)
 
     def run_until(self, state: ClusterState, pred, max_rounds: int,
                   check_every: int = 1) -> tuple[ClusterState, int]:

@@ -252,6 +252,14 @@ def recover(faults: FaultState, node: int) -> FaultState:
     return faults._replace(alive=faults.alive.at[node].set(True))
 
 
+def crash_many(faults: FaultState, nodes) -> FaultState:
+    """Crash-stop a batch of nodes in ONE scatter — a storm's crash
+    batch is tens of victims, and per-node ``crash`` calls cost one
+    dispatch each on a relay-attached device."""
+    idx = jnp.asarray(nodes, jnp.int32)
+    return faults._replace(alive=faults.alive.at[idx].set(False))
+
+
 def inject_partition(faults: FaultState, group_a, group_b) -> FaultState:
     """Sever all edges between two node groups (inject_partition/2).
 

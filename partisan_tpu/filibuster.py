@@ -141,14 +141,8 @@ class Checker:
 
     # ---- one execution -------------------------------------------------
     def _execute(self, schedule: frozenset[Coord]) -> Execution:
-        np = self._np
-        n = self._cl.cfg.n_nodes
-        drops = np.zeros((self._total, n, self.sched_width), np.bool_)
-        for (r, s, e) in schedule:
-            if e >= self.sched_width:
-                raise ValueError(f"emit slot {e} >= sched_width "
-                                 f"{self.sched_width}; raise sched_width")
-            drops[r, s, e] = True
+        drops = schedule_drops(schedule, self._total,
+                               self._cl.cfg.n_nodes, self.sched_width)
         st = self._st0._replace(interpose=self._sched_state(drops))
         st, cap = self._cl.record(st, self.horizon)
         tr = trace_mod.from_capture(cap)
@@ -234,6 +228,32 @@ class Checker:
         return Result(passed=True, executions=executions, pruned=pruned,
                       counterexample=None, candidates=len(all_candidates),
                       base_trace=base.trace)
+
+
+def schedule_drops(schedule: Iterable[Coord], total: int, n: int,
+                   width: int):
+    """Compile a set of ``(absolute round, sender, emit slot)`` omission
+    coordinates into the ``bool[total, n, width]`` drops tensor an
+    ``interpose.OmissionSchedule`` executes — the translation between
+    the checker's schedule representation and the interposition layer
+    (a soak ``Omission`` action takes such a tensor plus its own
+    absolute ``start`` anchor).  Out-of-range coordinates raise: a
+    silently clipped omission would make the checker report a schedule
+    "tolerated" that it never actually ran."""
+    import numpy as np
+
+    drops = np.zeros((total, n, width), np.bool_)
+    for (r, s, e) in schedule:
+        if e >= width:
+            raise ValueError(f"emit slot {e} >= sched_width {width}; "
+                             "raise sched_width")
+        if not 0 <= r < total:
+            raise ValueError(
+                f"omission round {r} outside the schedule window "
+                f"[0, {total}) — size the schedule to cover the "
+                "execution horizon")
+        drops[r, s, e] = True
+    return drops
 
 
 def app_messages(ev: trace_mod.TraceEvent) -> bool:

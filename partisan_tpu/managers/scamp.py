@@ -216,7 +216,27 @@ class Scamp:
                     requeue = present & (gr >= 0)
                     p2 = jnp.where(take, views.remove(p, node), p)
                     iv2 = views.remove(iv, node) if v2 else iv
-                    reply = plane_ops.where(requeue, self_requeue, nomsg)
+                    # Not a holder: forward the removal as a TTL-bounded
+                    # walk to one random member.  The leaver gossips to
+                    # its OUT-view, but the holders of its id are its
+                    # IN-view — two sets that can be disjoint, in which
+                    # case a holders-only wave (re-gossip strictly "when
+                    # present", v1 :239-262) dies on arrival and the
+                    # removal never reaches anyone who actually holds
+                    # it.  The reference does not strand removals this
+                    # way: its remove_subscription rides the periodic
+                    # membership gossip until it lands.  The walk is the
+                    # bounded sim analogue — same hop budget as the
+                    # subscription walks, so circulation dies with the
+                    # TTL and each holder re-injects at most once
+                    # (taking a removal makes it a non-holder).
+                    nxt = views.pick_one(p, k2, exclude=jnp.stack([node]))
+                    fwd_ok = ~present & (ttl > 0) & (nxt >= 0)
+                    fwd = msg.at[T.W_DST].set(nxt).at[T.W_SRC].set(me) \
+                             .at[T.W_TTL].set(ttl - 1)
+                    reply = plane_ops.where(
+                        requeue, self_requeue,
+                        plane_ops.where(fwd_ok, fwd, nomsg))
                     return (p2, jnp.where(present, iv2, iv),
                             fs, jnp.where(take, node, gr), reply)
 
@@ -274,10 +294,13 @@ class Scamp:
                 lambda d: mk(T.MsgKind.SCAMP_SUBSCRIPTION, d, ttl=_WALK_TTL,
                              payload=(fan_sub, jnp.int32(0))))(fan_dst)
 
-            # ---- removal gossip (v1 :247-255): to the pre-scan view ----
+            # ---- removal gossip (v1 :247-255): to the pre-scan view,
+            # with the walk hop budget so non-holders downstream can
+            # keep forwarding it toward the in-view (b_unsubscribe) ----
             rm_dst = jnp.where(gossip_rm >= 0, partial, -1)
             fanout_rm = jax.vmap(
                 lambda d: mk(T.MsgKind.SCAMP_UNSUBSCRIBE, d,
+                             ttl=_WALK_TTL,
                              payload=(gossip_rm,)))(rm_dst)
 
             # ---- graceful leave ---------------------------------------
@@ -301,10 +324,12 @@ class Scamp:
                         cfg, kd, me, jnp.where(leaving, d, -1),
                         payload=(me, r)))(kind_lv, in_view2, repl)
             else:
-                # v1 leave (:122-142): gossip remove_subscription(self).
+                # v1 leave (:122-142): gossip remove_subscription(self),
+                # with the walk hop budget (see b_unsubscribe).
                 fanout_lv = jax.vmap(
                     lambda d: mk(T.MsgKind.SCAMP_UNSUBSCRIBE,
                                  jnp.where(leaving, d, -1),
+                                 ttl=_WALK_TTL,
                                  payload=(me,)))(partial2)
 
             partial2 = jnp.where(leaving, views.EMPTY, partial2)

@@ -885,6 +885,76 @@ def config6_echo(n=2, sizes_kb=(1024, 2048, 4096, 8192),
             "measured_runs": n_runs, "rows": rows}
 
 
+def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200):
+    """Long-horizon soak (ROADMAP item 4): a repeating fault storm —
+    iid link drop → heal → crash batch → full partition → heal+revive →
+    churn ticks → heal — driven for thousands of rounds through the
+    chunked soak engine (soak.py): every execution bounded under the
+    minute-mark wall (tools/MINUTE_FAULT.md), the carry device-resident
+    between chunks, checkpoints at chunk boundaries, worker crashes
+    retried from the last checkpoint, and the health digest polled per
+    chunk (one int32) as the convergence signal.  Per-chunk rows go to
+    stderr as JSON lines (``kind: soak_chunk``); the stdout object
+    carries the engine's recovery/breach accounting."""
+    from partisan_tpu import health as health_mod
+    from partisan_tpu import soak as soak_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config
+    from partisan_tpu.models.plumtree import Plumtree
+
+    n = max(n, 64)
+
+    def mk():
+        return Cluster(_metrics_cfg(Config(
+            n_nodes=n, seed=7, peer_service_manager="hyparview",
+            msg_words=16, partition_mode="groups",
+            health=K_PROG, health_ring=512,
+            emit_compact=32 if n > 4096 else 0)), model=Plumtree())
+
+    cl = mk()
+    st = _boot_overlay(cl, n)
+    start = int(jax.device_get(st.rnd))
+    p = storm_period
+    storm = soak_mod.Storm(events=(
+        (0, soak_mod.LinkDrop(0.2)),
+        (p * 2 // 10, soak_mod.Heal()),
+        (p * 3 // 10, soak_mod.CrashBatch(frac=0.02)),
+        (p * 5 // 10, soak_mod.Partition()),
+        (p * 7 // 10, soak_mod.Heal(revive=True)),
+        (p * 8 // 10, soak_mod.Churn(0.01, 0.01)),
+        (p * 85 // 100, soak_mod.Churn(0.01, 0.01)),
+        (p * 9 // 10, soak_mod.Heal(revive=True)),
+    ), start=start, period=p)
+    # Seed the factory with the booted (compile-warm) cluster: the
+    # engine's first _cluster() reuses it; only a post-crash
+    # fresh-context rebuild pays mk() again.
+    warm = [cl]
+    eng = soak_mod.Soak(
+        make_cluster=lambda: warm.pop() if warm else mk(), storm=storm,
+        invariants=[soak_mod.conservation()],
+        cfg=soak_mod.SoakConfig(checkpoint_dir=ckpt_dir,
+                                checkpoint_every=10 * K_PROG))
+    t0 = time.perf_counter()
+    res = eng.run(st, rounds=rounds)
+    wall = time.perf_counter() - t0
+    import json as _json
+    import sys as _sys
+
+    for row in res.chunks:
+        print(_json.dumps({"kind": "soak_chunk", "config": 7, **row}),
+              file=_sys.stderr)
+    _emit_metrics(cl.cfg, res.state, 7)
+    digest = health_mod.digest(res.state)
+    return {"config": 7, "n": n, "rounds": res.rounds,
+            "chunks": len(res.chunks), "programs": res.programs,
+            "retries": res.retries, "breaches": res.breaches,
+            "storm_period": p,
+            "wall_s": round(wall, 1),
+            "rounds_per_sec": round(res.rounds / max(wall, 1e-9), 1),
+            "components": health_mod.digest_components(digest),
+            "healthy": health_mod.healthy(digest)}
+
+
 # ---------------------------------------------------------------------------
 
 ALL = {
@@ -894,9 +964,15 @@ ALL = {
     4: config4_scamp_churn,
     5: config5_causal_crash,
     6: config6_echo,
+    7: config7_soak,
 }
 
-DEFAULT_SIZES = {1: 16, 2: 1000, 3: 10_000, 4: 10_000, 5: 100_000, 6: 2}
+DEFAULT_SIZES = {1: 16, 2: 1000, 3: 10_000, 4: 10_000, 5: 100_000, 6: 2,
+                 7: 10_000}
+
+# Scenarios excluded from run_all's default sweep (run them with
+# --only/--soak): the soak is hours of simulated time by design.
+OPT_IN = frozenset({7})
 
 
 def run_all(scale: float = 1.0, only=None) -> list[dict]:
@@ -904,8 +980,14 @@ def run_all(scale: float = 1.0, only=None) -> list[dict]:
     for i, fn in ALL.items():
         if only and i not in only:
             continue
+        if not only and i in OPT_IN:
+            continue
         if i == 6:
             out.append(fn(num_messages=max(50, int(1000 * scale))))
+            continue
+        if i == 7:
+            out.append(fn(n=max(64, int(DEFAULT_SIZES[7] * scale)),
+                          rounds=max(400, int(2000 * scale))))
             continue
         n = max(8, int(DEFAULT_SIZES[i] * scale))
         out.append(fn(n=n))
@@ -939,6 +1021,18 @@ if __name__ == "__main__":
                          "in the scan carry) and emit redundancy ratio "
                          "/ tree depth / coverage round to stderr as "
                          "JSON lines (stdout is unchanged)")
+    ap.add_argument("--soak", action="store_true",
+                    help="run the long-horizon soak scenario (config 7) "
+                         "only: a repeating fault storm driven through "
+                         "the chunked soak engine — bounded executions, "
+                         "checkpoints at chunk boundaries, crash "
+                         "retry/restore, health digest per chunk "
+                         "(equivalent to --only 7)")
+    ap.add_argument("--soak-rounds", type=int, default=2000,
+                    help="soak horizon in rounds (with --soak)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="persist soak checkpoints here (atomic, "
+                         "fingerprinted; with --soak)")
     args = ap.parse_args()
     METRICS = METRICS or args.metrics
     LATENCY = LATENCY or args.latency
@@ -947,5 +1041,11 @@ if __name__ == "__main__":
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    for r in run_all(scale=args.scale, only=args.only):
-        print(json.dumps(r), flush=True)
+    if args.soak:
+        print(json.dumps(config7_soak(
+            n=max(64, int(DEFAULT_SIZES[7] * args.scale)),
+            rounds=args.soak_rounds, ckpt_dir=args.ckpt_dir)),
+            flush=True)
+    else:
+        for r in run_all(scale=args.scale, only=args.only):
+            print(json.dumps(r), flush=True)

@@ -1,0 +1,788 @@
+"""Long-horizon soak engine: chunked scan orchestration, crash-safe
+checkpoint/resume, and cross-chunk fault-storm schedules.
+
+The reference's robustness evidence is long-running CT suites cycling
+crash/partition/churn (partisan_SUITE.erl groups :214-315) plus
+Filibuster's deterministic schedule replay (ATC'19, PAPERS.md).  The
+sim's equivalent was capped at a few hundred rounds: a single
+``lax.scan`` execution that runs past the relay's per-execution wall
+deadline kills the TPU worker (the minute-mark fault,
+tools/MINUTE_FAULT.md), and the crash poisons the whole process — every
+later dispatch fails, and the post-crash worker runs ~20x degraded for
+a while.  This module turns "hours of simulated time" into a sequence
+of bounded XLA executions with the carry kept device-resident between
+them, plus the recovery machinery the wall fault demands:
+
+**Chunked scan orchestration.**  ``run`` / ``Soak.run`` advance a state
+by k rounds as chunks of at most ``chunk_cap`` (default 1000 — the
+measured-safe execution length), each chunk one ``cluster.steps`` scan.
+Chunking is PURE COMPOSITION of the same round function, so the result
+is bit-identical to one monolithic ``cluster.steps(state, k)`` — a test
+invariant (tests/test_soak.py), not an aspiration.  Chunk sizes adapt:
+the engine measures per-round wall cost and sizes the next chunk toward
+``chunk_target_s`` (default 15 s — well under the ~60 s horizon),
+quantized to a 1-2-5 ladder so the number of distinct scan programs
+stays O(log cap) (scan-length changes recompile the round at full
+width — the round-2 program-discipline lesson).
+
+**Crash-safe execution.**  Every chunk dispatch is guarded: a
+``jax.errors.JaxRuntimeError`` (worker crash) triggers
+retry-with-backoff — cool down (doubling), rebuild the cluster through
+the ``make_cluster`` factory (fresh jitted programs; on a real
+deployment a fresh process context), restore the last checkpoint, and
+replay forward.  Replay is deterministic because storm actions are
+pure functions of (state, round): rewinding to the checkpoint round
+re-derives the identical trajectory.  A retried chunk whose per-round
+cost jumps ``degraded_factor``x over the pre-crash baseline is treated
+as a degraded worker (MINUTE_FAULT: ~20x measured post-crash): the
+engine logs it, extends the cool-down and rebuilds again.  Checkpoints
+are host-side snapshots at chunk boundaries, always kept in memory and
+additionally persisted (atomically, config-fingerprinted) via
+``checkpoint.save_step`` when ``checkpoint_dir`` is set — so a soak
+survives both in-process worker crashes and whole-process restarts
+(``resume=True`` picks up the newest on-disk checkpoint).
+
+**Fault-storm schedules.**  A :class:`Storm` is a declarative timeline
+of (round offset, action) pairs — iid link drop, crash batches,
+partitions, heals, churn ticks, filibuster omission schedules, or
+arbitrary pure scripts — keyed by ABSOLUTE round and optionally
+repeating with a period.  Actions apply at chunk boundaries (the chunk
+sizer never crosses an event round), and the boundary protocol makes
+resume exact: a checkpoint at round r holds the state BEFORE round-r
+actions, and any resume at r (in-process retry or fresh-process
+restart) re-applies ``due(r)`` before stepping — so a resumed run
+replays the identical storm, bit for bit.
+
+**Invariants & the black box.**  Per-chunk invariant checks (e.g. the
+conservation law ``emitted == delivered + dropped``, or the health
+digest's one-component bit) run at every boundary; a breach logs a
+``partisan.soak.invariant_breach`` event and dumps the flight recorder
+(decoded to a replayable trace) plus metrics/latency/health/provenance
+snapshots to ``dump_dir`` — the post-mortem artifacts for "what broke
+at round 50,000".  The health digest is polled per chunk (one int32
+transfer) into the chunk log.
+
+Everything the engine does host-side lands in ``SoakResult.log`` as
+self-describing dicts; ``telemetry.replay_soak_events`` turns them into
+``partisan.soak.*`` bus events, and ``tools/soak_report.py`` exports
+them as JSON lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from partisan_tpu import checkpoint as checkpoint_mod
+from partisan_tpu import faults as faults_mod
+
+# Chunk-size quantization ladder (1-2-5 decades up to the minute-mark
+# hard cap): every adaptive chunk length is drawn from here, so a long
+# soak compiles at most ~10 distinct scan programs instead of one per
+# novel length.  Event/boundary clipping may still produce off-ladder
+# lengths, but storm gaps repeat with the storm period, so those
+# programs amortize too.
+CHUNK_LADDER = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000)
+
+
+def _ladder_floor(limit: float) -> int:
+    """Largest ladder chunk <= limit (>= 1)."""
+    best = 1
+    for c in CHUNK_LADDER:
+        if c <= limit:
+            best = c
+    return best
+
+
+def _sync(state) -> int:
+    """True execution barrier (scenarios._sync): a scalar device->host
+    transfer only materializes when the producing program finished —
+    block_until_ready does not reliably block on the relay backend.
+    This is also where an in-flight worker crash surfaces."""
+    return int(jax.device_get(state.rnd))
+
+
+# ---------------------------------------------------------------------------
+# Storm actions: pure, absolute-round-keyed state transforms
+# ---------------------------------------------------------------------------
+
+class Action:
+    """A storm action: ``apply(cluster, state, rnd) -> state``.  MUST be
+    a pure function of its arguments (all randomness through the
+    counter-based fault hashes keyed by (cfg.seed, rnd)) — resume
+    correctness depends on replaying the identical transform."""
+
+    def apply(self, cluster, state, rnd: int):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkDrop(Action):
+    """Set the iid per-edge drop probability (0.0 clears it)."""
+
+    p: float
+
+    def apply(self, cluster, state, rnd):
+        import jax.numpy as jnp
+
+        return state._replace(faults=state.faults._replace(
+            link_drop=jnp.float32(self.p)))
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashBatch(Action):
+    """Crash-stop a deterministic batch: explicit ``nodes``, or a
+    ``frac`` of currently-alive nodes drawn by the counter-based fault
+    hash keyed on (cfg.seed, rnd, salt, node) — same replay discipline
+    as the edge faults, so a resumed run crashes the same victims."""
+
+    frac: float = 0.0
+    nodes: tuple[int, ...] = ()
+    salt: int = 101
+
+    def apply(self, cluster, state, rnd):
+        import jax.numpy as jnp
+
+        f = state.faults
+        if self.nodes:
+            return state._replace(faults=faults_mod.crash_many(
+                f, list(self.nodes)))
+        n = f.alive.shape[0]
+        ids = jnp.arange(n, dtype=jnp.int32)
+        die = faults_mod.hash_bernoulli(
+            faults_mod.edge_hash(cluster.cfg.seed, jnp.int32(rnd),
+                                 self.salt, ids, ids),
+            self.frac)
+        return state._replace(faults=f._replace(alive=f.alive & ~die))
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition(Action):
+    """Full split of the id space (groups mode expresses only full
+    splits): ``at`` is the boundary id — [0, at) vs [at, n).  ``at=0``
+    splits at n//2."""
+
+    at: int = 0
+
+    def apply(self, cluster, state, rnd):
+        n = cluster.cfg.n_nodes
+        at = self.at or n // 2
+        return state._replace(faults=faults_mod.inject_partition(
+            state.faults, list(range(at)), list(range(at, n))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Heal(Action):
+    """Clear partitions and link drop (crash state persists unless
+    ``revive`` — dead nodes rejoining is churn's job, not heal's)."""
+
+    revive: bool = False
+
+    def apply(self, cluster, state, rnd):
+        import jax.numpy as jnp
+
+        f = faults_mod.resolve_partition(state.faults)
+        f = f._replace(link_drop=jnp.float32(0.0))
+        if self.revive:
+            f = f._replace(alive=jnp.ones_like(f.alive))
+        return state._replace(faults=f)
+
+
+@dataclasses.dataclass(frozen=True)
+class Churn(Action):
+    """One birth/death churn tick (faults.churn_step — pure in
+    (cfg.seed, rnd)).  Repeat it with a short storm period for
+    sustained churn."""
+
+    death_p: float
+    birth_p: float
+
+    def apply(self, cluster, state, rnd):
+        import jax.numpy as jnp
+
+        return state._replace(faults=faults_mod.churn_step(
+            state.faults, cluster.cfg.seed, jnp.int32(rnd),
+            self.death_p, self.birth_p))
+
+
+@dataclasses.dataclass(frozen=True)
+class Omission(Action):
+    """Install a filibuster-style omission schedule mid-soak: rows of
+    ``drops`` apply at absolute rounds ``start + i``.  The cluster must
+    have been BUILT with a bare ``interpose.OmissionSchedule`` (the
+    schedule tensor is a state leaf, but its window anchor and the
+    apply() program are jit-static), so this action RE-ENCODES its
+    absolute-round drops into the builder's frame — row ``start + i``
+    lands at builder row ``start + i - builder.start`` — and MERGES
+    (ORs) them into the installed schedule, so a later Omission never
+    erases an earlier one's still-pending rows (and replaying the same
+    action on resume is idempotent).  Drops that fall outside the
+    builder's window, or a sender/slot shape mismatch, raise instead
+    of silently dropping nothing."""
+
+    drops: Any            # host bool[T, n, E]
+    start: int = 0
+
+    def apply(self, cluster, state, rnd):
+        from partisan_tpu import interpose as interpose_mod
+
+        sched = cluster.interpose
+        if not isinstance(sched, interpose_mod.OmissionSchedule):
+            raise ValueError(
+                "Omission needs the Cluster built with a bare "
+                "interpose.OmissionSchedule interposition (got "
+                f"{type(sched).__name__}) — its window anchors the "
+                "compiled schedule reads")
+        old = state.interpose
+        drops = np.asarray(self.drops, np.bool_)
+        if drops.shape[1:] != tuple(old.shape[1:]):
+            raise ValueError(
+                f"Omission drops are {drops.shape[1:]} per round, the "
+                f"cluster's schedule is {tuple(old.shape[1:])} — build "
+                "the Cluster with an OmissionSchedule of the same "
+                "sender/slot width")
+        n_rows = old.shape[0] - 1     # last row is the all-pass pad
+        off = self.start - sched.start
+        new = np.array(jax.device_get(old), np.bool_, copy=True)
+        for i in range(drops.shape[0]):
+            if not drops[i].any():
+                continue
+            row = off + i
+            if not 0 <= row < n_rows:
+                raise ValueError(
+                    f"Omission drops at absolute round {self.start + i} "
+                    f"fall outside the cluster schedule's window "
+                    f"[{sched.start}, {sched.start + n_rows}) — size "
+                    "the builder's OmissionSchedule to cover the soak "
+                    "horizon")
+            new[row] |= drops[i]
+        import jax.numpy as jnp
+
+        return state._replace(interpose=jnp.asarray(new))
+
+
+@dataclasses.dataclass(frozen=True)
+class Script(Action):
+    """Escape hatch: ``fn(cluster, state, rnd) -> state``.  The caller
+    owns the purity obligation (see Action)."""
+
+    fn: Callable[[Any, Any, int], Any]
+
+    def apply(self, cluster, state, rnd):
+        return self.fn(cluster, state, rnd)
+
+
+@dataclasses.dataclass(frozen=True)
+class Storm:
+    """A declarative fault timeline: ``events = ((offset, action),
+    ...)`` with offsets relative to ``start``; with ``period`` > 0 the
+    whole timeline repeats every ``period`` rounds (offsets should fit
+    inside one period).  All scheduling is by ABSOLUTE round —
+    ``due(rnd)`` is a pure function, so a resumed run replays the
+    identical storm."""
+
+    events: tuple[tuple[int, Action], ...]
+    start: int = 0
+    period: int = 0
+
+    def __post_init__(self):
+        offs = [off for off, _ in self.events]
+        if any(o < 0 for o in offs):
+            raise ValueError(f"negative storm offsets: {offs}")
+        if self.period and max(offs, default=0) >= self.period:
+            raise ValueError(
+                f"storm offsets {offs} must fit inside period "
+                f"{self.period} (an offset >= period would collide "
+                "with the next cycle's images)")
+
+    def due(self, rnd: int) -> list[Action]:
+        """Actions firing at exactly absolute round ``rnd``, in
+        timeline order."""
+        out = []
+        for off, action in self.events:
+            at = self.start + off
+            if self.period:
+                if rnd >= at and (rnd - at) % self.period == 0:
+                    out.append(action)
+            elif rnd == at:
+                out.append(action)
+        return out
+
+    def next_after(self, rnd: int) -> int | None:
+        """Smallest absolute event round strictly greater than
+        ``rnd`` (None when the timeline is exhausted)."""
+        best = None
+        for off, _ in self.events:
+            at = self.start + off
+            if self.period:
+                if rnd < at:
+                    nxt = at
+                else:
+                    k = (rnd - at) // self.period + 1
+                    nxt = at + k * self.period
+            else:
+                nxt = at if rnd < at else None
+            if nxt is not None and (best is None or nxt < best):
+                best = nxt
+        return best
+
+
+# ---------------------------------------------------------------------------
+# Invariants
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Invariant:
+    """A per-chunk check: ``check(cluster, state) -> (ok, info)``."""
+
+    name: str
+    check: Callable[[Any, Any], tuple[bool, dict]]
+
+
+def conservation() -> Invariant:
+    """The round engine's conservation law: every emitted event message
+    is delivered or accounted as dropped (Stats reconciliation)."""
+    def check(cluster, state):
+        s = jax.device_get(state.stats)
+        e, d, dr = int(s.emitted), int(s.delivered), int(s.dropped)
+        return e == d + dr, {"emitted": e, "delivered": d, "dropped": dr}
+    return Invariant("conservation", check)
+
+
+def digest_healthy() -> Invariant:
+    """Health-digest check (requires Config.health > 0): the packed
+    one-scalar digest must be valid and report ONE component — the
+    "overlay re-merged" half of the soak suite's heal assertions.
+    Vacuously true when the plane is off or no snapshot landed yet."""
+    def check(cluster, state):
+        if getattr(state, "health", ()) == ():
+            return True, {"health": "off"}
+        from partisan_tpu import health as health_mod
+
+        word = health_mod.digest(state)
+        dec = health_mod.decode_digest(word)
+        if not dec["valid"]:
+            return True, {"valid": False}
+        return bool(dec["one_component"]), dec
+    return Invariant("digest_one_component", check)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SoakConfig:
+    """Engine knobs.  Defaults encode the measured minute-mark envelope
+    (tools/MINUTE_FAULT.md): ~15 s per execution, never above 1000
+    rounds per scan."""
+
+    chunk_cap: int = 1000         # hard per-execution round cap
+    chunk_target_s: float = 15.0  # wall-time budget a chunk is sized to
+    chunk_init: int = 100         # first chunk (before any measurement)
+    chunk_fixed: int = 0          # >0: disable adaptation, always this
+    #                               size (the parity-test mode)
+    checkpoint_every: int = 0     # min rounds between checkpoints
+    #                               (0 = every chunk boundary)
+    checkpoint_dir: str | None = None   # persist checkpoints here
+    #                               (atomic, fingerprinted); None =
+    #                               in-memory host snapshots only
+    max_retries: int = 3          # crash retries per chunk
+    cooldown_s: float = 1.0       # base backoff, doubles per attempt
+    degraded_factor: float = 20.0  # retried-chunk per-round slowdown
+    #                               treated as a degraded worker
+    dump_dir: str | None = None   # invariant-breach black-box dumps
+    stop_on_breach: bool = False  # abort the soak on a breach
+
+
+@dataclasses.dataclass
+class SoakResult:
+    state: Any
+    rounds: int                   # rounds actually advanced
+    chunks: list[dict]            # per-chunk rows (round, k, wall, ...)
+    log: list[dict]               # recovery/breach event log
+    retries: int
+    breaches: int
+    programs: int                 # distinct chunk lengths executed
+
+    def healthy(self) -> bool:
+        return self.breaches == 0
+
+
+@dataclasses.dataclass
+class Soak:
+    """The orchestrator.  ``make_cluster()`` must build a functionally
+    identical Cluster each call (fresh jitted programs — the
+    fresh-context rebuild after a worker crash); ``storm``/
+    ``invariants`` are optional layers; ``step_fn``/``sleep_fn`` are
+    test seams (fault injection without a real TPU, no real sleeps in
+    CI)."""
+
+    make_cluster: Callable[[], Any]
+    storm: Storm | None = None
+    invariants: Sequence[Invariant] = ()
+    cfg: SoakConfig = dataclasses.field(default_factory=SoakConfig)
+    bus: Any = None               # telemetry.Bus (optional, live events)
+    step_fn: Callable[[Any, Any, int], Any] | None = None
+    sleep_fn: Callable[[float], None] = time.sleep
+
+    def __post_init__(self):
+        self._cl = None
+        self._hold = None         # host-side snapshot (np leaves)
+        self._hold_rnd = -1
+        self._seen_breaches: set[tuple[int, str]] = set()
+
+    # ---- pieces -------------------------------------------------------
+    def _cluster(self):
+        if self._cl is None:
+            self._cl = self.make_cluster()
+        return self._cl
+
+    def _log_event(self, log: list, kind: str, **fields) -> None:
+        entry = {"kind": kind, **fields}
+        log.append(entry)
+        if self.bus is not None:
+            from partisan_tpu import telemetry as telemetry_mod
+
+            telemetry_mod.replay_soak_events(self.bus, [entry])
+
+    def _checkpoint(self, state, rnd: int) -> None:
+        """Host snapshot (always) + atomic on-disk save (when a dir is
+        configured).  Taken BEFORE round-``rnd`` storm actions apply —
+        the resume protocol's invariant (module docstring)."""
+        self._hold = jax.device_get(state)
+        self._hold_rnd = rnd
+        if self.cfg.checkpoint_dir is not None:
+            checkpoint_mod.save_step(self._hold, self.cfg.checkpoint_dir,
+                                     rnd, cfg=self._cluster().cfg)
+
+    def _restore(self, log: list, *, fresh_context: bool) -> tuple[Any, int]:
+        """Rebuild state from the last checkpoint; optionally discard
+        the (possibly poisoned) cluster so the next dispatch runs
+        against freshly built programs."""
+        if self._hold is None:
+            raise RuntimeError("no checkpoint to restore from")
+        if fresh_context:
+            self._cl = None
+        state = jax.device_put(self._hold)
+        # Mid-run restores always come from the in-memory snapshot (the
+        # on-disk copy, when a dir is set, is the same bytes but is only
+        # read by a fresh-process resume) — the event says so honestly.
+        self._log_event(log, "checkpoint_restored", round=self._hold_rnd,
+                        source="memory",
+                        on_disk=self.cfg.checkpoint_dir)
+        return state, self._hold_rnd
+
+    def _dump_breach(self, state, rnd: int, name: str, info: dict) -> list:
+        """Black-box dump: flight trace (replayable) + every enabled
+        plane's snapshot, one artifact set per breach."""
+        dump_dir = self.cfg.dump_dir
+        if dump_dir is None:
+            return []
+        os.makedirs(dump_dir, exist_ok=True)
+        paths = []
+        stem = os.path.join(dump_dir, f"breach_r{rnd}_{name}")
+        if getattr(state, "flight", ()) != ():
+            from partisan_tpu import latency as latency_mod
+
+            tr = latency_mod.flight_trace(state.flight)
+            p = stem + "_flight.npz"
+            tr.save(p)
+            paths.append(p)
+        planes: dict[str, Any] = {"info": info}
+        if getattr(state, "metrics", ()) != ():
+            from partisan_tpu import metrics as metrics_mod
+
+            planes["metrics_totals"] = metrics_mod.totals(
+                metrics_mod.snapshot(state.metrics))
+        if getattr(state, "latency", ()) != ():
+            from partisan_tpu import latency as latency_mod
+
+            planes["latency_percentiles"] = latency_mod.percentiles(
+                state.latency)
+        if getattr(state, "health", ()) != ():
+            from partisan_tpu import health as health_mod
+
+            planes["health"] = health_mod.rows(
+                health_mod.snapshot(state.health))
+        if getattr(state, "provenance", ()) != ():
+            from partisan_tpu import provenance as prov_mod
+
+            snap = prov_mod.snapshot(state.provenance)
+            planes["provenance_redundancy"] = prov_mod.redundancy(snap)
+        p = stem + ".json"
+        with open(p, "w") as f:
+            json.dump(planes, f, default=str)
+        paths.append(p)
+        return paths
+
+    def _check_invariants(self, state, rnd: int, log: list) -> int:
+        breaches = 0
+        for inv in self.invariants:
+            ok, info = inv.check(self._cluster(), state)
+            if ok or (rnd, inv.name) in self._seen_breaches:
+                continue
+            self._seen_breaches.add((rnd, inv.name))
+            dumps = self._dump_breach(state, rnd, inv.name, info)
+            self._log_event(log, "invariant_breach", round=rnd,
+                            invariant=inv.name, info=info, dumps=dumps)
+            breaches += 1
+        return breaches
+
+    def _chunk_size(self, rnd: int, until: int, per_round_s,
+                    last_ckpt: int) -> int:
+        """Next chunk length: adaptive ladder value under the wall
+        budget and hard cap, clipped so the chunk crosses neither the
+        soak end, the next storm event, nor the checkpoint cadence."""
+        c = self.cfg
+        if c.chunk_fixed > 0:
+            k = min(c.chunk_fixed, c.chunk_cap)
+        elif per_round_s is None or per_round_s <= 0:
+            k = min(_ladder_floor(c.chunk_init), c.chunk_cap)
+        else:
+            want = c.chunk_target_s / per_round_s
+            k = _ladder_floor(min(want, c.chunk_cap))
+        limit = until - rnd
+        if self.storm is not None:
+            nxt = self.storm.next_after(rnd)
+            if nxt is not None:
+                limit = min(limit, nxt - rnd)
+        if c.checkpoint_every > 0:
+            limit = min(limit, last_ckpt + c.checkpoint_every - rnd)
+        return max(1, min(k, limit))
+
+    # ---- the loop -----------------------------------------------------
+    def run(self, state=None, *, rounds: int | None = None,
+            until_round: int | None = None,
+            resume: bool = False) -> SoakResult:
+        """Advance ``state`` (or a fresh/resumed one) to ``until_round``
+        (absolute) or by ``rounds``.  With ``resume=True`` and a
+        configured ``checkpoint_dir``, the newest on-disk checkpoint is
+        loaded first — the fresh-process restart path; storm actions
+        due at the restored round re-apply, replaying the timeline
+        exactly (module docstring)."""
+        cl = self._cluster()
+        step = self.step_fn or (lambda c, s, k: c.steps(s, k))
+        if resume:
+            if self.cfg.checkpoint_dir is None:
+                raise ValueError("resume=True needs a checkpoint_dir")
+            loaded = checkpoint_mod.restore_latest(
+                self.cfg.checkpoint_dir, cl.init(), cfg=cl.cfg)
+            if loaded is not None:
+                state = loaded
+        if state is None:
+            state = cl.init()
+        r = _sync(state)
+        if until_round is None:
+            if rounds is None:
+                raise ValueError("pass rounds= or until_round=")
+            until_round = r + rounds
+        start = r
+        chunks: list[dict] = []
+        log: list[dict] = []
+        retries = breaches = 0
+        lengths: set[int] = set()
+        per_round_s = None
+        baseline: list[float] = []   # warm per-round samples
+        last_ckpt = r
+        # Two independent escalation counters: ``crash_streak`` counts
+        # CONSECUTIVE failed dispatches (any successful chunk resets it
+        # — transient crashes on different chunks don't share one
+        # budget), and ``deg_retries`` counts degraded-worker rollbacks
+        # since the last clean warm verdict.  ``armed`` means a restore
+        # happened and the next warm chunk must be judged.
+        crash_streak = 0
+        deg_retries = 0
+        armed = False
+        # Chunk lengths already executed in the CURRENT context: the
+        # first run of each distinct scan length pays trace/compile, so
+        # only repeat ("warm") lengths feed the baseline, the adaptive
+        # sizer, and the degraded-worker verdict.  Reset on every
+        # fresh-context rebuild — everything re-traces there.
+        ctx_lengths: set[int] = set()
+
+        while r < until_round:
+            # 1. invariant checks on the state entering this boundary
+            breaches += self._check_invariants(state, r, log)
+            if breaches and self.cfg.stop_on_breach:
+                break
+            # 2. checkpoint BEFORE boundary actions (resume re-applies
+            #    them) — always at the first boundary and then on the
+            #    cadence
+            if r == start or self.cfg.checkpoint_every == 0 \
+                    or r - last_ckpt >= self.cfg.checkpoint_every:
+                self._checkpoint(state, r)
+                last_ckpt = r
+            # 3. storm actions due at this round
+            if self.storm is not None:
+                for action in self.storm.due(r):
+                    state = action.apply(self._cluster(), state, r)
+            # 4. size and run the chunk, guarded
+            k = self._chunk_size(r, until_round, per_round_s, last_ckpt)
+            t0 = time.perf_counter()
+            try:
+                nxt_state = step(self._cluster(), state, k)
+                got = _sync(nxt_state)
+            except jax.errors.JaxRuntimeError as e:
+                crash_streak += 1
+                if crash_streak > self.cfg.max_retries:
+                    # exhausted BEFORE logging: the log records only
+                    # retries that actually ran
+                    raise RuntimeError(
+                        f"soak gave up at round {r}: "
+                        f"{crash_streak - 1} retries exhausted") from e
+                cool = self.cfg.cooldown_s * (2 ** (crash_streak - 1))
+                self._log_event(log, "chunk_retry", round=r, k=k,
+                                attempt=crash_streak, cooldown_s=cool,
+                                error=str(e)[:200])
+                retries += 1
+                self.sleep_fn(cool)
+                state, r = self._restore(log, fresh_context=True)
+                ctx_lengths = set()
+                armed = True
+                # drop rows for rounds the rewind will re-run — replay
+                # re-logs them, and sum(row.k) must equal rounds run
+                chunks[:] = [row for row in chunks if row["round"] < r]
+                continue
+            wall = time.perf_counter() - t0
+            crash_streak = 0      # a completed chunk breaks the streak
+            if got != r + k:
+                raise RuntimeError(
+                    f"chunk advanced to round {got}, expected {r + k}")
+            this_per_round = wall / k
+            warm = k in ctx_lengths
+            ctx_lengths.add(k)
+            taint_baseline = not warm
+            # 5. degraded-worker detection.  Compile-tainted chunks
+            #    (first run of a length in this context) are no
+            #    evidence either way; after a restore the first WARM
+            #    chunk is judged against the pre-restore baseline —
+            #    real degradation persists across chunks (MINUTE_FAULT's
+            #    measured ~20x was steady post-crash state, not a
+            #    one-off compile).
+            if warm and armed and not baseline:
+                # A crash before any warm sample existed: there is no
+                # healthy reference to judge against, and the samples
+                # about to seed the baseline may themselves be
+                # degraded.  Say so instead of silently skipping — the
+                # operator can compare per_round_s against other runs.
+                self._log_event(log, "degraded_unjudged", round=r, k=k,
+                                per_round_s=this_per_round)
+                armed = False
+            if warm and armed and baseline:
+                base = sorted(baseline)[len(baseline) // 2]
+                degraded = this_per_round \
+                    > self.cfg.degraded_factor * base
+                if degraded and deg_retries < self.cfg.max_retries:
+                    deg_retries += 1
+                    cool = self.cfg.cooldown_s * (2 ** deg_retries)
+                    self._log_event(
+                        log, "chunk_retry", round=r, k=k,
+                        attempt=deg_retries, cooldown_s=cool,
+                        degraded=True, per_round_s=this_per_round,
+                        baseline_s=base)
+                    retries += 1
+                    self.sleep_fn(cool)
+                    state, r = self._restore(log, fresh_context=True)
+                    ctx_lengths = set()
+                    chunks[:] = [row for row in chunks
+                                 if row["round"] < r]
+                    continue
+                if degraded:
+                    # Retries exhausted: accept and SAY SO.  The sample
+                    # still feeds the adaptive sizer (chunks must fit
+                    # the wall budget at the real, degraded rate) but
+                    # never the verdict baseline — a re-baselined
+                    # median would make future degradation invisible.
+                    self._log_event(
+                        log, "degraded_accepted", round=r, k=k,
+                        per_round_s=this_per_round, baseline_s=base)
+                    taint_baseline = True
+                else:
+                    deg_retries = 0
+                armed = False
+            if not taint_baseline:
+                baseline.append(this_per_round)
+                if len(baseline) > 32:
+                    baseline.pop(0)
+            if warm:
+                per_round_s = this_per_round if per_round_s is None \
+                    else 0.5 * per_round_s + 0.5 * this_per_round
+            row = {"round": r, "k": k, "wall_s": round(wall, 4),
+                   "per_round_s": round(this_per_round, 6)}
+            if getattr(nxt_state, "health", ()) != ():
+                from partisan_tpu import health as health_mod
+
+                word = health_mod.digest(nxt_state)
+                row["digest"] = word
+                row["healthy"] = health_mod.healthy(word)
+            chunks.append(row)
+            lengths.add(k)
+            state, r = nxt_state, got
+
+        # final boundary: invariants + on-disk checkpoint at the end
+        # round (a persisted soak resumes from its own tail).  The
+        # in-memory hold is only ever read by mid-run restores, so a
+        # dir-less run skips the final full device->host transfer.
+        breaches += self._check_invariants(state, r, log)
+        if self.cfg.checkpoint_dir is not None:
+            self._checkpoint(state, r)
+        return SoakResult(state=state, rounds=r - start, chunks=chunks,
+                          log=log, retries=retries, breaches=breaches,
+                          programs=len(lengths))
+
+
+# ---------------------------------------------------------------------------
+# Functional conveniences
+# ---------------------------------------------------------------------------
+
+def run(cluster, state, k: int, chunk: int = 0, *,
+        storm: Storm | None = None, **cfg_kw) -> Any:
+    """The minimal chunked-run API: advance ``state`` by ``k`` rounds
+    in chunks of ``chunk`` (0 = adaptive), returning the final state —
+    proven bit-identical to ``cluster.steps(state, k)``
+    (tests/test_soak.py chunking-parity suite).  The carry stays
+    device-resident throughout: only the initial boundary snapshots
+    (``checkpoint_every=k``), so the crash-retry floor is the run
+    start.  For per-boundary checkpoints, retries with storms, and the
+    event log, build a :class:`Soak` directly."""
+    cfg_kw.setdefault("checkpoint_every", max(k, 1))
+    # First _cluster() reuses the caller's warm instance; a post-crash
+    # fresh-context rebuild constructs new jitted programs via
+    # Cluster.rebuild() (falling back to the same instance only for
+    # cluster-likes without one, e.g. a ShardedCluster).
+    warm = [cluster]
+    engine = Soak(
+        make_cluster=lambda: warm.pop() if warm
+        else (cluster.rebuild() if hasattr(cluster, "rebuild")
+              else cluster),
+        storm=storm, cfg=SoakConfig(chunk_fixed=chunk, **cfg_kw))
+    return engine.run(state, rounds=k).state
+
+
+def reference_run(cluster, state, until_round: int,
+                  storm: Storm | None = None):
+    """The UNCHUNKED composition the parity tests compare against: the
+    same boundary protocol (actions at the start of their round), but
+    each storm gap executed as ONE uncapped ``cluster.steps`` scan.
+    This is what a soak "should" compute; ``Soak.run`` must match it
+    bit for bit."""
+    r = _sync(state)
+    while r < until_round:
+        if storm is not None:
+            for action in storm.due(r):
+                state = action.apply(cluster, state, r)
+            nxt = storm.next_after(r)
+            k = min(until_round - r, (nxt - r) if nxt is not None
+                    else until_round - r)
+        else:
+            k = until_round - r
+        state = cluster.steps(state, k)
+        r += k
+    return state

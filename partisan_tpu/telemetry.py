@@ -48,6 +48,13 @@ BROADCAST_REDUNDANCY = ("partisan", "broadcast", "redundancy_spike")
 BROADCAST_GRAFT_STORM = ("partisan", "broadcast", "graft_storm")
 BROADCAST_TREE_REPAIRED = ("partisan", "broadcast", "tree_repaired")
 
+# Soak-engine recovery events (soak.py host log -> discrete events):
+# chunk execution retried after a worker crash, state restored from a
+# checkpoint, and a per-chunk invariant breach (with its dump paths).
+SOAK_CHUNK_RETRY = ("partisan", "soak", "chunk_retry")
+SOAK_CHECKPOINT_RESTORED = ("partisan", "soak", "checkpoint_restored")
+SOAK_INVARIANT_BREACH = ("partisan", "soak", "invariant_breach")
+
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
 
@@ -313,6 +320,43 @@ def replay_broadcast_events(bus: Bus, snap: Mapping[str, Any], *,
                         {"round": int(rnd)})
             n_events += 1
             storm_start = None
+    return n_events
+
+
+def replay_soak_events(bus: Bus, log) -> int:
+    """Replay a soak engine's host-side event log (``soak.SoakResult.log``
+    — a list of self-describing dicts) as discrete
+    ``partisan.soak.*`` bus events — the recovery-path analogue of the
+    plane replays above.  Unlike those, the source here is already
+    discrete (the engine records each retry/restore/breach as it
+    happens), so the mapping is one log entry -> at most one event:
+
+    - ``chunk_retry`` — a chunk execution died (worker crash /
+      JaxRuntimeError) and was retried after a cool-down,
+    - ``checkpoint_restored`` — state was rebuilt from a checkpoint
+      (post-crash resume in a fresh context),
+    - ``invariant_breach`` — a per-chunk invariant failed; the
+      measurements carry the breach info and the metadata the dump
+      paths written for post-mortem (flight trace, plane snapshots).
+
+    Returns the number of events emitted."""
+    kinds = {
+        "chunk_retry": SOAK_CHUNK_RETRY,
+        "checkpoint_restored": SOAK_CHECKPOINT_RESTORED,
+        "invariant_breach": SOAK_INVARIANT_BREACH,
+    }
+    n_events = 0
+    for entry in log:
+        event = kinds.get(entry.get("kind"))
+        if event is None:
+            continue
+        meas = {k: v for k, v in entry.items()
+                if isinstance(v, (int, float)) and k != "round"}
+        meta = {k: v for k, v in entry.items()
+                if not isinstance(v, (int, float)) and k != "kind"}
+        meta["round"] = int(entry.get("round", -1))
+        bus.execute(event, meas, meta)
+        n_events += 1
     return n_events
 
 

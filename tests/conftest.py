@@ -29,3 +29,11 @@ xla_bridge._backend_factories.pop("axon", None)
 # seconds to compile; cache across test runs.
 jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-thousand-round soaks and other long runs — excluded "
+        "from the tier-1 gate (-m 'not slow'), run explicitly with "
+        "-m slow")

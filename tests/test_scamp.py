@@ -80,10 +80,13 @@ def test_v1_leave_propagates_removal():
     partial = np.asarray(st.manager.partial)
     assert (partial[5] < 0).all(), "leaver kept its view"
     holders = [i for i in range(24) if i != 5 and 5 in set(partial[i])]
-    # The removal wave only travels through nodes that themselves held
-    # the leaver (v1 :239-262 re-gossips only when present), so stale
-    # out-edges may linger — exactly as in the reference, where they die
-    # when a connect to the left node fails.  Require real shrinkage.
+    # Holders (re-gossip "when present", v1 :239-262) take removals;
+    # non-holders forward them as TTL-bounded walks so the wave can
+    # cross from the leaver's out-view to its in-view (the reference's
+    # remove_subscription rides periodic gossip until it lands).  Stale
+    # out-edges may still linger past the TTL — exactly as in the
+    # reference, where they die when a connect to the left node fails.
+    # Require real shrinkage.
     assert len(holders) < len(holders_before), (holders, holders_before)
     assert len(holders) <= max(2, len(holders_before) // 2), holders
 

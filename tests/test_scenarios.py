@@ -16,6 +16,8 @@ DISTRIBUTION-LEVEL conformance bands derived from the reference/papers
   infect-and-die fixed point.
 """
 
+import pytest
+
 from partisan_tpu import scenarios
 
 
@@ -85,3 +87,19 @@ def test_config5_causal_crash():
     # messages, per-edge FIFO, exactly once
     assert r["causal_deliveries"] == r["causal_expected"], r
     assert r["fifo_ok_receivers"] == r["n_receivers"], r
+
+
+@pytest.mark.slow
+def test_config7_soak_smoke():
+    """The long-horizon soak scenario (ROADMAP item 4) at CPU-smoke
+    scale: one full storm period through the chunked engine — the
+    conservation invariant must hold at every chunk boundary (zero
+    breaches), every chunk bounded, the health digest polled per
+    chunk.  Slow-marked: the engine's tier-1 coverage lives in
+    tests/test_soak.py; this gates the scenario wiring."""
+    r = scenarios.config7_soak(n=64, rounds=200, storm_period=200)
+    assert r["rounds"] == 200
+    assert r["chunks"] >= 2
+    assert r["breaches"] == 0, r
+    assert r["retries"] == 0, r
+    assert r["components"] >= 1

@@ -15,14 +15,20 @@ heal → churn), asserting after EVERY heal window that
   the round engine's conservation law).
 """
 
+import os
+
+import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
-from partisan_tpu import faults as faults_mod
+from partisan_tpu import checkpoint, faults as faults_mod, soak, telemetry
 from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
 from partisan_tpu.models.plumtree import Plumtree
 
-from support import boot_hyparview, components, hv_config
+from support import (assert_states_bitidentical, boot_hyparview,
+                     components, hv_config)
 
 N = 256
 
@@ -68,12 +74,10 @@ def test_soak_500_rounds_mixed_faults():
     st = cl.steps(st, 60)
     st, slot = heal_and_check(st, slot, "after link-drop storm")
 
-    # phase 2: crash a random tenth of the cluster
+    # phase 2: crash a random tenth of the cluster (one scatter)
     victims = rng.choice(N, size=N // 10, replace=False)
-    alive = st.faults.alive
-    for v in victims:
-        alive = alive.at[int(v)].set(False)
-    st = st._replace(faults=st.faults._replace(alive=alive))
+    st = st._replace(faults=faults_mod.crash_many(
+        st.faults, [int(v) for v in victims]))
     st = cl.steps(st, 60)
     st, slot = heal_and_check(st, slot, "after crash batch")
 
@@ -184,3 +188,519 @@ def test_boot_ladder_single_component_aligned_timers():
             s.model, s.faults.alive, 0)) == 1.0,
         max_rounds=60, check_every=5)
     assert conv != -1 and conv - r0 <= 30, (conv, r0)
+
+
+# ---------------------------------------------------------------------------
+# Chunked soak engine (soak.py): the long-horizon orchestration layer.
+#
+# The contracts under test, in dependency order:
+#  1. chunking is pure composition — soak.run(k, chunk) is bit-identical
+#     to one monolithic cluster.steps(state, k), for every chunk size
+#     (including 1 and non-divisors), with every observability plane
+#     AND the flight recorder riding the carry,
+#  2. checkpoints are crash-safe — atomic writes, config fingerprints,
+#     corruption and round validation all fail loudly,
+#  3. a worker crash mid-chunk (injected JaxRuntimeError) retries from
+#     the last checkpoint in a fresh context and lands bit-identically,
+#  4. storm timelines are absolute-round-keyed: a resumed run — same
+#     process or a fresh engine restoring from disk — replays the
+#     identical storm, bit for bit.
+# ---------------------------------------------------------------------------
+
+
+def _planes_cluster(n=32, seed=3):
+    """Small hyparview+plumtree cluster with EVERY plane in the carry:
+    metrics, latency, health, provenance, and the flight-recorder ring
+    (which forces the generic wire path, like capture)."""
+    cfg = Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 metrics=True, metrics_ring=64, latency=True,
+                 health=5, health_ring=32,
+                 provenance=True, provenance_ring=64,
+                 flight_rounds=4)
+    return Cluster(cfg, model=Plumtree())
+
+
+def _booted(cl, settle=20):
+    n = cl.cfg.n_nodes
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager,
+                             list(range(1, n)), [0] * (n - 1))
+    st = cl.steps(st._replace(manager=m), settle)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, int(st.rnd)))
+    return cl.steps(st, 5)
+
+
+def _test_storm(start, period=0):
+    """A full fault cycle: drop -> crash batch -> partition -> heal ->
+    churn, absolute-round-keyed at `start`."""
+    return soak.Storm(events=(
+        (0, soak.LinkDrop(0.2)),
+        (4, soak.CrashBatch(frac=0.05)),
+        (8, soak.Partition()),
+        (12, soak.Heal(revive=True)),
+        (16, soak.Churn(0.02, 0.02)),
+    ), start=start, period=period)
+
+
+def test_chunked_run_bit_identical_across_chunk_sizes():
+    """soak.run(k, chunk) == cluster.steps(state, k) bit-for-bit, with
+    all planes + flight enabled, for chunk=1 and a non-divisor chunk —
+    plus the Cluster.run_chunked front door.  k matches _booted's
+    settle length so the monolithic reference reuses its compiled scan
+    (tier-1 compile budget)."""
+    cl = _planes_cluster()
+    st = _booted(cl)
+    k = 20
+    ref = cl.steps(st, k)
+    for chunk in (1, 7):
+        got = soak.run(cl, st, k, chunk=chunk)
+        assert_states_bitidentical(got, ref, f"chunk={chunk}")
+    got = cl.run_chunked(st, k, chunk=7)
+    assert_states_bitidentical(got, ref, "run_chunked")
+
+
+@pytest.mark.slow
+def test_chunked_storm_parity_and_event_boundaries():
+    """A chunked storm run equals the unchunked reference composition
+    (one uncapped scan per storm gap), and no chunk ever crosses a
+    storm event round — the boundary discipline that makes host-side
+    fault actions land at exactly their scheduled round."""
+    cl = _planes_cluster()
+    st = _booted(cl)
+    r0 = int(jax.device_get(st.rnd))
+    storm = _test_storm(r0, period=20)
+    eng = soak.Soak(make_cluster=lambda: cl, storm=storm,
+                    invariants=[soak.conservation()],
+                    cfg=soak.SoakConfig(chunk_fixed=7))
+    res = eng.run(st, rounds=50)
+    assert res.rounds == 50 and res.breaches == 0
+    ref = soak.reference_run(cl, st, r0 + 50, storm=storm)
+    assert_states_bitidentical(res.state, ref, "storm_chunked_vs_ref")
+    # boundary discipline: event rounds only ever START a chunk
+    event_rounds = set()
+    r = r0
+    while r < r0 + 50:
+        nxt = storm.next_after(r)
+        if nxt is None or nxt >= r0 + 50:
+            break
+        event_rounds.add(nxt)
+        r = nxt
+    for row in res.chunks:
+        for ev in event_rounds:
+            assert not (row["round"] < ev < row["round"] + row["k"]), \
+                (row, ev)
+    # the health digest rode along: every chunk row polled it
+    assert all("digest" in row for row in res.chunks)
+
+
+def test_storm_omission_installs_filibuster_schedule():
+    """The Omission action re-encodes absolute-round drops into the
+    builder schedule's frame: the installed window must actually
+    suppress sends (vs the storm-free run), stay chunk-parity with the
+    unchunked reference, and a mis-anchored window must raise instead
+    of silently dropping nothing."""
+    from partisan_tpu import interpose
+    from partisan_tpu.models.anti_entropy import AntiEntropy
+
+    n = 8
+    cfg = Config(n_nodes=n, seed=2, inbox_cap=32)
+    model = AntiEntropy()
+    total = 64   # builder window: absolute rounds [0, 64)
+
+    def mk():
+        return Cluster(cfg, model=model,
+                       interpose=interpose.OmissionSchedule(
+                           np.zeros((total, n, 64), np.bool_), start=0))
+
+    cl = mk()
+    st = cl.init()
+    m = st.manager
+    for i in range(1, n):
+        m = cl.manager.join(cfg, m, i, 0)
+    st = cl.steps(st._replace(manager=m), 10)
+    st = st._replace(model=model.broadcast(st.model, 0, 0))
+    r0 = int(jax.device_get(st.rnd))
+
+    drops = np.ones((4, n, 64), np.bool_)   # omit EVERY send, 4 rounds
+    storm = soak.Storm(events=((2, soak.Omission(drops, start=r0 + 2)),),
+                       start=r0)
+    eng = soak.Soak(make_cluster=mk, storm=storm,
+                    cfg=soak.SoakConfig(chunk_fixed=5))
+    res = eng.run(st, rounds=20)
+    ref = soak.reference_run(mk(), st, r0 + 20, storm=storm)
+    assert_states_bitidentical(res.state, ref, "omission_storm")
+    # the schedule bit: the blackout window cost real deliveries
+    base = cl.steps(st, 20)
+    assert int(jax.device_get(res.state.stats.delivered)) \
+        < int(jax.device_get(base.stats.delivered))
+    # a window outside the builder's frame fails loudly
+    bad = soak.Storm(events=(
+        (0, soak.Omission(drops, start=total + 10)),), start=r0)
+    with pytest.raises(ValueError, match="outside the cluster schedule"):
+        soak.Soak(make_cluster=mk, storm=bad,
+                  cfg=soak.SoakConfig(chunk_fixed=5)).run(st, rounds=5)
+    # two Omissions MERGE: the second must not erase the first's
+    # still-pending rows (host-level, no stepping)
+    one = np.zeros((1, n, 64), np.bool_)
+    one[0, 3, 0] = True
+    s2 = soak.Omission(one, start=10).apply(cl, cl.init(), 0)
+    s2 = soak.Omission(one, start=30).apply(cl, s2, 0)
+    merged = np.asarray(jax.device_get(s2.interpose))
+    assert merged[10, 3, 0] and merged[30, 3, 0]
+
+
+def test_kill_at_chunk_boundary_resume_bit_parity(tmp_path):
+    """An injected JaxRuntimeError mid-run triggers retry-with-backoff:
+    cool down, rebuild the cluster (fresh context), restore the last
+    checkpoint, replay — and the final state is bit-identical to the
+    undisturbed run.  The recovery path emits chunk_retry +
+    checkpoint_restored (log and live bus), and on-disk checkpoints
+    appear at the chunk boundaries."""
+    def mk():
+        return _planes_cluster()
+
+    cl = mk()
+    st = _booted(cl)
+    r0 = int(jax.device_get(st.rnd))
+    crashed = {"done": False}
+
+    def step(c, s, k):
+        r = int(jax.device_get(s.rnd))
+        if not crashed["done"] and r + k > r0 + 25:
+            crashed["done"] = True
+            raise jax.errors.JaxRuntimeError("injected worker crash")
+        return c.steps(s, k)
+
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "soak"), rec)
+    slept = []
+    eng = soak.Soak(
+        make_cluster=mk, step_fn=step, bus=bus,
+        cfg=soak.SoakConfig(chunk_fixed=10, cooldown_s=0.25,
+                            checkpoint_dir=str(tmp_path),
+                            degraded_factor=1e9),
+        sleep_fn=slept.append)
+    res = eng.run(st, rounds=40)
+    assert res.retries == 1 and crashed["done"]
+    kinds = [e["kind"] for e in res.log]
+    assert kinds.count("chunk_retry") == 1
+    assert kinds.count("checkpoint_restored") == 1
+    assert slept == [0.25]          # backoff consulted the cool-down
+    assert [e[0] for e in rec.events] == [
+        telemetry.SOAK_CHUNK_RETRY, telemetry.SOAK_CHECKPOINT_RESTORED]
+    assert checkpoint.steps(tmp_path)[0] == r0
+    ref = cl.steps(st, 40)
+    assert_states_bitidentical(res.state, ref, "crash_resume")
+
+
+@pytest.mark.slow
+def test_fresh_process_resume_replays_storm(tmp_path):
+    """The whole-process restart path: engine A soaks partway through a
+    storm and is discarded; engine B — new cluster, new (identically
+    declared) storm — resumes from the newest on-disk checkpoint and
+    finishes.  The result is bit-identical to the uninterrupted
+    unchunked composition: the timeline is absolute-round-keyed and the
+    checkpoint-before-actions protocol re-applies the boundary's due
+    actions on resume, so the storm replays exactly."""
+    def mk():
+        return _planes_cluster()
+
+    cl = mk()
+    st = _booted(cl)
+    r0 = int(jax.device_get(st.rnd))
+
+    eng_a = soak.Soak(make_cluster=mk, storm=_test_storm(r0, period=20),
+                      cfg=soak.SoakConfig(chunk_fixed=8,
+                                          checkpoint_dir=str(tmp_path)))
+    eng_a.run(st, rounds=24)
+
+    eng_b = soak.Soak(make_cluster=mk, storm=_test_storm(r0, period=20),
+                      cfg=soak.SoakConfig(chunk_fixed=8,
+                                          checkpoint_dir=str(tmp_path)))
+    res = eng_b.run(resume=True, until_round=r0 + 56)
+    ref = soak.reference_run(mk(), st, r0 + 56,
+                             storm=_test_storm(r0, period=20))
+    assert_states_bitidentical(res.state, ref, "fresh_process_resume")
+
+
+@pytest.mark.slow
+def test_degraded_worker_detection_cools_down_and_rebuilds():
+    """Sustained post-crash slowness trips the degraded-worker path:
+    the first post-rebuild chunk is exempt (it pays re-trace/compile —
+    no evidence), the NEXT chunk is judged against the pre-crash
+    baseline (MINUTE_FAULT: ~20x measured, steady) — logged, cooled
+    down longer, rebuilt and re-run until the worker recovers."""
+    import time as time_mod
+
+    cl = Cluster(hv_config(16, seed=5), model=Plumtree())
+    st = _booted(cl, settle=10)
+    r0 = int(jax.device_get(st.rnd))
+    # slow for TWO chunks after the crash: the exempt rebuild chunk and
+    # the probation chunk that convicts
+    state = {"crash_at": r0 + 30, "crashed": False, "slow_left": 2}
+
+    def step(c, s, k):
+        r = int(jax.device_get(s.rnd))
+        if not state["crashed"] and r + k > state["crash_at"]:
+            state["crashed"] = True
+            raise jax.errors.JaxRuntimeError("injected worker crash")
+        out = c.steps(s, k)
+        int(jax.device_get(out.rnd))
+        if state["crashed"] and state["slow_left"] > 0:
+            state["slow_left"] -= 1
+            time_mod.sleep(2.0)      # the degraded worker: >>20x a warm
+            #                          CPU chunk of 5 rounds
+        return out
+
+    slept = []
+    eng = soak.Soak(make_cluster=lambda: cl, step_fn=step,
+                    cfg=soak.SoakConfig(chunk_fixed=5, cooldown_s=0.5,
+                                        degraded_factor=20.0,
+                                        max_retries=4),
+                    sleep_fn=slept.append)
+    res = eng.run(st, rounds=50)
+    assert res.rounds == 50
+    degraded = [e for e in res.log if e.get("degraded")]
+    assert len(degraded) == 1, res.log
+    # backoff doubled for the degraded retry (attempt 2 after the crash)
+    assert slept == [0.5, 1.0]
+    ref = cl.steps(st, 50)
+    assert_states_bitidentical(res.state, ref, "degraded_recovery")
+
+
+def test_retries_exhausted_raises():
+    cl = _small_cluster()    # shares the checkpoint tests' programs
+    st = _booted(cl, settle=5)
+
+    def step(c, s, k):
+        raise jax.errors.JaxRuntimeError("permanently down")
+
+    eng = soak.Soak(make_cluster=lambda: cl, step_fn=step,
+                    cfg=soak.SoakConfig(chunk_fixed=5, cooldown_s=0.0,
+                                        max_retries=2),
+                    sleep_fn=lambda s: None)
+    with pytest.raises(RuntimeError, match="retries exhausted"):
+        eng.run(st, rounds=10)
+
+
+def test_invariant_breach_dumps_black_box(tmp_path):
+    """A breached invariant logs partisan.soak.invariant_breach and
+    dumps the black box: the flight ring decoded to a replayable trace
+    plus every enabled plane's snapshot — and the dedup guard logs one
+    breach per (round, invariant), not one per retry visit."""
+    from partisan_tpu import trace as trace_mod
+
+    cl = _planes_cluster()   # same shape as the kill test: programs shared
+    st = _booted(cl)
+    always = soak.Invariant(
+        "always_red", lambda c, s: (False, {"why": "test"}))
+    eng = soak.Soak(make_cluster=lambda: cl, invariants=[always],
+                    cfg=soak.SoakConfig(chunk_fixed=10,
+                                        dump_dir=str(tmp_path)))
+    res = eng.run(st, rounds=20)
+    breaches = [e for e in res.log if e["kind"] == "invariant_breach"]
+    # one per boundary (start, 2 interior-ends... final): 3 boundaries
+    assert len(breaches) == res.breaches == 3
+    assert len({e["round"] for e in breaches}) == 3
+    for e in breaches:
+        assert e["invariant"] == "always_red"
+        assert e["dumps"], "no black-box dumps recorded"
+        for p in e["dumps"]:
+            assert os.path.exists(p), p
+    flight = [p for p in breaches[0]["dumps"] if p.endswith("_flight.npz")]
+    assert flight, "flight ring not dumped"
+    tr = trace_mod.Trace.load(flight[0])
+    assert tr.n_rounds == cl.cfg.flight_rounds
+
+
+def test_replay_soak_events_synthetic_log():
+    log = [
+        {"kind": "chunk_retry", "round": 7, "k": 10, "attempt": 1,
+         "cooldown_s": 1.0, "error": "boom"},
+        {"kind": "checkpoint_restored", "round": 5, "source": "/tmp/x"},
+        {"kind": "invariant_breach", "round": 9,
+         "invariant": "conservation", "info": {"emitted": 3},
+         "dumps": []},
+        {"kind": "chunk", "round": 0, "k": 10},      # not an event
+    ]
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "soak"), rec)
+    assert telemetry.replay_soak_events(bus, log) == 3
+    events = [e[0] for e in rec.events]
+    assert events == [telemetry.SOAK_CHUNK_RETRY,
+                      telemetry.SOAK_CHECKPOINT_RESTORED,
+                      telemetry.SOAK_INVARIANT_BREACH]
+    retry = rec.of(telemetry.SOAK_CHUNK_RETRY)[0]
+    assert retry[1]["attempt"] == 1 and retry[2]["round"] == 7
+    breach = rec.of(telemetry.SOAK_INVARIANT_BREACH)[0]
+    assert breach[2]["invariant"] == "conservation"
+    assert breach[2]["round"] == 9
+
+
+# ---------------------------------------------------------------------------
+# Hardened checkpoints (checkpoint.py): the soak engine's persistence
+# layer must fail loudly on every corruption the crash cycle can cause.
+# ---------------------------------------------------------------------------
+
+
+def _small_cluster(seed=5):
+    return Cluster(hv_config(24, seed=seed), model=Plumtree())
+
+
+def test_checkpoint_atomic_write_leaves_no_temp_files(tmp_path):
+    cl = _small_cluster()
+    st = cl.steps(_booted(cl, settle=5), 3)
+    p = tmp_path / "ck.npz"
+    checkpoint.save(st, p, cfg=cl.cfg)
+    assert p.exists()
+    leftovers = [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    assert not leftovers, leftovers
+    back = checkpoint.restore(p, like=cl.init(), cfg=cl.cfg)
+    assert_states_bitidentical(back, st, "atomic_roundtrip")
+
+
+def test_checkpoint_fingerprint_rejects_shape_preserving_drift(tmp_path):
+    """A config change that keeps every leaf shape (here: the seed) is
+    invisible to the structural check — the fingerprint must catch
+    it."""
+    cl = _small_cluster(seed=5)
+    st = _booted(cl, settle=5)
+    p = tmp_path / "ck.npz"
+    checkpoint.save(st, p, cfg=cl.cfg)
+    drifted = _small_cluster(seed=6)
+    with pytest.raises(checkpoint.CheckpointError, match="fingerprint"):
+        checkpoint.restore(p, like=drifted.init(), cfg=drifted.cfg)
+    # without the fingerprint cross-check the structural check alone
+    # accepts it — the gap the fingerprint closes
+    checkpoint.restore(p, like=drifted.init())
+
+
+def test_checkpoint_truncated_file_raises_clear_error(tmp_path):
+    cl = _small_cluster()
+    st = _booted(cl, settle=5)
+    p = tmp_path / "ck.npz"
+    checkpoint.save(st, p, cfg=cl.cfg)
+    raw = p.read_bytes()
+    p.write_bytes(raw[: len(raw) // 2])
+    with pytest.raises(checkpoint.CheckpointError,
+                       match="corrupt or truncated"):
+        checkpoint.restore(p, like=cl.init(), cfg=cl.cfg)
+    # garbage (not even a zip) is the same clear failure, not a
+    # BadZipFile traceback
+    p.write_bytes(b"not a checkpoint at all")
+    with pytest.raises(checkpoint.CheckpointError,
+                       match="corrupt or truncated"):
+        checkpoint.restore(p, like=cl.init())
+
+
+def test_checkpoint_round_validation(tmp_path):
+    cl = _small_cluster()
+    st = _booted(cl, settle=5)
+    rnd = int(jax.device_get(st.rnd))
+    p = tmp_path / "ck.npz"
+    checkpoint.save(st, p, cfg=cl.cfg)
+    checkpoint.restore(p, like=cl.init(), expect_rnd=rnd)
+    with pytest.raises(checkpoint.CheckpointError, match="expected round"):
+        checkpoint.restore(p, like=cl.init(), expect_rnd=rnd + 1)
+
+
+def test_restore_latest_falls_back_past_corrupt_newest(tmp_path):
+    """A torn newest checkpoint (OS crash publishing torn bytes) must
+    not permanently block resume: restore_latest falls back to the
+    next-older intact file; with every file corrupt it raises the
+    corruption error rather than returning None (which would silently
+    restart the soak from scratch)."""
+    cl = _small_cluster()
+    st = _booted(cl, settle=5)
+    checkpoint.save_step(st, tmp_path, int(jax.device_get(st.rnd)),
+                         cfg=cl.cfg)
+    st2 = cl.steps(st, 5)
+    r2 = int(jax.device_get(st2.rnd))
+    p2 = checkpoint.save_step(st2, tmp_path, r2, cfg=cl.cfg)
+    with open(p2, "r+b") as f:
+        f.truncate(64)
+    back = checkpoint.restore_latest(tmp_path, cl.init(), cfg=cl.cfg)
+    assert int(jax.device_get(back.rnd)) == int(jax.device_get(st.rnd))
+    assert_states_bitidentical(back, st, "fallback_restore")
+    for rnd in checkpoint.steps(tmp_path):
+        with open(tmp_path / f"ckpt_{rnd}.npz", "r+b") as f:
+            f.truncate(64)
+    with pytest.raises(checkpoint.CheckpointCorruptError,
+                       match="every checkpoint"):
+        checkpoint.restore_latest(tmp_path, cl.init(), cfg=cl.cfg)
+
+
+def test_checkpoint_v1_files_still_restore(tmp_path):
+    """Format-1 checkpoints (leaves only, pre-hardening) restore
+    without the new validation — old soak artifacts stay readable."""
+    cl = _small_cluster()
+    st = _booted(cl, settle=5)
+    leaves = jax.tree.leaves(st)
+    p = tmp_path / "legacy.npz"
+    np.savez_compressed(p, version=1, n_leaves=len(leaves),
+                        **{f"leaf_{i}": np.asarray(x)
+                           for i, x in enumerate(leaves)})
+    back = checkpoint.restore(p, like=cl.init(), cfg=cl.cfg)
+    assert_states_bitidentical(back, st, "v1_compat")
+
+
+# ---------------------------------------------------------------------------
+# The acceptance gate: thousands of rounds under a repeating storm,
+# crash-surviving, bit-identical to the unchunked composition.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_2000_rounds_repeating_storm_crash_surviving(tmp_path):
+    """ISSUE 7 acceptance: a >=2000-round soak under a repeating fault
+    storm completes via chunked execution (every chunk <= 1000 rounds),
+    is bit-identical to the equivalent unchunked composition, and —
+    with a worker crash injected mid-run — resumes from checkpoint with
+    the storm timeline replaying identically across the restart."""
+    def mk():
+        return Cluster(Config(
+            n_nodes=64, seed=11, peer_service_manager="hyparview",
+            msg_words=16, partition_mode="groups",
+            health=10, health_ring=64), model=Plumtree())
+
+    cl = mk()
+    st = _booted(cl)
+    r0 = int(jax.device_get(st.rnd))
+    rounds = 2000
+    storm = soak.Storm(events=(
+        (0, soak.LinkDrop(0.2)),
+        (40, soak.Heal()),
+        (60, soak.CrashBatch(frac=0.02)),
+        (100, soak.Partition()),
+        (140, soak.Heal(revive=True)),
+        (160, soak.Churn(0.01, 0.01)),
+        (180, soak.Heal(revive=True)),
+    ), start=r0, period=200)
+
+    crashed = {"done": False}
+
+    def step(c, s, k):
+        r = int(jax.device_get(s.rnd))
+        if not crashed["done"] and r + k > r0 + 1100:
+            crashed["done"] = True
+            raise jax.errors.JaxRuntimeError("injected worker crash")
+        return c.steps(s, k)
+
+    eng = soak.Soak(
+        make_cluster=mk, storm=storm, step_fn=step,
+        invariants=[soak.conservation()],
+        cfg=soak.SoakConfig(chunk_fixed=500, cooldown_s=0.0,
+                            checkpoint_every=200,
+                            checkpoint_dir=str(tmp_path),
+                            degraded_factor=1e9),
+        sleep_fn=lambda s: None)
+    res = eng.run(st, rounds=rounds)
+    assert res.rounds == rounds
+    assert crashed["done"] and res.retries == 1
+    assert all(row["k"] <= 1000 for row in res.chunks)
+    assert res.breaches == 0            # conservation held throughout
+    ref = soak.reference_run(mk(), st, r0 + rounds, storm=storm)
+    assert_states_bitidentical(res.state, ref, "acceptance_2000")

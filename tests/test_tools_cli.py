@@ -171,6 +171,31 @@ def test_broadcast_report_cli_smoke():
             summary["duplicates"] / summary["gossip_delivered"], 4)
 
 
+def test_soak_report_cli_smoke():
+    """Soak-engine exporter end-to-end off-TPU: chunk rows with the
+    polled health digest, an injected worker crash surfacing as the
+    chunk_retry / checkpoint_restored pair (log lines AND replayed
+    partisan.soak.* events), and a trailing summary that reconciles
+    with its own rows."""
+    out = _run("soak_report.py", "32", "30", "--chunk", "10",
+               "--crash-at", "15")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    kinds = [r["kind"] for r in rows]
+    assert kinds[-1] == "summary"
+    chunks = [r for r in rows if r["kind"] == "chunk"]
+    assert chunks and all("digest" in c for c in chunks)
+    assert sum(c["k"] for c in chunks) == 30
+    assert "chunk_retry" in kinds and "checkpoint_restored" in kinds
+    events = [tuple(r["event"]) for r in rows if r["kind"] == "event"]
+    assert ("partisan", "soak", "chunk_retry") in events
+    assert ("partisan", "soak", "checkpoint_restored") in events
+    summary = rows[-1]
+    assert summary["chunks"] == len(chunks)
+    assert summary["retries"] == 1
+    assert summary["rounds"] == 30
+
+
 def test_tools_cli_completeness():
     """Completeness guard: EVERY tools/*.py exposes a ``main()`` and
     survives a ``--help`` smoke with an honest zero exit — so a future
@@ -179,7 +204,8 @@ def test_tools_cli_completeness():
     tools_dir = os.path.join(_REPO, "tools")
     tools = sorted(f for f in os.listdir(tools_dir)
                    if f.endswith(".py"))
-    assert len(tools) >= 8, tools
+    assert len(tools) >= 9, tools
+    assert "soak_report.py" in tools
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     procs = {}
     for tool in tools:

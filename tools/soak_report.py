@@ -1,0 +1,169 @@
+"""Soak-engine JSON-lines exporter (the ``BENCH_*.json`` idiom: one
+self-describing JSON object per line).
+
+Boots a HyParView+Plumtree overlay with the health plane on, then
+drives it through the chunked soak engine (partisan_tpu/soak.py) under
+a repeating fault storm — printing one line per chunk (round, size,
+wall, health digest), one line per recovery/breach event
+(``chunk_retry`` / ``checkpoint_restored`` / ``invariant_breach`` with
+its dump paths), the replayed ``partisan.soak.*`` bus events, and a
+trailing summary::
+
+    python tools/soak_report.py [n] [rounds] [--chunk K] [--crash-at R]
+                                [--breach] [--ckpt-dir DIR]
+
+``--crash-at R`` injects a ``JaxRuntimeError`` into the first chunk
+dispatch that would cross R rounds into the soak — off-TPU proof of
+the retry/backoff + checkpoint-restore path (the minute-mark worker
+crash, tools/MINUTE_FAULT.md).  ``--breach`` holds a partition across the
+final quarter with the one-component invariant armed, so the output
+shows a real ``invariant_breach`` with black-box dumps.  Importable:
+``report(result)`` renders any ``soak.SoakResult``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def report(res, out=sys.stdout) -> dict:
+    """Dump a ``soak.SoakResult`` as JSON lines; returns (and prints as
+    the last line) the summary dict."""
+    from partisan_tpu import telemetry
+
+    for row in res.chunks:
+        print(json.dumps({"kind": "chunk", **row}), file=out)
+    for entry in res.log:
+        print(json.dumps(entry, default=str), file=out)
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("report", ("partisan", "soak"), rec)
+    telemetry.replay_soak_events(bus, res.log)
+    for event, meas, meta in rec.events:
+        print(json.dumps({"kind": "event", "event": list(event),
+                          **meas, **meta}, default=str), file=out)
+    summary = {"kind": "summary", "rounds": res.rounds,
+               "chunks": len(res.chunks), "programs": res.programs,
+               "retries": res.retries, "breaches": res.breaches,
+               "healthy": res.healthy()}
+    print(json.dumps(summary), file=out)
+    return summary
+
+
+USAGE = ("usage: soak_report.py [n] [rounds] [--chunk K] [--crash-at R] "
+         "[--breach] [--ckpt-dir DIR]")
+
+
+def main() -> None:
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
+    import jax
+
+    from partisan_tpu import soak
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config
+    from partisan_tpu.models.plumtree import Plumtree
+
+    # Persistent compile cache (the scenarios.py __main__ discipline):
+    # the smoke's scan programs reload across subprocess runs.
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/partisan_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    # Hand-rolled argv split: value flags consume their operand, so a
+    # flag value never leaks into the positional [n, rounds] slots.
+    VALUE_FLAGS = ("--chunk", "--crash-at", "--ckpt-dir")
+    argv = sys.argv[1:]
+    args, opts, breach = [], {}, False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in VALUE_FLAGS:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{a} needs a value\n{USAGE}")
+            opts[a] = argv[i + 1]
+            i += 2
+        elif a == "--breach":
+            breach = True
+            i += 1
+        elif a.startswith("--"):
+            raise SystemExit(f"unknown flag {a}\n{USAGE}")
+        else:
+            args.append(a)
+            i += 1
+    n = int(args[0]) if args else 128
+    rounds = int(args[1]) if len(args) > 1 else 120
+    chunk = int(opts.get("--chunk", 0))
+    crash_at = opts.get("--crash-at")
+    ckpt_dir = opts.get("--ckpt-dir")
+
+    def mk():
+        return Cluster(Config(
+            n_nodes=n, seed=9, peer_service_manager="hyparview",
+            msg_words=16, partition_mode="groups",
+            health=5, health_ring=max(64, rounds),
+            metrics=True, metrics_ring=max(128, rounds),
+            # The flight ring (the breach black box) forces the generic
+            # wire path and roughly doubles compile time — carry it
+            # only when the breach demo will dump it.
+            flight_rounds=8 if breach else 0), model=Plumtree())
+
+    cl = mk()
+    # The canonical batched staggered bootstrap (K_PROG-grained waves +
+    # settle), not a re-implementation that would drift from it.
+    from partisan_tpu.scenarios import _boot_overlay
+
+    st = _boot_overlay(cl, n, settle_execs=2)
+    start = int(jax.device_get(st.rnd))
+
+    q = max(10, rounds // 4)
+    events = [(0, soak.LinkDrop(0.15)), (q, soak.Heal()),
+              (2 * q, soak.CrashBatch(frac=0.05)),
+              (2 * q + q // 2, soak.Heal(revive=True))]
+    if breach:
+        # Hold a split across the tail so the armed one-component
+        # invariant breaches at the following chunk boundaries.
+        events.append((3 * q, soak.Partition()))
+    storm = soak.Storm(events=tuple(events), start=start)
+
+    step_fn = None
+    if crash_at is not None:
+        crash_round = start + int(crash_at)   # R rounds INTO the soak
+        fired = {"done": False}
+
+        def step_fn(c, s, k):  # noqa: F811 — the injection seam
+            r = int(jax.device_get(s.rnd))
+            if not fired["done"] and r + k > crash_round:
+                fired["done"] = True
+                raise jax.errors.JaxRuntimeError(
+                    f"injected worker crash at round {r} (--crash-at "
+                    f"{crash_round})")
+            return c.steps(s, k)
+
+    # Dump dir only when the breach demo can actually write to it, and
+    # announced in the output so the artifacts are findable.
+    dump_dir = None
+    if breach:
+        dump_dir = tempfile.mkdtemp(prefix="soak_dumps_")
+        print(json.dumps({"kind": "dump_dir", "path": dump_dir}))
+    warm = [cl]      # first _cluster() reuses the booted instance
+    eng = soak.Soak(
+        make_cluster=lambda: warm.pop() if warm else mk(),
+        storm=storm, step_fn=step_fn,
+        invariants=[soak.conservation(), soak.digest_healthy()],
+        cfg=soak.SoakConfig(chunk_fixed=chunk, checkpoint_dir=ckpt_dir,
+                            cooldown_s=0.0, dump_dir=dump_dir),
+        sleep_fn=lambda s: None)
+    res = eng.run(st, rounds=rounds)
+    report(res)
+
+
+if __name__ == "__main__":
+    main()
