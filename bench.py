@@ -476,6 +476,36 @@ def _pallas_verdict(budget_s: float) -> dict:
         return {"verdict": "SKIP", "reason": repr(exc)[:200]}
 
 
+def _lint_verdict(budget_s: float) -> dict:
+    """Fold a quick jaxlint run (tools/jaxlint.py --quick: plain round,
+    everything-on scan, capture round + package rules) into the
+    artifact, so "was the traced program clean" is recorded next to the
+    numbers it produced — a BENCH_r0x with a DIRTY verdict is measuring
+    a program that violates a pinned invariant (interleave budget,
+    host callback, narrow-dtype write...).  Subprocess on the remaining
+    wall budget; tracing is CPU-only (JAX_PLATFORMS=cpu) so the relay
+    is never touched and a stall cannot sink the bench."""
+    import subprocess
+
+    if budget_s < 30:
+        return {"verdict": "SKIP", "reason": "bench budget exhausted"}
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "jaxlint.py"), "--quick"],
+            capture_output=True, text=True, env=env,
+            timeout=max(30.0, min(120.0, budget_s)))
+        last = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        out = json.loads(last)
+        return {k: out[k] for k in ("verdict", "findings", "waived",
+                                    "matrix") if k in out}
+    except Exception as exc:  # lint failure must never sink the bench
+        return {"verdict": "SKIP", "reason": repr(exc)[:200]}
+
+
 def main() -> None:
     # Ladder: the HEADLINE size runs FIRST with the full per-size cap —
     # its warm median-of-N is the artifact's core; its cold run comes
@@ -560,6 +590,7 @@ def main() -> None:
     warm = top["warm"]
     print(json.dumps({
         "pallas_probe": _pallas_verdict(remaining()),
+        "jaxlint": _lint_verdict(remaining()),
         "metric": (f"simulated gossip rounds/sec "
                    f"({top['n']}-node hyparview+plumtree)"),
         "value": warm["rounds_per_sec"]["median"],

@@ -138,6 +138,10 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
 
     # jax.named_scope labels each phase in the HLO, so profiler traces
     # (tools/profile_round.py under jax.profiler) map to round phases.
+    # The labels are load-bearing: the lint zero-cost-when-off rule
+    # keys on them (an OFF plane's round.* scope must be absent, an ON
+    # plane's present — partisan_tpu/lint/rules.py), so renaming one
+    # fails the lint gate, not silently weakens it.
     with jax.named_scope("round.manager"):
         mstate, m_emit = manager.step(cfg, comm, state.manager, ctx)
     nbrs = None
@@ -395,9 +399,11 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         # THE plane->wire interleave: capture/flight need the trace's
         # interleaved int32 [n, E, W] tensor (TraceRound.sent is the
         # layout-stable contract), and it is the ONLY interleave the
-        # round program may contain (tests/test_program_budget.py counts
-        # them at the jaxpr level; the plain round traces zero — the
-        # exchange ships packed planes).
+        # round program may contain (the lint interleave-budget rule
+        # counts them at the jaxpr level — partisan_tpu/lint/rules.py,
+        # budget 1 here, 0 for the plain round whose exchange ships
+        # packed planes; tests/test_program_budget.py pins the exact
+        # counts).
         sent_wire = plane_ops.interleave(sent) if (capture or fx) else None
         if fx:
             # Flight recorder: the same (sent, dropped) pair capture

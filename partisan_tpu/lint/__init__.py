@@ -1,0 +1,54 @@
+"""jaxlint — a jaxpr-level static auditor for the round program.
+
+The reference ships a real static-analysis pass: ``partisan_analysis.erl``
+walks Core Erlang to derive the causality annotations that gate
+Filibuster (see ``partisan_tpu/analysis.py``, which ported the *dynamic*
+half).  In this rebuild the compile-time artifact is the **jaxpr** — the
+traced round program is a closed, inspectable IR — and this package is
+the enforced home for every invariant we previously policed with
+scattered ad-hoc asserts (string greps for callback primitives, a
+copy-pasted interleave counter) or did not police at all (the PR 6
+int16 hop-clip overflow shipped and was only caught by a parity
+matrix).
+
+Layout:
+
+- :mod:`core`      — Finding/Program/Report types, the recursive jaxpr
+  walker (scan/cond/while/pjit sub-jaxprs), waiver application.
+- :mod:`rules`     — the rule catalog (no-host-callback,
+  interleave-budget, zero-cost-when-off, narrow-dtype-overflow,
+  scatter-overlap, sharding-spec-completeness).
+- :mod:`intervals` — conservative value-range propagation over jaxpr
+  equations (the narrow-dtype rule's engine).
+- :mod:`matrix`    — the audited config matrix (each plane on/off,
+  plane-major x width-operand, capture, OTP stack, soak chunk).
+- :mod:`waivers`   — the pinned baseline of documented exceptions;
+  anything NOT in it fails, and in full-matrix runs a waiver nothing
+  matched fails too (the baseline cannot rot).
+- :mod:`pyscan`    — Python-level static hygiene (a pyflakes-lite
+  subset used as the fallback when ``ruff`` is not installed).
+
+Drivers: ``tools/jaxlint.py`` (JSON-lines CLI), ``tests/test_lint.py``
+(the tier-1 gate over the same matrix), ``bench.py``'s lint verdict.
+"""
+
+from partisan_tpu.lint.core import (  # noqa: F401
+    Finding,
+    Program,
+    Report,
+    iter_eqns,
+    run_programs,
+    site_of,
+    trace_program,
+)
+from partisan_tpu.lint.rules import (  # noqa: F401
+    PACKAGE_RULES,
+    PROGRAM_RULES,
+    count_wire_interleaves,
+)
+
+__all__ = [
+    "Finding", "Program", "Report", "iter_eqns", "run_programs",
+    "site_of", "trace_program", "PACKAGE_RULES", "PROGRAM_RULES",
+    "count_wire_interleaves",
+]

@@ -1,0 +1,180 @@
+"""Lint engine core: findings, programs, the jaxpr walker, waivers.
+
+Design constraints:
+
+- **Stable fingerprints.**  A finding's identity must survive line-number
+  churn and config permutations, or the waiver baseline rots on every
+  edit.  Fingerprints are ``rule:file:function:detail`` — the file and
+  function come from the equation's user-level source frame
+  (``eqn.source_info``), the detail from the rule (primitive + dtype,
+  scope name, ...).  Line numbers are reported for humans but excluded
+  from the identity.
+- **Full recursion.**  Every rule sees the whole program: the walker
+  descends into scan/while/cond/pjit sub-jaxprs (the round is a scan
+  body full of conds — a non-recursive walk would audit almost nothing).
+- **Waivers are pinned, not patterns.**  ``waivers.WAIVERS`` maps exact
+  fingerprints to documented reasons.  An unwaived finding fails; in
+  full-matrix runs a waiver that matched nothing fails too (stale
+  baseline — the exception it documented no longer exists).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.extend.core as jex_core
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class Finding(NamedTuple):
+    """One rule violation at one program site."""
+
+    rule: str       # rule name (rules.PROGRAM_RULES / PACKAGE_RULES key)
+    file: str       # repo-relative path of the user-level source frame
+    func: str       # function name at that frame ("?" when unknown)
+    detail: str     # rule-specific identity tail (primitive@dtype, ...)
+    message: str    # human-readable description
+    program: str = ""   # traced-program name ("" for package rules)
+    line: int = 0       # human context only — NOT part of the identity
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.file}:{self.func}:{self.detail}"
+
+
+class Program(NamedTuple):
+    """One traced program under audit."""
+
+    name: str
+    closed_jaxpr: Any   # jax.extend.core.ClosedJaxpr
+    cfg: Any            # partisan_tpu.config.Config (or None)
+    capture: bool = False   # traced with send-path capture (budget 1)
+    state: Any = None       # input-state template (abstract leaves ok)
+
+
+class Report(NamedTuple):
+    findings: list      # unwaived Findings — any entry is a failure
+    waived: list        # (Finding, reason) pairs the baseline covers
+    stale: list         # waiver fingerprints nothing matched (full runs)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale
+
+
+def trace_program(name: str, fn: Callable, state: Any, cfg: Any, *,
+                  capture: bool = False) -> Program:
+    """Trace ``fn(state)`` to a ClosedJaxpr (no compile, no device
+    work — ``state`` may be an abstract ``jax.eval_shape`` template)."""
+    return Program(name=name, closed_jaxpr=jax.make_jaxpr(fn)(state),
+                   cfg=cfg, capture=capture, state=state)
+
+
+# ---------------------------------------------------------------------------
+# The recursive walker
+# ---------------------------------------------------------------------------
+
+def sub_jaxprs(params: dict):
+    """Every Jaxpr found in an equation's params, as ClosedJaxprs
+    (scan/while 'jaxpr', cond 'branches', pjit 'jaxpr', custom calls)."""
+    for v in params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if isinstance(x, jex_core.ClosedJaxpr):
+                yield x
+            elif isinstance(x, jex_core.Jaxpr):
+                yield jex_core.ClosedJaxpr(x, ())
+
+
+def iter_eqns(jaxpr):
+    """Yield every equation of ``jaxpr`` and all its sub-jaxprs,
+    depth-first.  Accepts a Jaxpr or ClosedJaxpr."""
+    if isinstance(jaxpr, jex_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _rel(path: str) -> str:
+    """Repo-relative path (fingerprint-stable across checkouts)."""
+    ap = os.path.abspath(path)
+    if ap.startswith(_REPO_ROOT + os.sep):
+        return os.path.relpath(ap, _REPO_ROOT)
+    return os.path.basename(path)
+
+
+def site_of(eqn) -> tuple[str, str, int]:
+    """(file, function, line) of the equation's user-level source frame
+    — jax-internal frames are filtered by source_info's own user-frame
+    logic; everything degrades to ("?", "?", 0) rather than raising
+    (source_info layout is not a public API)."""
+    try:
+        from jax._src import source_info_util as siu
+
+        fr = siu.user_frame(eqn.source_info)
+        if fr is not None:
+            return _rel(fr.file_name), fr.function_name, fr.start_line
+    except Exception:
+        pass
+    return "?", "?", 0
+
+
+def scope_of(eqn) -> str:
+    """The equation's named_scope stack ("" when unscoped).  This is
+    the real phase label the profiler sees — unlike ``str(jaxpr)``
+    greps, which never contain scope names at all (the pre-lint
+    zero-cost-when-off string asserts were vacuous)."""
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# Running rules + applying the waiver baseline
+# ---------------------------------------------------------------------------
+
+def run_programs(programs, *, rules=None, package_rules=None,
+                 waivers=None, check_stale: bool = False) -> Report:
+    """Run program rules over every program (and package rules once),
+    split findings by the waiver baseline.  ``rules``/``package_rules``
+    are name lists (default: all registered); ``waivers`` maps
+    fingerprint -> reason (default: the pinned baseline).
+    ``check_stale=True`` (full-matrix runs only — subsets legitimately
+    leave waivers unmatched) reports baseline entries nothing used."""
+    from partisan_tpu.lint import rules as rules_mod
+    from partisan_tpu.lint import waivers as waivers_mod
+
+    if waivers is None:
+        waivers = waivers_mod.WAIVERS
+    prog_rules = rules_mod.PROGRAM_RULES if rules is None else {
+        k: rules_mod.PROGRAM_RULES[k] for k in rules}
+    pkg_rules = rules_mod.PACKAGE_RULES if package_rules is None else {
+        k: rules_mod.PACKAGE_RULES[k] for k in package_rules}
+
+    found: list[Finding] = []
+    for prog in programs:
+        for name, rule in prog_rules.items():
+            for f in rule(prog):
+                found.append(f._replace(rule=name, program=prog.name))
+    for name, rule in pkg_rules.items():
+        for f in rule():
+            found.append(f._replace(rule=name))
+
+    findings, waived = [], []
+    matched = set()
+    for f in found:
+        reason = waivers.get(f.fingerprint)
+        if reason is None:
+            findings.append(f)
+        else:
+            waived.append((f, reason))
+            matched.add(f.fingerprint)
+    stale = sorted(set(waivers) - matched) if check_stale else []
+    return Report(findings=findings, waived=waived, stale=stale)

@@ -1,0 +1,296 @@
+"""Conservative value-range propagation over jaxpr equations.
+
+The narrow-dtype overflow rule's engine (rules.py): every integer
+variable carries an interval ``[lo, hi]`` — literals and closed-over
+consts get their actual min/max, unknown inputs get their dtype's full
+range — and every equation propagates intervals in EXACT (unbounded)
+integer arithmetic.  A write whose exact-math interval does not fit the
+equation's output dtype, where that dtype is one of the narrow wire
+dtypes (types.NARROW_WIRE_DTYPES: int8/int16), is an overflow finding.
+
+This is precisely the shape of the PR 6 bug this rule exists to catch:
+``provenance.record_round`` clipped the int16 hop plane BEFORE widening
+— ``jnp.clip(hop_i16, 0, hop_max)`` with ``hop_max = 2**26 - 1`` — so
+the bound wrapped to ``-1`` as int16 and every claim's hop pinned to
+-1.  In the jaxpr that is a ``convert_element_type[int16]`` over a
+literal whose interval ``[2**26-1, 2**26-1]`` exceeds int16 (flagged),
+followed by an inverted ``min/max`` clamp (flagged independently when
+it survives as a ``clamp`` equation).  The analysis is conservative by
+construction: an unknown int32 narrowed to int16 flags even if the
+runtime values happen to fit — such sites are either restructured to
+clip-then-narrow (self-evidently safe) or pinned in the waiver baseline
+with the reason the range is actually bounded.
+
+Interval transfer is implemented for the primitives the round program
+actually narrows through (converts, add/sub/mul/neg, min/max, clamp,
+select, shape ops, concatenate, pad, iota, scatter flavors, calls and
+control flow); anything unknown degrades to the output dtype's full
+range — never unsound, at worst noisier.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.extend.core as jex_core
+
+from partisan_tpu import types as T
+from partisan_tpu.lint.core import Finding, site_of
+
+# The audited dtypes are DERIVED from the wire-packing map, so
+# narrowing another word in types.NARROW_WIRE_DTYPES automatically
+# extends this rule to it ("int16" unioned explicitly: the provenance
+# hop word narrows via types.wire_dtype's positional special case, not
+# the map).
+NARROW_DTYPES = tuple(sorted(
+    set(T.NARROW_WIRE_DTYPES.values()) | {"int16"}))
+
+# Shape/order-preserving primitives: output range == operand-0 range.
+_PASSTHROUGH = frozenset((
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+    "dynamic_slice", "rev", "copy", "stop_gradient", "expand_dims",
+    "gather", "reduce_max", "reduce_min", "cummax", "cummin", "sort",
+))
+
+# Call-like primitives: one sub-jaxpr, eqn invars map 1:1 onto its
+# invars and its outputs ARE the eqn outputs.
+_CALL_PRIMS = frozenset((
+    "pjit", "closed_call", "core_call", "xla_call", "custom_jvp_call",
+    "custom_vjp_call", "remat", "checkpoint",
+))
+
+
+def dtype_bounds(dt):
+    """(lo, hi) for integer dtypes, None for anything else (floats,
+    bools, PRNG keys — untracked)."""
+    try:
+        dt = np.dtype(dt)
+    except TypeError:
+        return None
+    if dt.kind in "iu":
+        ii = np.iinfo(dt)
+        return (int(ii.min), int(ii.max))
+    return None
+
+
+def _val_interval(v):
+    try:
+        a = np.asarray(v)
+    except Exception:
+        return None
+    if a.dtype.kind not in "iu":
+        return None
+    if a.size == 0:
+        return dtype_bounds(a.dtype)
+    return (int(a.min()), int(a.max()))
+
+
+class Analyzer:
+    """One pass over a ClosedJaxpr; overflow findings accumulate in
+    ``self.findings`` (detail = ``primitive@dtype`` — line-stable)."""
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+
+    # ---- entry -------------------------------------------------------
+    def analyze(self, closed_jaxpr) -> list[Finding]:
+        self._run_closed(closed_jaxpr, None, None)
+        return self.findings
+
+    # ---- env plumbing ------------------------------------------------
+    def _atom(self, env, a):
+        if isinstance(a, jex_core.Literal):
+            return _val_interval(a.val)
+        iv = env.get(a)
+        if iv is not None:
+            return iv
+        return dtype_bounds(getattr(a.aval, "dtype", None))
+
+    def _flag(self, eqn, odt, msg):
+        file, func, line = site_of(eqn)
+        self.findings.append(Finding(
+            rule="narrow-dtype-overflow", file=file, func=func,
+            detail=f"{eqn.primitive.name}@{odt}", message=msg,
+            line=line))
+
+    def _run_closed(self, cj, srcs, outer_env):
+        """Run a ClosedJaxpr; ``srcs`` maps its invars to outer atoms
+        (None entries = unknown, e.g. a scan carry)."""
+        env: dict = {}
+        for cv, cval in zip(cj.jaxpr.constvars, cj.consts):
+            env[cv] = _val_interval(cval)
+        if srcs is not None:
+            for iv_var, src in zip(cj.jaxpr.invars, srcs):
+                if src is not None:
+                    env[iv_var] = self._atom(outer_env, src)
+        self._run(cj.jaxpr, env)
+        return [env.get(o) if isinstance(o, jex_core.Var)
+                else _val_interval(getattr(o, "val", None))
+                for o in cj.jaxpr.outvars]
+
+    def _run(self, jaxpr, env):
+        for eqn in jaxpr.eqns:
+            self._eqn(env, eqn)
+
+    # ---- recursion into control flow / calls -------------------------
+    def _recurse(self, env, eqn):
+        """Handle sub-jaxpr-bearing equations.  Returns out intervals
+        (or None when the primitive was not one of ours)."""
+        p, params = eqn.primitive.name, eqn.params
+        if p in _CALL_PRIMS and "jaxpr" in params:
+            cj = params["jaxpr"]
+            if isinstance(cj, jex_core.Jaxpr):
+                cj = jex_core.ClosedJaxpr(cj, ())
+            if "call_jaxpr" in params:      # custom_* variants
+                cj = params["call_jaxpr"]
+            n = len(cj.jaxpr.invars)
+            return self._run_closed(cj, list(eqn.invars[:n]), env)
+        if p == "scan":
+            cj = params["jaxpr"]
+            nc = params["num_consts"]
+            # consts map through; carry/xs vary per iteration -> unknown
+            srcs = list(eqn.invars[:nc]) \
+                + [None] * (len(cj.jaxpr.invars) - nc)
+            self._run_closed(cj, srcs, env)
+            return [dtype_bounds(getattr(o.aval, "dtype", None))
+                    for o in eqn.outvars]
+        if p == "while":
+            cj_c, cj_b = params["cond_jaxpr"], params["body_jaxpr"]
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            self._run_closed(cj_c, list(eqn.invars[:cn])
+                             + [None] * (len(cj_c.jaxpr.invars) - cn),
+                             env)
+            self._run_closed(cj_b, list(eqn.invars[cn:cn + bn])
+                             + [None] * (len(cj_b.jaxpr.invars) - bn),
+                             env)
+            return [dtype_bounds(getattr(o.aval, "dtype", None))
+                    for o in eqn.outvars]
+        if p == "cond":
+            outs = None
+            for br in params["branches"]:
+                b_out = self._run_closed(br, list(eqn.invars[1:]), env)
+                if outs is None:
+                    outs = list(b_out)
+                else:           # union across branches
+                    outs = [None if (a is None or b is None)
+                            else (min(a[0], b[0]), max(a[1], b[1]))
+                            for a, b in zip(outs, b_out)]
+            return outs
+        # unknown sub-jaxpr-bearing primitive: still audit its body
+        from partisan_tpu.lint.core import sub_jaxprs
+
+        recursed = False
+        for sub in sub_jaxprs(params):
+            recursed = True
+            self._run_closed(sub, None, None)
+        if recursed:
+            return [dtype_bounds(getattr(o.aval, "dtype", None))
+                    for o in eqn.outvars]
+        return None
+
+    # ---- per-equation transfer ---------------------------------------
+    def _eqn(self, env, eqn):
+        sub_out = self._recurse(env, eqn)
+        if sub_out is not None:
+            for o, iv in zip(eqn.outvars, sub_out):
+                ob = dtype_bounds(getattr(o.aval, "dtype", None))
+                env[o] = iv if iv is not None else ob
+            return
+
+        p = eqn.primitive.name
+        ins = [self._atom(env, a) for a in eqn.invars]
+        odt = getattr(eqn.outvars[0].aval, "dtype", None)
+        ob = dtype_bounds(odt)
+        narrow = odt is not None and str(odt) in NARROW_DTYPES
+        res = ob
+
+        def exact(lo, hi):
+            """Exact-math interval; flags + saturates on overflow."""
+            nonlocal res
+            if ob is not None and (lo < ob[0] or hi > ob[1]):
+                if narrow:
+                    self._flag(eqn, odt,
+                               f"{p}: exact range [{lo}, {hi}] "
+                               f"overflows {odt}")
+                res = ob
+            else:
+                res = (lo, hi)
+
+        if p == "convert_element_type":
+            iv = ins[0]
+            if iv is not None and ob is not None:
+                if iv[0] < ob[0] or iv[1] > ob[1]:
+                    if narrow:
+                        self._flag(
+                            eqn, odt,
+                            f"narrowing value range [{iv[0]}, {iv[1]}] "
+                            f"to {odt} can wrap")
+                    res = ob
+                else:
+                    res = iv
+        elif p in ("add", "sub", "mul", "neg") and ob is not None:
+            a = ins[0]
+            b = ins[1] if len(ins) > 1 else None
+            if a is None or (p != "neg" and b is None):
+                res = ob
+            elif p == "add":
+                exact(a[0] + b[0], a[1] + b[1])
+            elif p == "sub":
+                exact(a[0] - b[1], a[1] - b[0])
+            elif p == "neg":
+                exact(-a[1], -a[0])
+            else:
+                c = (a[0] * b[0], a[0] * b[1], a[1] * b[0], a[1] * b[1])
+                exact(min(c), max(c))
+        elif p in ("max", "min") and None not in ins[:2]:
+            a, b = ins[0], ins[1]
+            res = ((max(a[0], b[0]), max(a[1], b[1])) if p == "max"
+                   else (min(a[0], b[0]), min(a[1], b[1])))
+        elif p == "clamp":
+            lo, x, hi = ins
+            if lo is not None and hi is not None and hi[1] < lo[0]:
+                self._flag(eqn, odt,
+                           f"inverted clamp: max [{hi[0]}, {hi[1]}] < "
+                           f"min [{lo[0]}, {lo[1]}] — wrapped bound?")
+            if None not in (lo, x, hi):
+                # clamp(lo, x, hi) = min(max(x, lo), hi) is monotone in
+                # every operand, so the hull is ENDPOINT-WISE: the
+                # lower result endpoint takes every operand's lower
+                # endpoint (a computed hi bound can pull results down
+                # to its own minimum), the upper takes every upper.
+                res = (min(max(x[0], lo[0]), hi[0]),
+                       min(max(x[1], lo[1]), hi[1]))
+        elif p == "select_n":
+            cases = ins[1:]
+            if cases and all(c is not None for c in cases):
+                res = (min(c[0] for c in cases),
+                       max(c[1] for c in cases))
+        elif p in _PASSTHROUGH:
+            if ins and ins[0] is not None:
+                res = ins[0]
+        elif p == "concatenate":
+            if ins and all(iv is not None for iv in ins):
+                res = (min(iv[0] for iv in ins),
+                       max(iv[1] for iv in ins))
+        elif p == "pad":
+            if len(ins) >= 2 and None not in ins[:2]:
+                res = (min(ins[0][0], ins[1][0]),
+                       max(ins[0][1], ins[1][1]))
+        elif p == "iota":
+            dim = eqn.params["shape"][eqn.params["dimension"]]
+            res = (0, max(0, dim - 1))
+        elif p.startswith("scatter"):
+            op = ins[0] if ins else None
+            upd = ins[2] if len(ins) > 2 else None
+            if op is not None and upd is not None:
+                if p in ("scatter", "scatter-max", "scatter-min"):
+                    res = (min(op[0], upd[0]), max(op[1], upd[1]))
+                elif p == "scatter-add" and narrow:
+                    # additive accumulation into a narrow buffer: the
+                    # sum is unbounded by the update range alone — the
+                    # dtype bound stands, no exact claim possible
+                    res = ob
+
+        for o in eqn.outvars:
+            b = dtype_bounds(getattr(o.aval, "dtype", None))
+            env[o] = res if o is eqn.outvars[0] else b

@@ -1,0 +1,111 @@
+"""The audited config matrix: which traced programs the linter walks.
+
+One entry per program SHAPE the repo actually ships — each plane on
+alone and all together, both wire layouts, the width operand, the
+capture and flight variants (the two programs allowed one interleave),
+the OTP service stack, and the soak chunk scan.  Tracing is
+``jax.make_jaxpr`` over an abstract ``jax.eval_shape`` state — no
+compile, no device work — so the full matrix stays tier-1 cheap
+(~1 s/program on CPU).
+
+``msg_words=17`` throughout, for the same reason as the program-budget
+tests: the interleave rule's width window {msg_words..wire_words} must
+stay disjoint from every other trailing dimension in the round
+(``inbox_cap=16`` would alias ``msg_words=16`` and false-positive on
+unrelated [n, cap]-trailing transposes).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from partisan_tpu.config import Config, PlumtreeConfig
+from partisan_tpu.lint.core import Program, trace_program
+
+
+def base_cfg(n: int = 32, **kw) -> Config:
+    """The hyparview+plumtree round the bench/scenario path runs."""
+    kw.setdefault("msg_words", 17)
+    kw.setdefault("plumtree", PlumtreeConfig(push_slots=2, lazy_cap=4))
+    return Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
+                  partition_mode="groups", max_broadcasts=8,
+                  inbox_cap=16, timer_stagger=False, **kw)
+
+
+def full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
+    """Every observability plane on + the width operand (the sharding
+    completeness rule's reference state)."""
+    return base_cfg(n, metrics=True, metrics_ring=16, latency=True,
+                    provenance=True, provenance_ring=16, health=4,
+                    health_ring=8, width_operand=True,
+                    flight_rounds=2 if flight else 0, **kw)
+
+
+def _round_program(name: str, cfg: Config, model=None, *,
+                   capture: bool = False, scan: int = 0) -> Program:
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.models.plumtree import Plumtree
+
+    cl = Cluster(cfg, model=Plumtree() if model is None else model)
+    state = jax.eval_shape(cl._build_init)
+    if capture:
+        fn = cl._round_traced
+    elif scan:
+        fn = lambda s: cl._scan(s, scan)   # noqa: E731 — scan program
+    else:
+        fn = cl._round
+    return trace_program(name, fn, state, cfg, capture=capture)
+
+
+def _otp_stack_program() -> Program:
+    """The OTP service stack round (rpc + monitor over fullmesh) — the
+    program test_program_budget's OTP budget guard traces."""
+    from partisan_tpu.models.stack import Stack
+    from partisan_tpu.otp import monitor as mon_mod
+    from partisan_tpu.otp import rpc as rpc_mod
+
+    stack = Stack([rpc_mod.RpcService((lambda x: x + 1,)),
+                   mon_mod.MonitorService()])
+    cfg = Config(n_nodes=8, seed=13, msg_words=17, inbox_cap=48,
+                 timer_stagger=False)
+    return _round_program("round/otp-stack", cfg, model=stack)
+
+
+def quick_matrix() -> list[Program]:
+    """The bench-verdict / CLI-smoke subset: the three highest-value
+    programs (plain round, everything-on scan, capture round)."""
+    return [
+        _round_program("round/planes-off", base_cfg()),
+        _round_program("scan/all-planes+width", full_cfg(), scan=4),
+        _round_program("round/all-planes/capture", full_cfg(),
+                       capture=True),
+    ]
+
+
+def default_matrix() -> list[Program]:
+    """The full audited matrix (tier-1 + tools/jaxlint.py)."""
+    progs = [
+        _round_program("round/planes-off", base_cfg()),
+        _round_program("round/planes-off/legacy-layout",
+                       base_cfg(plane_major=False)),
+        _round_program("round/metrics",
+                       base_cfg(metrics=True, metrics_ring=16)),
+        _round_program("round/latency", base_cfg(latency=True)),
+        _round_program("round/health",
+                       base_cfg(health=4, health_ring=8)),
+        _round_program("round/provenance",
+                       base_cfg(provenance=True, provenance_ring=16)),
+        _round_program("round/all-planes+width", full_cfg()),
+        _round_program("round/all-planes/capture", full_cfg(),
+                       capture=True),
+        _round_program("round/all-planes/flight",
+                       full_cfg(flight=True)),
+        _round_program("scan/all-planes+width", full_cfg(), scan=4),
+        _otp_stack_program(),
+        # the soak chunk program: what soak.py's chunked engine
+        # dispatches between checkpoints (scan over the full carry,
+        # flight ring included — the breach-dump source)
+        _round_program("scan/soak-chunk",
+                       full_cfg(n=16, flight=True), scan=4),
+    ]
+    return progs

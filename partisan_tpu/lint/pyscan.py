@@ -1,0 +1,226 @@
+"""Python-level static hygiene: a pyflakes-lite fallback.
+
+The tier-1 hygiene gate (tests/test_lint.py) prefers a real ``ruff
+check`` under the pinned config in ``ruff.toml``; this module is the
+dependency-free fallback for environments without ruff (this repo's
+container bakes no lint toolchain and installing one is off the table).
+It implements the same rule subset the pinned config selects, scoped
+the way pyflakes scopes them:
+
+- **F401** unused import — per-scope (module / function / class body):
+  an import is used if its bound name is loaded anywhere in the binding
+  scope's subtree (nested functions included — closure lookup), named
+  in ``__all__``, or explicitly re-exported via a self-alias
+  (``import x as x`` / ``from m import y as y``).  ``__init__.py``
+  files are exempt wholesale (re-export surface), matching the
+  per-file-ignores in ruff.toml.
+- **F403** ``from m import *`` — bans the one construct that makes
+  usage analysis (human or machine) impossible.
+- **E401** multiple modules on one ``import`` statement.
+
+``# noqa`` / ``# noqa: CODE`` comments on the flagged line suppress,
+same contract as ruff.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import NamedTuple
+
+_NOQA = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?",
+                   re.IGNORECASE)
+
+
+class PyFinding(NamedTuple):
+    file: str
+    line: int
+    code: str       # F401 | F403 | E401
+    message: str
+
+
+def _noqa_lines(src: str) -> dict[int, set[str] | None]:
+    """line -> suppressed codes (None = bare noqa, suppress all)."""
+    out: dict[int, set[str] | None] = {}
+    for i, ln in enumerate(src.splitlines(), 1):
+        m = _NOQA.search(ln)
+        if m:
+            codes = m.group("codes")
+            out[i] = ({c.strip().upper() for c in codes.split(",")}
+                      if codes else None)
+    return out
+
+
+class _Scope:
+    """One binding scope: module, function, or class body."""
+
+    def __init__(self, node):
+        self.node = node
+        # bound name -> (lineno, display, self_aliased)
+        self.imports: dict[str, tuple[int, str, bool]] = {}
+        self.used: set[str] = set()
+        self.children: list[_Scope] = []
+
+    def all_used(self) -> set[str]:
+        u = set(self.used)
+        for c in self.children:
+            u |= c.all_used()
+        return u
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _scan_nodes(nodes, scope, findings, noqa, fname):
+    """Walk a statement/expression list inside one binding scope,
+    recording import bindings and name uses, descending into nested
+    scopes with fresh _Scope children."""
+    for child in nodes:
+        if isinstance(child, ast.Import):
+            if len(child.names) > 1 and not _skip(noqa, child.lineno,
+                                                  "E401"):
+                findings.append(PyFinding(
+                    fname, child.lineno, "E401",
+                    "multiple imports on one line: "
+                    + ", ".join(a.name for a in child.names)))
+            for a in child.names:
+                bound = (a.asname or a.name).split(".")[0]
+                scope.imports[bound] = (
+                    child.lineno, a.name, a.asname == a.name)
+            continue
+        if isinstance(child, ast.ImportFrom):
+            if child.module == "__future__":
+                continue
+            for a in child.names:
+                if a.name == "*":
+                    if not _skip(noqa, child.lineno, "F403"):
+                        findings.append(PyFinding(
+                            fname, child.lineno, "F403",
+                            f"star import from "
+                            f"{child.module or '.'}"))
+                    continue
+                bound = a.asname or a.name
+                scope.imports[bound] = (
+                    child.lineno,
+                    f"{child.module or '.'}.{a.name}",
+                    a.asname == a.name)
+            continue
+        if isinstance(child, _SCOPE_NODES):
+            sub = _Scope(child)
+            scope.children.append(sub)
+            # decorators/defaults/annotations/bases evaluate in the
+            # ENCLOSING scope
+            for field in ("decorator_list", "bases", "keywords"):
+                for n in getattr(child, field, ()):
+                    _uses(n, scope)
+            args = getattr(child, "args", None)
+            if args is not None:
+                _ann_names(args, scope)
+            returns = getattr(child, "returns", None)
+            if returns is not None:
+                _ann_names(returns, scope)
+            _scan_nodes(child.body, sub, findings, noqa, fname)
+            continue
+        if isinstance(child, ast.Name):
+            scope.used.add(child.id)
+        if isinstance(child, ast.AnnAssign) \
+                and child.annotation is not None:
+            _ann_names(child.annotation, scope)
+        _scan_nodes(ast.iter_child_nodes(child), scope, findings,
+                    noqa, fname)
+
+
+def _ann_names(ann, scope):
+    """Names in an annotation subtree, parsing quoted annotations the
+    way pyflakes does (``api: "Callable[[], ...]"`` marks Callable
+    used)."""
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Name):
+            scope.used.add(n.id)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            try:
+                sub = ast.parse(n.value, mode="eval")
+            except SyntaxError:
+                continue
+            for m in ast.walk(sub):
+                if isinstance(m, ast.Name):
+                    scope.used.add(m.id)
+
+
+def _uses(node, scope):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            scope.used.add(n.id)
+
+
+def _skip(noqa, line, code) -> bool:
+    if line not in noqa:
+        return False
+    codes = noqa[line]
+    return codes is None or code.upper() in codes
+
+
+def _dunder_all(tree) -> set[str]:
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for el in ast.walk(node.value):
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            names.add(el.value)
+    return names
+
+
+def scan_file(path: str, rel: str | None = None) -> list[PyFinding]:
+    rel = rel or path
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as exc:
+        return [PyFinding(rel, exc.lineno or 0, "E999",
+                          f"syntax error: {exc.msg}")]
+    noqa = _noqa_lines(src)
+    findings: list[PyFinding] = []
+    root = _Scope(tree)
+    _scan_nodes(tree.body, root, findings, noqa, rel)
+    is_init = os.path.basename(path) == "__init__.py"
+    exported = _dunder_all(tree)
+
+    def walk_scope(scope):
+        used = scope.all_used()
+        for bound, (line, display, self_alias) in scope.imports.items():
+            if self_alias or bound in used:
+                continue
+            if scope is root and bound in exported:
+                continue
+            if is_init or _skip(noqa, line, "F401"):
+                continue
+            findings.append(PyFinding(
+                rel, line, "F401", f"unused import: {display}"
+                + (f" (as {bound})" if bound not in display.split(".")
+                   else "")))
+        for c in scope.children:
+            walk_scope(c)
+
+    walk_scope(root)
+    return sorted(findings, key=lambda f: (f.file, f.line, f.code))
+
+
+def scan_tree(root: str, rel_to: str | None = None) -> list[PyFinding]:
+    """Scan every .py under ``root`` (file or directory), skipping
+    __pycache__.  Paths in findings are relative to ``rel_to``."""
+    rel_to = rel_to or os.getcwd()
+    out: list[PyFinding] = []
+    if os.path.isfile(root):
+        return scan_file(root, os.path.relpath(root, rel_to))
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for f in sorted(files):
+            if f.endswith(".py"):
+                p = os.path.join(dirpath, f)
+                out += scan_file(p, os.path.relpath(p, rel_to))
+    return out

@@ -1,0 +1,322 @@
+"""The rule catalog.  Each rule is grounded in an invariant the repo
+already relies on (and previously policed ad hoc, or not at all):
+
+- **no-host-callback** — the planes' "zero host syncs inside the scan"
+  contract (previously four copy-pasted string greps over str(jaxpr)).
+- **interleave-budget** — the plane-major pipeline's one-interleave-per-
+  round contract (previously ``tests/test_program_budget.py``'s local
+  counter; that counter now lives here and the budget tests call it).
+- **zero-cost-when-off** — a disabled plane compiles NOTHING into the
+  round (its ``round.*`` named_scope phases are absent from the traced
+  program's name stacks — the old ``"round.latency" not in str(jaxpr)``
+  asserts were vacuous, scope names never print — and its carry leaf is
+  an empty ``()``).
+- **narrow-dtype-overflow** — conservative value-range propagation over
+  writes into the bytes-first int8/int16 planes
+  (``types.NARROW_WIRE_DTYPES``); the PR 6 hop-clip bug's shape.
+- **scatter-overlap** — nondeterministic overlapping writes: a plain
+  (replace-semantics) scatter without ``unique_indices``, or chained
+  non-unique scatters into one buffer inside one phase — the race
+  detector for the vmapped state machines.
+- **sharding-spec-completeness** — every ClusterState leaf (plane
+  leaves included) has a PartitionSpec in ``parallel/sharded.py``; a
+  new carry field that defaults to ``()`` in ``_state_specs`` while the
+  state carries arrays is exactly how a sharded run silently diverges.
+"""
+
+from __future__ import annotations
+
+from partisan_tpu.lint.core import (
+    Finding,
+    Program,
+    iter_eqns,
+    scope_of,
+    site_of,
+    sub_jaxprs,
+)
+
+# ---------------------------------------------------------------------------
+# no-host-callback
+# ---------------------------------------------------------------------------
+
+# Primitive names that move data across the device/host boundary inside
+# a program: any of these inside a jitted round/scan breaks the planes'
+# scan-carry contract (and stalls the relay on every round).
+_HOST_PRIMS = ("callback", "outfeed", "infeed", "debug_print")
+
+
+def no_host_callback(prog: Program) -> list[Finding]:
+    out = []
+    for eqn in iter_eqns(prog.closed_jaxpr):
+        name = eqn.primitive.name
+        if any(h in name for h in _HOST_PRIMS):
+            file, func, line = site_of(eqn)
+            out.append(Finding(
+                rule="", file=file, func=func, detail=name, line=line,
+                message=f"host-boundary primitive '{name}' inside the "
+                        f"jitted program"))
+    # belt-and-braces: effects promoted to the program level (a callback
+    # that somehow traced without its usual primitive name still carries
+    # an IO/callback effect class)
+    for eff in getattr(prog.closed_jaxpr, "effects", ()):
+        en = type(eff).__name__
+        if "IO" in en or "Callback" in en:
+            out.append(Finding(
+                rule="", file="<program>", func=prog.name,
+                detail=f"effect:{en}", line=0,
+                message=f"program carries host effect {en}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# interleave-budget (the re-homed tests/test_program_budget.py counter)
+# ---------------------------------------------------------------------------
+
+def _find_interleaves(jaxpr, widths):
+    """(offending_eqns, total_eqns): concatenates/transposes whose
+    OUTPUT carries a record-width minor axis on an [n, slots, W]
+    (ndim >= 3) tensor — the wire-layout materialization signature.
+    Recurses into cond/scan/while/pjit sub-jaxprs.  ``widths`` covers
+    msg_words..wire_words so pre- and post-stamp stacks both count."""
+    import jax.extend.core as jex_core
+
+    if isinstance(jaxpr, jex_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    eqns, n_eqns = [], 0
+    for eqn in jaxpr.eqns:
+        n_eqns += 1
+        out = eqn.outvars[0].aval
+        if (eqn.primitive.name in ("concatenate", "transpose")
+                and getattr(out, "ndim", 0) >= 3
+                and out.shape[-1] in widths):
+            if eqn.primitive.name == "concatenate":
+                if eqn.params["dimension"] == out.ndim - 1:
+                    eqns.append(eqn)
+            else:
+                perm = eqn.params["permutation"]
+                if perm[-1] != len(perm) - 1:   # minor axis moved
+                    eqns.append(eqn)
+        for sub in sub_jaxprs(eqn.params):
+            se, sn = _find_interleaves(sub, widths)
+            eqns += se
+            n_eqns += sn
+    return eqns, n_eqns
+
+
+def count_wire_interleaves(jaxpr, widths) -> tuple[int, int]:
+    """(interleave_count, total_equations) — the public counter the
+    program-budget tests call (single implementation, re-homed here
+    from tests/test_program_budget.py)."""
+    eqns, n_eqns = _find_interleaves(jaxpr, widths)
+    return len(eqns), n_eqns
+
+
+def interleave_budget(prog: Program) -> list[Finding]:
+    cfg = prog.cfg
+    if cfg is None or not cfg.plane_major:
+        return []   # the legacy interleaved layout re-stacks by design
+    budget = 1 if (prog.capture or cfg.flight_rounds) else 0
+    widths = set(range(cfg.msg_words, cfg.wire_words + 1))
+    eqns, _ = _find_interleaves(prog.closed_jaxpr, widths)
+    if len(eqns) <= budget:
+        return []
+    out = []
+    for eqn in eqns:
+        file, func, line = site_of(eqn)
+        out.append(Finding(
+            rule="", file=file, func=func,
+            detail=f"{eqn.primitive.name}", line=line,
+            message=f"wire interleave via {eqn.primitive.name} — "
+                    f"{len(eqns)} in program, budget {budget}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-when-off
+# ---------------------------------------------------------------------------
+
+def _planes_of(cfg):
+    """(plane name, enabled) for every optional carry plane."""
+    return (
+        ("metrics", bool(cfg.metrics)),
+        ("latency", bool(cfg.latency)),
+        ("flight", bool(cfg.flight_rounds)),
+        ("health", cfg.health > 0),
+        ("provenance", bool(cfg.provenance)),
+    )
+
+
+def zero_cost_when_off(prog: Program) -> list[Finding]:
+    cfg = prog.cfg
+    if cfg is None:
+        return []
+    off = [p for p, on in _planes_of(cfg) if not on]
+    on = [p for p, en in _planes_of(cfg) if en]
+    out = []
+    seen = set()
+    for eqn in iter_eqns(prog.closed_jaxpr):
+        scope = scope_of(eqn)
+        if not scope:
+            continue
+        for p in off + on:
+            tag = f"round.{p}"
+            if (scope == tag or scope.startswith(tag + "/")) \
+                    and p not in seen:
+                seen.add(p)
+                if p in on:
+                    continue
+                file, func, line = site_of(eqn)
+                out.append(Finding(
+                    rule="", file=file, func=func, detail=f"scope:{p}",
+                    line=line,
+                    message=f"phase '{tag}' compiled into the program "
+                            f"with the {p} plane OFF"))
+    # rule-keying guard, inverse direction: an ENABLED plane whose
+    # phase scope never appears means the named_scope label this rule
+    # keys on was renamed/removed in cluster.round_body — the off-check
+    # above would be vacuous from then on.
+    for p in on:
+        if p not in seen:
+            out.append(Finding(
+                rule="", file="partisan_tpu/cluster.py",
+                func="round_body", detail=f"scope-missing:{p}", line=0,
+                message=f"plane {p} is ON but no 'round.{p}' "
+                        f"named_scope appears in the traced program — "
+                        f"the zero-cost check's scope key has rotted"))
+    if prog.state is not None:
+        import jax.tree_util as jtu
+
+        for p in off:
+            leaf = getattr(prog.state, p, ())
+            if jtu.tree_leaves(leaf):
+                out.append(Finding(
+                    rule="", file="partisan_tpu/cluster.py",
+                    func="round_body", detail=f"carry:{p}", line=0,
+                    message=f"state carries a non-empty '{p}' leaf "
+                            f"with the plane OFF"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# narrow-dtype-overflow
+# ---------------------------------------------------------------------------
+
+def narrow_dtype_overflow(prog: Program) -> list[Finding]:
+    from partisan_tpu.lint.intervals import Analyzer
+
+    return Analyzer().analyze(prog.closed_jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# scatter-overlap
+# ---------------------------------------------------------------------------
+
+def _scatter_walk(jaxpr, out):
+    """Per-jaxpr scatter census: plain non-unique scatters, and chains
+    (a scatter whose operand buffer is another non-unique scatter's
+    output at the same level)."""
+    import jax.extend.core as jex_core
+
+    if isinstance(jaxpr, jex_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    produced = {}   # outvar -> eqn, scatter family only, this level
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name.startswith("scatter"):
+            unique = bool(eqn.params.get("unique_indices", False))
+            scope = scope_of(eqn) or "<unscoped>"
+            if name == "scatter" and not unique:
+                file, func, line = site_of(eqn)
+                out.append(Finding(
+                    rule="", file=file, func=func,
+                    detail=f"plain@{scope}", line=line,
+                    message="replace-semantics scatter without "
+                            "unique_indices: overlapping updates are "
+                            "nondeterministically ordered"))
+            op0 = eqn.invars[0]
+            prev = produced.get(op0)
+            if prev is not None and not unique \
+                    and not bool(prev.params.get("unique_indices",
+                                                 False)):
+                file, func, line = site_of(eqn)
+                out.append(Finding(
+                    rule="", file=file, func=func,
+                    detail=f"chain:{name}@{scope}", line=line,
+                    message=f"{name} over a buffer already written by "
+                            f"{prev.primitive.name} in phase "
+                            f"'{scope}', neither with unique_indices"))
+            for o in eqn.outvars:
+                produced[o] = eqn
+        for sub in sub_jaxprs(eqn.params):
+            _scatter_walk(sub, out)
+
+
+def scatter_overlap(prog: Program) -> list[Finding]:
+    out: list[Finding] = []
+    _scatter_walk(prog.closed_jaxpr, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sharding-spec-completeness (package rule)
+# ---------------------------------------------------------------------------
+
+def compare_specs(state, specs) -> list[Finding]:
+    """Findings for every state array leaf without a PartitionSpec at
+    the same tree path (and any spec path with no state leaf)."""
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec
+
+    s_paths = {jtu.keystr(p) for p, _ in
+               jtu.tree_leaves_with_path(state)}
+    p_paths = {jtu.keystr(p) for p, _ in jtu.tree_leaves_with_path(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec))}
+    out = []
+    for path in sorted(s_paths - p_paths):
+        out.append(Finding(
+            rule="", file="partisan_tpu/parallel/sharded.py",
+            func="_state_specs", detail=f"missing:{path}", line=0,
+            message=f"ClusterState leaf {path} has no PartitionSpec — "
+                    f"a sharded run will misplace or drop it"))
+    for path in sorted(p_paths - s_paths):
+        out.append(Finding(
+            rule="", file="partisan_tpu/parallel/sharded.py",
+            func="_state_specs", detail=f"orphan:{path}", line=0,
+            message=f"PartitionSpec at {path} matches no state leaf"))
+    return out
+
+
+def sharding_spec_completeness() -> list[Finding]:
+    """Build the full-featured state (every plane + flight + width
+    operand + delivery) abstractly and diff it against
+    ``ShardedCluster._state_specs`` — structure only, no device work
+    beyond a size-1 mesh object."""
+    import jax
+
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.lint.matrix import full_cfg
+    from partisan_tpu.models.plumtree import Plumtree
+    from partisan_tpu.parallel.sharded import ShardedCluster, make_mesh
+
+    cfg = full_cfg(flight=True)
+    cl = Cluster(cfg, model=Plumtree())
+    state = jax.eval_shape(cl._build_init)
+    sc = ShardedCluster(cfg, make_mesh(1), model=Plumtree())
+    return compare_specs(state, sc._state_specs(state))
+
+
+# ---------------------------------------------------------------------------
+# registries
+# ---------------------------------------------------------------------------
+
+PROGRAM_RULES = {
+    "no-host-callback": no_host_callback,
+    "interleave-budget": interleave_budget,
+    "zero-cost-when-off": zero_cost_when_off,
+    "narrow-dtype-overflow": narrow_dtype_overflow,
+    "scatter-overlap": scatter_overlap,
+}
+
+PACKAGE_RULES = {
+    "sharding-spec-completeness": sharding_spec_completeness,
+}

@@ -261,8 +261,6 @@ def totals(snap: dict) -> dict:
     """Whole-window aggregates — the reconciliation view against the
     legacy cumulative ``Stats`` counters (equal when the run fits the
     ring; see tests/test_metrics.py)."""
-    import numpy as np
-
     return {
         "rounds": int(len(snap["rounds"])),
         "emitted": int(snap["emitted"].sum()),

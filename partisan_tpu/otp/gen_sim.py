@@ -32,8 +32,6 @@ from partisan_tpu.managers.base import RoundCtx
 from partisan_tpu.ops import msg as msg_ops
 from partisan_tpu.ops import plane as plane_ops
 from partisan_tpu.otp import client as client_mod
-from partisan_tpu.otp.client import (
-    DOWN, IDLE, OK, QUEUED, TIMEOUT, WAITING)
 
 # server functions
 FN_INCR, FN_GET, FN_STOP = 1, 2, 3

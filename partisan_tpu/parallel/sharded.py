@@ -43,8 +43,7 @@ from partisan_tpu import provenance as provenance_mod
 from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
-from partisan_tpu.managers.base import RoundCtx
-from partisan_tpu.ops import exchange, gossip, rng
+from partisan_tpu.ops import exchange, gossip
 
 AXIS = "nodes"
 

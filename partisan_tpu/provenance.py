@@ -210,7 +210,10 @@ def stamp(cfg: Config, spec: ProvSpec | None, emitted,
     retransmissions.  Plane-major stacks grow two planes (no minor-axis
     concatenate); ``prov_src`` stays int32 (node ids), ``prov_hop``
     stores int16 (the claim accumulator clamps depth far below 2^15 —
-    see types.NARROW_WIRE_DTYPES)."""
+    see types.NARROW_WIRE_DTYPES).  The int32->int16 hop write below is
+    the lint narrow-dtype rule's one pinned waiver: the analyzer cannot
+    see the depth bound, the argument for it lives in
+    partisan_tpu/lint/waivers.py."""
     from partisan_tpu.ops import plane as plane_ops
 
     src = jnp.broadcast_to(gids.reshape(

@@ -144,7 +144,10 @@ def payload_words(msg_words: int) -> int:
 # int32, so a widened record is bit-identical to the legacy int32 path
 # at ANY horizon.  The map is data, not code: narrowing another word is
 # a one-line change here, gated by the parity matrix in
-# tests/test_faults.py / test_latency.py / test_provenance.py.
+# tests/test_faults.py / test_latency.py / test_provenance.py — AND by
+# the lint narrow-dtype-overflow rule (partisan_tpu/lint/intervals.py
+# derives its audited dtype set from this map), which statically flags
+# any write whose value range cannot fit the narrowed plane.
 NARROW_WIRE_DTYPES = {
     W_KIND: "int8",
     W_CHANNEL: "int8",

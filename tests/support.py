@@ -131,6 +131,45 @@ def plane_parity_case(mk_cfg, *, drive=None, record_k=8, label=""):
     return st_p, st_l
 
 
+# ---------------------------------------------------------------------------
+# Shared jaxpr-lint wrappers (partisan_tpu/lint): the single home of the
+# per-plane "no host callback inside the scan" and "zero cost when off"
+# checks that used to be copy-pasted string greps in
+# test_{metrics,health,latency,provenance}.py.  The lint rules are
+# strictly stronger: the callback check walks every sub-jaxpr's
+# primitive names (not str(jaxpr) substrings), and the zero-cost check
+# reads each equation's named_scope stack — which ``str(jaxpr)`` never
+# contains, so the old ``"round.latency" not in jaxpr`` asserts were
+# vacuous.
+# ---------------------------------------------------------------------------
+
+SCAN_LINT_RULES = ("no-host-callback", "zero-cost-when-off",
+                   "narrow-dtype-overflow", "scatter-overlap")
+
+
+def lint_scan(cl, st, k=8, *, rules=SCAN_LINT_RULES, name="test-scan"):
+    """Trace ``cl``'s k-round scan program and run the shared lint
+    rules over it (waiver baseline applied).  The interleave-budget
+    rule is excluded by default: its width window {msg_words..
+    wire_words} must be disjoint from other trailing dims, which only
+    configs built for it (msg_words=17) guarantee."""
+    from partisan_tpu import lint
+
+    prog = lint.trace_program(name, lambda s: cl._scan(s, k), st,
+                              cl.cfg)
+    return lint.run_programs([prog], rules=list(rules),
+                             package_rules=[])
+
+
+def assert_scan_lint_clean(cl, st, k=8, **kw):
+    """The migrated per-plane scan assert: zero unwaived lint findings
+    on the jitted k-round program."""
+    rep = lint_scan(cl, st, k, **kw)
+    assert not rep.findings, \
+        [f"{f.fingerprint}: {f.message}" for f in rep.findings]
+    return rep
+
+
 def components(active, alive, partition=None):
     """Connected components of the overlay (undirected union of active
     views), host-side — the numpy BFS the device health plane's

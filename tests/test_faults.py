@@ -160,8 +160,6 @@ def test_fast_wire_compaction_overflow_characterization():
     divergent round from an identical state, asserting the documented
     drop-counter delta — so the divergence stays bounded and
     intentional, not silent."""
-    import jax
-
     from partisan_tpu import interpose
     from partisan_tpu import metrics as metrics_mod
     from partisan_tpu.config import PlumtreeConfig

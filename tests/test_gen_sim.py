@@ -7,14 +7,12 @@ Covers the call / timeout / DOWN triad the reference's call path
 implements, plus cast, server serialization order, and stop semantics.
 """
 
-import jax.numpy as jnp
 import pytest
 
 from partisan_tpu import faults as faults_mod
 from partisan_tpu.cluster import Cluster
 from partisan_tpu.config import Config
 from partisan_tpu.models.stack import Stack
-from partisan_tpu.otp import gen_sim
 from partisan_tpu.otp.gen_sim import (
     FN_GET, FN_INCR, FN_STOP, GenServerService)
 

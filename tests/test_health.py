@@ -347,13 +347,11 @@ def test_snapshot_cadence_and_ring_wraparound():
 
 def test_health_state_is_scan_carry_no_callbacks():
     """No host transfer inside the scan: the health ring rides the
-    lax.scan carry."""
+    lax.scan carry (shared lint rules — see tests/support.py)."""
     cfg = support.hv_config(16, seed=1, health=2, health_ring=8)
     cl = Cluster(cfg)
     st = cl.init()
-    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 8))(st))
-    for prim in ("callback", "io_effect", "outfeed"):
-        assert prim not in jaxpr, prim
+    support.assert_scan_lint_clean(cl, st, 8)
     out = cl.steps(st, 8)
     assert health_mod.snapshot(out.health)["rounds"].tolist() == [1, 3, 5, 7]
 

@@ -1,0 +1,399 @@
+"""The jaxpr-level static auditor (partisan_tpu/lint): the tier-1 gate
+over the full config matrix, per-rule firing tests (a rule that cannot
+fail is not a guard), the PR 6 hop-clip regression fixture, and the
+Python-hygiene gate (ruff when installed, pyscan fallback otherwise).
+"""
+
+import os
+import shutil
+import subprocess
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import lint
+from partisan_tpu.lint import matrix, pyscan, rules, waivers
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CACHE: dict = {}
+
+
+def _matrix():
+    """Trace the audited matrix once per session (tracing is pure —
+    the per-rule tests below reuse the same Program objects)."""
+    if "matrix" not in _CACHE:
+        _CACHE["matrix"] = matrix.default_matrix()
+    return _CACHE["matrix"]
+
+
+# ---------------------------------------------------------------------------
+# The gate: the full audited matrix traces clean
+# ---------------------------------------------------------------------------
+
+def test_full_matrix_zero_unwaived_findings():
+    """The acceptance criterion: every program in the audited matrix
+    (each plane on/off, both layouts, width operand, capture + flight,
+    OTP stack, soak chunk) passes every rule with zero unwaived
+    findings — and no waiver is stale (the baseline cannot rot)."""
+    progs = _matrix()
+    assert len(progs) >= 10
+    rep = lint.run_programs(progs, check_stale=True)
+    assert not rep.findings, \
+        [f"{f.program} {f.fingerprint}: {f.message}"
+         for f in rep.findings]
+    assert not rep.stale, rep.stale
+    # the documented exceptions really are exercised (both pinned
+    # waivers matched — the baseline is live, not decorative)
+    assert {f.fingerprint for f, _ in rep.waived} \
+        == set(waivers.WAIVERS)
+
+
+# ---------------------------------------------------------------------------
+# narrow-dtype-overflow: the PR 6 hop-clip regression fixture
+# ---------------------------------------------------------------------------
+
+def _hop_clip(hop_plane, *, bits=6, widen_first):
+    """provenance.record_round's claim-hop read.  PR 6's bug was
+    ``widen_first=False``: clipping the int16 hop plane BEFORE widening
+    — ``hop_max = 2^(30-bits)-1`` wraps negative as int16 and
+    ``clip(x, 0, -1)`` pins every hop."""
+    hop_max = (1 << (30 - bits)) - 1
+    if widen_first:
+        return jnp.clip(hop_plane.astype(jnp.int32), 0, hop_max)
+    return jnp.clip(hop_plane, 0, hop_max).astype(jnp.int32)
+
+
+def _narrow_findings(fn, arg):
+    prog = lint.trace_program("fixture", fn, arg, None)
+    rep = lint.run_programs([prog], rules=["narrow-dtype-overflow"],
+                            package_rules=[], waivers={})
+    return rep.findings
+
+
+def test_narrow_dtype_rule_catches_hop_clip_regression():
+    """The reverted PR 6 ``provenance.record_round`` int16 hop-clip
+    overflow MUST fire the narrow-dtype rule (it previously shipped and
+    was only caught by a parity matrix); the fixed ordering — widen,
+    then clip — traces clean under the same rule."""
+    hop = jnp.zeros((8, 4), jnp.int16)   # the plane-major hop plane
+    bad = _narrow_findings(
+        lambda h: _hop_clip(h, widen_first=False), hop)
+    assert bad, "the reverted hop-clip bug produced no finding"
+    assert any("int16" in f.detail for f in bad), bad
+    # the bug is real, not a lint technicality: the wrapped bound pins
+    # every clipped hop to -1 at runtime
+    out = _hop_clip(jnp.full((2,), 5, jnp.int16), widen_first=False)
+    assert np.asarray(out).tolist() == [-1, -1]
+
+    good = _narrow_findings(
+        lambda h: _hop_clip(h, widen_first=True), hop)
+    assert not good, [f.message for f in good]
+    ok = np.asarray(_hop_clip(jnp.full((2,), 5, jnp.int16),
+                              widen_first=True))
+    assert ok.tolist() == [5, 5]
+
+
+def test_narrow_dtype_clamp_transfer_is_sound():
+    """A clamp whose hi bound is a COMPUTED value must not get a
+    falsely tight interval: lax.clamp(0, big_const, h) with h unknown
+    can return values as low as h's minimum, so narrowing the result to
+    int16 must flag (interval hulls are endpoint-wise — the lower
+    result endpoint takes hi's LOWER endpoint)."""
+    def f(h):
+        big = jnp.full((4,), 50, jnp.int32)
+        return jax.lax.clamp(jnp.int32(0), big, h).astype(jnp.int16)
+
+    dirty = _narrow_findings(f, jnp.zeros((4,), jnp.int32))
+    assert dirty, "computed-hi clamp result was assumed bounded"
+    # ...and with a literal hi that genuinely bounds, it stays clean
+    def g(h):
+        return jax.lax.clamp(jnp.int32(0), h,
+                             jnp.int32(100)).astype(jnp.int16)
+
+    assert not _narrow_findings(g, jnp.zeros((4,), jnp.int32))
+
+
+def test_narrow_dtype_rule_interval_precision():
+    """Bounded narrowing does NOT flag (clip-then-narrow is the
+    sanctioned shape); unbounded narrowing does."""
+    x32 = jnp.zeros((4,), jnp.int32)
+    clean = _narrow_findings(
+        lambda x: jnp.clip(x, 0, 127).astype(jnp.int8), x32)
+    assert not clean, [f.message for f in clean]
+    dirty = _narrow_findings(lambda x: x.astype(jnp.int8), x32)
+    assert dirty and "int8" in dirty[0].detail
+
+
+# ---------------------------------------------------------------------------
+# no-host-callback
+# ---------------------------------------------------------------------------
+
+def test_no_host_callback_rule_fires():
+    def with_cb(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    prog = lint.trace_program("cb", with_cb, jnp.ones(3), None)
+    rep = lint.run_programs([prog], rules=["no-host-callback"],
+                            package_rules=[], waivers={})
+    assert rep.findings and "callback" in rep.findings[0].detail
+
+    clean = lint.trace_program("ok", lambda x: x + 1, jnp.ones(3), None)
+    rep2 = lint.run_programs([clean], rules=["no-host-callback"],
+                             package_rules=[], waivers={})
+    assert not rep2.findings
+
+
+def test_no_host_callback_recurses_into_scan():
+    """A callback hidden inside a lax.scan body still fires — the
+    old str(jaxpr) greps only worked because str() flattens; the rule
+    must walk sub-jaxprs explicitly."""
+    def body(c, _):
+        c = jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(c.shape, c.dtype), c)
+        return c, None
+
+    prog = lint.trace_program(
+        "scan-cb", lambda x: jax.lax.scan(body, x, None, length=3)[0],
+        jnp.ones(3), None)
+    rep = lint.run_programs([prog], rules=["no-host-callback"],
+                            package_rules=[], waivers={})
+    assert rep.findings
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-when-off
+# ---------------------------------------------------------------------------
+
+def test_zero_cost_rule_fires_on_compiled_scope():
+    """A round.metrics phase traced into a program whose config says
+    the plane is off is a finding (the named_scope stack is read from
+    eqn.source_info — str(jaxpr) never contains scope names, which is
+    why the old string asserts were vacuous)."""
+    cfg = matrix.base_cfg()          # all planes off
+    assert not cfg.metrics
+
+    def leaky(x):
+        with jax.named_scope("round.metrics"):
+            return x * 2
+
+    prog = lint.trace_program("leak", leaky, jnp.ones(3), cfg)
+    rep = lint.run_programs([prog], rules=["zero-cost-when-off"],
+                            package_rules=[], waivers={})
+    assert rep.findings and rep.findings[0].detail == "scope:metrics"
+
+
+def test_zero_cost_rule_fires_on_missing_scope():
+    """The inverse keying guard: a plane that is ON but whose round.*
+    named_scope never appears means the label the rule greps for was
+    renamed — the rule must fail loudly instead of going vacuous."""
+    cfg = matrix.base_cfg(metrics=True, metrics_ring=8)
+    prog = lint.trace_program("bare", lambda x: x * 2, jnp.ones(3), cfg)
+    rep = lint.run_programs([prog], rules=["zero-cost-when-off"],
+                            package_rules=[], waivers={})
+    assert any(f.detail == "scope-missing:metrics"
+               for f in rep.findings), rep.findings
+
+
+def test_zero_cost_rule_fires_on_carry_leaf():
+    from collections import namedtuple
+
+    FakeState = namedtuple("FakeState", ["metrics"])
+    prog = lint.Program(
+        name="carry", closed_jaxpr=jax.make_jaxpr(lambda x: x)(
+            jnp.ones(2)),
+        cfg=matrix.base_cfg(), capture=False,
+        state=FakeState(metrics=jnp.zeros(3)))
+    rep = lint.run_programs([prog], rules=["zero-cost-when-off"],
+                            package_rules=[], waivers={})
+    assert any(f.detail == "carry:metrics" for f in rep.findings)
+
+
+# ---------------------------------------------------------------------------
+# interleave-budget (the counter itself is pinned by
+# tests/test_program_budget.py — here: the rule keys on the budget)
+# ---------------------------------------------------------------------------
+
+def test_interleave_budget_rule_keys_on_capture():
+    """The capture program's single interleave passes with
+    capture=True and fails when presented as a plain round — the rule
+    really reads the budget, not just the count."""
+    as_capture = next(p for p in _matrix()
+                      if p.name == "round/all-planes/capture")
+    assert as_capture.capture
+    rep = lint.run_programs([as_capture], rules=["interleave-budget"],
+                            package_rules=[], waivers={})
+    assert not rep.findings
+
+    as_plain = as_capture._replace(capture=False)
+    rep2 = lint.run_programs([as_plain], rules=["interleave-budget"],
+                             package_rules=[], waivers={})
+    assert rep2.findings, \
+        "capture interleave must exceed the plain-round budget of 0"
+
+
+# ---------------------------------------------------------------------------
+# scatter-overlap
+# ---------------------------------------------------------------------------
+
+def test_scatter_overlap_rule():
+    idx = jnp.asarray([0, 1, 1, 2])      # overlapping on purpose
+    v = jnp.arange(4.0)
+
+    def racy(x):
+        return x.at[idx].set(v)          # plain scatter, non-unique
+
+    def safe(x):
+        return x.at[idx].min(v)          # commutative, single write
+
+    def chained(x):
+        return x.at[idx].min(v).at[idx].max(v)   # two writes, one buf
+
+    x = jnp.zeros(8)
+    for fn, expect in ((racy, ["plain"]), (safe, []),
+                       (chained, ["chain"])):
+        prog = lint.trace_program(fn.__name__, fn, x, None)
+        rep = lint.run_programs([prog], rules=["scatter-overlap"],
+                                package_rules=[], waivers={})
+        kinds = [f.detail.split(":")[0].split("@")[0]
+                 for f in rep.findings]
+        assert kinds == expect, (fn.__name__, rep.findings)
+
+
+# ---------------------------------------------------------------------------
+# sharding-spec-completeness
+# ---------------------------------------------------------------------------
+
+def test_sharding_spec_completeness_clean_and_fires():
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map  # noqa: F401
+        except ImportError:
+            pytest.skip("no shard_map on this jax")
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.models.plumtree import Plumtree
+    from partisan_tpu.parallel.sharded import ShardedCluster, make_mesh
+
+    assert rules.sharding_spec_completeness() == []
+
+    # drop one plane's specs: every provenance leaf is reported missing
+    cfg = matrix.full_cfg(flight=True)
+    cl = Cluster(cfg, model=Plumtree())
+    state = jax.eval_shape(cl._build_init)
+    sc = ShardedCluster(cfg, make_mesh(1), model=Plumtree())
+    specs = sc._state_specs(state)
+    finds = rules.compare_specs(state, specs._replace(provenance=()))
+    assert finds
+    assert all("provenance" in f.detail for f in finds)
+    n_prov_leaves = len(jax.tree.leaves(state.provenance))
+    assert len(finds) == n_prov_leaves
+
+
+# ---------------------------------------------------------------------------
+# waiver mechanics
+# ---------------------------------------------------------------------------
+
+def test_waiver_pins_and_stale_detection():
+    def with_cb(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    prog = lint.trace_program("cb", with_cb, jnp.ones(3), None)
+    rep = lint.run_programs([prog], rules=["no-host-callback"],
+                            package_rules=[], waivers={})
+    fp = rep.findings[0].fingerprint
+    # pinned: the same finding is waived, and the report is clean
+    rep2 = lint.run_programs([prog], rules=["no-host-callback"],
+                             package_rules=[], waivers={fp: "test"},
+                             check_stale=True)
+    assert not rep2.findings and rep2.clean
+    assert [f.fingerprint for f, _ in rep2.waived] == [fp]
+    # stale: a waiver nothing matched fails the full run
+    rep3 = lint.run_programs(
+        [prog], rules=["no-host-callback"], package_rules=[],
+        waivers={fp: "test", "bogus:x:y:z": "rotted"},
+        check_stale=True)
+    assert rep3.stale == ["bogus:x:y:z"] and not rep3.clean
+
+
+def test_fingerprints_are_line_stable():
+    """Two traces of the same site from different configs share a
+    fingerprint (no line numbers in the identity) — the property the
+    waiver baseline depends on."""
+    def f(x):
+        return x.astype(jnp.int8)
+
+    a = lint.trace_program("a", f, jnp.zeros(3, jnp.int32), None)
+    b = lint.trace_program("b", f, jnp.zeros((5, 2), jnp.int32), None)
+    fa = lint.run_programs([a], rules=["narrow-dtype-overflow"],
+                           package_rules=[], waivers={}).findings
+    fb = lint.run_programs([b], rules=["narrow-dtype-overflow"],
+                           package_rules=[], waivers={}).findings
+    assert fa and fb and fa[0].fingerprint == fb[0].fingerprint
+
+
+# ---------------------------------------------------------------------------
+# Python-level static hygiene (satellite): ruff when installed, the
+# dependency-free pyscan fallback otherwise — same pinned rule subset
+# (ruff.toml <-> pyscan docstring).
+# ---------------------------------------------------------------------------
+
+_HYGIENE_TARGETS = ("partisan_tpu", "tools", "tests", "bench.py",
+                    "__graft_entry__.py")
+
+
+def test_python_hygiene():
+    ruff = shutil.which("ruff")
+    if ruff:
+        out = subprocess.run(
+            [ruff, "check", *_HYGIENE_TARGETS], cwd=_REPO,
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
+    else:
+        finds = []
+        for t in _HYGIENE_TARGETS:
+            finds += pyscan.scan_tree(os.path.join(_REPO, t),
+                                      rel_to=_REPO)
+        assert not finds, \
+            [f"{f.file}:{f.line} {f.code} {f.message}" for f in finds]
+
+
+def test_pyscan_rules(tmp_path):
+    """The fallback checker's contract on a synthetic module: unused
+    import (scoped), star import, one-line multi-import, noqa
+    suppression, string-annotation usage, self-alias re-export."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""\
+        import os, sys                     # E401; os unused
+        import json                        # unused -> F401
+        import io  # noqa: F401
+        import re as re                    # self-alias re-export: ok
+        from collections import *          # F403
+        from typing import Callable        # used only in a string ann
+
+        def f():
+            import math                    # unused in f -> F401
+            return sys.path
+
+        class C:
+            api: "Callable[[], int]"
+    """))
+    finds = pyscan.scan_file(str(mod), "mod.py")
+    codes = sorted((f.line, f.code) for f in finds)
+    assert (1, "E401") in codes
+    assert (2, "F401") in codes            # json
+    assert (5, "F403") in codes
+    assert (9, "F401") in codes            # math, function-scoped
+    lines = [ln for ln, c in codes if c == "F401"]
+    assert 1 in lines                      # os (sys is used)
+    assert 3 not in lines                  # noqa honored
+    assert 4 not in lines                  # self-alias
+    assert 6 not in lines                  # string annotation counts
+    # __init__.py files are a re-export surface: exempt
+    init = tmp_path / "__init__.py"
+    init.write_text("import json\n")
+    assert pyscan.scan_file(str(init), "__init__.py") == []

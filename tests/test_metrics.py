@@ -16,6 +16,7 @@ import numpy as np
 from partisan_tpu import metrics as metrics_mod
 from partisan_tpu.cluster import Cluster
 from partisan_tpu.config import Config, PlumtreeConfig
+from tests import support
 
 
 def _faulted_hyparview_run(n=64, rounds=100, ring=256):
@@ -164,13 +165,12 @@ def test_cause_taxonomy_stays_in_sync():
 def test_metrics_state_is_scan_carry_no_callbacks():
     """The acceptance criterion's 'no host transfer inside the scan':
     the metrics ring rides the lax.scan carry — the jitted k-round
-    program contains no host callback primitives."""
+    program is clean under the shared lint rules (no host-callback
+    primitives anywhere in the program, every OFF plane traceless)."""
     cfg = Config(n_nodes=16, seed=1, metrics=True, metrics_ring=16)
     cl = Cluster(cfg)
     st = cl.init()
-    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 8))(st))
-    for prim in ("callback", "io_effect", "outfeed"):
-        assert prim not in jaxpr, prim
+    support.assert_scan_lint_clean(cl, st, 8)
     # the ring leaves really are carried: they appear in the scan output
     out = cl.steps(st, 8)
     assert metrics_mod.snapshot(out.metrics)["rounds"].tolist() \

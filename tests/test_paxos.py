@@ -5,9 +5,7 @@ quorum-intersection bug caught AND shrunk, and a filibuster omission
 search over the proposal exchange.
 """
 
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from partisan_tpu import faults as faults_mod
 from partisan_tpu.cluster import Cluster

@@ -16,7 +16,6 @@ through the SAME epidemic tree the default version handler uses:
   partisan_config.erl:750-755).
 """
 
-import jax.numpy as jnp
 import pytest
 
 from partisan_tpu.cluster import Cluster

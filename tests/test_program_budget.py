@@ -21,7 +21,6 @@ load-bearing contracts on CPU:
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -236,47 +235,14 @@ def test_width_operand_sharded_parity():
 # layout re-stacks record minors throughout (every msg build + the
 # latency/provenance stamps).  Counting at the jaxpr level keeps the
 # layout win pinned on CPU between on-chip bench rounds.
+#
+# The counter itself is the lint package's interleave-budget rule
+# (partisan_tpu/lint/rules.py — re-homed there by ISSUE 9, single
+# implementation); these tests stay as thin callers pinning the exact
+# budgets per program shape.
 # ---------------------------------------------------------------------------
 
-def _iter_sub_jaxprs(params):
-    import jax.extend.core as jex_core
-
-    for v in params.values():
-        vals = v if isinstance(v, (tuple, list)) else (v,)
-        for x in vals:
-            if isinstance(x, jax.extend.core.ClosedJaxpr):
-                yield x.jaxpr
-            elif isinstance(x, jex_core.Jaxpr):
-                yield x
-
-
-def count_wire_interleaves(jaxpr, widths) -> tuple[int, int]:
-    """(interleave_count, total_equations), recursing into cond/scan/
-    while sub-jaxprs.  An interleave is a concatenate or transpose
-    whose OUTPUT carries a record-width minor axis on an [n, slots, W]
-    (ndim >= 3) tensor — the wire-layout materialization signature.
-    ``widths`` covers msg_words..wire_words so pre- and post-stamp
-    stacks both count."""
-    n_int = 0
-    n_eqns = 0
-    for eqn in jaxpr.eqns:
-        n_eqns += 1
-        out = eqn.outvars[0].aval
-        if (eqn.primitive.name in ("concatenate", "transpose")
-                and getattr(out, "ndim", 0) >= 3
-                and out.shape[-1] in widths):
-            if eqn.primitive.name == "concatenate":
-                if eqn.params["dimension"] == out.ndim - 1:
-                    n_int += 1
-            else:
-                perm = eqn.params["permutation"]
-                if perm[-1] != len(perm) - 1:   # minor axis moved
-                    n_int += 1
-        for sub in _iter_sub_jaxprs(eqn.params):
-            si, se = count_wire_interleaves(sub, widths)
-            n_int += si
-            n_eqns += se
-    return n_int, n_eqns
+from partisan_tpu.lint import count_wire_interleaves  # noqa: E402
 
 
 def _interleave_counts(cfg, capture=False):

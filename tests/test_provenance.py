@@ -244,8 +244,10 @@ def test_disabled_default_zero_overhead():
     assert st.inbox.data.shape[-1] == cfg.msg_words
     st2 = cl.steps(st, 5)
     assert st2.provenance == ()
-    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 4))(st))
-    assert "round.provenance" not in jaxpr
+    # the lint zero-cost rule reads every equation's named_scope stack:
+    # no round.provenance phase traced into the program (str(jaxpr)
+    # greps never saw scope names — this is the real check)
+    support.assert_scan_lint_clean(cl, st, 4)
 
 
 def test_wire_layout_with_latency_plane():
@@ -315,13 +317,11 @@ def test_provenance_plane_is_read_only():
 
 def test_provenance_state_is_scan_carry_no_callbacks():
     """No host transfer inside the scan: the forest + rings ride the
-    lax.scan carry."""
+    lax.scan carry (shared lint rules — see tests/support.py)."""
     cfg = _pt_cfg(8, provenance_ring=8)
     cl = Cluster(cfg, model=Plumtree())
     st = cl.init()
-    jaxpr = str(jax.make_jaxpr(lambda s: cl._scan(s, 6))(st))
-    for prim in ("callback", "io_effect", "outfeed"):
-        assert prim not in jaxpr, prim
+    support.assert_scan_lint_clean(cl, st, 6)
     out = cl.steps(st, 6)
     assert prov_mod.snapshot(out.provenance)["rounds"].tolist() \
         == [0, 1, 2, 3, 4, 5]

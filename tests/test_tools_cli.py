@@ -196,6 +196,28 @@ def test_soak_report_cli_smoke():
     assert summary["rounds"] == 30
 
 
+def test_jaxlint_cli_smoke():
+    """jaxpr-auditor argv smoke (tests/test_lint.py runs the full
+    matrix in-process; this pins the CLI contract): --quick emits JSON
+    lines ending in a CLEAN summary with the documented waivers
+    exercised, exits 0; a bad flag exits 2, not 0."""
+    out = _run("jaxlint.py", "--quick")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    summary = rows[-1]
+    assert summary["kind"] == "summary"
+    assert summary["verdict"] == "CLEAN"
+    assert summary["findings"] == 0
+    assert summary["waived"] >= 1, \
+        "the pinned waivers should be exercised by the quick matrix"
+    assert {r["kind"] for r in rows[:-1]} <= {"finding", "waived",
+                                              "stale_waiver"}
+    for r in rows[:-1]:
+        assert {"rule", "fingerprint", "message"} <= set(r)
+    bad = _run("jaxlint.py", "--bogus-flag")
+    assert bad.returncode == 2
+
+
 def test_tools_cli_completeness():
     """Completeness guard: EVERY tools/*.py exposes a ``main()`` and
     survives a ``--help`` smoke with an honest zero exit — so a future
@@ -204,8 +226,9 @@ def test_tools_cli_completeness():
     tools_dir = os.path.join(_REPO, "tools")
     tools = sorted(f for f in os.listdir(tools_dir)
                    if f.endswith(".py"))
-    assert len(tools) >= 9, tools
+    assert len(tools) >= 10, tools
     assert "soak_report.py" in tools
+    assert "jaxlint.py" in tools
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     procs = {}
     for tool in tools:

@@ -26,6 +26,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools._lib.jaxcache import enable_persistent_cache
+
+enable_persistent_cache()
+
 USAGE = "usage: broadcast_report.py [n] [rounds] [--fault]"
 
 

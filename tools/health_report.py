@@ -25,6 +25,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools._lib.jaxcache import enable_persistent_cache
+
+enable_persistent_cache()
+
 
 def report(state, out=sys.stdout) -> dict:
     """Dump ``state``'s health ring as JSON lines; returns the decoded

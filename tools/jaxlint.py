@@ -1,0 +1,129 @@
+"""jaxlint — run the jaxpr-level static auditor over the config matrix
+(the ``BENCH_*.json`` idiom: one self-describing JSON object per line).
+
+Traces the audited program matrix (each observability plane on/off,
+plane-major x width-operand, capture and flight variants, the OTP
+service stack, the soak chunk scan — see
+``partisan_tpu/lint/matrix.py``), runs the rule catalog
+(``partisan_tpu/lint/rules.py``), applies the pinned waiver baseline
+(``partisan_tpu/lint/waivers.py``) and prints findings as JSON lines::
+
+    python tools/jaxlint.py [--quick] [--rules r1,r2] [--no-stale]
+
+Output lines: ``{"kind": "finding", ...}`` for every unwaived finding,
+``{"kind": "waived", ...}`` for baseline-covered ones, then a trailing
+``{"kind": "summary", "verdict": "CLEAN"|"DIRTY", ...}``.  Exit code is
+0 only when the verdict is CLEAN (no unwaived findings, no stale
+waivers).
+
+``--quick`` runs the three-program subset (plain round, everything-on
+scan, capture round) plus the package rules — the budget-guarded form
+``bench.py`` folds into its artifact — and skips the stale-waiver check
+(a subset legitimately leaves waivers unmatched).  ``--no-stale``
+skips the stale check on a full run (for rule-filtered invocations).
+Also importable: ``verdict(quick=True)`` returns the summary dict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+USAGE = "usage: jaxlint.py [--quick] [--rules r1,r2] [--no-stale]"
+
+
+def _finding_row(kind, f, reason=None) -> dict:
+    row = {"kind": kind, "rule": f.rule, "program": f.program,
+           "file": f.file, "func": f.func, "line": f.line,
+           "detail": f.detail, "fingerprint": f.fingerprint,
+           "message": f.message}
+    if reason is not None:
+        row["waiver"] = reason
+    return row
+
+
+def run(quick: bool = False, rules=None, check_stale: bool = True,
+        out=sys.stdout) -> dict:
+    """Trace, audit, print JSON lines; returns the summary dict."""
+    from partisan_tpu.lint import (
+        PACKAGE_RULES,
+        PROGRAM_RULES,
+        matrix,
+        run_programs,
+    )
+
+    programs = matrix.quick_matrix() if quick else \
+        matrix.default_matrix()
+    prog_rules = pkg_rules = None
+    if rules is not None:
+        unknown = [r for r in rules
+                   if r not in PROGRAM_RULES and r not in PACKAGE_RULES]
+        if unknown:
+            raise SystemExit(f"unknown rules: {', '.join(unknown)}")
+        prog_rules = [r for r in rules if r in PROGRAM_RULES]
+        pkg_rules = [r for r in rules if r in PACKAGE_RULES]
+    rep = run_programs(
+        programs, rules=prog_rules, package_rules=pkg_rules,
+        check_stale=check_stale and not quick and rules is None)
+    for f in rep.findings:
+        print(json.dumps(_finding_row("finding", f)), file=out)
+    for f, reason in rep.waived:
+        print(json.dumps(_finding_row("waived", f, reason)), file=out)
+    for fp in rep.stale:
+        print(json.dumps({"kind": "stale_waiver", "fingerprint": fp,
+                          "message": "waiver matched no finding — the "
+                          "documented exception no longer exists"}),
+              file=out)
+    summary = {
+        "kind": "summary",
+        "matrix": "quick" if quick else "full",
+        "programs": [p.name for p in programs],
+        "findings": len(rep.findings),
+        "waived": len(rep.waived),
+        "stale_waivers": len(rep.stale),
+        "verdict": "CLEAN" if rep.clean else "DIRTY",
+    }
+    print(json.dumps(summary), file=out)
+    return summary
+
+
+def verdict(quick: bool = True) -> dict:
+    """The bench-artifact entry: run silently, return the summary."""
+    import io
+
+    return run(quick=quick, out=io.StringIO())
+
+
+def main() -> None:
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__)
+        return
+    args = sys.argv[1:]
+    quick = "--quick" in args
+    check_stale = "--no-stale" not in args
+    rules = None
+    for a in args:
+        if a.startswith("--rules"):
+            try:
+                val = a.split("=", 1)[1] if "=" in a else \
+                    args[args.index(a) + 1]
+            except IndexError:
+                print(USAGE, file=sys.stderr)
+                raise SystemExit(2)
+            rules = [r.strip() for r in val.split(",") if r.strip()]
+    known = {"--quick", "--no-stale"}
+    for a in args:
+        if a.startswith("--") and a not in known \
+                and not a.startswith("--rules"):
+            print(USAGE, file=sys.stderr)
+            raise SystemExit(2)
+    summary = run(quick=quick, rules=rules, check_stale=check_stale)
+    raise SystemExit(0 if summary["verdict"] == "CLEAN" else 1)
+
+
+if __name__ == "__main__":
+    main()

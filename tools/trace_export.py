@@ -48,6 +48,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools._lib.jaxcache import enable_persistent_cache
+
+enable_persistent_cache()
+
 PID = 1
 
 # jax.named_scope phase labels (cluster.round_body) — the category each
