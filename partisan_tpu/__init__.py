@@ -30,6 +30,9 @@ src/partisan_peer_service.erl):
   :mod:`partisan_tpu.health` — the device-resident observability
   planes (counter ring; delivery-age histograms + flight recorder;
   topology snapshots + the one-scalar health digest)
+- :mod:`partisan_tpu.control` — in-scan feedback controllers closing
+  the planes' loop (plumtree fanout governor, channel backpressure,
+  overlay self-healing escalation — `Config.control`)
 - :mod:`partisan_tpu.soak` — chunked long-horizon soak engine
   (crash-safe checkpoint/resume + fault-storm timelines)
 - :mod:`partisan_tpu.parallel` — shard_map multi-device execution

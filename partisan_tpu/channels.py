@@ -63,7 +63,8 @@ def init(cfg: Config, comm) -> OutboxState:
 
 
 def throttle(cfg: Config, comm, ob: OutboxState, emitted,
-             *, birth_rnd: Array | None = None):
+             *, birth_rnd: Array | None = None,
+             shed_age: Array | None = None):
     """Apply per-(edge, channel, lane) capacity to this round's sends.
 
     Returns (outbox', emitted') where emitted' carries the outbox's
@@ -72,7 +73,16 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted,
     ``birth_rnd`` set (the latency plane), a third value is returned:
     the shard-local age histogram of the sends SHED at the outbox cut
     (deferred-but-kept sends are not drops — their queueing time
-    surfaces in their eventual delivery age)."""
+    surfaces in their eventual delivery age).
+
+    ``shed_age`` (int32[C], requires ``birth_rnd``) is the backpressure
+    controller's per-channel stale-shed threshold (control.shed_age):
+    any record whose age has reached its channel's threshold is SHED
+    before the capacity ranking — Partisan's monotonic-channel load
+    shedding (partisan_peer_socket.erl:108-129) generalized per
+    channel, so a pressured bulk channel drops its stalest queued
+    copies instead of delivering them rounds late, while channels at
+    zero pressure (threshold = +inf) never shed here."""
     par_py = [c.parallelism for c in cfg.channels]
     par = jnp.asarray(par_py, jnp.int32)
     maxpar = max(par_py)
@@ -85,6 +95,15 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted,
     valid = both[..., T.W_KIND] != 0
     ch = jnp.clip(both[..., T.W_CHANNEL].astype(jnp.int32), 0,
                   cfg.n_channels - 1)
+    stale = None
+    if shed_age is not None:
+        from partisan_tpu import latency as latency_mod
+
+        assert birth_rnd is not None, \
+            "shed_age needs birth_rnd (the latency plane's ages)"
+        stale = valid & (latency_mod.ages(both, birth_rnd)
+                         >= shed_age[ch])
+        valid = valid & ~stale
     lane = (both[..., T.W_LANE] & 0x7FFFFFFF) % par[ch]
     dst = jnp.maximum(both[..., T.W_DST], 0)
     key = (dst * cfg.n_channels + ch) * maxpar + lane
@@ -103,8 +122,11 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted,
     run_start = jax.lax.cummax(
         jnp.where(is_start, m_idx[None, :], 0), axis=1)
     rank_sorted = m_idx[None, :] - run_start
+    # `order` is a per-row argsort permutation — indices are unique by
+    # construction, so the un-permuting scatter is race-free
     rank = jnp.zeros((n, M), jnp.int32).at[
-        jnp.arange(n)[:, None], order].set(rank_sorted)
+        jnp.arange(n)[:, None], order].set(rank_sorted,
+                                           unique_indices=True)
     budget = rate * jnp.ones((), jnp.int32)
     send_now = valid & (rank < budget)
     defer = valid & ~send_now
@@ -118,15 +140,23 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted,
     slot = jnp.where(keep, drank, OB)
     rows = jnp.broadcast_to(jnp.arange(n)[:, None], slot.shape)
     new_data = plane_ops.zeros_like(ob.data)
-    new_data = new_data.at[rows, slot].set(both, mode="drop")
-    shed = comm.allsum(jnp.sum(defer & ~keep, dtype=jnp.int32))
+    # unique by construction: each kept record's slot is its defer-rank
+    # (a per-row cumsum — strictly increasing among kept entries), so
+    # the scatter is race-free and the lint overlap audit can see it
+    new_data = new_data.at[rows, slot].set(both, mode="drop",
+                                           unique_indices=True)
+    cut = defer & ~keep
+    if stale is not None:
+        # backpressure sheds join the outbox-cut accounting: same cut
+        # site, same cause row (CAUSE_OUTBOX) in metrics and latency
+        cut = cut | stale
+    shed = comm.allsum(jnp.sum(cut, dtype=jnp.int32))
     ob_out = OutboxState(data=new_data, shed=ob.shed + shed)
     if birth_rnd is None:
         return ob_out, out
     from partisan_tpu import latency as latency_mod
 
-    return ob_out, out, latency_mod.age_hist(both, defer & ~keep,
-                                             birth_rnd)
+    return ob_out, out, latency_mod.age_hist(both, cut, birth_rnd)
 
 
 def shed_delta(before: OutboxState, after: OutboxState) -> Array:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from partisan_tpu import channels as channels_mod
+from partisan_tpu import control as control_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import health as health_mod
@@ -83,6 +84,14 @@ class ClusterState(NamedTuple):
     #                         forest + redundancy rings (or () when
     #                         Config.provenance is off — zero cost,
     #                         wire width and trace bit-identical)
+    control: Any = ()       # control.ControlState in-scan feedback
+    #                         controllers (or () when no Config.control
+    #                         flag is on — zero cost).  The round reads
+    #                         the ROUND-START operands (eager cap, shed
+    #                         ages, heal boost) and writes the next
+    #                         round's at the end of the body, so every
+    #                         decision is a pure function of the carry
+    #                         — deterministic and checkpoint-safe.
 
 
 class TraceRound(NamedTuple):
@@ -132,9 +141,10 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         faults_wire = state.faults._replace(alive=alive_g)
         alive_local = jax.lax.dynamic_slice(
             alive_g, (comm.node_offset,), (comm.n_local,))
+    cx = control_mod.enabled(cfg)   # static: in-scan feedback loops
     ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
                    inbox=state.inbox, faults=state.faults,
-                   n_active=state.n_active)
+                   n_active=state.n_active, control=state.control)
 
     # jax.named_scope labels each phase in the HLO, so profiler traces
     # (tools/profile_round.py under jax.profiler) map to round phases.
@@ -377,10 +387,20 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         if lx:
             lat_outbox = latency_mod.zero_hist()
         if channels_mod.enabled(cfg):
+            shed_ages = None
+            if cfg.control.backpressure:
+                # The ROUND-START pressure levels drive this round's
+                # per-channel stale-shed thresholds (actuation side of
+                # the backpressure loop; latency is on by validation,
+                # so the lx branch below always runs).
+                with jax.named_scope("round.control.backpressure"):
+                    shed_ages = control_mod.shed_age(
+                        cfg, state.control.backpressure)
             with jax.named_scope("round.throttle"):
                 if lx:
                     obstate, emitted, lat_outbox = channels_mod.throttle(
-                        cfg, comm, obstate, emitted, birth_rnd=state.rnd)
+                        cfg, comm, obstate, emitted, birth_rnd=state.rnd,
+                        shed_age=shed_ages)
                 else:
                     obstate, emitted = channels_mod.throttle(
                         cfg, comm, obstate, emitted)
@@ -466,6 +486,17 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         m_inbox_of = comm.allsum(jnp.sum(inbox.drops, dtype=jnp.int32))
         m_dead = comm.allsum(jnp.sum(
             jnp.where(dead, inbox.count, 0), dtype=jnp.int32))
+    ctrl_chmax = None
+    if cfg.control.backpressure:
+        # Sensing side of the backpressure loop: each channel's
+        # per-round delivered-age high-water mark (same pre-mask inbox
+        # and dead mask the latency plane reads), allmax-reduced so the
+        # pressure decision replicates across shards.  Computed ONCE
+        # and handed to record_round below, so the reduction (and its
+        # cross-shard collective) does not trace twice.
+        with jax.named_scope("round.control.backpressure"):
+            ctrl_chmax = control_mod.pressure_signal(
+                cfg, comm, inbox.data, dead, state.rnd)
     lt = state.latency
     if lx:
         # Delivery + dead-receiver ages read the PRE-mask inbox: the
@@ -476,7 +507,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             lt = latency_mod.record_round(
                 cfg, comm, lt, rnd=state.rnd, inbox_data=inbox.data,
                 dead=dead, fault_hist=lat_fault,
-                compact_hist=lat_compact, outbox_hist=lat_outbox)
+                compact_hist=lat_compact, outbox_hist=lat_outbox,
+                chmax=ctrl_chmax)
     pv = state.provenance
     if px:
         # Same delivered set as the metrics/latency planes (the routed
@@ -576,12 +608,22 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
 
             hstate = jax.lax.cond(due, health_body, lambda h: h,
                                   state.health)
+    ctrl = state.control
+    if cx:
+        # Controller step (control.py): a pure function of the planes'
+        # freshly written states — the NEXT round reads the result as
+        # its operands (one round of actuation delay, the price of
+        # staying a scan carry).  Each controller traces under its own
+        # round.control.* named_scope (the lint zero-cost key).
+        ctrl = control_mod.update(cfg, state.control, rnd=state.rnd,
+                                  pv=pv, health=hstate,
+                                  chmax=ctrl_chmax)
     out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
                        inbox=inbox, manager=mstate, model=dstate_model,
                        delivery=dstate, stats=stats, interpose=istate,
                        outbox=obstate, metrics=mets, latency=lt,
                        flight=fstate, n_active=state.n_active,
-                       health=hstate, provenance=pv)
+                       health=hstate, provenance=pv, control=ctrl)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent_wire,
                                dropped=fault_dropped)
@@ -713,6 +755,8 @@ class Cluster:
                     if health_mod.enabled(cfg) else ()),
             provenance=(provenance_mod.init(cfg, comm)
                         if provenance_mod.enabled(cfg) else ()),
+            control=(control_mod.init(cfg)
+                     if control_mod.enabled(cfg) else ()),
         )
 
     def _build_init(self) -> ClusterState:

@@ -147,6 +147,79 @@ class DistanceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ControlConfig:
+    """In-scan feedback controllers (control.py): pure functions of the
+    observability planes' carry state evaluated inside the jitted round,
+    closing the loop the planes only observed (ROADMAP item 5).  Each
+    controller is individually flag-gated, OFF by default at zero traced
+    cost (its ClusterState sub-leaf is ``()`` and no op carries a
+    ``round.control.*`` named_scope — the lint zero-cost rule keys on
+    both), deterministic, and replicated under sharding (every input is
+    an already-reduced plane value, so every shard computes the same
+    decision).
+
+    - ``fanout`` — the Plumtree eager-fanout governor (requires
+      ``Config.provenance``): reads the redundancy ring's per-round
+      duplicate/gossip counts and the GRAFT delivered counter and steps
+      a per-round eager-link budget between ``fanout_min`` and the
+      overlay width — the SRDS'07 redundancy-vs-repair trade, tuned
+      live instead of by static ``PlumtreeConfig`` capacities.
+    - ``backpressure`` — per-channel load shedding (requires
+      ``Config.latency`` and ``Config.channel_capacity``): integrates
+      each channel's per-round delivered-age high-water mark into a
+      pressure level that lowers the channel's stale-shed age threshold
+      in the capacity outbox — Partisan's monotonic-channel shed
+      (partisan_peer_socket.erl:108-129) generalized from a static
+      boolean to a per-channel feedback loop, so a saturated bulk
+      channel sheds aggressively while membership/ack channels stay
+      fresh.
+    - ``healing`` — overlay repair escalation (requires
+      ``Config.health > 0``): keys HyParView's shuffle/promotion
+      cadences and the heartbeat isolation window off the health
+      digest's one-component / no-isolates / min-degree bits instead of
+      fixed timers — probe+rejoin rates escalate by ``heal_boost``
+      cadence halvings while the overlay is degraded and relax after
+      ``heal_hold`` consecutive healthy snapshots.
+    """
+
+    fanout: bool = False
+    backpressure: bool = False
+    healing: bool = False
+    ring: int = 64               # decision-ring rounds kept per controller
+    # --- plumtree fanout governor (hysteresis bands, integer-exact) ----
+    fanout_min: int = 2          # eager-link budget floor
+    fanout_every: int = 8        # evaluation window in rounds: the
+    #                              governor accumulates dup/gossip/graft
+    #                              counts and steps the budget once per
+    #                              window (per-round ratios whipsaw —
+    #                              a wave's first hop looks redundancy-
+    #                              free, its fan-out hop redundant)
+    fanout_hi_pct: int = 40      # demote: window dup*100 >= hi*gossip
+    fanout_lo_pct: int = 10      # promote: window dup*100 <= lo*gossip
+    fanout_gossip_min: int = 8   # windows below this many gossip
+    #                              deliveries don't move the budget
+    graft_hi_pct: int = 25       # window grafts*100 >= this*gossip =
+    #                              repair dominating: promote (the
+    #                              eager set got too sparse)
+    # --- channel backpressure ------------------------------------------
+    age_hi: int = 4              # per-round delivered-age HWM that
+    #                              raises a channel's pressure level
+    age_lo: int = 1              # ... at or below this, pressure decays
+    press_max: int = 4           # pressure ceiling (shed threshold
+    #                              floor: max(1, age_hi >> (press-1)))
+    # --- overlay self-healing ------------------------------------------
+    heal_boost: int = 2          # cadence right-shift while degraded
+    #                              (shuffle/promotion/isolation-window
+    #                              intervals are divided by 2^boost)
+    heal_hold: int = 2           # consecutive healthy snapshots before
+    #                              relaxing back to the base cadences
+
+    @property
+    def any(self) -> bool:
+        return self.fanout or self.backpressure or self.healing
+
+
+@dataclasses.dataclass(frozen=True)
 class ScampConfig:
     """SCAMP parameters (include/partisan.hrl:240-241)."""
 
@@ -247,6 +320,7 @@ class Config:
     scamp: ScampConfig = ScampConfig()
     plumtree: PlumtreeConfig = PlumtreeConfig()
     distance: DistanceConfig = DistanceConfig()
+    control: ControlConfig = ControlConfig()
 
     # --- tensor capacities (sim-specific) ------------------------------
     inbox_cap: int = 32          # queued event messages per node per round
@@ -453,6 +527,46 @@ class Config:
             raise ValueError(
                 f"distance.model {self.distance.model!r} not in "
                 f"('ring', 'hash')")
+        # Controller prerequisites: each controller is a pure function
+        # of a plane's carry state — enabling one without its plane
+        # would silently read nothing (the loop must fail loudly).
+        if self.control.fanout and not self.provenance:
+            raise ValueError(
+                "control.fanout reads the provenance plane's redundancy "
+                "ring — set Config(provenance=True)")
+        if self.control.backpressure and not self.latency:
+            raise ValueError(
+                "control.backpressure reads delivery ages off the "
+                "latency plane's birth word — set Config(latency=True)")
+        if self.control.backpressure and not self.channel_capacity:
+            raise ValueError(
+                "control.backpressure drives shed thresholds in the "
+                "channel-capacity outbox — set "
+                "Config(channel_capacity=True)")
+        if self.control.healing and self.health <= 0:
+            raise ValueError(
+                "control.healing keys repair cadences off the health "
+                "digest — set Config(health=K)")
+        if self.control.any:
+            if self.control.ring < 1:
+                raise ValueError(
+                    f"control.ring must be >= 1, got {self.control.ring}")
+            if self.control.fanout_min < 1:
+                raise ValueError("control.fanout_min must be >= 1")
+            if self.control.press_max < 1:
+                raise ValueError("control.press_max must be >= 1")
+            if self.control.heal_boost < 0:
+                raise ValueError("control.heal_boost must be >= 0")
+            if not (0 <= self.control.fanout_lo_pct
+                    < self.control.fanout_hi_pct):
+                raise ValueError(
+                    "control fanout bands need "
+                    "0 <= fanout_lo_pct < fanout_hi_pct")
+            if self.control.fanout_every < 1:
+                raise ValueError("control.fanout_every must be >= 1")
+            if self.control.age_lo >= self.control.age_hi:
+                raise ValueError(
+                    "control backpressure bands need age_lo < age_hi")
         if not self.channel_capacity:
             # No silent no-op parity configs: a channel declaring
             # parallelism > 1 without capacity enforcement would be
@@ -620,4 +734,6 @@ class Config:
             d["plumtree"] = PlumtreeConfig(**d["plumtree"])
         if "distance" in d and isinstance(d["distance"], Mapping):
             d["distance"] = DistanceConfig(**d["distance"])
+        if "control" in d and isinstance(d["control"], Mapping):
+            d["control"] = ControlConfig(**d["control"])
         return cls(**d)

@@ -1,0 +1,388 @@
+"""In-scan feedback controllers: close the loop the observability
+planes opened (ROADMAP item 5).
+
+PRs 1/2/4/5 built four device-resident planes that *observe* the
+cluster — message counts (metrics.py), delivery ages (latency.py),
+overlay topology (health.py), dissemination structure (provenance.py).
+This module *acts* on them: three small pure functions of plane state
+evaluated inside ``round_body``'s jitted scan, each one the live
+version of a self-tuning mechanism the cited papers describe:
+
+- **Plumtree fanout governor** (``Config.control.fanout``).  Plumtree
+  (Leitão et al., SRDS'07) is explicitly a self-tuning broadcast: the
+  eager set narrows when duplicates prove it redundant and widens when
+  GRAFT repair proves it too sparse.  The sim's slot-recycle epochs
+  RESET the learned ``pruned`` flags on every fresh broadcast (a new
+  root grows its own tree — models/plumtree.py epoch docs), so a
+  recycled-slot workload re-floods at full overlay fanout forever.
+  The governor retains what the flags cannot: it reads the provenance
+  ring's per-round duplicate/gossip counts and GRAFT delivered counter
+  and steps a per-(node, tree) eager-link BUDGET between
+  ``fanout_min`` and the overlay width.  The budget is applied at push
+  time (models/plumtree.py eager push): links beyond it are demoted to
+  the lazy I_HAVE path for that push — exactly a pruned link's wire
+  behavior, but reversible each round and immune to epoch resets —
+  and a GRAFT storm (repair pressure) promotes immediately.
+
+- **Channel backpressure** (``Config.control.backpressure``).
+  Partisan's transport permits exactly one drop path: stale sends on
+  monotonic channels under receiver backpressure
+  (partisan_peer_socket.erl:108-129) — newer state supersedes older,
+  so shedding is safe and membership never head-of-line-blocks behind
+  bulk (the ATC'19 claim).  This controller generalizes the static
+  boolean into feedback: each channel's per-round delivered-age
+  high-water mark (the latency plane's signal) integrates into a
+  pressure level; pressure lowers the channel's stale-shed AGE
+  threshold in the channel-capacity outbox (channels.throttle), so a
+  saturated bulk channel sheds its stalest queued records aggressively
+  — bounding its delivery p99 — while an unsaturated membership/ack
+  channel's threshold stays at infinity.
+
+- **Self-healing escalation** (``Config.control.healing``).  The
+  reference repairs its overlay on fixed wall-clock timers (shuffle
+  10 s, promotion 5 s, isolation window 40 s).  This controller keys
+  those cadences off the health digest instead: while the digest
+  reports a degraded overlay (>1 component, isolated nodes, or alive
+  nodes below the active_min degree floor) the shuffle/promotion
+  intervals and the heartbeat isolation window are divided by
+  ``2^heal_boost`` (managers/hyparview.py), escalating probe+rejoin
+  rates exactly while partitioned; after ``heal_hold`` consecutive
+  healthy snapshots the cadences relax to base.
+
+Shared discipline (the planes' own, ARCHITECTURE.md "Observability"):
+
+- **pure + deterministic** — controller state is a scan carry; every
+  decision is a function of (config statics, replicated plane values),
+  so runs replay bit-identically and checkpoint/restore mid-storm
+  resumes the exact decision sequence (tests/test_soak.py),
+- **replicated under sharding** — inputs are already allsum/allmax-
+  reduced plane values (parallel/sharded.py replicates every control
+  leaf), so all shards step identical controller state,
+- **zero cost when off** — a disabled controller's ClusterState
+  sub-leaf is ``()`` and no op carries its ``round.control.*``
+  named_scope (the lint zero-cost rule audits both, over the extended
+  matrix in partisan_tpu/lint/matrix.py),
+- **observable** — each controller writes a per-round decision ring
+  (shared ``metrics.ring_order`` decode); ``telemetry.
+  replay_control_events`` turns ring transitions into
+  ``partisan.control.*`` bus events and soak chunk rows carry a
+  :func:`poll` summary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.config import Config
+
+_BIG = jnp.int32(2**30)
+
+
+class FanoutState(NamedTuple):
+    """Plumtree eager-fanout governor (replicated).
+
+    ``R`` = Config.control.ring."""
+
+    eager_cap: Array    # int32 — eager links allowed per (node, tree)
+    win_dup: Array      # int32 — duplicates in the current window
+    win_gossip: Array   # int32 — gossip deliveries, current window
+    win_graft: Array    # int32 — GRAFTs delivered, current window
+    adjustments: Array  # int32 — cap changes over the whole run
+    rnd: Array          # int32[R] — decision-ring round labels (-1)
+    cap: Array          # int32[R] — cap in force after each round
+
+
+class BackpressureState(NamedTuple):
+    """Per-channel shed-pressure integrator (replicated).
+
+    ``C`` = Config.n_channels, ``R`` = Config.control.ring."""
+
+    press: Array        # int32[C] — pressure level per channel
+    adjustments: Array  # int32 — pressure-level changes, whole run
+    rnd: Array          # int32[R]
+    press_ring: Array   # int32[R, C] — pressure after each round
+
+
+class HealingState(NamedTuple):
+    """Overlay repair-escalation state (replicated)."""
+
+    boost: Array        # int32 — cadence right-shift in force (0 = base)
+    streak: Array       # int32 — consecutive healthy snapshots
+    adjustments: Array  # int32 — boost changes, whole run
+    rnd: Array          # int32[R]
+    boost_ring: Array   # int32[R] — boost after each round
+
+
+class ControlState(NamedTuple):
+    """Per-controller sub-states; a disabled controller's leaf is
+    ``()`` (empty pytree — zero carry cost, like the planes)."""
+
+    fanout: Any = ()
+    backpressure: Any = ()
+    healing: Any = ()
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.control.any
+
+
+def _overlay_width(cfg: Config) -> int:
+    """The eager-cap ceiling: the manager's neighbor-slot width K —
+    the widest eager set a node can physically push to."""
+    from partisan_tpu import managers as managers_mod
+
+    return max(1, managers_mod.neighbor_width(cfg))
+
+
+def init(cfg: Config) -> ControlState:
+    R = cfg.control.ring
+    ring = jnp.full((R,), -1, jnp.int32)
+    fan, bp, heal = (), (), ()
+    if cfg.control.fanout:
+        fan = FanoutState(
+            eager_cap=jnp.int32(_overlay_width(cfg)),
+            win_dup=jnp.int32(0), win_gossip=jnp.int32(0),
+            win_graft=jnp.int32(0),
+            adjustments=jnp.int32(0),
+            rnd=ring, cap=jnp.zeros((R,), jnp.int32))
+    if cfg.control.backpressure:
+        C = cfg.n_channels
+        bp = BackpressureState(
+            press=jnp.zeros((C,), jnp.int32),
+            adjustments=jnp.int32(0),
+            rnd=ring, press_ring=jnp.zeros((R, C), jnp.int32))
+    if cfg.control.healing:
+        heal = HealingState(
+            boost=jnp.int32(0), streak=jnp.int32(0),
+            adjustments=jnp.int32(0),
+            rnd=ring, boost_ring=jnp.zeros((R,), jnp.int32))
+    return ControlState(fanout=fan, backpressure=bp, healing=heal)
+
+
+# ---------------------------------------------------------------------------
+# Operand readers (round_body / managers / models read the ROUND-START
+# controller state; the update below writes the next round's)
+# ---------------------------------------------------------------------------
+
+def shed_age(cfg: Config, bp: BackpressureState) -> Array:
+    """int32[C]: the per-channel stale-shed age threshold the capacity
+    outbox applies this round (channels.throttle ``shed_age``).  Zero
+    pressure = no shedding (threshold past any real age); each level
+    halves the threshold from ``age_hi`` down to a floor of 1 round."""
+    c = cfg.control
+    floor = jnp.maximum(jnp.int32(1),
+                        jnp.int32(c.age_hi) >> jnp.maximum(
+                            bp.press - 1, 0))
+    return jnp.where(bp.press > 0, floor, _BIG)
+
+
+def pressure_signal(cfg: Config, comm, inbox_data, dead: Array,
+                    rnd: Array) -> Array:
+    """int32[C]: this round's per-channel delivered-age high-water mark
+    — the backpressure loop's sensor, reduced (``comm.allmax``) so the
+    pressure decision replicates across shards.  Reads the same
+    pre-mask inbox and dead mask as ``latency.record_round`` through
+    the shared :func:`latency.channel_age_max`, so the signal cannot
+    drift from the plane's own high-water accounting."""
+    from partisan_tpu import latency as latency_mod
+    from partisan_tpu import types as T
+
+    live = inbox_data[..., T.W_KIND] != 0
+    delivered = live & ~dead[:, None]
+    return comm.allmax(latency_mod.channel_age_max(
+        cfg, inbox_data, delivered, rnd))
+
+
+# ---------------------------------------------------------------------------
+# The per-round update (pure; called at the end of round_body on the
+# freshly written plane states)
+# ---------------------------------------------------------------------------
+
+def _fanout_update(cfg: Config, fs: FanoutState, rnd: Array,
+                   pv) -> FanoutState:
+    """Step the eager-link budget off the redundancy ring row the
+    provenance plane just wrote for ``rnd`` (replicated values).
+
+    The governor accumulates the round's duplicate/gossip/GRAFT counts
+    into a window and evaluates once every ``fanout_every`` rounds —
+    per-round ratios whipsaw (a dissemination wave's first hop looks
+    redundancy-free, its fan-out hop heavily redundant), the window
+    averages a wave.  A window whose duplicate fraction reaches
+    ``fanout_hi_pct`` demotes one link (down to ``fanout_min``); a
+    window at/below ``fanout_lo_pct`` — or one where GRAFT repair
+    reaches ``graft_hi_pct`` of gossip (the eager set got too sparse
+    and lazy repair is doing the work) — promotes one (up to the
+    overlay width).  Windows with fewer than ``fanout_gossip_min``
+    gossip deliveries hold the budget (quiet traffic is noise, the
+    same stance as telemetry's redundancy_min)."""
+    from partisan_tpu.provenance import CTL_NAMES
+
+    c = cfg.control
+    slot = jnp.mod(rnd, cfg.provenance_ring)
+    w_dup = fs.win_dup + jnp.sum(pv.dup[slot], dtype=jnp.int32)
+    w_gos = fs.win_gossip + pv.gossip[slot]
+    w_gra = fs.win_graft + pv.ctl[slot, CTL_NAMES.index("graft"), 1]
+
+    evaluate = jnp.mod(rnd + 1, c.fanout_every) == 0
+    measurable = w_gos >= c.fanout_gossip_min
+    hot = measurable & (w_dup * 100 >= c.fanout_hi_pct * w_gos)
+    storm = measurable & (w_gra * 100 >= c.graft_hi_pct * w_gos)
+    cold = measurable & (w_dup * 100 <= c.fanout_lo_pct * w_gos)
+    promote = evaluate & (storm | cold)
+    demote = evaluate & hot & ~promote
+    cap = jnp.clip(
+        fs.eager_cap + promote.astype(jnp.int32)
+        - demote.astype(jnp.int32),
+        c.fanout_min, _overlay_width(cfg))
+    stepped = cap != fs.eager_cap
+    rslot = jnp.mod(rnd, c.ring)
+    zero = jnp.int32(0)
+    return FanoutState(
+        eager_cap=cap,
+        win_dup=jnp.where(evaluate, zero, w_dup),
+        win_gossip=jnp.where(evaluate, zero, w_gos),
+        win_graft=jnp.where(evaluate, zero, w_gra),
+        adjustments=fs.adjustments + stepped.astype(jnp.int32),
+        rnd=fs.rnd.at[rslot].set(rnd),
+        cap=fs.cap.at[rslot].set(cap))
+
+
+def _backpressure_update(cfg: Config, bp: BackpressureState, rnd: Array,
+                         chmax: Array) -> BackpressureState:
+    """Integrate each channel's per-round delivered-age high-water mark
+    (``chmax`` int32[C], already allmax-reduced by round_body) into the
+    pressure level: at/above ``age_hi`` raises it, at/below ``age_lo``
+    decays it — a bounded integrator, so a transient spike sheds for a
+    few rounds and a quiet channel relaxes back to no-shed."""
+    c = cfg.control
+    up = chmax >= c.age_hi
+    down = chmax <= c.age_lo
+    press = jnp.clip(bp.press + up.astype(jnp.int32)
+                     - down.astype(jnp.int32), 0, c.press_max)
+    changed = jnp.sum((press != bp.press).astype(jnp.int32))
+    rslot = jnp.mod(rnd, c.ring)
+    return BackpressureState(
+        press=press,
+        adjustments=bp.adjustments + changed,
+        rnd=bp.rnd.at[rslot].set(rnd),
+        press_ring=bp.press_ring.at[rslot].set(press))
+
+
+def _healing_update(cfg: Config, hs: HealingState, rnd: Array,
+                    health) -> HealingState:
+    """Re-key the escalation off the digest the health plane just
+    (possibly) wrote.  Decisions only move on snapshot rounds — the
+    digest is fresh exactly then ((rnd+1) % health == 0, the cadence
+    round_body's snapshot cond uses) — so ``heal_hold`` counts
+    SNAPSHOTS, not rounds; the ring still records every round's boost
+    in force."""
+    from partisan_tpu import health as health_mod
+
+    c = cfg.control
+    due = jnp.mod(rnd + 1, cfg.health) == 0
+    word = health.digest
+    valid = (word & health_mod.DIGEST_VALID) != 0
+    ok_bits = health_mod.OVERLAY_BITS   # the shared graph-health bits
+    degraded = valid & ((word & ok_bits) != ok_bits)
+    streak_s = jnp.where(degraded, 0, hs.streak + valid.astype(jnp.int32))
+    boost_s = jnp.where(
+        degraded, jnp.int32(c.heal_boost),
+        jnp.where(streak_s >= c.heal_hold, jnp.int32(0), hs.boost))
+    boost = jnp.where(due, boost_s, hs.boost)
+    streak = jnp.where(due, streak_s, hs.streak)
+    rslot = jnp.mod(rnd, c.ring)
+    return HealingState(
+        boost=boost, streak=streak,
+        adjustments=hs.adjustments + (boost != hs.boost).astype(jnp.int32),
+        rnd=hs.rnd.at[rslot].set(rnd),
+        boost_ring=hs.boost_ring.at[rslot].set(boost))
+
+
+def update(cfg: Config, cs: ControlState, *, rnd: Array, pv=None,
+           health=None, chmax: Array | None = None) -> ControlState:
+    """One controller step, at the end of ``round_body`` on the planes'
+    freshly written states.  Pure: (replicated inputs) -> (replicated
+    controller state); the applied operands (eager cap, shed ages,
+    heal boost) are read at the NEXT round's start from the carry —
+    one round of actuation delay, the price of staying a scan carry.
+    Each controller traces under its own ``round.control.*``
+    named_scope (the lint zero-cost key)."""
+    fan, bp, heal = cs.fanout, cs.backpressure, cs.healing
+    if cfg.control.fanout:
+        with jax.named_scope("round.control.fanout"):
+            fan = _fanout_update(cfg, fan, rnd, pv)
+    if cfg.control.backpressure:
+        with jax.named_scope("round.control.backpressure"):
+            bp = _backpressure_update(cfg, bp, rnd, chmax)
+    if cfg.control.healing:
+        with jax.named_scope("round.control.healing"):
+            heal = _healing_update(cfg, heal, rnd, health)
+    return ControlState(fanout=fan, backpressure=bp, healing=heal)
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers (the planes' snapshot/rows idiom)
+# ---------------------------------------------------------------------------
+
+def poll(cs: ControlState) -> dict:
+    """Tiny host summary of the controllers' CURRENT operands (a few
+    scalar transfers — what soak chunk rows carry)."""
+    import jax as _jax
+
+    out: dict = {}
+    if cs.fanout != ():
+        out["eager_cap"] = int(_jax.device_get(cs.fanout.eager_cap))
+        out["fanout_adjustments"] = int(
+            _jax.device_get(cs.fanout.adjustments))
+    if cs.backpressure != ():
+        import numpy as np
+
+        out["press"] = np.asarray(
+            _jax.device_get(cs.backpressure.press)).astype(int).tolist()
+    if cs.healing != ():
+        out["heal_boost"] = int(_jax.device_get(cs.healing.boost))
+    return out
+
+
+def snapshot(cs: ControlState) -> dict:
+    """Decode the decision rings (one device->host transfer, after the
+    scan), ordered by round via the shared ``metrics.ring_order``."""
+    import jax as _jax
+    import numpy as np
+
+    from partisan_tpu.metrics import ring_order
+
+    host = _jax.device_get(cs)
+    out: dict = {}
+    if host.fanout != ():
+        rnd = np.asarray(host.fanout.rnd)
+        idx = ring_order(rnd)
+        out["fanout"] = {
+            "rounds": rnd[idx],
+            "cap": np.asarray(host.fanout.cap)[idx],
+            "eager_cap": int(host.fanout.eager_cap),
+            "adjustments": int(host.fanout.adjustments),
+        }
+    if host.backpressure != ():
+        rnd = np.asarray(host.backpressure.rnd)
+        idx = ring_order(rnd)
+        out["backpressure"] = {
+            "rounds": rnd[idx],
+            "press": np.asarray(host.backpressure.press_ring)[idx],
+            "current": np.asarray(host.backpressure.press),
+            "adjustments": int(host.backpressure.adjustments),
+        }
+    if host.healing != ():
+        rnd = np.asarray(host.healing.rnd)
+        idx = ring_order(rnd)
+        out["healing"] = {
+            "rounds": rnd[idx],
+            "boost": np.asarray(host.healing.boost_ring)[idx],
+            "current": int(host.healing.boost),
+            "adjustments": int(host.healing.adjustments),
+        }
+    return out

@@ -301,6 +301,22 @@ def healthy(word: int) -> bool:
     return (int(word) & bits) == bits
 
 
+# The OVERLAY-health bit set (coverage excluded — coverage describes a
+# workload, not the graph): the single definition the healing
+# controller's degraded predicate (control.py), the A/B heal oracle
+# (scenarios.control_ab) and the tests all key on, so the actuation
+# predicate and its evidence cannot drift.
+OVERLAY_BITS = (DIGEST_ONE_COMPONENT | DIGEST_NO_ISOLATES
+                | DIGEST_MIN_DEGREE)
+
+
+def overlay_ok(word: int) -> bool:
+    """Valid digest whose one-component / no-isolates / min-degree
+    bits are ALL set — the graph-health predicate, coverage aside."""
+    bits = DIGEST_VALID | OVERLAY_BITS
+    return (int(word) & bits) == bits
+
+
 def digest_converged(word: int) -> bool:
     """The convergence predicate ``_converge`` polls: a recorded
     snapshot whose coverage bit is set."""

@@ -192,9 +192,27 @@ def zero_hist() -> Array:
     return jnp.zeros((N_BUCKETS,), jnp.int32)
 
 
+def channel_age_max(cfg: Config, msgs: Array, mask: Array,
+                    rnd: Array) -> Array:
+    """int32[C]: max age among the records selected by ``mask``, per
+    ``W_CHANNEL`` (shard-local; callers ``comm.allmax``).  0 = floor
+    (ages are >= 0).  Shared by :func:`record_round`'s high-water-mark
+    accumulate and the backpressure controller's per-round pressure
+    signal (control.py) — one implementation, so the two cannot
+    drift."""
+    C = cfg.n_channels
+    ch = jnp.clip(msgs[..., T.W_CHANNEL], 0, C - 1)
+    a = ages(msgs, rnd)
+    return jnp.max(
+        jnp.where(mask[..., None] & (ch[..., None] == jnp.arange(C)),
+                  a[..., None], 0),
+        axis=tuple(range(a.ndim)))
+
+
 def record_round(cfg: Config, comm, ls: LatencyState, *, rnd: Array,
                  inbox_data: Array, dead: Array, fault_hist: Array,
-                 compact_hist: Array, outbox_hist: Array) -> LatencyState:
+                 compact_hist: Array, outbox_hist: Array,
+                 chmax: Array | None = None) -> LatencyState:
     """Accumulate one round's ages.  ``inbox_data`` is the routed inbox
     BEFORE the dead-receiver masking (``[n_local, cap, W]``) and
     ``dead`` its per-node mask (under ``Config.width_operand`` the mask
@@ -203,7 +221,11 @@ def record_round(cfg: Config, comm, ls: LatencyState, *, rnd: Array,
     the three drop histograms arrive shard-local from their cut sites.
     Every increment is reduced here (allsum / allmax), keeping the
     state replicated — this runs inside the jitted scan body, zero
-    host syncs."""
+    host syncs.  ``chmax`` optionally supplies the ALREADY-REDUCED
+    per-round per-channel age maximum (``comm.allmax(channel_age_max(
+    ...))`` over the same inputs) — round_body passes the backpressure
+    controller's pressure signal so the reduction (and its cross-shard
+    collective) traces once, not twice."""
     from partisan_tpu.metrics import CAUSE_COMPACT, CAUSE_DEAD, \
         CAUSE_FAULT, CAUSE_OUTBOX
 
@@ -212,14 +234,10 @@ def record_round(cfg: Config, comm, ls: LatencyState, *, rnd: Array,
     dlv = comm.allsum(channel_age_hist(cfg, inbox_data, delivered, rnd))
 
     # Per-channel delivery-age high-water mark (0 = floor: ages >= 0).
-    C = cfg.n_channels
-    ch = jnp.clip(inbox_data[..., T.W_CHANNEL], 0, C - 1)
-    a = ages(inbox_data, rnd)
-    per_ch = jnp.max(
-        jnp.where(delivered[..., None] & (ch[..., None]
-                                          == jnp.arange(C)), a[..., None], 0),
-        axis=tuple(range(a.ndim)))
-    hwm = jnp.maximum(ls.age_hwm, comm.allmax(per_ch))
+    if chmax is None:
+        chmax = comm.allmax(channel_age_max(cfg, inbox_data, delivered,
+                                            rnd))
+    hwm = jnp.maximum(ls.age_hwm, chmax)
 
     dead_hist = age_hist(inbox_data, live & dead[:, None], rnd)
     drop = ls.drop_age

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import jax
 
-from partisan_tpu.config import Config, PlumtreeConfig
+from partisan_tpu.config import Config, ControlConfig, PlumtreeConfig
 from partisan_tpu.lint.core import Program, trace_program
 
 
@@ -39,6 +39,15 @@ def full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
                     provenance=True, provenance_ring=16, health=4,
                     health_ring=8, width_operand=True,
                     flight_rounds=2 if flight else 0, **kw)
+
+
+def control_full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
+    """Every plane + every in-scan controller (the closed-loop round;
+    also the sharding completeness rule's reference state — controller
+    leaves need PartitionSpecs like any other carry)."""
+    return full_cfg(n, flight=flight, channel_capacity=True,
+                    control=ControlConfig(fanout=True, backpressure=True,
+                                          healing=True, ring=8), **kw)
 
 
 def _round_program(name: str, cfg: Config, model=None, *,
@@ -107,5 +116,23 @@ def default_matrix() -> list[Program]:
         # flight ring included — the breach-dump source)
         _round_program("scan/soak-chunk",
                        full_cfg(n=16, flight=True), scan=4),
+        # in-scan controllers (ROADMAP item 5 guard rail): each
+        # controller alone over its prerequisite plane — its off-state
+        # is covered by every entry above (no round.control.* scope may
+        # appear there) — plus the all-controllers closed-loop scan
+        _round_program("round/control-fanout",
+                       base_cfg(provenance=True, provenance_ring=16,
+                                control=ControlConfig(fanout=True,
+                                                      ring=8))),
+        _round_program("round/control-backpressure",
+                       base_cfg(latency=True, channel_capacity=True,
+                                control=ControlConfig(backpressure=True,
+                                                      ring=8))),
+        _round_program("round/control-healing",
+                       base_cfg(health=4, health_ring=8,
+                                control=ControlConfig(healing=True,
+                                                      ring=8))),
+        _round_program("scan/control-all+planes",
+                       control_full_cfg(), scan=4),
     ]
     return progs

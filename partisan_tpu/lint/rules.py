@@ -136,14 +136,33 @@ def interleave_budget(prog: Program) -> list[Finding]:
 # ---------------------------------------------------------------------------
 
 def _planes_of(cfg):
-    """(plane name, enabled) for every optional carry plane."""
+    """(plane/controller name, enabled) for every optional carry
+    subsystem.  Controller names are dotted — their named_scope is
+    ``round.control.<name>`` and their carry leaf ``state.control.
+    <name>`` (the dotted path walks the sub-pytree)."""
     return (
         ("metrics", bool(cfg.metrics)),
         ("latency", bool(cfg.latency)),
         ("flight", bool(cfg.flight_rounds)),
         ("health", cfg.health > 0),
         ("provenance", bool(cfg.provenance)),
+        ("control.fanout", cfg.control.fanout),
+        ("control.backpressure", cfg.control.backpressure),
+        ("control.healing", cfg.control.healing),
     )
+
+
+def _carry_leaf(state, dotted: str):
+    """Walk ``state.<a>.<b>`` with () short-circuiting (a disabled
+    parent leaf has no attributes).  The empty check is structural —
+    ``x == ()`` on an array raises, and rule-firing fixtures trace bare
+    arrays as the program state."""
+    leaf = state
+    for part in dotted.split("."):
+        if isinstance(leaf, tuple) and len(leaf) == 0:
+            return ()
+        leaf = getattr(leaf, part, ())
+    return leaf
 
 
 def zero_cost_when_off(prog: Program) -> list[Finding]:
@@ -158,10 +177,13 @@ def zero_cost_when_off(prog: Program) -> list[Finding]:
         scope = scope_of(eqn)
         if not scope:
             continue
+        segs = scope.split("/")
         for p in off + on:
             tag = f"round.{p}"
-            if (scope == tag or scope.startswith(tag + "/")) \
-                    and p not in seen:
+            # Segment match: controller scopes nest under phase scopes
+            # (e.g. round.model/round.control.fanout inside plumtree's
+            # push), so the key must hit at any stack depth.
+            if tag in segs and p not in seen:
                 seen.add(p)
                 if p in on:
                     continue
@@ -187,7 +209,7 @@ def zero_cost_when_off(prog: Program) -> list[Finding]:
         import jax.tree_util as jtu
 
         for p in off:
-            leaf = getattr(prog.state, p, ())
+            leaf = _carry_leaf(prog.state, p)
             if jtu.tree_leaves(leaf):
                 out.append(Finding(
                     rule="", file="partisan_tpu/cluster.py",
@@ -294,11 +316,11 @@ def sharding_spec_completeness() -> list[Finding]:
     import jax
 
     from partisan_tpu.cluster import Cluster
-    from partisan_tpu.lint.matrix import full_cfg
+    from partisan_tpu.lint.matrix import control_full_cfg
     from partisan_tpu.models.plumtree import Plumtree
     from partisan_tpu.parallel.sharded import ShardedCluster, make_mesh
 
-    cfg = full_cfg(flight=True)
+    cfg = control_full_cfg(flight=True)
     cl = Cluster(cfg, model=Plumtree())
     state = jax.eval_shape(cl._build_init)
     sc = ShardedCluster(cfg, make_mesh(1), model=Plumtree())

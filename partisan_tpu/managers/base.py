@@ -48,6 +48,12 @@ class RoundCtx(NamedTuple):
     #               otherwise).  Full-range random id draws (rejoin
     #               contacts, discovery fallbacks) MUST be bounded by
     #               it so prefix dynamics match a native-width run.
+    control: Any = ()  # control.ControlState — the ROUND-START feedback-
+    #               controller operands (() when Config.control has no
+    #               controller on).  Managers/models gate reads on the
+    #               STATIC Config.control flags: plumtree's eager push
+    #               reads ctx.control.fanout.eager_cap, hyparview's
+    #               cadences read ctx.control.healing.boost.
 
 
 class Manager(Protocol):

@@ -338,7 +338,19 @@ class HyParView:
         # width-operand trace-parity contract (an inactive row firing
         # pr_fire made rounds busy that a native-width run leaves
         # quiet).
-        sh_fire = ((ctx.rnd + ph) % cfg.shuffle_every == 0) \
+        # Self-healing escalation (control.py): while the health digest
+        # reports a degraded overlay the repair cadences run at
+        # interval >> boost — probe/promotion rates escalate exactly
+        # while partitioned and relax once healed (the reference's
+        # fixed wall-clock timers, made a feedback operand).
+        sh_every = jnp.int32(cfg.shuffle_every)
+        pr_every = jnp.int32(cfg.promotion_every)
+        if cfg.control.healing:
+            with jax.named_scope("round.control.healing"):
+                boost = ctx.control.healing.boost
+                sh_every = jnp.maximum(sh_every >> boost, 1)
+                pr_every = jnp.maximum(pr_every >> boost, 1)
+        sh_fire = ((ctx.rnd + ph) % sh_every == 0) \
             & (asize0 > 0) & ctx.alive
         # Random promotion stays PER-NODE STAGGERED even under aligned
         # timers: it is the view-healing path broadcast stragglers
@@ -346,7 +358,7 @@ class HyParView:
         # 16k (a straggler waits out the whole promotion interval).  It
         # only fires for under-full nodes, so a settled overlay still
         # reaches the quiet path every non-shuffle round.
-        pr_fire = ((ctx.rnd + gids) % cfg.promotion_every == 0) & \
+        pr_fire = ((ctx.rnd + gids) % pr_every == 0) & \
             (asize0 < hv.active_min) & ctx.alive
         if hv.xbot:
             x_timer = ((ctx.rnd + ph) % cfg.xbot_every == 0) \
@@ -888,7 +900,14 @@ class HyParView:
         hb_epoch, hb_rnd = state.hb_epoch, state.hb_rnd
         if hv.heartbeat:
             H = cfg.rounds(hv.heartbeat_every_ms)
-            window = cfg.rounds(hv.isolation_window_ms)
+            window = jnp.int32(cfg.rounds(hv.isolation_window_ms))
+            if cfg.control.healing:
+                # Escalated isolation window: a stale-epoch node
+                # re-joins sooner while the digest shows the overlay
+                # degraded (the rejoin-rate half of the escalation).
+                with jax.named_scope("round.control.healing"):
+                    window = jnp.maximum(
+                        window >> ctx.control.healing.boost, 1)
             # The epoch root is the lowest-id ALIVE node — root duty
             # migrates on crash (a fixed node-0 root would freeze every
             # epoch when node 0 dies and put the whole cluster into a

@@ -397,6 +397,19 @@ class Plumtree:
             psrc_sel = post_sel[..., PW + 2]                # [n, S]
             eager = live_k & ~pruned_sel & (nbrs[:, None, :]
                                             != psrc_sel[:, :, None])
+            gov_cut = None
+            if cfg.control.fanout:
+                # Fanout governor (control.py): bound this push's eager
+                # set to the round-start budget ctx.control carries —
+                # links beyond it take the lazy I_HAVE path below (a
+                # pruned link's exact wire behavior), so the cut is
+                # reversible per round and survives the slot-recycle
+                # epoch resets that wipe the learned ``pruned`` flags.
+                with jax.named_scope("round.control.fanout"):
+                    gov_cap = ctx.control.fanout.eager_cap
+                    erank = jnp.cumsum(eager, axis=-1) - 1
+                    gov_cut = eager & (erank >= gov_cap)
+                    eager = eager & ~gov_cut
             dst = jnp.where(sel_ok[:, :, None] & eager, nbrs[:, None, :], -1)
             data_sel = post_sel[..., :PW]                   # [n, S, PW]
             push_msgs = msg_ops.build(
@@ -406,7 +419,9 @@ class Plumtree:
                          post_sel[..., PW][:, :, None],
                          post_sel[..., PW + 1][:, :, None]),
             ).reshape(n_local, S * K, W)
-            lazy_new = sel_ok[:, :, None] & live_k & pruned_sel     # [n, S, K]
+            lazy_sel = pruned_sel if gov_cut is None \
+                else pruned_sel | gov_cut
+            lazy_new = sel_ok[:, :, None] & live_k & lazy_sel       # [n, S, K]
             oh_sel = (sel[:, :, None] == jnp.arange(B)[None, None, :])
             lazyp = lazyp | (jnp.einsum(
                 "nsb,nsk->nbk", oh_sel.astype(jnp.bfloat16),

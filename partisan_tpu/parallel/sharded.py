@@ -33,6 +33,7 @@ from jax import Array
 from jax.sharding import Mesh, PartitionSpec as P
 
 from partisan_tpu import channels as channels_mod
+from partisan_tpu import control as control_mod
 from partisan_tpu import delivery as delivery_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import health as health_mod
@@ -287,6 +288,10 @@ class ShardedCluster:
                             gossip=repl, claims=repl, ctl=repl,
                             depth_hwm=repl, cover_rnd=repl,
                             dup_cum=repl, gossip_cum=repl)),
+            # Controllers: every decision is a function of already-
+            # reduced plane values, so all shards step identical
+            # controller state — replicated like the rings it reads.
+            control=spec_like(state.control, repl),
         )
 
     # ---- state construction ------------------------------------------
@@ -317,6 +322,8 @@ class ShardedCluster:
                     if health_mod.enabled(cfg) else ()),
             provenance=(provenance_mod.init(cfg, self.host_comm)
                         if provenance_mod.enabled(cfg) else ()),
+            control=(control_mod.init(cfg)
+                     if control_mod.enabled(cfg) else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

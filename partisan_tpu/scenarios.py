@@ -955,6 +955,323 @@ def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200):
             "healthy": health_mod.healthy(digest)}
 
 
+def config8_overload(n=96, waves=10, wave_len=12, adaptive=True,
+                     seed=7):
+    """Bulk-traffic overload under channel capacity: the backpressure
+    controller's A/B harness (ROADMAP item 3's first SLO slice).
+
+    Repeated bursts of simultaneous fresh plumtree broadcasts saturate
+    the per-edge broadcast lanes (``lane_rate=1``): static config
+    defers pile up in the shared outbox and deliver rounds late —
+    exactly the head-of-line blocking Partisan's ATC'19 motivation
+    names.  With ``adaptive=True`` the backpressure controller
+    (``Config.control.backpressure``) integrates each channel's
+    delivered-age high-water mark into a pressure level and sheds the
+    stalest queued records, bounding per-channel delivery p99 while
+    plumtree's repair path keeps coverage complete.  Returns the
+    per-channel p99/max/count from ``latency.percentiles`` — the
+    ``--slo`` gate's input."""
+    from partisan_tpu import latency as latency_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, ControlConfig, PlumtreeConfig
+    from partisan_tpu.models.plumtree import Plumtree
+
+    n = max(n, 32)
+    ctl = ControlConfig(backpressure=True) if adaptive \
+        else ControlConfig()
+    cfg = Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 latency=True, channel_capacity=True, lane_rate=1,
+                 outbox_cap=48, max_broadcasts=8, control=ctl,
+                 plumtree=PlumtreeConfig(aae=False))
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = _boot_joinall(cl, 40)
+    rng = np.random.default_rng(9)
+    ver = 1
+    for _ in range(waves):
+        mm = st.model
+        for s in range(4):
+            src = int(rng.integers(0, n))
+            mm = model.broadcast(mm, src, s, ver + 1, fresh=True)
+        ver += 1
+        st = cl.steps(st._replace(model=mm), wave_len)
+    _sync(st)
+    names = tuple(c.name for c in cfg.channels)
+    pct = latency_mod.percentiles(st.latency, channels=names)
+    out = {"config": 8, "n": n, "adaptive": adaptive,
+           "waves": waves, "wave_len": wave_len,
+           "coverage": round(float(model.coverage(
+               st.model, st.faults.alive, 3, version=ver)), 4),
+           "outbox_shed": int(jax.device_get(st.outbox.shed)),
+           "p99": {ch: pct[ch]["p99"] for ch in names},
+           "age_max": {ch: pct[ch]["max"] for ch in names},
+           "delivered": {ch: pct[ch]["count"] for ch in names}}
+    if adaptive:
+        from partisan_tpu import control as control_mod
+
+        out["control"] = control_mod.poll(st.control)
+    return out
+
+
+def slo_gate(p99: dict, bound: int) -> tuple[bool, list[dict]]:
+    """Per-channel p99 pass/fail rows against ``bound`` rounds (the
+    ``--slo`` gate over ``latency.percentiles`` output).  Channels
+    with no traffic pass vacuously."""
+    rows = []
+    ok = True
+    for ch, v in p99.items():
+        passed = v is None or v <= bound
+        ok = ok and passed
+        rows.append({"kind": "slo", "channel": ch, "p99": v,
+                     "bound": bound, "pass": passed})
+    return ok, rows
+
+
+def _boot_joinall(cl, settle: int):
+    """All nodes join via node 0 in one scripted batch, then settle —
+    the A/B harnesses' shared bootstrap (deterministic and cheap; the
+    staggered _boot_overlay is for fidelity-sensitive scenarios)."""
+    n = cl.cfg.n_nodes
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager, list(range(1, n)),
+                             [0] * (n - 1))
+    return cl.steps(st._replace(manager=m), settle)
+
+
+def fanout_ab_arm(adaptive: bool, n=128, waves=12, wave_len=10,
+                  seed=3) -> dict:
+    """ONE arm of the fanout governor's A/B (the single harness both
+    ``control_ab`` — the committed CONTROL_AB.json — and the tier-1
+    gate in tests/test_control.py run, so the evidence and the test
+    cannot drift apart).  Recycled-slot broadcasts reset the learned
+    pruned flags by design (per-root trees), so the static config
+    re-floods at full overlay fanout every recycle; the governor
+    retains the learned budget.  lazy_tick 3 rounds so I_HAVE adverts
+    lag the eager wave (the reference's 1 s batching vs ms hops)
+    instead of racing it.  AAE is off so dissemination is measurably
+    eager+lazy (the exchange lane otherwise out-races the flood and
+    leaves nothing to govern) — which makes the lazy advert chain the
+    ONLY last-mile repair, so shuffles are quiesced for the run: link
+    churn sheds ``lazy_pending`` flags by design (plumtree's
+    neighbors_down handling) and with AAE off a shed advert toward a
+    governor-cut straggler would never retransmit (production configs
+    keep AAE on exactly for this).  Returns cumulative + steady-half
+    redundancy ratios, final-slot coverage, and the controller's
+    poll."""
+    from partisan_tpu import control as control_mod
+    from partisan_tpu import provenance as prov_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import (Config, ControlConfig,
+                                     HyParViewConfig, PlumtreeConfig)
+    from partisan_tpu.models.plumtree import Plumtree
+
+    ctl = ControlConfig(fanout=True) if adaptive else ControlConfig()
+    cfg = Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 provenance=True, provenance_ring=512,
+                 max_broadcasts=8, control=ctl, lazy_tick_ms=3000,
+                 hyparview=HyParViewConfig(active_min=6, active_max=8,
+                                           shuffle_interval_ms=60_000),
+                 plumtree=PlumtreeConfig(aae=False))
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = _boot_joinall(cl, 60)
+    rng = np.random.default_rng(5)
+    ver = 1
+    for w in range(waves):
+        st = st._replace(model=model.broadcast(
+            st.model, int(rng.integers(0, n)), w % 4, ver + 1,
+            fresh=True))
+        ver += 1
+        st = cl.steps(st, wave_len)
+    traffic_end = int(jax.device_get(st.rnd))
+    # drain: the last wave's lazy/graft repair gets one more window
+    # before coverage is judged (the claim is coverage-at-completion;
+    # reading at the exact wave boundary races the final graft RTT)
+    st = cl.steps(st, wave_len)
+    _sync(st)
+    snap = prov_mod.snapshot(st.provenance)
+    rr = np.asarray(snap["rounds"])
+    g = np.asarray(snap["gossip"]).astype(float)
+    d = np.asarray(snap["dup"]).sum(axis=1).astype(float)
+    # the steady half of the TRAFFIC phase (drain rounds excluded)
+    tail = (rr >= traffic_end - (waves // 2) * wave_len) \
+        & (rr < traffic_end)
+    arm = {
+        "redundancy_ratio": prov_mod.redundancy(
+            snap)["redundancy_ratio"],
+        "steady_redundancy_ratio": round(
+            float(d[tail].sum()) / max(float(g[tail].sum()), 1), 4),
+        "coverage": round(float(model.coverage(
+            st.model, st.faults.alive, (waves - 1) % 4,
+            version=ver)), 4),
+    }
+    if adaptive:
+        arm.update(control_mod.poll(st.control))
+        arm["_state"] = st               # for the tier-1 gate's ring
+    return arm
+
+
+def fanout_calm_arm(adaptive: bool, n=64, seed=4) -> dict:
+    """The calm-run arm: one ordinary broadcast, no recycles, then 30
+    further QUIET rounds.  The no-regression claim is outcome parity —
+    identical coverage and redundancy to the static arm (the governor
+    MAY take a step on the one dissemination wave; a single recoverable
+    demotion with identical outcomes is the loop working, not a
+    regression) — plus stillness on the quiet tail: once traffic
+    stops, the governor must stop too (``quiet_adjustments`` == 0)."""
+    from partisan_tpu import control as control_mod
+    from partisan_tpu import provenance as prov_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, ControlConfig, PlumtreeConfig
+    from partisan_tpu.models.plumtree import Plumtree
+
+    ctl = ControlConfig(fanout=True) if adaptive else ControlConfig()
+    cfg = Config(n_nodes=n, seed=seed,
+                 peer_service_manager="hyparview", msg_words=16,
+                 partition_mode="groups", provenance=True,
+                 provenance_ring=256, max_broadcasts=4, control=ctl,
+                 plumtree=PlumtreeConfig(aae=False))
+    model = Plumtree()
+    cl = Cluster(cfg, model=model)
+    st = _boot_joinall(cl, 40)
+    st = st._replace(model=model.broadcast(st.model, 0, 0, 2))
+    st = cl.steps(st, 30)
+    adj_after_wave = (int(jax.device_get(st.control.fanout.adjustments))
+                      if adaptive else 0)
+    st = cl.steps(st, 30)                 # the quiet tail
+    _sync(st)
+    arm = {"redundancy_ratio": prov_mod.redundancy(
+               st.provenance)["redundancy_ratio"],
+           "coverage": round(float(model.coverage(
+               st.model, st.faults.alive, 0, version=2)), 4)}
+    if adaptive:
+        arm.update(control_mod.poll(st.control))
+        arm["quiet_adjustments"] = (arm["fanout_adjustments"]
+                                    - adj_after_wave)
+    return arm
+
+
+def healing_ab_arm(adaptive: bool, n=128, seed=11,
+                   crash_frac=0.35) -> dict:
+    """ONE arm of the healing escalation A/B (shared by ``control_ab``
+    and the tier-1 gate): a crash batch degrades the digest; the arm
+    reports rounds until the controller's own graph-health predicate
+    (``health.overlay_ok``) holds again."""
+    from partisan_tpu import control as control_mod
+    from partisan_tpu import faults as faults_mod
+    from partisan_tpu import health as health_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, ControlConfig
+    from partisan_tpu.models.plumtree import Plumtree
+
+    ctl = ControlConfig(healing=True) if adaptive else ControlConfig()
+    cfg = Config(n_nodes=n, seed=seed,
+                 peer_service_manager="hyparview", msg_words=16,
+                 partition_mode="groups", health=5, health_ring=256,
+                 control=ctl)
+    cl = Cluster(cfg, model=Plumtree())
+    st = _boot_joinall(cl, 60)
+    rng = np.random.default_rng(13)
+    victims = rng.choice(np.arange(1, n), size=int(n * crash_frac),
+                         replace=False)
+    st = st._replace(faults=faults_mod.crash_many(
+        st.faults, [int(v) for v in victims]))
+    r0 = int(jax.device_get(st.rnd))
+    healed = -1
+    for _ in range(60):
+        st = cl.steps(st, 5)
+        if health_mod.overlay_ok(health_mod.digest(st)):
+            healed = int(jax.device_get(st.rnd)) - r0
+            break
+    arm = {"rounds_to_heal": healed}
+    if adaptive:
+        arm.update(control_mod.poll(st.control))
+        arm["_state"] = st               # for the tier-1 gate's follow-on
+    return arm
+
+
+def _strip_state(arm: dict) -> dict:
+    """Drop the test-only state handle before JSON export."""
+    return {k: v for k, v in arm.items() if k != "_state"}
+
+
+def control_ab(scale: float = 1.0) -> dict:
+    """The three controllers' A/B evidence (ISSUE 10 acceptance): for
+    each, one scenario where the closed loop beats the best static
+    config on its headline metric, plus a calm-run no-regression check
+    for the fanout governor.  Every arm is deterministic (fixed seeds)
+    and SHARED with the tier-1 gates in tests/test_control.py (the
+    ``*_ab_arm`` harnesses above), so the committed CONTROL_AB.json
+    reproduces exactly and certifies the same procedure the tests
+    assert."""
+    out: dict = {}
+
+    # ---- 1. fanout governor: steady-state redundancy ratio ------------
+    n = max(64, int(128 * scale))
+    fan_s = fanout_ab_arm(False, n=n)
+    fan_a = _strip_state(fanout_ab_arm(True, n=n))
+    out["fanout"] = {
+        "metric": "steady_redundancy_ratio", "n": n,
+        "static": fan_s, "adaptive": fan_a,
+        "win": fan_a["steady_redundancy_ratio"]
+        < fan_s["steady_redundancy_ratio"],
+        "coverage_ok": fan_a["coverage"] == 1.0,
+    }
+
+    # ---- 1b. fanout calm-run no-regression ----------------------------
+    cn = max(48, int(64 * scale))
+    calm_s = fanout_calm_arm(False, n=cn)
+    calm_a = fanout_calm_arm(True, n=cn)
+    out["fanout_calm"] = {
+        "static": calm_s, "adaptive": calm_a,
+        # outcome parity + quiet-tail stillness (see fanout_calm_arm)
+        "no_regression": (calm_a["coverage"] == calm_s["coverage"]
+                          and calm_a["redundancy_ratio"]
+                          == calm_s["redundancy_ratio"]
+                          and calm_a["quiet_adjustments"] == 0),
+    }
+
+    # ---- 2. backpressure: per-channel delivery p99 under overload -----
+    bp_n = max(48, int(96 * scale))
+    bp_s = config8_overload(n=bp_n, adaptive=False)
+    bp_a = config8_overload(n=bp_n, adaptive=True)
+    bulk = [ch for ch, v in bp_s["p99"].items() if v is not None]
+    # A trafficked channel must STAY trafficked in the adaptive arm (a
+    # loop that sheds a channel to silence has destroyed it, not
+    # improved it) and strictly beat the static p99.
+    out["backpressure"] = {
+        "metric": "p99_delivery_age", "n": bp_n,
+        "static": bp_s, "adaptive": bp_a,
+        "win": bool(bulk) and all(
+            bp_a["p99"][ch] is not None
+            and bp_a["delivered"][ch] > 0
+            and bp_a["p99"][ch] < bp_s["p99"][ch]
+            for ch in bulk),
+        "coverage_ok": bp_a["coverage"] == 1.0,
+    }
+
+    # ---- 3. healing: rounds-to-heal after a crash batch ---------------
+    hn = max(64, int(128 * scale))
+    heal_s = healing_ab_arm(False, n=hn)
+    heal_a = _strip_state(healing_ab_arm(True, n=hn))
+    out["healing"] = {
+        "metric": "rounds_to_heal", "n": hn,
+        "static": heal_s, "adaptive": heal_a,
+        "win": (heal_a["rounds_to_heal"] != -1
+                and (heal_s["rounds_to_heal"] == -1
+                     or heal_a["rounds_to_heal"]
+                     < heal_s["rounds_to_heal"])),
+    }
+
+    out["all_win"] = bool(out["fanout"]["win"]
+                          and out["backpressure"]["win"]
+                          and out["healing"]["win"]
+                          and out["fanout_calm"]["no_regression"])
+    return out
+
+
 # ---------------------------------------------------------------------------
 
 ALL = {
@@ -965,14 +1282,17 @@ ALL = {
     5: config5_causal_crash,
     6: config6_echo,
     7: config7_soak,
+    8: config8_overload,
 }
 
 DEFAULT_SIZES = {1: 16, 2: 1000, 3: 10_000, 4: 10_000, 5: 100_000, 6: 2,
-                 7: 10_000}
+                 7: 10_000, 8: 96}
 
 # Scenarios excluded from run_all's default sweep (run them with
-# --only/--soak): the soak is hours of simulated time by design.
-OPT_IN = frozenset({7})
+# --only/--soak/--slo): the soak is hours of simulated time by design;
+# the overload scenario is the backpressure controller's A/B harness
+# and SLO-gate input, driven by --slo / --control-ab.
+OPT_IN = frozenset({7, 8})
 
 
 def run_all(scale: float = 1.0, only=None) -> list[dict]:
@@ -1033,6 +1353,21 @@ if __name__ == "__main__":
     ap.add_argument("--ckpt-dir", default=None,
                     help="persist soak checkpoints here (atomic, "
                          "fingerprinted; with --soak)")
+    ap.add_argument("--slo", type=int, nargs="?", const=4, default=None,
+                    metavar="P99_ROUNDS",
+                    help="per-channel p99 SLO gate (default bound 4 "
+                         "rounds): run the bulk-traffic overload "
+                         "scenario (config 8) as the backpressure A/B "
+                         "harness — static arm for reference, adaptive "
+                         "arm gated — print one slo verdict line per "
+                         "channel from latency.percentiles and exit "
+                         "non-zero if the closed loop breaches")
+    ap.add_argument("--control-ab", action="store_true",
+                    help="run the three in-scan controllers' A/B "
+                         "evidence scenarios (fanout redundancy, "
+                         "backpressure p99, healing rounds-to-heal, "
+                         "calm no-regression) and print the comparison "
+                         "object (the committed CONTROL_AB.json)")
     args = ap.parse_args()
     METRICS = METRICS or args.metrics
     LATENCY = LATENCY or args.latency
@@ -1041,6 +1376,23 @@ if __name__ == "__main__":
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    if args.control_ab:
+        print(json.dumps(control_ab(scale=args.scale)), flush=True)
+        raise SystemExit(0)
+    if args.slo is not None:
+        n8 = max(48, int(DEFAULT_SIZES[8] * args.scale))
+        static = config8_overload(n=n8, adaptive=False)
+        adaptive = config8_overload(n=n8, adaptive=True)
+        print(json.dumps({"kind": "overload_static", **static}),
+              flush=True)
+        print(json.dumps({"kind": "overload_adaptive", **adaptive}),
+              flush=True)
+        ok, rows = slo_gate(adaptive["p99"], args.slo)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        print(json.dumps({"kind": "slo_verdict", "pass": ok,
+                          "bound": args.slo}), flush=True)
+        raise SystemExit(0 if ok else 1)
     if args.soak:
         print(json.dumps(config7_soak(
             n=max(64, int(DEFAULT_SIZES[7] * args.scale)),

@@ -722,6 +722,13 @@ class Soak:
                 word = health_mod.digest(nxt_state)
                 row["digest"] = word
                 row["healthy"] = health_mod.healthy(word)
+            if getattr(nxt_state, "control", ()) != ():
+                # in-scan controller operands at the chunk boundary (a
+                # few scalar transfers): eager cap / pressure levels /
+                # heal boost in force, surfaced per soak_report row
+                from partisan_tpu import control as control_mod
+
+                row["control"] = control_mod.poll(nxt_state.control)
             chunks.append(row)
             lengths.add(k)
             state, r = nxt_state, got

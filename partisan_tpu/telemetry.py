@@ -48,6 +48,13 @@ BROADCAST_REDUNDANCY = ("partisan", "broadcast", "redundancy_spike")
 BROADCAST_GRAFT_STORM = ("partisan", "broadcast", "graft_storm")
 BROADCAST_TREE_REPAIRED = ("partisan", "broadcast", "tree_repaired")
 
+# Control-plane events (control.py decision rings -> discrete events):
+# an in-scan controller changed its operand — the closed-loop analogue
+# of the planes' threshold events above.
+CONTROL_FANOUT_ADJUSTED = ("partisan", "control", "fanout_adjusted")
+CONTROL_SHED_CHANGED = ("partisan", "control", "shed_threshold_changed")
+CONTROL_HEALING = ("partisan", "control", "healing_escalated")
+
 # Soak-engine recovery events (soak.py host log -> discrete events):
 # chunk execution retried after a worker crash, state restored from a
 # checkpoint, and a per-chunk invariant breach (with its dump paths).
@@ -320,6 +327,68 @@ def replay_broadcast_events(bus: Bus, snap: Mapping[str, Any], *,
                         {"round": int(rnd)})
             n_events += 1
             storm_start = None
+    return n_events
+
+
+def replay_control_events(bus: Bus, snap: Mapping[str, Any], *,
+                          channels: tuple[str, ...] | None = None) -> int:
+    """Replay a controller snapshot (``control.snapshot``) as discrete
+    ``partisan.control.*`` bus events — the host-side adapter from the
+    in-scan decision rings to the telemetry idiom (same shape as the
+    plane replays above).  The rings record the operand in force after
+    EVERY round, so an event is a round where it CHANGED:
+
+    - ``fanout_adjusted`` — the plumtree eager-link budget stepped
+      (measurements carry the new and previous cap),
+    - ``shed_threshold_changed`` — a channel's backpressure level moved
+      (one event per changed channel, the channel in the metadata),
+    - ``healing_escalated`` — the overlay repair boost changed
+      (escalations and relaxations both; direction in the metadata).
+
+    Returns the number of events emitted."""
+    n_events = 0
+    fan = snap.get("fanout")
+    if fan is not None:
+        rounds = np.asarray(fan["rounds"])
+        cap = np.asarray(fan["cap"])
+        for i in range(1, len(rounds)):
+            if cap[i] != cap[i - 1]:
+                bus.execute(CONTROL_FANOUT_ADJUSTED,
+                            {"cap": int(cap[i]), "prev": int(cap[i - 1])},
+                            {"round": int(rounds[i])})
+                n_events += 1
+    bp = snap.get("backpressure")
+    if bp is not None:
+        rounds = np.asarray(bp["rounds"])
+        press = np.asarray(bp["press"])
+        C = press.shape[1] if press.ndim == 2 else 0
+        # index-padded: a caller-supplied tuple shorter than the ring's
+        # channel axis falls back to ch{i} instead of IndexError
+        given = tuple(channels) if channels is not None else ()
+        names = tuple(given[i] if i < len(given) else f"ch{i}"
+                      for i in range(C))
+        for i in range(1, len(rounds)):
+            for c in range(C):
+                if press[i, c] != press[i - 1, c]:
+                    bus.execute(CONTROL_SHED_CHANGED,
+                                {"press": int(press[i, c]),
+                                 "prev": int(press[i - 1, c])},
+                                {"round": int(rounds[i]),
+                                 "channel": names[c]})
+                    n_events += 1
+    heal = snap.get("healing")
+    if heal is not None:
+        rounds = np.asarray(heal["rounds"])
+        boost = np.asarray(heal["boost"])
+        for i in range(1, len(rounds)):
+            if boost[i] != boost[i - 1]:
+                bus.execute(CONTROL_HEALING,
+                            {"boost": int(boost[i]),
+                             "prev": int(boost[i - 1])},
+                            {"round": int(rounds[i]),
+                             "direction": "escalate"
+                             if boost[i] > boost[i - 1] else "relax"})
+                n_events += 1
     return n_events
 
 

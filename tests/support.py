@@ -3,8 +3,36 @@ analogue (reference test/partisan_support.erl:46+): config factories,
 staggered bootstrap, and host-side overlay graph checks."""
 
 import collections
+import os
 
 from partisan_tpu.config import Config
+
+# ---------------------------------------------------------------------------
+# Tier-1 runtime scale knobs (ISSUE 10 satellite).  The 1-CPU container
+# measures the full suite well past the 870 s budget with ZERO failures
+# (PR 8 note: five runs timed out at 83-87%; a full baseline run here
+# measured 1409 s) — the wall is environmental, and the heaviest tests
+# are parameterized by node width / trial count, not by what they
+# assert.  These constants shrink those dimensions WITHOUT touching any
+# assertion: every oracle gate still runs, over fewer or smaller
+# randomized instances.  PARTISAN_TEST_FULL=1 restores the original
+# (TPU-sized) parameters for full-fidelity runs.
+# ---------------------------------------------------------------------------
+
+FULL = bool(int(os.environ.get("PARTISAN_TEST_FULL", "0") or "0"))
+# widest sharded-parity width (tests/test_sharded.py wide-convergence
+# parity: 4096 = 512 nodes/shard on mesh8; 1024 = 128/shard still
+# exercises the a2a quota + multi-wave bootstrap cross-shard)
+WIDE_N = 4096 if FULL else 1024
+# larger-scale SCAMP conformance band (tests/test_scenarios.py): the
+# band is asserted at EVERY scale; 256 is still 2x the smoke n
+SCAMP_BAND_N = 512 if FULL else 256
+# randomized-overlay trials per oracle gate (health BFS / provenance
+# trace-replay): the gates assert EXACT parity per overlay either way
+ORACLE_TRIALS = 40 if FULL else 20
+# mixed-fault soak width (tests/test_soak.py 500-round storm): the
+# storm schedule and every invariant are width-independent
+SOAK_N = 256 if FULL else 128
 
 
 def hv_config(n, seed, **kw):

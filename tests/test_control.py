@@ -1,0 +1,314 @@
+"""In-scan feedback controllers (partisan_tpu/control.py, ISSUE 10):
+
+- flag-off is the default and carries nothing (the lint matrix gates
+  zero-cost); flag-ON over a CALM run is behaviorally identical — every
+  non-control leaf bit-matches the off run (no threshold crossed means
+  no actuation, so turning a loop on cannot perturb a healthy cluster),
+- each controller closes its loop: the fanout governor lowers
+  steady-state redundancy on a recycled-broadcast workload, the
+  backpressure controller bounds per-channel delivery p99 under
+  overload, the healing controller beats the fixed-timer repair
+  cadence after a crash batch,
+- decisions are deterministic, replicated under sharding, checkpoint-
+  safe, and observable (decision rings -> partisan.control.* events).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import control as control_mod
+from partisan_tpu import telemetry
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, ControlConfig
+from partisan_tpu.models.plumtree import Plumtree
+
+from support import assert_scan_lint_clean, assert_states_bitidentical
+
+
+def _join_all(cl, st):
+    n = cl.cfg.n_nodes
+    m = cl.manager.join_many(cl.cfg, st.manager,
+                             list(range(1, n)), [0] * (n - 1))
+    return st._replace(manager=m)
+
+
+def _all_cfg(ctl: ControlConfig, n=32, **kw) -> Config:
+    """Every plane + channel capacity: the closed-loop round's shape."""
+    return Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
+                  msg_words=16, partition_mode="groups",
+                  provenance=True, provenance_ring=64,
+                  latency=True, channel_capacity=True,
+                  health=5, health_ring=32, max_broadcasts=8,
+                  control=ctl, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Config validation: a controller without its plane must fail loudly
+# ---------------------------------------------------------------------------
+
+def test_controller_prerequisites_validated():
+    with pytest.raises(ValueError, match="provenance"):
+        Config(control=ControlConfig(fanout=True))
+    with pytest.raises(ValueError, match="latency"):
+        Config(channel_capacity=True,
+               control=ControlConfig(backpressure=True))
+    with pytest.raises(ValueError, match="channel_capacity"):
+        Config(latency=True, control=ControlConfig(backpressure=True))
+    with pytest.raises(ValueError, match="health"):
+        Config(control=ControlConfig(healing=True))
+    # a valid closed-loop config builds
+    _all_cfg(ControlConfig(fanout=True, backpressure=True, healing=True))
+
+
+# ---------------------------------------------------------------------------
+# Calm-run parity: controllers ON but never triggered == controllers OFF
+# ---------------------------------------------------------------------------
+
+def test_calm_run_flag_on_is_behaviorally_identical():
+    """On a settled, healthy, quiet overlay no controller's threshold
+    is crossed, so the flag-on run's every NON-control leaf must
+    bit-match the flag-off run: turning the loops on cannot perturb a
+    calm cluster (the per-controller off-state bit-parity is the lint
+    matrix's zero-cost gate)."""
+    ctl_on = ControlConfig(fanout=True, backpressure=True, healing=True,
+                           ring=16)
+    cfg_off = _all_cfg(ControlConfig())
+    cfg_on = _all_cfg(ctl_on)
+    cl_off = Cluster(cfg_off, model=Plumtree())
+    cl_on = Cluster(cfg_on, model=Plumtree())
+    # settle to a healthy overlay WITHOUT controllers, then fork: the
+    # on-arm gets the same state plus a fresh controller leaf
+    st = cl_off.steps(_join_all(cl_off, cl_off.init()), 60)
+    st_on = st._replace(control=control_mod.init(cfg_on))
+    out_off = cl_off.steps(st, 25)
+    out_on = cl_on.steps(st_on, 25)
+    # no actuation happened: budget at full width, no pressure, boost 0
+    k = out_on.control
+    assert int(k.fanout.eager_cap) == cfg_on.hyparview.active_max
+    assert int(np.asarray(k.backpressure.press).max()) == 0
+    assert int(k.healing.boost) == 0
+    assert_states_bitidentical(out_off._replace(control=()),
+                               out_on._replace(control=()),
+                               "calm_on_vs_off")
+
+
+# ---------------------------------------------------------------------------
+# Fanout governor: redundancy falls, coverage holds
+# ---------------------------------------------------------------------------
+
+def test_fanout_governor_reduces_steady_redundancy():
+    """The SRDS'07 trade, closed-loop: recycled-slot broadcasts reset
+    the learned pruned flags (per-root trees), so the static config
+    re-floods at full fanout forever; the governor's retained budget
+    must cut the steady-state duplicate fraction while lazy repair
+    keeps coverage complete.  Runs the SAME harness as the committed
+    CONTROL_AB.json (scenarios.fanout_ab_arm), at test scale."""
+    from partisan_tpu.scenarios import fanout_ab_arm
+
+    arm_s = fanout_ab_arm(False, n=64, waves=8)
+    arm_a = fanout_ab_arm(True, n=64, waves=8)
+    assert arm_s["coverage"] == 1.0 and arm_a["coverage"] == 1.0
+    assert arm_a["steady_redundancy_ratio"] \
+        < arm_s["steady_redundancy_ratio"], (arm_a, arm_s)
+    st = arm_a["_state"]
+    fs = st.control.fanout
+    assert int(fs.adjustments) > 0
+    assert int(fs.eager_cap) < 8     # demoted below the overlay width
+    # the decision ring recorded the trajectory (ordered, labeled)
+    snap = control_mod.snapshot(st.control)["fanout"]
+    assert snap["rounds"].max() == int(jax.device_get(st.rnd)) - 1
+    assert snap["cap"].min() >= 2            # never below the floor
+
+
+# ---------------------------------------------------------------------------
+# Backpressure: stale sheds bound p99; fresh channels untouched
+# ---------------------------------------------------------------------------
+
+def _overload_run(adaptive, n=48, waves=6, wave_len=12):
+    from partisan_tpu.scenarios import config8_overload
+
+    return config8_overload(n=n, waves=waves, wave_len=wave_len,
+                            adaptive=adaptive)
+
+
+def test_backpressure_bounds_p99_under_overload():
+    """Partisan's monotonic shed, generalized: under bulk-lane
+    saturation the closed loop sheds the stalest queued records —
+    bounding saturated channels' delivery p99 strictly below the
+    static config's — while the channel STAYS trafficked (shedding a
+    channel to silence would be destruction, not improvement) and
+    coverage stays complete (plumtree repair re-covers shed gossip)."""
+    s = _overload_run(False)
+    a = _overload_run(True)
+    assert s["coverage"] == 1.0 and a["coverage"] == 1.0
+    saturated = [ch for ch, v in s["p99"].items() if v is not None]
+    assert saturated, "overload scenario produced no traffic"
+    for ch in saturated:
+        assert a["p99"][ch] is not None and a["delivered"][ch] > 0, ch
+        assert a["p99"][ch] < s["p99"][ch], (ch, a["p99"], s["p99"])
+    assert a["outbox_shed"] > s["outbox_shed"]   # the mechanism: sheds
+    assert any(p > 0 for p in a["control"]["press"])
+
+
+def test_backpressure_shed_age_thresholds():
+    """The pressure->threshold map: 0 = never shed; each level halves
+    from age_hi down to a floor of 1."""
+    cfg = _all_cfg(ControlConfig(backpressure=True, age_hi=8,
+                                 press_max=5))
+    bp = control_mod.init(cfg).backpressure
+    for press, want in ((0, None), (1, 8), (2, 4), (3, 2), (4, 1),
+                        (5, 1)):
+        ages = control_mod.shed_age(
+            cfg, bp._replace(press=jnp.full_like(bp.press, press)))
+        got = int(np.asarray(ages)[0])
+        if want is None:
+            assert got >= 2**29     # effectively +inf
+        else:
+            assert got == want, (press, got)
+
+
+# ---------------------------------------------------------------------------
+# Healing escalation: digest-keyed cadences beat fixed timers
+# ---------------------------------------------------------------------------
+
+def test_healing_escalation_beats_fixed_timers():
+    """Rounds-to-heal after a 35% crash batch: the digest-keyed
+    escalated cadences must restore a healthy digest strictly faster
+    than the reference's fixed shuffle/promotion timers — and the
+    escalation must RELAX once healed (boost returns to 0 after
+    heal_hold healthy snapshots).  Runs the SAME harness as the
+    committed CONTROL_AB.json (scenarios.healing_ab_arm), at test
+    scale."""
+    from partisan_tpu.scenarios import healing_ab_arm
+
+    n = 96
+    arm_s = healing_ab_arm(False, n=n)
+    arm_a = healing_ab_arm(True, n=n)
+    healed_s, healed_a = arm_s["rounds_to_heal"], arm_a["rounds_to_heal"]
+    assert healed_a != -1
+    assert healed_s == -1 or healed_a < healed_s, (healed_a, healed_s)
+    st = arm_a["_state"]
+    hs = st.control.healing
+    assert int(hs.adjustments) >= 1          # it escalated at least once
+    # run on: after heal_hold consecutive healthy snapshots the boost
+    # relaxes (min-degree flickers for a few windows while the
+    # escalated shuffles settle, so poll rather than pin a round)
+    cl = Cluster(Config(
+        n_nodes=n, seed=11, peer_service_manager="hyparview",
+        msg_words=16, partition_mode="groups", health=5, health_ring=256,
+        control=ControlConfig(healing=True)), model=Plumtree())
+    relaxed = False
+    for _ in range(16):
+        st = cl.steps(st, 5)
+        if int(st.control.healing.boost) == 0:
+            relaxed = True
+            break
+    assert relaxed, "escalation never relaxed after healing"
+    snap = control_mod.snapshot(st.control)["healing"]
+    assert snap["boost"].max() >= 1          # the ring saw the episode
+
+
+# ---------------------------------------------------------------------------
+# Determinism / sharding / checkpoint / lint / telemetry
+# ---------------------------------------------------------------------------
+
+def test_controllers_sharded_parity():
+    """The closed-loop round under shard_map: controller decisions are
+    functions of already-reduced plane values, so the sharded run must
+    be bit-identical to the single-device run — controller leaves
+    included."""
+    from partisan_tpu.parallel import ShardedCluster, make_mesh
+
+    assert len(jax.devices()) >= 8
+    cfg = _all_cfg(ControlConfig(fanout=True, backpressure=True,
+                                 healing=True, ring=16), n=32)
+    model = Plumtree()
+
+    def run(make):
+        cl = make()
+        st = _join_all(cl, cl.init())
+        st = cl.steps(st, 20)
+        st = st._replace(model=model.broadcast(st.model, 0, 0, 2,
+                                               fresh=True))
+        st = cl.steps(st, 20)
+        return jax.device_get(st)
+
+    a = run(lambda: Cluster(cfg, model=model))
+    b = run(lambda: ShardedCluster(cfg, make_mesh(8), model=model))
+    assert_states_bitidentical(a, b, "control_sharded")
+
+
+def test_controllers_checkpoint_roundtrip(tmp_path):
+    """Controller state rides the checkpoint like any carry leaf, and
+    the config fingerprint covers the control block (a changed band is
+    shape-preserving drift the fingerprint must catch)."""
+    from partisan_tpu import checkpoint
+
+    cfg = _all_cfg(ControlConfig(fanout=True, backpressure=True,
+                                 healing=True, ring=16))
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.steps(_join_all(cl, cl.init()), 15)
+    p = tmp_path / "ck.npz"
+    checkpoint.save(st, p, cfg=cfg)
+    back = checkpoint.restore(p, like=cl.init(), cfg=cfg)
+    assert_states_bitidentical(back, st, "control_ckpt")
+    drifted = cfg.replace(control=ControlConfig(
+        fanout=True, backpressure=True, healing=True, ring=16,
+        fanout_hi_pct=41))
+    with pytest.raises(checkpoint.CheckpointError, match="fingerprint"):
+        checkpoint.restore(p, like=cl.init(), cfg=drifted)
+
+
+def test_controllers_scan_lint_clean():
+    """The closed-loop scan passes the shared lint rules (no host
+    callback, zero-cost keying, narrow dtypes, scatter overlap) — the
+    matrix gate's in-test twin."""
+    cfg = _all_cfg(ControlConfig(fanout=True, backpressure=True,
+                                 healing=True, ring=16), n=16)
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    assert_scan_lint_clean(cl, st, k=4, name="control-scan")
+
+
+def test_replay_control_events():
+    """Ring transitions -> partisan.control.* bus events: one event per
+    change, channel-tagged for backpressure, direction-tagged for
+    healing."""
+    snap = {
+        "fanout": {"rounds": np.asarray([10, 11, 12, 13]),
+                   "cap": np.asarray([6, 5, 5, 4])},
+        "backpressure": {"rounds": np.asarray([10, 11, 12]),
+                         "press": np.asarray([[0, 0], [0, 1], [0, 1]])},
+        "healing": {"rounds": np.asarray([10, 11, 12]),
+                    "boost": np.asarray([0, 2, 0])},
+    }
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "control"), rec)
+    n = telemetry.replay_control_events(bus, snap,
+                                        channels=("default", "bulk"))
+    assert n == 5
+    fan = rec.of(telemetry.CONTROL_FANOUT_ADJUSTED)
+    assert [(e[1]["cap"], e[2]["round"]) for e in fan] == [(5, 11), (4, 13)]
+    shed = rec.of(telemetry.CONTROL_SHED_CHANGED)
+    assert len(shed) == 1 and shed[0][2]["channel"] == "bulk"
+    heal = rec.of(telemetry.CONTROL_HEALING)
+    assert [e[2]["direction"] for e in heal] == ["escalate", "relax"]
+
+
+def test_control_poll_and_events_from_real_run():
+    """End-to-end: a real closed-loop run's snapshot replays through
+    the bus, and poll() gives the soak chunk row summary."""
+    from partisan_tpu.scenarios import fanout_ab_arm
+
+    st = fanout_ab_arm(True, n=48, waves=4)["_state"]
+    snap = control_mod.snapshot(st.control)
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "control"), rec)
+    n = telemetry.replay_control_events(bus, snap)
+    assert n >= 1                       # the governor moved at least once
+    p = control_mod.poll(st.control)
+    assert set(p) >= {"eager_cap", "fanout_adjustments"}

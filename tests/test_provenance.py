@@ -6,7 +6,8 @@ through cluster/delivery/channels/interpose):
   perturb the simulation (read-only plane, bit-for-bit),
 - the ACCEPTANCE gate: the device-accumulated dissemination forest and
   redundancy/control rings match the host trace-replay oracle
-  (tests/support.py ProvenanceOracle) EXACTLY on >= 50 randomized,
+  (tests/support.py ProvenanceOracle) EXACTLY on dozens of randomized
+  (support.ORACLE_TRIALS-sized),
   faulted and churned overlays, for both the plumtree spec (hop +
   epoch words) and the hop-less rumor-mongering spec,
 - slot recycles (epoch bumps) reset the forest entry on both sides,
@@ -139,16 +140,18 @@ def _assert_matches_oracle(cfg, st, oracle, trial):
 
 
 def test_plumtree_parity_with_oracle_on_randomized_overlays():
-    """The acceptance gate: >= 40 plumtree overlays (randomized join
+    """The acceptance gate: ORACLE_TRIALS plumtree overlays (randomized join
     topology, random origins, crashes, recovery, iid link drop) — the
     device plane must equal the host trace-replay oracle EXACTLY:
     forest tables, per-round redundancy/control rings, depth high-water
     marks, time-to-coverage, cumulative totals."""
     cfg = _pt_cfg()
     cl = _cluster("pt", lambda: Cluster(cfg, model=Plumtree()))
+    from support import ORACLE_TRIALS
+
     rng = np.random.default_rng(42)
     gossip_seen = dup_seen = 0
-    for trial in range(40):
+    for trial in range(ORACLE_TRIALS):
         st, oracle = _random_overlay_trial(
             cl, cfg, rng,
             inject=lambda cl, m, node, b, start:

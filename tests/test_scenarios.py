@@ -69,7 +69,9 @@ def test_config4_scamp_band_holds_at_larger_scale():
     10k).  The rate-bounded admission stagger makes the subscription
     process scale-invariant; gate it at the largest CPU-feasible n
     too."""
-    r = scenarios.config4_scamp_churn(n=512, rounds=40)
+    from support import SCAMP_BAND_N
+
+    r = scenarios.config4_scamp_churn(n=SCAMP_BAND_N, rounds=40)
     assert r["in_band"], r
 
 

@@ -27,10 +27,10 @@ from partisan_tpu.cluster import Cluster
 from partisan_tpu.config import Config
 from partisan_tpu.models.plumtree import Plumtree
 
-from support import (assert_states_bitidentical, boot_hyparview,
+from support import (SOAK_N, assert_states_bitidentical, boot_hyparview,
                      components, hv_config)
 
-N = 256
+N = SOAK_N
 
 
 def _one_component(st) -> bool:
@@ -348,6 +348,60 @@ def test_storm_omission_installs_filibuster_schedule():
     s2 = soak.Omission(one, start=30).apply(cl, s2, 0)
     merged = np.asarray(jax.device_get(s2.interpose))
     assert merged[10, 3, 0] and merged[30, 3, 0]
+
+
+def test_mid_storm_restore_replays_controller_decisions(tmp_path):
+    """ISSUE 10 soak interplay: with all three in-scan controllers in
+    the carry, a worker crash mid-STORM (retry + fresh context +
+    checkpoint restore) must replay every controller decision
+    bit-identically — the final state, CONTROLLER LEAVES INCLUDED
+    (eager-cap trajectory rings, pressure integrators, heal boost),
+    equals the undisturbed storm run's.  Controllers are pure functions
+    of the carry, so the checkpoint protocol that replays the storm
+    replays the loop; this extends the storm-replay parity suite to
+    the closed-loop round."""
+    from partisan_tpu.config import ControlConfig
+
+    def mk():
+        cfg = Config(n_nodes=32, seed=3, peer_service_manager="hyparview",
+                     msg_words=16, partition_mode="groups",
+                     metrics=True, metrics_ring=64, latency=True,
+                     health=5, health_ring=32,
+                     provenance=True, provenance_ring=64,
+                     channel_capacity=True,
+                     control=ControlConfig(fanout=True, backpressure=True,
+                                           healing=True, ring=16))
+        return Cluster(cfg, model=Plumtree())
+
+    cl = mk()
+    st = _booted(cl)
+    r0 = int(jax.device_get(st.rnd))
+    storm = _test_storm(r0, period=20)   # the storm drives escalation
+    crashed = {"done": False}
+
+    def step(c, s, k):
+        r = int(jax.device_get(s.rnd))
+        if not crashed["done"] and r + k > r0 + 25:
+            crashed["done"] = True
+            raise jax.errors.JaxRuntimeError("injected worker crash")
+        return c.steps(s, k)
+
+    eng = soak.Soak(
+        make_cluster=mk, storm=storm, step_fn=step,
+        cfg=soak.SoakConfig(chunk_fixed=10, cooldown_s=0.0,
+                            checkpoint_dir=str(tmp_path),
+                            degraded_factor=1e9),
+        sleep_fn=lambda s: None)
+    res = eng.run(st, rounds=40)
+    assert res.retries == 1 and crashed["done"]
+    # controller operands surfaced on every chunk row (the soak_report
+    # surface of the decision state)
+    assert all("control" in row for row in res.chunks)
+    ref = soak.reference_run(mk(), st, r0 + 40, storm=storm)
+    # the storm crashed nodes and degraded the digest: the healing
+    # loop must actually have acted for this parity to mean anything
+    assert int(ref.control.healing.adjustments) >= 1
+    assert_states_bitidentical(res.state, ref, "control_storm_resume")
 
 
 def test_kill_at_chunk_boundary_resume_bit_parity(tmp_path):

@@ -10,15 +10,21 @@ its dump paths), the replayed ``partisan.soak.*`` bus events, and a
 trailing summary::
 
     python tools/soak_report.py [n] [rounds] [--chunk K] [--crash-at R]
-                                [--breach] [--ckpt-dir DIR]
+                                [--breach] [--control] [--ckpt-dir DIR]
 
 ``--crash-at R`` injects a ``JaxRuntimeError`` into the first chunk
 dispatch that would cross R rounds into the soak — off-TPU proof of
 the retry/backoff + checkpoint-restore path (the minute-mark worker
 crash, tools/MINUTE_FAULT.md).  ``--breach`` holds a partition across the
 final quarter with the one-component invariant armed, so the output
-shows a real ``invariant_breach`` with black-box dumps.  Importable:
-``report(result)`` renders any ``soak.SoakResult``.
+shows a real ``invariant_breach`` with black-box dumps.  ``--control``
+closes the loop: all three in-scan controllers (control.py — plumtree
+fanout governor, channel backpressure, healing escalation) ride the
+soak with their prerequisite planes, every chunk row carries the
+operands in force (``control``: eager cap / pressure / boost), and the
+replayed ``partisan.control.*`` decision events print alongside the
+soak events.  Importable: ``report(result)`` renders any
+``soak.SoakResult``.
 """
 
 from __future__ import annotations
@@ -31,9 +37,10 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def report(res, out=sys.stdout) -> dict:
+def report(res, out=sys.stdout, channels=None) -> dict:
     """Dump a ``soak.SoakResult`` as JSON lines; returns (and prints as
-    the last line) the summary dict."""
+    the last line) the summary dict.  ``channels`` optionally names the
+    config's channels so controller shed events carry real labels."""
     from partisan_tpu import telemetry
 
     for row in res.chunks:
@@ -44,6 +51,16 @@ def report(res, out=sys.stdout) -> dict:
     bus = telemetry.Bus()
     bus.attach("report", ("partisan", "soak"), rec)
     telemetry.replay_soak_events(bus, res.log)
+    if getattr(res.state, "control", ()) != ():
+        # controller decision events (fanout_adjusted /
+        # shed_threshold_changed / healing_escalated), replayed from
+        # the in-scan decision rings with real channel names
+        from partisan_tpu import control as control_mod
+
+        bus.attach("control", ("partisan", "control"), rec)
+        telemetry.replay_control_events(
+            bus, control_mod.snapshot(res.state.control),
+            channels=channels)
     for event, meas, meta in rec.events:
         print(json.dumps({"kind": "event", "event": list(event),
                           **meas, **meta}, default=str), file=out)
@@ -81,7 +98,7 @@ def main() -> None:
     # flag value never leaks into the positional [n, rounds] slots.
     VALUE_FLAGS = ("--chunk", "--crash-at", "--ckpt-dir")
     argv = sys.argv[1:]
-    args, opts, breach = [], {}, False
+    args, opts, breach, control = [], {}, False, False
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -92,6 +109,9 @@ def main() -> None:
             i += 2
         elif a == "--breach":
             breach = True
+            i += 1
+        elif a == "--control":
+            control = True
             i += 1
         elif a.startswith("--"):
             raise SystemExit(f"unknown flag {a}\n{USAGE}")
@@ -104,6 +124,17 @@ def main() -> None:
     crash_at = opts.get("--crash-at")
     ckpt_dir = opts.get("--ckpt-dir")
 
+    from partisan_tpu.config import ControlConfig
+
+    ctl = {}
+    if control:
+        # close the loop: the controllers + their prerequisite planes
+        ctl = dict(latency=True, channel_capacity=True,
+                   provenance=True, provenance_ring=max(128, rounds),
+                   control=ControlConfig(fanout=True, backpressure=True,
+                                         healing=True,
+                                         ring=max(64, rounds)))
+
     def mk():
         return Cluster(Config(
             n_nodes=n, seed=9, peer_service_manager="hyparview",
@@ -113,7 +144,7 @@ def main() -> None:
             # The flight ring (the breach black box) forces the generic
             # wire path and roughly doubles compile time — carry it
             # only when the breach demo will dump it.
-            flight_rounds=8 if breach else 0), model=Plumtree())
+            flight_rounds=8 if breach else 0, **ctl), model=Plumtree())
 
     cl = mk()
     # The canonical batched staggered bootstrap (K_PROG-grained waves +
@@ -162,7 +193,7 @@ def main() -> None:
                             cooldown_s=0.0, dump_dir=dump_dir),
         sleep_fn=lambda s: None)
     res = eng.run(st, rounds=rounds)
-    report(res)
+    report(res, channels=tuple(c.name for c in cl.cfg.channels))
 
 
 if __name__ == "__main__":
