@@ -506,6 +506,46 @@ def _lint_verdict(budget_s: float) -> dict:
         return {"verdict": "SKIP", "reason": repr(exc)[:200]}
 
 
+def _cost_card(budget_s: float) -> dict:
+    """Fold the STATIC round-cost census (tools/profile_phases.py
+    --cost: per-phase gather/scatter eqn counts, fetched scalars,
+    materialized [n, ., .] intermediate bytes of the plain 32k round)
+    into the artifact, so every future bench carries the op-count
+    trajectory next to the wall numbers it explains — BENCH_NOTES'
+    corrected cost model as a measured series.  CPU-only subprocess
+    (tracing, no compile): the relay is never touched."""
+    import subprocess
+
+    if budget_s < 20:
+        return {"verdict": "SKIP", "reason": "bench budget exhausted"}
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # The census itself is ~1-2 s; --budgets re-traces the whole
+        # lint matrix (~60 s on a slow CPU), so only fold the verdict
+        # in when the budget can actually pay for it — a tight budget
+        # must degrade to census-only, never to a SKIP card.
+        budgets = budget_s >= 90
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "profile_phases.py"),
+             "--cost", "--width-op", "32768"]
+            + (["--budgets"] if budgets else []),
+            capture_output=True, text=True, env=env,
+            timeout=max(20.0, min(120.0, budget_s)))
+        rows = [json.loads(ln) for ln in p.stdout.splitlines()
+                if ln.startswith("{")]
+        summary = next(r for r in reversed(rows) if r["kind"] == "cost")
+        phases = {r["phase"]: {k: r[k] for k in
+                               ("gather_scatter_eqns", "fetched_scalars",
+                                "interm_mib", "eqns")}
+                  for r in rows if r["kind"] == "cost_phase"}
+        return {k: v for k, v in summary.items() if k != "kind"} | {
+            "phases": phases}
+    except Exception as exc:  # census failure must never sink the bench
+        return {"verdict": "SKIP", "reason": repr(exc)[:200]}
+
+
 def main() -> None:
     # Ladder: the HEADLINE size runs FIRST with the full per-size cap —
     # its warm median-of-N is the artifact's core; its cold run comes
@@ -591,6 +631,7 @@ def main() -> None:
     print(json.dumps({
         "pallas_probe": _pallas_verdict(remaining()),
         "jaxlint": _lint_verdict(remaining()),
+        "cost": _cost_card(remaining()),
         "metric": (f"simulated gossip rounds/sec "
                    f"({top['n']}-node hyparview+plumtree)"),
         "value": warm["rounds_per_sec"]["median"],

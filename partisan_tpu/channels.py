@@ -134,17 +134,14 @@ def throttle(cfg: Config, comm, ob: OutboxState, emitted,
     out = both.at[..., T.W_KIND].set(
         jnp.where(send_now, both[..., T.W_KIND], 0))
 
-    # Compact deferred sends into the outbox (slot order = FIFO).
+    # Compact deferred sends into the outbox (slot order = FIFO): slot
+    # s takes the s-th deferred record — ONE dtype-grouped fill-gather
+    # over the sorted defer indices instead of W per-plane scatters
+    # (the round-cost meter's coalescing rule; empty slots fill 0).
     drank = jnp.cumsum(defer, axis=1) - 1
     keep = defer & (drank < OB)
-    slot = jnp.where(keep, drank, OB)
-    rows = jnp.broadcast_to(jnp.arange(n)[:, None], slot.shape)
-    new_data = plane_ops.zeros_like(ob.data)
-    # unique by construction: each kept record's slot is its defer-rank
-    # (a per-row cumsum — strictly increasing among kept entries), so
-    # the scatter is race-free and the lint overlap audit can see it
-    new_data = new_data.at[rows, slot].set(both, mode="drop",
-                                           unique_indices=True)
+    pos = jnp.sort(jnp.where(keep, m_idx[None, :], M), axis=1)[:, :OB]
+    new_data = plane_ops.take_rows(both, pos, fill=True)
     cut = defer & ~keep
     if stale is not None:
         # backpressure sheds join the outbox-cut accounting: same cut

@@ -160,9 +160,16 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             nbrs = manager.neighbors(cfg, mstate, comm)
             dstate_model, a_emit = model.step(cfg, comm, state.model,
                                               ctx, nbrs)
-            emitted = plane_ops.concat([m_emit, a_emit], axis=1)
+            # ONE assembly concatenate: managers/models hand back
+            # block tuples (plane_ops.blocks_of), so no record byte is
+            # copied twice between emission and the wire.
+            emitted = plane_ops.concat(
+                plane_ops.blocks_of(m_emit) + plane_ops.blocks_of(a_emit),
+                axis=1)
     else:
-        dstate_model, emitted = (), m_emit
+        mb = plane_ops.blocks_of(m_emit)
+        dstate_model = ()
+        emitted = mb[0] if len(mb) == 1 else plane_ops.concat(mb, axis=1)
     if px:
         # Provenance pair: widen every fresh emission by (emitter gid,
         # sender tree hop).  Appended BEFORE the birth word so the

@@ -242,21 +242,23 @@ def _free_slot_of_rank(free: Array) -> Array:
                            free.shape), mode="drop")
 
 
-def _compact(rows, mask: Array, cap: int) -> tuple[Array, Array]:
-    """Per-node: gather ``rows[i, e]`` where ``mask`` into ``cap`` slots,
+def _compact(recs, mask: Array, cap: int) -> tuple[Array, Array]:
+    """Per-node: gather ``recs[i, e]`` where ``mask`` into ``cap`` slots,
     preserving slot order.  Returns (packed [n, cap, w], n_dropped).
-    Layout-agnostic: Planes records compact per-plane off the same slot
-    map (no interleave)."""
+    Slot s takes the s-th masked record (ascending slot order — a
+    stable sort of the masked indices), fetched by ONE dtype-grouped
+    fill-gather instead of the previous per-plane scatter (W scatter
+    eqns per call on the causal lanes; the round-cost meter's
+    coalescing rule).  Layout-agnostic: arrays ride the same index."""
     n, e = mask.shape
-    rank = jnp.cumsum(mask, axis=1) - 1
-    slot = jnp.where(mask, rank, e + cap)
-    if plane_ops.is_planes(rows):
-        out = plane_ops.zero_planes((n, cap),
-                                    tuple(w.dtype for w in rows.ws))
-    else:
-        out = jnp.zeros((n, cap, rows.shape[-1]), rows.dtype)
-    rows_idx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
-    out = out.at[rows_idx, slot].set(rows, mode="drop")
+    idxs = jnp.sort(jnp.where(
+        mask, jnp.arange(e, dtype=jnp.int32)[None, :], e), axis=1)
+    if cap <= e:
+        pos = idxs[:, :cap]
+    else:   # more slots than sources: the tail stays empty (fill)
+        pos = jnp.concatenate(
+            [idxs, jnp.full((n, cap - e), e, jnp.int32)], axis=1)
+    out = plane_ops.take_rows(recs, pos, fill=True)
     dropped = jnp.sum(jnp.maximum(
         jnp.sum(mask, axis=1) - cap, 0), dtype=jnp.int32)
     return out, dropped
@@ -545,8 +547,12 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             # A dead destination ends its streams: clear the table entries
             # so a recovered destination gets a FRESH stream (seq 1, new
             # epoch) instead of a watermark gap it can never fill.
-            tbl_dead = (dst_ids0 >= 0) \
-                & ~ctx.faults.alive[jnp.maximum(dst_ids0, 0)]
+            # BOTH per-destination liveness reads (the dst table's and
+            # the unacked store's) ride ONE packed gather over the
+            # concatenated id lists — the pack_wire_info discipline.
+            alive_both = ctx.faults.alive[jnp.maximum(
+                jnp.concatenate([dst_ids0, h_dst], axis=1), 0)]
+            tbl_dead = (dst_ids0 >= 0) & ~alive_both[:, :DC]
             dst_ids0 = jnp.where(tbl_dead, -1, dst_ids0)
             dst_seq0 = jnp.where(tbl_dead, 0, dst_seq0)
             dst_ep0 = jnp.where(tbl_dead, 0, dst_ep0)
@@ -559,7 +565,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
             hb_id = jnp.take_along_axis(dst_ids0, hb, axis=1)
             hb_ep = jnp.take_along_axis(dst_ep0, hb, axis=1)
             stream_live = (hb_id == h_dst) & (hb_ep == h_ep2) \
-                & ctx.faults.alive[jnp.maximum(h_dst, 0)]
+                & alive_both[:, DC:]
             aborted = (hist[..., T.W_KIND] != 0) & ~stream_live
             n_aborted = comm.allsum(jnp.sum(aborted, dtype=jnp.int32))
             hist = hist.at[..., T.W_KIND].set(
@@ -753,18 +759,29 @@ def _fetch(buf, shared, idx: Array):
     """Per-node record fetch over the combined candidate index space:
     ``idx < B`` reads the node's buffer row, else the shared table.
     buf [n, B, w], shared [G, w], idx [n, D] -> [n, D, w].
-    Layout-agnostic: Planes fetch per plane off the same index map."""
+
+    Plane-major records ride TWO dtype-grouped fill-gathers (one per
+    source) whose out-of-branch entries fill 0, merged by an exact
+    disjoint ADD — previously every plane paid its own pair of gathers
+    plus a pair of selects (2·(W+A) gather eqns per fetch on the causal
+    lanes; the round-cost meter's coalescing rule)."""
     B = buf.shape[1]
     G = shared.shape[0]
+    if plane_ops.is_planes(buf):
+        n = buf.shape[0]
+        rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+        in_b = idx < B
+        in_s = (idx >= B) & (idx < B + G)
+        pos_b = jnp.where(in_b, jnp.clip(idx, 0, B - 1) + rows * B,
+                          n * B)
+        pos_s = jnp.where(in_s, idx - B, G)
+        flat_b = plane_ops.Planes(tuple(w.reshape(-1) for w in buf.ws))
+        gb = plane_ops.take_flat(flat_b, pos_b, fill=True)
+        gs = plane_ops.take_flat(shared, pos_s, fill=True)
+        return plane_ops.Planes(tuple(
+            b + s for b, s in zip(gb.ws, gs.ws)))
     ib = jnp.clip(idx, 0, B - 1)
     is_ = jnp.clip(idx - B, 0, G - 1)
-    if plane_ops.is_planes(buf):
-        return plane_ops.Planes(tuple(
-            jnp.where((idx < B + G),
-                      jnp.where(idx < B,
-                                jnp.take_along_axis(wb, ib, axis=1),
-                                ws[is_]), 0)
-            for wb, ws in zip(buf.ws, shared.ws)))
     from_buf = jnp.take_along_axis(buf, ib[..., None], axis=1)
     from_shared = shared[is_]
     out = jnp.where((idx < B)[..., None], from_buf, from_shared)
@@ -1078,10 +1095,8 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
                     okey, jnp.where(d, s_i * (C * ((1 << 18) + 1)) + ckey,
                                     INF2))
             topv, topi = jax.lax.top_k(-okey, D2)
-            rows2 = jnp.arange(n)[:, None]
-            drecs = plane_ops.where(
-                -topv < INF2, plane_ops.take_records(cmsg, (rows2, topi)),
-                0)
+            drecs = plane_ops.take_rows(
+                cmsg, jnp.where(-topv < INF2, topi, C), fill=True)
             drecs = drecs.at[..., T.W_LANE].set(
                 jnp.where(drecs[..., T.W_KIND] != 0, lid,
                           drecs[..., T.W_LANE]))
@@ -1103,9 +1118,8 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
             # unacked store recovers them on the next replay tick).
             fkey = jnp.where(avail_f & cvalid, ckey, INF2)
             ftop, fidx = jax.lax.top_k(-fkey, B2)
-            new_buf = plane_ops.where(
-                -ftop < INF2, plane_ops.take_records(cmsg, (rows2, fidx)),
-                0)
+            new_buf = plane_ops.take_rows(
+                cmsg, jnp.where(-ftop < INF2, fidx, C), fill=True)
             n_fut = jnp.sum(fkey < INF2, axis=1, dtype=jnp.int32)
             shed = comm.allsum(jnp.sum(jnp.maximum(n_fut - B2, 0),
                                        dtype=jnp.int32))

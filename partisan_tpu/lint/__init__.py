@@ -22,6 +22,12 @@ Layout:
   equations (the narrow-dtype rule's engine).
 - :mod:`matrix`    — the audited config matrix (each plane on/off,
   plane-major x width-operand, capture, OTP stack, soak chunk).
+- :mod:`cost`      — the round-cost meter: per-phase gather/scatter
+  eqn counts, fetched scalars and materialized [n, ., .] intermediate
+  bytes (BENCH_NOTES' corrected cost model, made a measured quantity).
+- :mod:`cost_budgets` — pinned per-program cost budgets; the
+  round-cost-budget rule fails tier-1 on regression OR on a stale
+  (unpinned-improvement) budget.
 - :mod:`waivers`   — the pinned baseline of documented exceptions;
   anything NOT in it fails, and in full-matrix runs a waiver nothing
   matched fails too (the baseline cannot rot).
@@ -41,6 +47,12 @@ from partisan_tpu.lint.core import (  # noqa: F401
     site_of,
     trace_program,
 )
+from partisan_tpu.lint.cost import (  # noqa: F401
+    Census,
+    PhaseCost,
+    census,
+    census_program,
+)
 from partisan_tpu.lint.rules import (  # noqa: F401
     PACKAGE_RULES,
     PROGRAM_RULES,
@@ -50,5 +62,6 @@ from partisan_tpu.lint.rules import (  # noqa: F401
 __all__ = [
     "Finding", "Program", "Report", "iter_eqns", "run_programs",
     "site_of", "trace_program", "PACKAGE_RULES", "PROGRAM_RULES",
-    "count_wire_interleaves",
+    "count_wire_interleaves", "Census", "PhaseCost", "census",
+    "census_program",
 ]

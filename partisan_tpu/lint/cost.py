@@ -1,0 +1,228 @@
+"""Round-cost meter: a jaxpr-level census of what the traced round
+actually dispatches — the static half of BENCH_NOTES' corrected cost
+model ("the 32k round is dozens of 2-5 ms ops paying HBM round-trips on
+materialized [n, cap, .] intermediates; gathers/scatters are priced per
+fetched scalar").  The r5 fused-wire-filter surgery (one packed gather
+replacing ~6 cross-row gathers, 246 -> 162 ms) was guided by exactly
+this model; the meter makes it a measured, gated quantity instead of a
+prose estimate.
+
+Three numbers per phase (``round.*`` named_scope key, inherited down
+into cond/scan sub-jaxprs the way the profiler's trace viewer groups
+them):
+
+- **gather/scatter equation count** — each is one dispatched op on the
+  relay-attached backend, the per-op tax the round pays regardless of
+  size.  ``gather`` covers take/take_along_axis/fancy indexing;
+  ``scatter*`` covers every ``.at[].set/add/max/min`` flavor.
+- **fetched scalars** — gather output elements + scatter update
+  elements: the per-fetched-scalar price of the cost model.
+- **materialized [n, ., .] intermediate bytes** — output bytes of every
+  equation whose result carries the node axis with rank >= 2, excluding
+  pure view/layout ops (broadcast/iota/reshape/slice/...) and call
+  wrappers (pjit/cond/scan — their inner equations are counted, the
+  wrapper result would double-count).  This is the HBM-round-trip
+  traffic a fused backend could avoid and this backend pays.
+
+The census is static — ``jax.make_jaxpr`` over ``jax.eval_shape``
+state, no device, no compile — so a 32k-config round prices in ~1 s on
+CPU (``tools/profile_phases.py --cost``), and the pinned budgets in
+:mod:`partisan_tpu.lint.cost_budgets` gate op-count regressions in
+tier-1 exactly like the interleave budget does (the ``round-cost-
+budget`` rule in rules.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.extend.core as jex_core
+
+from partisan_tpu.lint.core import Program, scope_of, sub_jaxprs
+
+# Call wrappers: the walker descends into their sub-jaxprs, so counting
+# the wrapper equation's own (forwarded) outputs would double-count.
+_WRAPPER_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "xla_call", "named_call",
+    "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+    "remat", "remat2", "checkpoint", "cond", "while", "scan",
+    "shard_map", "custom_partitioning",
+})
+
+# Pure view/layout primitives: XLA serves these as lazy views or fuses
+# them into consumers — they do not force an HBM round-trip of their
+# own.  Everything else (arithmetic, selects, concatenates, sorts,
+# gathers, reductions' inputs...) counts as materialized output.
+_VIEW_PRIMS = frozenset({
+    "broadcast_in_dim", "iota", "reshape", "squeeze", "expand_dims",
+    "slice", "rev", "copy", "stop_gradient", "convert_element_type",
+    "bitcast_convert_type",
+})
+
+# Primitives whose params carry a SCALAR combinator jaxpr (the
+# scatter/reduce update lambda) rather than a program body: the eqn
+# itself is counted, the lambda is not walked.
+_SCALAR_BODY_PRIMS = frozenset({
+    "reduce", "reduce_window", "select_and_scatter",
+    "select_and_scatter_add", "reduce_precision",
+})
+
+
+class PhaseCost(NamedTuple):
+    """Static cost census for one round phase (or a whole program)."""
+
+    gathers: int = 0        # gather-family equations
+    scatters: int = 0       # scatter-family equations
+    fetched: int = 0        # gather output + scatter update elements
+    interm_bytes: int = 0   # materialized [n, ., .]-output bytes
+    eqns: int = 0           # every equation (wrappers excluded)
+
+    def __add__(self, other: "PhaseCost") -> "PhaseCost":
+        return PhaseCost(*(a + b for a, b in zip(self, other)))
+
+    @property
+    def gather_scatter(self) -> int:
+        return self.gathers + self.scatters
+
+
+class Census(NamedTuple):
+    phases: dict         # phase label -> PhaseCost ("-" = unphased)
+    total: PhaseCost
+    n: int               # the node-axis width the byte metric keyed on
+
+    def rows(self) -> list:
+        """JSON-ready per-phase rows, heaviest interm_bytes first,
+        with a trailing 'total' row."""
+        out = []
+        order = sorted(self.phases,
+                       key=lambda p: -self.phases[p].interm_bytes)
+        for ph in order:
+            c = self.phases[ph]
+            out.append({"phase": ph, **_row(c)})
+        out.append({"phase": "total", **_row(self.total)})
+        return out
+
+
+def _row(c: PhaseCost) -> dict:
+    return {
+        "gather_eqns": c.gathers, "scatter_eqns": c.scatters,
+        "gather_scatter_eqns": c.gather_scatter,
+        "fetched_scalars": c.fetched,
+        "interm_mib": round(c.interm_bytes / 2**20, 2),
+        "eqns": c.eqns,
+    }
+
+
+def _nbytes(aval) -> int:
+    b = aval.dtype.itemsize
+    for d in aval.shape:
+        b *= d
+    return b
+
+
+def _phase_of(eqn, inherited: str) -> str:
+    """The eqn's round.* named_scope segment, else the enclosing one
+    (sub-jaxpr equations do not re-enter the tracing-time scope stack,
+    so cond/scan bodies inherit the phase of the call site)."""
+    scope = scope_of(eqn)
+    if scope:
+        for seg in scope.split("/"):
+            if seg.startswith("round."):
+                return seg
+    return inherited
+
+
+def census(closed_jaxpr, n: int) -> Census:
+    """Walk one traced program into a per-phase :class:`PhaseCost`.
+
+    ``n`` keys the byte metric: only outputs whose LEADING axis is the
+    node axis (shape[0] == n) with rank >= 2 count — the [n, slots, .]/
+    [n, cap, .] temporaries of the cost model; [n]-vectors and
+    node-free tensors are noise at every scale that matters."""
+    phases: dict[str, PhaseCost] = {}
+
+    def bump(phase: str, **kw) -> None:
+        cur = phases.get(phase, PhaseCost())
+        phases[phase] = cur._replace(
+            **{k: getattr(cur, k) + v for k, v in kw.items()})
+
+    def walk(jaxpr, inherited: str) -> None:
+        if isinstance(jaxpr, jex_core.ClosedJaxpr):
+            jaxpr = jaxpr.jaxpr
+        for eqn in jaxpr.eqns:
+            phase = _phase_of(eqn, inherited)
+            name = eqn.primitive.name
+            if name not in _WRAPPER_PRIMS:
+                bump(phase, eqns=1)
+                if name == "gather":
+                    bump(phase, gathers=1,
+                         fetched=max(_nelems(eqn.outvars[0].aval), 1))
+                elif name.startswith("scatter"):
+                    upd = eqn.invars[2].aval if len(eqn.invars) >= 3 \
+                        else eqn.outvars[0].aval
+                    bump(phase, scatters=1,
+                         fetched=max(_nelems(upd), 1))
+                if name not in _VIEW_PRIMS:
+                    for ov in eqn.outvars:
+                        av = getattr(ov, "aval", None)
+                        shp = getattr(av, "shape", ())
+                        if len(shp) >= 2 and shp[0] == n:
+                            bump(phase, interm_bytes=_nbytes(av))
+            if name in _SCALAR_BODY_PRIMS or name.startswith("scatter"):
+                continue   # the sub-jaxpr is a scalar combinator lambda
+            for sub in sub_jaxprs(eqn.params):
+                walk(sub, phase)
+
+    walk(closed_jaxpr, "-")
+    total = PhaseCost()
+    for c in phases.values():
+        total = total + c
+    return Census(phases=phases, total=total, n=n)
+
+
+def _nelems(aval) -> int:
+    e = 1
+    for d in aval.shape:
+        e *= d
+    return e
+
+
+def census_program(prog: Program) -> Census:
+    """Census a lint :class:`Program` (node width from its config)."""
+    n = prog.cfg.n_nodes if prog.cfg is not None else -1
+    return census(prog.closed_jaxpr, n)
+
+
+# ---------------------------------------------------------------------------
+# The 32k-config reference program (the bench round)
+# ---------------------------------------------------------------------------
+
+def bench_round_program(n: int = 32_768, *,
+                        width_operand: bool = False) -> Program:
+    """Trace the PLAIN bench-config round (hyparview+plumtree, planes
+    off — bench.py's make_cfg capacity knobs) at ``n`` nodes,
+    abstractly: this is the program BENCH_NOTES' cost model prices and
+    the round-11 before/after numbers quote.  No device, no compile.
+
+    ``width_operand=True`` adds the bootstrap ladder's active-prefix
+    masking that bench.py actually runs with (``--cost --width-op``;
+    bench.py's cost card uses it) — the default stays the plain round
+    the pinned acceptance baseline was measured on."""
+    import jax
+
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, HyParViewConfig, \
+        PlumtreeConfig
+    from partisan_tpu.lint.core import trace_program
+    from partisan_tpu.models.plumtree import Plumtree
+
+    cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                 timer_stagger=False, width_operand=width_operand,
+                 hyparview=HyParViewConfig(isolation_window_ms=25_000),
+                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    cl = Cluster(cfg, model=Plumtree())
+    state = jax.eval_shape(cl._build_init)
+    name = f"round/bench-{n}" + ("+width" if width_operand else "")
+    return trace_program(name, cl._round, state, cfg)

@@ -1,0 +1,46 @@
+"""Pinned round-cost budgets: the op-count ratchet.
+
+One entry per audited matrix program the repo treats as a hot path
+(matrix.py names).  The ``round-cost-budget`` rule (rules.py) censuses
+each with the round-cost meter (cost.py) and fails tier-1 when a value
+regresses past its pin — the same loud-failure discipline as the
+interleave budget — or when the program got CHEAPER than the slack band
+below the pin (a stale budget: an improvement landed unpinned, so the
+next regression up to the old pin would land silently).
+
+Re-pin protocol (mirrors waivers.py): when a finding fires, reproduce
+with ``python tools/profile_phases.py --cost --budgets``, decide whether
+the delta is intended, and update the numbers here IN THE SAME CHANGE
+with a justification in the commit.  Budgets are measured at the matrix
+configs' n=32 — gather/scatter and equation counts are n-independent,
+and intermediate bytes scale ~linearly in n, so a 32-node pin gates the
+32k round's shape too (BENCH_NOTES round-7 records the 32k absolutes).
+
+History: pinned at PR 11's gather-coalesced round — 59 gather/scatter
+eqns in the plain 32k round vs 102 at PR 10 (-42%), 1716.5 MiB vs
+2472.8 MiB materialized [n, ., .] intermediates (-31%).
+"""
+
+from __future__ import annotations
+
+# Below these fractions of the pin, a budget is STALE (improvement
+# landed unpinned).  gather/scatter counts are pinned exactly.
+STALE_EQN_FRACTION = 0.97
+STALE_BYTE_FRACTION = 0.90
+
+BUDGETS: dict = {
+    # The plain bench round (hyparview+plumtree, planes off) — the hot
+    # path every BENCH_r0x prices.
+    "round/planes-off": {
+        "gather_scatter": 56,
+        "interm_kib": 1884.0,
+        "eqns": 3355,
+    },
+    # Every observability plane + the width operand — the bench/soak
+    # shape with full accounting on.
+    "round/all-planes+width": {
+        "gather_scatter": 111,
+        "interm_kib": 2322.0,
+        "eqns": 4261,
+    },
+}

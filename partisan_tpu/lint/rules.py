@@ -328,6 +328,65 @@ def sharding_spec_completeness() -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# round-cost-budget (the op-count ratchet — partisan_tpu/lint/cost.py)
+# ---------------------------------------------------------------------------
+
+def round_cost_budget(prog: Program) -> list[Finding]:
+    """Census the program with the round-cost meter and hold it to the
+    pinned budget (cost_budgets.BUDGETS, keyed by matrix program name).
+    Over budget = an op-count/intermediate-bytes REGRESSION; the
+    gather/scatter count is pinned exactly and byte/eqn budgets carry a
+    small slack band below which the budget is STALE (an improvement
+    landed unpinned — re-pin it, the same no-rot discipline as the
+    waiver baseline).  Programs without a budget entry are not judged;
+    tests/test_cost.py pins that every budget entry names a matrix
+    program, so the baseline cannot silently detach."""
+    from partisan_tpu.lint import cost as cost_mod
+    from partisan_tpu.lint import cost_budgets
+
+    budget = cost_budgets.BUDGETS.get(prog.name)
+    if budget is None:
+        return []
+    c = cost_mod.census_program(prog).total
+    out = []
+
+    def emit(metric: str, message: str) -> None:
+        out.append(Finding(
+            rule="", file="partisan_tpu/lint/cost_budgets.py",
+            func="BUDGETS", detail=f"{prog.name}:{metric}", line=0,
+            message=message))
+
+    gs, pin = c.gather_scatter, budget["gather_scatter"]
+    if gs > pin:
+        emit("over:gather_scatter",
+             f"{gs} gather/scatter eqns, budget {pin} — an op-count "
+             f"regression (each is a dispatched op on the relay "
+             f"backend); shrink it or re-pin with justification")
+    elif gs < pin:
+        emit("stale:gather_scatter",
+             f"{gs} gather/scatter eqns, budget {pin} — improvement "
+             f"unpinned; re-pin cost_budgets.BUDGETS")
+    kib, kpin = c.interm_bytes / 1024.0, budget["interm_kib"]
+    if kib > kpin:
+        emit("over:interm_kib",
+             f"{kib:.1f} KiB materialized [n,.,.] intermediates, "
+             f"budget {kpin} KiB")
+    elif kib < kpin * cost_budgets.STALE_BYTE_FRACTION:
+        emit("stale:interm_kib",
+             f"{kib:.1f} KiB vs budget {kpin} KiB — improvement "
+             f"unpinned; re-pin cost_budgets.BUDGETS")
+    eq, epin = c.eqns, budget["eqns"]
+    if eq > epin:
+        emit("over:eqns",
+             f"{eq} equations, budget {epin}")
+    elif eq < epin * cost_budgets.STALE_EQN_FRACTION:
+        emit("stale:eqns",
+             f"{eq} equations vs budget {epin} — improvement unpinned; "
+             f"re-pin cost_budgets.BUDGETS")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registries
 # ---------------------------------------------------------------------------
 
@@ -337,6 +396,7 @@ PROGRAM_RULES = {
     "zero-cost-when-off": zero_cost_when_off,
     "narrow-dtype-overflow": narrow_dtype_overflow,
     "scatter-overlap": scatter_overlap,
+    "round-cost-budget": round_cost_budget,
 }
 
 PACKAGE_RULES = {

@@ -228,10 +228,15 @@ class HyParView:
         unreach = jnp.any(~reachable)
 
         def prune(_):
-            return (jax.vmap(views.keep_only, in_axes=(0, None))(
-                        state.active, reachable),
-                    jax.vmap(views.keep_only, in_axes=(0, None))(
-                        state.passive, reachable))
+            # ONE packed gather over the concatenated views instead of a
+            # keep_only gather per view (reachable[id] is priced per
+            # fetched scalar either way, but each gather is its own
+            # dispatched op — the round-cost meter's coalescing rule).
+            both = jnp.concatenate([state.active, state.passive], axis=1)
+            ok = (both >= 0) & reachable[jnp.maximum(both, 0)]
+            cleaned = jnp.where(ok, both, -1)
+            A_ = state.active.shape[1]
+            return cleaned[:, :A_], cleaned[:, A_:]
 
         active, passive_in = jax.lax.cond(
             unreach, prune, lambda _: (state.active, state.passive), 0)
@@ -380,12 +385,17 @@ class HyParView:
             cad_l = cad_l | jnp.any(x_timer)
         cad_busy = comm.allsum(cad_l.astype(jnp.int32)) > 0
 
-        E_BUSY = cap + 4 * A + 2 + (cap if hv.xbot else 0)
-        E_CAD = 2 + (1 if hv.xbot else 0)
+        # Per-block emission widths: step hands back a TUPLE of blocks
+        # (plane_ops.blocks_of) so round_body concatenates the emission
+        # stack exactly once — the busy/cad bodies and their quiet
+        # twins must agree on this structure for the lax.cond.
+        BUSY_SHAPES = [cap, A, A, A, A, 1, 1] + ([cap] if hv.xbot else [])
+        CAD_SHAPES = [1, 1] + ([1] if hv.xbot else [])
 
         def quiet_body(_):
             return (active0, passive0,
-                    msg_ops.zero_stack(cfg, (n_local, E_BUSY)))
+                    tuple(msg_ops.zero_stack(cfg, (n_local, k))
+                          for k in BUSY_SHAPES))
 
         def busy_body(_):
             in_active0 = slot_in(active0, src)                 # [n, cap]
@@ -547,10 +557,19 @@ class HyParView:
             # [n, cap, passive_max].
             sh_slot = jnp.argmax(sh_int, axis=1)               # first hit
             sh_any = jnp.any(sh_int, axis=1)
+            shr_slot = jnp.argmax(is_shr, axis=1)
+            shr_any = jnp.any(is_shr, axis=1)
             origin1 = jnp.take_along_axis(origin, sh_slot[:, None],
                                           axis=1)[:, 0]
-            ids1 = plane_ops.stack_words(plane_ops.take_along(
-                sh_ids, sh_slot[:, None], axis=1))[:, 0]       # [n, S]
+            # ONE dtype-grouped take serves BOTH sample reads — the
+            # integrated shuffle's ids here and the shuffle-reply's ids
+            # in the passive merge below (previously 2 x S per-plane
+            # gathers, the manager's largest gather-eqn block).
+            both_ids = plane_ops.stack_words(plane_ops.take_along(
+                sh_ids, jnp.stack([sh_slot, shr_slot], axis=1),
+                axis=1))                                       # [n, 2, S]
+            ids1 = both_ids[:, 0]                              # [n, S]
+            shr_ids1 = both_ids[:, 1]
             mine1 = row_ranked(passive0, _TAG_MINE, SAMPLE)    # [n, S]
             shreply_msgs = msg_ops.build(
                 cfg, T.MsgKind.HPV_SHUFFLE_REPLY, gids,
@@ -775,10 +794,8 @@ class HyParView:
                              >> jnp.uint32(1)).astype(jnp.int32) | 1,
                             0)
             p_slotborne, _ = compact(pw0, psc, PSEL)           # [n, PSEL]
-            shr_slot = jnp.argmax(is_shr, axis=1)
-            shr_any = jnp.any(is_shr, axis=1)
-            shr_ids1 = plane_ops.stack_words(plane_ops.take_along(
-                sh_ids, shr_slot[:, None], axis=1))[:, 0]       # [n, S]
+            # shr_slot/shr_any/shr_ids1 rode the packed shuffle take
+            # above (one grouped gather for both sample reads)
             pcands = jnp.concatenate([
                 p_slotborne,
                 jnp.where(sh_any[:, None], ids1, -1),
@@ -809,8 +826,7 @@ class HyParView:
                       shreply_msgs[:, None, :]]
             if hv.xbot:
                 blocks += [x_disc]
-            return new_active2, new_passive2, plane_ops.concat(blocks,
-                                                               axis=1)
+            return new_active2, new_passive2, tuple(blocks)
 
         new_active, new_passive, emitted_hv = jax.lax.cond(
             busy, busy_body, quiet_body, 0)
@@ -881,10 +897,11 @@ class HyParView:
                 cblocks.append(msg_ops.build(
                     cfg, T.MsgKind.HPV_XBOT_OPT, gids,
                     jnp.where(x_fire, cand, -1), payload=(z,))[:, None, :])
-            return plane_ops.concat(cblocks, axis=1)
+            return tuple(cblocks)
 
         def cad_quiet(_):
-            return msg_ops.zero_stack(cfg, (n_local, E_CAD))
+            return tuple(msg_ops.zero_stack(cfg, (n_local, k))
+                         for k in CAD_SHAPES)
 
         emitted_cad = jax.lax.cond(cad_busy, cad_body, cad_quiet, 0)
 
@@ -1002,21 +1019,22 @@ class HyParView:
                 cfg, comm, state.dist, ctx,
                 jnp.concatenate([active0, psamp], axis=1))
 
-        blocks = [emitted_hv, emitted_cad, join_msgs[:, None, :]]
+        blocks = [*emitted_hv, *emitted_cad, join_msgs[:, None, :]]
         if cfg.distance.enabled:
             blocks += [dist_emit]
-        emitted = plane_ops.concat(blocks, axis=1)
 
         # Crash-stopped and left nodes are frozen and silent (a left node
         # is inert until a scripted rejoin — the reference's leaver shuts
         # its partisan instance down, pluggable analogue :1790-1805).
         # A node IS still live during its leave round (it must emit the
         # disconnect fan-out), and a rejoin (join_target set) clears left.
+        # The mask touches only each block's kind plane; the blocks ride
+        # to round_body unconcatenated (plane_ops.blocks_of).
         live = ctx.alive & (~state.left | (state.join_target >= 0))
         new_active = jnp.where(live[:, None], new_active, state.active)
         new_passive = jnp.where(live[:, None], new_passive, state.passive)
-        emitted = emitted.at[..., T.W_KIND].set(
-            jnp.where(live[:, None], emitted[..., T.W_KIND], 0))
+        blocks = [b.at[..., T.W_KIND].set(
+            jnp.where(live[:, None], b[..., T.W_KIND], 0)) for b in blocks]
 
         # A scripted JOIN retries every round until an explicit accept
         # (HPV_NEIGHBOR_ACCEPTED) arrives — the walk-end adoption or the
@@ -1050,7 +1068,7 @@ class HyParView:
                 new_dist, state.dist)
                 if cfg.distance.enabled else state.dist),
         )
-        return new_state, emitted
+        return new_state, tuple(blocks)
 
     # ---- views -------------------------------------------------------
     def neighbors(self, cfg: Config, state: HyParViewState,

@@ -126,6 +126,11 @@ class Plumtree:
             raise ValueError(
                 f"plumtree with a {PW}-word handler payload needs "
                 f"msg_words >= {need}, got {cfg.msg_words}")
+        if cfg.inbox_cap > 1023:
+            # the packed per-(tree, link) flag fold keeps one 10-bit
+            # count field per condition (see step)
+            raise ValueError(
+                f"plumtree needs inbox_cap <= 1023, got {cfg.inbox_cap}")
         return PlumtreeState(
             data=jnp.broadcast_to(self.handler.bottom(),
                                   (n, B, PW)).astype(jnp.int32),
@@ -204,12 +209,17 @@ class Plumtree:
                        | jnp.any(npu)
                        | jnp.any(lazyp & (nbrs >= 0)[:, None, :]))
         pt_go = comm.allsum(pt_go_local.astype(jnp.int32)) > 0
-        E_PT = cap + S * K + L
+        # Emission blocks (replies / eager pushes / i_haves) stay a
+        # TUPLE through the cond and the step return — round_body
+        # concatenates the round's emission stack exactly once
+        # (plane_ops.blocks_of).
+        PT_SHAPES = (cap, S * K, L)
 
         def pt_skip(_):
             return (data, rr, pruned, lazyp, npu, psrc, state.epoch,
                     state.nonmono,
-                    msg_ops.zero_stack(cfg, (n_local, E_PT)))
+                    tuple(msg_ops.zero_stack(cfg, (n_local, k))
+                          for k in PT_SHAPES))
 
         def pt_body(_, data=data, rr=rr, pruned=pruned, lazyp=lazyp,
                     npu=npu, psrc=psrc, is_g=is_g, is_ih=is_ih,
@@ -262,22 +272,12 @@ class Plumtree:
             ks_ok = hit.any(-1)
             ki = jnp.argmax(hit, -1)
 
-            oh_b = (b[:, :, None] == jnp.arange(B)[None, None, :])  # [n, cap, B]
-            oh_k = ((ki[:, :, None] == jnp.arange(K)[None, None, :])
-                    & ks_ok[:, :, None])                            # [n, cap, K]
             # Monotone-recycle constraint check: an epoch-bumping gossip
             # whose payload does NOT dominate the receiver's store means
             # the recycled broadcast broke the lattice contract the
             # epoch-oblivious store depends on — count it (never silent).
             nonmono = state.nonmono + jnp.sum(
                 bump_g & ~hd.leq(data_b, pay), axis=1, dtype=jnp.int32)
-
-            def any_bk(cond):
-                """[n, cap] slot mask -> bool[n, B, K] any-hit, as an MXU
-                matmul over the one-hot encodings."""
-                lhs = (oh_b & cond[:, :, None]).astype(jnp.bfloat16)
-                rhs = oh_k.astype(jnp.bfloat16)
-                return jnp.einsum("ncb,nck->nbk", lhs, rhs) > 0.5
 
             # ---- gossip merge (handler join fold, Mod:merge :571-577) --
             stale_g = is_g & hd.leq(pay, data_b)                    # is_stale
@@ -292,6 +292,8 @@ class Plumtree:
                              .astype(jnp.int32).at[
                     r2e, jnp.where(is_g, b, B)].max(pay, mode="drop"))
             else:
+                oh_b = (b[:, :, None]
+                        == jnp.arange(B)[None, None, :])            # [n, cap, B]
                 gmask = (oh_b & is_g[:, :, None])                   # [n, cap, B]
                 expanded = jnp.where(gmask[..., None], pay[:, :, None, :],
                                      hd.bottom())                   # [n,cap,B,PW]
@@ -354,10 +356,28 @@ class Plumtree:
 
             # ---- per-(tree, link) flags -------------------------------
             missing_ih = is_ih & ~hd.leq(pay, data_b)
-            prune_req = any_bk(is_pr | stale_g)
-            unprune = any_bk(is_gr | missing_ih | (is_g & ~stale_g))
+            # Three any-hit folds over (tree, link slot) in ONE packed
+            # scatter-add: each condition keeps its own 10-bit count
+            # field (cap <= 1023, validated in init), scattered at
+            # (b, ki) with non-neighbor senders dropped.  Integer sums
+            # are exact, so the >0 tests reproduce the previous one-hot
+            # MXU folds' booleans bit for bit — minus the [n, cap, B] +
+            # [n, cap, K] bfloat16 one-hot materializations the
+            # round-cost meter priced as the model phase's largest
+            # block.  scatter-add is commutative: lint-clean overlap.
+            c_pr = is_pr | stale_g
+            c_un = is_gr | missing_ih | (is_g & ~stale_g)
+            c_ak = is_gr | is_ak
+            packed_c = (c_pr.astype(jnp.int32)
+                        + (c_un.astype(jnp.int32) << 10)
+                        + (c_ak.astype(jnp.int32) << 20))
+            acc = jnp.zeros((n_local, B, K), jnp.int32).at[
+                r2e, b, jnp.where(ks_ok, ki, K)].add(packed_c,
+                                                     mode="drop")
+            prune_req = (acc & 1023) > 0
+            unprune = ((acc >> 10) & 1023) > 0
             pruned = (pruned | prune_req) & ~unprune
-            lazyp = lazyp & ~any_bk(is_gr | is_ak)
+            lazyp = lazyp & ~(((acc >> 20) & 1023) > 0)
 
             # ---- per-slot replies (against the round-start store) -----
             present_b = hd.present(data_b)                          # [n, cap]
@@ -446,8 +466,7 @@ class Plumtree:
                          adv_pack[..., PW + 1]))
 
             return (data, rr, pruned, lazyp, npu, psrc, tgt_ep, nonmono,
-                    plane_ops.concat([replies, push_msgs, ihave_msgs],
-                                     axis=1))
+                    (replies, push_msgs, ihave_msgs))
 
         (data, rr, pruned, lazyp, npu, psrc, tgt_ep, nonmono,
          emitted) = jax.lax.cond(pt_go, pt_body, pt_skip, 0)
@@ -579,8 +598,10 @@ class Plumtree:
             return jnp.where(
                 dead.reshape((-1,) + (1,) * (new.ndim - 1)), old, new)
 
-        emitted = emitted.at[..., T.W_KIND].set(
-            jnp.where(dead[:, None], 0, emitted[..., T.W_KIND]))
+        emitted = tuple(
+            b.at[..., T.W_KIND].set(
+                jnp.where(dead[:, None], 0, b[..., T.W_KIND]))
+            for b in emitted)
         new_state = PlumtreeState(
             data=keep(data, state.data),
             rround=keep(rr, state.rround),

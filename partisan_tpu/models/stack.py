@@ -38,8 +38,8 @@ class Stack:
         for m, s in zip(self.models, state):
             s2, e = m.step(cfg, comm, s, ctx, nbrs)
             outs.append(s2)
-            emits.append(e)
-        return tuple(outs), plane_ops.concat(emits, axis=1)
+            emits += plane_ops.blocks_of(e)
+        return tuple(outs), tuple(emits)
 
     def coverage(self, state: tuple, alive: Array, slot: int = 0) -> Array:
         """Coverage of the FIRST sub-model that defines one (the

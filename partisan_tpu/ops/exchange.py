@@ -119,7 +119,12 @@ def route(emitted, n: int, cap: int, *, node_offset: int | Array = 0) -> Inbox:
     valid = cap_idx[None, :] < counts[:n, None]
     src_pos = jnp.clip(src_pos, 0, dst.shape[0] - 1)
     take = order[src_pos]                                  # flat msg index
-    data = plane_ops.where(valid, plane_ops.take_records(flat, take), 0)
+    # Invalid slots ride the gather as out-of-range sentinels and fill
+    # with zero records — one dtype-grouped fill-gather instead of W
+    # per-plane gathers plus a W-plane select (the round-cost meter's
+    # largest gather-equation block, partisan_tpu/lint/cost.py).
+    take = jnp.where(valid, take, dst.shape[0])
+    data = plane_ops.take_flat(flat, take, fill=True)
 
     delivered = jnp.minimum(counts[:n], cap)
     return Inbox(data=data, count=delivered, drops=counts[:n] - delivered)
@@ -141,11 +146,12 @@ def compact_emissions(emitted, cap: int):
     valid = emitted[:, :, W_KIND] != 0
     order = jnp.argsort(~valid, axis=1, stable=True)
     take = order[:, :cap]
-    rows = jnp.arange(n)[:, None]
     keep = jnp.arange(cap, dtype=jnp.int32)[None, :] < \
         valid.sum(axis=1, dtype=jnp.int32)[:, None]
-    return plane_ops.where(keep, plane_ops.take_records(emitted, (rows, take)),
-                           0)
+    # Dead slots become out-of-range sentinels: the dtype-grouped
+    # fill-gather zeroes them in the same op (see route()).
+    return plane_ops.take_rows(emitted, jnp.where(keep, take, E),
+                               fill=True)
 
 
 def merge_inboxes(a: Inbox, b: Inbox) -> Inbox:
@@ -162,12 +168,11 @@ def merge_inboxes(a: Inbox, b: Inbox) -> Inbox:
     valid = both[:, :, W_KIND] != 0
     order = jnp.argsort(~valid, axis=1, stable=True)       # [n, m]
     take = order[:, :cap]
-    rows = jnp.arange(n)[:, None]
+    m = both.shape[1]
     vcount = valid.sum(axis=1, dtype=jnp.int32)
     keep = jnp.arange(cap, dtype=jnp.int32)[None, :] < \
         jnp.minimum(vcount, cap)[:, None]
-    data = plane_ops.where(keep, plane_ops.take_records(both, (rows, take)),
-                           0)
+    data = plane_ops.take_rows(both, jnp.where(keep, take, m), fill=True)
     total = a.count + b.count
     delivered = jnp.minimum(total, cap)
     return Inbox(

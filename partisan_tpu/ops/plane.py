@@ -48,7 +48,7 @@ __all__ = [
     "Planes", "is_planes", "concat", "where", "zeros_like",
     "zero_planes", "interleave", "deinterleave",
     "append_words", "append_tail", "stack_words", "stack_records",
-    "take_records", "take_along",
+    "take_records", "take_along", "take_rows", "take_flat",
 ]
 
 
@@ -195,9 +195,14 @@ class _PlanesAtRef:
         if isinstance(wsel, int):
             w = self.p.ws[wsel]
             v = jnp.asarray(val).astype(w.dtype)
-            if plane_idx:
+            if plane_idx and not all(
+                    isinstance(s, slice) and s == slice(None)
+                    for s in plane_idx):
                 new = w.at[plane_idx].set(v, **kw)
             else:
+                # a full-slice word write replaces the plane outright —
+                # ``p.at[..., W_KIND].set(mask)`` is the pipeline's
+                # bread-and-butter and must not trace a scatter per call
                 new = jnp.broadcast_to(v, jnp.shape(w))
             ws = list(self.p.ws)
             ws[wsel] = new
@@ -236,6 +241,17 @@ def is_planes(x) -> bool:
 # ---------------------------------------------------------------------------
 # Layout-agnostic helpers (Array | Planes)
 # ---------------------------------------------------------------------------
+
+def blocks_of(x) -> list:
+    """Emission blocks of a manager/model ``step`` result.  Hot-path
+    managers/models return a TUPLE of record blocks instead of one
+    pre-concatenated stack, so the round assembles the emission stack
+    with exactly ONE concatenate (the nested assembly used to copy
+    every record byte twice — ~13% of the plain round's materialized
+    bytes in the round-cost meter).  A single stack (legacy managers,
+    third-party models) passes through as a one-block list."""
+    return list(x) if isinstance(x, (tuple, list)) else [x]
+
 
 def concat(blocks: Sequence, axis: int = 1):
     """Concatenate emission blocks on a record axis (NOT the word
@@ -333,8 +349,12 @@ def stack_records(blocks: Sequence, axis: int = 0):
 def take_along(p, idx: Array, axis: int):
     """Per-plane ``take_along_axis`` over a RECORD axis: ``idx`` has the
     record shape (no trailing word-axis ``[..., None]`` — each plane
-    already lacks the word axis).  Arrays get the legacy broadcast."""
+    already lacks the word axis).  Arrays get the legacy broadcast.
+    Planes on the common ``axis=1`` of a [n, E] record stack take the
+    dtype-grouped single-gather path (:func:`take_rows`)."""
     if is_planes(p):
+        if axis == 1 and jnp.ndim(p.ws[0]) == 2:
+            return take_rows(p, idx)
         return Planes(tuple(
             jnp.take_along_axis(w, idx, axis=axis) for w in p.ws))
     return jnp.take_along_axis(p, idx[..., None], axis=axis)
@@ -353,11 +373,118 @@ def zero_planes(shape: tuple, dtypes: Sequence) -> Planes:
 
 
 def take_records(p, plane_idx):
-    """Gather whole records: ``p[plane_idx]`` per plane (compaction /
-    route-sort gathers)."""
+    """Gather whole records: ``p[plane_idx]`` per plane (generic fancy
+    indexing — the hot compaction/route paths use the dtype-grouped
+    :func:`take_rows`/:func:`take_flat` instead: W per-plane gathers
+    each re-trace index normalization and dispatch as W ops, the
+    single largest gather-eqn block the round-cost meter found)."""
     if is_planes(p):
         return Planes(tuple(w[plane_idx] for w in p.ws))
     return p[plane_idx]
+
+
+# ---------------------------------------------------------------------------
+# Dtype-grouped record gathers (the gather-coalescing surgery)
+# ---------------------------------------------------------------------------
+#
+# A Planes record gather used to cost one gather EQUATION per word plane
+# (W of them), each re-tracing its own index math.  On the relay-attached
+# backend every equation is a dispatched op priced per fetched scalar
+# (BENCH_NOTES corrected cost model), so the wire stage's two record
+# gathers (compaction, route) alone were 32 of the plain 32k round's 102
+# gather/scatter equations.  Planes sharing a storage dtype now stack on
+# a NEW LEADING axis (never the minor/wire axis — the one-interleave
+# budget keys on record-width minor-axis stacks and stays untouched) and
+# ride ONE ``lax.gather`` per dtype group; the per-plane results are
+# cheap leading-axis slices of the group result.  Out-of-range indices
+# (>= the record count) fill with 0 under ``fill=True`` — the
+# ``where(keep, taken, 0)`` select the callers used to trace per plane
+# is folded into the gather itself.
+
+def _group_gather(ws, pos, fill: bool):
+    """One gather per dtype group of flat ``[m]`` planes.
+
+    ``pos``: int32 index array (any shape) into the flat record axis;
+    entries >= m (only legal with ``fill=True``) produce 0.  Returns the
+    gathered planes (shape ``pos.shape``) in input order."""
+    from jax import lax
+
+    mode = (lax.GatherScatterMode.FILL_OR_DROP if fill
+            else lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+    groups: dict = {}
+    for i, w in enumerate(ws):
+        groups.setdefault(jnp.result_type(w), []).append(i)
+    out = [None] * len(ws)
+    idx = pos[..., None]
+    for idxs in groups.values():
+        if len(idxs) == 1:
+            w = ws[idxs[0]]
+            dn = lax.GatherDimensionNumbers(
+                offset_dims=(), collapsed_slice_dims=(0,),
+                start_index_map=(0,))
+            out[idxs[0]] = lax.gather(w, idx, dn, (1,), mode=mode,
+                                      fill_value=0)
+        else:
+            g = len(idxs)
+            stacked = jnp.stack([ws[i] for i in idxs], axis=0)  # [g, m]
+            dn = lax.GatherDimensionNumbers(
+                offset_dims=(0,), collapsed_slice_dims=(1,),
+                start_index_map=(1,))
+            got = lax.gather(stacked, idx, dn, (g, 1), mode=mode,
+                             fill_value=0)                # [g, *pos]
+            for j, i in enumerate(idxs):
+                out[i] = got[j]
+    return out
+
+
+def take_flat(p, pos, *, fill: bool = False):
+    """Gather whole records out of a FLAT ``[m]``-record stack by
+    ``pos`` (any index shape) — the route sort's fetch.  ``fill=True``
+    turns out-of-range positions into all-zero records (one fused
+    fill-gather instead of a per-plane select)."""
+    if is_planes(p):
+        return Planes(tuple(_group_gather(p.ws, pos, fill)))
+    if fill:
+        return p.at[pos].get(mode="fill", fill_value=0)
+    return p[pos]
+
+
+def take_rows(p, idx, *, fill: bool = False):
+    """Per-row record take: ``out[i, j] = p[i, idx[i, j]]`` over a
+    ``[n, E]``-record stack (compaction / queue-admission gathers).
+    ``idx`` is int32[n, k]; entries >= E (with ``fill=True``) yield
+    all-zero records.  One gather per dtype group via a flat-composed
+    index (rows are a multiply-add away, not a per-plane concatenated
+    index pair)."""
+    if is_planes(p):
+        n, E = jnp.shape(p.ws[0])
+        rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+        # OOB rides the compose: the sentinel must leave the WHOLE flat
+        # axis (idx may already be E), and a NEGATIVE index is out of
+        # bounds too — composed into a flat position it would silently
+        # read a neighboring row's record (the Array path's fill mode
+        # treats it as OOB, and layout parity is the module contract).
+        if fill:
+            # one negative-turn wrap, THEN out-of-range fills — exactly
+            # jnp.take_along_axis(mode="fill")'s order, so the two
+            # layouts agree record-for-record on any index
+            w_idx = jnp.where(idx < 0, idx + E, idx)
+            pos = jnp.where((w_idx >= E) | (w_idx < 0), n * E,
+                            w_idx + rows * E)
+        else:
+            # wrap one negative turn (take_along_axis's negative-index
+            # semantics), then clamp WITHIN the row: an unguarded
+            # row-composed index would read a neighboring row's record.
+            # (A truly out-of-range index clamps here where jnp's
+            # default fills INT_MAX — callers promise in-range.)
+            pos = jnp.clip(jnp.where(idx < 0, idx + E, idx),
+                           0, E - 1) + rows * E
+        flat = Planes(tuple(w.reshape(-1) for w in p.ws))
+        return take_flat(flat, pos, fill=fill)
+    if fill:
+        return jnp.take_along_axis(p, idx[..., None], axis=1,
+                                   mode="fill", fill_value=0)
+    return jnp.take_along_axis(p, idx[..., None], axis=1)
 
 
 def interleave(p):

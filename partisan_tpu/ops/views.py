@@ -218,17 +218,29 @@ def bucket_merge(view: Array, cands: Array, ranks: Array, self_id: Array,
     node's own active view).
     """
     p_width = view.shape[0]
+    c_width = cands.shape[0]
     ok = (cands >= 0) & (cands != self_id)
     if exclude is not None:
         ok &= ~jnp.any(cands[:, None] == exclude[None, :], axis=1)
     slot = bucket_slot(cands, p_width)
-    hit = ok[None, :] & (slot[None, :] == jnp.arange(p_width)[:, None])
-    # `| 1` keeps a hitting candidate's rank nonzero — a rank of exactly
-    # 0 would lose the argmax to column 0 and insert the wrong id.
-    rank = jnp.where(hit, ranks[None, :] | jnp.uint32(1), jnp.uint32(0))
-    best = jnp.argmax(rank, axis=1)
-    has = jnp.any(hit, axis=1)
-    return jnp.where(has, cands[best], view)
+    # Per-slot winner WITHOUT the [P, C] one-hot (vmapped it was an
+    # [n, P, C] uint32 materialization — the round-cost meter priced it
+    # the single largest intermediate of the manager phase): scatter-max
+    # the `| 1`-lifted ranks into the P slots, then scatter-min the
+    # candidate index among rank-winners, exactly reproducing the old
+    # argmax's first-index tie-break.  Both scatters are commutative
+    # (lint scatter-overlap clean); `| 1` keeps a hitting candidate's
+    # rank nonzero so `best > 0` still means "some candidate hit".
+    rank = jnp.where(ok, ranks | jnp.uint32(1), jnp.uint32(0))
+    tgt = jnp.where(ok, slot, p_width)
+    best = jnp.zeros((p_width,), jnp.uint32).at[tgt].max(rank,
+                                                        mode="drop")
+    is_best = ok & (rank == best[jnp.minimum(slot, p_width - 1)])
+    idx = jnp.arange(c_width, dtype=jnp.int32)
+    win = jnp.full((p_width,), c_width, jnp.int32).at[
+        jnp.where(is_best, slot, p_width)].min(idx, mode="drop")
+    has = best > 0
+    return jnp.where(has, cands[jnp.minimum(win, c_width - 1)], view)
 
 
 # (The former sequential merge_sample — and its env-gated batched

@@ -21,18 +21,19 @@ from partisan_tpu.config import Config
 
 FULL = bool(int(os.environ.get("PARTISAN_TEST_FULL", "0") or "0"))
 # widest sharded-parity width (tests/test_sharded.py wide-convergence
-# parity: 4096 = 512 nodes/shard on mesh8; 1024 = 128/shard still
-# exercises the a2a quota + multi-wave bootstrap cross-shard)
-WIDE_N = 4096 if FULL else 1024
+# parity: 4096 = 512 nodes/shard on mesh8; 768 = 96/shard still
+# exercises the a2a quota + multi-wave bootstrap cross-shard — the
+# parity assert is bit-exact at every width)
+WIDE_N = 4096 if FULL else 768
 # larger-scale SCAMP conformance band (tests/test_scenarios.py): the
 # band is asserted at EVERY scale; 256 is still 2x the smoke n
 SCAMP_BAND_N = 512 if FULL else 256
 # randomized-overlay trials per oracle gate (health BFS / provenance
 # trace-replay): the gates assert EXACT parity per overlay either way
-ORACLE_TRIALS = 40 if FULL else 20
+ORACLE_TRIALS = 40 if FULL else 16
 # mixed-fault soak width (tests/test_soak.py 500-round storm): the
 # storm schedule and every invariant are width-independent
-SOAK_N = 256 if FULL else 128
+SOAK_N = 256 if FULL else 96
 
 
 def hv_config(n, seed, **kw):

@@ -1,0 +1,205 @@
+"""The round-cost meter (partisan_tpu/lint/cost.py) and its budget
+gate: meter semantics on synthetic programs (known gather/scatter
+counts, phase attribution, byte accounting), the budget rule's
+over/stale firing directions, the pin that every budget entry names a
+real matrix program, and the PR 11 headline — the gather-coalesced
+round's census stays at or below the surgery's landing point.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from partisan_tpu import lint
+from partisan_tpu.lint import cost, cost_budgets
+from partisan_tpu.lint.rules import round_cost_budget
+from test_lint import _matrix   # session-shared matrix trace (tier-1
+#                                 runtime: tracing the 16 programs twice
+#                                 would cost ~60 s on this container)
+
+_CACHE: dict = {}
+
+
+def _bench32():
+    if "c" not in _CACHE:
+        _CACHE["c"] = cost.census_program(cost.bench_round_program(32))
+    return _CACHE["c"]
+
+
+# ---------------------------------------------------------------------------
+# meter semantics on synthetic programs
+# ---------------------------------------------------------------------------
+
+def test_census_counts_gathers_and_scatters():
+    n = 8
+
+    def f(x):
+        idx = jnp.zeros((n, 2), jnp.int32)
+        g = jnp.take_along_axis(x, idx, axis=1)          # 1 gather
+        s = x.at[jnp.arange(n), 0].max(g[:, 0])          # 1 scatter-max
+        return g, s
+
+    c = cost.census(jax.make_jaxpr(f)(jnp.zeros((n, 4), jnp.int32)), n)
+    assert c.total.gathers == 1
+    assert c.total.scatters == 1
+    # fetched scalars: gather output (n*2) + scatter updates (n)
+    assert c.total.fetched == n * 2 + n
+
+
+def test_census_phase_attribution_inherits_into_cond():
+    """Equations inside a lax.cond branch carry no named_scope of their
+    own — they must inherit the phase of the call site (the walker's
+    phase inheritance), and an inner scope overrides it."""
+    n = 4
+
+    def f(x):
+        with jax.named_scope("round.manager"):
+            y = jax.lax.cond(x[0, 0] > 0,
+                             lambda v: v * 2 + 1,
+                             lambda v: v - 1, x)
+        with jax.named_scope("round.model"):
+            z = y + 3
+        return z
+
+    c = cost.census(jax.make_jaxpr(f)(jnp.zeros((n, 3), jnp.int32)), n)
+    assert "round.manager" in c.phases
+    assert "round.model" in c.phases
+    # the cond's branch arithmetic landed under round.manager
+    assert c.phases["round.manager"].eqns >= 2
+
+
+def test_census_byte_metric_keys_on_node_axis():
+    """Only [n, ., .]-shaped non-view outputs count: an [n, k] add
+    counts its bytes, a broadcast/reshape of the same shape does not,
+    and an [m, k] tensor (no node axis) is ignored."""
+    n, k = 16, 5
+
+    def f(x):
+        a = x + 1                            # [n, k] int32 — counted
+        b = jnp.reshape(a, (k, n))           # view — not counted
+        c = jnp.zeros((7, 3), jnp.int32) + 1   # no node axis — ignored
+        return a, b, c
+
+    cen = cost.census(jax.make_jaxpr(f)(jnp.zeros((n, k), jnp.int32)), n)
+    assert cen.total.interm_bytes == n * k * 4
+
+
+def test_census_scan_body_counted_once():
+    n = 4
+
+    def f(x):
+        def body(c, _):
+            return c.at[jnp.arange(n), 0].max(c[:, 0]), None
+        return jax.lax.scan(body, x, None, length=10)[0]
+
+    c = cost.census(jax.make_jaxpr(f)(jnp.zeros((n, 2), jnp.int32)), n)
+    assert c.total.scatters == 1   # static census: 10 iterations, 1 eqn
+
+
+def test_rows_orders_heaviest_first_with_total_tail():
+    rows = _bench32().rows()
+    assert rows[-1]["phase"] == "total"
+    weights = [r["interm_mib"] for r in rows[:-1]]
+    assert weights == sorted(weights, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# the budget gate
+# ---------------------------------------------------------------------------
+
+def _prog(name="round/planes-off"):
+    return next(p for p in _matrix() if p.name == name)
+
+
+def test_budget_entries_name_matrix_programs():
+    """A budget keyed to a renamed/removed matrix program would never
+    fire again — the baseline must not silently detach."""
+    names = {p.name for p in _matrix()}
+    for key in cost_budgets.BUDGETS:
+        assert key in names, f"budget {key!r} names no matrix program"
+
+
+def test_pinned_budgets_are_clean():
+    """The committed pins match the committed code exactly (the same
+    acceptance the waiver baseline gets in test_lint)."""
+    finds = []
+    for name in cost_budgets.BUDGETS:
+        finds += round_cost_budget(_prog(name))
+    assert not finds, [f"{f.detail}: {f.message}" for f in finds]
+
+
+def test_budget_rule_fires_on_regression_and_stale():
+    prog = _prog()
+    c = cost.census_program(prog).total
+    pin = dict(cost_budgets.BUDGETS[prog.name])
+    try:
+        # regression direction: pin BELOW the actual census
+        cost_budgets.BUDGETS[prog.name] = {
+            "gather_scatter": c.gather_scatter - 1,
+            "interm_kib": round(c.interm_bytes / 1024.0 - 50, 1),
+            "eqns": c.eqns - 100,
+        }
+        over = round_cost_budget(prog)
+        assert {f.detail.split(":", 1)[1] for f in over} == {
+            "over:gather_scatter", "over:interm_kib", "over:eqns"}, over
+        # stale direction: pin far ABOVE the actual census
+        cost_budgets.BUDGETS[prog.name] = {
+            "gather_scatter": c.gather_scatter + 5,
+            "interm_kib": round(c.interm_bytes / 1024.0 * 2, 1),
+            "eqns": c.eqns * 2,
+        }
+        stale = round_cost_budget(prog)
+        assert {f.detail.split(":", 1)[1] for f in stale} == {
+            "stale:gather_scatter", "stale:interm_kib", "stale:eqns"}, \
+            stale
+        # unbudgeted programs are not judged
+        assert round_cost_budget(prog._replace(name="no/such")) == []
+    finally:
+        cost_budgets.BUDGETS[prog.name] = pin
+
+
+def test_budget_rule_rides_the_lint_report():
+    """The rule is registered: an inflated budget fails a lint run over
+    the matrix program like any other finding (fingerprint-stable, so
+    it could even be waived — it never should be)."""
+    prog = _prog()
+    pin = dict(cost_budgets.BUDGETS[prog.name])
+    try:
+        cost_budgets.BUDGETS[prog.name] = dict(pin, gather_scatter=1)
+        rep = lint.run_programs([prog], rules=["round-cost-budget"],
+                                package_rules=[], waivers={})
+        assert rep.findings
+        fp = rep.findings[0].fingerprint
+        assert fp.startswith("round-cost-budget:")
+        assert "over:gather_scatter" in fp
+    finally:
+        cost_budgets.BUDGETS[prog.name] = pin
+
+
+# ---------------------------------------------------------------------------
+# the PR 11 headline: the coalesced round's census
+# ---------------------------------------------------------------------------
+
+def test_gather_coalescing_landing_point():
+    """The surgery's landing point, pinned as ceilings (the budgets pin
+    the matrix configs exactly; this pins the BENCH-config round the
+    acceptance criterion quotes): PR 10's plain round traced 102
+    gather/scatter eqns and ~2473 MiB of materialized [n, ., .]
+    intermediates at 32k — the coalesced round must stay >= 25% / >= 30%
+    below that.  Counts are n-independent; bytes scale linearly, so the
+    32-node trace stands in for 32k (2473 MiB * 32/32768 = 2.4 MiB)."""
+    c = _bench32().total
+    assert c.gather_scatter <= 76, \
+        f"{c.gather_scatter} gather/scatter eqns — the 25%-below-HEAD " \
+        f"acceptance ceiling is 76"
+    head_bytes_at_32 = 2472.8 * 2**20 * 32 / 32768
+    assert c.interm_bytes <= 0.70 * head_bytes_at_32, \
+        f"{c.interm_bytes / 2**20:.1f} MiB at n=32 — the 30%-below-HEAD " \
+        f"ceiling is {0.70 * head_bytes_at_32 / 2**20:.1f}"
+
+
+def test_wire_fast_phase_is_coalesced():
+    """The wire stage's record fetches ride dtype-grouped gathers: the
+    phase that traced 39 gather/scatter eqns at HEAD must stay under
+    16 (3 dtype groups x 2 fetch sites + index plumbing)."""
+    c = _bench32()
+    assert c.phases["round.wire_fast"].gather_scatter <= 16

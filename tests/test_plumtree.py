@@ -185,7 +185,11 @@ def test_higher_epoch_ihave_recruits_pruned_node():
     st2, emitted = model.step(cfg, comm, st, ctx, nbrs)
     assert int(st2.epoch[0, 0]) == 1            # adopted the advert's epoch
     assert not bool(st2.pruned[0, 0, :].any())  # flags reset for new tree
-    em = np.asarray(emitted[0])
+    # step returns emission BLOCKS (plane_ops.blocks_of contract)
+    from partisan_tpu.ops import plane as plane_ops
+
+    em = np.concatenate([np.asarray(b)[0]
+                         for b in plane_ops.blocks_of(emitted)], axis=0)
     grafts = em[(em[:, T.W_KIND] == T.MsgKind.PT_GRAFT)
                 & (em[:, T.W_DST] == 1)]
     assert len(grafts) >= 1                     # grafted back in, same round

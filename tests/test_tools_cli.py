@@ -36,6 +36,24 @@ def test_profile_phases_cli_smoke():
     assert bad.returncode != 0
 
 
+def test_profile_phases_cost_smoke():
+    """--cost: the static round-cost census runs deviceless, prints
+    per-phase JSON lines plus a summary, and --budgets judges the
+    pinned lint budgets (exit 1 on over/stale, 0 when clean — and the
+    committed budgets MUST be clean)."""
+    out = _run("profile_phases.py", "--cost", "--budgets", "256")
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    phases = [r for r in rows if r["kind"] == "cost_phase"]
+    assert {"round.manager", "round.model", "round.wire_fast"} <= \
+        {r["phase"] for r in phases}, phases
+    summary = next(r for r in rows if r["kind"] == "cost")
+    assert summary["budget_verdict"] == "CLEAN", rows
+    assert summary["gather_scatter_eqns"] > 0
+    assert summary["eqns"] > summary["gather_scatter_eqns"]
+
+
 def test_profile_phases_layout_ab_smoke():
     """--layout A/B (interleaved legacy vs plane-major): both layouts'
     phase series run and the machine-readable stderr lines carry one

@@ -239,12 +239,61 @@ def main(n: int, plane_major: bool = True, tag: str = "") -> None:
     timed("FULL round (active)", full, st_full)
 
 
-USAGE = """usage: profile_phases.py [--layout] [n] [only]
+def cost_census(n: int, budgets: bool = False,
+                width_op: bool = False) -> int:
+    """``--cost``: the STATIC round-cost census — trace the plain
+    bench-config round at ``n`` abstractly (no device, no compile) and
+    print the round-cost meter's per-phase rows as JSON lines plus one
+    summary object (partisan_tpu/lint/cost.py; BENCH_NOTES' corrected
+    cost model as a measured quantity).  ``--budgets`` additionally
+    judges the pinned lint matrix budgets (cost_budgets.BUDGETS) and
+    exits 1 on any over/stale finding — the CLI face of the tier-1
+    ``round-cost-budget`` rule."""
+    import json
+
+    jax.config.update("jax_platforms", "cpu")
+    from partisan_tpu.lint import cost as cost_mod
+
+    prog = cost_mod.bench_round_program(n, width_operand=width_op)
+    census = cost_mod.census_program(prog)
+    rows = census.rows()
+    for row in rows[:-1]:   # the trailing 'total' row is the summary
+        print(json.dumps({"kind": "cost_phase", "n": n, **row}),
+              flush=True)
+    rc = 0
+    out = {"kind": "cost", "n": n, "program": prog.name,
+           **{k: v for k, v in rows[-1].items() if k != "phase"}}
+    if budgets:
+        from partisan_tpu.lint import matrix
+        from partisan_tpu.lint.rules import round_cost_budget
+
+        finds = []
+        for p in matrix.default_matrix():
+            finds += round_cost_budget(p)
+        for f in finds:
+            print(json.dumps({"kind": "cost_budget_finding",
+                              "detail": f.detail,
+                              "message": f.message}), flush=True)
+        out["budget_verdict"] = "CLEAN" if not finds else "DIRTY"
+        out["budget_findings"] = len(finds)
+        rc = 0 if not finds else 1
+    print(json.dumps(out), flush=True)
+    return rc
+
+
+USAGE = """usage: profile_phases.py [--layout] [--cost [--budgets]] [n] [only]
 
 --layout: A/B the two wire layouts — interleaved legacy
 (Config.plane_major=False) vs plane-major — over every phase, emitting
 a machine-readable per-phase series on stderr
-(`profile_phases,layout=...,phase=...,ms_per_iter=...`)."""
+(`profile_phases,layout=...,phase=...,ms_per_iter=...`).
+
+--cost: STATIC per-phase round-cost census (gather/scatter eqns,
+fetched scalars, materialized [n,.,.] intermediate bytes) of the plain
+bench round at n (default 32768) — jaxpr-level, runs with NO device.
+--budgets additionally judges the pinned lint cost budgets and exits 1
+on any over/stale finding.  --width-op traces with Config.width_operand
+like the real bench program (bench.py's cost card does)."""
 
 
 if __name__ == "__main__":
@@ -252,9 +301,15 @@ if __name__ == "__main__":
         print(USAGE)
         print(__doc__.strip())
     else:
-        argv = [a for a in sys.argv[1:] if a != "--layout"]
+        argv = [a for a in sys.argv[1:]
+                if a not in ("--layout", "--cost", "--budgets",
+                             "--width-op")]
         layout_ab = "--layout" in sys.argv
         size = int(argv[0]) if argv else 32_768
+        if "--cost" in sys.argv:
+            raise SystemExit(cost_census(
+                size, budgets="--budgets" in sys.argv,
+                width_op="--width-op" in sys.argv))
         if layout_ab:
             main(size, plane_major=False, tag="interleaved")
             main(size, plane_major=True, tag="plane")
