@@ -35,6 +35,7 @@ from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
 from partisan_tpu import provenance as provenance_mod
+from partisan_tpu import workload as workload_mod
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
 from partisan_tpu.managers.base import RoundCtx
@@ -92,6 +93,14 @@ class ClusterState(NamedTuple):
     #                         round's at the end of the body, so every
     #                         decision is a pure function of the carry
     #                         — deterministic and checkpoint-safe.
+    traffic: Any = ()       # workload.TrafficState open-loop traffic
+    #                         generator (or () when Config.traffic is
+    #                         off — zero cost).  Carries the DYNAMIC
+    #                         intensity (absolute arrival rate, in-scan
+    #                         churn probability) that workload.SetRate /
+    #                         SetChurn storm actions script, so flash
+    #                         crowds and diurnal ramps checkpoint and
+    #                         replay with the fault timeline.
 
 
 class TraceRound(NamedTuple):
@@ -122,6 +131,17 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     # a flight=() state) and latency-only runs stay recorder-free.
     fx = latency_mod.flight_enabled(cfg) and state.flight != ()
     wx = cfg.width_operand  # static: active-prefix masking
+    tx = workload_mod.enabled(cfg)  # static: open-loop traffic plane
+    if tx and cfg.traffic.churn:
+        # In-scan diurnal churn: one birth/death tick at the carried
+        # probability, applied at ROUND START so this round's ctx and
+        # wire see the post-tick mask — the host-side boundary-action
+        # timing, moved inside the scan (a per-round boundary action
+        # would force soak chunks to length 1).
+        with jax.named_scope("round.traffic"):
+            state = state._replace(faults=workload_mod.churn(
+                cfg, state.traffic, state.faults, state.rnd,
+                state.n_active))
     gids = comm.local_ids()
     keys = rng.node_keys(cfg.seed, state.rnd, gids)
     alive_local = jax.lax.dynamic_slice(
@@ -154,6 +174,17 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     # fails the lint gate, not silently weakens it.
     with jax.named_scope("round.manager"):
         mstate, m_emit = manager.step(cfg, comm, state.manager, ctx)
+    tstate = state.traffic
+    t_blocks = ()
+    if tx:
+        # Open-loop arrivals: a fresh [n, burst_max] APP block joining
+        # the single assembly concatenate below — traffic records ride
+        # every downstream stage (provenance/latency stamps, shed,
+        # interposition, faults, route) exactly like model emissions.
+        with jax.named_scope("round.traffic"):
+            tstate, t_emit = workload_mod.generate(cfg, comm,
+                                                   state.traffic, ctx)
+            t_blocks = tuple(plane_ops.blocks_of(t_emit))
     nbrs = None
     if model is not None:
         with jax.named_scope("round.model"):
@@ -164,10 +195,11 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             # block tuples (plane_ops.blocks_of), so no record byte is
             # copied twice between emission and the wire.
             emitted = plane_ops.concat(
-                plane_ops.blocks_of(m_emit) + plane_ops.blocks_of(a_emit),
+                tuple(plane_ops.blocks_of(m_emit))
+                + tuple(plane_ops.blocks_of(a_emit)) + t_blocks,
                 axis=1)
     else:
-        mb = plane_ops.blocks_of(m_emit)
+        mb = tuple(plane_ops.blocks_of(m_emit)) + t_blocks
         dstate_model = ()
         emitted = mb[0] if len(mb) == 1 else plane_ops.concat(mb, axis=1)
     if px:
@@ -630,7 +662,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                        delivery=dstate, stats=stats, interpose=istate,
                        outbox=obstate, metrics=mets, latency=lt,
                        flight=fstate, n_active=state.n_active,
-                       health=hstate, provenance=pv, control=ctrl)
+                       health=hstate, provenance=pv, control=ctrl,
+                       traffic=tstate)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent_wire,
                                dropped=fault_dropped)
@@ -764,6 +797,8 @@ class Cluster:
                         if provenance_mod.enabled(cfg) else ()),
             control=(control_mod.init(cfg)
                      if control_mod.enabled(cfg) else ()),
+            traffic=(workload_mod.init(cfg)
+                     if workload_mod.enabled(cfg) else ()),
         )
 
     def _build_init(self) -> ClusterState:

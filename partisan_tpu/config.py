@@ -220,6 +220,52 @@ class ControlConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TrafficConfig:
+    """Open-loop workload generator (workload.py): deterministic,
+    device-resident per-round message arrivals injected into the round's
+    emission assembly — the production traffic plane (ROADMAP item 3).
+
+    Open-loop means arrivals never wait for the cluster: the generator
+    keeps offering load at the configured rate whether or not the system
+    keeps up (the coordinated-omission-free stance of production load
+    harnesses), so saturation shows up as queueing age in the latency
+    plane, not as a silently throttled workload.
+
+    Arrivals are drawn in-scan from the counter-based fault hash keyed
+    on (seed, round, node, slot) — the same replay discipline as the
+    fault plane, so a traffic trajectory is a pure function of the
+    config and replays bit-identically across chunking, checkpoint
+    resume, and sharding.  Burst sizes are bounded-Zipf: emission slot
+    ``k`` fires with probability ``rate · (k+1)^-zipf_s / H`` (H the
+    normalizer), so per-node per-round arrival counts are heavy-tailed
+    up to ``burst_max``; destinations draw from a hot-spot law (``u``
+    squared ``hot_skew`` times concentrates traffic onto low ids — a
+    popularity skew every cache/partition story needs).
+
+    The DYNAMIC intensity (the absolute arrival rate in thousandths of
+    a message/node/round, initialized from ``rate_x1000``, plus an
+    optional in-scan churn probability) rides in the
+    ``ClusterState.traffic`` carry leaf so ``workload.SetRate`` /
+    ``SetChurn`` storm actions can script flash crowds and diurnal
+    ramps that checkpoint/resume replays exactly.  Off (the default):
+    the carry leaf is ``()`` and no op traces under ``round.traffic``
+    — zero cost, bit-identical rounds (the lint zero-cost rule audits
+    both over the traffic matrix entries)."""
+
+    enabled: bool = False
+    rate_x1000: int = 500        # base expected arrivals/node/round ×1000
+    burst_max: int = 4           # emission slots per node per round
+    zipf_s: float = 1.0          # burst-slot Zipf exponent (0 = uniform)
+    hot_skew: int = 0            # destination hot-spot squarings
+    #                              (0 = uniform destinations)
+    channel: str = BROADCAST_CHANNEL   # channel the bulk arrivals ride
+    churn: bool = False          # compile the in-scan diurnal churn
+    #                              stage (rate still starts at 0 —
+    #                              workload.SetChurn arms it)
+    ring: int = 64               # per-round arrival ring (observability)
+
+
+@dataclasses.dataclass(frozen=True)
 class ScampConfig:
     """SCAMP parameters (include/partisan.hrl:240-241)."""
 
@@ -321,6 +367,7 @@ class Config:
     plumtree: PlumtreeConfig = PlumtreeConfig()
     distance: DistanceConfig = DistanceConfig()
     control: ControlConfig = ControlConfig()
+    traffic: TrafficConfig = TrafficConfig()
 
     # --- tensor capacities (sim-specific) ------------------------------
     inbox_cap: int = 32          # queued event messages per node per round
@@ -543,6 +590,26 @@ class Config:
                 "control.backpressure drives shed thresholds in the "
                 "channel-capacity outbox — set "
                 "Config(channel_capacity=True)")
+        if self.traffic.enabled:
+            # The generator's statics are resolved at trace time; a bad
+            # value would otherwise surface as an opaque trace error.
+            if self.traffic.channel not in names:
+                raise ValueError(
+                    f"traffic.channel {self.traffic.channel!r} is not a "
+                    f"configured channel; have {names}")
+            if not 1 <= self.traffic.burst_max <= 64:
+                raise ValueError(
+                    f"traffic.burst_max must be in [1, 64], got "
+                    f"{self.traffic.burst_max}")
+            if self.traffic.rate_x1000 < 0:
+                raise ValueError("traffic.rate_x1000 must be >= 0")
+            if self.traffic.zipf_s < 0:
+                raise ValueError("traffic.zipf_s must be >= 0")
+            if self.traffic.hot_skew < 0:
+                raise ValueError("traffic.hot_skew must be >= 0")
+            if self.traffic.ring < 1:
+                raise ValueError(
+                    f"traffic.ring must be >= 1, got {self.traffic.ring}")
         if self.control.healing and self.health <= 0:
             raise ValueError(
                 "control.healing keys repair cadences off the health "
@@ -736,4 +803,6 @@ class Config:
             d["distance"] = DistanceConfig(**d["distance"])
         if "control" in d and isinstance(d["control"], Mapping):
             d["control"] = ControlConfig(**d["control"])
+        if "traffic" in d and isinstance(d["traffic"], Mapping):
+            d["traffic"] = TrafficConfig(**d["traffic"])
         return cls(**d)

@@ -310,6 +310,35 @@ def inject_partition(faults: FaultState, group_a, group_b) -> FaultState:
     return faults._replace(partition=p)
 
 
+def inject_directed_cut(faults: FaultState, src_group,
+                        dst_group) -> FaultState:
+    """Sever edges ONE WAY: messages src→dst are cut, dst→src still
+    flow — the asymmetric-link fault (a NAT'd or misrouted node that
+    can send but not receive, the classic gray failure).
+
+    Dense partition mode only: ``edge_cut``'s dense branch already
+    reads the per-(src, dst) matrix directionally (``partition[s, d]``
+    — ``inject_partition`` just happens to set both triangles), so the
+    fix is exactly this asymmetric setter.  Groups mode packs ONE
+    per-node label into the fast wire word (``pack_wire_info``) and a
+    direction needs the (src, dst) PAIR, so it raises loudly instead
+    of silently aliasing — and since the fast wire path requires
+    groups mode, directed cuts always price the generic path and the
+    packed ``alive|group`` word's bit-parity contract
+    (``wire_cut_from_info`` vs ``edge_cut``) is untouched.  Heal with
+    ``resolve_partition`` (one fault surface)."""
+    p = faults.partition
+    if p.ndim != 2:
+        raise ValueError(
+            "directed cuts need partition_mode='dense': the groups "
+            "mode packs one per-node label into the fast-wire word "
+            "and cannot express a per-(src, dst) direction")
+    a = jnp.asarray(src_group)
+    b = jnp.asarray(dst_group)
+    return faults._replace(partition=p.at[a[:, None], b[None, :]].set(True))
+
+
 def resolve_partition(faults: FaultState) -> FaultState:
-    """Heal all partitions (resolve_partition/1)."""
+    """Heal all partitions (resolve_partition/1) — directed cuts
+    included (``inject_directed_cut`` writes the same matrix)."""
     return faults._replace(partition=jnp.zeros_like(faults.partition))

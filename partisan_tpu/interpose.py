@@ -199,47 +199,100 @@ class Delay:
         return {"buf": shard, "due": shard, "missed": repl}
 
     def apply(self, cfg, comm, state, emitted, ctx):
-        n, e, _w = emitted.shape
-        buf, due = state["buf"], state["due"]
-        missed0 = state.get("missed", jnp.int32(0))
-
-        # 1. Release matured messages (due in (0, rnd]).
-        ripe = (due >= 0) & (due <= ctx.rnd)
-        released = _drop_where(buf, ~ripe)
-        # Mark released as re-injected so a re-applied pred can skip them.
-        released = released.at[..., T.W_FLAGS].set(jnp.where(
-            ripe, released[..., T.W_FLAGS] | self.mark_flag,
-            released[..., T.W_FLAGS]))
-        buf = _drop_where(buf, ripe)
-        due = jnp.where(ripe, -1, due)
-
-        # 2. Capture newly-matching messages into free slots.
         hold = self.pred(cfg, ctx, emitted) & (emitted[..., T.W_KIND] != 0)
-        free = due < 0                                   # [n, cap]
-        # Rank of each message among this node's holds / each slot among frees.
-        hold_rank = jnp.cumsum(hold, axis=1) - 1         # [n, e]
-        free_rank = jnp.cumsum(free, axis=1) - 1         # [n, cap]
-        n_free = jnp.sum(free, axis=1)                   # [n]
-        can = hold & (hold_rank < n_free[:, None])
-        # Scatter captured messages into the free slots by matching ranks.
-        slot_of_rank = jnp.full((n, self.cap), self.cap, jnp.int32)
-        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, self.cap))
-        slot_of_rank = slot_of_rank.at[
-            rows, jnp.where(free, free_rank, self.cap)
-        ].set(jnp.arange(self.cap, dtype=jnp.int32)[None, :], mode="drop")
-        tgt = jnp.where(can, slot_of_rank[
-            jnp.broadcast_to(jnp.arange(n)[:, None], (n, e)),
-            jnp.minimum(hold_rank, self.cap - 1)], self.cap)
-        erows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
-        buf = buf.at[erows, tgt].set(emitted, mode="drop")
-        due = due.at[erows, tgt].set(ctx.rnd + self.rounds, mode="drop")
-        emitted = _drop_where(emitted, can)
+        rounds_row = jnp.full((emitted.shape[0],), self.rounds, jnp.int32)
+        return _hold_release(comm, state, emitted, ctx, hold=hold,
+                             rounds_row=rounds_row, cap=self.cap,
+                             mark_flag=self.mark_flag)
 
-        # 3. Append released messages to this round's emissions.
-        out = plane_ops.concat([emitted, released], axis=1)
-        missed = missed0 + comm.allsum(
-            jnp.sum(hold & ~can, dtype=jnp.int32))
-        return {"buf": buf, "due": due, "missed": missed}, out
+
+def _hold_release(comm, state, emitted, ctx, *, hold, rounds_row,
+                  cap, mark_flag):
+    """The shared hold-buffer machinery behind :class:`Delay` and
+    :class:`StragglerDelay`: release matured messages, capture the
+    ``hold``-selected ones into free slots for ``rounds_row[node]``
+    rounds, append releases to this round's emissions.  ``state`` is a
+    dict with ``buf``/``due``/``missed`` keys (extra keys pass through
+    untouched — StragglerDelay keeps its ``mult`` there)."""
+    n, e, _w = emitted.shape
+    buf, due = state["buf"], state["due"]
+    missed0 = state.get("missed", jnp.int32(0))
+
+    # 1. Release matured messages (due in (0, rnd]).
+    ripe = (due >= 0) & (due <= ctx.rnd)
+    released = _drop_where(buf, ~ripe)
+    # Mark released as re-injected so a re-applied pred can skip them.
+    released = released.at[..., T.W_FLAGS].set(jnp.where(
+        ripe, released[..., T.W_FLAGS] | mark_flag,
+        released[..., T.W_FLAGS]))
+    buf = _drop_where(buf, ripe)
+    due = jnp.where(ripe, -1, due)
+
+    # 2. Capture newly-matching messages into free slots.
+    free = due < 0                                   # [n, cap]
+    # Rank of each message among this node's holds / each slot among frees.
+    hold_rank = jnp.cumsum(hold, axis=1) - 1         # [n, e]
+    free_rank = jnp.cumsum(free, axis=1) - 1         # [n, cap]
+    n_free = jnp.sum(free, axis=1)                   # [n]
+    can = hold & (hold_rank < n_free[:, None])
+    # Scatter captured messages into the free slots by matching ranks.
+    slot_of_rank = jnp.full((n, cap), cap, jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, cap))
+    slot_of_rank = slot_of_rank.at[
+        rows, jnp.where(free, free_rank, cap)
+    ].set(jnp.arange(cap, dtype=jnp.int32)[None, :], mode="drop")
+    tgt = jnp.where(can, slot_of_rank[
+        jnp.broadcast_to(jnp.arange(n)[:, None], (n, e)),
+        jnp.minimum(hold_rank, cap - 1)], cap)
+    erows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
+    buf = buf.at[erows, tgt].set(emitted, mode="drop")
+    due = due.at[erows, tgt].set((ctx.rnd + rounds_row)[:, None],
+                                 mode="drop")
+    emitted = _drop_where(emitted, can)
+
+    # 3. Append released messages to this round's emissions.
+    out = plane_ops.concat([emitted, released], axis=1)
+    missed = missed0 + comm.allsum(
+        jnp.sum(hold & ~can, dtype=jnp.int32))
+    return {**state, "buf": buf, "due": due, "missed": missed}, out
+
+
+@dataclasses.dataclass(frozen=True)
+class StragglerDelay:
+    """Slow-node straggler stage (the traffic plane's per-node delay):
+    state carries a per-node hold multiplier ``mult`` int32[n_local]
+    (0 — the init value — passes straight through); every live message
+    a slow node emits is held ``mult[node]`` rounds before re-injection
+    on the send path, modeling a node whose egress is slow rather than
+    cut.  ``mult`` is scripted mid-run by ``workload.Stragglers`` storm
+    actions (the interpose state is a ClusterState leaf, so the change
+    checkpoints and replays like any other boundary action).  Released
+    messages carry ``mark_flag`` so they are not re-held."""
+
+    cap: int = 8
+    mark_flag: int = T.F_DELAY_RELEASED
+
+    def init(self, cfg: Config, comm: Any) -> Any:
+        n = comm.n_local
+        return {
+            "mult": jnp.zeros((n,), jnp.int32),
+            "buf": msg_ops.zero_wire(cfg, (n, self.cap)),
+            "due": jnp.full((n, self.cap), -1, jnp.int32),
+            "missed": jnp.int32(0),
+        }
+
+    def specs(self, shard, repl):
+        return {"mult": shard, "buf": shard, "due": shard,
+                "missed": repl}
+
+    def apply(self, cfg, comm, state, emitted, ctx):
+        mult = state["mult"]
+        hold = (emitted[..., T.W_KIND] != 0) \
+            & (mult[:, None] > 0) \
+            & ((emitted[..., T.W_FLAGS] & self.mark_flag) == 0)
+        return _hold_release(comm, state, emitted, ctx, hold=hold,
+                             rounds_row=mult, cap=self.cap,
+                             mark_flag=self.mark_flag)
 
 
 def _not_yet_released(cfg: Config, ctx: RoundCtx, emitted: Array) -> Array:

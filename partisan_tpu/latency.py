@@ -322,6 +322,28 @@ def percentiles(ls_or_snap, channels: tuple[str, ...] | None = None) -> dict:
     return out
 
 
+def window_snap(prev: dict | None, cur: dict) -> dict:
+    """A :func:`percentiles` input covering only the deliveries BETWEEN
+    two cumulative snapshots (count histograms differenced; the
+    high-water mark stays the cumulative maximum, so windowed quantile
+    bucket edges clamp conservatively — a windowed p99 can never exceed
+    the run's true maximum age).  ``prev=None`` passes ``cur`` through
+    (the first window is since-start).  This is how the soak engine's
+    ``poll_latency`` chunk rows turn the cumulative plane into a
+    per-chunk p99 series (soak.py / telemetry.replay_traffic_events)."""
+    if prev is None:
+        return cur
+    import numpy as np
+
+    return {
+        "deliver": np.asarray(cur["deliver"]) - np.asarray(prev["deliver"]),
+        "drop_age": np.asarray(cur["drop_age"])
+        - np.asarray(prev["drop_age"]),
+        "age_hwm": cur["age_hwm"],
+        "bounds": cur["bounds"],
+    }
+
+
 def flight_trace(fl: FlightState):
     """Decode a flight-recorder ring into a ``trace.Trace`` ordered by
     round — the post-mortem view of the last K rounds, interchangeable

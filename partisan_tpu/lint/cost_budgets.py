@@ -43,4 +43,14 @@ BUDGETS: dict = {
         "interm_kib": 2322.0,
         "eqns": 4261,
     },
+    # The open-loop traffic generator over the plain round (PR 12):
+    # +2 gather/scatter (the burst-slot arrival draw's emission build)
+    # and ~60 KiB of per-round arrival intermediates over the
+    # planes-off pin — the whole price of the traffic plane when ON;
+    # OFF is bit-identical to "round/planes-off" (zero-cost rule).
+    "round/traffic": {
+        "gather_scatter": 58,
+        "interm_kib": 1945.0,
+        "eqns": 3502,
+    },
 }

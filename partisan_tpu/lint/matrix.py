@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import jax
 
-from partisan_tpu.config import Config, ControlConfig, PlumtreeConfig
+from partisan_tpu.config import (Config, ControlConfig, PlumtreeConfig,
+                                 TrafficConfig)
 from partisan_tpu.lint.core import Program, trace_program
 
 
@@ -42,9 +43,12 @@ def full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
 
 
 def control_full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
-    """Every plane + every in-scan controller (the closed-loop round;
-    also the sharding completeness rule's reference state — controller
-    leaves need PartitionSpecs like any other carry)."""
+    """Every plane + every in-scan controller + the traffic generator
+    (the closed-loop round under load; also the sharding completeness
+    rule's reference state — controller and traffic leaves need
+    PartitionSpecs like any other carry)."""
+    kw.setdefault("traffic", TrafficConfig(enabled=True, churn=True,
+                                           ring=8))
     return full_cfg(n, flight=flight, channel_capacity=True,
                     control=ControlConfig(fanout=True, backpressure=True,
                                           healing=True, ring=8), **kw)
@@ -134,5 +138,24 @@ def default_matrix() -> list[Program]:
                                                       ring=8))),
         _round_program("scan/control-all+planes",
                        control_full_cfg(), scan=4),
+        # the traffic plane (ROADMAP item 3): the generator alone over
+        # the plain round — its off-state is covered by every entry
+        # above (no round.traffic scope may appear there, pinned by
+        # the zero-cost rule) and the round-cost-budget rule holds it
+        # to the pinned "round/traffic" budget
+        _round_program("round/traffic",
+                       base_cfg(traffic=TrafficConfig(enabled=True,
+                                                      ring=8))),
+        # the SLO-suite shape: traffic + in-scan churn + latency +
+        # channel capacity + the backpressure controller, as a scan —
+        # what scenarios.traffic_slo dispatches
+        _round_program("scan/traffic-slo",
+                       base_cfg(traffic=TrafficConfig(enabled=True,
+                                                      churn=True,
+                                                      ring=8),
+                                latency=True, channel_capacity=True,
+                                control=ControlConfig(backpressure=True,
+                                                      ring=8)),
+                       scan=4),
     ]
     return progs

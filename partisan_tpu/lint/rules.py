@@ -149,6 +149,7 @@ def _planes_of(cfg):
         ("control.fanout", cfg.control.fanout),
         ("control.backpressure", cfg.control.backpressure),
         ("control.healing", cfg.control.healing),
+        ("traffic", cfg.traffic.enabled),
     )
 
 

@@ -41,6 +41,7 @@ from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
 from partisan_tpu import provenance as provenance_mod
+from partisan_tpu import workload as workload_mod
 from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
@@ -292,6 +293,10 @@ class ShardedCluster:
             # reduced plane values, so all shards step identical
             # controller state — replicated like the rings it reads.
             control=spec_like(state.control, repl),
+            # Traffic generator: a reduced scalar + ring (arrival
+            # counts are allsum-reduced before every write), identical
+            # on every shard — replicated like the controllers.
+            traffic=spec_like(state.traffic, repl),
         )
 
     # ---- state construction ------------------------------------------
@@ -324,6 +329,8 @@ class ShardedCluster:
                         if provenance_mod.enabled(cfg) else ()),
             control=(control_mod.init(cfg)
                      if control_mod.enabled(cfg) else ()),
+            traffic=(workload_mod.init(cfg)
+                     if workload_mod.enabled(cfg) else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

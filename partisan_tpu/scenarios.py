@@ -1273,6 +1273,456 @@ def control_ab(scale: float = 1.0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Traffic-plane SLO suite (ROADMAP item 3): the app models under
+# sustained adversarial open-loop load — flash crowds, diurnal churn,
+# partitions, one-way links, stragglers — every scenario gated
+# Dapper-style on the latency plane's per-channel p99.  Partisan's
+# ATC'19 claim operationalized: the bulk channel may degrade under a
+# flash crowd; the membership/control channels must hold their p99.
+# ---------------------------------------------------------------------------
+
+BULK_CHANNEL = "bulk"
+TRAFFIC_SLO_BOUND = 4          # rounds: control channels' p99 ceiling
+TRAFFIC_MODELS = ("p2p_chat", "causal_chat", "paxos", "commit",
+                  "alsberg_day")
+# models whose controllers-off vs controllers-on A/B the suite runs
+# (the backpressure-win evidence; the rest run the closed loop only)
+TRAFFIC_AB_MODELS = ("p2p_chat", "causal_chat", "paxos")
+
+
+def _traffic_build(model_name: str, n: int):
+    """One app model's harness under the traffic plane: returns
+    ``(model, extras, boot, drive, check)`` — the model (possibly a
+    Stack), config extras, overlay bootstrap, the app's own scripted
+    workload as (state, start, rounds) -> (state, storm-events), and
+    the end-of-run application check (the protocol's own guarantee
+    must survive the storm)."""
+    from partisan_tpu import soak as soak_mod
+    from partisan_tpu.config import PlumtreeConfig
+
+    if model_name in ("p2p_chat", "causal_chat"):
+        from partisan_tpu.models.plumtree import Plumtree
+        from partisan_tpu.models.stack import Stack
+
+        plum = Plumtree()
+        extras = dict(peer_service_manager="hyparview", msg_words=16,
+                      health=5, health_ring=256, max_broadcasts=8,
+                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4,
+                                              aae=True))
+        senders = tuple(range(1, 5))
+        receivers = tuple(range(n - 8, n - 4))
+
+        if model_name == "p2p_chat":
+            from partisan_tpu.models.p2p_chat import P2PChat
+
+            chat = P2PChat()
+            stack = Stack([plum, chat])
+            extras["causal_p2p_labels"] = ("chat",)
+
+            def drive(st, start, rounds):
+                # two sends per sender: one calm, one INSIDE the flash
+                # crowd — per-edge FIFO must survive the overload
+                nodes = np.repeat(np.asarray(senders, np.int32), 2)
+                rnds = np.stack([
+                    np.full(len(senders), start + 4),
+                    np.full(len(senders), start + rounds // 4 + 4),
+                ], axis=1).reshape(-1)
+                dsts = np.repeat(np.asarray(receivers, np.int32), 2)
+                m = chat.schedule_many(stack.sub(st.model, 1),
+                                       nodes, rnds, dsts)
+                return st._replace(
+                    model=stack.replace_sub(st.model, 1, m)), ()
+
+            def check(st):
+                import jax as _jax
+
+                logs = P2PChat.logs(_jax.device_get(
+                    stack.sub(st.model, 1)))
+                got = sum(len(logs[int(r)]) for r in receivers)
+                fifo = all(P2PChat.edge_fifo_ok(logs[int(r)])
+                           for r in receivers)
+                return bool(fifo and got >= len(senders)), \
+                    {"causal_delivered": int(got),
+                     "causal_expected": 2 * len(senders)}
+        else:
+            from partisan_tpu.models.causal_chat import CausalChat
+
+            chat = CausalChat()
+            stack = Stack([plum, chat])
+            extras["causal_labels"] = ("chat",)
+            extras["n_actors"] = n
+
+            def drive(st, start, rounds):
+                m = stack.sub(st.model, 1)
+                for s in senders:
+                    m = chat.schedule(m, int(s), start + 4)
+                    m = chat.schedule(m, int(s),
+                                      start + rounds // 4 + 4)
+                return st._replace(
+                    model=stack.replace_sub(st.model, 1, m)), ()
+
+            def check(st):
+                import jax as _jax
+
+                logs = CausalChat.logs(_jax.device_get(
+                    stack.sub(st.model, 1)))
+                got = sum(len(lg) for lg in logs)
+                return bool(got > 0), {"causal_delivered": int(got)}
+
+        def boot(cl):
+            return _boot_joinall(cl, 40)
+
+        return stack, extras, boot, drive, check
+
+    if model_name == "paxos":
+        from partisan_tpu.models.paxos import Paxos
+
+        model = Paxos(slots=2)
+        extras = dict(msg_words=13, inbox_cap=96)
+
+        def boot(cl):
+            return _boot_fullmesh(cl, n)
+
+        def drive(st, start, rounds):
+            def prop(slot, node, value, off):
+                def fn(cluster, state, rnd):
+                    return state._replace(model=model.propose(
+                        state.model, node, slot, value, rnd, n))
+                return (off, soak_mod.Script(fn))
+            # decree 0 proposed calm, decree 1 mid-flash-crowd by TWO
+            # rival proposers at the same boundary (the overload must
+            # not break safety).  Offsets sit on the K_PROG chunk
+            # grain — an off-grain storm event would compile a second
+            # scan length (see traffic_scenario's g()).
+            crowd = rounds // 4 // K_PROG * K_PROG + K_PROG
+            return st, (prop(0, 1, 111, K_PROG),
+                        prop(1, 2, 222, crowd),
+                        prop(1, 3, 333, crowd))
+
+        def check(st):
+            decided0 = len(model.decided_nodes(st.model, 0))
+            decided1 = len(model.decided_nodes(st.model, 1))
+            return bool(model.agreement(st.model)
+                        and decided0 > n // 2 and decided1 > n // 2), \
+                {"decided_0": int(decided0), "decided_1": int(decided1)}
+
+        return model, extras, boot, drive, check
+
+    if model_name == "commit":
+        from partisan_tpu.models import commit as commit_mod
+
+        model = commit_mod.CommitProtocol("lampson_2pc", slots=2)
+        extras = dict(inbox_cap=96, emit_cap=16)
+
+        def boot(cl):
+            return _boot_fullmesh(cl, n)
+
+        def drive(st, start, rounds):
+            def begin(slot, coord, value, off):
+                def fn(cluster, state, rnd):
+                    return state._replace(model=model.begin(
+                        state.model, coord, slot, value,
+                        state.faults.alive, rnd))
+                return (off, soak_mod.Script(fn))
+            crowd = rounds // 4 // K_PROG * K_PROG + K_PROG
+            return st, (begin(0, 0, 5, K_PROG),
+                        begin(1, 1, 9, crowd))
+
+        def check(st):
+            agree = bool(jax.device_get(model.agreement(st.model)))
+            delivered = int(np.asarray(jax.device_get(
+                st.model.p_status == commit_mod.P_COMMIT)).sum())
+            return agree and delivered > 0, \
+                {"agreement": agree, "commits": delivered}
+
+        return model, extras, boot, drive, check
+
+    if model_name == "alsberg_day":
+        from partisan_tpu.models.alsberg_day import AlsbergDay
+
+        model = AlsbergDay(keys=4)
+        extras = dict(inbox_cap=96, emit_cap=16)
+
+        def boot(cl):
+            return _boot_fullmesh(cl, n)
+
+        def drive(st, start, rounds):
+            def write(client, key, value, off):
+                def fn(cluster, state, rnd):
+                    return state._replace(model=model.write(
+                        state.model, client, key, value))
+                return (off, soak_mod.Script(fn))
+            crowd = rounds // 4 // K_PROG * K_PROG + K_PROG
+            return st, (write(5, 0, 42, K_PROG),
+                        write(6, 1, 43, crowd))
+
+        def check(st):
+            ok = bool(jax.device_get(st.model.req_ok[5, 0])) \
+                and bool(jax.device_get(st.model.req_ok[6, 1]))
+            rep = bool(jax.device_get(AlsbergDay.replicated(
+                st.model, 0, st.faults.alive)))
+            return ok and rep, {"acked": ok, "replicated": rep}
+
+        return model, extras, boot, drive, check
+
+    raise ValueError(f"unknown traffic model {model_name!r}; have "
+                     f"{TRAFFIC_MODELS}")
+
+
+def traffic_scenario(model_name: str, n: int = 64, rounds: int = 240,
+                     adaptive: bool = True, seed: int = 29,
+                     bound: int = TRAFFIC_SLO_BOUND,
+                     rate_x1000: int = 600,
+                     crowd_x1000: int = 4000) -> dict:
+    """ONE app model under the full adversarial traffic plane, driven
+    through the chunked soak engine: open-loop bulk arrivals on a
+    dedicated ``bulk`` channel (hot-spot skewed), a flash crowd at
+    rounds/8..3/8, slow-node stragglers across the crowd, a diurnal
+    churn pulse, a one-way (directed) link cut, and a regional
+    partition+heal — while the app's own scripted workload runs and
+    must keep its guarantee.  Gates (the returned dict): per-channel
+    p99 (control channels <= ``bound`` while bulk degrades),
+    conservation at every chunk boundary, overlay recovery (health
+    digest, where the model runs on hyparview), and the app check.
+    ``adaptive`` arms the backpressure controller (+ healing where the
+    health plane is on) — the A/B the committed TRAFFIC_SLO.json
+    carries."""
+    from partisan_tpu import interpose as interpose_mod
+    from partisan_tpu import latency as latency_mod
+    from partisan_tpu import soak as soak_mod
+    from partisan_tpu import workload
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import (ChannelSpec, Config, ControlConfig,
+                                     DEFAULT_CHANNELS, TrafficConfig)
+
+    n = max(n, 24)
+    model, extras, boot, drive, check = _traffic_build(model_name, n)
+    hx = extras.get("health", 0) > 0
+    ctl = ControlConfig(backpressure=True, healing=hx, ring=64) \
+        if adaptive else ControlConfig()
+    cfg = Config(
+        n_nodes=n, seed=seed,
+        channels=DEFAULT_CHANNELS + (ChannelSpec(BULK_CHANNEL),),
+        latency=True, channel_capacity=True, lane_rate=1,
+        outbox_cap=128, control=ctl,
+        # dense faults so the one-way cut is expressible (n is far
+        # under the dense threshold at suite scale)
+        partition_mode="dense",
+        traffic=TrafficConfig(enabled=True, rate_x1000=rate_x1000,
+                              burst_max=4, zipf_s=1.0, hot_skew=2,
+                              channel=BULK_CHANNEL, churn=True,
+                              ring=256),
+        **extras)
+    cl = Cluster(cfg, model=model,
+                 interpose=interpose_mod.StragglerDelay(cap=16))
+    st = boot(cl)
+    # The boot is scaffolding: a join storm through lane_rate=1
+    # channels leaves a deferred-control backlog whose late deliveries
+    # would dominate the cumulative p99 for the first chunks.  Zero
+    # the histograms so the gate measures the STORM phase (stats and
+    # queues carry over untouched — the conservation ledger is
+    # from-init cumulative).
+    st = st._replace(latency=latency_mod.init(cfg))
+    start = int(jax.device_get(st.rnd))
+    q = rounds // 8
+    st, app_events = drive(st, start, rounds)
+
+    slow = tuple(range(n - 4, n))      # high ids: never app-critical
+    half = n // 2
+
+    def g(off: int) -> int:
+        """Snap a storm offset to the K_PROG chunk grain: the soak
+        engine clips chunks at event rounds, so an off-grain offset
+        would compile a SECOND scan length per scenario config (the
+        file's one-k=K_PROG-program discipline)."""
+        return max(K_PROG, off // K_PROG * K_PROG)
+
+    timeline = workload.Traffic(
+        # q..3q: flash crowd with slow-node stragglers riding it
+        workload.flash_crowd(g(q), g(2 * q), crowd_x1000, rate_x1000)
+        + ((g(q), workload.Stragglers(nodes=slow, mult=2)),
+           (g(3 * q), workload.Stragglers(nodes=slow, mult=0)),
+           # ~3.5q..4q — one-way cut: the upper half can't reach the
+           # lower (the lower->upper direction still flows)
+           (g(3 * q + q // 2), workload.DirectedCut(
+               src=tuple(range(half, n)), dst=tuple(range(half)))),
+           (g(4 * q), soak_mod.Heal()),
+           # ~4.5q..5.5q — diurnal churn pulse (in-scan, 0.4%/round)
+           (g(4 * q + q // 2), workload.SetChurn(4000)),
+           (g(5 * q + q // 2), workload.SetChurn(0)),
+           # ~5.5q..6q — regional partition, then heal + revive the
+           # churn casualties; the last 2q rounds are the recovery
+           # window the end-state health gate judges
+           (g(5 * q + q // 2), soak_mod.Partition()),
+           (g(6 * q), soak_mod.Heal(revive=True)))
+        + tuple(app_events))
+    storm = timeline.storm(start=start)
+
+    # Conservation at every boundary — the flow ledger
+    # (soak.flow_conservation): exact (slack 0) for the event-lane
+    # models, capacity deferrals included; the chat models' causal
+    # lanes get a small upward slack for their 8 scheduled sends'
+    # fan-out bookkeeping, one-sided for the p2p duplicate netting
+    # (see the invariant's docs).  Overlay recovery is judged on the
+    # END state (a scripted partition is SUPPOSED to split the digest
+    # mid-run, so the one-component invariant is not armed).
+    causal = bool(cfg.causal_labels or cfg.causal_p2p_labels)
+    # Upward slack: a broadcast-causal lane fans each of the 8
+    # scheduled sends to up to n receivers; the p2p lane delivers
+    # each exactly once.
+    slack = (8 * n if cfg.causal_labels else 32) if causal else 0
+    invariants = [soak_mod.flow_conservation(
+        slack=slack, one_sided=bool(cfg.causal_p2p_labels))]
+    warm = [cl]
+    eng = soak_mod.Soak(
+        make_cluster=lambda: warm.pop() if warm else Cluster(
+            cfg, model=model,
+            interpose=interpose_mod.StragglerDelay(cap=16)),
+        storm=storm, invariants=invariants,
+        cfg=soak_mod.SoakConfig(chunk_fixed=K_PROG,
+                                poll_latency=True))
+    t0 = time.perf_counter()
+    res = eng.run(st, rounds=rounds)
+    wall = time.perf_counter() - t0
+    st = res.state
+
+    names = tuple(c.name for c in cfg.channels)
+    pct = latency_mod.percentiles(st.latency, channels=names)
+    p99 = {ch: pct[ch]["p99"] for ch in names}
+    delivered = {ch: pct[ch]["count"] for ch in names}
+    # control channels = every trafficked channel except bulk
+    control_ok = all(
+        p99[ch] is not None and p99[ch] <= bound
+        for ch in names
+        if ch != BULK_CHANNEL and delivered[ch] > 0)
+    app_ok, app_info = check(st)
+    # Head-of-line isolation, judged per WINDOW inside the flash
+    # crowd: chunks where the bulk channel's windowed p99 breached the
+    # bound while every other trafficked channel held — the ATC'19
+    # claim measured on the same clock as the overload.
+    crowd_rows = [row for row in res.chunks
+                  if row.get("traffic", {}).get("rate_x1000", 0)
+                  >= crowd_x1000]
+
+    def _isolated(row):
+        p = row.get("p99") or {}
+        bulk_w = p.get(BULK_CHANNEL)
+        ctrl = [v for ch, v in p.items()
+                if ch != BULK_CHANNEL and v is not None]
+        # bulk breached while at least one MEASURED control channel
+        # held (a window with no control deliveries is no evidence)
+        return (bulk_w is not None and bulk_w > bound
+                and bool(ctrl) and all(v <= bound for v in ctrl))
+
+    out = {
+        "model": model_name, "n": n, "rounds": res.rounds,
+        "adaptive": adaptive, "bound": bound,
+        "crowd_chunks": len(crowd_rows),
+        "crowd_isolation_chunks": sum(
+            1 for row in crowd_rows if _isolated(row)),
+        "p99": p99, "age_max": {ch: pct[ch]["max"] for ch in names},
+        "delivered": delivered,
+        "bulk_p99": p99[BULK_CHANNEL],
+        "control_ok": bool(control_ok),
+        "outbox_shed": int(jax.device_get(st.outbox.shed)),
+        "traffic": workload.poll(st.traffic),
+        "breaches": res.breaches, "retries": res.retries,
+        "chunks": len(res.chunks),
+        "slo_windows": _slo_window_count(res.chunks, bound),
+        "app_ok": bool(app_ok), "app": app_info,
+        "wall_s": round(wall, 1),
+    }
+    if hx:
+        # Recovery gate: the GRAPH-health bits (one component, no
+        # isolates, min degree — health.overlay_ok), judged over the
+        # last few chunk snapshots: the storm heals at 6q and the gate
+        # asks "did the overlay re-merge in the 2q recovery window".
+        # The digest's coverage bit is not consulted — no broadcast is
+        # scheduled on slot 0 in these scenarios, so it reads
+        # incomplete by construction.
+        from partisan_tpu import health as health_mod
+
+        tail = [row["digest"] for row in res.chunks[-3:]
+                if "digest" in row]
+        out["overlay_ok"] = bool(any(
+            health_mod.overlay_ok(d) for d in tail))
+    if adaptive:
+        from partisan_tpu import control as control_mod
+
+        out["control"] = control_mod.poll(st.control)
+    return out
+
+
+def _slo_window_count(chunks, bound: int) -> int:
+    """Breach windows in a soak's chunk rows (the same maximal-run
+    definition telemetry.replay_traffic_events emits events for)."""
+    from partisan_tpu import telemetry as telemetry_mod
+
+    bus = telemetry_mod.Bus()
+    counter = {"n": 0}
+    bus.attach("w", telemetry_mod.TRAFFIC_SLO_BREACH_WINDOW,
+               lambda *_a: counter.__setitem__("n", counter["n"] + 1))
+    telemetry_mod.replay_traffic_events(bus, chunks, slo_rounds=bound,
+                                        crowd_x1000=2 ** 31 - 1)
+    return counter["n"]
+
+
+def traffic_slo(scale: float = 1.0, bound: int = TRAFFIC_SLO_BOUND) -> dict:
+    """The multi-scenario SLO suite (the committed TRAFFIC_SLO.json):
+    every app model under the adversarial traffic plane with the
+    controllers ON, plus controllers-off reference arms for the A/B
+    models.  Deterministic seeds throughout — the artifact reproduces
+    bit-for-bit from ``scenarios.py --slo``.
+
+    Verdicts:
+    - per scenario: control channels' p99 within ``bound`` +
+      conservation + overlay recovery + the app's own guarantee,
+    - ``isolation``: some static arm shows the bulk channel degraded
+      past the bound while its control channels held — the ATC'19
+      head-of-line-isolation demonstration,
+    - ``wins``: adaptive bulk p99 strictly better than static on the
+      A/B models (the controller-interplay answer from PR 9)."""
+    out: dict = {"bound": bound, "scale": scale, "scenarios": {}}
+    wins = 0
+    isolation = 0
+    all_ok = True
+    for name in TRAFFIC_MODELS:
+        base_n = 64 if name in ("p2p_chat", "causal_chat") else 48
+        n = max(24, int(base_n * scale))
+        rounds = max(80, int(240 * scale))
+        entry: dict = {}
+        adaptive = traffic_scenario(name, n=n, rounds=rounds,
+                                    adaptive=True, bound=bound)
+        entry["adaptive"] = adaptive
+        ok = (adaptive["control_ok"] and adaptive["app_ok"]
+              and adaptive["breaches"] == 0
+              and adaptive.get("overlay_ok", True))
+        entry["ok"] = bool(ok)
+        all_ok = all_ok and ok
+        if name in TRAFFIC_AB_MODELS:
+            static = traffic_scenario(name, n=n, rounds=rounds,
+                                      adaptive=False, bound=bound)
+            entry["static"] = static
+            sb, ab = static["bulk_p99"], adaptive["bulk_p99"]
+            win = (sb is not None and ab is not None and ab < sb)
+            entry["win"] = bool(win)
+            wins += int(win)
+        # head-of-line isolation: some arm shows crowd windows where
+        # bulk breached while every control channel held
+        iso = max(entry.get("static", {}).get(
+            "crowd_isolation_chunks", 0),
+            adaptive["crowd_isolation_chunks"])
+        if iso > 0:
+            isolation += 1
+            entry["isolation"] = True
+        out["scenarios"][name] = entry
+    out["wins"] = wins
+    out["isolation_scenarios"] = isolation
+    out["pass"] = bool(all_ok and wins >= 2 and isolation >= 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL = {
     1: config1_anti_entropy,
@@ -1355,13 +1805,19 @@ if __name__ == "__main__":
                          "fingerprinted; with --soak)")
     ap.add_argument("--slo", type=int, nargs="?", const=4, default=None,
                     metavar="P99_ROUNDS",
-                    help="per-channel p99 SLO gate (default bound 4 "
+                    help="per-channel p99 SLO suite (default bound 4 "
                          "rounds): run the bulk-traffic overload "
-                         "scenario (config 8) as the backpressure A/B "
-                         "harness — static arm for reference, adaptive "
-                         "arm gated — print one slo verdict line per "
-                         "channel from latency.percentiles and exit "
-                         "non-zero if the closed loop breaches")
+                         "scenario (config 8, the backpressure A/B "
+                         "harness) AND the traffic-plane multi-"
+                         "scenario suite (traffic_slo: every app model "
+                         "under flash crowds / stragglers / churn / "
+                         "one-way cuts / partitions, controllers-off "
+                         "vs -on) — print per-channel and per-scenario "
+                         "verdict lines plus the TRAFFIC_SLO object, "
+                         "and exit non-zero if any gate breaches")
+    ap.add_argument("--slo-out", default=None, metavar="PATH",
+                    help="also write the traffic_slo object (the "
+                         "committed TRAFFIC_SLO.json) to PATH")
     ap.add_argument("--control-ab", action="store_true",
                     help="run the three in-scan controllers' A/B "
                          "evidence scenarios (fanout redundancy, "
@@ -1392,7 +1848,24 @@ if __name__ == "__main__":
             print(json.dumps(row), flush=True)
         print(json.dumps({"kind": "slo_verdict", "pass": ok,
                           "bound": args.slo}), flush=True)
-        raise SystemExit(0 if ok else 1)
+        # the traffic-plane multi-scenario suite (ROADMAP item 3): one
+        # verdict line per scenario, then the committed-artifact object
+        suite = traffic_slo(scale=args.scale, bound=args.slo)
+        for name, entry in suite["scenarios"].items():
+            line = {"kind": "traffic_slo_scenario", "model": name,
+                    "ok": entry["ok"],
+                    "isolation": entry.get("isolation", False)}
+            if "win" in entry:
+                line["win"] = entry["win"]
+                line["bulk_p99_static"] = entry["static"]["bulk_p99"]
+                line["bulk_p99_adaptive"] = \
+                    entry["adaptive"]["bulk_p99"]
+            print(json.dumps(line), flush=True)
+        print(json.dumps({"kind": "traffic_slo", **suite}), flush=True)
+        if args.slo_out:
+            with open(args.slo_out, "w") as f:
+                json.dump(suite, f, indent=1)
+        raise SystemExit(0 if (ok and suite["pass"]) else 1)
     if args.soak:
         print(json.dumps(config7_soak(
             n=max(64, int(DEFAULT_SIZES[7] * args.scale)),

@@ -355,6 +355,41 @@ def conservation() -> Invariant:
     return Invariant("conservation", check)
 
 
+def flow_conservation(slack: int = 0,
+                      one_sided: bool = False) -> Invariant:
+    """Conservation as a LEDGER: ``delivered + dropped - emitted``.
+
+    By the round's accounting identity (``dropped`` accumulates
+    ``n_emitted - ev_delivered``, ``delivered`` accumulates
+    ``ev_delivered + causal_delivered``) the ledger equals the
+    cumulative NET causal delivery count — exactly 0 for event-lane-
+    only configs at EVERY boundary, capacity deferrals and
+    interposition holds included (a queued record sits in ``emitted``
+    AND ``dropped`` until it lands; a held one in neither).  With
+    ``slack=0`` this is :func:`conservation` restated — and it stays
+    exact where the plain law breaks.
+
+    Causal lanes move the ledger: broadcast-causal fan-out and
+    buffered re-deliveries push it up by bounded per-app-message
+    constants (pass ``slack`` = a bound on scheduled causal app
+    messages), and the P2P lane's documented stats netting
+    (delivery.py ``inbound``: app deliveries minus pulled-out
+    arrivals) pushes it DOWN one per suppressed duplicate — unbounded
+    under retransmit storms, so p2p configs pass ``one_sided=True``
+    to drop the lower bound (inflation, the corruption signature,
+    stays gated)."""
+    def check(cluster, state):
+        s = jax.device_get(state.stats)
+        e, d, dr = int(s.emitted), int(s.delivered), int(s.dropped)
+        ledger = d + dr - e
+        ok = ledger <= slack and (one_sided or ledger >= -slack)
+        info = {"emitted": e, "delivered": d, "dropped": dr,
+                "ledger": ledger, "slack": slack,
+                "one_sided": one_sided}
+        return ok, info
+    return Invariant("flow_conservation", check)
+
+
 def digest_healthy() -> Invariant:
     """Health-digest check (requires Config.health > 0): the packed
     one-scalar digest must be valid and report ONE component — the
@@ -399,6 +434,11 @@ class SoakConfig:
     #                               treated as a degraded worker
     dump_dir: str | None = None   # invariant-breach black-box dumps
     stop_on_breach: bool = False  # abort the soak on a breach
+    poll_latency: bool = False    # per-chunk WINDOWED per-channel p99
+    #                               rows (latency plane required): the
+    #                               engine diffs cumulative histograms
+    #                               between boundaries — the SLO-window
+    #                               series replay_traffic_events reads
 
 
 @dataclasses.dataclass
@@ -437,6 +477,11 @@ class Soak:
         self._hold = None         # host-side snapshot (np leaves)
         self._hold_rnd = -1
         self._seen_breaches: set[tuple[int, str]] = set()
+        self._lat_prev = None     # last latency snapshot (poll_latency
+        #                           windows diff against it; re-anchored
+        #                           at the checkpoint's histograms on
+        #                           restore so replayed windows match
+        #                           the rows the rewind dropped)
 
     # ---- pieces -------------------------------------------------------
     def _cluster(self):
@@ -471,6 +516,17 @@ class Soak:
         if fresh_context:
             self._cl = None
         state = jax.device_put(self._hold)
+        # Re-anchor the windowed-p99 differ at the RESTORED histograms:
+        # the replayed chunks re-diff from the checkpoint exactly as the
+        # dropped rows did (a None anchor would make the first
+        # post-restore "window" cumulative since init and double-count
+        # every round the kept rows already covered).
+        if self.cfg.poll_latency and getattr(state, "latency", ()) != ():
+            from partisan_tpu import latency as latency_mod
+
+            self._lat_prev = latency_mod.snapshot(state.latency)
+        else:
+            self._lat_prev = None
         # Mid-run restores always come from the in-memory snapshot (the
         # on-disk copy, when a dir is set, is the same bytes but is only
         # read by a fresh-process resume) — the event says so honestly.
@@ -578,6 +634,14 @@ class Soak:
                 state = loaded
         if state is None:
             state = cl.init()
+        if self.cfg.poll_latency and getattr(state, "latency", ()) != ():
+            # Anchor the windowed-p99 differ at the ENTRY histograms —
+            # the first window covers the first chunk, not everything
+            # accumulated before this run (a boot phase, or the whole
+            # pre-crash history on a fresh-process resume=True).
+            from partisan_tpu import latency as latency_mod
+
+            self._lat_prev = latency_mod.snapshot(state.latency)
         r = _sync(state)
         if until_round is None:
             if rounds is None:
@@ -729,6 +793,29 @@ class Soak:
                 from partisan_tpu import control as control_mod
 
                 row["control"] = control_mod.poll(nxt_state.control)
+            if getattr(nxt_state, "traffic", ()) != ():
+                # traffic-generator operands in force (rate multiplier,
+                # churn probability, cumulative arrivals) — the series
+                # telemetry.replay_traffic_events derives flash-crowd
+                # events from
+                from partisan_tpu import workload as workload_mod
+
+                row["traffic"] = workload_mod.poll(nxt_state.traffic)
+            if self.cfg.poll_latency \
+                    and getattr(nxt_state, "latency", ()) != ():
+                # WINDOWED per-channel p99 (this chunk's deliveries
+                # only): the cumulative histograms diff at boundaries,
+                # turning the plane into the per-window SLO series
+                from partisan_tpu import latency as latency_mod
+
+                snap = latency_mod.snapshot(nxt_state.latency)
+                names = tuple(
+                    c.name for c in self._cluster().cfg.channels)
+                pct = latency_mod.percentiles(
+                    latency_mod.window_snap(self._lat_prev, snap),
+                    channels=names)
+                row["p99"] = {ch: e["p99"] for ch, e in pct.items()}
+                self._lat_prev = snap
             chunks.append(row)
             lengths.add(k)
             state, r = nxt_state, got

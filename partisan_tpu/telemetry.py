@@ -55,6 +55,13 @@ CONTROL_FANOUT_ADJUSTED = ("partisan", "control", "fanout_adjusted")
 CONTROL_SHED_CHANGED = ("partisan", "control", "shed_threshold_changed")
 CONTROL_HEALING = ("partisan", "control", "healing_escalated")
 
+# Traffic-plane events (workload.py generator + soak chunk rows ->
+# discrete events): the open-loop rate multiplier spiking into a flash
+# crowd, and windows of chunks whose per-channel windowed p99 breached
+# the SLO bound.
+TRAFFIC_FLASH_CROWD = ("partisan", "traffic", "flash_crowd")
+TRAFFIC_SLO_BREACH_WINDOW = ("partisan", "traffic", "slo_breach_window")
+
 # Soak-engine recovery events (soak.py host log -> discrete events):
 # chunk execution retried after a worker crash, state restored from a
 # checkpoint, and a per-chunk invariant breach (with its dump paths).
@@ -389,6 +396,84 @@ def replay_control_events(bus: Bus, snap: Mapping[str, Any], *,
                              "direction": "escalate"
                              if boost[i] > boost[i - 1] else "relax"})
                 n_events += 1
+    return n_events
+
+
+def replay_traffic_events(bus: Bus, chunks, *, slo_rounds: int | None = None,
+                          crowd_x1000: int | None = None) -> int:
+    """Replay a soak run's chunk rows (``soak.SoakResult.chunks`` —
+    each row optionally carrying a ``traffic`` poll and, under
+    ``SoakConfig.poll_latency``, a windowed per-channel ``p99`` dict)
+    as discrete ``partisan.traffic.*`` bus events — the traffic plane's
+    adapter to the telemetry idiom (same shape as the plane replays
+    above).
+
+    - ``flash_crowd`` — the open-loop rate multiplier crossed
+      ``crowd_x1000`` (default: 2x the first row's rate).
+      Edge-triggered: a sustained crowd is one event.
+    - ``slo_breach_window`` — one event per MAXIMAL consecutive run of
+      chunks in which some channel's windowed p99 EXCEEDED
+      ``slo_rounds`` (p99 == bound passes, matching every other SLO
+      gate; skipped when ``slo_rounds`` is None or no row carries a
+      p99 series).  Measurements carry the window's worst
+      p99 and chunk count; metadata its start round, end round and
+      worst channel — the Dapper-style "which window breached, how
+      badly" record the SLO suite commits.
+
+    Returns the number of events emitted."""
+    rows = [r for r in chunks if "traffic" in r]
+    n_events = 0
+    if rows:
+        base = int(rows[0]["traffic"].get("rate_x1000", 0))
+        thresh = crowd_x1000 if crowd_x1000 is not None \
+            else 2 * max(base, 1)
+        hot = False
+        for r in rows:
+            rate = int(r["traffic"].get("rate_x1000", 0))
+            h = rate >= thresh
+            if h and not hot:
+                bus.execute(TRAFFIC_FLASH_CROWD,
+                            {"rate_x1000": rate,
+                             "sent": int(r["traffic"].get("sent", 0))},
+                            {"round": int(r["round"])})
+                n_events += 1
+            hot = h
+    if slo_rounds is not None:
+        window: dict | None = None
+
+        def emit(w):
+            bus.execute(TRAFFIC_SLO_BREACH_WINDOW,
+                        {"worst_p99": w["worst_p99"],
+                         "chunks": w["chunks"]},
+                        {"round": w["start"], "end_round": w["end"],
+                         "channel": w["channel"],
+                         "slo_rounds": int(slo_rounds)})
+
+        for r in chunks:
+            p99 = r.get("p99") or {}
+            over = {ch: v for ch, v in p99.items()
+                    if v is not None and v > slo_rounds}
+            worst = max(over.items(), key=lambda kv: kv[1]) \
+                if over else None
+            if worst is not None:
+                end = int(r["round"]) + int(r.get("k", 0))
+                if window is None:
+                    window = {"start": int(r["round"]), "end": end,
+                              "channel": worst[0],
+                              "worst_p99": int(worst[1]), "chunks": 1}
+                else:
+                    window["chunks"] += 1
+                    window["end"] = end
+                    if worst[1] > window["worst_p99"]:
+                        window["channel"] = worst[0]
+                        window["worst_p99"] = int(worst[1])
+            elif window is not None:
+                emit(window)
+                n_events += 1
+                window = None
+        if window is not None:
+            emit(window)
+            n_events += 1
     return n_events
 
 

@@ -1,0 +1,417 @@
+"""Production traffic plane: a deterministic, device-resident,
+OPEN-LOOP workload generator plus the declarative ``Traffic`` timeline
+that scripts it (ROADMAP item 3).
+
+Partisan's ATC'19 motivation (PAPERS.md) is that bulk application
+traffic must not head-of-line-block the membership/control planes —
+yet every scenario in this repo was bootstrap+converge shaped until
+this module: the backpressure/fanout/healing controllers (control.py)
+and the latency plane's per-channel p99 (latency.py) had never been
+exercised under sustained adversarial load.  This module is that load:
+
+**Open-loop arrivals, in-scan.**  ``generate`` runs inside
+``cluster.round_body`` (under the ``round.traffic`` named_scope, after
+the manager/model emission assembly) and offers ``rate`` messages per
+node per round REGARDLESS of what the cluster absorbs — the
+coordinated-omission-free stance of production load harnesses: a
+saturated cluster shows up as queueing age in the latency plane, never
+as a silently throttled workload.  Every draw comes from the
+counter-based fault hash keyed on (seed, round, node, slot)
+(faults.edge_hash — the replay-determinism discipline), so the arrival
+stream is a pure function of the config: it replays bit-for-bit across
+chunked scans, checkpoint resume mid-storm, and shardings.
+
+**Heavy-tailed shape.**  Burst sizes are bounded-Zipf: emission slot
+``k`` of ``burst_max`` fires with probability ``rate · w_k`` where
+``w_k ∝ (k+1)^-zipf_s`` (normalized), so per-(node, round) arrival
+counts are heavy-tailed up to the static slot bound.  Destinations
+draw from a hot-spot law: a uniform ``u`` squared ``hot_skew`` times
+concentrates traffic onto low ids (at ``hot_skew=2``, a 64-node
+cluster sends ~1/3 of all bulk traffic to node 0) — the popularity
+skew that actually saturates per-edge channel lanes and exposes
+head-of-line behavior.  Under ``Config.width_operand`` destinations
+are bounded by the dynamic ``n_active`` operand, preserving the
+prefix-dynamics contract.
+
+**The ``Traffic`` timeline.**  Dynamic intensity (``rate_x1000``, and
+an optional in-scan churn probability) rides in the
+``ClusterState.traffic`` carry leaf; the actions below (``SetRate``,
+``SetChurn``, ``DirectedCut``, ``Stragglers``) mutate it at absolute
+rounds THROUGH ``soak.Storm`` — traffic composes with the fault storm
+as one timeline under one scheduler, so the soak engine's
+checkpoint/resume boundary protocol replays traffic and faults
+together, exactly.  ``flash_crowd`` / ``diurnal`` / ``diurnal_churn``
+build the standard shapes as event tuples ready to splice into a
+Storm.
+
+**Zero cost when off** (the planes' discipline, ARCHITECTURE.md):
+``Config(traffic=TrafficConfig(enabled=False))`` — the default —
+keeps the carry leaf an empty ``()`` and no op under a
+``round.traffic`` scope (lint zero-cost rule, traffic matrix entries
+in partisan_tpu/lint/matrix.py); the plain round's pinned cost budget
+(lint/cost_budgets.py) is unchanged.  Replicated under sharding: the
+state is a reduced scalar + ring, identical on every shard
+(parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+from partisan_tpu.ops import msg as msg_ops
+
+# Hash-site salts (the faults.py discipline: one static salt per call
+# site; slot indices fold into the src stream id, bounded by the
+# config validation burst_max <= 64).
+_ARRIVAL_SALT = 7101
+_DST_SALT = 7301
+_CHURN_DEATH_SALT = 7501
+_CHURN_BIRTH_SALT = 7502
+
+# Payload word P0 of every generated record: a recognizable op id far
+# from any app model's opcode space (paxos/commit/alsberg use 30-34),
+# so bulk arrivals are inert "opaque bytes" to every protocol that
+# shares the inbox.
+TRAFFIC_OP = 90
+
+
+class TrafficState(NamedTuple):
+    """The traffic plane's carry (all replicated — every value is a
+    reduced scalar or a ring of reduced scalars)."""
+
+    rate_x1000: Array   # int32 — ABSOLUTE arrival rate in thousandths
+    #                     of a message/node/round (initialized from
+    #                     TrafficConfig.rate_x1000; SetRate replaces it
+    #                     outright — not a multiplier of the base)
+    churn_x1e6: Array   # int32 — per-round churn probability ×1e6
+    #                     (0 = still; requires TrafficConfig.churn to
+    #                     have compiled the stage)
+    sent: Array         # int32 — cumulative arrivals (cluster-wide)
+    rnd_ring: Array     # int32[R] — ring of round labels (-1 = empty)
+    arr_ring: Array     # int32[R] — arrivals per recorded round
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.traffic.enabled
+
+
+def init(cfg: Config) -> TrafficState:
+    t = cfg.traffic
+    return TrafficState(
+        rate_x1000=jnp.int32(t.rate_x1000),
+        churn_x1e6=jnp.int32(0),
+        sent=jnp.int32(0),
+        rnd_ring=jnp.full((t.ring,), -1, jnp.int32),
+        arr_ring=jnp.zeros((t.ring,), jnp.int32),
+    )
+
+
+def slot_weights(cfg: Config) -> tuple[float, ...]:
+    """Static bounded-Zipf slot weights: ``w_k ∝ (k+1)^-zipf_s``,
+    normalized to sum 1 so the expected burst equals the rate (until
+    per-slot probabilities saturate at 1 under flash-crowd rates —
+    bursts are bounded by ``burst_max`` by construction)."""
+    t = cfg.traffic
+    raw = [(k + 1) ** -t.zipf_s for k in range(t.burst_max)]
+    h = sum(raw)
+    return tuple(r / h for r in raw)
+
+
+def churn(cfg: Config, ts: TrafficState, faults: faults_mod.FaultState,
+          rnd: Array, n_active) -> faults_mod.FaultState:
+    """One in-scan diurnal-churn tick: each node dies/revives with the
+    carried ``churn_x1e6`` probability — ``faults.churn_step``'s
+    birth/death process moved inside the scan so diurnal ramps are a
+    handful of ``SetChurn`` boundary actions, not one storm event per
+    round (which would force chunk size 1).  Distinct hash sites from
+    the host-side churn engine, so the two compose without stream
+    collisions.  Restricted to the active prefix under
+    ``Config.width_operand`` (inert rows keep their init liveness —
+    the prefix-dynamics contract)."""
+    p = ts.churn_x1e6.astype(jnp.float32) / jnp.float32(1e6)
+    n = faults.alive.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    die = faults_mod.hash_bernoulli(
+        faults_mod.edge_hash(cfg.seed, rnd, _CHURN_DEATH_SALT, ids, ids), p)
+    born = faults_mod.hash_bernoulli(
+        faults_mod.edge_hash(cfg.seed, rnd, _CHURN_BIRTH_SALT, ids, ids), p)
+    alive = jnp.where(faults.alive, ~die, born)
+    if not isinstance(n_active, tuple):
+        alive = jnp.where(ids < n_active, alive, faults.alive)
+    return faults._replace(alive=alive)
+
+
+def generate(cfg: Config, comm, ts: TrafficState, ctx):
+    """One round of open-loop arrivals: returns ``(state', emitted)``
+    with ``emitted`` a fresh ``[n_local, burst_max]`` APP emission
+    block (plane-major under ``Config.plane_major``, like every model
+    emission) for ``round_body``'s single assembly concatenate.
+    Crashed/inactive rows (``ctx.alive`` False) emit nothing."""
+    t = cfg.traffic
+    gids = comm.local_ids()
+    n = comm.n_local
+    B = t.burst_max
+    ch = cfg.channel_id(t.channel)
+    rate = ts.rate_x1000.astype(jnp.float32) / jnp.float32(1000)
+    wvec = jnp.asarray(slot_weights(cfg), jnp.float32)       # [B]
+    ks = jnp.arange(B, dtype=jnp.int32)
+    sid = gids[:, None] * 64 + ks[None, :]    # distinct stream per slot
+
+    h_arr = faults_mod.edge_hash(cfg.seed, ctx.rnd, _ARRIVAL_SALT,
+                                 sid, gids[:, None])
+    fire = faults_mod.hash_bernoulli(h_arr, rate * wvec[None, :]) \
+        & ctx.alive[:, None]
+
+    # Destination: hot-spot law over the ACTIVE id space.  The width
+    # comes from the n_active operand (not cfg.n_nodes) so a
+    # width-operand run at n_active=w draws the same destinations as a
+    # native n_nodes=w run — the prefix-dynamics contract.
+    h_dst = faults_mod.edge_hash(cfg.seed, ctx.rnd, _DST_SALT,
+                                 sid, gids[:, None])
+    u = (h_dst >> 8).astype(jnp.float32) / jnp.float32(2 ** 24)
+    for _ in range(t.hot_skew):
+        u = u * u
+    wd = (jnp.int32(cfg.n_nodes) if isinstance(ctx.n_active, tuple)
+          else ctx.n_active)
+    d = jnp.minimum((u * wd.astype(jnp.float32)).astype(jnp.int32),
+                    wd - 1)
+    # no self-sends: bump onto the next active id (wrapping)
+    bump = jnp.where(d + 1 >= wd, 0, d + 1)
+    d = jnp.where(d == gids[:, None], bump, d)
+    dst = jnp.where(fire, d, -1)
+
+    emitted = msg_ops.build(
+        cfg, T.MsgKind.APP, gids[:, None], dst, channel=ch,
+        payload=(jnp.full((n, B), TRAFFIC_OP, jnp.int32),))
+
+    n_arr = comm.allsum(jnp.sum(fire, dtype=jnp.int32))
+    slot = jnp.mod(ctx.rnd, t.ring)
+    return TrafficState(
+        rate_x1000=ts.rate_x1000,
+        churn_x1e6=ts.churn_x1e6,
+        sent=ts.sent + n_arr,
+        rnd_ring=ts.rnd_ring.at[slot].set(ctx.rnd),
+        arr_ring=ts.arr_ring.at[slot].set(n_arr)), emitted
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers (the planes' poll/snapshot idiom)
+# ---------------------------------------------------------------------------
+
+def poll(ts: TrafficState) -> dict:
+    """Tiny host summary of the generator's current operands (a few
+    scalar transfers — what soak chunk rows carry)."""
+    import jax
+
+    return {"rate_x1000": int(jax.device_get(ts.rate_x1000)),
+            "churn_x1e6": int(jax.device_get(ts.churn_x1e6)),
+            "sent": int(jax.device_get(ts.sent))}
+
+
+def snapshot(ts: TrafficState) -> dict:
+    """Decode the arrival ring (one device->host transfer), ordered by
+    round via the shared ``metrics.ring_order``."""
+    import jax
+    import numpy as np
+
+    from partisan_tpu.metrics import ring_order
+
+    host = jax.device_get(ts)
+    rnd = np.asarray(host.rnd_ring)
+    idx = ring_order(rnd)
+    return {"rounds": rnd[idx], "arrivals": np.asarray(host.arr_ring)[idx],
+            "sent": int(host.sent), "rate_x1000": int(host.rate_x1000)}
+
+
+# ---------------------------------------------------------------------------
+# Timeline actions (duck-typed soak.Action: pure ``apply(cluster,
+# state, rnd) -> state`` transforms keyed by absolute round — the
+# resume-correctness obligation is the Storm's, documented there)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SetRate:
+    """Set the open-loop arrival rate OUTRIGHT, in thousandths of a
+    message per node per round — the same absolute scale as
+    ``TrafficConfig.rate_x1000``, which it replaces (not a multiplier
+    of it): ``SetRate(2000)`` means 2 msgs/node/round regardless of
+    the configured base.  Flash crowds are a high SetRate and one
+    restoring the base; see :func:`flash_crowd`."""
+
+    x1000: int
+
+    def apply(self, cluster, state, rnd):
+        if state.traffic == ():
+            raise ValueError(
+                "SetRate needs the traffic plane on — "
+                "Config(traffic=TrafficConfig(enabled=True))")
+        return state._replace(traffic=state.traffic._replace(
+            rate_x1000=jnp.int32(self.x1000)))
+
+
+@dataclasses.dataclass(frozen=True)
+class SetChurn:
+    """Set the in-scan churn probability (millionths/round).  The
+    cluster must have compiled the stage (TrafficConfig.churn=True) —
+    scripting churn into a program without it would silently do
+    nothing, so it raises instead."""
+
+    x1e6: int
+
+    def apply(self, cluster, state, rnd):
+        if state.traffic == ():
+            raise ValueError(
+                "SetChurn needs the traffic plane on — "
+                "Config(traffic=TrafficConfig(enabled=True))")
+        if not cluster.cfg.traffic.churn:
+            raise ValueError(
+                "SetChurn needs the in-scan churn stage compiled — "
+                "Config(traffic=TrafficConfig(churn=True))")
+        return state._replace(traffic=state.traffic._replace(
+            churn_x1e6=jnp.int32(self.x1e6)))
+
+
+@dataclasses.dataclass(frozen=True)
+class DirectedCut:
+    """Sever edges ONE WAY (src group -> dst group) — the asymmetric
+    link fault (a router advertising routes it won't carry).  Dense
+    partition mode only; see ``faults.inject_directed_cut``.  Heal
+    with the storm's ordinary ``soak.Heal`` (resolve_partition clears
+    directed cuts too — the matrix is one fault surface)."""
+
+    src: tuple[int, ...]
+    dst: tuple[int, ...]
+
+    def apply(self, cluster, state, rnd):
+        return state._replace(faults=faults_mod.inject_directed_cut(
+            state.faults, list(self.src), list(self.dst)))
+
+
+@dataclasses.dataclass(frozen=True)
+class Stragglers:
+    """Mark nodes as slow: every message they emit is held ``mult``
+    rounds on the send path (0 clears).  The cluster must be built
+    with an ``interpose.StragglerDelay`` — bare, inside an
+    ``interpose.Chain`` (a lone StragglerDelay in the chain is found
+    automatically: the egress/ingress config delay keys wrap a bare
+    stage into a Chain behind the caller's back), or at an explicit
+    chain ``index`` — whose per-node multiplier this action scatters
+    into."""
+
+    nodes: tuple[int, ...]
+    mult: int
+    index: Any = None
+
+    def apply(self, cluster, state, rnd):
+        from partisan_tpu import interpose as interpose_mod
+
+        ip, ist = cluster.interpose, state.interpose
+        idx = self.index
+        if isinstance(ip, interpose_mod.Chain):
+            if idx is None:
+                hits = [i for i, item in enumerate(ip.items)
+                        if isinstance(item,
+                                      interpose_mod.StragglerDelay)]
+                if len(hits) == 1:
+                    idx = hits[0]
+                elif len(hits) > 1:
+                    raise ValueError(
+                        f"the interposition Chain holds StragglerDelay "
+                        f"stages at indices {hits} — pass Stragglers("
+                        f"index=...) to pick one")
+        elif idx is not None:
+            raise ValueError(
+                f"Stragglers(index={idx}) but the cluster's "
+                f"interposition is not a Chain (got "
+                f"{type(ip).__name__}) — drop the index")
+        if idx is not None:
+            ip = ip.items[idx]
+            sub = ist[idx]
+        else:
+            sub = ist
+        if not isinstance(ip, interpose_mod.StragglerDelay):
+            at = f" at Chain index {idx}" if idx is not None else ""
+            raise ValueError(
+                "Stragglers needs the Cluster built with an "
+                f"interpose.StragglerDelay (got {type(ip).__name__}"
+                f"{at})")
+        mult = sub["mult"].at[jnp.asarray(self.nodes, jnp.int32)].set(
+            jnp.int32(self.mult))
+        new_sub = dict(sub)
+        new_sub["mult"] = mult
+        if idx is not None:
+            ist = tuple(new_sub if i == idx else s
+                        for i, s in enumerate(ist))
+        else:
+            ist = new_sub
+        return state._replace(interpose=ist)
+
+
+# ---------------------------------------------------------------------------
+# Timeline builders
+# ---------------------------------------------------------------------------
+
+def flash_crowd(off: int, rounds: int, x1000: int,
+                base_x1000: int) -> tuple:
+    """A flash crowd: rate jumps to ``x1000`` at storm offset ``off``
+    and restores to ``base_x1000`` after ``rounds``."""
+    return ((off, SetRate(x1000)), (off + rounds, SetRate(base_x1000)))
+
+
+def _staircase(period: int, steps: int, make_action) -> tuple:
+    """A triangle wave across ``period`` rounds as ``2·steps + 1``
+    events: the rising and falling steps plus a CLOSING base-level
+    event, so a ONE-SHOT splice (a period-0 storm) does not strand the
+    elevated level past the cycle's end.  The closing offset clamps to
+    ``period - 1`` (a repeating storm needs offsets inside the period;
+    its next cycle's first event re-asserts the base one round later,
+    idempotently).  Staircase, not per-round: boundary actions every
+    round would force the soak's chunks to length 1."""
+    events = []
+    for i in range(2 * steps + 1):
+        tri = i / steps if i <= steps else (2 * steps - i) / steps
+        off = min(period * i // (2 * steps), period - 1)
+        events.append((off, make_action(min(tri, 1.0))))
+    return tuple(events)
+
+
+def diurnal(period: int, lo_x1000: int, hi_x1000: int,
+            steps: int = 4) -> tuple:
+    """A diurnal rate cycle (triangle staircase, :func:`_staircase`)
+    between ``lo_x1000`` and ``hi_x1000`` — splice into a Storm with
+    ``period`` so it repeats."""
+    return _staircase(period, steps, lambda tri: SetRate(
+        int(round(lo_x1000 + (hi_x1000 - lo_x1000) * tri))))
+
+
+def diurnal_churn(period: int, hi_x1e6: int, steps: int = 4) -> tuple:
+    """A diurnal churn ramp (same staircase shape, SetChurn actions):
+    membership churn that peaks mid-period and stills at the ends."""
+    return _staircase(period, steps, lambda tri: SetChurn(
+        int(round(hi_x1e6 * tri))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Traffic:
+    """A declarative traffic timeline: ``events = ((offset, action),
+    ...)`` — the workload-side half of a soak storm.  It deliberately
+    has no scheduler of its own: :meth:`storm` merges the events (plus
+    any fault-side ``extra``) into ONE ``soak.Storm``, so the soak
+    engine's absolute-round boundary protocol replays traffic and
+    faults together, bit for bit."""
+
+    events: tuple
+
+    def storm(self, start: int = 0, period: int = 0, extra=()):
+        from partisan_tpu import soak as soak_mod
+
+        merged = tuple(sorted(tuple(self.events) + tuple(extra),
+                              key=lambda e: e[0]))
+        return soak_mod.Storm(events=merged, start=start, period=period)

@@ -304,3 +304,40 @@ def test_plane_parity_base_wire_generic_path():
                            egress_delay_ms=1_000)
 
     plane_parity_case(mk, label="base_generic")
+
+
+def test_directed_cut_characterization():
+    """inject_directed_cut severs exactly the src->dst direction
+    (dense mode): forward messages die on the wire, the reverse
+    direction and unrelated edges flow, resolve_partition heals, and
+    groups mode raises loudly (a single packed per-node label cannot
+    express a direction — the fast-wire parity contract stays
+    untouched because the fast path requires groups mode)."""
+    import numpy as np
+    import pytest
+
+    f = faults_mod.none(8, "dense")
+    f = faults_mod.inject_directed_cut(f, [1, 2], [5, 6])
+    src = jnp.asarray([1, 2, 5, 6, 1, 3])
+    dst = jnp.asarray([5, 6, 1, 2, 3, 5])
+    cut = faults_mod.edge_cut(f, src, dst, seed=0, rnd=jnp.int32(4),
+                              salt=9)
+    #       1->5  2->6  5->1  6->2  1->3  3->5
+    assert np.asarray(cut).tolist() == [True, True, False, False,
+                                        False, False]
+    # filter_msgs drops exactly the forward direction
+    import partisan_tpu.types as T
+    from partisan_tpu.ops import msg as msg_ops
+
+    em = msg_ops.build(12, T.MsgKind.APP,
+                       jnp.asarray([[1], [5]]), jnp.asarray([[5], [1]]))
+    out = faults_mod.filter_msgs(f, em, seed=0, rnd=jnp.int32(4),
+                                 salt=9)
+    assert int(out[0, 0, T.W_KIND]) == 0       # 1->5 cut
+    assert int(out[1, 0, T.W_KIND]) != 0       # 5->1 flows
+    # heal clears the directed cut with everything else
+    healed = faults_mod.resolve_partition(f)
+    assert not bool(np.asarray(healed.partition).any())
+    with pytest.raises(ValueError, match="dense"):
+        faults_mod.inject_directed_cut(faults_mod.none(8, "groups"),
+                                       [1], [2])

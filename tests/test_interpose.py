@@ -123,3 +123,56 @@ def test_omission_schedule_expires():
     assert int(st.rnd) > 3
     st = cl.steps(st, 10)
     assert _coverage(model, st) == 1.0
+
+
+def test_straggler_delay_per_node_mult():
+    """StragglerDelay (the traffic plane's slow-node stage): mult=0
+    nodes pass straight through; a straggler's mail arrives exactly
+    mult rounds late, with its origin intact."""
+    cl, model, st = _booted(interpose.StragglerDelay(cap=8))
+    # mark the broadcaster slow by 3 rounds
+    st = st._replace(interpose={
+        **st.interpose,
+        "mult": st.interpose["mult"].at[0].set(3)})
+    r0 = int(st.rnd)
+    st = cl.steps(st, 3)
+    assert _coverage(model, st) == 1.0 / N   # still held
+    st = cl.steps(st, 3)
+    assert _coverage(model, st) == 1.0       # released + delivered
+    assert int(st.interpose["missed"]) == 0
+    # a fast node's broadcast in the same run is NOT delayed
+    st = st._replace(model=model.broadcast(st.model, 1, 1))
+    st = cl.steps(st, 2)
+    assert float(model.coverage(st.model, st.faults.alive, 1)) == 1.0
+    del r0
+
+
+def test_straggler_workload_action_sets_and_clears():
+    """workload.Stragglers scripts the per-node multiplier mid-run
+    (bare stage and Chain-indexed), and validates the stage exists."""
+    import pytest
+
+    from partisan_tpu import workload as W
+
+    cl, model, st = _booted(interpose.StragglerDelay(cap=8))
+    st = W.Stragglers(nodes=(2, 3), mult=4).apply(cl, st, 0)
+    assert np.asarray(st.interpose["mult"])[[2, 3]].tolist() == [4, 4]
+    st = W.Stragglers(nodes=(2,), mult=0).apply(cl, st, 0)
+    assert np.asarray(st.interpose["mult"])[[2, 3]].tolist() == [0, 4]
+    # an explicit index against a bare (non-Chain) stage fails loudly
+    with pytest.raises(ValueError, match="not a Chain"):
+        W.Stragglers(nodes=(2,), mult=1, index=0).apply(cl, st, 0)
+
+    chain = interpose.Chain([interpose.StragglerDelay(cap=4),
+                             interpose.Drop(lambda c, x, e: jnp.zeros(
+                                 e[..., T.W_KIND].shape, bool))])
+    cl2, _m, st2 = _booted(chain)
+    st2 = W.Stragglers(nodes=(1,), mult=2, index=0).apply(cl2, st2, 0)
+    assert int(np.asarray(st2.interpose[0]["mult"])[1]) == 2
+    # a lone StragglerDelay inside a Chain is found WITHOUT an index —
+    # the egress/ingress config delay keys wrap a bare stage into a
+    # Chain behind the caller's back, and the action must still land
+    st2 = W.Stragglers(nodes=(2,), mult=3).apply(cl2, st2, 0)
+    assert int(np.asarray(st2.interpose[0]["mult"])[2]) == 3
+    with pytest.raises(ValueError, match="StragglerDelay"):
+        W.Stragglers(nodes=(1,), mult=2, index=1).apply(cl2, st2, 0)

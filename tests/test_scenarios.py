@@ -105,3 +105,21 @@ def test_config7_soak_smoke():
     assert r["breaches"] == 0, r
     assert r["retries"] == 0, r
     assert r["components"] >= 1
+
+
+def test_traffic_scenario_smoke():
+    """The traffic-plane SLO harness end to end at CPU-smoke scale:
+    one app model (paxos — the cheapest fullmesh build) under the full
+    adversarial timeline with the backpressure controller on.  The
+    gates the committed TRAFFIC_SLO.json carries must hold: control
+    channels within the bound, conservation clean, the app's own
+    guarantee intact, and the flash crowd visibly priced on the bulk
+    channel."""
+    r = scenarios.traffic_scenario("paxos", n=24, rounds=80,
+                                   adaptive=True)
+    assert r["breaches"] == 0, r
+    assert r["control_ok"], r
+    assert r["app_ok"], r["app"]
+    assert r["delivered"][scenarios.BULK_CHANNEL] > 0
+    assert r["traffic"]["sent"] > 0
+    assert r["crowd_chunks"] > 0

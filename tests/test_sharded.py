@@ -431,3 +431,40 @@ def test_sharded_plane_vs_legacy_layout(mesh8):
         return cl.steps(st, 10)
 
     assert_states_bitidentical(run(True), run(False), "sharded_layouts")
+
+
+def test_traffic_plane_sharded_parity(mesh8):
+    """The open-loop traffic generator under sharding: the arrival
+    stream is a pure function of (seed, round, node) and its state a
+    reduced scalar + ring, so the sharded run must evolve
+    bit-identically to the single-device one — traffic leaf included
+    (this also guards ShardedCluster.init()'s traffic leaf: a missing
+    one crashes at trace time)."""
+    import numpy as np
+
+    from partisan_tpu import workload as workload_mod
+    from partisan_tpu.config import TrafficConfig
+    from partisan_tpu.models.plumtree import Plumtree
+
+    cfg = Config(n_nodes=16, seed=27, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 traffic=TrafficConfig(enabled=True, rate_x1000=900,
+                                       hot_skew=1, ring=16))
+
+    def run(make):
+        cl = make()
+        st = cl.init()
+        m = cl.manager.join_many(cfg, st.manager, list(range(1, 16)),
+                                 [0] * 15)
+        st = cl.steps(st._replace(manager=m), 24)
+        return jax.device_get(st)
+
+    st_l = run(lambda: Cluster(cfg, model=Plumtree()))
+    st_s = run(lambda: ShardedCluster(cfg, mesh8, model=Plumtree()))
+    assert workload_mod.poll(st_l.traffic) \
+        == workload_mod.poll(st_s.traffic)
+    assert np.array_equal(np.asarray(st_l.traffic.arr_ring),
+                          np.asarray(st_s.traffic.arr_ring))
+    assert int(st_l.stats.delivered) == int(st_s.stats.delivered)
+    assert int(st_l.stats.dropped) == int(st_s.stats.dropped)
+    assert bool(jnp.all(st_l.manager.active == st_s.manager.active))

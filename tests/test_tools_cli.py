@@ -41,7 +41,10 @@ def test_profile_phases_cost_smoke():
     per-phase JSON lines plus a summary, and --budgets judges the
     pinned lint budgets (exit 1 on over/stale, 0 when clean — and the
     committed budgets MUST be clean)."""
-    out = _run("profile_phases.py", "--cost", "--budgets", "256")
+    from support import COST_SMOKE_N
+
+    out = _run("profile_phases.py", "--cost", "--budgets",
+               str(COST_SMOKE_N))
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     rows = [json.loads(ln) for ln in out.stdout.splitlines()
             if ln.startswith("{")]
@@ -261,3 +264,23 @@ def test_tools_cli_completeness():
         stdout, stderr = p.communicate(timeout=120)
         assert p.returncode == 0, (tool, stderr[-2000:])
         assert stdout.strip(), f"{tool} --help printed nothing"
+
+
+def test_soak_report_traffic_smoke():
+    """--traffic: the open-loop generator rides the soak — chunk rows
+    carry the generator operands and a windowed per-channel p99, and
+    the scripted flash crowd replays as a partisan.traffic.flash_crowd
+    event alongside the soak events."""
+    out = _run("soak_report.py", "32", "40", "--chunk", "10",
+               "--traffic")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    chunks = [r for r in rows if r["kind"] == "chunk"]
+    assert chunks and all("traffic" in c for c in chunks)
+    assert all("p99" in c for c in chunks)
+    rates = [c["traffic"]["rate_x1000"] for c in chunks]
+    assert max(rates) >= 8 * min(rates), rates   # the crowd fired
+    assert chunks[-1]["traffic"]["sent"] > 0
+    events = [tuple(r["event"]) for r in rows if r["kind"] == "event"]
+    assert ("partisan", "traffic", "flash_crowd") in events
+    assert rows[-1]["kind"] == "summary"

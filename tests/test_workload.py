@@ -1,0 +1,355 @@
+"""Traffic-plane tests (partisan_tpu/workload.py): deterministic
+open-loop arrivals, heavy-tailed shape, timeline actions through the
+soak storm, zero cost when off, and the crash-replay acceptance gate —
+a >=2000-round soak with traffic + storm surviving an injected worker
+crash and replaying the arrival stream bit-for-bit from checkpoint."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import faults as faults_mod
+from partisan_tpu import soak
+from partisan_tpu import workload as W
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, TrafficConfig
+from partisan_tpu.managers.base import RoundCtx
+from partisan_tpu.ops import plane as plane_ops
+
+from support import assert_states_bitidentical
+
+
+def _cfg(n=24, **kw):
+    kw.setdefault("traffic", TrafficConfig(enabled=True, rate_x1000=800,
+                                           ring=32))
+    kw.setdefault("partition_mode", "groups")
+    return Config(n_nodes=n, seed=3, peer_service_manager="hyparview",
+                  msg_words=16, **kw)
+
+
+def _ctx(cl, rnd=5, n_active=()):
+    n = cl.cfg.n_nodes
+    return RoundCtx(rnd=jnp.int32(rnd), alive=jnp.ones((n,), jnp.bool_),
+                    keys=None, inbox=None,
+                    faults=faults_mod.none(n, "groups"),
+                    n_active=n_active, control=())
+
+
+def _gen(cl, rnd=5, n_active=()):
+    ts, emitted = W.generate(cl.cfg, cl.comm, W.init(cl.cfg),
+                             _ctx(cl, rnd, n_active))
+    return ts, np.asarray(jax.device_get(plane_ops.interleave(emitted)))
+
+
+# ---------------------------------------------------------------------------
+# The generator
+# ---------------------------------------------------------------------------
+
+def test_arrivals_deterministic_and_rate_shaped():
+    """Same config => bit-identical arrival stream; the mean arrival
+    count tracks the configured rate; bursts stay within burst_max."""
+    cl = Cluster(_cfg())
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager, list(range(1, 24)),
+                             [0] * 23)
+    st = cl.steps(st._replace(manager=m), 30)
+    snap = W.snapshot(st.traffic)
+    # a second, independently built cluster replays the identical stream
+    cl2 = Cluster(_cfg())
+    st2 = cl2.steps(cl2.init()._replace(manager=m), 30)
+    snap2 = W.snapshot(st2.traffic)
+    assert np.array_equal(snap["arrivals"], snap2["arrivals"])
+    assert snap["sent"] == snap2["sent"] > 0
+    # open-loop rate: 24 nodes x 0.8/round ~= 19; allow wide tolerance
+    mean = float(snap["arrivals"][snap["rounds"] >= 0].mean())
+    assert 0.5 * 19.2 <= mean <= 1.5 * 19.2, mean
+    # conservation through the normal wire stages
+    s = jax.device_get(st.stats)
+    assert int(s.emitted) == int(s.delivered) + int(s.dropped)
+
+
+def test_burst_bound_and_channel():
+    """Every generated record is APP on the configured channel with an
+    in-range destination; per-node bursts never exceed burst_max."""
+    from partisan_tpu import types as T
+
+    cl = Cluster(_cfg(traffic=TrafficConfig(
+        enabled=True, rate_x1000=5000, burst_max=3, ring=8)))
+    _ts, rec = _gen(cl)
+    kind = rec[..., T.W_KIND]
+    live = kind != 0
+    assert rec.shape[1] == 3                     # burst_max slots
+    assert live.any()
+    assert (kind[live] == int(T.MsgKind.APP)).all()
+    assert (rec[..., T.W_CHANNEL][live]
+            == cl.cfg.channel_id(cl.cfg.traffic.channel)).all()
+    dst = rec[..., T.W_DST][live]
+    assert (0 <= dst).all() and (dst < cl.cfg.n_nodes).all()
+    # no self-sends
+    src = rec[..., T.W_SRC][live]
+    assert (src != dst).all()
+
+
+def test_hot_skew_concentrates_destinations():
+    """hot_skew squares the destination draw toward low ids: the hot
+    eighth of the id space receives a clearly super-uniform share."""
+    from partisan_tpu import types as T
+
+    def share(hot_skew):
+        cl = Cluster(_cfg(n=64, traffic=TrafficConfig(
+            enabled=True, rate_x1000=4000, burst_max=4,
+            hot_skew=hot_skew, ring=8)))
+        dsts = []
+        for rnd in range(1, 30):
+            _ts, rec = _gen(cl, rnd=rnd)
+            live = rec[..., T.W_KIND] != 0
+            dsts.append(rec[..., T.W_DST][live])
+        d = np.concatenate(dsts)
+        return float((d < 8).mean())
+
+    uniform = share(0)
+    hot = share(2)
+    assert uniform < 0.25, uniform      # ~1/8 under the uniform draw
+    assert hot > 2 * uniform, (hot, uniform)
+
+
+def test_width_operand_prefix_parity():
+    """Arrivals on an n_active=w prefix match a native n_nodes=w run
+    bit-for-bit (rows [0, w)): the draws key off the operand, and
+    inert rows stay silent."""
+    w = 16
+    cl_wide = Cluster(_cfg(n=32, width_operand=True))
+    cl_nat = Cluster(_cfg(n=w))
+    ctx_w = _ctx(cl_wide, rnd=7, n_active=jnp.int32(w))
+    # inert rows read dead through ctx.alive, like round_body masks them
+    ctx_w = ctx_w._replace(
+        alive=ctx_w.alive & (jnp.arange(32) < w))
+    _, em_w = W.generate(cl_wide.cfg, cl_wide.comm,
+                         W.init(cl_wide.cfg), ctx_w)
+    _, em_n = W.generate(cl_nat.cfg, cl_nat.comm,
+                         W.init(cl_nat.cfg), _ctx(cl_nat, rnd=7))
+    rw = np.asarray(jax.device_get(plane_ops.interleave(em_w)))
+    rn = np.asarray(jax.device_get(plane_ops.interleave(em_n)))
+    assert np.array_equal(rw[:w], rn)
+    assert (rw[w:, :, 0] == 0).all()    # inert rows emit nothing
+
+
+def test_traffic_off_zero_cost_and_scan_lint():
+    """Off (the default): the carry leaf is () — and the traced scan
+    with traffic ON stays lint-clean (no-host-callback, zero-cost keys
+    for the OTHER planes, narrow dtypes, scatter overlap)."""
+    from support import assert_scan_lint_clean
+
+    cl_off = Cluster(Config(n_nodes=16, seed=3, msg_words=16,
+                            peer_service_manager="hyparview",
+                            partition_mode="groups"))
+    assert cl_off.init().traffic == ()
+    cl_on = Cluster(_cfg(n=16))
+    assert_scan_lint_clean(cl_on, cl_on.init(), k=4)
+
+
+# ---------------------------------------------------------------------------
+# Timeline actions
+# ---------------------------------------------------------------------------
+
+def test_actions_validate_prerequisites():
+    cl_off = Cluster(Config(n_nodes=8, seed=1))
+    st = cl_off.init()
+    with pytest.raises(ValueError, match="traffic plane"):
+        W.SetRate(2000).apply(cl_off, st, 0)
+    cl_nochurn = Cluster(_cfg(n=8))
+    st2 = cl_nochurn.init()
+    with pytest.raises(ValueError, match="churn stage"):
+        W.SetChurn(1000).apply(cl_nochurn, st2, 0)
+    with pytest.raises(ValueError, match="StragglerDelay"):
+        W.Stragglers(nodes=(1,), mult=2).apply(cl_nochurn, st2, 0)
+
+
+def test_timeline_composes_with_storm_actions():
+    """flash_crowd + diurnal + diurnal_churn build sorted event tuples
+    that merge with fault actions into ONE soak.Storm."""
+    ev = W.flash_crowd(10, 20, 3000, 500)
+    assert [off for off, _ in ev] == [10, 30]
+    di = W.diurnal(80, 200, 1000, steps=2)
+    # the wave CLOSES at the base level (a one-shot splice must not
+    # strand the elevated rate; the closing offset clamps inside the
+    # period so repeating storms stay valid)
+    assert [off for off, _ in di] == [0, 20, 40, 60, 79]
+    assert [a.x1000 for _, a in di] == [200, 600, 1000, 600, 200]
+    dc = W.diurnal_churn(80, 8000, steps=2)
+    assert isinstance(dc[0][1], W.SetChurn)
+    assert dc[-1][1].x1e6 == 0 and dc[-1][0] < 80
+    storm = W.Traffic(ev).storm(
+        start=5, extra=((0, soak.LinkDrop(0.1)),))
+    assert [a.__class__.__name__ for a in storm.due(5)] == ["LinkDrop"]
+    assert [a.__class__.__name__ for a in storm.due(15)] == ["SetRate"]
+
+
+def test_directed_cut_action_one_way():
+    cl = Cluster(_cfg(n=8, partition_mode="dense"))
+    st = cl.init()
+    st = W.DirectedCut(src=(1, 2), dst=(5,)).apply(cl, st, 0)
+    cut_fwd = faults_mod.edge_cut(st.faults, jnp.asarray([1]),
+                                  jnp.asarray([5]), 0, jnp.int32(0), 1)
+    cut_rev = faults_mod.edge_cut(st.faults, jnp.asarray([5]),
+                                  jnp.asarray([1]), 0, jnp.int32(0), 1)
+    assert bool(cut_fwd[0]) and not bool(cut_rev[0])
+    healed = soak.Heal().apply(cl, st, 0)
+    assert not bool(np.asarray(healed.faults.partition).any())
+
+
+def test_in_scan_churn_rate_rides_the_carry():
+    """SetChurn arms the in-scan birth/death stage; churn_x1e6=0 (the
+    init value) leaves liveness bit-identical to a churn-compiled run
+    that never arms it."""
+    cfg = _cfg(n=24, traffic=TrafficConfig(enabled=True, rate_x1000=500,
+                                           churn=True, ring=16))
+    cl = Cluster(cfg)
+    st0 = cl.init()
+    quiet = cl.steps(st0, 20)
+    assert bool(np.asarray(quiet.faults.alive).all())
+    armed = W.SetChurn(50_000).apply(cl, st0, 0)    # 5%/round
+    churned = cl.steps(armed, 20)
+    alive = int(np.asarray(churned.faults.alive).sum())
+    assert alive < 24, "5%/round churn over 20 rounds killed nobody"
+
+
+# ---------------------------------------------------------------------------
+# The acceptance gate: long soak + storm + crash, bit-exact replay
+# ---------------------------------------------------------------------------
+
+def test_2000_round_traffic_soak_survives_crash_bitexact(tmp_path):
+    """A >=2000-round soak under a repeating traffic+fault storm
+    (periodic flash crowds, diurnal churn ramps, link-drop pulses)
+    survives an injected worker crash mid-horizon — retry, fresh
+    context, checkpoint restore — and the final state (arrival stream
+    included) is bit-identical to the unchunked reference
+    composition."""
+    rounds = 2000
+    cfg = _cfg(n=32, traffic=TrafficConfig(
+        enabled=True, rate_x1000=400, churn=True, hot_skew=1, ring=64))
+
+    def mk():
+        return Cluster(cfg)
+
+    cl = mk()
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager, list(range(1, 32)),
+                             [0] * 31)
+    st = cl.steps(st._replace(manager=m), 20)
+    r0 = int(jax.device_get(st.rnd))
+    period = 400
+    # Every offset is a multiple of 100 so both the chunked run and
+    # the unchunked reference execute ONE scan length — the test's
+    # wall cost is runtime, not a compile per storm gap.  (The churn
+    # window is hand-rolled for that alignment; the diurnal_churn
+    # builder's shape is unit-tested above.)
+    storm = W.Traffic(
+        W.flash_crowd(100, 100, 2500, 400)
+        + ((100, W.SetChurn(6000)), (300, W.SetChurn(0)))
+        + ((200, soak.LinkDrop(0.1)), (300, soak.Heal()))
+    ).storm(start=r0, period=period)
+
+    crash_round = r0 + 1000
+    fired = {"done": False}
+
+    def step(c, s, k):
+        r = int(jax.device_get(s.rnd))
+        if not fired["done"] and r + k > crash_round:
+            fired["done"] = True
+            raise jax.errors.JaxRuntimeError("injected worker crash")
+        return c.steps(s, k)
+
+    eng = soak.Soak(
+        make_cluster=mk, storm=storm, step_fn=step,
+        invariants=[soak.conservation()],
+        cfg=soak.SoakConfig(chunk_fixed=200,
+                            checkpoint_dir=str(tmp_path),
+                            cooldown_s=0.0),
+        sleep_fn=lambda s: None)
+    res = eng.run(st, rounds=rounds)
+    assert res.rounds == rounds
+    assert res.retries == 1 and fired["done"]
+    assert res.breaches == 0
+
+    ref = soak.reference_run(mk(), st, r0 + rounds, storm=storm)
+    assert_states_bitidentical(res.state, ref, "traffic_soak_vs_ref")
+    assert W.poll(res.state.traffic) == W.poll(ref.traffic)
+    assert W.poll(res.state.traffic)["sent"] > 0
+
+def test_windowed_p99_reanchors_at_restore(tmp_path):
+    """poll_latency windows after a crash-retry rewind diff from the
+    CHECKPOINT's histograms, not from init: the replayed rows must
+    equal an undisturbed run's rows (a None anchor would make the
+    first post-restore window cumulative and double-count everything
+    the kept rows already covered)."""
+    cfg = _cfg(n=24, latency=True)
+
+    def mk():
+        return Cluster(cfg)
+
+    cl = mk()
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager, list(range(1, 24)),
+                             [0] * 23)
+    st = cl.steps(st._replace(manager=m), 10)
+
+    def run(crash_at):
+        fired = {"done": False}
+
+        def step(c, s, k):
+            r = int(jax.device_get(s.rnd))
+            if crash_at is not None and not fired["done"] \
+                    and r + k > crash_at:
+                fired["done"] = True
+                raise jax.errors.JaxRuntimeError("injected crash")
+            return c.steps(s, k)
+
+        eng = soak.Soak(
+            make_cluster=mk, step_fn=step,
+            cfg=soak.SoakConfig(chunk_fixed=10, cooldown_s=0.0,
+                                checkpoint_dir=str(tmp_path),
+                                poll_latency=True),
+            sleep_fn=lambda s: None)
+        return eng.run(st, rounds=60)
+
+    r0 = int(jax.device_get(st.rnd))
+    clean = run(None)
+    crashed = run(r0 + 35)
+    assert crashed.retries == 1
+    assert [c["p99"] for c in crashed.chunks] \
+        == [c["p99"] for c in clean.chunks]
+
+
+def test_replay_traffic_events_windows():
+    """telemetry.replay_traffic_events: edge-triggered flash crowds and
+    maximal consecutive breach windows from synthetic chunk rows."""
+    from partisan_tpu import telemetry
+
+    rows = [
+        {"round": 0, "k": 10, "traffic": {"rate_x1000": 500, "sent": 1},
+         "p99": {"bulk": 1, "default": 1}},
+        {"round": 10, "k": 10, "traffic": {"rate_x1000": 4000, "sent": 2},
+         "p99": {"bulk": 6, "default": 1}},
+        {"round": 20, "k": 10, "traffic": {"rate_x1000": 4000, "sent": 3},
+         "p99": {"bulk": 9, "default": 2}},
+        {"round": 30, "k": 10, "traffic": {"rate_x1000": 500, "sent": 4},
+         "p99": {"bulk": 2, "default": 1}},
+        {"round": 40, "k": 10, "traffic": {"rate_x1000": 500, "sent": 5},
+         "p99": {"bulk": 7, "default": 1}},
+    ]
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "traffic"), rec)
+    n = telemetry.replay_traffic_events(bus, rows, slo_rounds=4)
+    kinds = [e[0] for e in rec.events]
+    assert n == 3
+    assert kinds.count(telemetry.TRAFFIC_FLASH_CROWD) == 1
+    windows = [e for e in rec.events
+               if e[0] == telemetry.TRAFFIC_SLO_BREACH_WINDOW]
+    assert len(windows) == 2
+    first = windows[0]
+    assert first[1]["worst_p99"] == 9 and first[1]["chunks"] == 2
+    assert first[2]["round"] == 10 and first[2]["end_round"] == 30
+    assert first[2]["channel"] == "bulk"

@@ -10,7 +10,8 @@ its dump paths), the replayed ``partisan.soak.*`` bus events, and a
 trailing summary::
 
     python tools/soak_report.py [n] [rounds] [--chunk K] [--crash-at R]
-                                [--breach] [--control] [--ckpt-dir DIR]
+                                [--breach] [--control] [--traffic]
+                                [--ckpt-dir DIR]
 
 ``--crash-at R`` injects a ``JaxRuntimeError`` into the first chunk
 dispatch that would cross R rounds into the soak — off-TPU proof of
@@ -23,7 +24,14 @@ fanout governor, channel backpressure, healing escalation) ride the
 soak with their prerequisite planes, every chunk row carries the
 operands in force (``control``: eager cap / pressure / boost), and the
 replayed ``partisan.control.*`` decision events print alongside the
-soak events.  Importable: ``report(result)`` renders any
+soak events.  ``--traffic`` turns on the open-loop workload generator
+(workload.py) with a mid-run flash crowd scripted through the same
+storm: every chunk row carries the generator's operands (``traffic``:
+rate / churn / cumulative arrivals) plus a WINDOWED per-channel p99
+(``p99``, the latency plane's cumulative histograms diffed at chunk
+boundaries), and the replayed ``partisan.traffic.*`` events
+(``flash_crowd``, ``slo_breach_window``) print alongside the soak
+events.  Importable: ``report(result)`` renders any
 ``soak.SoakResult``.
 """
 
@@ -37,10 +45,12 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def report(res, out=sys.stdout, channels=None) -> dict:
+def report(res, out=sys.stdout, channels=None, slo_rounds=None) -> dict:
     """Dump a ``soak.SoakResult`` as JSON lines; returns (and prints as
     the last line) the summary dict.  ``channels`` optionally names the
-    config's channels so controller shed events carry real labels."""
+    config's channels so controller shed events carry real labels;
+    ``slo_rounds`` arms the traffic replay's breach-window events when
+    chunk rows carry the windowed p99 series."""
     from partisan_tpu import telemetry
 
     for row in res.chunks:
@@ -51,6 +61,12 @@ def report(res, out=sys.stdout, channels=None) -> dict:
     bus = telemetry.Bus()
     bus.attach("report", ("partisan", "soak"), rec)
     telemetry.replay_soak_events(bus, res.log)
+    if any("traffic" in row for row in res.chunks):
+        # traffic-plane events (flash_crowd / slo_breach_window),
+        # replayed from the chunk rows' operand + windowed-p99 series
+        bus.attach("traffic", ("partisan", "traffic"), rec)
+        telemetry.replay_traffic_events(bus, res.chunks,
+                                        slo_rounds=slo_rounds)
     if getattr(res.state, "control", ()) != ():
         # controller decision events (fanout_adjusted /
         # shed_threshold_changed / healing_escalated), replayed from
@@ -73,7 +89,7 @@ def report(res, out=sys.stdout, channels=None) -> dict:
 
 
 USAGE = ("usage: soak_report.py [n] [rounds] [--chunk K] [--crash-at R] "
-         "[--breach] [--ckpt-dir DIR]")
+         "[--breach] [--control] [--traffic] [--ckpt-dir DIR]")
 
 
 def main() -> None:
@@ -98,7 +114,7 @@ def main() -> None:
     # flag value never leaks into the positional [n, rounds] slots.
     VALUE_FLAGS = ("--chunk", "--crash-at", "--ckpt-dir")
     argv = sys.argv[1:]
-    args, opts, breach, control = [], {}, False, False
+    args, opts, breach, control, traffic = [], {}, False, False, False
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -113,6 +129,9 @@ def main() -> None:
         elif a == "--control":
             control = True
             i += 1
+        elif a == "--traffic":
+            traffic = True
+            i += 1
         elif a.startswith("--"):
             raise SystemExit(f"unknown flag {a}\n{USAGE}")
         else:
@@ -124,7 +143,9 @@ def main() -> None:
     crash_at = opts.get("--crash-at")
     ckpt_dir = opts.get("--ckpt-dir")
 
-    from partisan_tpu.config import ControlConfig
+    from partisan_tpu.config import ControlConfig, TrafficConfig
+
+    TRAFFIC_BASE = 400     # base rate ×1000; flash crowd = 8x it
 
     ctl = {}
     if control:
@@ -134,6 +155,15 @@ def main() -> None:
                    control=ControlConfig(fanout=True, backpressure=True,
                                          healing=True,
                                          ring=max(64, rounds)))
+    if traffic:
+        # the open-loop generator + the latency plane its windowed-p99
+        # rows read (flash crowd scripted through the storm below);
+        # composes with --control, which already set latency=True
+        ctl.setdefault("latency", True)
+        ctl["traffic"] = TrafficConfig(enabled=True,
+                                       rate_x1000=TRAFFIC_BASE,
+                                       hot_skew=1,
+                                       ring=max(64, rounds))
 
     def mk():
         return Cluster(Config(
@@ -162,7 +192,16 @@ def main() -> None:
         # Hold a split across the tail so the armed one-component
         # invariant breaches at the following chunk boundaries.
         events.append((3 * q, soak.Partition()))
-    storm = soak.Storm(events=tuple(events), start=start)
+    if traffic:
+        # A flash crowd through the SAME storm: 8x the base rate for a
+        # quarter of the soak — the timeline composition the traffic
+        # plane is built around (workload.Traffic docs).
+        from partisan_tpu import workload
+
+        events.extend(workload.flash_crowd(q, q, 8 * TRAFFIC_BASE,
+                                           TRAFFIC_BASE))
+    storm = soak.Storm(events=tuple(sorted(events, key=lambda e: e[0])),
+                       start=start)
 
     step_fn = None
     if crash_at is not None:
@@ -190,10 +229,12 @@ def main() -> None:
         storm=storm, step_fn=step_fn,
         invariants=[soak.conservation(), soak.digest_healthy()],
         cfg=soak.SoakConfig(chunk_fixed=chunk, checkpoint_dir=ckpt_dir,
-                            cooldown_s=0.0, dump_dir=dump_dir),
+                            cooldown_s=0.0, dump_dir=dump_dir,
+                            poll_latency=traffic),
         sleep_fn=lambda s: None)
     res = eng.run(st, rounds=rounds)
-    report(res, channels=tuple(c.name for c in cl.cfg.channels))
+    report(res, channels=tuple(c.name for c in cl.cfg.channels),
+           slo_rounds=4 if traffic else None)
 
 
 if __name__ == "__main__":
