@@ -57,7 +57,6 @@ PER_SIZE_CAP_S = 340.0         # no single rung may eat the whole budget
 def run(n: int, verbose: bool = False, metrics: bool = False,
         latency: bool = False, health: bool = False,
         provenance: bool = False) -> dict:
-    from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config, HyParViewConfig, \
         PlumtreeConfig
     from partisan_tpu.models.plumtree import Plumtree
@@ -136,12 +135,20 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
 
     cfg = make_cfg(n)
     model = Plumtree()
-    cl = Cluster(cfg, model=model, donate=True)
+    # Sharded-by-default (ROADMAP item 2): n >= scenarios.SHARDED_N_MIN
+    # on a multi-device backend runs the node-sharded SPMD round over
+    # every chip; below it (or single-device) the single-chip Cluster —
+    # so the 32k comparability anchor is untouched and the 100k/1M
+    # rungs flip wherever a mesh exists.
+    from partisan_tpu.scenarios import make_cluster_auto
+
+    cl = make_cluster_auto(cfg, model=model, donate=True)
 
     def make_cluster(width):
         if width == n:
             return cl
-        return Cluster(make_cfg(width), model=model, donate=True)
+        return make_cluster_auto(make_cfg(width), model=model,
+                                 donate=True)
 
     # Every per-check host call must be ONE jitted dispatch: on the
     # relay-attached device each eager op is a host round-trip (~0.5 s),
@@ -546,6 +553,61 @@ def _cost_card(budget_s: float) -> dict:
         return {"verdict": "SKIP", "reason": repr(exc)[:200]}
 
 
+def _memory_card(budget_s: float) -> dict:
+    """Fold the per-device MEMORY census (bench.py --dry-1m: the
+    1M-node sharded round's carry residency by plane on an 8-way host
+    mesh, judged against the pinned cost_budgets.DRY_1M budget) into
+    the artifact, so every bench records the HBM footprint next to the
+    wall numbers — the sharded-by-default flip's readiness gate as a
+    measured series.  CPU-only subprocess (eval_shape + make_jaxpr, no
+    device buffers): the relay is never touched."""
+    import subprocess
+
+    if budget_s < 20:
+        return {"verdict": "SKIP", "reason": "bench budget exhausted"}
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--dry-1m"],
+            capture_output=True, text=True, env=env,
+            timeout=max(20.0, min(120.0, budget_s)))
+        last = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        out = json.loads(last)
+        return {k: out[k] for k in
+                ("verdict", "n", "devices", "state_mib_per_device",
+                 "budget_mib_per_device", "interm_mib_per_device",
+                 "replicated_node_axis") if k in out}
+    except Exception as exc:  # census failure must never sink the bench
+        return {"verdict": "SKIP", "reason": repr(exc)[:200]}
+
+
+def dry_1m(argv) -> None:
+    """``bench.py --dry-1m [n]``: the 1M-node readiness check.  Forces
+    the 8-virtual-device CPU platform (the census needs a real mesh but
+    zero device memory — everything is eval_shape/make_jaxpr), censuses
+    the sharded round program at n (default 1M), prints ONE JSON line
+    with per-device resident bytes by plane vs the pinned budget plus
+    the replicated-node-axis audit, and exits non-zero on FAIL."""
+    from partisan_tpu.hostmesh import force_host_devices
+
+    force_host_devices()
+    jax.config.update("jax_platforms", "cpu")
+    try:  # drop the image's axon PJRT plugin (conftest discipline)
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    sizes = [a for a in argv if not a.startswith("--")]
+    n = int(sizes[0]) if sizes else 1_000_000
+    from partisan_tpu.lint import cost as cost_mod
+
+    card = cost_mod.dry_1m_report(n)
+    print(json.dumps(card))
+    raise SystemExit(0 if card["verdict"] == "PASS" else 1)
+
+
 def main() -> None:
     # Ladder: the HEADLINE size runs FIRST with the full per-size cap —
     # its warm median-of-N is the artifact's core; its cold run comes
@@ -632,6 +694,7 @@ def main() -> None:
         "pallas_probe": _pallas_verdict(remaining()),
         "jaxlint": _lint_verdict(remaining()),
         "cost": _cost_card(remaining()),
+        "memory": _memory_card(remaining()),
         "metric": (f"simulated gossip rounds/sec "
                    f"({top['n']}-node hyparview+plumtree)"),
         "value": warm["rounds_per_sec"]["median"],
@@ -656,7 +719,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+    if "--dry-1m" in sys.argv:
+        # 1M-node readiness: abstract census on the 8-way host mesh —
+        # no TPU, no compile, ~2 s.  Must run before any backend use.
+        dry_1m([a for a in sys.argv[1:] if a != "--dry-1m"])
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--one":
         if "--cache-dir" in sys.argv:
             # cold-start knob: point THIS run at a caller-chosen
             # compilation-cache dir (fresh temp dir = cold: the round

@@ -67,6 +67,12 @@ class LocalComm:
         the metrics plane's high-water-mark reduction)."""
         return x
 
+    def allmin(self, x: Array) -> Array:
+        """Min of a per-shard value across all shards (identity here).
+        Elementwise on arrays — the health plane's segment-local FastSV
+        reduces its per-shard label proposals through this."""
+        return x
+
     def actor_gather(self, x: Array, a: int) -> Array:
         """Rows of ``x`` for global nodes 0..a-1 (the causal actor
         space), visible to every shard.  Requires a <= n_local so the

@@ -14,10 +14,17 @@ watching the graph they travel on, under the same discipline
 - **statically shaped** — every ``Config.health`` rounds (the snapshot
   cadence; 0 = off) the round body computes one topology snapshot and
   writes it into a ring of ``Config.health_ring`` slots,
-- **replicated under sharding** — the snapshot is computed from the
-  all-gathered global neighbor table, so every shard derives the SAME
-  values (parallel/sharded.py replicates the health leaves like the
-  metrics ring),
+- **replicated under sharding** — the snapshot's VALUES are identical
+  on every shard, but (since the sharded-by-default overlay flip) they
+  are computed SEGMENT-LOCALLY: each shard works on its own
+  ``[n_local, cap]`` neighbor rows and the shards exchange only label
+  VECTORS per iteration (the halo — see :func:`component_count_sharded`)
+  plus scalar/histogram reductions.  The old formulation all-gathered
+  the whole ``[n_global, cap]`` neighbor table onto every shard — the
+  first O(n·cap) replicated tensor that cannot fit at 1M nodes
+  (ROADMAP item 2); no kernel here may materialize a full-node-axis
+  rank-2 tensor (the jaxlint ``replicated-node-axis`` rule gates this,
+  partisan_tpu/lint/rules.py),
 - **free when disabled** — ``Config.health=0`` (the default) keeps the
   ClusterState leaf an empty ``()`` pytree: no arrays, no ops, and the
   round trace is bit-identical to pre-health behavior.
@@ -216,6 +223,140 @@ def out_degrees(nbrs: Array, alive: Array,
                    dtype=jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Segment-local kernels (sharded-by-default path): each shard touches
+# only its own [n_local, K] neighbor rows; cross-shard state is label /
+# alive VECTORS (O(n_global) words) and scalar reductions — never a
+# replicated [n_global, K] matrix.  With LocalComm every collective is
+# the identity, so single-device and sharded runs share ONE code path
+# and are bit-identical by construction (min/max reductions commute).
+# ---------------------------------------------------------------------------
+
+def live_edges_local(nbrs_local: Array, alive_local: Array,
+                     alive_global: Array, gids: Array,
+                     partition: Array | None = None) -> Array:
+    """bool[n_local, K]: :func:`live_edges` for one shard's rows.
+    ``gids`` are the rows' global ids; ``alive_global`` is the
+    replicated global mask (a vector — remote endpoints are read from
+    it, never from a gathered per-node matrix)."""
+    n = alive_global.shape[0]
+    nc = jnp.clip(nbrs_local, 0, n - 1)
+    live = (nbrs_local >= 0) & alive_local[:, None] & alive_global[nc]
+    if partition is not None and getattr(partition, "ndim", 0) > 0:
+        if partition.ndim == 2:
+            live = live & ~partition[gids[:, None], nc]
+        else:
+            live = live & (partition[gids][:, None] == partition[nc])
+    return live
+
+
+def component_count_sharded(nbrs_local: Array, alive_global: Array,
+                            comm, partition: Array | None = None
+                            ) -> tuple[Array, Array]:
+    """Segment-local FastSV with halo exchange: the sharded form of
+    :func:`component_count`, bit-identical to it by construction.
+
+    Each shard carries labels only for its OWN rows (``f_l`` int32
+    [n_local]) and pointer-jumps over its local ``[n_local, K]`` edges.
+    Per iteration the shards exchange exactly two label vectors:
+
+    - the **halo gather** — ``comm.gather_vec(f_l)`` assembles the
+      global label vector so local edges can read the labels of the
+      remote neighbors they reference (every boundary label, O(n)
+      int32 words — vs the O(n·K) neighbor matrix the gathered
+      formulation replicated),
+    - the **halo reduce** — each shard scatter-mins its hook proposals
+      for REMOTE nodes (a tree may hook onto a grandparent owned by
+      another shard) into a full-range proposal vector, met elementwise
+      across shards by ``comm.allmin`` and sliced back to the local
+      range.
+
+    min is commutative and associative, so decomposing the gathered
+    update into local-shortcut + cross-shard-proposal parts changes
+    nothing: after every iteration the concatenated ``f_l`` equals the
+    gathered version's ``f`` exactly — which is what makes the health
+    digest bit-identical between single-chip and sharded runs
+    (tests/test_sharded_health.py gates this against the BFS oracle).
+
+    Returns ``(labels int32[n_local], count int32)``; the count is
+    allsum-reduced (replicated)."""
+    n = alive_global.shape[0]
+    n_local, K = nbrs_local.shape
+    gids = comm.local_ids()
+    alive_l = jax.lax.dynamic_slice(alive_global, (comm.node_offset,),
+                                    (comm.n_local,))
+    if K == 0 or n == 1:
+        return gids, comm.allsum(jnp.sum(alive_l, dtype=jnp.int32))
+    nc = jnp.clip(nbrs_local, 0, n - 1)
+    live = live_edges_local(nbrs_local, alive_l, alive_global, gids,
+                            partition)
+    # per-edge endpoint target slots; index n = out-of-range: dropped
+    tgt_v = jnp.where(live, nc, n).reshape(-1)
+
+    def body(_, f_l):
+        f_g = comm.gather_vec(f_l)                  # [n] — the halo
+        g_g = f_g[f_g]                              # grandparents [n]
+        g_l = jax.lax.dynamic_slice(g_g, (comm.node_offset,),
+                                    (comm.n_local,))
+        m = jnp.minimum(f_l, g_l)                   # shortcut
+        gv = jnp.where(live, g_g[nc], n)            # nbr grandparents
+        gb = jnp.broadcast_to(g_l[:, None], live.shape)
+        # aggressive hooking, local side
+        m = jnp.minimum(m, jnp.min(gv, axis=1))
+        # hook proposals for (possibly remote) targets: endpoint,
+        # my parent, their parent — same three scatters as the
+        # gathered body, landing in a full-range proposal vector
+        prop = jnp.full((n,), n, jnp.int32)
+        prop = prop.at[tgt_v].min(gb.reshape(-1), mode="drop")
+        fu = jnp.where(live, jnp.broadcast_to(f_l[:, None], live.shape),
+                       n).reshape(-1)
+        prop = prop.at[fu].min(gv.reshape(-1), mode="drop")
+        fv = jnp.where(live, f_g[nc], n).reshape(-1)
+        prop = prop.at[fv].min(gb.reshape(-1), mode="drop")
+        prop = comm.allmin(prop)                    # the halo reduce
+        return jnp.minimum(m, jax.lax.dynamic_slice(
+            prop, (comm.node_offset,), (comm.n_local,)))
+
+    iters = int(math.ceil(math.log2(max(n, 2)))) + 4
+    lbl = jax.lax.fori_loop(0, iters, body, gids)
+    count = comm.allsum(jnp.sum((lbl == gids) & alive_l,
+                                dtype=jnp.int32))
+    return lbl, count
+
+
+def symmetry_violations_sharded(nbrs_local: Array, alive_global: Array,
+                                comm,
+                                partition: Array | None = None) -> Array:
+    """Sharded :func:`symmetry_violations`: live directed edges i->j
+    with no j->i entry in j's view.  The back-edge check needs REMOTE
+    rows, but never a whole remote table: one neighbor-table COLUMN at
+    a time is exchanged as a global [n] vector (K bounded halo reads
+    per snapshot), and each shard compares only its own [n_local, K]
+    edges against it — O(n·K) exchanged words and O(n_local·K²) local
+    work, no [n_global, K] tensor anywhere.  Allsum-reduced
+    (replicated)."""
+    n = alive_global.shape[0]
+    n_local, K = nbrs_local.shape
+    if K == 0:
+        return comm.allsum(jnp.int32(0))
+    gids = comm.local_ids()
+    alive_l = jax.lax.dynamic_slice(alive_global, (comm.node_offset,),
+                                    (comm.n_local,))
+    nc = jnp.clip(nbrs_local, 0, n - 1)
+    live = live_edges_local(nbrs_local, alive_l, alive_global, gids,
+                            partition)
+    me = gids[:, None]
+
+    def slot(s, has):
+        col = comm.gather_vec(jax.lax.dynamic_slice_in_dim(
+            nbrs_local, s, 1, axis=1)[:, 0])            # [n] column s
+        return has | (col[nc] == me)
+
+    has_back = jax.lax.fori_loop(
+        0, K, slot, jnp.zeros((n_local, K), jnp.bool_))
+    return comm.allsum(jnp.sum(live & ~has_back, dtype=jnp.int32))
+
+
 # Above this many [n, K, K] elements the symmetry check runs slot-wise
 # (O(n·K) memory per step instead of one O(n·K²) gather): partial-view
 # overlays (hyparview K ~ 6 at 100k = 4.9M) take the one-shot; wide
@@ -350,9 +491,13 @@ def record_snapshot(cfg: Config, comm, hs: HealthState, *, rnd: Array,
     """Compute one topology snapshot and write it into the ring.
 
     ``nbrs_local`` is this shard's neighbor rows ([n_local, K], global
-    ids); it is all-gathered here so every shard derives identical
-    (replicated) values from the identical global graph — the health
-    analogue of the metrics plane's allsum-before-write discipline.
+    ids); every graph kernel runs SEGMENT-LOCALLY over them — the
+    cross-shard state is label/alive VECTORS (the FastSV halo) and
+    allsum/allmin/allmax reductions, never a gathered [n_global, K]
+    table — so each shard derives identical (replicated) ring values
+    at O(n_local·K + n_global) resident words.  This is the health
+    analogue of the metrics plane's allsum-before-write discipline,
+    and the kernel the 1M-node budget (``bench.py --dry-1m``) keys on.
     ``alive_global`` arrives pre-masked by the active prefix under
     ``Config.width_operand`` (round_body passes the wire-stage alive),
     so snapshots match a native-width run's.  ``cov_ok`` is the
@@ -360,19 +505,27 @@ def record_snapshot(cfg: Config, comm, hs: HealthState, *, rnd: Array,
     model (True when no model carries a coverage notion).  Runs behind
     a ``lax.cond`` in round_body — non-snapshot rounds pay nothing."""
     R = cfg.health_ring
-    nbrs = comm.gather_vec(nbrs_local)              # [n_global, K]
     alive = alive_global
+    gids = comm.local_ids()
+    alive_l = jax.lax.dynamic_slice(alive, (comm.node_offset,),
+                                    (comm.n_local,))
 
-    _, comps = component_count(nbrs, alive, partition)
-    deg = out_degrees(nbrs, alive, partition)
-    n_alive = jnp.sum(alive, dtype=jnp.int32)
-    iso = jnp.sum(alive & (deg == 0), dtype=jnp.int32)
-    hist = degree_histogram(deg, alive)
+    _, comps = component_count_sharded(nbrs_local, alive, comm,
+                                       partition)
+    live_l = live_edges_local(nbrs_local, alive_l, alive, gids,
+                              partition)
+    deg_l = jnp.sum(live_l, axis=1, dtype=jnp.int32)   # [n_local]
+    n_alive = comm.allsum(jnp.sum(alive_l, dtype=jnp.int32))
+    iso = comm.allsum(jnp.sum(alive_l & (deg_l == 0), dtype=jnp.int32))
+    hist = comm.allsum(degree_histogram(deg_l, alive_l))
     # min over ALIVE nodes only; an all-dead overlay reports 0/0
     dmin = jnp.where(n_alive > 0,
-                     jnp.min(jnp.where(alive, deg, _BIG)), jnp.int32(0))
-    dmax = jnp.max(jnp.where(alive, deg, 0))
-    sym = symmetry_violations(nbrs, alive, partition)
+                     comm.allmin(jnp.min(jnp.where(alive_l, deg_l,
+                                                   _BIG))),
+                     jnp.int32(0))
+    dmax = comm.allmax(jnp.max(jnp.where(alive_l, deg_l, 0)))
+    sym = symmetry_violations_sharded(nbrs_local, alive, comm,
+                                      partition)
 
     # Churn = diffs BETWEEN snapshots; the FIRST snapshot has no
     # predecessor window, so it only establishes the baseline (zero
@@ -380,7 +533,9 @@ def record_snapshot(cfg: Config, comm, hs: HealthState, *, rnd: Array,
     # spurious ups/joins against the zero-initialized reference
     # vectors (and fire a bogus churn bus event on a fault-free run).
     first = (hs.digest & DIGEST_VALID) == 0
-    conn = alive & (deg > 0)
+    # connectivity vector: segment-local degrees, gathered back to the
+    # replicated [n] reference vector the churn windows diff against
+    conn = comm.gather_vec(alive_l & (deg_l > 0))
 
     def window(prev, now):
         return jnp.where(

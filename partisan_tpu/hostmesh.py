@@ -1,0 +1,34 @@
+"""The 8-virtual-device CPU host platform, in one place.
+
+Sharded (shard_map) programs need a multi-device mesh even for purely
+abstract work — the lint matrix's sharded entries, the per-device
+memory census, ``bench.py --dry-1m`` — and on CPU that mesh comes from
+XLA's forced host-device count.  Every entry point that traces them
+(tests/conftest.py, tools/jaxlint.py, tools/profile_phases.py,
+bench.py's dry-run) calls :func:`force_host_devices` instead of
+carrying its own copy of the flag-append, so the pinned count cannot
+drift between harnesses.
+
+Import-light on purpose (no jax): the flag is read when the first
+backend initializes (the first ``jax.devices()``), so calling this any
+time before that — even after ``import jax`` — takes effect.
+"""
+
+from __future__ import annotations
+
+import os
+
+# The pinned harness width: 8 shards matches the MULTICHIP_r0x meshes
+# and divides every audited width (32 matrix nodes ... 1M dry-run).
+HOST_DEVICE_COUNT = 8
+
+
+def force_host_devices(count: int = HOST_DEVICE_COUNT) -> None:
+    """Append ``--xla_force_host_platform_device_count`` to XLA_FLAGS
+    unless the caller's environment already pins one (an explicit
+    operator choice wins)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={count}"
+        ).strip()

@@ -197,6 +197,181 @@ def census_program(prog: Program) -> Census:
 # The 32k-config reference program (the bench round)
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# Per-device memory budget meter (the sharded-by-default flip's gate:
+# ROADMAP item 2's "budget HBM per device").  Everything here is
+# abstract — jax.eval_shape state + jax.make_jaxpr programs under the
+# real mesh specs, no device buffer ever allocated — so the 1M-node
+# census runs tier-1, CPU-only, in seconds.
+# ---------------------------------------------------------------------------
+
+def _spec_shard_factor(spec, n_shards: int) -> int:
+    """How many ways a leaf is split under its PartitionSpec on the
+    1-D ``nodes`` mesh: every dim entry naming a mesh axis divides the
+    per-device residency by the mesh size; P() (replicated) divides by
+    nothing."""
+    factor = 1
+    for entry in tuple(spec):
+        if entry is not None:
+            factor *= n_shards
+    return factor
+
+
+def state_memory_rows(state, specs, n_shards: int) -> list[dict]:
+    """Per-PLANE per-device resident bytes of a (possibly abstract)
+    ClusterState under the sharding specs — one row per top-level
+    carry field, heaviest first, plus a trailing total row.  This is
+    the HBM the scan carry pins for the whole run; round intermediates
+    ride on top (see :func:`device_memory_census`)."""
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec
+
+    rows = []
+    total = 0
+    for field in state._fields:
+        leaves = jtu.tree_leaves(getattr(state, field))
+        spec_leaves = jtu.tree_leaves(
+            getattr(specs, field),
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        if not leaves:
+            continue
+        if len(leaves) != len(spec_leaves):
+            raise ValueError(
+                f"state/spec leaf mismatch under {field!r}: "
+                f"{len(leaves)} vs {len(spec_leaves)} (the sharding-"
+                f"spec-completeness rule should have caught this)")
+        b = 0
+        for leaf, spec in zip(leaves, spec_leaves):
+            b += _nbytes(leaf) // _spec_shard_factor(spec, n_shards)
+        rows.append({"plane": field, "mib_per_device":
+                     round(b / 2**20, 3)})
+        total += b
+    rows.sort(key=lambda r: -r["mib_per_device"])
+    rows.append({"plane": "total",
+                 "mib_per_device": round(total / 2**20, 3)})
+    return rows
+
+
+def resident_memory_rows(state) -> list[dict]:
+    """Single-device form of :func:`state_memory_rows` (everything
+    resident on the one device) — what tools/soak_report.py stamps on
+    every soak so the artifact carries its HBM footprint."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    specs = jax.tree.map(lambda _: PartitionSpec(), state)
+    return state_memory_rows(state, specs, 1)
+
+
+def _shard_map_inner(closed_jaxpr):
+    """(inner_jaxpr, n_shards) of the first shard_map equation in a
+    traced program (None, 0 when absent)."""
+    import jax.extend.core as jex_core
+
+    from partisan_tpu.lint.core import iter_eqns
+    from partisan_tpu.lint.rules import _mesh_shards
+
+    for eqn in iter_eqns(closed_jaxpr):
+        if eqn.primitive.name == "shard_map":
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (tuple, list)) else (v,)
+                for x in vals:
+                    if isinstance(x, (jex_core.Jaxpr,
+                                      jex_core.ClosedJaxpr)):
+                        return x, _mesh_shards(eqn)
+    return None, 0
+
+
+def dry_run_cfg(n: int = 1_000_000):
+    """The 1M-readiness config: bench.py's capacity knobs (hyparview +
+    plumtree, inbox 16, emit_compact 32, width operand) plus the
+    health plane ON (the segment-local FastSV is exactly what the
+    budget prices) and the scalable destination-sharded exchange."""
+    from partisan_tpu.config import Config, HyParViewConfig, \
+        PlumtreeConfig
+
+    return Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                  msg_words=16, partition_mode="groups",
+                  max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                  timer_stagger=False, width_operand=True,
+                  health=10, health_ring=64,
+                  sharded_exchange="all_to_all",
+                  hyparview=HyParViewConfig(isolation_window_ms=25_000),
+                  plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+
+
+def device_memory_census(cfg, model=None, n_devices: int = 8) -> dict:
+    """The per-device memory card: census one SHARDED round program
+    under the real mesh specs — carry-state residency by plane (what
+    the scan pins in HBM for the whole run), the round's materialized
+    [n_local, ·, ·] intermediate volume (the transient working set a
+    fused backend could avoid), and the replicated-node-axis audit
+    (unwaived findings = an O(n) regression shipped).  All abstract:
+    eval_shape + make_jaxpr, no device buffers."""
+    from partisan_tpu import lint
+    from partisan_tpu.lint import matrix as matrix_mod
+    from partisan_tpu.lint import waivers as waivers_mod
+    from partisan_tpu.lint.core import trace_program
+
+    # ONE construction for the censused state AND the audited program
+    # (matrix.sharded_parts), so the two cannot silently diverge.
+    sc, state, specs, body = matrix_mod.sharded_parts(
+        cfg, model=model, n_devices=n_devices)
+    n_shards = sc.mesh.devices.size
+    prog = trace_program(f"round/memory-{cfg.n_nodes}", body, state,
+                         cfg)
+    rows = state_memory_rows(state, specs, n_shards)
+
+    inner, shards = _shard_map_inner(prog.closed_jaxpr)
+    n_local = cfg.n_nodes // max(shards, 1)
+    interm = census(inner, n_local).total if inner is not None \
+        else PhaseCost()
+    rep = lint.run_programs([prog], rules=["replicated-node-axis"],
+                            package_rules=[],
+                            waivers=waivers_mod.WAIVERS)
+    return {
+        "n": cfg.n_nodes, "devices": n_shards,
+        "state_mib_per_device": rows[-1]["mib_per_device"],
+        "planes": rows,
+        "interm_mib_per_device": round(interm.interm_bytes / 2**20, 2),
+        "replicated_node_axis": {
+            "findings": len(rep.findings),
+            "waived": len(rep.waived),
+            "fingerprints": sorted({f.fingerprint
+                                    for f in rep.findings}),
+        },
+    }
+
+
+def dry_1m_report(n: int = 1_000_000, n_devices: int = 8) -> dict:
+    """``bench.py --dry-1m``: the 1M-node readiness check — census the
+    1M-node sharded round on the 8-way host mesh and judge the
+    per-device resident bytes against the pinned budget
+    (cost_budgets.DRY_1M).  PASS = within budget AND zero unwaived
+    replicated-node-axis findings."""
+    from partisan_tpu.lint import cost_budgets
+
+    card = device_memory_census(dry_run_cfg(n), n_devices=n_devices)
+    budget = cost_budgets.DRY_1M
+    # Scale the pinned budget to the shape the census actually ran at:
+    # linearly in n (every node-axis leaf is linear in n) and
+    # inversely in the device count (the residency is sharded-leaf
+    # dominated — 154 of 159 MiB at the 1M/8-way pin), so a 4-way run
+    # is judged against ~2x the pin instead of spuriously FAILing and
+    # a 16-way run cannot hide a 2x regression behind the 8-way pin.
+    budget_mib = (budget["state_mib_per_device"] * (n / budget["n"])
+                  * (budget["devices"] / card["devices"]))
+    within = card["state_mib_per_device"] <= budget_mib
+    clean = card["replicated_node_axis"]["findings"] == 0
+    card.update({
+        "kind": "dry_1m",
+        "budget_mib_per_device": round(budget_mib, 1),
+        "within_budget": bool(within),
+        "verdict": "PASS" if (within and clean) else "FAIL",
+    })
+    return card
+
+
 def bench_round_program(n: int = 32_768, *,
                         width_operand: bool = False) -> Program:
     """Trace the PLAIN bench-config round (hyparview+plumtree, planes

@@ -28,6 +28,21 @@ from __future__ import annotations
 STALE_EQN_FRACTION = 0.97
 STALE_BYTE_FRACTION = 0.90
 
+# The 1M-node per-device memory budget (ISSUE 13): the sharded round's
+# carry-state residency on an 8-way mesh, censused abstractly by
+# lint/cost.device_memory_census over the dry_run_cfg shape (bench
+# capacities + health plane + a2a exchange).  Measured 159.2 MiB/device
+# at pin time; the pin carries ~10% headroom so benign leaf additions
+# don't trip it, while an O(n) replicated-matrix regression (the class
+# the replicated-node-axis rule guards) blows straight through.
+# Re-measure with `python bench.py --dry-1m`; re-pin here WITH the
+# change that moves it.  tests/test_sharded_health.py gates it tier-1.
+DRY_1M: dict = {
+    "n": 1_000_000,
+    "devices": 8,
+    "state_mib_per_device": 176.0,
+}
+
 BUDGETS: dict = {
     # The plain bench round (hyparview+plumtree, planes off) — the hot
     # path every BENCH_r0x prices.
@@ -37,11 +52,16 @@ BUDGETS: dict = {
         "eqns": 3355,
     },
     # Every observability plane + the width operand — the bench/soak
-    # shape with full accounting on.
+    # shape with full accounting on.  Re-pinned at ISSUE 13's
+    # segment-local health plane: +3 gather/scatter, +32 eqns — the
+    # halo-exchange FastSV's per-iteration label gather/slice and the
+    # slot-column symmetry loop, traded for never materializing the
+    # [n, cap] gathered graph (the 1M enabler; still n-independent
+    # counts, so the 32-node pin gates every scale).
     "round/all-planes+width": {
-        "gather_scatter": 111,
+        "gather_scatter": 114,
         "interm_kib": 2322.0,
-        "eqns": 4261,
+        "eqns": 4293,
     },
     # The open-loop traffic generator over the plain round (PR 12):
     # +2 gather/scatter (the burst-slot arrival draw's emission build)

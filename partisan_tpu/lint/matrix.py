@@ -70,6 +70,58 @@ def _round_program(name: str, cfg: Config, model=None, *,
     return trace_program(name, fn, state, cfg, capture=capture)
 
 
+def sharded_parts(cfg: Config, model=None, n_devices: int = 8):
+    """(cluster, abstract state, specs, shard_map'd round body) for one
+    sharded config — the shared construction behind
+    :func:`sharded_round_program` AND the memory census
+    (lint/cost.device_memory_census), so the program audited and the
+    state censused can never silently diverge.  Needs >= 2 host
+    devices so n_local < n_global (partisan_tpu/hostmesh.py is the
+    shared pin); raises otherwise rather than silently building a
+    vacuous size-1 mesh."""
+    from partisan_tpu.models.plumtree import Plumtree
+    from partisan_tpu.parallel.sharded import (ShardedCluster,
+                                               _shard_map, make_mesh)
+
+    n_dev = min(n_devices, len(jax.devices()))
+    if n_dev < 2:
+        raise RuntimeError(
+            "sharded matrix programs need >= 2 host devices — call "
+            "partisan_tpu.hostmesh.force_host_devices() before jax's "
+            "backend initializes (tools/jaxlint.py and "
+            "tests/conftest.py both do)")
+    sc = ShardedCluster(cfg, make_mesh(n_dev),
+                        model=Plumtree() if model is None else model)
+    state = jax.eval_shape(sc._build_init)
+    specs = sc._state_specs(state)
+    body = _shard_map(sc._round_shard, sc.mesh, in_specs=(specs,),
+                      out_specs=specs)
+    return sc, state, specs, body
+
+
+def sharded_round_program(name: str, cfg: Config, model=None,
+                          n_devices: int = 8) -> Program:
+    """Trace ONE sharded (shard_map) round abstractly: the program the
+    ``replicated-node-axis`` rule audits."""
+    _sc, state, _specs, body = sharded_parts(cfg, model=model,
+                                             n_devices=n_devices)
+    return trace_program(name, body, state, cfg)
+
+
+def sharded_cfgs() -> dict:
+    """The two audited sharded shapes, by program name: the PLAIN
+    sharded round on the scalable (all_to_all) exchange — the
+    sharded-by-default hot path, which must carry no full-node-axis
+    tensor at all — and the health-carrying round whose segment-local
+    FastSV + halo exchange replaced the gathered [n, cap] graph."""
+    return {
+        "round/sharded-plain": base_cfg(
+            sharded_exchange="all_to_all"),
+        "round/sharded-health": base_cfg(
+            sharded_exchange="all_to_all", health=4, health_ring=8),
+    }
+
+
 def _otp_stack_program() -> Program:
     """The OTP service stack round (rpc + monitor over fullmesh) — the
     program test_program_budget's OTP budget guard traces."""
@@ -157,5 +209,13 @@ def default_matrix() -> list[Program]:
                                 control=ControlConfig(backpressure=True,
                                                       ring=8)),
                        scan=4),
+        # the sharded-by-default path (ROADMAP item 2): the plain
+        # sharded round and the health-carrying one, traced through a
+        # real shard_map on the 8-virtual-device host mesh — the
+        # replicated-node-axis rule's audit surface (plus every other
+        # program rule; the waivers for the hyparview walk snapshots
+        # live on these entries)
+        *(sharded_round_program(name, cfg)
+          for name, cfg in sharded_cfgs().items()),
     ]
     return progs

@@ -22,6 +22,12 @@ already relies on (and previously policed ad hoc, or not at all):
   leaves included) has a PartitionSpec in ``parallel/sharded.py``; a
   new carry field that defaults to ``()`` in ``_state_specs`` while the
   state carries arrays is exactly how a sharded run silently diverges.
+- **replicated-node-axis** — no equation inside the sharded
+  (shard_map) round may materialize a full-node-axis ``[n_global, ·]``
+  tensor beyond a replicated vector: the O(n)-per-device HBM
+  regression class that breaks the 1M-node budget (the health plane's
+  all-gathered ``[n, cap]`` FastSV input was the first offender —
+  ROADMAP item 2; segment-local + halo is the sanctioned shape).
 """
 
 from __future__ import annotations
@@ -329,6 +335,96 @@ def sharding_spec_completeness() -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# replicated-node-axis (the O(n) HBM regression class — ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+def _mesh_shards(eqn) -> int:
+    """Shard count of a shard_map equation (0 when unreadable)."""
+    mesh = eqn.params.get("mesh")
+    if mesh is None:
+        return 0
+    for attr in ("size", "devices"):
+        v = getattr(mesh, attr, None)
+        if v is not None:
+            try:
+                return int(getattr(v, "size", v))
+            except (TypeError, ValueError):
+                pass
+    shape = getattr(mesh, "shape", None)
+    if shape:
+        try:
+            import math as _math
+
+            return int(_math.prod(shape.values()))
+        except (AttributeError, TypeError):
+            pass
+    return 0
+
+
+def replicated_node_axis(prog: Program) -> list[Finding]:
+    """Inside a sharded (shard_map) program, flag every equation whose
+    output materializes the FULL global node axis with more than a
+    vector's worth of elements: a ``[n_global, ·]`` tensor resident on
+    every device is exactly the O(n) regression class that breaks the
+    per-device O(n_local + halo) memory budget at 1M nodes (the health
+    plane's all-gathered ``[n, cap]`` neighbor table was the first
+    offender — ROADMAP item 2).  Replicated VECTORS ([n] masks, FastSV
+    halo labels, partition groups) are the sanctioned cross-shard
+    state and pass; view/layout primitives and call wrappers are
+    skipped like the cost meter does.  Single-device programs (no
+    shard_map, or a size-1 mesh where n_local == n_global) are not
+    judged.  Legitimately bounded full-axis reads (the hyparview
+    random-walk view snapshots) carry pinned waivers with the bound
+    written down."""
+    from partisan_tpu.lint.cost import _VIEW_PRIMS, _WRAPPER_PRIMS
+
+    cfg = prog.cfg
+    if cfg is None:
+        return []
+    n = cfg.n_nodes
+    out: list[Finding] = []
+
+    def walk(jaxpr, inside: bool) -> None:
+        import jax.extend.core as jex_core
+
+        if isinstance(jaxpr, jex_core.ClosedJaxpr):
+            jaxpr = jaxpr.jaxpr
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            sub_inside = inside
+            if name == "shard_map":
+                sub_inside = _mesh_shards(eqn) >= 2
+            elif (inside and name not in _WRAPPER_PRIMS
+                    and name not in _VIEW_PRIMS):
+                for ov in eqn.outvars:
+                    av = getattr(ov, "aval", None)
+                    shp = getattr(av, "shape", ())
+                    elems = 1
+                    for d in shp:
+                        elems *= d
+                    # the node axis in ANY position (a transposed
+                    # [K, n] replicates the same O(n·K) bytes) with
+                    # more than a vector's worth of elements
+                    if len(shp) >= 2 and n in shp and elems > n:
+                        file, func, line = site_of(eqn)
+                        tail = "x".join("n" if d == n else str(d)
+                                        for d in shp)
+                        out.append(Finding(
+                            rule="", file=file, func=func,
+                            detail=f"{name}:[{tail}]", line=line,
+                            message=f"'{name}' materializes a full-"
+                                    f"node-axis [{tail}] tensor "
+                                    f"inside the sharded program — "
+                                    f"replicate vectors only; shard "
+                                    f"the matrix or halo-read it"))
+            for sub in sub_jaxprs(eqn.params):
+                walk(sub, sub_inside)
+
+    walk(prog.closed_jaxpr, False)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # round-cost-budget (the op-count ratchet — partisan_tpu/lint/cost.py)
 # ---------------------------------------------------------------------------
 
@@ -397,6 +493,7 @@ PROGRAM_RULES = {
     "zero-cost-when-off": zero_cost_when_off,
     "narrow-dtype-overflow": narrow_dtype_overflow,
     "scatter-overlap": scatter_overlap,
+    "replicated-node-axis": replicated_node_axis,
     "round-cost-budget": round_cost_budget,
 }
 

@@ -25,13 +25,46 @@ WAIVERS: dict[str, str] = {
     "convert_element_type@int16":
         "prov_hop is depth-bounded (claim clamp 2^(30-bits), +1/round) "
         "— int16 per types.NARROW_WIRE_DTYPES",
-    # health.py's FastSV component counter: pointer-jumping min-label
-    # propagation scatters `.at[...].min(...)` repeatedly into the same
-    # label table.  min is commutative and associative, so overlapping
-    # updates commute — the chain is deterministic by construction
-    # (gated against the host BFS oracle in tests/test_health.py).
+    # health.py's FastSV component counter (segment-local + halo form):
+    # pointer-jumping min-label propagation scatters `.at[...].min(...)`
+    # repeatedly into the same label/proposal table.  min is commutative
+    # and associative, so overlapping updates commute — the chain is
+    # deterministic by construction (gated against the host BFS oracle
+    # in tests/test_health.py and tests/test_sharded_health.py).
     "scatter-overlap:partisan_tpu/health.py:body:"
     "chain:scatter-min@<unscoped>":
         "FastSV min-label propagation: min-scatter chains commute; "
-        "BFS-oracle-gated in tests/test_health.py",
+        "BFS-oracle-gated in tests/test_health.py + "
+        "tests/test_sharded_health.py",
+    # --- replicated-node-axis: the pinned full-axis exceptions of the
+    # --- sharded round, each with its per-device byte bound written
+    # --- down (the 1M/8-way budget in lint/cost_budgets.py prices all
+    # --- of them; bench.py --dry-1m re-measures every run)
+    # HyParView's in-round random walks (forward_join fan-out, shuffle)
+    # hop over a SNAPSHOT of every node's active view: random access to
+    # remote views is the protocol (SRDS'07 TTL walks), so the [n,
+    # active_max] gather is inherent.  Bounded: active_max=6 int32 =
+    # 24 MB/device at 1M nodes, and both gathers live inside lax.cond
+    # bodies that only run on join/shuffle rounds (quiet rounds pay
+    # nothing).
+    "replicated-node-axis:partisan_tpu/parallel/sharded.py:gather_vec:"
+    "all_gather:[nx6]":
+        "hyparview walk view snapshot: [n, active_max=6] int32 = 24 MB/"
+        "device at 1M, cond-gated to join/shuffle rounds",
+    # The sharded gossip merge (ShardComm.push_max): each shard
+    # scatter-maxes its local rows into a full-range proposal, reduced
+    # elementwise across shards.  The proposal is TRANSIENT (one buffer,
+    # freed after the slice) and its width is the gossip payload — the
+    # plumtree AAE epoch/store push at [n, max_broadcasts·2] = 64 MB/
+    # device at 1M with the bench capacities.  A destination-sorted
+    # quota exchange (the a2a route's shape) could bound it to
+    # O(n_local·S·Q) if profiles ever justify the machinery.
+    "replicated-node-axis:partisan_tpu/ops/gossip.py:push_max:"
+    "scatter-max:[nx16]":
+        "sharded gossip halo-reduce proposal: transient [n, B*2] = "
+        "64 MB/device at 1M (plumtree AAE push)",
+    "replicated-node-axis:partisan_tpu/parallel/sharded.py:push_max:"
+    "pmax:[nx16]":
+        "cross-shard elementwise reduce of the gossip proposal above — "
+        "same transient 64 MB/device bound",
 }

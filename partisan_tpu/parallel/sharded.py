@@ -158,10 +158,19 @@ class ShardComm:
                               self.inbox_cap, node_offset=self.node_offset)
 
     def push_max(self, rows: Array, dst: Array) -> Array:
-        all_rows = jax.lax.all_gather(rows, AXIS, axis=0, tiled=True)
-        all_dst = jax.lax.all_gather(dst, AXIS, axis=0, tiled=True)
-        return gossip.push_max(all_rows, all_dst, n_out=self.n_local,
-                               node_offset=self.node_offset)
+        """Sharded scatter-max gossip WITHOUT replicating the senders:
+        each shard scatters its own rows into a full-range proposal,
+        shards reduce elementwise (pmax — max is commutative/associative
+        so the result is bit-identical to the old gather-everything
+        form), and each shard keeps its own node range.  Per-device
+        residency is one [n_global, D] proposal instead of the gathered
+        [n_global, D] rows + [n_global, K] edges + their [n_global·K, D]
+        repeat — for the heartbeat's D=1 rows that is a plain [n]
+        vector, which the replicated-node-axis lint rule permits."""
+        prop = gossip.push_max(rows, dst, n_out=self.n_global)
+        prop = jax.lax.pmax(prop, AXIS)
+        return jax.lax.dynamic_slice_in_dim(prop, self.node_offset,
+                                            self.n_local, axis=0)
 
     def push_or(self, rows: Array, dst: Array) -> Array:
         return self.push_max(rows.astype(jnp.uint8), dst).astype(jnp.bool_)
@@ -174,6 +183,12 @@ class ShardComm:
         """Cross-shard scalar max (keeps metrics high-water marks
         replicated — same discipline as allsum)."""
         return jax.lax.pmax(x, AXIS)
+
+    def allmin(self, x: Array) -> Array:
+        """Cross-shard elementwise min — the halo-exchange reduction of
+        the health plane's segment-local FastSV (each shard's label
+        proposals for remote nodes meet here)."""
+        return jax.lax.pmin(x, AXIS)
 
     def gather_vec(self, x: Array) -> Array:
         return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
@@ -201,6 +216,9 @@ class ShardedCluster:
     manager: Any = None
     model: Any = None
     interpose: Any = None
+    donate: bool = False    # donate the state carry to steps() — same
+    #                         contract as Cluster.donate (callers thread
+    #                         state linearly)
 
     def __post_init__(self) -> None:
         if self.manager is None:
@@ -301,6 +319,13 @@ class ShardedCluster:
 
     # ---- state construction ------------------------------------------
     def init(self) -> ClusterState:
+        return self.shard_state(self._build_init())
+
+    def _build_init(self) -> ClusterState:
+        """The UNSHARDED initial state (host/global arrays) — also the
+        abstract template ``jax.eval_shape`` traces for the lint
+        matrix's sharded programs and the per-device memory census
+        (lint/cost.py), so keep it device-placement-free."""
         cfg = self.cfg
         state = ClusterState(
             rnd=jnp.int32(0),
@@ -345,7 +370,7 @@ class ShardedCluster:
             state = state._replace(
                 flight=latency_mod.flight_init(cfg,
                                                tuple(tr.sent.shape)))
-        return self.shard_state(state)
+        return state
 
     def shard_state(self, state: ClusterState) -> ClusterState:
         """Place a host/global state onto the mesh per the specs."""
@@ -378,7 +403,8 @@ class ShardedCluster:
         self._steps = jax.jit(
             lambda s, k: jax.lax.scan(
                 lambda c, _: (body(c), None), s, None, length=k)[0],
-            static_argnums=1)
+            static_argnums=1,
+            donate_argnums=(0,) if self.donate else ())
         trace_specs = TraceRound(rnd=P(), sent=P(AXIS), dropped=P(AXIS))
         tbody = _shard_map(self._round_shard_traced, self.mesh,
                            in_specs=(specs,),

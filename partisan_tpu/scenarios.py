@@ -128,6 +128,47 @@ def _sync(st) -> None:
     int(jax.device_get(st.rnd))
 
 
+# Sharded-by-default threshold (ROADMAP item 2): at or above this node
+# count, make_cluster_auto returns a node-sharded ShardedCluster over
+# every visible device instead of a single-device Cluster.  65536 keeps
+# the 32k round single-chip (the BENCH_r0x comparability anchor) and
+# flips the 100k headline + the 1M target to the sharded path wherever
+# more than one device exists; single-device environments (the CPU test
+# container outside the 8-virtual-device harness, a lone chip) fall
+# back to Cluster unchanged.  Override with PARTISAN_SHARDED_N.
+import os as _os
+
+SHARDED_N_MIN = int(_os.environ.get("PARTISAN_SHARDED_N", 65_536))
+
+
+def make_cluster_auto(cfg, model=None, interpose=None, donate=False):
+    """Cluster factory with the sharded path as the default at large n:
+    node counts >= SHARDED_N_MIN on a multi-device backend get a
+    ShardedCluster over a 1-D mesh of the LARGEST device count that
+    divides n (all devices for the power-of-two ladder sizes and the
+    100k/1M rungs on 8-way meshes; 100k on a 64-way slice still
+    shards 50-way rather than falling back to one melting chip);
+    only a prime-ish n with no usable divisor — or a single-device
+    backend — gets the single-device Cluster.  Both expose the same
+    API (init/step/steps/record/run_until, donate), so callers are
+    placement-agnostic — which is the whole point:
+    tests/test_sharded.py pins that the two evolve bit-identically."""
+    from partisan_tpu.cluster import Cluster
+
+    n_dev = len(jax.devices())
+    if cfg.n_nodes >= SHARDED_N_MIN and n_dev > 1:
+        for k in range(n_dev, 1, -1):
+            if cfg.n_nodes % k == 0:
+                from partisan_tpu.parallel.sharded import (
+                    ShardedCluster, make_mesh)
+
+                return ShardedCluster(cfg, make_mesh(k), model=model,
+                                      interpose=interpose,
+                                      donate=donate)
+    return Cluster(cfg, model=model, interpose=interpose,
+                   donate=donate)
+
+
 def _boot_fullmesh(cl, n):
     st = cl.init()
     m = st.manager
@@ -568,7 +609,6 @@ def config3_plumtree_drop(n=10_000, drop=0.05, max_rounds=400):
     partisan_plumtree_broadcast.erl:861-905)."""
     import jax.numpy as jnp
 
-    from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config
     from partisan_tpu.models.plumtree import Plumtree
 
@@ -577,7 +617,7 @@ def config3_plumtree_drop(n=10_000, drop=0.05, max_rounds=400):
                             msg_words=16, partition_mode="groups",
                             emit_compact=32 if n > 4096 else 0))
     model = Plumtree()
-    cl = Cluster(cfg, model=model)
+    cl = make_cluster_auto(cfg, model=model)
     cov = jax.jit(lambda s: model.coverage(s.model, s.faults.alive, 0))
     st = _boot_overlay(cl, n)
     st = st._replace(faults=st.faults._replace(link_drop=jnp.float32(drop)))
@@ -609,7 +649,6 @@ def config4_scamp_churn(n=10_000, churn_per_min=0.30, rounds=120):
     import jax.numpy as jnp
 
     from partisan_tpu import faults as faults_mod
-    from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config
 
     # inbox_cap sized so the subscription-walk storms of the batched
@@ -620,7 +659,7 @@ def config4_scamp_churn(n=10_000, churn_per_min=0.30, rounds=120):
                             peer_service_manager="scamp_v2",
                             msg_words=16, partition_mode="groups",
                             inbox_cap=96))
-    cl = Cluster(cfg)
+    cl = make_cluster_auto(cfg)
     # Admission stagger (join_round gating): each wave's subscriptions
     # enter spread over the wave's rounds, so fanouts land on contact
     # views settled by earlier admissions — without it a mass same-round
@@ -679,7 +718,6 @@ def config5_causal_crash(n=100_000, senders=64, crashes=16,
     and the overlay heals around them.  Checks: per-(sender, receiver)
     FIFO with exactly-once delivery at every receiver, and plumtree
     broadcast convergence across the healed overlay."""
-    from partisan_tpu.cluster import Cluster
     from partisan_tpu.config import Config, PlumtreeConfig
     from partisan_tpu.models.p2p_chat import P2PChat
     from partisan_tpu.models.plumtree import Plumtree
@@ -709,12 +747,15 @@ def config5_causal_crash(n=100_000, senders=64, crashes=16,
                                               lazy_cap=4)))
 
     cfg = make_cfg(n)
-    cl = Cluster(cfg, model=stack)
+    # sharded-by-default at scale (ROADMAP item 2): >= SHARDED_N_MIN on
+    # a multi-device backend runs the node-sharded SPMD round
+    cl = make_cluster_auto(cfg, model=stack)
     cov = jax.jit(lambda s: plum.coverage(stack.sub(s.model, 0),
                                           s.faults.alive, 0))
 
     def make_cluster(width):
-        return cl if width == n else Cluster(make_cfg(width), model=stack)
+        return cl if width == n else make_cluster_auto(make_cfg(width),
+                                                       model=stack)
 
     _, st = _boot_ladder(make_cluster, n)
     start = int(st.rnd)
@@ -1305,12 +1346,60 @@ def _traffic_build(model_name: str, n: int):
         from partisan_tpu.models.stack import Stack
 
         plum = Plumtree()
+        # provenance ON: the chat scenarios now SCHEDULE plumtree
+        # broadcasts (below), so the dissemination forest + redundancy
+        # ring are live evidence — the fanout-governor × flash-crowd
+        # interplay (ROADMAP item 3's remaining gap) and the
+        # crowd-window redundancy gate both read it.
         extras = dict(peer_service_manager="hyparview", msg_words=16,
                       health=5, health_ring=256, max_broadcasts=8,
+                      provenance=True, provenance_ring=512,
                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4,
                                               aae=True))
         senders = tuple(range(1, 5))
         receivers = tuple(range(n - 8, n - 4))
+
+        def bcast(slot, root, value, off):
+            """A scheduled plumtree broadcast as a storm Script: the
+            ACTUAL broadcast workload the chat suites carried plumtree
+            for but never exercised — one calm, one inside the flash
+            crowd (callers pick offsets)."""
+            def fn(cluster, state, rnd):
+                m = stack.replace_sub(state.model, 0, plum.broadcast(
+                    stack.sub(state.model, 0), root, slot, value))
+                return _mark_bcast(state._replace(model=m), root, slot)
+            return (off, soak_mod.Script(fn))
+
+        def bcast_events(start, rounds):
+            # The crowd-window geometry MUST mirror traffic_scenario's
+            # timeline: flash crowd spans [g(q), g(q) + g(2q)) with
+            # q = rounds/8 and g() the K_PROG grain snap.  The calm
+            # broadcast fires STRICTLY BEFORE the window opens (offset
+            # 0 at suite-smoke scale, where g(q) == K_PROG) so the
+            # crowd-window gossip gate cannot be satisfied by the
+            # baseline broadcast; the second fires at the window's
+            # grain-snapped MIDPOINT — strictly inside at every scale
+            # (at rounds=80: window [10, 30), broadcast at 20; a
+            # rounds//4 formula would land exactly ON the restore
+            # round there and the crowd gate would never see it).
+            def g(off):
+                return max(K_PROG, off // K_PROG * K_PROG)
+
+            q = rounds // 8
+            calm = max(0, g(q) - K_PROG)
+            mid = g(q) + max(K_PROG,
+                             g(2 * q) // 2 // K_PROG * K_PROG)
+            return (bcast(0, 0, start + calm, calm),
+                    bcast(1, 0, start + mid, mid))
+
+        def bcast_check(st):
+            """Both scheduled broadcasts fully covered the (healed)
+            overlay — the crowd one proves dissemination survives the
+            overload window."""
+            alive = st.faults.alive
+            cov = [float(jax.device_get(plum.coverage(
+                stack.sub(st.model, 0), alive, s))) for s in (0, 1)]
+            return cov
 
         if model_name == "p2p_chat":
             from partisan_tpu.models.p2p_chat import P2PChat
@@ -1331,7 +1420,8 @@ def _traffic_build(model_name: str, n: int):
                 m = chat.schedule_many(stack.sub(st.model, 1),
                                        nodes, rnds, dsts)
                 return st._replace(
-                    model=stack.replace_sub(st.model, 1, m)), ()
+                    model=stack.replace_sub(st.model, 1, m)), \
+                    bcast_events(start, rounds)
 
             def check(st):
                 import jax as _jax
@@ -1341,9 +1431,12 @@ def _traffic_build(model_name: str, n: int):
                 got = sum(len(logs[int(r)]) for r in receivers)
                 fifo = all(P2PChat.edge_fifo_ok(logs[int(r)])
                            for r in receivers)
-                return bool(fifo and got >= len(senders)), \
+                cov = bcast_check(st)
+                return bool(fifo and got >= len(senders)
+                            and all(c == 1.0 for c in cov)), \
                     {"causal_delivered": int(got),
-                     "causal_expected": 2 * len(senders)}
+                     "causal_expected": 2 * len(senders),
+                     "bcast_coverage": cov}
         else:
             from partisan_tpu.models.causal_chat import CausalChat
 
@@ -1359,7 +1452,8 @@ def _traffic_build(model_name: str, n: int):
                     m = chat.schedule(m, int(s),
                                       start + rounds // 4 + 4)
                 return st._replace(
-                    model=stack.replace_sub(st.model, 1, m)), ()
+                    model=stack.replace_sub(st.model, 1, m)), \
+                    bcast_events(start, rounds)
 
             def check(st):
                 import jax as _jax
@@ -1367,7 +1461,10 @@ def _traffic_build(model_name: str, n: int):
                 logs = CausalChat.logs(_jax.device_get(
                     stack.sub(st.model, 1)))
                 got = sum(len(lg) for lg in logs)
-                return bool(got > 0), {"causal_delivered": int(got)}
+                cov = bcast_check(st)
+                return bool(got > 0 and all(c == 1.0 for c in cov)), \
+                    {"causal_delivered": int(got),
+                     "bcast_coverage": cov}
 
         def boot(cl):
             return _boot_joinall(cl, 40)
@@ -1498,8 +1595,13 @@ def traffic_scenario(model_name: str, n: int = 64, rounds: int = 240,
     n = max(n, 24)
     model, extras, boot, drive, check = _traffic_build(model_name, n)
     hx = extras.get("health", 0) > 0
-    ctl = ControlConfig(backpressure=True, healing=hx, ring=64) \
-        if adaptive else ControlConfig()
+    px = bool(extras.get("provenance"))
+    # The chat scenarios carry provenance because they now SCHEDULE
+    # plumtree broadcasts — so the adaptive arm also arms the eager-
+    # fanout governor there: the fanout × flash-crowd interplay under
+    # real overload, gated by the crowd-window redundancy below.
+    ctl = ControlConfig(backpressure=True, healing=hx, fanout=px,
+                        ring=64) if adaptive else ControlConfig()
     cfg = Config(
         n_nodes=n, seed=seed,
         channels=DEFAULT_CHANNELS + (ChannelSpec(BULK_CHANNEL),),
@@ -1632,6 +1734,32 @@ def traffic_scenario(model_name: str, n: int = 64, rounds: int = 240,
         "app_ok": bool(app_ok), "app": app_info,
         "wall_s": round(wall, 1),
     }
+    if px:
+        # Broadcast-under-load gate (ROADMAP item 3 remaining): the
+        # scheduled plumtree broadcasts' dissemination, judged in the
+        # FLASH-CROWD window off the provenance ring — gossip copies
+        # must actually move during the overload (coverage progresses
+        # under load, not just after it) and duplicates must not exceed
+        # gossip deliveries (redundancy ratio <= 1: the eager tree +
+        # governor keep fan-out bounded while the crowd squeezes the
+        # channels).  End-state coverage rides the app check
+        # (bcast_coverage in `app`).
+        from partisan_tpu import provenance as prov_mod
+
+        snap = prov_mod.snapshot(st.provenance)
+        lo, hi = start + g(q), start + g(q) + g(2 * q)
+        mask = (snap["rounds"] >= lo) & (snap["rounds"] < hi)
+        crowd_gossip = int(snap["gossip"][mask].sum())
+        crowd_dup = int(snap["dup"][mask].sum())
+        out["broadcast"] = {
+            **prov_mod.redundancy(snap),
+            "crowd_gossip": crowd_gossip,
+            "crowd_dup": crowd_dup,
+            "crowd_redundancy": (round(crowd_dup / crowd_gossip, 4)
+                                 if crowd_gossip else None),
+        }
+        out["broadcast_ok"] = bool(crowd_gossip > 0
+                                   and crowd_dup <= crowd_gossip)
     if hx:
         # Recovery gate: the GRAPH-health bits (one component, no
         # isolates, min degree — health.overlay_ok), judged over the
@@ -1696,7 +1824,8 @@ def traffic_slo(scale: float = 1.0, bound: int = TRAFFIC_SLO_BOUND) -> dict:
         entry["adaptive"] = adaptive
         ok = (adaptive["control_ok"] and adaptive["app_ok"]
               and adaptive["breaches"] == 0
-              and adaptive.get("overlay_ok", True))
+              and adaptive.get("overlay_ok", True)
+              and adaptive.get("broadcast_ok", True))
         entry["ok"] = bool(ok)
         all_ok = all_ok and ok
         if name in TRAFFIC_AB_MODELS:

@@ -10,12 +10,12 @@ test/partisan_support.erl:46+).
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from partisan_tpu.hostmesh import force_host_devices  # noqa: E402
+
+force_host_devices()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
@@ -29,6 +29,21 @@ xla_bridge._backend_factories.pop("axon", None)
 # seconds to compile; cache across test runs.
 jax.config.update("jax_compilation_cache_dir", "/tmp/partisan_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """Session-scoped 8-way mesh over the virtual CPU devices, shared
+    by every sharded suite (ISSUE 13 runtime paydown: the mesh — and
+    the jit caches keyed on it — build once per session instead of per
+    module)."""
+    from partisan_tpu.parallel.sharded import make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 cpu devices"
+    return make_mesh(8)
 
 
 def pytest_configure(config):

@@ -44,6 +44,12 @@ SOAK_CYCLES = 4 if FULL else 3
 # the census is shape-static — the budget verdict is judged at the
 # matrix's n=32 regardless, so the smoke width only prices the trace
 COST_SMOKE_N = 256 if FULL else 64
+# segment-local-FastSV parity sweep (tests/test_sharded_health.py):
+# random overlays compared sharded vs gathered vs the BFS oracle.  The
+# ISSUE 13 acceptance floor is 50; all trials share TWO compiled
+# shard_map programs (fixed padded shape), so extra trials cost only
+# host BFS time
+FASTSV_TRIALS = 64 if FULL else 50
 
 
 def hv_config(n, seed, **kw):

@@ -79,11 +79,17 @@ def test_calm_run_flag_on_is_behaviorally_identical():
     cl_off = Cluster(cfg_off, model=Plumtree())
     cl_on = Cluster(cfg_on, model=Plumtree())
     # settle to a healthy overlay WITHOUT controllers, then fork: the
-    # on-arm gets the same state plus a fresh controller leaf
-    st = cl_off.steps(_join_all(cl_off, cl_off.init()), 60)
+    # on-arm gets the same state plus a fresh controller leaf.  ONE
+    # scan length (k=20) throughout: each extra length is a full XLA
+    # compile of the heaviest (all-planes + controllers) round — the
+    # scenarios.py K_PROG discipline, applied to the suite's top
+    # wall-clock test (ISSUE 13 runtime paydown).
+    st = _join_all(cl_off, cl_off.init())
+    for _ in range(3):
+        st = cl_off.steps(st, 20)
     st_on = st._replace(control=control_mod.init(cfg_on))
-    out_off = cl_off.steps(st, 25)
-    out_on = cl_on.steps(st_on, 25)
+    out_off = cl_off.steps(st, 20)
+    out_on = cl_on.steps(st_on, 20)
     # no actuation happened: budget at full width, no pressure, boost 0
     k = out_on.control
     assert int(k.fanout.eager_cap) == cfg_on.hyparview.active_max

@@ -107,6 +107,23 @@ def test_config7_soak_smoke():
     assert r["components"] >= 1
 
 
+def test_traffic_chat_broadcast_gate():
+    """ROADMAP item 3's remaining gap, closed: the chat scenarios now
+    SCHEDULE plumtree broadcasts (one calm, one inside the flash
+    crowd) with the fanout governor armed.  Gates: both broadcasts
+    reach full coverage on the healed overlay, gossip copies actually
+    moved DURING the crowd window, and crowd-window redundancy stays
+    bounded (dup <= gossip) — dissemination survives the overload."""
+    r = scenarios.traffic_scenario("p2p_chat", n=32, rounds=80,
+                                   adaptive=True)
+    assert r["app_ok"], r["app"]
+    assert r["app"]["bcast_coverage"] == [1.0, 1.0], r["app"]
+    assert r["broadcast_ok"], r["broadcast"]
+    assert r["broadcast"]["crowd_gossip"] > 0, r["broadcast"]
+    assert r["breaches"] == 0, r
+    assert "control" in r            # the governor really was armed
+
+
 def test_traffic_scenario_smoke():
     """The traffic-plane SLO harness end to end at CPU-smoke scale:
     one app model (paxos — the cheapest fullmesh build) under the full

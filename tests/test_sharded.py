@@ -27,10 +27,8 @@ def bootstrap(cl, st):
     return st._replace(manager=m)
 
 
-@pytest.fixture(scope="module")
-def mesh8():
-    assert len(jax.devices()) >= 8, "conftest must force 8 cpu devices"
-    return make_mesh(8)
+# mesh8 is the session-scoped fixture from conftest.py (shared with
+# tests/test_sharded_health.py — one mesh per session).
 
 
 def test_sharded_matches_local(mesh8):

@@ -1,0 +1,345 @@
+"""Segment-local FastSV + halo exchange (ISSUE 13 tentpole): the
+sharded health plane on the 8-virtual-device CPU mesh.
+
+- segment-local FastSV vs the gathered FastSV vs the host BFS oracle
+  (tests/support.components) on >= support.FASTSV_TRIALS random
+  overlays — sparse, dense, heavily faulted, group-partitioned, plus
+  the adversarial path graph — all sharing TWO compiled shard_map
+  programs (fixed padded shape; content varies),
+- sharded-vs-single-chip BIT-parity of the whole health ring + digest
+  on a faulted/partitioned hyparview run,
+- the width-operand prefix-masking case: a sharded width-operand run
+  snapshots the same topology series as a native-width single-chip run,
+- the per-device memory meter: state_memory_rows exactness at small n,
+  the pinned 1M/8-way budget (bench.py --dry-1m's gate, tier-1), and
+  the replicated-node-axis rule firing on a synthetic offender.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from partisan_tpu import health as health_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config
+from partisan_tpu.parallel.sharded import AXIS, ShardComm, _shard_map
+from tests import support
+
+P = jax.sharding.PartitionSpec
+
+_N, _K = 256, 7     # ONE padded device shape for the whole sweep
+#                     (256 = 32 rows/shard on mesh8)
+
+
+def _random_overlay(rng, n, k):
+    """Random directed neighbor table + alive mask at logical (n, k),
+    padded to (_N, _K) — the test_health.py idiom: dead pad rows, -1
+    pad slots, identical component structure, no per-trial recompile."""
+    nbrs = np.full((_N, _K), -1, np.int32)
+    nbrs[:n, :k] = rng.integers(-1, n, size=(n, k))
+    ids = np.arange(_N, dtype=np.int32)[:, None]
+    nbrs = np.where(nbrs == ids, -1, nbrs)
+    alive = np.zeros(_N, bool)
+    alive[:n] = rng.random(n) > rng.uniform(0.0, 0.4)
+    return nbrs, alive
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_counters(mesh_key):
+    """The two compiled sharded counters (plain, partitioned) — built
+    once per session off the shared mesh fixture."""
+    mesh = _sharded_counters.meshes[mesh_key]
+    comm = ShardComm(n_global=_N, inbox_cap=8, msg_words=12, n_shards=8)
+
+    def plain(nb, al):
+        return health_mod.component_count_sharded(nb, al, comm)[1]
+
+    def parted(nb, al, pt):
+        return health_mod.component_count_sharded(nb, al, comm, pt)[1]
+
+    count_s = jax.jit(_shard_map(plain, mesh, in_specs=(P(AXIS), P()),
+                                 out_specs=P()))
+    count_sp = jax.jit(_shard_map(parted, mesh,
+                                  in_specs=(P(AXIS), P(), P()),
+                                  out_specs=P()))
+    return count_s, count_sp
+
+
+_sharded_counters.meshes = {}
+
+
+def _counters(mesh8):
+    _sharded_counters.meshes["m"] = mesh8
+    return _sharded_counters("m")
+
+
+def test_fastsv_sharded_vs_gathered_vs_bfs_oracle(mesh8):
+    """The acceptance sweep: >= FASTSV_TRIALS random overlays where the
+    segment-local count, the gathered count and the BFS oracle agree
+    EXACTLY — faulted and group-partitioned graphs included."""
+    from support import FASTSV_TRIALS
+
+    rng = np.random.default_rng(1302)
+    count_g = jax.jit(
+        lambda nb, al: health_mod.component_count(nb, al)[1])
+    count_gp = jax.jit(
+        lambda nb, al, pt: health_mod.component_count(nb, al, pt)[1])
+    count_s, count_sp = _counters(mesh8)
+
+    checked = 0
+    plain_trials = FASTSV_TRIALS - FASTSV_TRIALS // 3
+    for trial in range(plain_trials):
+        n = int(rng.integers(2, _N + 1))
+        k = int(rng.integers(1, _K + 1))
+        nbrs, alive = _random_overlay(rng, n, k)
+        nb, al = jnp.asarray(nbrs), jnp.asarray(alive)
+        want = len(support.components(nbrs, alive))
+        got_s = int(count_s(nb, al))
+        got_g = int(count_g(nb, al))
+        assert got_s == got_g == want, (trial, n, k, got_s, got_g, want)
+        checked += 1
+    # group-partitioned overlays: cross-group edges severed exactly
+    # like faults.edge_cut's static component
+    for trial in range(FASTSV_TRIALS // 3):
+        n = int(rng.integers(4, _N + 1))
+        k = int(rng.integers(1, _K + 1))
+        nbrs, alive = _random_overlay(rng, n, k)
+        part = rng.integers(0, int(rng.integers(2, 5)),
+                            size=_N).astype(np.int32)
+        nb, al, pt = jnp.asarray(nbrs), jnp.asarray(alive), \
+            jnp.asarray(part)
+        want = len(support.components(nbrs, alive, partition=part))
+        got_s = int(count_sp(nb, al, pt))
+        got_g = int(count_gp(nb, al, pt))
+        assert got_s == got_g == want, (trial, n, k, got_s, got_g, want)
+        checked += 1
+    # adversarial worst case: a path graph spanning every shard (the
+    # min label must cross all 8 shard boundaries via the halo)
+    for n in (2, 63, _N):
+        nbrs = np.full((_N, _K), -1, np.int32)
+        nbrs[1:n, 0] = np.arange(n - 1)
+        alive = np.zeros(_N, bool)
+        alive[:n] = True
+        assert int(count_s(jnp.asarray(nbrs), jnp.asarray(alive))) == 1
+        alive[n // 2] = False
+        got = int(count_s(jnp.asarray(nbrs), jnp.asarray(alive)))
+        assert got == len(support.components(nbrs, alive)), n
+        checked += 2
+    assert checked >= FASTSV_TRIALS + 6
+
+
+def test_sharded_symmetry_matches_reference(mesh8):
+    """The slot-column halo symmetry check agrees with the gathered
+    reference kernel (and transitively with test_health.py's brute
+    force) across random overlays on the same compiled program."""
+    rng = np.random.default_rng(77)
+    comm = ShardComm(n_global=_N, inbox_cap=8, msg_words=12, n_shards=8)
+    sym_s = jax.jit(_shard_map(
+        lambda nb, al: health_mod.symmetry_violations_sharded(
+            nb, al, comm),
+        mesh8, in_specs=(P(AXIS), P()), out_specs=P()))
+    for trial in range(12):
+        n = int(rng.integers(2, _N + 1))
+        k = int(rng.integers(1, _K + 1))
+        nbrs, alive = _random_overlay(rng, n, k)
+        want = int(health_mod.symmetry_violations(
+            jnp.asarray(nbrs), jnp.asarray(alive)))
+        got = int(sym_s(jnp.asarray(nbrs), jnp.asarray(alive)))
+        assert got == want, (trial, n, k, got, want)
+
+
+def test_sharded_digest_bit_parity_under_faults(mesh8):
+    """Single-chip vs 8-way sharded bit-parity of the WHOLE health
+    ring (every series + the packed digest) on a hyparview overlay
+    driven through crashes and a group partition — the ISSUE 13
+    digest-parity acceptance gate."""
+    from partisan_tpu.parallel.sharded import ShardedCluster
+
+    cfg = support.hv_config(64, seed=13, health=5, health_ring=32,
+                            partition_mode="groups")
+
+    def drive(cl):
+        # ONE scan length (k=10) for every phase: each extra length is
+        # a full compile of the health-carrying round, paid per arm
+        # (runtime paydown — the scenarios.py K_PROG discipline)
+        st = cl.init()
+        m = st.manager
+        for base in range(1, 64, 16):
+            m = cl.manager.join_many(
+                cfg, m, list(range(base, min(base + 16, 64))),
+                [0] * len(range(base, min(base + 16, 64))))
+            st = cl.steps(st._replace(manager=m), 10)
+            m = st.manager
+        alive = st.faults.alive.at[jnp.asarray([7, 21, 40])].set(False)
+        part = st.faults.partition.at[jnp.arange(24)].set(1)
+        st = st._replace(faults=st.faults._replace(alive=alive,
+                                                   partition=part))
+        st = cl.steps(st, 10)
+        st = st._replace(faults=st.faults._replace(
+            partition=jnp.zeros_like(part)))
+        st = cl.steps(st, 10)
+        return cl.steps(st, 10)
+
+    st_l = drive(Cluster(cfg))
+    st_s = drive(ShardedCluster(cfg, mesh8))
+    snap_l = health_mod.snapshot(st_l.health)
+    snap_s = health_mod.snapshot(st_s.health)
+    for name, series in snap_l.items():
+        assert np.array_equal(series, snap_s[name]), name
+    assert health_mod.digest(st_l) == health_mod.digest(st_s)
+    # the run really exercised the interesting bits: a split window
+    # and the crash downs are visible in the (identical) rings
+    assert snap_l["components"].max() > 1
+    assert snap_l["downs"].sum() == 3
+
+
+def test_width_operand_prefix_masking_sharded(mesh8):
+    """Width-operand prefix masking under sharding: a sharded
+    2n-capacity run activated to n snapshots the same topology series
+    as a native-width single-chip run — the prefix-dynamics contract
+    extended to the segment-local health plane."""
+    from partisan_tpu import cluster as cluster_mod
+    from partisan_tpu.parallel.sharded import ShardedCluster
+
+    def boot(cl, n):
+        # one scan length (k=2) throughout — settle runs as 10 cheap
+        # dispatches instead of compiling a second scan program per
+        # arm (runtime paydown)
+        st = cl.init()
+        if cl.cfg.width_operand:
+            st = cluster_mod.activate(st, n)
+        for base in range(1, n, 8):
+            m = cl.manager.join_many(
+                cl.cfg, st.manager,
+                list(range(base, min(base + 8, n))),
+                [0] * len(range(base, min(base + 8, n))))
+            st = cl.steps(st._replace(manager=m), 2)
+        for _ in range(10):
+            st = cl.steps(st, 2)
+        return st
+
+    n = 24
+    cfg_n = support.hv_config(n, seed=6, health=4, health_ring=16)
+    st_n = boot(Cluster(cfg_n), n)
+    cfg_w = support.hv_config(2 * n, seed=6, health=4, health_ring=16,
+                              width_operand=True)
+    st_w = boot(ShardedCluster(cfg_w, mesh8), n)
+    snap_n = health_mod.snapshot(st_n.health)
+    snap_w = health_mod.snapshot(st_w.health)
+    for name in ("rounds", "components", "isolated", "deg_min",
+                 "deg_max", "sym_violations", "joins", "leaves", "ups",
+                 "downs", "deg_hist"):
+        assert np.array_equal(snap_n[name], snap_w[name]), name
+
+
+def test_make_cluster_auto_selects_sharded(monkeypatch):
+    """The sharded-by-default flip: at/above the threshold on a
+    multi-device backend the factory returns a ShardedCluster over
+    every device (and it runs); below it, or when n doesn't divide the
+    mesh, the single-device Cluster — same API either way."""
+    from partisan_tpu import scenarios
+    from partisan_tpu.parallel.sharded import ShardedCluster
+
+    monkeypatch.setattr(scenarios, "SHARDED_N_MIN", 64)
+    cl = scenarios.make_cluster_auto(Config(n_nodes=64, seed=1),
+                                     donate=True)
+    assert isinstance(cl, ShardedCluster)
+    assert cl.mesh.devices.size == 8 and cl.donate
+    st = cl.step(cl.init())                 # the SPMD round really runs
+    assert int(st.rnd) == 1
+    # n not divisible by the full mesh: shard over the LARGEST divisor
+    # (100 on 8 devices -> a 5-way mesh), not a one-chip fallback
+    cl2 = scenarios.make_cluster_auto(Config(n_nodes=100, seed=1))
+    assert isinstance(cl2, ShardedCluster)
+    assert cl2.mesh.devices.size == 5
+    cl3 = scenarios.make_cluster_auto(Config(n_nodes=67, seed=1))
+    assert isinstance(cl3, Cluster)         # prime n: no usable mesh
+    cl4 = scenarios.make_cluster_auto(Config(n_nodes=32, seed=1))
+    assert isinstance(cl4, Cluster)         # below threshold
+
+
+# ---------------------------------------------------------------------------
+# The per-device memory meter + the pinned 1M budget
+# ---------------------------------------------------------------------------
+
+def test_state_memory_rows_exact():
+    """The census's byte accounting is exact: sharded leaves divide by
+    the mesh size, replicated leaves don't, planes sum to the total."""
+    from partisan_tpu.lint import cost as cost_mod
+    from partisan_tpu.models.plumtree import Plumtree
+    from partisan_tpu.parallel.sharded import ShardedCluster, make_mesh
+
+    cfg = support.hv_config(64, seed=1, health=4, health_ring=8,
+                            partition_mode="groups")
+    sc = ShardedCluster(cfg, make_mesh(8), model=Plumtree())
+    state = jax.eval_shape(sc._build_init)
+    rows = cost_mod.state_memory_rows(state, sc._state_specs(state), 8)
+    by = {r["plane"]: r["mib_per_device"] for r in rows}
+    # manager.active [64, 6] int32 sharded 8 ways = 192 B/device; the
+    # manager row also carries passive/join/heartbeat leaves — check
+    # the exact hand sum of the hyparview state instead of one leaf
+    import jax.tree_util as jtu
+
+    want = sum(
+        leaf.dtype.itemsize * int(np.prod(leaf.shape)) // 8
+        for leaf in jtu.tree_leaves(state.manager)) / 2**20
+    assert abs(by["manager"] - want) < 1e-3
+    # faults (replicated): alive bool[64] + partition int32[64] +
+    # link_drop f32 = 64 + 256 + 4 bytes, NOT divided by 8
+    assert abs(by["faults"] - (64 + 256 + 4) / 2**20) < 1e-3
+    assert abs(by["total"] - sum(v for k, v in by.items()
+                                 if k != "total")) < 1e-2
+
+
+def test_dry_1m_budget_holds():
+    """The 1M-node readiness gate, tier-1: the sharded round's
+    per-device carry residency on the 8-way mesh stays within the
+    pinned budget AND the replicated-node-axis audit is clean — the
+    O(n) HBM regression class cannot land silently (bench.py --dry-1m
+    is the CLI face of this same check)."""
+    from partisan_tpu.lint import cost as cost_mod
+    from partisan_tpu.lint import cost_budgets
+
+    card = cost_mod.dry_1m_report(cost_budgets.DRY_1M["n"])
+    assert card["verdict"] == "PASS", card
+    assert card["within_budget"], card
+    assert card["replicated_node_axis"]["findings"] == 0, card
+    # budget freshness: a big unpinned improvement would let the next
+    # regression land silently (the cost-budget stale discipline)
+    assert card["state_mib_per_device"] >= \
+        0.5 * cost_budgets.DRY_1M["state_mib_per_device"], card
+
+
+def test_replicated_node_axis_rule_fires(mesh8):
+    """A rule that cannot fail is not a guard: a shard_map body that
+    all-gathers an [n, 2] matrix fires; the vector-only twin is clean
+    (replicated vectors are the sanctioned cross-shard state)."""
+    from partisan_tpu import lint
+
+    cfg = Config(n_nodes=64, seed=1)
+
+    def bad(x):                       # x: [n_local, 2] -> [n, 2]
+        g = jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
+        return jnp.sum(g * 2, axis=0)
+
+    def good(x):                      # vector halo: [n] only
+        g = jax.lax.all_gather(x[:, 0], AXIS, axis=0, tiled=True)
+        return jnp.sum(g * 2)[None]
+
+    x = jnp.zeros((64, 2), jnp.int32)
+    for fn, out_spec, expect in ((bad, P(), True), (good, P(), False)):
+        prog = lint.trace_program(
+            "fixture", _shard_map(fn, mesh8, in_specs=(P(AXIS),),
+                                  out_specs=out_spec), x, cfg)
+        rep = lint.run_programs([prog], rules=["replicated-node-axis"],
+                                package_rules=[], waivers={})
+        assert bool(rep.findings) == expect, (fn.__name__, rep.findings)
+    # and outside any shard_map the rule never judges (single-device
+    # programs materialize [n, ·] by design)
+    prog = lint.trace_program(
+        "plain", lambda x: jnp.tile(x, (1, 3)), x, cfg)
+    rep = lint.run_programs([prog], rules=["replicated-node-axis"],
+                            package_rules=[], waivers={})
+    assert not rep.findings

@@ -32,6 +32,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The matrix's sharded (shard_map) programs need a multi-device host
+# platform — the one shared pin (partisan_tpu/hostmesh.py).
+from partisan_tpu.hostmesh import force_host_devices
+
+force_host_devices()
+
 USAGE = "usage: jaxlint.py [--quick] [--rules r1,r2] [--no-stale]"
 
 

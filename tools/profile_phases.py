@@ -20,6 +20,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# --cost --budgets re-traces the lint matrix, whose sharded entries
+# need a multi-device host platform — the one shared pin
+# (partisan_tpu/hostmesh.py); harmless on the TPU path (host-platform
+# flag only).
+from partisan_tpu.hostmesh import force_host_devices
+
+force_host_devices()
+
 import jax
 import jax.numpy as jnp
 import numpy as np

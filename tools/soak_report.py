@@ -177,6 +177,17 @@ def main() -> None:
             flight_rounds=8 if breach else 0, **ctl), model=Plumtree())
 
     cl = mk()
+    # The per-run memory card (the bench artifact's `memory` sibling):
+    # per-plane resident bytes of the scan carry, censused abstractly
+    # (jax.eval_shape — no device buffers) so every soak records the
+    # HBM footprint its config pins for the whole horizon.
+    from partisan_tpu.lint import cost as cost_mod
+
+    mem_rows = cost_mod.resident_memory_rows(
+        jax.eval_shape(cl._build_init))
+    print(json.dumps({"kind": "memory",
+                      "mib_resident": mem_rows[-1]["mib_per_device"],
+                      "planes": mem_rows[:-1]}))
     # The canonical batched staggered bootstrap (K_PROG-grained waves +
     # settle), not a re-implementation that would drift from it.
     from partisan_tpu.scenarios import _boot_overlay
