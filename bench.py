@@ -718,11 +718,29 @@ def main() -> None:
     }))
 
 
+def fleet(argv) -> None:
+    """``bench.py --fleet W [n]``: the vmapped fleet sweep — W
+    independent clusters in ONE jitted program (partisan_tpu/fleet.py),
+    emitting the distribution card (p5/p50/p95 rounds-to-converge,
+    redundancy ratio, per-channel p99 across the member population)
+    instead of a single-seed point.  Defaults: W=8 members of n=256."""
+    from partisan_tpu import scenarios
+
+    sizes = [int(a) for a in argv if not a.startswith("--")]
+    width = sizes[0] if sizes else 8
+    n = sizes[1] if len(sizes) > 1 else 256
+    card = scenarios.fleet_sweep(width=width, n=n)
+    print(json.dumps(card))
+    raise SystemExit(0 if card["converged"] == card["width"] else 1)
+
+
 if __name__ == "__main__":
     if "--dry-1m" in sys.argv:
         # 1M-node readiness: abstract census on the 8-way host mesh —
         # no TPU, no compile, ~2 s.  Must run before any backend use.
         dry_1m([a for a in sys.argv[1:] if a != "--dry-1m"])
+    elif "--fleet" in sys.argv:
+        fleet([a for a in sys.argv[1:] if a != "--fleet"])
     elif len(sys.argv) >= 3 and sys.argv[1] == "--one":
         if "--cache-dir" in sys.argv:
             # cold-start knob: point THIS run at a caller-chosen

@@ -35,6 +35,8 @@ src/partisan_peer_service.erl):
   overlay self-healing escalation — `Config.control`)
 - :mod:`partisan_tpu.soak` — chunked long-horizon soak engine
   (crash-safe checkpoint/resume + fault-storm timelines)
+- :mod:`partisan_tpu.fleet` — vmapped cluster populations (batched
+  fault-schedule search, controller-band tuning, distribution sweeps)
 - :mod:`partisan_tpu.parallel` — shard_map multi-device execution
 - :mod:`partisan_tpu.bridge` — Erlang port bridge (ETF + server)
 - :mod:`partisan_tpu.scenarios` — the five driver benchmark configs

@@ -101,6 +101,16 @@ class ClusterState(NamedTuple):
     #                         SetChurn storm actions script, so flash
     #                         crowds and diurnal ramps checkpoint and
     #                         replay with the fault timeline.
+    salt: Any = ()          # uint32 scalar — per-run seed salt (or ()
+    #                         when Config.salt_operand is off).  The
+    #                         round's every stochastic draw keys off
+    #                         the EFFECTIVE seed cfg.seed + salt, so
+    #                         one program serves any seed — what lets
+    #                         fleet.Fleet vmap W independent clusters
+    #                         (each member's salt is its stream
+    #                         namespace) and what makes a member
+    #                         bit-identical to the unbatched run at
+    #                         Config(seed=cfg.seed + salt).
 
 
 class TraceRound(NamedTuple):
@@ -132,6 +142,16 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     fx = latency_mod.flight_enabled(cfg) and state.flight != ()
     wx = cfg.width_operand  # static: active-prefix masking
     tx = workload_mod.enabled(cfg)  # static: open-loop traffic plane
+    # Effective seed (Config.salt_operand): every per-round stochastic
+    # draw below keys off cfg.seed + state.salt instead of the static
+    # cfg.seed, so ONE round program serves any seed — the fleet
+    # runner's stream namespace (fleet.py).  uint32 wraparound equals
+    # the static path's mod-2**32, so salt=0 is bit-identical to the
+    # unsalted round and salt=s to a native Config(seed=cfg.seed + s)
+    # run (tests/test_fleet.py pins both).
+    seed = cfg.seed
+    if cfg.salt_operand:
+        seed = jnp.uint32(cfg.seed) + jnp.asarray(state.salt, jnp.uint32)
     if tx and cfg.traffic.churn:
         # In-scan diurnal churn: one birth/death tick at the carried
         # probability, applied at ROUND START so this round's ctx and
@@ -141,9 +161,9 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         with jax.named_scope("round.traffic"):
             state = state._replace(faults=workload_mod.churn(
                 cfg, state.traffic, state.faults, state.rnd,
-                state.n_active))
+                state.n_active, seed=seed))
     gids = comm.local_ids()
-    keys = rng.node_keys(cfg.seed, state.rnd, gids)
+    keys = rng.node_keys(seed, state.rnd, gids)
     alive_local = jax.lax.dynamic_slice(
         state.faults.alive, (comm.node_offset,), (comm.n_local,))
     # Active-prefix masking (Config.width_operand): rows with gid >=
@@ -164,7 +184,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     cx = control_mod.enabled(cfg)   # static: in-scan feedback loops
     ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
                    inbox=state.inbox, faults=state.faults,
-                   n_active=state.n_active, control=state.control)
+                   n_active=state.n_active, control=state.control,
+                   seed=seed)
 
     # jax.named_scope labels each phase in the HLO, so profiler traces
     # (tools/profile_round.py under jax.profiler) map to round phases.
@@ -305,7 +326,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                     (comm.n_local,))
                 cut = faults_mod.wire_cut_from_info(
                     faults_wire, info_d, kind_w != 0, gids, dst_w,
-                    alive_local, group_l, cfg.seed, state.rnd,
+                    alive_local, group_l, seed, state.rnd,
                     _MSG_FILTER_TAG)
                 final = emc.at[..., 0].set(jnp.where(cut, 0, kind_w))
                 out = (comm.route(final), shed_n)
@@ -452,7 +473,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         with jax.named_scope("round.fault"):
             sent = emitted
             emitted = faults_mod.filter_msgs(
-                faults_wire, emitted, cfg.seed, state.rnd,
+                faults_wire, emitted, seed, state.rnd,
                 _MSG_FILTER_TAG)
             fault_dropped = (sent[..., 0] != 0) & (emitted[..., 0] == 0)
         # THE plane->wire interleave: capture/flight need the trace's
@@ -663,7 +684,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                        outbox=obstate, metrics=mets, latency=lt,
                        flight=fstate, n_active=state.n_active,
                        health=hstate, provenance=pv, control=ctrl,
-                       traffic=tstate)
+                       traffic=tstate, salt=state.salt)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent_wire,
                                dropped=fault_dropped)
@@ -682,6 +703,19 @@ def activate(state: ClusterState, width) -> ClusterState:
             "activate() needs Config.width_operand=True (the state "
             "carries no n_active operand)")
     return state._replace(n_active=jnp.asarray(width, jnp.int32))
+
+
+def with_salt(state: ClusterState, salt) -> ClusterState:
+    """Set the per-run seed salt (Config.salt_operand runs): the
+    round's stochastic draws key off ``cfg.seed + salt``.  A dynamic
+    operand change, so NO retrace — the same program serves every
+    seed (the salted sibling of :func:`activate`).  A run at salt=s is
+    bit-identical to a native ``Config(seed=cfg.seed + s)`` run."""
+    if isinstance(state.salt, tuple):
+        raise ValueError(
+            "with_salt() needs Config(salt_operand=True) (the state "
+            "carries no salt operand)")
+    return state._replace(salt=jnp.asarray(salt, jnp.uint32))
 
 
 def active_alive(state: ClusterState) -> Array:
@@ -799,6 +833,7 @@ class Cluster:
                      if control_mod.enabled(cfg) else ()),
             traffic=(workload_mod.init(cfg)
                      if workload_mod.enabled(cfg) else ()),
+            salt=(jnp.uint32(0) if cfg.salt_operand else ()),
         )
 
     def _build_init(self) -> ClusterState:

@@ -438,6 +438,36 @@ class Config:
     #                              operand (tests/test_program_budget.py
     #                              enforces this).
 
+    # --- fleet runner (fleet.py) ---------------------------------------
+    salt_operand: bool = False   # carry a per-run SEED SALT as a dynamic
+    #                              uint32 scalar in ClusterState (salt):
+    #                              every per-round counter-hash and
+    #                              threefry draw keys off the effective
+    #                              seed ``cfg.seed + salt`` instead of
+    #                              the static ``cfg.seed``, so one round
+    #                              program serves any seed — the batch
+    #                              analogue of width_operand.  Contract
+    #                              (tests/test_fleet.py): salt=0 is
+    #                              bit-identical to salt_operand=False,
+    #                              and salt=s to an unbatched run at
+    #                              Config(seed=cfg.seed + s).  Off = the
+    #                              ClusterState leaf is () and the round
+    #                              is bit-identical to before.  Static
+    #                              link GEOMETRY (distance.link_cost)
+    #                              deliberately stays keyed on cfg.seed:
+    #                              fleet members share a world, not a
+    #                              random stream.
+    fleet_width: int = 0         # >0: this config describes one MEMBER
+    #                              of a W-wide vmapped fleet
+    #                              (fleet.Fleet) — the round program
+    #                              itself never reads it; it exists so
+    #                              checkpoint fingerprints distinguish a
+    #                              fleet state (leading [W] batch axis
+    #                              on every leaf but rnd) from a member
+    #                              state, and between widths.  Requires
+    #                              salt_operand (members without
+    #                              independent streams would correlate).
+
     # --- fault-state representation ------------------------------------
     partition_mode: str = "auto"  # auto | dense | groups — dense bool[n,n]
     #                               supports arbitrary edge cuts; groups
@@ -610,6 +640,14 @@ class Config:
             if self.traffic.ring < 1:
                 raise ValueError(
                     f"traffic.ring must be >= 1, got {self.traffic.ring}")
+        if self.fleet_width < 0:
+            raise ValueError(
+                f"fleet_width must be >= 0, got {self.fleet_width}")
+        if self.fleet_width and not self.salt_operand:
+            raise ValueError(
+                "fleet_width > 0 needs salt_operand=True — fleet "
+                "members without a per-cluster seed salt would share "
+                "every fault/arrival stream (fleet.Fleet sets both)")
         if self.control.healing and self.health <= 0:
             raise ValueError(
                 "control.healing keys repair cadences off the health "

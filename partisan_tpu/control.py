@@ -85,7 +85,12 @@ _BIG = jnp.int32(2**30)
 class FanoutState(NamedTuple):
     """Plumtree eager-fanout governor (replicated).
 
-    ``R`` = Config.control.ring."""
+    ``R`` = Config.control.ring.  The ``band_*`` leaves are the
+    governor's hysteresis BANDS promoted from ControlConfig statics to
+    dynamic operands (initialized from the config, so an untouched
+    state behaves bit-identically): the fleet runner's population-based
+    tuner (fleet.tune) stacks a different band vector per vmapped
+    member, evaluating a whole band population in ONE program."""
 
     eager_cap: Array    # int32 — eager links allowed per (node, tree)
     win_dup: Array      # int32 — duplicates in the current window
@@ -94,27 +99,37 @@ class FanoutState(NamedTuple):
     adjustments: Array  # int32 — cap changes over the whole run
     rnd: Array          # int32[R] — decision-ring round labels (-1)
     cap: Array          # int32[R] — cap in force after each round
+    band_min: Array     # int32 — ControlConfig.fanout_min operand
+    band_hi: Array      # int32 — ControlConfig.fanout_hi_pct operand
+    band_lo: Array      # int32 — ControlConfig.fanout_lo_pct operand
+    band_graft: Array   # int32 — ControlConfig.graft_hi_pct operand
 
 
 class BackpressureState(NamedTuple):
     """Per-channel shed-pressure integrator (replicated).
 
-    ``C`` = Config.n_channels, ``R`` = Config.control.ring."""
+    ``C`` = Config.n_channels, ``R`` = Config.control.ring; ``band_*``
+    are the age bands as dynamic operands (see FanoutState)."""
 
     press: Array        # int32[C] — pressure level per channel
     adjustments: Array  # int32 — pressure-level changes, whole run
     rnd: Array          # int32[R]
     press_ring: Array   # int32[R, C] — pressure after each round
+    band_age_hi: Array  # int32 — ControlConfig.age_hi operand
+    band_age_lo: Array  # int32 — ControlConfig.age_lo operand
 
 
 class HealingState(NamedTuple):
-    """Overlay repair-escalation state (replicated)."""
+    """Overlay repair-escalation state (replicated); ``band_*`` are the
+    escalation bands as dynamic operands (see FanoutState)."""
 
     boost: Array        # int32 — cadence right-shift in force (0 = base)
     streak: Array       # int32 — consecutive healthy snapshots
     adjustments: Array  # int32 — boost changes, whole run
     rnd: Array          # int32[R]
     boost_ring: Array   # int32[R] — boost after each round
+    band_boost: Array   # int32 — ControlConfig.heal_boost operand
+    band_hold: Array    # int32 — ControlConfig.heal_hold operand
 
 
 class ControlState(NamedTuple):
@@ -142,24 +157,33 @@ def init(cfg: Config) -> ControlState:
     R = cfg.control.ring
     ring = jnp.full((R,), -1, jnp.int32)
     fan, bp, heal = (), (), ()
-    if cfg.control.fanout:
+    c = cfg.control
+    if c.fanout:
         fan = FanoutState(
             eager_cap=jnp.int32(_overlay_width(cfg)),
             win_dup=jnp.int32(0), win_gossip=jnp.int32(0),
             win_graft=jnp.int32(0),
             adjustments=jnp.int32(0),
-            rnd=ring, cap=jnp.zeros((R,), jnp.int32))
-    if cfg.control.backpressure:
+            rnd=ring, cap=jnp.zeros((R,), jnp.int32),
+            band_min=jnp.int32(c.fanout_min),
+            band_hi=jnp.int32(c.fanout_hi_pct),
+            band_lo=jnp.int32(c.fanout_lo_pct),
+            band_graft=jnp.int32(c.graft_hi_pct))
+    if c.backpressure:
         C = cfg.n_channels
         bp = BackpressureState(
             press=jnp.zeros((C,), jnp.int32),
             adjustments=jnp.int32(0),
-            rnd=ring, press_ring=jnp.zeros((R, C), jnp.int32))
-    if cfg.control.healing:
+            rnd=ring, press_ring=jnp.zeros((R, C), jnp.int32),
+            band_age_hi=jnp.int32(c.age_hi),
+            band_age_lo=jnp.int32(c.age_lo))
+    if c.healing:
         heal = HealingState(
             boost=jnp.int32(0), streak=jnp.int32(0),
             adjustments=jnp.int32(0),
-            rnd=ring, boost_ring=jnp.zeros((R,), jnp.int32))
+            rnd=ring, boost_ring=jnp.zeros((R,), jnp.int32),
+            band_boost=jnp.int32(c.heal_boost),
+            band_hold=jnp.int32(c.heal_hold))
     return ControlState(fanout=fan, backpressure=bp, healing=heal)
 
 
@@ -172,11 +196,10 @@ def shed_age(cfg: Config, bp: BackpressureState) -> Array:
     """int32[C]: the per-channel stale-shed age threshold the capacity
     outbox applies this round (channels.throttle ``shed_age``).  Zero
     pressure = no shedding (threshold past any real age); each level
-    halves the threshold from ``age_hi`` down to a floor of 1 round."""
-    c = cfg.control
+    halves the threshold from the carried ``band_age_hi`` operand down
+    to a floor of 1 round."""
     floor = jnp.maximum(jnp.int32(1),
-                        jnp.int32(c.age_hi) >> jnp.maximum(
-                            bp.press - 1, 0))
+                        bp.band_age_hi >> jnp.maximum(bp.press - 1, 0))
     return jnp.where(bp.press > 0, floor, _BIG)
 
 
@@ -227,21 +250,25 @@ def _fanout_update(cfg: Config, fs: FanoutState, rnd: Array,
     w_gos = fs.win_gossip + pv.gossip[slot]
     w_gra = fs.win_graft + pv.ctl[slot, CTL_NAMES.index("graft"), 1]
 
+    # Bands read from the CARRIED operands (fs.band_*, initialized from
+    # ControlConfig — fleet.tune stacks a population of them), not the
+    # config statics, so a vmapped fleet evaluates W band settings in
+    # one program.
     evaluate = jnp.mod(rnd + 1, c.fanout_every) == 0
     measurable = w_gos >= c.fanout_gossip_min
-    hot = measurable & (w_dup * 100 >= c.fanout_hi_pct * w_gos)
-    storm = measurable & (w_gra * 100 >= c.graft_hi_pct * w_gos)
-    cold = measurable & (w_dup * 100 <= c.fanout_lo_pct * w_gos)
+    hot = measurable & (w_dup * 100 >= fs.band_hi * w_gos)
+    storm = measurable & (w_gra * 100 >= fs.band_graft * w_gos)
+    cold = measurable & (w_dup * 100 <= fs.band_lo * w_gos)
     promote = evaluate & (storm | cold)
     demote = evaluate & hot & ~promote
     cap = jnp.clip(
         fs.eager_cap + promote.astype(jnp.int32)
         - demote.astype(jnp.int32),
-        c.fanout_min, _overlay_width(cfg))
+        fs.band_min, _overlay_width(cfg))
     stepped = cap != fs.eager_cap
     rslot = jnp.mod(rnd, c.ring)
     zero = jnp.int32(0)
-    return FanoutState(
+    return fs._replace(
         eager_cap=cap,
         win_dup=jnp.where(evaluate, zero, w_dup),
         win_gossip=jnp.where(evaluate, zero, w_gos),
@@ -259,13 +286,13 @@ def _backpressure_update(cfg: Config, bp: BackpressureState, rnd: Array,
     decays it — a bounded integrator, so a transient spike sheds for a
     few rounds and a quiet channel relaxes back to no-shed."""
     c = cfg.control
-    up = chmax >= c.age_hi
-    down = chmax <= c.age_lo
+    up = chmax >= bp.band_age_hi
+    down = chmax <= bp.band_age_lo
     press = jnp.clip(bp.press + up.astype(jnp.int32)
                      - down.astype(jnp.int32), 0, c.press_max)
     changed = jnp.sum((press != bp.press).astype(jnp.int32))
     rslot = jnp.mod(rnd, c.ring)
-    return BackpressureState(
+    return bp._replace(
         press=press,
         adjustments=bp.adjustments + changed,
         rnd=bp.rnd.at[rslot].set(rnd),
@@ -290,12 +317,12 @@ def _healing_update(cfg: Config, hs: HealingState, rnd: Array,
     degraded = valid & ((word & ok_bits) != ok_bits)
     streak_s = jnp.where(degraded, 0, hs.streak + valid.astype(jnp.int32))
     boost_s = jnp.where(
-        degraded, jnp.int32(c.heal_boost),
-        jnp.where(streak_s >= c.heal_hold, jnp.int32(0), hs.boost))
+        degraded, hs.band_boost,
+        jnp.where(streak_s >= hs.band_hold, jnp.int32(0), hs.boost))
     boost = jnp.where(due, boost_s, hs.boost)
     streak = jnp.where(due, streak_s, hs.streak)
     rslot = jnp.mod(rnd, c.ring)
-    return HealingState(
+    return hs._replace(
         boost=boost, streak=streak,
         adjustments=hs.adjustments + (boost != hs.boost).astype(jnp.int32),
         rnd=hs.rnd.at[rslot].set(rnd),
@@ -330,21 +357,19 @@ def update(cfg: Config, cs: ControlState, *, rnd: Array, pv=None,
 
 def poll(cs: ControlState) -> dict:
     """Tiny host summary of the controllers' CURRENT operands (a few
-    scalar transfers — what soak chunk rows carry)."""
-    import jax as _jax
+    scalar transfers — what soak chunk rows carry).  Scalar leaves of a
+    FLEET state (fleet.py) arrive with a leading member axis and are
+    reported as per-member lists."""
+    from partisan_tpu.metrics import host_int
 
     out: dict = {}
     if cs.fanout != ():
-        out["eager_cap"] = int(_jax.device_get(cs.fanout.eager_cap))
-        out["fanout_adjustments"] = int(
-            _jax.device_get(cs.fanout.adjustments))
+        out["eager_cap"] = host_int(cs.fanout.eager_cap)
+        out["fanout_adjustments"] = host_int(cs.fanout.adjustments)
     if cs.backpressure != ():
-        import numpy as np
-
-        out["press"] = np.asarray(
-            _jax.device_get(cs.backpressure.press)).astype(int).tolist()
+        out["press"] = host_int(cs.backpressure.press)
     if cs.healing != ():
-        out["heal_boost"] = int(_jax.device_get(cs.healing.boost))
+        out["heal_boost"] = host_int(cs.healing.boost)
     return out
 
 

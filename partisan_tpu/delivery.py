@@ -500,7 +500,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                 is_rst[:, None, :]
                 & (h_dst[:, :, None] == inb[..., T.W_SRC][:, None, :])
             ).any(axis=2)                                          # [n, H]
-            reopen_ep = (rng_ops.rank32(cfg.seed, ctx.rnd,
+            reopen_ep = (rng_ops.rank32(ctx.seed, ctx.rnd,
                                         _P2P_REOPEN_TAG + pi,
                                         gids[:, None], jnp.maximum(h_dst, 0))
                          % jnp.uint32(_EPOCH_MASK) + 1).astype(jnp.int32)
@@ -618,7 +618,7 @@ def outbound(cfg: Config, comm, st: DeliveryState, emitted: Array,
                                 jnp.take_along_axis(dst_seq0, b, axis=1), 0)
             cur_ep = jnp.where(tracked,
                                jnp.take_along_axis(dst_ep0, b, axis=1), 0)
-            fresh_ep = (rng_ops.rank32(cfg.seed, ctx.rnd, _P2P_EPOCH_TAG + pi,
+            fresh_ep = (rng_ops.rank32(ctx.seed, ctx.rnd, _P2P_EPOCH_TAG + pi,
                                        gids[:, None], jnp.maximum(d, 0))
                         % jnp.uint32(_EPOCH_MASK) + 1).astype(jnp.int32)
             ep = jnp.where(tracked, cur_ep, fresh_ep)
@@ -824,7 +824,7 @@ def inbound(cfg: Config, comm, st: DeliveryState, inbox: exchange.Inbox,
             ctx.faults,
             jnp.broadcast_to(s_msg[None, :, T.W_SRC], (n, G)),
             jnp.where(s_valid[None, :], gids[:, None], -1),
-            cfg.seed, ctx.rnd, _CAUSAL_SALT + li)
+            ctx.seed, ctx.rnd, _CAUSAL_SALT + li)
         arr_ok = s_valid[None, :] & ~cut & ctx.alive[:, None]
 
         # Buffered candidates (already arrived in earlier rounds).

@@ -229,7 +229,7 @@ class DistanceService:
             # neighbor row is id-positional — a head slice would only
             # ever probe the lowest ids)
             gids = comm.local_ids()
-            r = rng.rank32(cfg.seed, ctx.rnd, _TAG_PROBE, gids[:, None],
+            r = rng.rank32(ctx.seed, ctx.rnd, _TAG_PROBE, gids[:, None],
                            jnp.arange(nbrs.shape[1])[None, :])
             sc = jnp.where(nbrs >= 0, r | jnp.uint32(1), jnp.uint32(0))
             v, top = jax.lax.top_k(sc, self.probe_k)

@@ -76,15 +76,27 @@ def _mix32(x: Array) -> Array:
     return x
 
 
-def edge_hash(seed: int, rnd: Array, salt: int, src: Array,
+def edge_hash(seed: int | Array, rnd: Array, salt: int, src: Array,
               dst: Array) -> Array:
     """Deterministic uint32 hash per (edge, round, call-site).  Mixing is
     cascaded (not one linear XOR-combine) so distinct edges can't collide
-    permanently across all rounds/salts."""
-    site = (seed * 0x27D4EB2F + salt) & 0xFFFFFFFF
+    permanently across all rounds/salts.
+
+    ``seed`` may be a traced uint32 scalar — the fleet runner's salted
+    per-cluster seed (``Config.salt_operand``; cluster.round_body passes
+    ``cfg.seed + state.salt``).  uint32 wraparound is exactly the Python
+    path's mod-2**32, so a traced seed numerically equal to a static one
+    draws the identical stream: the salt=0 member of a fleet is
+    bit-identical to the unbatched run, and the salt=s member to an
+    unbatched ``Config(seed=cfg.seed + s)`` run."""
+    if isinstance(seed, int):
+        site = jnp.uint32((seed * 0x27D4EB2F + salt) & 0xFFFFFFFF)
+    else:
+        site = (jnp.asarray(seed, jnp.uint32) * jnp.uint32(0x27D4EB2F)
+                + jnp.uint32(salt & 0xFFFFFFFF))
     h = _mix32(jnp.asarray(src, jnp.uint32) ^ jnp.uint32(0x9E3779B1))
     h = _mix32(h ^ jnp.asarray(dst, jnp.uint32))
-    h = _mix32(h ^ jnp.asarray(rnd, jnp.uint32) ^ jnp.uint32(site))
+    h = _mix32(h ^ jnp.asarray(rnd, jnp.uint32) ^ site)
     return h
 
 

@@ -230,20 +230,50 @@ class Checker:
                       base_trace=base.trace)
 
 
-def schedule_drops(schedule: Iterable[Coord], total: int, n: int,
-                   width: int):
-    """Compile a set of ``(absolute round, sender, emit slot)`` omission
-    coordinates into the ``bool[total, n, width]`` drops tensor an
+def schedule_drops(schedule, total: int, n: int, width: int):
+    """Compile omission coordinates into the drops tensor an
     ``interpose.OmissionSchedule`` executes — the translation between
     the checker's schedule representation and the interposition layer
     (a soak ``Omission`` action takes such a tensor plus its own
-    absolute ``start`` anchor).  Out-of-range coordinates raise: a
-    silently clipped omission would make the checker report a schedule
-    "tolerated" that it never actually ran."""
+    absolute ``start`` anchor).
+
+    Two input shapes:
+
+    - ONE schedule (an iterable of ``(absolute round, sender, emit
+      slot)`` coordinate tuples) compiles to ``bool[total, n, width]``;
+    - a BATCH of ``W`` schedules (an iterable whose elements are
+      themselves schedules) compiles to the STACKED
+      ``bool[W, total, n, width]`` tensor the fleet runner installs as
+      one vmapped state operand (fleet.search) — member ``w`` of the
+      leading axis executes exactly ``schedule_drops(schedules[w],
+      ...)``.
+
+    FRAME CONVENTION (shared with ``interpose.OmissionSchedule`` and
+    the soak ``Omission`` action): row ``t`` of the round axis applies
+    at absolute round ``start + t`` of the executing cluster
+    (``start=0`` here — coordinates are absolute rounds); rounds at or
+    past ``total`` pass everything through (schedules are finite
+    windows — a schedule SHORTER than the execution horizon omits
+    nothing in its tail, by design, never by broadcast).  Out-of-range
+    coordinates raise: a silently clipped omission would make the
+    checker report a schedule "tolerated" that it never actually ran.
+    """
     import numpy as np
 
+    sched = list(schedule)
+
+    def is_coord(c):
+        # a coordinate is any 3-sequence of ints (tuples from the
+        # trace, lists from JSON) — anything else is a nested schedule
+        return (isinstance(c, (tuple, list)) and len(c) == 3
+                and all(isinstance(x, (int, np.integer)) for x in c))
+
+    if sched and not is_coord(sched[0]):
+        # batch of schedules -> stacked [W, total, n, width]
+        return np.stack([schedule_drops(s, total, n, width)
+                         for s in sched])
     drops = np.zeros((total, n, width), np.bool_)
-    for (r, s, e) in schedule:
+    for (r, s, e) in sched:
         if e >= width:
             raise ValueError(f"emit slot {e} >= sched_width {width}; "
                              "raise sched_width")

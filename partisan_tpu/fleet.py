@@ -1,0 +1,668 @@
+"""Fleet runner: vmapped cluster populations (ROADMAP item 4).
+
+The whole cluster is a pure scan over dense pytree state, so the most
+jax-native scale move left after sharding the node axis (ROADMAP item
+2) is a batch axis over CLUSTERS: run W independent small/mid clusters
+as ONE jitted program — ``jax.vmap`` over ``cluster.round_body`` with a
+leading fleet axis threaded through every ``ClusterState`` leaf and
+every plane (metrics / latency / health / provenance / control /
+traffic).  Three things make the members genuinely independent inside
+one program, each a DYNAMIC OPERAND rather than a Python branch:
+
+- **per-cluster seeds** — ``Config.salt_operand`` carries a uint32
+  seed salt in the state; every per-round counter-hash and threefry
+  draw keys off the effective seed ``cfg.seed + salt`` (cluster.py),
+  so member ``j`` with salt ``s`` evolves bit-identically to an
+  unbatched run at ``Config(seed=cfg.seed + s)`` — the replay contract
+  every counterexample below leans on;
+- **per-cluster fault schedules** — an ``interpose.OmissionSchedule``
+  whose drops tensor is a state leaf: stacking it ``[W, T+1, n, E]``
+  gives each member its own Filibuster schedule under the same
+  ``apply()`` program (``filibuster.schedule_drops`` compiles a batch
+  of schedules to exactly this stack);
+- **per-cluster controller bands** — ControlConfig's hysteresis bands
+  ride the controller state as ``band_*`` operands (control.py), so a
+  band POPULATION is one stacked vector per band.
+
+The round counter ``rnd`` deliberately stays UNBATCHED (every member
+advances in lockstep — ``vmap in_axes=None``): host-side code that
+polls ``state.rnd`` (the soak engine's ``_sync``, checkpoint round
+metadata, storm timelines) works on a fleet state unchanged, and the
+round's cadence ``lax.cond`` predicates (health snapshots, quiet-round
+gates keyed on rnd) stay UNBATCHED conds instead of decaying to
+both-branch selects.
+
+Drivers:
+
+- :func:`search` — the batched Filibuster-style fault-schedule fuzzer:
+  a population of omission schedules runs as one program and each
+  member reduces through the existing oracle predicates (stats
+  conservation, ``health.overlay_ok``, model coverage, an optional
+  app-guarantee assertion) to a per-schedule pass/fail; every failing
+  schedule yields a :class:`Counterexample` that replays standalone —
+  bit-identical — through the unbatched ``Cluster`` path.
+- :func:`tune` — population-based controller-band search: one band
+  setting per member over the CONTROL_AB fanout harness's workload,
+  scored by the same deterministic steady-state redundancy /coverage
+  metrics as the committed CONTROL_AB.json.
+- ``scenarios.fleet_sweep`` / ``bench.py --fleet W n`` — distribution
+  cards (p5/p50/p95 rounds-to-converge, redundancy, per-channel p99)
+  over a seed population, the statistical-evaluation axis Leitão et
+  al. (SRDS'07) use for Plumtree.
+
+Storm/Traffic timelines compose through the soak engine unchanged:
+wrap any ``soak.Action`` / workload action in :class:`Member` to hit
+one member (or :class:`AllMembers` for the whole fleet) — a raw action
+applied to a fleet state would replace batched ``[W]`` leaves with
+scalars and is therefore never legal.  The soak engine itself drives a
+``Fleet`` like any cluster (``steps``/``init``/``rebuild``/``cfg``):
+chunk rows poll per-member digest lists, the generic invariants check
+every member, and checkpoints fingerprint ``Config.fleet_width`` so a
+fleet snapshot can never silently restore into a member template.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from partisan_tpu import filibuster as filibuster_mod
+from partisan_tpu import health as health_mod
+from partisan_tpu import interpose as interpose_mod
+from partisan_tpu.cluster import Cluster, ClusterState
+from partisan_tpu.config import Config
+
+
+def _member_axes() -> ClusterState:
+    """The vmap in/out axes tree: every leaf batched on the leading
+    fleet axis EXCEPT the round counter (unbatched — lockstep by
+    construction, see module doc)."""
+    kw = {f: 0 for f in ClusterState._fields}
+    kw["rnd"] = None
+    return ClusterState(**kw)
+
+
+@dataclasses.dataclass
+class Fleet:
+    """W independent clusters as one vmapped program.
+
+    Construction mirrors :class:`Cluster` (manager/model/interpose are
+    static and specialize the trace); the batched state comes from
+    :meth:`init`, whose ``salts`` vector (default ``arange(W)``) is
+    each member's seed-stream namespace.  ``cfg`` is normalized to
+    ``salt_operand=True, fleet_width=W`` — :attr:`member_cfg`
+    (``fleet_width=0``) is the config of the unbatched twin that
+    counterexample replay and the fleet-vs-loop parity tests run.
+    Single-device only (LocalComm): members batch on one chip; the
+    node-sharded path (parallel/sharded.py) is the orthogonal axis."""
+
+    cfg: Config
+    width: int
+    manager: Any = None
+    model: Any = None
+    interpose: Any = None
+    donate: bool = False
+
+    def __post_init__(self) -> None:
+        if self.width < 1:
+            raise ValueError(f"fleet width must be >= 1, got {self.width}")
+        if self.cfg.fleet_width not in (0, self.width):
+            raise ValueError(
+                f"Config.fleet_width={self.cfg.fleet_width} disagrees "
+                f"with Fleet(width={self.width})")
+        self.cfg = self.cfg.replace(salt_operand=True,
+                                    fleet_width=self.width)
+        self._user_interpose = self.interpose
+        # The unbatched member twin: source of the round program the
+        # fleet vmaps, of state templates, and of the counterexample
+        # replay path.  Its config differs ONLY in fleet_width (which
+        # the round never reads), so member state slices are leaf-wise
+        # compatible with its own states.
+        self.member = Cluster(self.cfg.replace(fleet_width=0),
+                              manager=self.manager, model=self.model,
+                              interpose=self.interpose)
+        self.manager = self.member.manager
+        self.model = self.member.model
+        self.interpose = self.member.interpose
+        self.comm = self.member.comm
+        self._axes = _member_axes()
+        self._round_v = jax.vmap(self.member._round,
+                                 in_axes=(self._axes,),
+                                 out_axes=self._axes)
+        self._steps = jax.jit(self._scan, static_argnums=1,
+                              donate_argnums=(0,) if self.donate else ())
+        self._step = jax.jit(self._round_v)
+        self._init = jax.jit(self._build_init)
+
+    # ---- properties ---------------------------------------------------
+    @property
+    def member_cfg(self) -> Config:
+        return self.member.cfg
+
+    # ---- state construction -------------------------------------------
+    def _build_init(self, salts) -> ClusterState:
+        base = self.member._build_init()
+        W = self.width
+
+        def bcast(x):
+            x = jnp.asarray(x)
+            return jnp.broadcast_to(x[None], (W,) + x.shape)
+
+        vals = {
+            f: (getattr(base, f) if f == "rnd"
+                else jax.tree.map(bcast, getattr(base, f)))
+            for f in ClusterState._fields}
+        return ClusterState(**vals)._replace(
+            salt=jnp.asarray(salts, jnp.uint32))
+
+    def init(self, salts=None) -> ClusterState:
+        """Batched initial state (one jitted program).  ``salts``
+        (int[W], default ``arange(W)``) namespaces each member's
+        fault/arrival/gossip streams: member j is bit-identical to an
+        unbatched run at ``Config(seed=cfg.seed + salts[j])``.  Equal
+        salts are legal and meaningful — schedule search wants members
+        that differ ONLY in their schedule operand."""
+        if salts is None:
+            salts = np.arange(self.width, dtype=np.uint32)
+        salts = np.asarray(salts, np.uint32)
+        if salts.shape != (self.width,):
+            raise ValueError(
+                f"salts must be shape ({self.width},), got {salts.shape}")
+        return self._init(jnp.asarray(salts))
+
+    # ---- the vmapped round --------------------------------------------
+    def _scan(self, state: ClusterState, k: int) -> ClusterState:
+        return jax.lax.scan(
+            lambda s, _: (self._round_v(s), None), state, None, length=k
+        )[0]
+
+    # ---- public API (the Cluster surface the soak engine drives) ------
+    def step(self, state: ClusterState) -> ClusterState:
+        return self._step(state)
+
+    def steps(self, state: ClusterState, k: int) -> ClusterState:
+        """Advance every member k rounds as ONE XLA program."""
+        return self._steps(state, k)
+
+    def run_chunked(self, state: ClusterState, k: int,
+                    chunk: int = 0) -> ClusterState:
+        from partisan_tpu import soak as soak_mod
+
+        return soak_mod.run(self, state, k, chunk=chunk)
+
+    def rebuild(self) -> "Fleet":
+        """Fresh jitted programs (the soak engine's fresh-context
+        factory after a worker crash — Cluster.rebuild's contract)."""
+        return Fleet(self.cfg, width=self.width, manager=self.manager,
+                     model=self.model, interpose=self._user_interpose,
+                     donate=self.donate)
+
+    def programs(self) -> int:
+        """Distinct compiled ``steps`` programs so far — the jit-cache
+        guard a W-member run asserts stays 1 (no per-member retrace:
+        schedules, salts and bands are operands, not trace constants)."""
+        return self._steps._cache_size()
+
+    # ---- member access -------------------------------------------------
+    def member_state(self, state: ClusterState, j: int) -> ClusterState:
+        """Member j's unbatched ClusterState (``rnd`` passes through —
+        it is shared).  Leaf-compatible with ``self.member`` states:
+        the slice of a fleet run IS a state of the unbatched twin."""
+        vals = {
+            f: (getattr(state, f) if f == "rnd"
+                else jax.tree.map(lambda x: x[j], getattr(state, f)))
+            for f in ClusterState._fields}
+        return ClusterState(**vals)
+
+    def set_member(self, state: ClusterState, j: int,
+                   sub: ClusterState) -> ClusterState:
+        """Write an (edited) member state back into the batch.  The
+        shared ``rnd`` is kept from ``state`` — members advance in
+        lockstep and no storm action may break that."""
+        vals = {}
+        for f in ClusterState._fields:
+            v = getattr(state, f)
+            if f == "rnd":
+                vals[f] = v
+            else:
+                vals[f] = jax.tree.map(
+                    lambda x, s: x.at[j].set(jnp.asarray(s)),
+                    v, getattr(sub, f))
+        return ClusterState(**vals)
+
+    def map_members(self, fn: Callable, *subtrees):
+        """vmap a per-member state transform over fleet-batched
+        subtree(s) — e.g. injecting a broadcast into every member:
+        ``st._replace(model=fleet.map_members(lambda m:
+        model.broadcast(m, 0, 0, 2), st.model))``."""
+        return jax.vmap(fn)(*subtrees)
+
+    def coverage(self, state: ClusterState, slot: int, version=1):
+        """float[W]: each member's model coverage for ``slot`` over its
+        own alive mask — the oracle predicate, batched."""
+        if self.model is None or not hasattr(self.model, "coverage"):
+            raise ValueError("fleet model has no coverage()")
+
+        def cov(ms, alive):
+            return self.model.coverage(ms, alive, slot, version=version)
+
+        return jax.vmap(cov)(state.model, state.faults.alive)
+
+    def member_latency(self, state: ClusterState, j: int,
+                       channels=None) -> dict:
+        """Member j's per-channel delivery-age percentiles (host-side;
+        the latency plane must be on)."""
+        from partisan_tpu import latency as latency_mod
+
+        if state.latency == ():
+            raise ValueError("latency plane is off")
+        ls = jax.tree.map(lambda x: x[j], state.latency)
+        return latency_mod.percentiles(ls, channels=channels)
+
+
+# ---------------------------------------------------------------------------
+# Per-member storm/timeline actions (soak.Storm composition)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Member:
+    """Apply a soak/workload action to ONE fleet member: the member is
+    sliced out, the inner action runs against the unbatched member twin
+    (so ``cluster.cfg`` / ``cluster.interpose`` mean what the action
+    expects), and the result scatters back.  This is how per-cluster
+    Storm/Traffic timelines compose: one ``soak.Storm`` whose events
+    carry ``Member(j, ...)`` wrappers — the schedule stays ONE timeline
+    under the soak engine's absolute-round boundary protocol, and a
+    serial run of member j with the bare inner actions replays the
+    identical trajectory (tests/test_fleet.py fleet-vs-loop parity).
+
+    Host-side action hashes (e.g. ``CrashBatch(frac=...)``) key off the
+    member twin's STATIC ``cfg.seed`` — identical for every member, so
+    decorrelate per-member frac-draws by varying the action's own
+    ``salt`` field; the in-scan streams are already namespaced by the
+    member's state salt."""
+
+    j: int
+    action: Any
+
+    def apply(self, fleet, state, rnd):
+        if not isinstance(fleet, Fleet):
+            raise ValueError(
+                "Member actions need the soak cluster to be a "
+                f"fleet.Fleet (got {type(fleet).__name__})")
+        if not 0 <= self.j < fleet.width:
+            raise ValueError(
+                f"member {self.j} outside fleet width {fleet.width}")
+        sub = fleet.member_state(state, self.j)
+        sub = self.action.apply(fleet.member, sub, rnd)
+        return fleet.set_member(state, self.j, sub)
+
+
+@dataclasses.dataclass(frozen=True)
+class AllMembers:
+    """Apply an action to EVERY member (a fleet-wide storm event).
+    Never apply a raw action to a fleet state directly: it would
+    overwrite batched ``[W]`` leaves with member-shaped values."""
+
+    action: Any
+
+    def apply(self, fleet, state, rnd):
+        for j in range(fleet.width):
+            state = Member(j, self.action).apply(fleet, state, rnd)
+        return state
+
+
+# ---------------------------------------------------------------------------
+# Batched Filibuster-style schedule search
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Counterexample:
+    """One failing schedule, extracted from the fleet run.  ``salt`` +
+    ``schedule`` fully determine the standalone reproduction: an
+    unbatched ``Cluster`` at the member config with ``with_salt(state,
+    salt)`` and this schedule's drops replays the member bit-for-bit
+    (``search(replay_check=True)`` asserts exactly that)."""
+
+    member: int
+    salt: int
+    schedule: frozenset
+    seed: int                 # effective seed = member cfg.seed + salt
+    oracle: dict              # the failing predicate values
+    replayed: bool = False    # unbatched replay verified bit-identical
+
+
+@dataclasses.dataclass
+class SearchResult:
+    passed: bool              # no schedule in the population failed
+    width: int
+    verdicts: list            # bool per schedule
+    oracle: dict              # per-predicate arrays over the population
+    counterexamples: list
+    programs: int             # distinct steps programs (must stay 1)
+    state: Any                # final batched state
+    state0: Any               # booted batched state (schedules installed)
+
+    def render(self) -> str:
+        n_fail = sum(1 for v in self.verdicts if not v)
+        if self.passed:
+            return (f"fleet.search: PASSED — {self.width} schedules, "
+                    f"one program x {self.programs} scan length(s)")
+        return (f"fleet.search: FAILED — {n_fail}/{self.width} "
+                f"schedules, members "
+                f"{[c.member for c in self.counterexamples]}")
+
+
+def population(trace, candidate=None, *, width: int, max_faults: int = 2,
+               seed: int = 0, include_empty: bool = True) -> list:
+    """Generate a deterministic schedule population from a golden
+    trace: ``width`` distinct ≤``max_faults``-subsets of the trace's
+    candidate omission coordinates (``filibuster.app_messages`` by
+    default) — the batched analogue of the serial Checker's
+    trace-guided enumeration, sized for one vmap instead of a loop."""
+    candidate = candidate or filibuster_mod.app_messages
+    cands = [(e.rnd, e.src, e.slot) for e in trace.events()
+             if not e.dropped and candidate(e)]
+    if not cands:
+        raise ValueError("trace has no candidate omissions")
+    rng = np.random.default_rng(seed)
+    out: list = [frozenset()] if include_empty else []
+    seen = set(out)
+    attempts = 0
+    while len(out) < width and attempts < 64 * width:
+        attempts += 1
+        k = int(rng.integers(1, max_faults + 1))
+        pick = rng.choice(len(cands), size=min(k, len(cands)),
+                          replace=False)
+        s = frozenset(cands[int(i)] for i in pick)
+        if s in seen:
+            continue
+        seen.add(s)
+        out.append(s)
+    base = len(out)               # tiny candidate pools: cycle honestly
+    while len(out) < width:
+        out.append(out[len(out) % base])
+    return out
+
+
+def search(build: Callable, schedules: Sequence, horizon: int, *,
+           sched_width: int = 64, coverage_slot: int | None = None,
+           coverage_version=1,
+           assertion: Callable | None = None,
+           replay_check: bool = True) -> SearchResult:
+    """Run a population of omission schedules as ONE jitted program and
+    reduce each member through the oracle predicates.
+
+    ``build(sched: interpose.OmissionSchedule) -> (Fleet, state)``
+    constructs and BOOTS the fleet — called once with a zeroed probe
+    schedule to learn the boot round (the serial ``filibuster.Checker``
+    protocol); the canonical ``[W, total+1, n, sched_width]`` stacked
+    schedule then replaces the interpose leaf on the booted state
+    (state surgery, not a rebuild — the jitted programs are reused).
+    Schedule search wants members that differ ONLY in their schedule,
+    so ``build`` should init with equal salts (``fleet.init(salts=
+    np.zeros(W))``); distinct salts compose fine but make a schedule's
+    verdict specific to its member's seed.
+
+    Oracles, each skipped when its plane/model is absent: stats
+    conservation (emitted == delivered + dropped, per member),
+    ``health.overlay_ok`` over the member digest, model coverage for
+    ``coverage_slot`` == 1.0, and an optional per-member
+    ``assertion(member_cluster, member_state) -> bool`` for app
+    guarantees.  With ``replay_check`` every failing member re-runs
+    through the UNBATCHED member cluster and must match bit-for-bit —
+    the trace/replay determinism gate, now per counterexample."""
+    probe = interpose_mod.OmissionSchedule(
+        np.zeros((1, 1, 1), np.bool_), start=0)
+    fl, st0 = build(probe)
+    if not isinstance(fl, Fleet):
+        raise ValueError("build() must return (Fleet, state)")
+    if not isinstance(fl.member.interpose,
+                      interpose_mod.OmissionSchedule):
+        raise ValueError(
+            "fleet.search needs the Fleet built with a bare "
+            "interpose.OmissionSchedule (got "
+            f"{type(fl.member.interpose).__name__})")
+    W = fl.width
+    if len(schedules) != W:
+        raise ValueError(
+            f"{len(schedules)} schedules for a width-{W} fleet")
+    n = fl.member_cfg.n_nodes
+    total = int(jax.device_get(st0.rnd)) + horizon
+
+    # Silent-clip guard: OmissionSchedule.apply clips the schedule's
+    # slot axis to the round's emission width E — a coordinate at slot
+    # >= E would never fire and its schedule would be reported
+    # "tolerated" without ever running.  E is discovered abstractly
+    # from the captured round's send stack (no compile).
+    tr = jax.eval_shape(fl.member._round_traced,
+                        jax.eval_shape(fl.member._build_init))
+    emit_width = tr[1].sent.shape[1]
+    max_slot = max((c[2] for s in schedules for c in s), default=-1)
+    if max_slot >= min(sched_width, emit_width):
+        raise ValueError(
+            f"schedule slot {max_slot} >= emission width "
+            f"{min(sched_width, emit_width)} — the omission would be "
+            "silently clipped (schedule_drops frame convention)")
+
+    drops = filibuster_mod.schedule_drops(
+        [sorted(s) for s in schedules], total, n, sched_width)
+    stacked = np.concatenate(
+        [drops, np.zeros((W, 1, n, sched_width), np.bool_)], axis=1)
+    st0 = st0._replace(interpose=jnp.asarray(stacked))
+
+    final = fl.steps(st0, horizon)
+
+    # ---- oracle reduction (host-side, over batched leaves) ------------
+    oracle: dict = {}
+    stats = jax.device_get(final.stats)
+    e = np.asarray(stats.emitted)
+    d = np.asarray(stats.delivered)
+    dr = np.asarray(stats.dropped)
+    oracle["conservation"] = (e == d + dr)
+    if getattr(final, "health", ()) != ():
+        words = health_mod.digest(final)
+        oracle["overlay_ok"] = np.asarray(
+            [health_mod.overlay_ok(w) for w in words])
+    if coverage_slot is not None:
+        cov = np.asarray(jax.device_get(fl.coverage(
+            final, coverage_slot, version=coverage_version)))
+        oracle["coverage"] = (cov >= 1.0)
+        oracle["coverage_value"] = cov
+    if assertion is not None:
+        oracle["assertion"] = np.asarray(
+            [bool(assertion(fl.member, fl.member_state(final, j)))
+             for j in range(W)])
+    preds = [v for k, v in oracle.items() if v.dtype == np.bool_]
+    verdicts = [bool(np.all([p[j] for p in preds])) for j in range(W)]
+
+    salts = np.asarray(jax.device_get(st0.salt))
+    cexs = []
+    for j in range(W):
+        if verdicts[j]:
+            continue
+        info = {k: (v[j].tolist() if hasattr(v[j], "tolist") else v[j])
+                for k, v in oracle.items()}
+        cex = Counterexample(
+            member=j, salt=int(salts[j]), schedule=frozenset(schedules[j]),
+            seed=fl.member_cfg.seed + int(salts[j]), oracle=info)
+        if replay_check:
+            # The extraction contract: the losing member's seed +
+            # schedule replays STANDALONE through the unbatched path,
+            # bit-identical (same leaves, same verdict).
+            sub0 = fl.member_state(st0, j)
+            sub_fin = fl.member.steps(sub0, horizon)
+            want = fl.member_state(final, j)
+            for (pa, xa), (_pb, xb) in zip(
+                    jax.tree_util.tree_leaves_with_path(sub_fin),
+                    jax.tree_util.tree_leaves_with_path(want)):
+                if not np.array_equal(np.asarray(jax.device_get(xa)),
+                                      np.asarray(jax.device_get(xb))):
+                    raise RuntimeError(
+                        f"counterexample member {j} diverged from its "
+                        f"unbatched replay at "
+                        f"{jax.tree_util.keystr(pa)}")
+            cex.replayed = True
+        cexs.append(cex)
+
+    return SearchResult(
+        passed=not cexs, width=W, verdicts=verdicts, oracle=oracle,
+        counterexamples=cexs, programs=fl.programs(), state=final,
+        state0=st0)
+
+
+# ---------------------------------------------------------------------------
+# Population-based controller-band tuning
+# ---------------------------------------------------------------------------
+
+_FANOUT_BANDS = {"fanout_min": "band_min", "fanout_hi_pct": "band_hi",
+                 "fanout_lo_pct": "band_lo", "graft_hi_pct": "band_graft"}
+_BP_BANDS = {"age_hi": "band_age_hi", "age_lo": "band_age_lo"}
+_HEAL_BANDS = {"heal_boost": "band_boost", "heal_hold": "band_hold"}
+
+
+def set_bands(state: ClusterState, bands: Sequence[dict]) -> ClusterState:
+    """Stack a band population onto a fleet state: ``bands[j]`` maps
+    ControlConfig field names (``fanout_hi_pct``, ``age_hi``,
+    ``heal_boost``, ...) to member j's value; missing keys keep the
+    config default the state was initialized with.  Band semantics
+    (and int32-overflow care: window counters multiply by the pct
+    bands) are the controller's — see control.py."""
+    ctl = state.control
+    if ctl == ():
+        raise ValueError("state carries no controller to band-tune "
+                         "(enable a Config.control flag)")
+    unknown = set().union(*bands) - (set(_FANOUT_BANDS) | set(_BP_BANDS)
+                                     | set(_HEAL_BANDS))
+    if unknown:
+        raise ValueError(f"unknown band fields: {sorted(unknown)}")
+
+    def apply(sub, mapping):
+        if sub == ():
+            return sub
+        reps = {}
+        for ck, leaf in mapping.items():
+            if not any(ck in b for b in bands):
+                continue
+            cur = np.asarray(jax.device_get(getattr(sub, leaf)))
+            vals = [int(b.get(ck, cur[j] if cur.ndim else cur))
+                    for j, b in enumerate(bands)]
+            reps[leaf] = jnp.asarray(vals, jnp.int32)
+        return sub._replace(**reps) if reps else sub
+
+    return state._replace(control=ctl._replace(
+        fanout=apply(ctl.fanout, _FANOUT_BANDS),
+        backpressure=apply(ctl.backpressure, _BP_BANDS),
+        healing=apply(ctl.healing, _HEAL_BANDS)))
+
+
+def tune(bands: Sequence[dict], *, n: int = 128, waves: int = 12,
+         wave_len: int = 10, seed: int = 3, settle: int = 60) -> dict:
+    """Population-based fanout-band search over the CONTROL_AB fanout
+    harness's exact workload (scenarios.fanout_ab_arm: recycled-slot
+    plumtree broadcasts on a quiesced hyparview overlay, AAE off) — W
+    band settings evaluated in ONE vmapped program, scored by the same
+    deterministic metrics the committed CONTROL_AB.json carries:
+    steady-half redundancy ratio (lower is better) gated on final-slot
+    coverage == 1.0.  All members share salt 0 (the A/B's fixed-seed
+    determinism: bands are the only thing varied), so with a population
+    containing the default bands and a static-equivalent setting
+    (``{"fanout_hi_pct": 200}`` — a duplicate fraction can never reach
+    200%, so the governor never demotes and the eager cap pins at the
+    overlay width), the winner reproduces CONTROL_AB's fanout verdict.
+    """
+    from partisan_tpu import provenance as prov_mod
+    from partisan_tpu.config import (ControlConfig, HyParViewConfig,
+                                     PlumtreeConfig)
+    from partisan_tpu.models.plumtree import Plumtree
+
+    W = len(bands)
+    cfg = Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 provenance=True, provenance_ring=512,
+                 max_broadcasts=8, control=ControlConfig(fanout=True),
+                 lazy_tick_ms=3000,
+                 hyparview=HyParViewConfig(active_min=6, active_max=8,
+                                           shuffle_interval_ms=60_000),
+                 plumtree=PlumtreeConfig(aae=False))
+    model = Plumtree()
+    fl = Fleet(cfg, width=W, model=model)
+    st = fl.init(salts=np.zeros(W, np.uint32))
+    st = set_bands(st, bands)
+    joins = list(range(1, n))
+    contacts = [0] * (n - 1)
+    st = st._replace(manager=fl.map_members(
+        lambda m: fl.manager.join_many(cfg, m, joins, contacts),
+        st.manager))
+    st = fl.steps(st, settle)
+    rng = np.random.default_rng(5)
+    ver = 1
+    for w in range(waves):
+        root, slot, v = int(rng.integers(0, n)), w % 4, ver + 1
+        st = st._replace(model=fl.map_members(
+            lambda m: model.broadcast(m, root, slot, v, fresh=True),
+            st.model))
+        ver += 1
+        st = fl.steps(st, wave_len)
+    traffic_end = int(jax.device_get(st.rnd))
+    st = fl.steps(st, wave_len)     # drain (fanout_ab_arm protocol)
+
+    cov = np.asarray(jax.device_get(fl.coverage(
+        st, (waves - 1) % 4, version=ver)))
+    scores, members = [], []
+    for j in range(W):
+        snap = prov_mod.snapshot(
+            jax.tree.map(lambda x: x[j], st.provenance))
+        rr = np.asarray(snap["rounds"])
+        g = np.asarray(snap["gossip"]).astype(float)
+        dup = np.asarray(snap["dup"]).sum(axis=1).astype(float)
+        tail = (rr >= traffic_end - (waves // 2) * wave_len) \
+            & (rr < traffic_end)
+        steady = round(float(dup[tail].sum())
+                       / max(float(g[tail].sum()), 1), 4)
+        members.append({
+            "bands": dict(bands[j]),
+            "steady_redundancy_ratio": steady,
+            "redundancy_ratio":
+                prov_mod.redundancy(snap)["redundancy_ratio"],
+            "coverage": round(float(cov[j]), 4),
+        })
+        scores.append(steady)
+    eligible = [j for j in range(W) if cov[j] >= 1.0]
+    if not eligible:
+        winner = None
+    else:
+        winner = min(eligible, key=lambda j: (scores[j], j))
+    return {
+        "metric": "steady_redundancy_ratio", "n": n, "waves": waves,
+        "width": W, "members": members, "winner": winner,
+        "winner_bands": dict(bands[winner]) if winner is not None
+        else None,
+        "programs": fl.programs(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Distribution cards (the sweep drivers' shared reducer)
+# ---------------------------------------------------------------------------
+
+def distribution(values, qs=(5, 50, 95)) -> dict:
+    """p5/p50/p95 (+ min/max/mean) over a member population — the card
+    format ``scenarios.fleet_sweep`` / ``bench.py --fleet`` emit.
+    None/-1 entries (e.g. unconverged members) are reported in
+    ``missing`` and excluded from the quantiles."""
+    vals = [v for v in values if v is not None and v >= 0]
+    out = {"count": len(values), "missing": len(values) - len(vals)}
+    if not vals:
+        return out
+    a = np.asarray(vals, float)
+    for q in qs:
+        out[f"p{q}"] = round(float(np.percentile(a, q)), 4)
+    out["min"] = round(float(a.min()), 4)
+    out["max"] = round(float(a.max()), 4)
+    out["mean"] = round(float(a.mean()), 4)
+    return out

@@ -470,14 +470,20 @@ def digest_components(word: int) -> int:
     return (int(word) >> _COMP_SHIFT) & _COMP_MASK
 
 
-def digest(state) -> int:
+def digest(state) -> int | list:
     """ONE scalar device->host transfer: the latest packed digest word
     of a health-carrying ClusterState (0 = plane off or no snapshot
-    yet)."""
+    yet).  A FLEET state (fleet.py — leading member axis on every leaf
+    but rnd) returns the per-member list of digest words instead."""
     hs = getattr(state, "health", ())
     if hs == ():
         return 0
-    return int(jax.device_get(hs.digest))
+    word = jax.device_get(hs.digest)
+    import numpy as np
+
+    if np.ndim(word):
+        return [int(w) for w in np.asarray(word)]
+    return int(word)
 
 
 # ---------------------------------------------------------------------------

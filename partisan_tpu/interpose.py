@@ -120,10 +120,20 @@ class OmissionSchedule:
     (partisan_trace_orchestrator.erl:598-650 preloaded omissions).
 
     ``drops``: host bool[T, n_global, E]; row i applies at absolute round
-    ``start + i``.  Rounds outside [start, start+T) pass everything
-    through (schedules are finite windows).  Slots are identified by the
-    (round, sender, emission-slot) coordinate, which is stable because the
-    round step is deterministic.
+    ``start + i`` (the FRAME CONVENTION shared with
+    ``filibuster.schedule_drops`` and the soak ``Omission`` action).
+    Rounds outside [start, start+T) pass everything through — a
+    schedule SHORTER than the horizon omits nothing in its tail, by
+    design (the appended all-pass pad row is what out-of-window reads
+    land on; it is never broadcast over the window).  Slots are
+    identified by the (round, sender, emission-slot) coordinate, which
+    is stable because the round step is deterministic.
+
+    Under the fleet runner (fleet.py) the installed state leaf grows a
+    leading member axis — ``bool[W, T+1, n, E]``, one schedule per
+    vmapped member (``filibuster.schedule_drops`` compiles a batch of
+    schedules to exactly this stack, pre-pad) — and ``apply`` runs
+    per-member under vmap against the unbatched [T+1, n, E] view.
     """
 
     drops: Any  # np/jnp bool[T, n_global, E]
@@ -131,6 +141,17 @@ class OmissionSchedule:
 
     def init(self, cfg: Config, comm: Any) -> Any:
         d = jnp.asarray(self.drops, jnp.bool_)
+        if d.ndim != 3:
+            # A mis-ranked tensor (e.g. a [n, E] mask missing the round
+            # axis, or an already-stacked [W, T, n, E] batch) would
+            # otherwise be indexed on the WRONG axis by apply() —
+            # silently reinterpreting senders as rounds.  Batched
+            # schedules are installed by the fleet runner as state
+            # leaves, never through init().
+            raise ValueError(
+                f"OmissionSchedule drops must be rank-3 [T, n, E] "
+                f"(row i = absolute round start+i); got shape "
+                f"{tuple(d.shape)}")
         # Pad with one all-pass round so reads at rnd >= T are in range.
         return jnp.concatenate(
             [d, jnp.zeros((1,) + d.shape[1:], jnp.bool_)], axis=0)

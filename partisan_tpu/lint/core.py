@@ -21,6 +21,7 @@ Design constraints:
 from __future__ import annotations
 
 import os
+import re
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -125,15 +126,35 @@ def site_of(eqn) -> tuple[str, str, int]:
     return "?", "?", 0
 
 
+_XFORM_WRAP = re.compile(r"^\w+\((.+)\)$")
+
+
 def scope_of(eqn) -> str:
     """The equation's named_scope stack ("" when unscoped).  This is
     the real phase label the profiler sees — unlike ``str(jaxpr)``
     greps, which never contain scope names at all (the pre-lint
-    zero-cost-when-off string asserts were vacuous)."""
+    zero-cost-when-off string asserts were vacuous).
+
+    Transform decorations are UNWRAPPED per segment: under ``jax.vmap``
+    (the fleet runner's batched round, lint/matrix.py ``fleet/*``
+    entries) a scope segment prints as ``vmap(round.latency)`` — the
+    same phase, batched — and every scope consumer (the zero-cost
+    rule's ON/OFF keys, the cost meter's phase census) must see through
+    the wrapper or the fleet programs would audit as scope-less."""
     try:
-        return str(eqn.source_info.name_stack)
+        raw = str(eqn.source_info.name_stack)
     except Exception:
         return ""
+    if "(" not in raw:
+        return raw
+    segs = []
+    for seg in raw.split("/"):
+        m = _XFORM_WRAP.match(seg)
+        while m:
+            seg = m.group(1)
+            m = _XFORM_WRAP.match(seg)
+        segs.append(seg)
+    return "/".join(segs)
 
 
 # ---------------------------------------------------------------------------

@@ -73,4 +73,18 @@ BUDGETS: dict = {
         "interm_kib": 1945.0,
         "eqns": 3502,
     },
+    # The vmapped fleet round (ISSUE 14): W=4 members of the plain
+    # hyparview+plumtree round batched by fleet.Fleet.  The
+    # gather/scatter and eqn counts are the ratchet here — they must
+    # stay ~one member round (+2 gs for the salt-batched fault hash's
+    # batched gathers), NEVER O(W): a per-member Python branch sneaking
+    # in would multiply them by the fleet width.  The byte census keys
+    # materialized intermediates on a LEADING node axis, so batched
+    # [W, n, ·] tensors are deliberately under-counted — bytes are
+    # pinned for drift detection only.
+    "fleet/round": {
+        "gather_scatter": 58,
+        "interm_kib": 19.0,
+        "eqns": 5221,
+    },
 }

@@ -45,10 +45,11 @@ def full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
 def control_full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
     """Every plane + every in-scan controller + the traffic generator
     (the closed-loop round under load; also the sharding completeness
-    rule's reference state — controller and traffic leaves need
-    PartitionSpecs like any other carry)."""
+    rule's reference state — controller, traffic and seed-salt leaves
+    need PartitionSpecs like any other carry)."""
     kw.setdefault("traffic", TrafficConfig(enabled=True, churn=True,
                                            ring=8))
+    kw.setdefault("salt_operand", True)
     return full_cfg(n, flight=flight, channel_capacity=True,
                     control=ControlConfig(fanout=True, backpressure=True,
                                           healing=True, ring=8), **kw)
@@ -120,6 +121,29 @@ def sharded_cfgs() -> dict:
         "round/sharded-health": base_cfg(
             sharded_exchange="all_to_all", health=4, health_ring=8),
     }
+
+
+def fleet_round_program(name: str = "fleet/round", width: int = 4,
+                        cfg: Config | None = None,
+                        scan: int = 0) -> Program:
+    """Trace ONE vmapped fleet round abstractly (fleet.Fleet): W
+    members' clusters batched on a leading axis, schedules/salts/bands
+    as stacked operands.  The audit surface for the fleet path: the
+    member round's rules (no-host-callback, zero-cost-when-off keyed
+    per plane, interleave budget, narrow dtypes, scatter overlap) must
+    survive the vmap transform, and ``fleet/round``'s cost budget pins
+    the batched op census (cost_budgets.py)."""
+    import jax.numpy as jnp
+
+    from partisan_tpu.fleet import Fleet
+    from partisan_tpu.models.plumtree import Plumtree
+
+    fl = Fleet(cfg or base_cfg(salt_operand=True), width=width,
+               model=Plumtree())
+    state = jax.eval_shape(fl._build_init,
+                           jax.ShapeDtypeStruct((width,), jnp.uint32))
+    fn = (lambda s: fl._scan(s, scan)) if scan else fl._round_v
+    return trace_program(name, fn, state, fl.cfg)
 
 
 def _otp_stack_program() -> Program:
@@ -217,5 +241,14 @@ def default_matrix() -> list[Program]:
         # live on these entries)
         *(sharded_round_program(name, cfg)
           for name, cfg in sharded_cfgs().items()),
+        # the vmapped fleet (ROADMAP item 4): the plain fleet round
+        # (pinned by the "fleet/round" cost budget — one batched
+        # member must price ~W x the member round, never O(W^2)) and
+        # the sweep-shaped scan with every plane + the salted width
+        # operand batched, which keys the zero-cost rule's ON-scope
+        # checks through the vmap transform
+        fleet_round_program(),
+        fleet_round_program("scan/fleet-sweep",
+                            cfg=full_cfg(salt_operand=True), scan=2),
     ]
     return progs

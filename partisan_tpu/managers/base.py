@@ -54,6 +54,16 @@ class RoundCtx(NamedTuple):
     #               STATIC Config.control flags: plumtree's eager push
     #               reads ctx.control.fanout.eager_cap, hyparview's
     #               cadences read ctx.control.healing.boost.
+    seed: Any = 0  # the round's EFFECTIVE seed: cfg.seed (a Python int
+    #               — the historical static path) or, under
+    #               Config.salt_operand, the traced uint32 scalar
+    #               ``cfg.seed + state.salt`` (the fleet runner's
+    #               per-cluster stream namespace).  EVERY per-round
+    #               stochastic draw (faults.edge_hash / filter_edges,
+    #               rng.rank32 site keys) must key off ctx.seed, not
+    #               cfg.seed, so fleet members draw independent
+    #               streams; static world GEOMETRY (distance.link_cost)
+    #               stays on cfg.seed by design.
 
 
 class Manager(Protocol):

@@ -62,7 +62,7 @@ class ClientServer:
         dst = jnp.where(fires[:, None] & state.joined,
                         all_ids[None, :], jnp.int32(-1))
         dst = faults_mod.filter_edges(
-            ctx.faults, gids, dst, cfg.seed, ctx.rnd, _GOSSIP_EDGE_TAG)
+            ctx.faults, gids, dst, ctx.seed, ctx.rnd, _GOSSIP_EDGE_TAG)
         pushed = comm.push_or(state.known, dst)
         known = state.known | (pushed & ctx.alive[:, None])
         known = jnp.where(ctx.alive[:, None], known, state.known)

@@ -72,7 +72,7 @@ class FullMesh:
         dst = jnp.where(fires[:, None] & peer, all_ids[None, :], jnp.int32(-1))
 
         dst = faults_mod.filter_edges(
-            ctx.faults, gids, dst, cfg.seed, ctx.rnd, _GOSSIP_EDGE_TAG)
+            ctx.faults, gids, dst, ctx.seed, ctx.rnd, _GOSSIP_EDGE_TAG)
 
         flat = state.view.reshape(n_local, 2 * n_global)
         pushed = comm.push_max(flat, dst).reshape(n_local, 2, n_global)

@@ -279,7 +279,9 @@ class HyParView:
         slot_col = jnp.arange(cap, dtype=jnp.int32)[None, :]
 
         def ranked(tag, *coords):
-            return rng.rank32(cfg.seed, ctx.rnd, tag, *coords)
+            # ctx.seed, not cfg.seed: the salted per-run stream
+            # (fleet members must draw independently — managers/base.py)
+            return rng.rank32(ctx.seed, ctx.rnd, tag, *coords)
 
         def row_ranked(view, tag, k, exclude=None):
             """int32[n, k]: k distinct random members per row of

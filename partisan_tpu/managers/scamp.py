@@ -351,7 +351,7 @@ class Scamp:
         fires = fires & ctx.alive & ~state.left
         ping_dst = jnp.where(fires[:, None], partial2, -1)
         ping_dst = faults_mod.filter_edges(
-            ctx.faults, gids, ping_dst, cfg.seed, ctx.rnd, _PING_EDGE_TAG)
+            ctx.faults, gids, ping_dst, ctx.seed, ctx.rnd, _PING_EDGE_TAG)
         stamp = jnp.broadcast_to(
             (ctx.rnd + 1)[None, None], (n_local, 1)).astype(jnp.uint32)
         heard = comm.push_max(stamp, ping_dst)[:, 0].astype(jnp.int32)

@@ -208,6 +208,19 @@ def ring_order(rnd) -> "np.ndarray":
     return keep[np.argsort(rnd[keep], kind="stable")]
 
 
+def host_int(x):
+    """Host view of a scalar carry leaf: a plain int, or the per-member
+    int list when the leaf arrives fleet-batched with a leading member
+    axis (fleet.py states) — shared by every poll/invariant that must
+    read both shapes (control.poll, workload.poll, the soak
+    invariants)."""
+    import jax
+    import numpy as np
+
+    a = np.asarray(jax.device_get(x))
+    return a.astype(int).tolist() if a.ndim else int(a)
+
+
 def snapshot(ms: MetricsState) -> dict:
     """Decode the ring into per-round series ordered by round (one
     device->host transfer, AFTER the scan — never inside it).

@@ -69,7 +69,7 @@ class AntiEntropy:
         targets = jax.vmap(pick)(ctx.keys, nbrs, fires)       # int32[n_local, FANOUT]
 
         push_dst = faults_mod.filter_edges(
-            ctx.faults, gids, targets, cfg.seed, ctx.rnd, _PUSH_EDGE_TAG)
+            ctx.faults, gids, targets, ctx.seed, ctx.rnd, _PUSH_EDGE_TAG)
 
         # Pull replies for LAST round's AE_PULL requests (inbox).
         in_msgs = ctx.inbox.data
@@ -78,7 +78,7 @@ class AntiEntropy:
         pull_dst = jnp.where(is_pull, in_msgs[:, :, T.W_SRC], jnp.int32(-1))
         pull_dst = jnp.where(ctx.alive[:, None], pull_dst, jnp.int32(-1))
         pull_dst = faults_mod.filter_edges(
-            ctx.faults, gids, pull_dst, cfg.seed, ctx.rnd, _PULL_EDGE_TAG)
+            ctx.faults, gids, pull_dst, ctx.seed, ctx.rnd, _PULL_EDGE_TAG)
 
         dst = jnp.concatenate([push_dst, pull_dst], axis=1)
         pushed = comm.push_or(state.store, dst)

@@ -527,7 +527,7 @@ class Plumtree:
                     tgt = jnp.where(changed & (nbrs >= 0)
                                     & ctx.alive[:, None], nbrs, -1)
                     tgt = faults_mod.filter_edges(
-                        ctx.faults, gids, tgt, cfg.seed, ctx.rnd,
+                        ctx.faults, gids, tgt, ctx.seed, ctx.rnd,
                         _AAE_EDGE_TAG)
                     return hd.exchange_with_epochs(comm, data, tgt_ep,
                                                    tgt)
@@ -566,7 +566,7 @@ class Plumtree:
 
                     tick_tgt = jax.vmap(pick)(ctx.keys, nbrs, fires)
                     tick_tgt = faults_mod.filter_edges(
-                        ctx.faults, gids, tick_tgt, cfg.seed, ctx.rnd,
+                        ctx.faults, gids, tick_tgt, ctx.seed, ctx.rnd,
                         _AAE_EDGE_TAG)
                     p_t, ep_t = hd.exchange_with_epochs(
                         comm, data, tgt_ep, tick_tgt)

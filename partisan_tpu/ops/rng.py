@@ -19,8 +19,23 @@ import jax.numpy as jnp
 
 
 def round_key(seed: int | jax.Array, rnd: jax.Array) -> jax.Array:
-    """Key for a whole round (scalar)."""
-    base = jax.random.key(seed) if isinstance(seed, int) else seed
+    """Key for a whole round (scalar).
+
+    ``seed`` may be a Python int, an already-derived PRNG key, or a
+    traced integer scalar — the fleet runner (fleet.py) carries a
+    per-cluster seed salt in the state (``Config.salt_operand``), so
+    the round's effective seed becomes a dynamic operand.  An integer
+    seed below 2**32 produces the same key whether it arrives as a
+    Python int or a traced uint32 (``jax.random.key`` zero-extends
+    both), which is what makes a salted member bit-identical to an
+    unbatched run at ``Config(seed=base+salt)``."""
+    if isinstance(seed, int):
+        base = jax.random.key(seed)
+    elif jax.dtypes.issubdtype(jnp.asarray(seed).dtype,
+                               jax.dtypes.prng_key):
+        base = seed
+    else:
+        base = jax.random.key(seed)
     return jax.random.fold_in(base, rnd)
 
 
@@ -39,7 +54,8 @@ def subkey(key: jax.Array, tag: int) -> jax.Array:
     return jax.random.fold_in(key, tag)
 
 
-def rank32(seed: int, rnd: jax.Array, tag: int, a, b=0, c=0) -> jax.Array:
+def rank32(seed: int | jax.Array, rnd: jax.Array, tag: int, a, b=0,
+           c=0) -> jax.Array:
     """Deterministic uint32 ranking keys from integer coordinates.
 
     The cheap alternative to deriving per-site threefry keys + gumbel
@@ -53,15 +69,25 @@ def rank32(seed: int, rnd: jax.Array, tag: int, a, b=0, c=0) -> jax.Array:
     ``tag`` namespaces call sites (use distinct small ints).  Streams are
     independent of :func:`partisan_tpu.faults.edge_hash` by construction
     (different combine), but keep tags distinct from fault salts anyway.
+
+    ``seed`` may be a traced uint32 scalar (the fleet runner's salted
+    per-cluster seed): uint32 wraparound arithmetic is exactly the
+    Python path's ``& 0xFFFFFFFF`` mod-2**32, so a traced seed equal to
+    a static one draws the identical stream.
     """
     from partisan_tpu.faults import _mix32
 
-    site = (seed * 0x27D4EB2F + tag * 0x165667B1) & 0xFFFFFFFF
+    if isinstance(seed, int):
+        site = jnp.uint32((seed * 0x27D4EB2F + tag * 0x165667B1)
+                          & 0xFFFFFFFF)
+    else:
+        site = (jnp.asarray(seed, jnp.uint32) * jnp.uint32(0x27D4EB2F)
+                + jnp.uint32((tag * 0x165667B1) & 0xFFFFFFFF))
     x = (jnp.asarray(a, jnp.uint32) * jnp.uint32(0x9E3779B1)
          ^ jnp.asarray(b, jnp.uint32) * jnp.uint32(0x85EBCA77)
          ^ jnp.asarray(c, jnp.uint32) * jnp.uint32(0xC2B2AE3D)
          ^ (jnp.asarray(rnd, jnp.uint32) * jnp.uint32(0x27D4EB2F)
-            + jnp.uint32(site)))
+            + site))
     return _mix32(_mix32(x))
 
 

@@ -315,6 +315,9 @@ class ShardedCluster:
             # counts are allsum-reduced before every write), identical
             # on every shard — replicated like the controllers.
             traffic=spec_like(state.traffic, repl),
+            # Seed salt: a scalar operand, replicated like n_active
+            # (every shard derives the same effective seed from it).
+            salt=(() if isinstance(state.salt, tuple) else repl),
         )
 
     # ---- state construction ------------------------------------------
@@ -356,6 +359,7 @@ class ShardedCluster:
                      if control_mod.enabled(cfg) else ()),
             traffic=(workload_mod.init(cfg)
                      if workload_mod.enabled(cfg) else ()),
+            salt=(jnp.uint32(0) if cfg.salt_operand else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

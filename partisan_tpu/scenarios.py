@@ -1313,6 +1313,89 @@ def control_ab(scale: float = 1.0) -> dict:
     return out
 
 
+def fleet_sweep(width: int = 8, n: int = 256, seed: int = 0,
+                max_rounds: int = 300, settle: int = 40,
+                salts=None) -> dict:
+    """Distribution card over a SEED POPULATION (ROADMAP item 4c): W
+    independent hyparview+plumtree clusters — one per salt — run as ONE
+    vmapped program (fleet.Fleet), each broadcasting from node 0 after
+    the same scripted bootstrap, polled on the batched health digest
+    until every member converges (or ``max_rounds``).  Emits
+    p5/p50/p95 distributions — not single-seed points — for
+    rounds-to-converge (from each member's health snapshot ring),
+    whole-run redundancy ratio (provenance plane), and per-channel
+    delivery-age p99 (latency plane): the statistical evaluation axes
+    of Leitão et al. (SRDS'07), at one-program cost.  The CLI is
+    ``bench.py --fleet W [n]``; ``tools/fleet_report.py`` exports
+    per-member JSON lines."""
+    from partisan_tpu import fleet as fleet_mod
+    from partisan_tpu import health as health_mod
+    from partisan_tpu import provenance as prov_mod
+    from partisan_tpu.config import Config
+    from partisan_tpu.metrics import ring_order
+    from partisan_tpu.models.plumtree import Plumtree
+
+    cfg = Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 health=K_PROG, health_ring=max(64, max_rounds // K_PROG + 8),
+                 provenance=True, provenance_ring=256, latency=True,
+                 max_broadcasts=8, salt_operand=True)
+    model = Plumtree()
+    fl = fleet_mod.Fleet(cfg, width=width, model=model)
+    t0 = time.perf_counter()
+    st = fl.init(salts)
+    joins, contacts = list(range(1, n)), [0] * (n - 1)
+    st = st._replace(manager=fl.map_members(
+        lambda m: fl.manager.join_many(cfg, m, joins, contacts),
+        st.manager))
+    st = fl.steps(st, settle)
+    r0 = int(jax.device_get(st.rnd))
+    st = st._replace(model=fl.map_members(
+        lambda m: model.broadcast(m, 0, 0, 2), st.model))
+    for _ in range(0, max_rounds, K_PROG):
+        words = health_mod.digest(st)
+        if all(health_mod.digest_converged(w) for w in words):
+            break
+        st = fl.steps(st, K_PROG)
+    wall = time.perf_counter() - t0
+
+    # per-member reductions (host-side slices of the batched planes)
+    conv, redund, p99 = [], [], {}
+    for j in range(width):
+        hs = jax.tree.map(lambda x: x[j], st.health)
+        rr = np.asarray(jax.device_get(hs.rnd))
+        dg = np.asarray(jax.device_get(hs.digests))
+        order = ring_order(rr)
+        rr, dg = rr[order], dg[order]
+        hit = [int(r) - r0 for r, w in zip(rr, dg)
+               if r >= r0 and health_mod.digest_converged(int(w))]
+        conv.append(hit[0] if hit else -1)
+        redund.append(prov_mod.redundancy(
+            jax.tree.map(lambda x: x[j], st.provenance))
+            ["redundancy_ratio"])
+        for ch, v in fl.member_latency(
+                st, j, channels=tuple(c.name for c in cfg.channels)
+        ).items():
+            p99.setdefault(ch, []).append(v["p99"])
+    card = {
+        "config": "fleet_sweep", "width": width, "n": n, "seed": seed,
+        "rounds": int(jax.device_get(st.rnd)) - r0,
+        "converged": sum(1 for c in conv if c >= 0),
+        "rounds_to_converge": fleet_mod.distribution(conv),
+        "redundancy_ratio": fleet_mod.distribution(redund),
+        "p99": {ch: fleet_mod.distribution(vs)
+                for ch, vs in p99.items() if any(v is not None
+                                                 for v in vs)},
+        "programs": fl.programs(),
+        "wall_s": round(wall, 2),
+        "members": {
+            "rounds_to_converge": conv,
+            "redundancy_ratio": redund,
+        },
+    }
+    return card
+
+
 # ---------------------------------------------------------------------------
 # Traffic-plane SLO suite (ROADMAP item 3): the app models under
 # sustained adversarial open-loop load — flash crowds, diurnal churn,

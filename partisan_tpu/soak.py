@@ -345,13 +345,26 @@ class Invariant:
     check: Callable[[Any, Any], tuple[bool, dict]]
 
 
+def _stat(x):
+    """Host view of a stats leaf: int for scalars, per-member list for
+    fleet-batched [W] leaves (fleet.py states check every member)."""
+    from partisan_tpu.metrics import host_int
+
+    return host_int(x)
+
+
 def conservation() -> Invariant:
     """The round engine's conservation law: every emitted event message
-    is delivered or accounted as dropped (Stats reconciliation)."""
+    is delivered or accounted as dropped (Stats reconciliation).  On a
+    fleet state the law must hold per member."""
     def check(cluster, state):
         s = jax.device_get(state.stats)
-        e, d, dr = int(s.emitted), int(s.delivered), int(s.dropped)
-        return e == d + dr, {"emitted": e, "delivered": d, "dropped": dr}
+        e = np.asarray(s.emitted)
+        d = np.asarray(s.delivered)
+        dr = np.asarray(s.dropped)
+        ok = bool(np.all(e == d + dr))
+        return ok, {"emitted": _stat(e), "delivered": _stat(d),
+                    "dropped": _stat(dr)}
     return Invariant("conservation", check)
 
 
@@ -380,12 +393,15 @@ def flow_conservation(slack: int = 0,
     stays gated)."""
     def check(cluster, state):
         s = jax.device_get(state.stats)
-        e, d, dr = int(s.emitted), int(s.delivered), int(s.dropped)
+        e = np.asarray(s.emitted)
+        d = np.asarray(s.delivered)
+        dr = np.asarray(s.dropped)
         ledger = d + dr - e
-        ok = ledger <= slack and (one_sided or ledger >= -slack)
-        info = {"emitted": e, "delivered": d, "dropped": dr,
-                "ledger": ledger, "slack": slack,
-                "one_sided": one_sided}
+        ok = bool(np.all(ledger <= slack)
+                  and (one_sided or np.all(ledger >= -slack)))
+        info = {"emitted": _stat(e), "delivered": _stat(d),
+                "dropped": _stat(dr), "ledger": _stat(ledger),
+                "slack": slack, "one_sided": one_sided}
         return ok, info
     return Invariant("flow_conservation", check)
 
@@ -401,6 +417,12 @@ def digest_healthy() -> Invariant:
         from partisan_tpu import health as health_mod
 
         word = health_mod.digest(state)
+        if isinstance(word, list):    # fleet state: every member's digest
+            decs = [health_mod.decode_digest(w) for w in word]
+            if not any(d["valid"] for d in decs):
+                return True, {"valid": False}
+            ok = all(d["one_component"] for d in decs if d["valid"])
+            return ok, {"members": decs}
         dec = health_mod.decode_digest(word)
         if not dec["valid"]:
             return True, {"valid": False}
@@ -785,7 +807,12 @@ class Soak:
 
                 word = health_mod.digest(nxt_state)
                 row["digest"] = word
-                row["healthy"] = health_mod.healthy(word)
+                # fleet states poll a per-member digest list: the row is
+                # healthy when every member is
+                row["healthy"] = (
+                    all(health_mod.healthy(w) for w in word)
+                    if isinstance(word, list)
+                    else health_mod.healthy(word))
             if getattr(nxt_state, "control", ()) != ():
                 # in-scan controller operands at the chunk boundary (a
                 # few scalar transfers): eager cap / pressure levels /

@@ -125,7 +125,7 @@ def slot_weights(cfg: Config) -> tuple[float, ...]:
 
 
 def churn(cfg: Config, ts: TrafficState, faults: faults_mod.FaultState,
-          rnd: Array, n_active) -> faults_mod.FaultState:
+          rnd: Array, n_active, seed=None) -> faults_mod.FaultState:
     """One in-scan diurnal-churn tick: each node dies/revives with the
     carried ``churn_x1e6`` probability — ``faults.churn_step``'s
     birth/death process moved inside the scan so diurnal ramps are a
@@ -134,14 +134,19 @@ def churn(cfg: Config, ts: TrafficState, faults: faults_mod.FaultState,
     the host-side churn engine, so the two compose without stream
     collisions.  Restricted to the active prefix under
     ``Config.width_operand`` (inert rows keep their init liveness —
-    the prefix-dynamics contract)."""
+    the prefix-dynamics contract).  ``seed`` is the round's EFFECTIVE
+    seed (round_body passes the salted ``cfg.seed + state.salt`` under
+    Config.salt_operand — fleet members must churn independently);
+    None falls back to the static cfg.seed."""
+    if seed is None:
+        seed = cfg.seed
     p = ts.churn_x1e6.astype(jnp.float32) / jnp.float32(1e6)
     n = faults.alive.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
     die = faults_mod.hash_bernoulli(
-        faults_mod.edge_hash(cfg.seed, rnd, _CHURN_DEATH_SALT, ids, ids), p)
+        faults_mod.edge_hash(seed, rnd, _CHURN_DEATH_SALT, ids, ids), p)
     born = faults_mod.hash_bernoulli(
-        faults_mod.edge_hash(cfg.seed, rnd, _CHURN_BIRTH_SALT, ids, ids), p)
+        faults_mod.edge_hash(seed, rnd, _CHURN_BIRTH_SALT, ids, ids), p)
     alive = jnp.where(faults.alive, ~die, born)
     if not isinstance(n_active, tuple):
         alive = jnp.where(ids < n_active, alive, faults.alive)
@@ -164,7 +169,9 @@ def generate(cfg: Config, comm, ts: TrafficState, ctx):
     ks = jnp.arange(B, dtype=jnp.int32)
     sid = gids[:, None] * 64 + ks[None, :]    # distinct stream per slot
 
-    h_arr = faults_mod.edge_hash(cfg.seed, ctx.rnd, _ARRIVAL_SALT,
+    # ctx.seed, not cfg.seed: arrivals key off the salted per-run
+    # stream (fleet members draw independent workloads)
+    h_arr = faults_mod.edge_hash(ctx.seed, ctx.rnd, _ARRIVAL_SALT,
                                  sid, gids[:, None])
     fire = faults_mod.hash_bernoulli(h_arr, rate * wvec[None, :]) \
         & ctx.alive[:, None]
@@ -173,7 +180,7 @@ def generate(cfg: Config, comm, ts: TrafficState, ctx):
     # comes from the n_active operand (not cfg.n_nodes) so a
     # width-operand run at n_active=w draws the same destinations as a
     # native n_nodes=w run — the prefix-dynamics contract.
-    h_dst = faults_mod.edge_hash(cfg.seed, ctx.rnd, _DST_SALT,
+    h_dst = faults_mod.edge_hash(ctx.seed, ctx.rnd, _DST_SALT,
                                  sid, gids[:, None])
     u = (h_dst >> 8).astype(jnp.float32) / jnp.float32(2 ** 24)
     for _ in range(t.hot_skew):
@@ -207,12 +214,13 @@ def generate(cfg: Config, comm, ts: TrafficState, ctx):
 
 def poll(ts: TrafficState) -> dict:
     """Tiny host summary of the generator's current operands (a few
-    scalar transfers — what soak chunk rows carry)."""
-    import jax
+    scalar transfers — what soak chunk rows carry).  Fleet states
+    (fleet.py — leading member axis) report per-member lists."""
+    from partisan_tpu.metrics import host_int
 
-    return {"rate_x1000": int(jax.device_get(ts.rate_x1000)),
-            "churn_x1e6": int(jax.device_get(ts.churn_x1e6)),
-            "sent": int(jax.device_get(ts.sent))}
+    return {"rate_x1000": host_int(ts.rate_x1000),
+            "churn_x1e6": host_int(ts.churn_x1e6),
+            "sent": host_int(ts.sent)}
 
 
 def snapshot(ts: TrafficState) -> dict:

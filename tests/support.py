@@ -32,10 +32,13 @@ WIDE_N = 4096 if FULL else 768
 SCAMP_BAND_N = 512 if FULL else 192
 # randomized-overlay trials per oracle gate (health BFS / provenance
 # trace-replay): the gates assert EXACT parity per overlay either way
-ORACLE_TRIALS = 40 if FULL else 16
+# (12 still sweeps faulted/partitioned/churned variants — ISSUE 14
+# runtime paydown offsetting the new fleet suite)
+ORACLE_TRIALS = 40 if FULL else 12
 # mixed-fault soak width (tests/test_soak.py 500-round storm): the
-# storm schedule and every invariant are width-independent
-SOAK_N = 256 if FULL else 96
+# storm schedule and every invariant are width-independent (80 keeps
+# the crash batches > a quarter of the overlay — ISSUE 14 paydown)
+SOAK_N = 256 if FULL else 80
 # crash/recover cycles in the p2p-stream soak (tests/test_soak.py):
 # every cycle runs the identical guarantee check; 3 still rotates the
 # crash through every receiver once
@@ -50,6 +53,17 @@ COST_SMOKE_N = 256 if FULL else 64
 # shard_map programs (fixed padded shape), so extra trials cost only
 # host BFS time
 FASTSV_TRIALS = 64 if FULL else 50
+# fleet-runner suite (tests/test_fleet.py) scale knobs: the parity /
+# storm assertions are width- and size-independent (every member is
+# compared bit-for-bit against its own serial run), so tier-1 shrinks
+# the populations without touching an assertion.  FLEET_SEARCH_W stays
+# at the ISSUE 14 acceptance floor (a W>=64 search must be ONE jitted
+# program) in both modes — the members are 16-node clusters, so width
+# is cheap; it is the serial comparisons that scale with width.
+FLEET_PAR_W = 8 if FULL else 4          # fleet-vs-loop parity width
+FLEET_SEARCH_W = 64                     # acceptance floor, both modes
+FLEET_TUNE_N = 128 if FULL else 64      # tune harness overlay size
+FLEET_TUNE_WAVES = 12 if FULL else 8    # broadcast waves per tune run
 
 
 def hv_config(n, seed, **kw):

@@ -1,0 +1,425 @@
+"""Fleet runner (partisan_tpu/fleet.py): vmapped cluster populations.
+
+The load-bearing contract is FLEET-VS-LOOP BIT-PARITY: member j of a
+vmapped fleet evolves bit-identically to an unbatched serial run with
+the same salt — through calm rounds, per-member crash+partition
+storms, flash-crowd traffic, the chunked soak engine and
+checkpoint/resume.  On top of it: the salted counter-hash contract
+(salt=0 == the unsalted program; salt=s == a native seed+s run), the
+batched Filibuster search's one-program + counterexample-replay
+acceptance (ISSUE 14), and the band-population tuner reproducing the
+committed CONTROL_AB fanout verdict.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu import fleet as fleet_mod
+from partisan_tpu import interpose, soak, workload
+from partisan_tpu.cluster import Cluster, with_salt
+from partisan_tpu.config import Config, PlumtreeConfig, TrafficConfig
+from partisan_tpu.models.plumtree import Plumtree
+from tests.support import (FLEET_PAR_W, FLEET_SEARCH_W, FLEET_TUNE_N,
+                           FLEET_TUNE_WAVES, assert_states_bitidentical)
+
+
+def _cfg(n=24, seed=7, **kw):
+    kw.setdefault("msg_words", 16)
+    kw.setdefault("partition_mode", "groups")
+    kw.setdefault("salt_operand", True)
+    return Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                  **kw)
+
+
+def _joined(cl_or_fl, st, cfg):
+    joins, contacts = list(range(1, cfg.n_nodes)), [0] * (cfg.n_nodes - 1)
+    if isinstance(cl_or_fl, fleet_mod.Fleet):
+        return st._replace(manager=cl_or_fl.map_members(
+            lambda m: cl_or_fl.manager.join_many(cfg, m, joins, contacts),
+            st.manager))
+    return st._replace(manager=cl_or_fl.manager.join_many(
+        cfg, st.manager, joins, contacts))
+
+
+def _no_salt(state):
+    """Drop the salt leaf for comparison against salt_operand=False
+    states (the only structural difference the flag introduces)."""
+    return state._replace(salt=())
+
+
+# ---------------------------------------------------------------------------
+# Satellite: salted counter-hash characterization
+# ---------------------------------------------------------------------------
+
+def test_salt_streams_diverge_and_salt0_is_bitidentical():
+    """The per-cluster salt namespaces every in-scan stream: a W=2
+    fleet with salts (0, 5) has member 0 bit-identical to the plain
+    UNSALTED unbatched run (salt_operand=False — the pre-fleet
+    program), member 1 bit-identical to a native Config(seed=base+5)
+    run, and the two members' trajectories diverge."""
+    n, seed, k = 24, 7, 12
+    cfg = _cfg(n, seed)
+
+    def drive(cl, st):
+        st = _joined(cl, st, cfg)
+        if isinstance(cl, fleet_mod.Fleet):
+            # batched leaves take batched writes (the Member-wrapper
+            # rule — a scalar write would deflate the fleet axis)
+            st = st._replace(faults=st.faults._replace(
+                link_drop=jnp.full((cl.width,), 0.1, jnp.float32)))
+            st = st._replace(model=cl.map_members(
+                lambda m: cl.model.broadcast(m, 0, 0, 3), st.model))
+        else:
+            st = st._replace(faults=st.faults._replace(
+                link_drop=jnp.float32(0.1)))
+            st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 3))
+        return cl.steps(st, k)
+
+    fl = fleet_mod.Fleet(cfg, width=2, model=Plumtree())
+    fst = drive(fl, fl.init(salts=np.asarray([0, 5], np.uint32)))
+
+    plain = Cluster(cfg.replace(salt_operand=False, fleet_width=0),
+                    model=Plumtree())
+    p = drive(plain, plain.init())
+    assert_states_bitidentical(
+        p, _no_salt(fl.member_state(fst, 0)), "salt0-vs-unsalted")
+
+    native = Cluster(cfg.replace(seed=seed + 5, salt_operand=False,
+                                 fleet_width=0), model=Plumtree())
+    nst = drive(native, native.init())
+    assert_states_bitidentical(
+        nst, _no_salt(fl.member_state(fst, 1)), "salt5-vs-native")
+
+    m0, m1 = fl.member_state(fst, 0), fl.member_state(fst, 1)
+    diff = sum(
+        int(not np.array_equal(np.asarray(jax.device_get(a)),
+                               np.asarray(jax.device_get(b))))
+        for a, b in zip(jax.tree.leaves(m0), jax.tree.leaves(m1)))
+    assert diff > 0, "members with different salts did not diverge"
+
+
+def test_traced_seed_hash_paths_match_static():
+    """edge_hash / rank32 with a traced uint32 seed reproduce the
+    Python-int path bit-for-bit (the uint32-wraparound == mod-2**32
+    identity every salted stream relies on)."""
+    from partisan_tpu import faults
+    from partisan_tpu.ops import rng
+
+    rnd = jnp.int32(13)
+    src = jnp.arange(6, dtype=jnp.int32)
+    dst = src[::-1]
+    for seed in (0, 7, 2**31 + 9):
+        h_static = faults.edge_hash(seed, rnd, 11, src, dst)
+        h_traced = jax.jit(lambda s: faults.edge_hash(
+            s, rnd, 11, src, dst))(jnp.uint32(seed))
+        np.testing.assert_array_equal(np.asarray(h_static),
+                                      np.asarray(h_traced))
+        r_static = rng.rank32(seed, rnd, 31, src, dst)
+        r_traced = jax.jit(lambda s: rng.rank32(
+            s, rnd, 31, src, dst))(jnp.uint32(seed))
+        np.testing.assert_array_equal(np.asarray(r_static),
+                                      np.asarray(r_traced))
+        k_static = rng.node_keys(seed, rnd, src)
+        k_traced = jax.jit(lambda s: rng.node_keys(s, rnd, src))(
+            jnp.uint32(seed))
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(k_static)),
+            np.asarray(jax.random.key_data(k_traced)))
+
+
+# ---------------------------------------------------------------------------
+# Fleet-vs-loop parity: storms + traffic + soak engine + checkpoints
+# ---------------------------------------------------------------------------
+
+def _storm_cfg(n=24, seed=9):
+    # dense partition mode: the Partition member-storm below needs the
+    # per-(src,dst) matrix; traffic on for the flash-crowd member
+    return _cfg(n, seed, partition_mode="dense",
+                traffic=TrafficConfig(enabled=True, rate_x1000=300,
+                                      ring=16))
+
+
+def _member_events(n):
+    """Per-member storm timelines: member 1 rides a crash+partition
+    storm, member 2 a flash-crowd traffic ramp; the rest stay calm."""
+    crash = soak.CrashBatch(nodes=(3, 5))
+    part = soak.Partition(at=n // 2)
+    heal = soak.Heal()
+    crowd = workload.flash_crowd(10, 10, 3000, 300)
+    member1 = ((8, crash), (12, part), (24, heal))
+    member2 = tuple(crowd)
+    return member1, member2
+
+
+def test_fleet_vs_serial_bitparity_under_member_storms():
+    """A W-member fleet driven through the chunked soak engine with
+    per-member storm timelines (Member-wrapped crash+partition on one
+    member, a flash-crowd traffic ramp on another) is bit-identical,
+    member by member, to W serial soak runs with the bare actions —
+    the fleet-vs-loop contract under exactly the fault surfaces the
+    sweep drivers script."""
+    n, seed, horizon, W = 24, 9, 36, FLEET_PAR_W
+    cfg = _storm_cfg(n, seed)
+    member1, member2 = _member_events(n)
+
+    fl = fleet_mod.Fleet(cfg, width=W, model=Plumtree())
+    fst = _joined(fl, fl.init(), cfg)
+    events = tuple((off, fleet_mod.Member(1, act)) for off, act in member1)
+    events += tuple((off, fleet_mod.Member(2, act)) for off, act in member2)
+    storm = soak.Storm(events=tuple(sorted(events, key=lambda e: e[0])))
+    engine = soak.Soak(make_cluster=lambda: fl, storm=storm,
+                       invariants=[soak.conservation()],
+                       cfg=soak.SoakConfig(chunk_fixed=6))
+    res = engine.run(fst, rounds=horizon)
+    assert res.breaches == 0
+    final = res.state
+
+    # serial twins: one calm member plus BOTH storm members (further
+    # calm members are redundant with member 0 — each serial run
+    # compiles its own programs, the suite's cost driver)
+    for j in range(min(W, 3)):
+        per = {1: member1, 2: member2}.get(j, ())
+        cl = Cluster(cfg.replace(fleet_width=0), model=Plumtree())
+        st = with_salt(_joined(cl, cl.init(), cfg), j)
+        sstorm = soak.Storm(events=per) if per else None
+        st = soak.reference_run(cl, st, horizon, storm=sstorm)
+        assert_states_bitidentical(st, fl.member_state(final, j),
+                                   f"member{j}")
+
+
+def test_fleet_checkpoint_resume_roundtrip(tmp_path):
+    """A fleet soak checkpoint/resume roundtrip through the soak
+    engine: kill after the first leg, resume from disk in a FRESH
+    engine, and land bit-identical to the uninterrupted run.  The
+    fingerprint carries Config.fleet_width, so a fleet snapshot
+    refuses to restore against the member (unbatched) config."""
+    from partisan_tpu import checkpoint
+
+    n, seed, W = 24, 11, 2
+    cfg = _storm_cfg(n, seed)
+    crash, part = soak.CrashBatch(nodes=(3, 5)), soak.Partition(at=n // 2)
+    storm = soak.Storm(events=(
+        (6, fleet_mod.Member(1, crash)), (12, fleet_mod.Member(1, part)),
+        (18, fleet_mod.Member(1, soak.Heal()))))
+    warm = fleet_mod.Fleet(cfg, width=W, model=Plumtree())
+
+    def run_leg(fl, rounds, state=None, resume=False):
+        engine = soak.Soak(
+            make_cluster=lambda: fl, storm=storm,
+            cfg=soak.SoakConfig(chunk_fixed=6,
+                                checkpoint_dir=str(tmp_path)))
+        if state is None and not resume:
+            state = _joined(fl, fl.init(), cfg)
+        return engine.run(state, rounds=rounds, resume=resume).state
+
+    run_leg(warm, 12)
+    # fresh-process leg: a NEW Fleet (fresh jitted programs) resumes
+    # from disk and continues
+    st2 = run_leg(fleet_mod.Fleet(cfg, width=W, model=Plumtree()),
+                  12, resume=True)
+    # uninterrupted reference reuses the warm fleet's programs
+    full = run_leg(warm, 24)
+    assert_states_bitidentical(st2, full, "resume-vs-uninterrupted")
+
+    # fingerprint: fleet checkpoints are not member checkpoints
+    steps = checkpoint.steps(str(tmp_path))
+    assert steps, "no checkpoints written"
+    member_cl = Cluster(cfg.replace(fleet_width=0), model=Plumtree())
+    with pytest.raises(checkpoint.CheckpointError):
+        checkpoint.restore(
+            tmp_path / f"ckpt_{steps[-1]}.npz", member_cl.init(),
+            cfg=member_cl.cfg)
+
+
+def test_raw_action_on_fleet_state_needs_member_wrapper():
+    """Member() validates its target; and the wrapper refuses plain
+    clusters — the guard rails around 'never apply a raw action to a
+    batched state'."""
+    cfg = _cfg(16)
+    fl = fleet_mod.Fleet(cfg, width=2, model=Plumtree())
+    st = fl.init()
+    with pytest.raises(ValueError):
+        fleet_mod.Member(5, soak.Heal()).apply(fl, st, 0)
+    cl = Cluster(cfg.replace(fleet_width=0), model=Plumtree())
+    with pytest.raises(ValueError):
+        fleet_mod.Member(0, soak.Heal()).apply(cl, cl.init(), 0)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: stacked schedule batches + frame convention
+# ---------------------------------------------------------------------------
+
+def test_schedule_drops_batch_stacks_and_validates():
+    from partisan_tpu import filibuster
+
+    s0 = frozenset()
+    s1 = frozenset({(2, 1, 3), (4, 0, 0)})
+    single = filibuster.schedule_drops(s1, 6, 4, 5)
+    batch = filibuster.schedule_drops([s0, s1], 6, 4, 5)
+    assert batch.shape == (2, 6, 4, 5)
+    assert not batch[0].any()
+    np.testing.assert_array_equal(batch[1], single)
+    with pytest.raises(ValueError):
+        filibuster.schedule_drops([frozenset({(9, 0, 0)})], 6, 4, 5)
+    with pytest.raises(ValueError):
+        filibuster.schedule_drops([frozenset({(0, 0, 7)})], 6, 4, 5)
+
+
+def test_omission_schedule_rejects_misranked_drops():
+    """A mis-ranked drops tensor (missing round axis, or an already
+    stacked batch) must fail loudly at init — apply() would otherwise
+    silently index senders as rounds."""
+    cfg = _cfg(8, salt_operand=False)
+    cl = Cluster(cfg)
+    for bad in (np.zeros((8, 4), bool), np.zeros((2, 6, 8, 4), bool)):
+        with pytest.raises(ValueError):
+            interpose.OmissionSchedule(bad).init(cfg, cl.comm)
+
+
+def test_short_schedule_tail_passes_through():
+    """The frame convention's tail rule: a schedule shorter than the
+    horizon omits nothing past its window (never broadcasts its last
+    row) — the blackout rows suppress every delivery, the rounds after
+    the window deliver again."""
+    n = 16
+    cfg = _cfg(n, seed=5, salt_operand=False)
+    T = 6
+    drops = np.ones((T, n, 64), bool)        # blackout rounds 0..5 only
+    cl = Cluster(cfg, model=Plumtree(),
+                 interpose=interpose.OmissionSchedule(drops, start=0))
+    st = _joined(cl, cl.init(), cfg)
+    st = cl.steps(st, T)
+    s = jax.device_get(st.stats)
+    assert int(s.emitted) == 0               # in-window: everything cut
+    st = cl.steps(st, 10)
+    s = jax.device_get(st.stats)
+    assert int(s.emitted) > 0                # past the window: pass-through
+
+
+# ---------------------------------------------------------------------------
+# The acceptance drivers: batched search + band tuning
+# ---------------------------------------------------------------------------
+
+def test_fleet_search_w64_one_program_and_counterexample_replay():
+    """ISSUE 14 acceptance: a W>=64 fleet.search over distinct fault
+    schedules runs as ONE jitted program per scan length (the jit
+    cache guard — no per-member retrace), every failing schedule's
+    counterexample replays bit-identically through the unbatched path
+    (search raises if not; we also re-assert coverage here), and the
+    passing schedules pass."""
+    n, W, horizon, settle = 16, FLEET_SEARCH_W, 10, 30
+    cfg = _cfg(n, seed=5, plumtree=PlumtreeConfig(aae=False))
+    joins, contacts = list(range(1, n)), [0] * (n - 1)
+
+    def build(sched):
+        fl = fleet_mod.Fleet(cfg, width=W, model=Plumtree(),
+                             interpose=sched)
+        st = fl.init(salts=np.zeros(W, np.uint32))
+        st = st._replace(manager=fl.map_members(
+            lambda m: fl.manager.join_many(cfg, m, joins, contacts),
+            st.manager))
+        st = fl.steps(st, settle)
+        st = st._replace(model=fl.map_members(
+            lambda m: fl.model.broadcast(m, 0, 0, 3), st.model))
+        return fl, st
+
+    # golden trace -> candidate population (serial member twin)
+    cl = Cluster(cfg.replace(fleet_width=0), model=Plumtree(),
+                 interpose=interpose.OmissionSchedule(
+                     np.zeros((1, 1, 1), np.bool_), start=0))
+    st = _joined(cl, cl.init(), cfg)
+    st = cl.steps(st, settle)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 3))
+    from partisan_tpu import trace as trace_mod
+
+    _, capture = cl.record(st, horizon)
+    emit_w = capture.sent.shape[2]
+    tr = trace_mod.from_capture(capture)
+    boot = int(jax.device_get(st.rnd))
+    scheds = fleet_mod.population(
+        tr, lambda e: e.kind_name.startswith("PT_"),
+        width=W - 1, max_faults=2, seed=1)
+    # one adversarial member: silence the broadcast root for the whole
+    # horizon — with AAE off, dissemination is wire-only, so coverage
+    # MUST fail (the deterministic counterexample)
+    scheds.append(frozenset(
+        (r, 0, e) for r in range(boot, boot + horizon)
+        for e in range(emit_w)))
+    assert len(set(scheds)) == W, "schedules must be distinct"
+
+    res = fleet_mod.search(build, scheds, horizon, sched_width=emit_w,
+                           coverage_slot=0, coverage_version=3)
+    assert not res.passed
+    assert res.verdicts[:-1].count(False) == 0, \
+        "trace-guided small schedules should be tolerated here"
+    assert res.verdicts[-1] is False
+    [cex] = res.counterexamples
+    assert cex.member == W - 1 and cex.replayed
+    assert cex.seed == cfg.seed        # salt 0: same-environment search
+    assert cex.oracle["coverage_value"] == pytest.approx(1 / n)
+    # the jit-cache guard: TWO scan lengths total (settle + horizon),
+    # W-INDEPENDENT — a per-member retrace would show up here
+    assert res.programs == 2, res.programs
+
+
+def test_fleet_tune_reproduces_control_ab_fanout_winner():
+    """ISSUE 14 acceptance: population-based band tuning reproduces
+    the committed CONTROL_AB.json fanout verdict from a band
+    population containing the winner — the default (adaptive) bands
+    beat a static-equivalent setting (hi band unreachable => the
+    governor never demotes and the eager cap pins at the overlay
+    width) on steady-state redundancy at full coverage."""
+    bands = [{"fanout_hi_pct": 200}, {}]        # [static-like, winner]
+    out = fleet_mod.tune(bands, n=FLEET_TUNE_N, waves=FLEET_TUNE_WAVES)
+    assert out["winner"] == 1, out
+    assert out["winner_bands"] == {}
+    m_static, m_adapt = out["members"]
+    assert m_static["coverage"] == 1.0 and m_adapt["coverage"] == 1.0
+    assert (m_adapt["steady_redundancy_ratio"]
+            < m_static["steady_redundancy_ratio"]), out
+    # band population ran as one program per scan length, not one per
+    # member (settle + wave + drain lengths)
+    assert out["programs"] <= 3
+
+
+def test_set_bands_maps_and_validates():
+    from partisan_tpu.config import ControlConfig
+
+    cfg = _cfg(16, provenance=True, provenance_ring=16,
+               control=ControlConfig(fanout=True, ring=8))
+    fl = fleet_mod.Fleet(cfg, width=3, model=Plumtree())
+    st = fl.init()
+    st = fleet_mod.set_bands(st, [{"fanout_hi_pct": 55},
+                                  {"fanout_min": 3},
+                                  {}])
+    fan = jax.device_get(st.control.fanout)
+    np.testing.assert_array_equal(np.asarray(fan.band_hi), [55, 40, 40])
+    np.testing.assert_array_equal(np.asarray(fan.band_min), [2, 3, 2])
+    with pytest.raises(ValueError):
+        fleet_mod.set_bands(st, [{"bogus": 1}, {}, {}])
+    with pytest.raises(ValueError):
+        fleet_mod.set_bands(st._replace(control=()), [{}, {}, {}])
+
+
+# ---------------------------------------------------------------------------
+# Sweep card
+# ---------------------------------------------------------------------------
+
+def test_fleet_sweep_card_distributions():
+    """scenarios.fleet_sweep: every member converges, the card carries
+    distributions over the population, and the run stays a handful of
+    programs (width-independent).  Kept tiny — the tools CLI smoke
+    (tests/test_tools_cli.py::test_fleet_report_cli_smoke) runs the
+    full exporter at 3x32 end-to-end."""
+    from partisan_tpu import scenarios
+
+    card = scenarios.fleet_sweep(width=2, n=24, max_rounds=120,
+                                 settle=24)
+    assert card["converged"] == 2
+    d = card["rounds_to_converge"]
+    assert d["count"] == 2 and d["missing"] == 0
+    assert 0 <= d["p5"] <= d["p50"] <= d["p95"]
+    assert card["programs"] <= 2
+    assert set(card["members"]["rounds_to_converge"]) != {-1}

@@ -181,7 +181,8 @@ def test_higher_epoch_ihave_recruits_pruned_node():
     inbox = exchange.route(ih.reshape(1, 1, -1), n, cfg.inbox_cap)
     ctx = RoundCtx(rnd=jnp.int32(10), alive=jnp.ones(n, bool),
                    keys=jax.random.split(jax.random.PRNGKey(0), n),
-                   inbox=inbox, faults=faults_mod.none(n))
+                   inbox=inbox, faults=faults_mod.none(n),
+                   seed=cfg.seed)
     st2, emitted = model.step(cfg, comm, st, ctx, nbrs)
     assert int(st2.epoch[0, 0]) == 1            # adopted the advert's epoch
     assert not bool(st2.pruned[0, 0, :].any())  # flags reset for new tree

@@ -247,9 +247,10 @@ def test_tools_cli_completeness():
     tools_dir = os.path.join(_REPO, "tools")
     tools = sorted(f for f in os.listdir(tools_dir)
                    if f.endswith(".py"))
-    assert len(tools) >= 10, tools
+    assert len(tools) >= 11, tools
     assert "soak_report.py" in tools
     assert "jaxlint.py" in tools
+    assert "fleet_report.py" in tools
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     procs = {}
     for tool in tools:
@@ -264,6 +265,31 @@ def test_tools_cli_completeness():
         stdout, stderr = p.communicate(timeout=120)
         assert p.returncode == 0, (tool, stderr[-2000:])
         assert stdout.strip(), f"{tool} --help printed nothing"
+
+
+def test_fleet_report_cli_smoke():
+    """Fleet-runner exporter end-to-end on CPU: one member line per
+    vmapped cluster, distribution lines with ordered quantiles, and a
+    summary whose convergence count reconciles with its own member
+    rows — the population analogue of the soak exporter's contract."""
+    out = _run("fleet_report.py", "3", "32", "--rounds", "120")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    members = [r for r in rows if r["kind"] == "member"]
+    assert len(members) == 3
+    assert all(r["salt"] == r["member"] for r in members)
+    dists = {(r["metric"], r.get("channel")) for r in rows
+             if r["kind"] == "distribution"}
+    assert ("rounds_to_converge", None) in dists
+    assert ("redundancy_ratio", None) in dists
+    conv = [r for r in rows if r["kind"] == "distribution"
+            and r["metric"] == "rounds_to_converge"][0]
+    assert conv["p5"] <= conv["p50"] <= conv["p95"]
+    summary = rows[-1]
+    assert summary["kind"] == "summary"
+    assert summary["width"] == 3
+    assert summary["converged"] == sum(
+        1 for r in members if r["rounds_to_converge"] >= 0)
 
 
 def test_soak_report_traffic_smoke():

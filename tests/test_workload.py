@@ -33,7 +33,7 @@ def _ctx(cl, rnd=5, n_active=()):
     return RoundCtx(rnd=jnp.int32(rnd), alive=jnp.ones((n,), jnp.bool_),
                     keys=None, inbox=None,
                     faults=faults_mod.none(n, "groups"),
-                    n_active=n_active, control=())
+                    n_active=n_active, control=(), seed=cl.cfg.seed)
 
 
 def _gen(cl, rnd=5, n_active=()):

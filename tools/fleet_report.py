@@ -1,0 +1,165 @@
+"""Fleet-runner JSON-lines exporter (the ``BENCH_*.json`` idiom: one
+self-describing JSON object per line).
+
+Runs a vmapped cluster population (partisan_tpu/fleet.py) — W
+independent hyparview+plumtree clusters, one seed salt each, as ONE
+jitted program — and prints one ``member`` line per cluster
+(rounds-to-converge from its health snapshot ring, whole-run
+redundancy ratio), one ``distribution`` line per metric (p5/p50/p95
+across the population), and a trailing ``summary``::
+
+    python tools/fleet_report.py [W] [n] [--rounds R] [--search]
+
+``--search`` additionally runs a small batched Filibuster-style
+schedule search (fleet.search): a population of omission schedules
+drawn from a golden trace plus one adversarial blackout schedule, one
+``schedule`` line per member with its verdict, and a
+``counterexample`` line for every failing schedule — each verified to
+replay bit-identically through the unbatched path before it prints.
+
+Importable: ``report(card)`` renders any ``scenarios.fleet_sweep``
+card as JSON lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _help() -> None:
+    print(__doc__.strip())
+
+
+def report(card: dict, out=None) -> None:
+    """Render a ``scenarios.fleet_sweep`` card as JSON lines."""
+    out = out or sys.stdout
+    members = card.get("members", {})
+    conv = members.get("rounds_to_converge", [])
+    red = members.get("redundancy_ratio", [])
+    for j in range(card["width"]):
+        print(json.dumps({
+            "kind": "member", "member": j, "salt": j,
+            "rounds_to_converge": conv[j] if j < len(conv) else None,
+            "redundancy_ratio": red[j] if j < len(red) else None,
+        }), file=out, flush=True)
+    for metric in ("rounds_to_converge", "redundancy_ratio"):
+        print(json.dumps({"kind": "distribution", "metric": metric,
+                          **card[metric]}), file=out, flush=True)
+    for ch, dist in card.get("p99", {}).items():
+        print(json.dumps({"kind": "distribution", "metric": "p99",
+                          "channel": ch, **dist}), file=out, flush=True)
+    print(json.dumps({
+        "kind": "summary", "width": card["width"], "n": card["n"],
+        "rounds": card["rounds"], "converged": card["converged"],
+        "programs": card["programs"], "wall_s": card["wall_s"],
+    }), file=out, flush=True)
+
+
+def _search_demo(n: int = 16, width: int = 6, horizon: int = 10) -> None:
+    """A small end-to-end fleet.search: schedules from a golden trace
+    plus one guaranteed-failing root blackout (plumtree with AAE off —
+    dissemination is wire-only, so silencing the broadcast root for the
+    whole horizon must break coverage)."""
+    import jax
+    import numpy as np
+
+    from partisan_tpu import fleet as fleet_mod
+    from partisan_tpu import interpose
+    from partisan_tpu import trace as trace_mod
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.config import Config, PlumtreeConfig
+    from partisan_tpu.models.plumtree import Plumtree
+
+    cfg = Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 salt_operand=True, plumtree=PlumtreeConfig(aae=False))
+    joins, contacts = list(range(1, n)), [0] * (n - 1)
+
+    def build(sched):
+        fl = fleet_mod.Fleet(cfg, width=width, model=Plumtree(),
+                             interpose=sched)
+        st = fl.init(salts=np.zeros(width, np.uint32))
+        st = st._replace(manager=fl.map_members(
+            lambda m: fl.manager.join_many(cfg, m, joins, contacts),
+            st.manager))
+        st = fl.steps(st, 30)
+        st = st._replace(model=fl.map_members(
+            lambda m: fl.model.broadcast(m, 0, 0, 3), st.model))
+        return fl, st
+
+    cl = Cluster(cfg.replace(fleet_width=0), model=Plumtree(),
+                 interpose=interpose.OmissionSchedule(
+                     np.zeros((1, 1, 1), np.bool_), start=0))
+    st = cl.init()
+    st = st._replace(manager=cl.manager.join_many(
+        cfg, st.manager, joins, contacts))
+    st = cl.steps(st, 30)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, 3))
+    _, cap = cl.record(st, horizon)
+    emit_w = cap.sent.shape[2]
+    tr = trace_mod.from_capture(cap)
+    boot = int(jax.device_get(st.rnd))
+    scheds = fleet_mod.population(
+        tr, lambda e: e.kind_name.startswith("PT_"),
+        width=width - 1, max_faults=2, seed=1)
+    scheds.append(frozenset(
+        (r, 0, e) for r in range(boot, boot + horizon)
+        for e in range(emit_w)))
+    res = fleet_mod.search(build, scheds, horizon, sched_width=emit_w,
+                           coverage_slot=0, coverage_version=3)
+    for j, ok in enumerate(res.verdicts):
+        print(json.dumps({"kind": "schedule", "member": j,
+                          "omissions": len(scheds[j]), "pass": ok}),
+              flush=True)
+    for c in res.counterexamples:
+        print(json.dumps({
+            "kind": "counterexample", "member": c.member,
+            "salt": c.salt, "seed": c.seed,
+            "omissions": len(c.schedule), "replayed": c.replayed,
+        }), flush=True)
+    print(json.dumps({"kind": "search_summary", "width": res.width,
+                      "passed": res.passed,
+                      "failing": len(res.counterexamples),
+                      "programs": res.programs}), flush=True)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--help" in argv or "-h" in argv:
+        _help()
+        return 0
+    import jax
+
+    # Persistent compile cache (the tools' shared discipline): the
+    # vmapped fleet scan re-traces per width/length — cache across
+    # invocations so the CLI smoke prices decode, not XLA.
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/partisan_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from partisan_tpu import scenarios
+
+    # consume flag VALUES before scanning positionals, so
+    # `fleet_report.py --rounds 300` does not read 300 as the width
+    argv = list(argv)
+    rounds = 200
+    if "--rounds" in argv:
+        i = argv.index("--rounds")
+        rounds = int(argv[i + 1])
+        del argv[i:i + 2]
+    sizes = [int(a) for a in argv
+             if not a.startswith("--") and a.isdigit()]
+    width = sizes[0] if sizes else 4
+    n = sizes[1] if len(sizes) > 1 else 48
+    card = scenarios.fleet_sweep(width=width, n=n, max_rounds=rounds)
+    report(card)
+    if "--search" in argv:
+        _search_demo()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
