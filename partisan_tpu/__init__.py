@@ -37,6 +37,12 @@ src/partisan_peer_service.erl):
   (crash-safe checkpoint/resume + fault-storm timelines)
 - :mod:`partisan_tpu.fleet` — vmapped cluster populations (batched
   fault-schedule search, controller-band tuning, distribution sweeps)
+- :mod:`partisan_tpu.elastic` — runtime elasticity (join-path
+  scale-out, leave-path scale-in with in-scan drain deactivation,
+  the resize timeline — `Config.elastic`)
+- :mod:`partisan_tpu.ingress` — streaming ingress (double-buffered
+  host→device inject ring at the soak chunk boundary, journaled
+  replay of external request traces — `Config.ingress`)
 - :mod:`partisan_tpu.parallel` — shard_map multi-device execution
 - :mod:`partisan_tpu.bridge` — Erlang port bridge (ETF + server)
 - :mod:`partisan_tpu.scenarios` — the five driver benchmark configs

@@ -29,7 +29,15 @@ Crash-safety hardening (the soak engine's contract, soak.py):
 - **config fingerprint** — ``save(..., cfg=...)`` stores a digest of
   the full Config (including the wire-word layout and storage dtypes,
   which PR 6 made config-dependent) so a restore against a drifted
-  configuration fails loudly even when the leaf shapes happen to agree,
+  configuration fails loudly even when the leaf shapes happen to agree.
+  The fingerprint is RESIZE-AWARE (ISSUE 15): ``n_nodes`` is excluded
+  from the digest — width is validated STRUCTURALLY (leaf shapes, and
+  the restored ``n_active`` operand) instead, so a snapshot taken at
+  one capacity restores into a wider program (``resize=True``) and an
+  elastic run resumes at a different active width under the same
+  program.  Version-3 files also store the full config FIELD TABLE, so
+  a fingerprint mismatch names the drifted fields instead of printing
+  two truncated hashes,
 - **round validation** — the state's round counter is stored beside the
   leaves; ``restore`` cross-checks it against the restored ``rnd`` leaf
   and (optionally) a caller-expected round,
@@ -51,11 +59,15 @@ import zlib
 import jax
 import numpy as np
 
-# Version 2 adds the fingerprint/round/wire-layout metadata; version 1
-# files (leaves only) remain restorable — their extra validation is
-# simply unavailable.
-FORMAT_VERSION = 2
-_COMPAT_VERSIONS = (1, 2)
+# Version 2 added the fingerprint/round/wire-layout metadata; version 3
+# makes the fingerprint resize-aware (width-free) and stores the config
+# field table + the saving width for structural validation and
+# field-by-field drift diagnostics.  Version 1 files (leaves only)
+# remain restorable — their extra validation is simply unavailable;
+# version 2 files validate against the LEGACY (width-inclusive)
+# fingerprint, so they predate resizes but never false-fail.
+FORMAT_VERSION = 3
+_COMPAT_VERSIONS = (1, 2, 3)
 _NAME = re.compile(r"^ckpt_(\d+)\.npz$")
 
 
@@ -71,21 +83,103 @@ class CheckpointCorruptError(CheckpointError):
     across config drift (older files would mask the real problem)."""
 
 
-def config_fingerprint(cfg) -> str:
-    """Stable digest of a Config — including the resolved wire layout
-    (word count + per-word storage dtypes), which determines every wire
-    buffer's shape and dtype.  Two configs with equal fingerprints
-    produce structurally interchangeable states; a mismatch means the
-    checkpoint was written under a different configuration and must not
-    be silently restored (the drift ``restore``'s shape check alone can
-    miss: e.g. a seed or cadence change keeps all shapes)."""
+_N_NODES_RE = re.compile(r"\bn_nodes=\d+")
+
+
+def _wire_desc(cfg) -> str:
     wire = cfg.wire_layout
     if isinstance(wire, tuple):
-        wire_desc = ",".join(str(np.dtype(d)) for d in wire)
-    else:
-        wire_desc = f"int32x{wire}"
-    blob = f"{cfg!r}|wire={wire_desc}".encode()
+        return ",".join(str(np.dtype(d)) for d in wire)
+    return f"int32x{wire}"
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable RESIZE-AWARE digest of a Config — including the resolved
+    wire layout (word count + per-word storage dtypes), which
+    determines every wire buffer's shape and dtype, but EXCLUDING
+    ``n_nodes``: width is a runtime quantity now (the elastic resize
+    paths move ``n_active``, and a narrower snapshot may prefix-embed
+    into a wider program — ``restore(resize=True)``), so it is
+    validated structurally (leaf shapes + the saved width metadata)
+    instead of poisoning the digest.  Every OTHER drift still fails
+    loudly — a seed or cadence change keeps all shapes, which the
+    shape check alone would miss."""
+    blob = _N_NODES_RE.sub("n_nodes=*", repr(cfg), count=1)
+    blob = f"{blob}|wire={_wire_desc(cfg)}".encode()
     return hashlib.sha256(blob).hexdigest()
+
+
+# Config fields added DURING the version-2 era (v2 shipped in PR 7 and
+# was only bumped by PR 15), at their default reprs, NEWEST FIRST: a
+# v2 file's stored digest was computed over a repr without the fields
+# that postdate it, so the legacy validation strips these groups
+# progressively and accepts a match at ANY era (a config actually
+# USING one of these features postdates the file that lacks its
+# segment and can never match it, so its mismatch is correct, not a
+# false failure).
+_POST_V2_FIELD_SEGMENTS = (
+    # PR 15: elastic + ingress lanes
+    (", elastic=False, elastic_ring=16",
+     ", ingress=IngressConfig(enabled=False, slots=8, ring_cap=4096, "
+     "quota=256, payload_op=91)"),
+    # PR 14: fleet runner operands
+    (", salt_operand=False", ", fleet_width=0"),
+    # PR 12: traffic plane
+    (", traffic=TrafficConfig(enabled=False, rate_x1000=500, "
+     "burst_max=4, zipf_s=1.0, hot_skew=0, channel='broadcast', "
+     "churn=False, ring=64)",),
+)
+
+
+def legacy_fingerprints(cfg) -> set[str]:
+    """Every version-2-era (width-inclusive) digest this config could
+    have been saved under: the post-v2 field groups stripped at their
+    defaults, one era at a time (newest first — a file written between
+    two additions carries the older fields but not the newer).
+    ``restore`` accepts a v2 file whose stored digest matches ANY era,
+    so old files under an identical logical config never false-fail
+    (tests/test_elastic.py pins the stripped form)."""
+    out = set()
+    blob = repr(cfg)
+    for group in _POST_V2_FIELD_SEGMENTS:
+        for seg in group:
+            blob = blob.replace(seg, "", 1)
+        out.add(hashlib.sha256(
+            f"{blob}|wire={_wire_desc(cfg)}".encode()).hexdigest())
+    return out
+
+
+def legacy_fingerprint(cfg) -> str:
+    """The LATEST v2-era digest (only the newest post-v2 group
+    stripped) — what a file saved just before the v3 bump stores."""
+    blob = repr(cfg)
+    for seg in _POST_V2_FIELD_SEGMENTS[0]:
+        blob = blob.replace(seg, "", 1)
+    return hashlib.sha256(
+        f"{blob}|wire={_wire_desc(cfg)}".encode()).hexdigest()
+
+
+def config_fields(cfg) -> dict:
+    """Flat ``{field: repr(value)}`` table of a Config — stored beside
+    the fingerprint (v3) so a mismatch can be diffed field-by-field
+    and the exception can NAME the drifted fields instead of printing
+    two truncated hashes."""
+    import dataclasses as _dc
+
+    out = {f.name: repr(getattr(cfg, f.name))
+           for f in _dc.fields(cfg)}
+    out["<wire>"] = _wire_desc(cfg)
+    return out
+
+
+def _diff_fields(stored: dict, expected: dict) -> list[str]:
+    """Human-readable per-field drift lines, sorted by field name."""
+    out = []
+    for k in sorted(set(stored) | set(expected)):
+        s, e = stored.get(k, "<absent>"), expected.get(k, "<absent>")
+        if s != e:
+            out.append(f"{k}: checkpoint {s} != expected {e}")
+    return out
 
 
 def save(state, path: str | os.PathLike, cfg=None) -> None:
@@ -104,7 +198,11 @@ def save(state, path: str | os.PathLike, cfg=None) -> None:
     if rnd is not None:
         meta["rnd"] = np.int64(int(np.asarray(rnd)))
     if cfg is not None:
+        import json as _json
+
         meta["fingerprint"] = np.str_(config_fingerprint(cfg))
+        meta["config_desc"] = np.str_(_json.dumps(config_fields(cfg)))
+        meta["n_nodes"] = np.int64(cfg.n_nodes)
     fd, tmp = tempfile.mkstemp(
         prefix=os.path.basename(path) + ".tmp.",
         dir=os.path.dirname(path) or ".")
@@ -134,17 +232,58 @@ def _open_checked(path):
             f"checkpoint {path!r} is corrupt or truncated: {e}") from e
 
 
+def _embed_leaf(i, a, t, old_n, new_n, jnp):
+    """Resize-restore one leaf: equal shapes pass through; shapes that
+    differ ONLY in axes where the checkpoint has ``old_n`` and the
+    template ``new_n`` (the node axes — flight rings carry theirs at
+    axis 1, dense partitions at both) prefix-embed into the template's
+    init values, so rows ``[old_n, new_n)`` come up inert exactly as a
+    fresh activation leaves them.  Anything else is real structural
+    drift and raises."""
+    tsh = np.shape(t)
+    if a.shape == tsh:
+        return jnp.asarray(a)
+    if len(a.shape) == len(tsh):
+        ok = all(sa == st or (sa == old_n and st == new_n)
+                 for sa, st in zip(a.shape, tsh))
+        if ok and old_n < new_n:
+            out = np.asarray(t).copy()
+            out[tuple(slice(0, s) for s in a.shape)] = a
+            return jnp.asarray(out)
+    raise CheckpointError(
+        f"leaf {i}: checkpoint {a.shape}/{a.dtype} != template "
+        f"{tsh}/{np.asarray(t).dtype} and the delta is not a node-axis "
+        f"prefix growth {old_n}->{new_n}")
+
+
 def restore(path: str | os.PathLike, like, cfg=None,
-            expect_rnd: int | None = None):
+            expect_rnd: int | None = None, resize: bool = False):
     """Rebuild a checkpoint against the structural template ``like``
     (same treedef — e.g. ``cluster.init()``).  Shape/dtype mismatches
     raise, catching config drift between save and restore; ``cfg``
-    additionally validates the stored config fingerprint, and
-    ``expect_rnd`` the stored round number.  Corrupt or truncated files
-    raise :class:`CheckpointError` (reading decompresses every member,
-    so a torn tail or bit flip surfaces here, not later)."""
+    additionally validates the stored config fingerprint (width-free
+    since v3 — ``n_nodes`` is validated structurally instead, so an
+    elastic snapshot resumes at any active width of the same program),
+    and ``expect_rnd`` the stored round number.  On a fingerprint
+    mismatch of a v3 file the stored config FIELD TABLE is diffed and
+    the exception names the drifted fields.  ``resize=True``
+    additionally accepts a NARROWER checkpoint into a wider template:
+    node-axis leaves prefix-embed (rows beyond the saved width keep
+    the template's init values — inert, exactly as activation expects)
+    — the cross-capacity half of resize-safe checkpoints; the restored
+    ``n_active`` operand still reports the saved active width.
+    Corrupt or truncated files raise :class:`CheckpointError` (reading
+    decompresses every member, so a torn tail or bit flip surfaces
+    here, not later)."""
+    import json as _json
+
     import jax.numpy as jnp
 
+    if resize and cfg is None:
+        raise ValueError(
+            "restore(resize=True) needs cfg= — the prefix-embed is "
+            "keyed on the template capacity (cfg.n_nodes) vs the "
+            "checkpoint's saved width")
     path = os.fspath(path)
     treedef = jax.tree.structure(like)
     tmpl = jax.tree.leaves(like)
@@ -160,6 +299,10 @@ def restore(path: str | os.PathLike, like, cfg=None,
             version = int(z["version"])
             stored_fp = (str(z["fingerprint"])
                          if "fingerprint" in z.files else None)
+            stored_desc = (str(z["config_desc"])
+                           if "config_desc" in z.files else None)
+            stored_n = (int(z["n_nodes"])
+                        if "n_nodes" in z.files else None)
             n = int(z["n_leaves"])
             stored_rnd = int(z["rnd"]) if "rnd" in z.files else None
         except (KeyError, OSError, ValueError, zipfile.BadZipFile,
@@ -172,25 +315,73 @@ def restore(path: str | os.PathLike, like, cfg=None,
                 f"checkpoint version {version} not supported "
                 f"(expected one of {_COMPAT_VERSIONS})")
         if cfg is not None and stored_fp is not None:
-            want = config_fingerprint(cfg)
-            if stored_fp != want:
+            # v3 stores the width-free digest; v2 stored a legacy
+            # width-inclusive one computed over its ERA's repr —
+            # accept any era's digest (legacy_fingerprints) so an old
+            # file under an identical logical config never false-fails.
+            if version >= 3:
+                mismatch = stored_fp != config_fingerprint(cfg)
+                want = config_fingerprint(cfg)
+            else:
+                mismatch = stored_fp not in legacy_fingerprints(cfg)
+                want = legacy_fingerprint(cfg)
+            if mismatch:
+                detail = ""
+                if stored_desc is not None:
+                    drift = _diff_fields(_json.loads(stored_desc),
+                                         config_fields(cfg))
+                    # width is deliberately digest-free (validated
+                    # structurally) — naming it as "drift" here would
+                    # blame a difference v3 explicitly permits
+                    drift = [d for d in drift
+                             if not d.startswith("n_nodes:")]
+                    if drift:
+                        detail = ("; drifted fields: "
+                                  + "; ".join(drift))
+                    else:
+                        detail = ("; no field-level drift found — "
+                                  "fingerprint scheme mismatch?")
                 raise CheckpointError(
                     f"checkpoint {path!r} was written under a different "
                     f"configuration (fingerprint {stored_fp[:12]}… != "
                     f"{want[:12]}…) — refusing to restore across config "
-                    "drift")
+                    f"drift{detail}")
         if n != len(tmpl):
             raise CheckpointError(
                 f"checkpoint has {n} leaves, template has {len(tmpl)} "
                 f"(configuration changed since save?)")
+        new_n = (cfg.n_nodes if cfg is not None else None)
+        do_resize = (resize and stored_n is not None
+                     and new_n is not None and stored_n != new_n)
+        if do_resize and stored_n > new_n:
+            raise CheckpointError(
+                f"checkpoint {path!r} was saved at capacity {stored_n} "
+                f"— cannot shrink into a {new_n}-wide template (scale "
+                "in BEFORE snapshotting, then restore the narrow "
+                "state)")
         leaves = []
         try:
             for i, t in enumerate(tmpl):
                 a = z[f"leaf_{i}"]
-                if a.shape != np.shape(t) or a.dtype != np.asarray(t).dtype:
+                if a.dtype != np.asarray(t).dtype:
                     raise CheckpointError(
                         f"leaf {i}: checkpoint {a.shape}/{a.dtype} != "
                         f"template {np.shape(t)}/{np.asarray(t).dtype}")
+                if do_resize:
+                    leaves.append(_embed_leaf(i, a, t, stored_n, new_n,
+                                              jnp))
+                    continue
+                if a.shape != np.shape(t):
+                    hint = ""
+                    if (stored_n is not None and new_n is not None
+                            and stored_n != new_n):
+                        hint = (f" (saved at capacity {stored_n}, "
+                                f"template is {new_n}-wide — pass "
+                                "resize=True to prefix-embed)")
+                    raise CheckpointError(
+                        f"leaf {i}: checkpoint {a.shape}/{a.dtype} != "
+                        f"template {np.shape(t)}/{np.asarray(t).dtype}"
+                        + hint)
                 leaves.append(jnp.asarray(a))
         except (KeyError, OSError, ValueError, zipfile.BadZipFile,
                 zlib.error) as e:

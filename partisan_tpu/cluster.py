@@ -29,7 +29,9 @@ from jax import Array
 from partisan_tpu import channels as channels_mod
 from partisan_tpu import control as control_mod
 from partisan_tpu import delivery as delivery_mod
+from partisan_tpu import elastic as elastic_mod
 from partisan_tpu import faults as faults_mod
+from partisan_tpu import ingress as ingress_mod
 from partisan_tpu import health as health_mod
 from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
@@ -111,6 +113,23 @@ class ClusterState(NamedTuple):
     #                         namespace) and what makes a member
     #                         bit-identical to the unbatched run at
     #                         Config(seed=cfg.seed + salt).
+    elastic: Any = ()       # elastic.ElasticState runtime-resize
+    #                         machinery (or () when Config.elastic is
+    #                         off — zero cost).  Carries the scale-in
+    #                         drain boundary + deadline (the ROUND
+    #                         fires the deactivation in-scan when the
+    #                         deadline passes) and the resize-event
+    #                         ring — the elastic timeline, replayed
+    #                         exactly across checkpoint restore.
+    ingress: Any = ()       # ingress.IngressState host→device inject
+    #                         buffer (or () when Config.ingress is off
+    #                         — zero cost).  Externally-enqueued
+    #                         requests staged at chunk boundaries emit
+    #                         at their release rounds as ordinary APP
+    #                         records; admission sheds count emitted
+    #                         AND dropped (CAUSE_INGRESS) so the
+    #                         conservation law survives admission
+    #                         control.
 
 
 class TraceRound(NamedTuple):
@@ -152,6 +171,23 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     seed = cfg.seed
     if cfg.salt_operand:
         seed = jnp.uint32(cfg.seed) + jnp.asarray(state.salt, jnp.uint32)
+    ex = elastic_mod.enabled(cfg)   # static: runtime-resize machinery
+    gx = ingress_mod.enabled(cfg)   # static: host→device inject lane
+    # Elastic stage FIRST (before any active-prefix mask derives): a
+    # pending scale-in deactivation fires here when its drain deadline
+    # passes — the only place the round program itself moves the
+    # n_active operand — and every n_active transition lands in the
+    # resize ring.  n_act replaces state.n_active for the REST of the
+    # round, so the deactivation round's masks, reductions and pickers
+    # all see the post-resize width (plane totals stay exact across
+    # resizes by construction).
+    estate = state.elastic
+    n_act = state.n_active
+    traffic_w = None
+    if ex:
+        with jax.named_scope("round.elastic"):
+            estate, n_act, traffic_w = elastic_mod.track(
+                cfg, state.elastic, state.rnd, state.n_active)
     if tx and cfg.traffic.churn:
         # In-scan diurnal churn: one birth/death tick at the carried
         # probability, applied at ROUND START so this round's ctx and
@@ -161,7 +197,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         with jax.named_scope("round.traffic"):
             state = state._replace(faults=workload_mod.churn(
                 cfg, state.traffic, state.faults, state.rnd,
-                state.n_active, seed=seed))
+                n_act, seed=seed))
     gids = comm.local_ids()
     keys = rng.node_keys(seed, state.rnd, gids)
     alive_local = jax.lax.dynamic_slice(
@@ -176,7 +212,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     # values.  state.faults itself stays unmasked (see RoundCtx.faults).
     faults_wire = state.faults
     if wx:
-        act_g = jnp.arange(cfg.n_nodes, dtype=jnp.int32) < state.n_active
+        act_g = jnp.arange(cfg.n_nodes, dtype=jnp.int32) < n_act
         alive_g = state.faults.alive & act_g
         faults_wire = state.faults._replace(alive=alive_g)
         alive_local = jax.lax.dynamic_slice(
@@ -184,7 +220,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
     cx = control_mod.enabled(cfg)   # static: in-scan feedback loops
     ctx = RoundCtx(rnd=state.rnd, alive=alive_local, keys=keys,
                    inbox=state.inbox, faults=state.faults,
-                   n_active=state.n_active, control=state.control,
+                   n_active=n_act, control=state.control,
                    seed=seed)
 
     # jax.named_scope labels each phase in the HLO, so profiler traces
@@ -202,10 +238,27 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         # the single assembly concatenate below — traffic records ride
         # every downstream stage (provenance/latency stamps, shed,
         # interposition, faults, route) exactly like model emissions.
+        # Under Config.elastic the arrival width is the elastic stage's
+        # traffic_w: draining rows neither source nor attract NEW
+        # arrivals (the graceful-leave half of a scale-in).
         with jax.named_scope("round.traffic"):
             tstate, t_emit = workload_mod.generate(cfg, comm,
-                                                   state.traffic, ctx)
+                                                   state.traffic, ctx,
+                                                   width=traffic_w)
             t_blocks = tuple(plane_ops.blocks_of(t_emit))
+    gstate = state.ingress
+    i_blocks = ()
+    ing_shed = ing_shed_ch = None
+    if gx:
+        # Streaming-ingress release: externally-staged requests whose
+        # release round arrived emit as a fresh [n, slots] APP block —
+        # the same downstream ride as traffic arrivals.  shed counts
+        # (source dead at release + boundary buffer-full) fold into
+        # this round's emitted+dropped books below.
+        with jax.named_scope("round.ingress"):
+            gstate, g_emit, ing_shed, ing_shed_ch = ingress_mod.release(
+                cfg, comm, state.ingress, ctx)
+            i_blocks = tuple(plane_ops.blocks_of(g_emit))
     nbrs = None
     if model is not None:
         with jax.named_scope("round.model"):
@@ -217,10 +270,11 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             # copied twice between emission and the wire.
             emitted = plane_ops.concat(
                 tuple(plane_ops.blocks_of(m_emit))
-                + tuple(plane_ops.blocks_of(a_emit)) + t_blocks,
+                + tuple(plane_ops.blocks_of(a_emit)) + t_blocks
+                + i_blocks,
                 axis=1)
     else:
-        mb = tuple(plane_ops.blocks_of(m_emit)) + t_blocks
+        mb = tuple(plane_ops.blocks_of(m_emit)) + t_blocks + i_blocks
         dstate_model = ()
         emitted = mb[0] if len(mb) == 1 else plane_ops.concat(mb, axis=1)
     if px:
@@ -537,6 +591,15 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                                         cfg.wire_layout)
 
         inbox = jax.lax.cond(any_emit, route_body, route_skip, 0)
+    if gx:
+        # Open-loop admission accounting (ingress.py): shed external
+        # requests are offered load — they join the emitted count here
+        # and the CAUSE_INGRESS drops row below, so the conservation
+        # law (emitted == delivered + dropped) holds exactly through
+        # admission control.
+        n_emitted = n_emitted + ing_shed
+        if mx:
+            emit_ch = emit_ch + ing_shed_ch
     # Crash-stopped receivers drop everything addressed to them.
     dead = ~alive_local
     if mx:
@@ -617,10 +680,13 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             # It absorbs what round_body cannot see directly (a2a quota
             # sheds inside the sharded exchange; channel-capacity
             # defer/release churn, which makes it transiently negative).
+            m_ingress = ing_shed if gx else jnp.int32(0)
             m_other = (n_emitted - ev_delivered) - (
-                m_compact + m_fault + m_inbox_of + m_dead + m_outbox)
+                m_compact + m_fault + m_inbox_of + m_dead + m_outbox
+                + m_ingress)
             drops_vec = jnp.stack([m_compact, m_fault, m_inbox_of,
-                                   m_dead, m_outbox, m_other])
+                                   m_dead, m_outbox, m_ingress,
+                                   m_other])
             dlv_of = (delivery_mod.overflow_total(dstate)
                       - delivery_mod.overflow_total(state.delivery))
             nbrs_m = nbrs if nbrs is not None \
@@ -682,9 +748,10 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                        inbox=inbox, manager=mstate, model=dstate_model,
                        delivery=dstate, stats=stats, interpose=istate,
                        outbox=obstate, metrics=mets, latency=lt,
-                       flight=fstate, n_active=state.n_active,
+                       flight=fstate, n_active=n_act,
                        health=hstate, provenance=pv, control=ctrl,
-                       traffic=tstate, salt=state.salt)
+                       traffic=tstate, salt=state.salt,
+                       elastic=estate, ingress=gstate)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent_wire,
                                dropped=fault_dropped)
@@ -697,12 +764,21 @@ def activate(state: ClusterState, width) -> ClusterState:
     simply become live, their leaves already holding init values (the
     masking above guarantees inert rows were never written).  A dynamic
     operand change, so NO retrace/recompile: the same round program
-    serves every width."""
+    serves every width.
+
+    Host-boundary validation (ISSUE 15 satellite): ``width`` must be a
+    concrete integer in ``[1, n_nodes]`` — an out-of-range operand used
+    to clamp silently downstream (every picker/mask clips), turning a
+    typo'd 10_000 on a 4096-capacity program into a quiet no-op.  The
+    guard is ``elastic.check_width`` — ONE rule shared with the
+    ScaleOut/ScaleIn paths."""
     if isinstance(state.n_active, tuple):
         raise ValueError(
             "activate() needs Config.width_operand=True (the state "
             "carries no n_active operand)")
-    return state._replace(n_active=jnp.asarray(width, jnp.int32))
+    w = elastic_mod.check_width("activate()", width,
+                                state.faults.alive.shape[0])
+    return state._replace(n_active=jnp.int32(w))
 
 
 def with_salt(state: ClusterState, salt) -> ClusterState:
@@ -834,6 +910,10 @@ class Cluster:
             traffic=(workload_mod.init(cfg)
                      if workload_mod.enabled(cfg) else ()),
             salt=(jnp.uint32(0) if cfg.salt_operand else ()),
+            elastic=(elastic_mod.init(cfg)
+                     if elastic_mod.enabled(cfg) else ()),
+            ingress=(ingress_mod.init(cfg, comm)
+                     if ingress_mod.enabled(cfg) else ()),
         )
 
     def _build_init(self) -> ClusterState:

@@ -266,6 +266,49 @@ class TrafficConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IngressConfig:
+    """Streaming ingress lane (ingress.py): a double-buffered
+    host→device inject ring at the chunked-scan boundary (ROADMAP
+    item 5).  Externally-enqueued requests — a recorded production
+    trace, a live service front-end — drain into a per-node
+    device-resident inject buffer between soak chunks (exactly where
+    the device-resident carry already meets the host) and are emitted
+    by the jitted round at their release rounds, riding every wire
+    stage (latency/provenance stamps, shed, faults, route) like any
+    model emission.
+
+    Admission control is layered: the HOST ring is bounded
+    (``ring_cap``; ring-full offers shed deterministically, tail-drop),
+    per-channel per-boundary quotas (``quota``) defer excess requests
+    to the next boundary — and when the backpressure controller is
+    armed the quota halves per pressure level (``quota >> press[ch]``),
+    so external admission rides the same feedback loop that sheds
+    stale in-flight records.  Requests that reach the device but find
+    their per-node buffer full (or their source row dead at release)
+    are shed ON DEVICE and counted under the metrics plane's
+    ``ingress_shed`` cause — and, by the open-loop stance, count as
+    offered load: emitted AND dropped, so the conservation law holds
+    through admission control.
+
+    Off (the default): the ``ClusterState.ingress`` carry leaf is
+    ``()`` and no op traces under ``round.ingress`` — zero cost,
+    bit-identical rounds (lint zero-cost rule + pinned cost budget)."""
+
+    enabled: bool = False
+    slots: int = 8          # per-node staged-request buffer slots (the
+    #                         inject block's emission width)
+    ring_cap: int = 4096    # host ring capacity (requests); ring-full
+    #                         offers shed (counted host-side)
+    quota: int = 256        # per-channel requests admitted per chunk
+    #                         boundary (0 = unlimited); halved per
+    #                         backpressure pressure level when the
+    #                         controller is armed
+    payload_op: int = 91    # default P0 op id stamped on external
+    #                         requests (distinct from TRAFFIC_OP 90 —
+    #                         both inert "opaque bytes" to app models)
+
+
+@dataclasses.dataclass(frozen=True)
 class ScampConfig:
     """SCAMP parameters (include/partisan.hrl:240-241)."""
 
@@ -368,6 +411,7 @@ class Config:
     distance: DistanceConfig = DistanceConfig()
     control: ControlConfig = ControlConfig()
     traffic: TrafficConfig = TrafficConfig()
+    ingress: IngressConfig = IngressConfig()
 
     # --- tensor capacities (sim-specific) ------------------------------
     inbox_cap: int = 32          # queued event messages per node per round
@@ -437,6 +481,30 @@ class Config:
     #                              random picker is bounded by the
     #                              operand (tests/test_program_budget.py
     #                              enforces this).
+
+    # --- runtime elasticity (elastic.py) -------------------------------
+    elastic: bool = False        # carry the ELASTIC resize machinery in
+    #                              ClusterState (elastic.ElasticState):
+    #                              an in-scan drain gauge (scale-in marks
+    #                              rows [w, n_active) draining at a
+    #                              bounded deadline; the ROUND applies
+    #                              the deactivation when the deadline
+    #                              passes — so a scale-in is ONE storm
+    #                              action and replays across checkpoint
+    #                              restore without boundary alignment),
+    #                              a resize-event ring (the elastic
+    #                              timeline: every n_active transition,
+    #                              recorded in-scan), and the traffic
+    #                              redirection that stops open-loop
+    #                              arrivals sourcing at / targeting
+    #                              draining rows.  Requires
+    #                              width_operand (resizes move the
+    #                              n_active operand).  Off = the leaf is
+    #                              () and the round is bit-identical to
+    #                              before (lint zero-cost rule keys on
+    #                              the round.elastic scope).
+    elastic_ring: int = 16       # resize events kept in the timeline
+    #                              ring (scale-out/scale-in history)
 
     # --- fleet runner (fleet.py) ---------------------------------------
     salt_operand: bool = False   # carry a per-run SEED SALT as a dynamic
@@ -640,6 +708,26 @@ class Config:
             if self.traffic.ring < 1:
                 raise ValueError(
                     f"traffic.ring must be >= 1, got {self.traffic.ring}")
+        if self.elastic and not self.width_operand:
+            raise ValueError(
+                "elastic=True moves the n_active operand at runtime — "
+                "set Config(width_operand=True)")
+        if self.elastic_ring < 1:
+            raise ValueError(
+                f"elastic_ring must be >= 1, got {self.elastic_ring}")
+        if self.ingress.enabled:
+            if not 1 <= self.ingress.slots <= 64:
+                raise ValueError(
+                    f"ingress.slots must be in [1, 64], got "
+                    f"{self.ingress.slots}")
+            if self.ingress.ring_cap < 1:
+                raise ValueError(
+                    f"ingress.ring_cap must be >= 1, got "
+                    f"{self.ingress.ring_cap}")
+            if self.ingress.quota < 0:
+                raise ValueError(
+                    f"ingress.quota must be >= 0 (0 = unlimited), got "
+                    f"{self.ingress.quota}")
         if self.fleet_width < 0:
             raise ValueError(
                 f"fleet_width must be >= 0, got {self.fleet_width}")
@@ -843,4 +931,6 @@ class Config:
             d["control"] = ControlConfig(**d["control"])
         if "traffic" in d and isinstance(d["traffic"], Mapping):
             d["traffic"] = TrafficConfig(**d["traffic"])
+        if "ingress" in d and isinstance(d["ingress"], Mapping):
+            d["ingress"] = IngressConfig(**d["ingress"])
         return cls(**d)

@@ -1,0 +1,364 @@
+"""Runtime elasticity: scale-out/scale-in of a live cluster under
+traffic (ROADMAP item 5 — the width-operand machinery promoted from a
+bootstrap trick to a production capability).
+
+Partisan's whole point is membership that survives churn — nodes join
+and leave while traffic flows (Meiklejohn et al., ATC'19) — yet until
+this module the sim's capacity was chosen at construction time: the
+``n_active`` operand (Config.width_operand, PR 3) could activate prefix
+rows only as a bootstrap-ladder device, and nothing could shrink a
+cluster gracefully.  This module makes both first-class, composable
+with storms/traffic timelines, and replay-exact across checkpoint
+restore:
+
+**Scale-out** (:class:`ScaleOut`, :func:`scale_out`): activate rows
+``[cur, w)`` of the pre-allocated program — a dynamic-operand change,
+no retrace — and enroll them through the manager's JOIN machinery
+(``join_many`` at hash-derived contacts in the old prefix,
+:func:`join_contacts`): activated rows enter like real nodes joining a
+live overlay, never silently pre-wired.  The join storm settles through
+the ordinary admission/retry paths.
+
+**Scale-in** (:class:`ScaleIn`): graceful, through the LEAVE path —
+rows ``[w, cur)`` get the manager's leave (disconnect fan-out, the
+reference's leaver shutting its instance down), the traffic plane stops
+sourcing/targeting NEW arrivals at them (the ``round.elastic``
+redirection in cluster.round_body), and in-flight records (outbox/ack
+queues, routed deliveries) flush for a bounded drain window.  The
+DEACTIVATION itself happens IN-SCAN: :class:`ElasticState` carries the
+drain boundary and an absolute-round deadline, and the jitted round
+flips ``n_active`` down when the deadline passes — so one storm action
+scripts the whole sequence, chunk boundaries never need to align with
+the deadline, and a checkpoint restored mid-drain replays the
+deactivation at the identical round.
+
+**The elastic timeline.**  Every ``n_active`` transition (host
+activation or in-scan deactivation alike) is recorded in a small
+device-resident resize ring — ``snapshot``/``poll`` read it back, soak
+chunk rows carry it, and ``telemetry.replay_elastic_events`` turns it
+into ``partisan.elastic.*`` bus events.
+
+Zero cost when off (the planes' discipline): ``Config.elastic=False``
+(the default) keeps the carry leaf ``()`` and no op traces under
+``round.elastic`` — lint zero-cost rule + the pinned ``round/elastic``
+cost budget (lint/cost_budgets.py).  Replicated under sharding (every
+leaf is a reduced scalar or a ring of them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.config import Config
+
+# Hash-site salt for scale-out join contacts (the faults.py one-salt-
+# per-call-site discipline).
+_CONTACT_SALT = 7901
+
+
+class ElasticState(NamedTuple):
+    """The elastic plane's carry (all replicated — reduced scalars and
+    rings of them)."""
+
+    drain_lo: Array     # int32 — scale-in target width while draining
+    #                     (-1 = not draining).  Rows [drain_lo,
+    #                     n_active) are DRAINING: they have left the
+    #                     overlay (manager leave) and the traffic plane
+    #                     neither sources nor targets new arrivals
+    #                     there, but they stay alive to flush in-flight
+    #                     records until the deadline.
+    deadline: Array     # int32 — absolute round the in-scan
+    #                     deactivation fires (n_active := drain_lo)
+    prev_active: Array  # int32 — last round's n_active (transition
+    #                     detector for the resize ring)
+    rnd_ring: Array     # int32[R] — resize-event rounds (-1 = empty)
+    w_ring: Array       # int32[R] — n_active value after each event
+    from_ring: Array    # int32[R] — n_active value BEFORE each event
+    #                     (the direction tag replay_elastic_events
+    #                     reads: w < from is a scale-in).  The FIRST
+    #                     recorded transition's from-width is the
+    #                     CONSTRUCTION capacity (prev_active inits to
+    #                     cfg.n_nodes) — a static property, so it is
+    #                     the one elastic value excluded from the
+    #                     prefix-dynamics contract across capacities
+    #                     (tests/test_elastic.py)
+    resizes: Array      # int32 — cumulative resize transitions
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.elastic
+
+
+def init(cfg: Config) -> ElasticState:
+    R = cfg.elastic_ring
+    return ElasticState(
+        drain_lo=jnp.int32(-1),
+        deadline=jnp.int32(0),
+        prev_active=jnp.int32(cfg.n_nodes),
+        rnd_ring=jnp.full((R,), -1, jnp.int32),
+        w_ring=jnp.zeros((R,), jnp.int32),
+        from_ring=jnp.zeros((R,), jnp.int32),
+        resizes=jnp.int32(0),
+    )
+
+
+def track(cfg: Config, es: ElasticState, rnd: Array, n_active: Array):
+    """The in-scan elastic stage (cluster.round_body, ``round.elastic``
+    scope), run at ROUND START before the active-prefix masks derive:
+
+    1. fire the pending scale-in deactivation when the drain deadline
+       passes (``n_active := drain_lo`` — the only place the round
+       program itself moves the width operand),
+    2. record any ``n_active`` transition (host activation or the
+       in-scan deactivation) into the resize ring,
+    3. return the effective TRAFFIC width: ``drain_lo`` while draining
+       (new arrivals neither source at nor target draining rows), else
+       the post-deactivation ``n_active``.
+
+    Returns ``(state', n_active', traffic_width)``."""
+    draining = es.drain_lo >= 0
+    fire = draining & (rnd >= es.deadline)
+    n_act = jnp.where(fire, es.drain_lo, n_active)
+    drain_lo = jnp.where(fire, jnp.int32(-1), es.drain_lo)
+    # Effective arrival width: during the drain window NEW open-loop
+    # arrivals stay inside the surviving prefix; after (and without a
+    # drain) it is simply the active width.
+    traffic_w = jnp.where(drain_lo >= 0, es.drain_lo, n_act)
+
+    changed = n_act != es.prev_active
+    slot = jnp.mod(es.resizes, cfg.elastic_ring)
+    rnd_ring = jnp.where(changed, es.rnd_ring.at[slot].set(rnd),
+                         es.rnd_ring)
+    w_ring = jnp.where(changed, es.w_ring.at[slot].set(n_act),
+                       es.w_ring)
+    from_ring = jnp.where(
+        changed, es.from_ring.at[slot].set(es.prev_active),
+        es.from_ring)
+    out = ElasticState(
+        drain_lo=drain_lo,
+        deadline=es.deadline,
+        prev_active=n_act,
+        rnd_ring=rnd_ring,
+        w_ring=w_ring,
+        from_ring=from_ring,
+        resizes=es.resizes + changed.astype(jnp.int32),
+    )
+    return out, n_act, traffic_w
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers (the planes' poll/snapshot idiom)
+# ---------------------------------------------------------------------------
+
+def poll(es: ElasticState) -> dict:
+    """Tiny host summary (a few scalar transfers — what soak chunk rows
+    carry).  Fleet states report per-member lists (metrics.host_int)."""
+    from partisan_tpu.metrics import host_int
+
+    return {"drain_lo": host_int(es.drain_lo),
+            "deadline": host_int(es.deadline),
+            "n_active": host_int(es.prev_active),
+            "resizes": host_int(es.resizes)}
+
+
+def snapshot(es: ElasticState) -> dict:
+    """Decode the resize ring (one device->host transfer): the elastic
+    timeline, ordered by round via the shared ``metrics.ring_order``."""
+    import jax
+    import numpy as np
+
+    from partisan_tpu.metrics import ring_order
+
+    host = jax.device_get(es)
+    rnd = np.asarray(host.rnd_ring)
+    idx = ring_order(rnd)
+    return {"rounds": rnd[idx], "widths": np.asarray(host.w_ring)[idx],
+            "from": np.asarray(host.from_ring)[idx],
+            "resizes": int(host.resizes),
+            "drain_lo": int(host.drain_lo),
+            "n_active": int(host.prev_active)}
+
+
+# ---------------------------------------------------------------------------
+# Validation + the join/leave plumbing
+# ---------------------------------------------------------------------------
+
+def check_width(tag: str, w, n: int) -> int:
+    """THE host-boundary width guard, shared by ``cluster.activate``
+    and both scale paths (one rule, one message): the width must be a
+    concrete integer in ``[1, n]`` — an out-of-range operand used to
+    clamp silently downstream (every picker/mask clips), turning a
+    typo'd 10_000 on a 4096-capacity program into a quiet no-op."""
+    try:
+        w = int(w)
+    except TypeError as e:
+        raise ValueError(
+            f"{tag}: width must be a concrete host-side integer "
+            f"(got {type(w).__name__}) — resizes are host boundary "
+            "actions, never traced") from e
+    if not 1 <= w <= n:
+        raise ValueError(
+            f"{tag}: width {w} out of range [1, {n}] — the program's "
+            f"capacity is fixed at construction (cfg.n_nodes={n}); "
+            "widths beyond it would silently clamp downstream")
+    return w
+
+
+def join_contacts(seed: int, rnd: int, lo: int, hi: int):
+    """Deterministic join contacts for rows ``[lo, hi)``: each new row
+    gets a hash-derived contact in the OLD prefix ``[0, lo)`` — pure in
+    (seed, rnd), so a restored-and-replayed scale-out enrolls the
+    identical topology.  Keyed on cfg.seed (not the salted stream),
+    like storm crash batches: the join geometry is part of the
+    scripted timeline, not the per-member noise."""
+    from partisan_tpu import faults as faults_mod
+
+    ids = jnp.arange(lo, hi, dtype=jnp.int32)
+    h = faults_mod.edge_hash(seed, jnp.int32(rnd), _CONTACT_SALT,
+                             ids, ids)
+    return (h % jnp.uint32(max(lo, 1))).astype(jnp.int32)
+
+
+def _leave_many(manager, cfg: Config, mstate, nodes):
+    """Batched graceful leave: one scatter where the manager supports
+    it, else the per-node ``leave`` loop (the Manager protocol
+    minimum)."""
+    if hasattr(manager, "leave_many"):
+        return manager.leave_many(cfg, mstate, nodes)
+    for i in nodes:
+        mstate = manager.leave(cfg, mstate, int(i))
+    return mstate
+
+
+def _join_many(manager, cfg: Config, mstate, nodes, targets):
+    if hasattr(manager, "join_many"):
+        return manager.join_many(cfg, mstate, nodes, targets)
+    for i, t in zip(nodes, targets):
+        mstate = manager.join(cfg, mstate, int(i), int(t))
+    return mstate
+
+
+# ---------------------------------------------------------------------------
+# Storm actions (duck-typed soak.Action — pure ``apply(cluster, state,
+# rnd) -> state`` keyed by absolute round, the resume-correctness
+# obligation documented on soak.Storm)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ScaleOut:
+    """Grow the active prefix to ``width`` under live traffic: activate
+    rows ``[cur, width)`` (same program — a dynamic-operand change) and
+    enroll them via the manager's scripted-join machinery at
+    hash-derived contacts in the old prefix (:func:`join_contacts`).
+    The join storm then settles through the ordinary admission/retry
+    paths — activated rows are never silently pre-wired.  Requires
+    ``Config.width_operand``; refuses to fire mid-drain (finish the
+    scale-in first — interleaved resizes would race the in-scan
+    deactivation)."""
+
+    width: int
+
+    def apply(self, cluster, state, rnd):
+        import numpy as np
+
+        from partisan_tpu import cluster as cluster_mod
+
+        if isinstance(state.n_active, tuple):
+            raise ValueError(
+                "ScaleOut needs Config(width_operand=True) — the state "
+                "carries no n_active operand")
+        cfg = cluster.cfg
+        w = check_width("ScaleOut", self.width, cfg.n_nodes)
+        cur = int(np.asarray(state.n_active))
+        if w <= cur:
+            raise ValueError(
+                f"ScaleOut to width {w} but n_active is already {cur} "
+                "— scale-out must grow (use ScaleIn to shrink)")
+        if getattr(state, "elastic", ()) != ():
+            if int(np.asarray(state.elastic.drain_lo)) >= 0:
+                raise ValueError(
+                    "ScaleOut while a scale-in drain is pending — wait "
+                    "for the drain deadline (the in-scan deactivation) "
+                    "before growing again")
+        contacts = join_contacts(cfg.seed, rnd, cur, w)
+        nodes = jnp.arange(cur, w, dtype=jnp.int32)
+        state = cluster_mod.activate(state, w)
+        return state._replace(manager=_join_many(
+            cluster.manager, cfg, state.manager, nodes, contacts))
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleIn:
+    """Shrink the active prefix to ``width``, gracefully: rows
+    ``[width, cur)`` LEAVE first (the manager's disconnect fan-out /
+    leave gossip), new open-loop arrivals stop sourcing at and
+    targeting them (the ``round.elastic`` traffic redirection), and
+    after ``drain`` rounds — the bounded outbox/ack flush window — the
+    jitted round deactivates them IN-SCAN at the recorded deadline.
+    One action scripts the whole sequence; a checkpoint restored
+    mid-drain replays the deactivation at the identical round.
+    Requires ``Config.elastic`` (the drain machinery lives in the
+    ElasticState carry)."""
+
+    width: int
+    drain: int = 32
+
+    def apply(self, cluster, state, rnd):
+        import numpy as np
+
+        if getattr(state, "elastic", ()) == ():
+            raise ValueError(
+                "ScaleIn needs Config(elastic=True) — the graceful "
+                "drain deadline lives in the ElasticState carry")
+        cfg = cluster.cfg
+        w = check_width("ScaleIn", self.width, cfg.n_nodes)
+        if self.drain < 1:
+            raise ValueError(
+                f"ScaleIn drain window must be >= 1 round, got "
+                f"{self.drain}")
+        cur = int(np.asarray(state.n_active))
+        if w >= cur:
+            raise ValueError(
+                f"ScaleIn to width {w} but n_active is {cur} — "
+                "scale-in must shrink (use ScaleOut to grow)")
+        if int(np.asarray(state.elastic.drain_lo)) >= 0:
+            raise ValueError(
+                "ScaleIn while an earlier drain is still pending — "
+                "one drain window at a time")
+        nodes = jnp.arange(w, cur, dtype=jnp.int32)
+        mstate = _leave_many(cluster.manager, cfg, state.manager, nodes)
+        es = state.elastic._replace(
+            drain_lo=jnp.int32(w),
+            deadline=jnp.int32(int(rnd) + int(self.drain)))
+        return state._replace(manager=mstate, elastic=es)
+
+
+# ---------------------------------------------------------------------------
+# Direct host APIs (the non-soak front door)
+# ---------------------------------------------------------------------------
+
+def scale_out(cluster, state, width: int):
+    """Scale out NOW (at the state's current round): activate + enroll.
+    Equivalent to ``ScaleOut(width)`` firing at this round; the caller
+    steps the cluster to let the join storm settle."""
+    import numpy as np
+
+    return ScaleOut(width).apply(cluster, state,
+                                 int(np.asarray(state.rnd)))
+
+
+def scale_in(cluster, state, width: int, drain: int = 32,
+             settle: int = 0):
+    """Scale in NOW, running the drain to completion: leave + traffic
+    redirection, then ``drain + 1 + settle`` rounds so the in-scan
+    deadline fires and the overlay settles.  Returns the post-drain
+    state (``n_active == width``)."""
+    import numpy as np
+
+    state = ScaleIn(width, drain=drain).apply(
+        cluster, state, int(np.asarray(state.rnd)))
+    return cluster.steps(state, drain + 1 + settle)

@@ -1,0 +1,494 @@
+"""Streaming ingress: a double-buffered host→device inject ring at the
+chunked-scan boundary (ROADMAP item 5 — the live-bridge seam of
+ARCHITECTURE.md opened into a production arrival lane).
+
+Until this module every message in the sim was BORN IN-SCAN: model
+emissions, or the workload generator's synthetic arrivals (workload.py)
+— both pure functions of the config.  A servable core needs the
+opposite: request streams that originate OUTSIDE the program (a
+recorded production trace, a live front-end) and still ride the
+deterministic round.  The chunk boundary of the soak engine is exactly
+where the device-resident carry already meets the host, so that is
+where the lane opens:
+
+**The host ring** (:class:`IngressRing`) is double-buffered: producers
+``offer`` requests into the FRONT buffer at any time while the soak
+engine drains the BACK buffer staged at the previous boundary — host
+enqueue overlaps device execution, the classic double buffer.  The
+ring is bounded (``IngressConfig.ring_cap``): a full ring sheds offers
+deterministically (tail-drop), counted in the ring's host ledger.
+
+**The boundary drain** (:class:`IngressFeed`) pops requests FIFO under
+per-channel per-boundary quotas (``IngressConfig.quota``; with the
+backpressure controller armed the quota halves per pressure level —
+external admission rides the same feedback loop that sheds stale
+in-flight records), stages them into the device-resident per-node
+inject buffer (one scatter), and JOURNALS the batch: the append-only
+JSON-lines journal (:class:`Journal`) is both the replay file format —
+a recorded external trace is a second arrival mode for the SLO suite
+(``workload.trace_arrivals`` produces one from the in-scan law) — and
+the resume contract: a soak rewound or restarted re-injects the
+journaled batches at their boundaries instead of re-draining the ring,
+so the elastic/storm/ingress timeline replays bit-for-bit.
+
+**The in-scan release** (:func:`release`, cluster.round_body under
+``round.ingress``): each staged request emits at its release round from
+its source row as an ordinary APP record — latency/provenance stamps,
+shed, interposition, faults and route all apply.  Requests whose
+source row is dead (or deactivated) at release, and requests the drain
+could not stage (per-node buffer full), are shed ON DEVICE and — by
+the open-loop stance: offered load is load — counted as emitted AND
+dropped under the metrics plane's ``ingress_shed`` cause
+(metrics.CAUSE_INGRESS), so the conservation law holds exactly through
+admission control.
+
+Zero cost when off (the planes' discipline):
+``Config(ingress=IngressConfig(enabled=False))`` — the default — keeps
+the carry leaf ``()`` and no op under ``round.ingress`` (lint
+zero-cost rule; ``scan/ingress`` matrix entry, pinned ``round/ingress``
+cost budget)."""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu import types as T
+from partisan_tpu.config import Config
+from partisan_tpu.ops import msg as msg_ops
+
+
+class Request(NamedTuple):
+    """One external request: release at absolute round ``rnd`` (clamped
+    forward if already past), emitted by node ``src`` to ``dst`` on
+    ``channel``, carrying one payload word."""
+
+    rnd: int
+    src: int
+    dst: int
+    channel: int = 0
+    payload: int = 0
+
+
+class IngressState(NamedTuple):
+    """The device-resident inject buffer: ``S = IngressConfig.slots``
+    staged requests per node (node-sharded under parallel/sharded.py),
+    plus replicated shed/injected ledgers."""
+
+    dst: Array        # int32[n_local, S] — destination (-1 = empty)
+    channel: Array    # int32[n_local, S]
+    payload: Array    # int32[n_local, S]
+    release: Array    # int32[n_local, S] — absolute release round
+    #                   (-1 = empty slot)
+    shed_pend: Array  # int32[C] — per-channel boundary-drain sheds
+    #                   (buffer-full) not yet folded into a round's
+    #                   books; the next round's release() counts them
+    #                   emitted+dropped (CAUSE_INGRESS) and zeroes this
+    shed_total: Array  # int32 — cumulative device-side ingress sheds
+    injected: Array   # int32 — cumulative requests actually emitted
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.ingress.enabled
+
+
+def init(cfg: Config, comm) -> IngressState:
+    n, S = comm.n_local, cfg.ingress.slots
+    return IngressState(
+        dst=jnp.full((n, S), -1, jnp.int32),
+        channel=jnp.zeros((n, S), jnp.int32),
+        payload=jnp.zeros((n, S), jnp.int32),
+        release=jnp.full((n, S), -1, jnp.int32),
+        shed_pend=jnp.zeros((cfg.n_channels,), jnp.int32),
+        shed_total=jnp.int32(0),
+        injected=jnp.int32(0),
+    )
+
+
+def release(cfg: Config, comm, gs: IngressState, ctx):
+    """The in-scan release stage: emit every staged request whose
+    release round has arrived (``release <= rnd``) from its source row
+    as a fresh ``[n_local, S]`` APP emission block for round_body's
+    single assembly concatenate, then clear the slots.  A due request
+    whose source row is dead/inactive (``ctx.alive`` False) cannot be
+    emitted — it is shed, and joins the boundary's pending buffer-full
+    sheds in this round's emitted+dropped books (the open-loop
+    accounting; see module docstring).
+
+    Returns ``(state', emitted, shed_round, shed_ch)``: ``shed_round``
+    the replicated scalar the round adds to its emission count and the
+    ``CAUSE_INGRESS`` drops row, ``shed_ch`` its per-channel breakdown
+    (added to the per-channel emitted series so it keeps summing to
+    the scalar count)."""
+    C = cfg.n_channels
+    gids = comm.local_ids()
+    due = (gs.release >= 0) & (gs.release <= ctx.rnd)
+    fire = due & ctx.alive[:, None]
+    stale = due & ~ctx.alive[:, None]
+    ch = jnp.clip(gs.channel, 0, C - 1)
+    dstv = jnp.where(fire, gs.dst, -1)
+    emitted = msg_ops.build(
+        cfg, T.MsgKind.APP, gids[:, None], dstv, channel=ch,
+        payload=(gs.payload,))
+    n_fire = comm.allsum(jnp.sum(fire, dtype=jnp.int32))
+    stale_ch = comm.allsum(jnp.sum(
+        (ch[..., None] == jnp.arange(C)) & stale[..., None],
+        axis=(0, 1), dtype=jnp.int32))
+    shed_ch = gs.shed_pend + stale_ch
+    shed_round = jnp.sum(shed_ch, dtype=jnp.int32)
+    out = IngressState(
+        dst=jnp.where(due, -1, gs.dst),
+        channel=jnp.where(due, 0, gs.channel),
+        payload=jnp.where(due, 0, gs.payload),
+        release=jnp.where(due, -1, gs.release),
+        shed_pend=jnp.zeros((C,), jnp.int32),
+        shed_total=gs.shed_total + shed_round,
+        injected=gs.injected + n_fire,
+    )
+    return out, emitted, shed_round, shed_ch
+
+
+def poll(gs: IngressState) -> dict:
+    """Tiny host summary (scalar transfers — what soak chunk rows
+    carry); fleet states report per-member lists."""
+    import jax
+    import numpy as np
+
+    from partisan_tpu.metrics import host_int
+
+    rel = np.asarray(jax.device_get(gs.release))
+    return {"staged": int((rel >= 0).sum()),
+            "injected": host_int(gs.injected),
+            "shed": host_int(gs.shed_total)}
+
+
+# ---------------------------------------------------------------------------
+# The host ring (double-buffered, bounded)
+# ---------------------------------------------------------------------------
+
+class IngressRing:
+    """Bounded double-buffered request ring.  ``offer`` appends to the
+    FRONT buffer (the producer side, any time); ``begin_drain`` swaps —
+    the filled front becomes this boundary's drain batch while new
+    offers land in a fresh front — and ``defer`` puts quota-rejected
+    requests back at the HEAD of the front buffer (FIFO order is
+    preserved across boundaries: deferred requests drain first next
+    time).  Ring-full offers shed deterministically (tail-drop),
+    counted in the ``offered``/``shed_full`` ledger."""
+
+    def __init__(self, cap: int):
+        if cap < 1:
+            raise ValueError(f"ring cap must be >= 1, got {cap}")
+        self.cap = cap
+        self._front: collections.deque = collections.deque()
+        self._back: collections.deque = collections.deque()
+        self.offered = 0
+        self.shed_full = 0
+
+    def __len__(self) -> int:
+        return len(self._front) + len(self._back)
+
+    def offer(self, reqs) -> int:
+        """Enqueue requests; returns how many were ACCEPTED (the rest
+        shed on a full ring — the bounded-admission contract)."""
+        accepted = 0
+        for r in reqs:
+            self.offered += 1
+            if len(self) >= self.cap:
+                self.shed_full += 1
+                continue
+            self._front.append(Request(*r))
+            accepted += 1
+        return accepted
+
+    def begin_drain(self) -> list:
+        """Swap buffers and return this boundary's drain batch (FIFO:
+        any leftover from the previous boundary first)."""
+        batch = list(self._back) + list(self._front)
+        self._back = collections.deque()
+        self._front = collections.deque()
+        return batch
+
+    def defer(self, reqs) -> None:
+        """Requests rejected by this boundary's quota go back to the
+        head of the line for the next one."""
+        self._back.extend(reqs)
+
+
+# ---------------------------------------------------------------------------
+# The replay journal (the recorded-trace file format)
+# ---------------------------------------------------------------------------
+
+class Journal:
+    """Append-only JSON-lines journal of boundary drains: one line
+    ``{"round": r, "requests": [[rnd, src, dst, channel, payload],
+    ...]}`` per boundary that staged anything.  Doubles as the replay
+    file format — ``load`` turns a recorded trace back into the
+    round-keyed batches an :class:`IngressFeed` re-injects."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+
+    def append(self, rnd: int, reqs) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps({
+                "round": int(rnd),
+                "requests": [list(Request(*r)) for r in reqs]}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    @staticmethod
+    def load(path: str | os.PathLike) -> dict:
+        """``{round: [Request, ...]}`` from a journal/trace file (empty
+        when the file does not exist yet)."""
+        out: dict = {}
+        path = os.fspath(path)
+        if not os.path.exists(path):
+            return out
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                out[int(row["round"])] = [
+                    Request(*r) for r in row["requests"]]
+        return out
+
+
+def write_trace(path: str | os.PathLike, reqs, every: int = 1) -> int:
+    """Write a request list as a replay trace, batched onto boundary
+    rounds (requests released at round r land in the batch for the
+    largest multiple of ``every`` <= r — matching a soak whose chunks
+    are ``every`` rounds).  Returns the number of batches written."""
+    byrnd: dict = {}
+    for r in reqs:
+        r = Request(*r)
+        byrnd.setdefault((r.rnd // every) * every, []).append(r)
+    j = Journal(path)
+    if os.path.exists(j.path):
+        os.unlink(j.path)
+    for rnd in sorted(byrnd):
+        j.append(rnd, byrnd[rnd])
+    return len(byrnd)
+
+
+# ---------------------------------------------------------------------------
+# The boundary feed (drain + stage + journal + replay)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class IngressFeed:
+    """What the soak engine calls at every chunk boundary
+    (``Soak.ingress``).  Modes compose:
+
+    - **live**: a :class:`IngressRing` to drain, with per-channel
+      quotas (base ``Config.ingress.quota``, halved per backpressure
+      pressure level when the controller is armed) and an optional
+      release-round lookahead ``window`` (requests due beyond
+      ``r + window`` stay in the ring — the per-node buffer only holds
+      ``slots`` future releases);
+    - **journaled**: every staged batch is RECORDED — in memory always
+      (an in-process rewound retry re-injects the recorded batch and
+      leaves the ring untouched, even journal-less), and appended to
+      ``journal_path`` when set (the replay file AND the fresh-process
+      resume contract; live rings without a journal cannot replay
+      across a process restart — pass ``journal_path`` for that);
+    - **replay**: no ring, just a journal/trace file — the recorded
+      external trace as an arrival mode.  Recorded rounds are BOUNDARY
+      rounds: the soak's chunk sizer clips at :meth:`next_after` (like
+      storm events), so adaptive chunking always lands a boundary
+      exactly on each recorded batch; batches recorded for rounds
+      before the run's start are never injected (align the trace with
+      ``write_trace(..., every=...)``).
+    """
+
+    ring: IngressRing | None = None
+    journal_path: str | os.PathLike | None = None
+    window: int = 0               # 0 = stage everything due eventually
+
+    def __post_init__(self):
+        self._journal = (Journal(self.journal_path)
+                         if self.journal_path is not None else None)
+        # In-memory replay record: boundary round -> staged batch.
+        # Seeded from the journal file (fresh-process resume / trace
+        # mode) and grown by every live drain — the rewind contract
+        # holds with or without a journal on disk.
+        self._recorded = (Journal.load(self.journal_path)
+                          if self.journal_path is not None else {})
+
+    def next_after(self, rnd: int):
+        """Smallest recorded boundary round strictly greater than
+        ``rnd`` (None when none remain) — the soak's chunk sizer clips
+        at it, exactly like a storm event, so adaptive chunking never
+        skips past a recorded batch."""
+        later = [r for r in self._recorded if r > rnd]
+        return min(later) if later else None
+
+    def prune(self, before_rnd: int) -> int:
+        """Drop in-memory replay records below ``before_rnd`` (the
+        soak calls this at every durable checkpoint: a rewind never
+        goes below the last checkpoint round, and a fresh-process
+        resume re-seeds from the journal FILE — so entries below it
+        are dead weight that would otherwise grow for the whole run).
+        Returns how many were dropped."""
+        stale = [r for r in self._recorded if r < before_rnd]
+        for r in stale:
+            del self._recorded[r]
+        return len(stale)
+
+    # ---- pieces ------------------------------------------------------
+    def _quotas(self, cfg: Config, state):
+        """Per-channel admission quota for this boundary: the base
+        quota (0 = unlimited), halved per pressure level when the
+        backpressure controller is armed — external admission rides
+        the existing feedback loop."""
+        import numpy as np
+
+        base = cfg.ingress.quota
+        if base <= 0:
+            return None
+        q = [base] * cfg.n_channels
+        ctrl = getattr(state, "control", ())
+        if ctrl != () and getattr(ctrl, "backpressure", ()) != ():
+            import jax
+
+            press = np.asarray(
+                jax.device_get(ctrl.backpressure.press)).reshape(-1)
+            for c in range(min(cfg.n_channels, press.shape[0])):
+                q[c] = max(1, base >> int(press[c]))
+        return q
+
+    def _select(self, cfg: Config, batch, r: int, quotas):
+        """FIFO admission under quotas + the release-round window.
+        Returns (admitted, deferred)."""
+        take, defer = [], []
+        used = [0] * cfg.n_channels
+        for req in batch:
+            req = Request(*req)
+            ch = min(max(int(req.channel), 0), cfg.n_channels - 1)
+            if self.window > 0 and req.rnd >= r + self.window:
+                defer.append(req)
+            elif quotas is not None and used[ch] >= quotas[ch]:
+                defer.append(req)
+            else:
+                used[ch] += 1
+                take.append(req)
+        return take, defer
+
+    # ---- the boundary hook -------------------------------------------
+    def drain(self, cluster, state, r: int):
+        """Stage this boundary's requests onto ``state`` (see class
+        doc).  Returns ``(state', report | None)`` — the report dict is
+        the soak log's ``ingress_drain`` event payload."""
+        cfg = cluster.cfg
+        if getattr(state, "ingress", ()) == ():
+            raise ValueError(
+                "IngressFeed needs the ingress lane compiled in — "
+                "Config(ingress=IngressConfig(enabled=True))")
+        if r in self._recorded:
+            # Replay: the journaled batch IS the contract (a rewound
+            # retry or fresh-process resume re-injects it verbatim;
+            # the live ring — if any — is not consumed again).
+            take, deferred, replayed = self._recorded[r], [], True
+        else:
+            if self.ring is None:
+                return state, None
+            batch = self.ring.begin_drain()
+            if not batch:
+                return state, None
+            take, deferred = self._select(cfg, batch, r,
+                                          self._quotas(cfg, state))
+            self.ring.defer(deferred)
+            replayed = False
+            if take:
+                # Record BEFORE staging (memory always, disk when
+                # configured): if the chunk after this boundary
+                # crashes, the rewound retry replays this exact batch
+                # instead of finding the ring already consumed.
+                self._recorded[r] = list(take)
+                if self._journal is not None:
+                    self._journal.append(r, take)
+        if not take and not deferred:
+            return state, None
+        shed = invalid = 0
+        if take:
+            state, shed, invalid = stage(cfg, state, take, r)
+        # An all-deferred boundary still reports: the admission-control
+        # series must show the quota/window holding requests back, not
+        # go silent until something is finally admitted.
+        return state, {"round": int(r),
+                       "staged": len(take) - shed - invalid,
+                       "shed_buffer_full": shed,
+                       "shed_invalid": invalid,
+                       "deferred": len(deferred),
+                       "replayed": replayed}
+
+
+def stage(cfg: Config, state, reqs, r: int):
+    """Scatter ``reqs`` into the state's per-node inject buffer, FIFO
+    per row into free slots: one ``[n, S]`` occupancy transfer + four
+    device scatters per boundary.  Requests that find their row full
+    are shed DETERMINISTICALLY (later-offered first to go) and counted
+    into ``shed_pend`` — the next round folds them into the
+    emitted+dropped books under CAUSE_INGRESS; MALFORMED requests
+    (src/dst outside the program's id space) shed too but are counted
+    SEPARATELY, so a bad trace never masquerades as buffer pressure.
+    Release rounds already past clamp forward to ``r`` (a late request
+    fires in the chunk's first round).  Returns
+    ``(state', n_shed_buffer_full, n_shed_invalid)``."""
+    import jax
+    import numpy as np
+
+    gs = state.ingress
+    n, S = gs.release.shape
+    occ = np.asarray(jax.device_get(gs.release)) >= 0     # [n, S]
+    free: dict = {}
+    rows, slots, dsts, chs, pays, rels = [], [], [], [], [], []
+    shed = invalid = 0
+    shed_ch = np.zeros((cfg.n_channels,), np.int32)
+
+    def _shed(req):
+        shed_ch[min(max(int(req.channel), 0), cfg.n_channels - 1)] += 1
+
+    for req in reqs:
+        req = Request(*req)
+        src = int(req.src)
+        if not 0 <= src < n or not 0 <= int(req.dst) < n:
+            invalid += 1
+            _shed(req)
+            continue
+        if src not in free:
+            free[src] = [s for s in range(S) if not occ[src, s]]
+        if not free[src]:
+            shed += 1
+            _shed(req)
+            continue
+        s = free[src].pop(0)
+        rows.append(src)
+        slots.append(s)
+        dsts.append(int(req.dst))
+        chs.append(min(max(int(req.channel), 0), cfg.n_channels - 1))
+        pays.append(int(req.payload))
+        rels.append(max(int(req.rnd), int(r)))
+    if rows:
+        ri = jnp.asarray(rows, jnp.int32)
+        si = jnp.asarray(slots, jnp.int32)
+        gs = gs._replace(
+            dst=gs.dst.at[ri, si].set(jnp.asarray(dsts, jnp.int32)),
+            channel=gs.channel.at[ri, si].set(
+                jnp.asarray(chs, jnp.int32)),
+            payload=gs.payload.at[ri, si].set(
+                jnp.asarray(pays, jnp.int32)),
+            release=gs.release.at[ri, si].set(
+                jnp.asarray(rels, jnp.int32)))
+    if shed or invalid:
+        gs = gs._replace(
+            shed_pend=gs.shed_pend + jnp.asarray(shed_ch, jnp.int32))
+    return state._replace(ingress=gs), shed, invalid

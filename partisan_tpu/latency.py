@@ -36,11 +36,13 @@ Design constraints are the metrics plane's (ARCHITECTURE.md
   keeps the ClusterState leaf an empty ``()`` pytree and the wire
   record at ``msg_words`` — no extra words, no ops.
 
-Age attribution coverage: the ``CAUSE_INBOX`` and ``CAUSE_OTHER`` rows
-of the drop-age histogram stay zero — an inbox-overflow victim dies
-inside route()'s gather (never materialized per-message) and the
-residual cause is by definition what round_body cannot see; their
-*counts* remain exact in the metrics plane.
+Age attribution coverage: the ``CAUSE_INBOX``, ``CAUSE_INGRESS`` and
+``CAUSE_OTHER`` rows of the drop-age histogram stay zero — an
+inbox-overflow victim dies inside route()'s gather (never materialized
+per-message), an ingress-shed request never received a birth word (it
+died before emission), and the residual cause is by definition what
+round_body cannot see; their *counts* remain exact in the metrics
+plane.
 
 **Flight recorder** (``Config(flight_rounds=K)``).  A ring of the last
 K rounds' post-interposition wire tensors + fault-drop masks, kept in
@@ -78,7 +80,8 @@ class LatencyState(NamedTuple):
 
     deliver: Array   # int32[C, B] — event-lane delivery ages by channel
     drop_age: Array  # int32[N_CAUSES, B] — drop ages by cause (rows
-    #                  CAUSE_INBOX / CAUSE_OTHER structurally zero)
+    #                  CAUSE_INBOX / CAUSE_INGRESS / CAUSE_OTHER
+    #                  structurally zero)
     age_hwm: Array   # int32[C] — max delivery age observed per channel
 
 

@@ -58,10 +58,13 @@ BUDGETS: dict = {
     # slot-column symmetry loop, traded for never materializing the
     # [n, cap] gathered graph (the 1M enabler; still n-independent
     # counts, so the 32-node pin gates every scale).
+    # (re-pinned +2 eqns at ISSUE 15: the drop-cause taxonomy grew the
+    # ingress_shed row — a structurally-zero constant in this config's
+    # drops stack, priced at one broadcast + one add.)
     "round/all-planes+width": {
         "gather_scatter": 114,
         "interm_kib": 2322.0,
-        "eqns": 4293,
+        "eqns": 4295,
     },
     # The open-loop traffic generator over the plain round (PR 12):
     # +2 gather/scatter (the burst-slot arrival draw's emission build)
@@ -72,6 +75,29 @@ BUDGETS: dict = {
         "gather_scatter": 58,
         "interm_kib": 1945.0,
         "eqns": 3502,
+    },
+    # The elastic round (ISSUE 15): width operand + the in-scan drain
+    # gauge/resize ring + the traffic generator with drain
+    # redirection.  Over "round/traffic": +3 scatters (the resize
+    # ring's conditional rnd/width/from writes) and ~47 eqns (deadline
+    # compare, transition detect, the redirected source mask) — the
+    # whole price of runtime elasticity when ON; OFF is bit-identical
+    # to the planes-off round (zero-cost rule).
+    "round/elastic": {
+        "gather_scatter": 61,
+        "interm_kib": 1945.0,
+        "eqns": 3549,
+    },
+    # The ingress-armed round (ISSUE 15): staged-request release over
+    # the plain round — ZERO extra gathers/scatters (the inject buffer
+    # reads/writes are full-tensor wheres; the emission block joins
+    # the existing assembly concat) and ~67 eqns of due/stale masking
+    # + per-channel shed fold.  The scan entry ("scan/ingress")
+    # audits the chunked shape the soak engine dispatches.
+    "round/ingress": {
+        "gather_scatter": 56,
+        "interm_kib": 1915.0,
+        "eqns": 3422,
     },
     # The vmapped fleet round (ISSUE 14): W=4 members of the plain
     # hyparview+plumtree round batched by fleet.Fleet.  The

@@ -19,8 +19,8 @@ from __future__ import annotations
 
 import jax
 
-from partisan_tpu.config import (Config, ControlConfig, PlumtreeConfig,
-                                 TrafficConfig)
+from partisan_tpu.config import (Config, ControlConfig, IngressConfig,
+                                 PlumtreeConfig, TrafficConfig)
 from partisan_tpu.lint.core import Program, trace_program
 
 
@@ -44,12 +44,16 @@ def full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
 
 def control_full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
     """Every plane + every in-scan controller + the traffic generator
-    (the closed-loop round under load; also the sharding completeness
-    rule's reference state — controller, traffic and seed-salt leaves
-    need PartitionSpecs like any other carry)."""
+    + the elastic/ingress lanes (the closed-loop round under load;
+    also the sharding completeness rule's reference state —
+    controller, traffic, seed-salt, elastic and ingress leaves need
+    PartitionSpecs like any other carry)."""
     kw.setdefault("traffic", TrafficConfig(enabled=True, churn=True,
                                            ring=8))
     kw.setdefault("salt_operand", True)
+    kw.setdefault("elastic", True)
+    kw.setdefault("elastic_ring", 8)
+    kw.setdefault("ingress", IngressConfig(enabled=True, slots=4))
     return full_cfg(n, flight=flight, channel_capacity=True,
                     control=ControlConfig(fanout=True, backpressure=True,
                                           healing=True, ring=8), **kw)
@@ -232,6 +236,25 @@ def default_matrix() -> list[Program]:
                                 latency=True, channel_capacity=True,
                                 control=ControlConfig(backpressure=True,
                                                       ring=8)),
+                       scan=4),
+        # runtime elasticity + streaming ingress (ROADMAP item 5):
+        # the elastic round (width operand + the in-scan drain gauge +
+        # traffic redirection — the resize hot path, cost-pinned) and
+        # the ingress-armed SCAN (staged-request release riding the
+        # chunked-scan shape the soak engine dispatches).  Every entry
+        # above covers their off-state (no round.elastic /
+        # round.ingress scope may appear there — zero-cost rule).
+        _round_program("round/elastic",
+                       base_cfg(width_operand=True, elastic=True,
+                                elastic_ring=8,
+                                traffic=TrafficConfig(enabled=True,
+                                                      ring=8))),
+        _round_program("round/ingress",
+                       base_cfg(ingress=IngressConfig(enabled=True,
+                                                      slots=4))),
+        _round_program("scan/ingress",
+                       base_cfg(ingress=IngressConfig(enabled=True,
+                                                      slots=4)),
                        scan=4),
         # the sharded-by-default path (ROADMAP item 2): the plain
         # sharded round and the health-carrying one, traced through a

@@ -156,6 +156,8 @@ def _planes_of(cfg):
         ("control.backpressure", cfg.control.backpressure),
         ("control.healing", cfg.control.healing),
         ("traffic", cfg.traffic.enabled),
+        ("elastic", bool(cfg.elastic)),
+        ("ingress", cfg.ingress.enabled),
     )
 
 

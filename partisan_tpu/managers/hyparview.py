@@ -1124,3 +1124,11 @@ class HyParView:
 
     def leave(self, cfg: Config, state: HyParViewState, node: int) -> HyParViewState:
         return state._replace(leaving=state.leaving.at[node].set(True))
+
+    def leave_many(self, cfg: Config, state: HyParViewState,
+                   nodes) -> HyParViewState:
+        """Batched graceful leave (one scatter — the elastic scale-in
+        path marks thousands of departing rows at once; per-node
+        leave() dispatch would dominate the boundary)."""
+        idx = jnp.asarray(nodes, jnp.int32)
+        return state._replace(leaving=state.leaving.at[idx].set(True))

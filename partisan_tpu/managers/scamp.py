@@ -438,3 +438,10 @@ class Scamp:
 
     def leave(self, cfg: Config, state: ScampState, node: int) -> ScampState:
         return state._replace(leaving=state.leaving.at[node].set(True))
+
+    def leave_many(self, cfg: Config, state: ScampState,
+                   nodes) -> ScampState:
+        """Batched graceful leave (one scatter — the elastic scale-in
+        path's departure batch, mirroring join_many)."""
+        idx = jnp.asarray(nodes, jnp.int32)
+        return state._replace(leaving=state.leaving.at[idx].set(True))

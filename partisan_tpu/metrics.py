@@ -33,6 +33,12 @@ lane's per-round ``emitted - delivered`` delta — exactly what legacy
 - ``inbox_overflow`` — receiver inbox past ``inbox_cap`` (route drops),
 - ``dead_receiver``  — addressed to a crash-stopped node,
 - ``outbox_shed``    — channel-capacity outbox overflow (channels.py),
+- ``ingress_shed``   — streaming-ingress admission sheds (ingress.py):
+  externally-offered requests the device could not honor — source row
+  dead/deactivated at release, or the per-node inject buffer full at
+  the boundary drain.  By the open-loop stance these count as offered
+  load: the round adds them to BOTH the emitted count and this drops
+  row, so the conservation law holds exactly through admission control,
 - ``other``          — the residual: everything the direct counters
   cannot see from round_body (all_to_all quota sheds inside the sharded
   exchange, and the transient defer/release imbalance of channel-
@@ -66,10 +72,11 @@ CAUSE_FAULT = 1
 CAUSE_INBOX = 2
 CAUSE_DEAD = 3
 CAUSE_OUTBOX = 4
-CAUSE_OTHER = 5
-N_CAUSES = 6
+CAUSE_INGRESS = 5
+CAUSE_OTHER = 6
+N_CAUSES = 7
 CAUSE_NAMES = ("compact_shed", "fault_cut", "inbox_overflow",
-               "dead_receiver", "outbox_shed", "other")
+               "dead_receiver", "outbox_shed", "ingress_shed", "other")
 
 
 class MetricsState(NamedTuple):

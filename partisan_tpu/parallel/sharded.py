@@ -35,8 +35,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from partisan_tpu import channels as channels_mod
 from partisan_tpu import control as control_mod
 from partisan_tpu import delivery as delivery_mod
+from partisan_tpu import elastic as elastic_mod
 from partisan_tpu import faults as faults_mod
 from partisan_tpu import health as health_mod
+from partisan_tpu import ingress as ingress_mod
 from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
@@ -318,6 +320,19 @@ class ShardedCluster:
             # Seed salt: a scalar operand, replicated like n_active
             # (every shard derives the same effective seed from it).
             salt=(() if isinstance(state.salt, tuple) else repl),
+            # Elastic resize machinery: drain boundary/deadline and the
+            # resize ring are reduced scalars — replicated like the
+            # width operand they move.
+            elastic=spec_like(state.elastic, repl),
+            # Ingress inject buffer: per-node staged requests shard on
+            # the node axis like the inbox they feed; the shed/injected
+            # ledgers are replicated scalars (allsum-reduced before
+            # every write).
+            ingress=(() if state.ingress == ()
+                     else ingress_mod.IngressState(
+                         dst=shard, channel=shard, payload=shard,
+                         release=shard, shed_pend=repl,
+                         shed_total=repl, injected=repl)),
         )
 
     # ---- state construction ------------------------------------------
@@ -360,6 +375,10 @@ class ShardedCluster:
             traffic=(workload_mod.init(cfg)
                      if workload_mod.enabled(cfg) else ()),
             salt=(jnp.uint32(0) if cfg.salt_operand else ()),
+            elastic=(elastic_mod.init(cfg)
+                     if elastic_mod.enabled(cfg) else ()),
+            ingress=(ingress_mod.init(cfg, self.host_comm)
+                     if ingress_mod.enabled(cfg) else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

@@ -1055,6 +1055,132 @@ def config8_overload(n=96, waves=10, wave_len=12, adaptive=True,
     return out
 
 
+def config9_elastic(n=8192, seed=7, drain=3 * K_PROG, bound=8,
+                    ingress_trace=None, ckpt_dir=None):
+    """Runtime elasticity under live traffic (ROADMAP item 5): a
+    cluster booted at HALF its pre-allocated capacity scales OUT to
+    full width mid-flash-crowd (activated rows enroll through the join
+    path), survives a crash batch, then scales IN to a quarter through
+    the graceful leave path (drain window + in-scan deactivation) —
+    all as ONE storm timeline through the chunked soak engine, so the
+    whole elastic trajectory checkpoints and replays bit-for-bit.
+
+    Gates (the stdout object): conservation breaches == 0 across every
+    resize, overlay recovery (health digest one-component + healthy at
+    the end), per-channel delivered-age p99 <= ``bound``, and the
+    recorded elastic timeline hitting exactly [half, full, quarter].
+    ``ingress_trace`` optionally replays a recorded external-arrival
+    trace (ingress.Journal format) through the inject ring alongside
+    the in-scan traffic — the second arrival mode."""
+    from partisan_tpu import elastic as elastic_mod
+    from partisan_tpu import health as health_mod
+    from partisan_tpu import latency as latency_mod
+    from partisan_tpu import soak as soak_mod
+    from partisan_tpu import workload as workload_mod
+    from partisan_tpu.cluster import Cluster, activate
+    from partisan_tpu.config import Config, IngressConfig, TrafficConfig
+    from partisan_tpu.models.plumtree import Plumtree
+
+    n = max(n, 64)
+    w0, w_hi, w_lo = n // 2, n, n // 4
+    base_rate, crowd_rate = 300, 1500
+
+    def mk():
+        cfg = Config(
+            n_nodes=n, seed=seed, peer_service_manager="hyparview",
+            msg_words=16, partition_mode="groups",
+            width_operand=True, elastic=True,
+            latency=True, metrics=True, metrics_ring=512,
+            health=K_PROG, health_ring=512,
+            traffic=TrafficConfig(enabled=True, rate_x1000=base_rate,
+                                  burst_max=2, hot_skew=1),
+            ingress=IngressConfig(enabled=ingress_trace is not None,
+                                  slots=8),
+            emit_compact=32 if n > 4096 else 0)
+        return Cluster(cfg, model=Plumtree())
+
+    cl = mk()
+    st = activate(cl.init(), w0)
+    rng = np.random.default_rng(7)
+    base = 1
+    join = jax.jit(lambda m, nodes, tgts: cl.manager.join_many(
+        cl.cfg, m, nodes, tgts))
+    while base < w0:
+        hi = min(base * 8, w0)
+        nodes = np.arange(base, hi, dtype=np.int32)
+        tgts = rng.integers(0, base, size=nodes.shape[0]).astype(np.int32)
+        st = cl.steps(st._replace(manager=join(st.manager, nodes, tgts)),
+                      K_PROG)
+        base = hi
+    for _ in range(3):
+        st = cl.steps(st, K_PROG)
+    _sync(st)
+    start = int(jax.device_get(st.rnd))
+
+    # The elastic timeline: flash crowd -> scale OUT mid-crowd ->
+    # crash batch -> crowd ends -> scale IN (drain + in-scan
+    # deactivation) -> heal.  Offsets in K_PROG-sized phases.
+    P = K_PROG
+    events = (
+        workload_mod.flash_crowd(P, 6 * P, crowd_rate, base_rate)
+        + ((2 * P, soak_mod.ScaleOut(w_hi)),
+           (4 * P, soak_mod.CrashBatch(frac=0.02)),
+           (8 * P, soak_mod.ScaleIn(w_lo, drain=drain)),
+           (8 * P + drain + P, soak_mod.Heal(revive=True))))
+    storm = workload_mod.Traffic(events=()).storm(
+        start=start, extra=events)
+    feed = None
+    if ingress_trace is not None:
+        from partisan_tpu import ingress as ingress_mod
+
+        feed = ingress_mod.IngressFeed(journal_path=ingress_trace)
+    warm = [cl]
+    eng = soak_mod.Soak(
+        make_cluster=lambda: warm.pop() if warm else mk(), storm=storm,
+        invariants=[soak_mod.conservation()],
+        ingress=feed,
+        cfg=soak_mod.SoakConfig(poll_latency=True,
+                                checkpoint_dir=ckpt_dir,
+                                checkpoint_every=10 * K_PROG))
+    rounds = 8 * P + drain + 6 * P
+    t0 = time.perf_counter()
+    res = eng.run(st, rounds=rounds)
+    wall = time.perf_counter() - t0
+    import json as _json
+    import sys as _sys
+
+    for row in res.chunks:
+        print(_json.dumps({"kind": "soak_chunk", "config": 9, **row}),
+              file=_sys.stderr)
+    _emit_metrics(cl.cfg, res.state, 9)
+    digest = health_mod.digest(res.state)
+    timeline = elastic_mod.snapshot(res.state.elastic)
+    names = tuple(c.name for c in cl.cfg.channels)
+    pct = latency_mod.percentiles(res.state.latency, channels=names)
+    p99 = {ch: pct[ch]["p99"] for ch in names}
+    slo_ok, _rows = slo_gate(p99, bound)
+    widths = [int(w) for w in timeline["widths"]]
+    out = {"config": 9, "n": n, "rounds": res.rounds,
+           "chunks": len(res.chunks), "retries": res.retries,
+           "breaches": res.breaches,
+           "widths": widths, "resizes": timeline["resizes"],
+           "n_active": timeline["n_active"],
+           "traffic": workload_mod.poll(res.state.traffic),
+           "p99": p99, "slo_bound": bound,
+           "wall_s": round(wall, 1),
+           "components": health_mod.digest_components(digest),
+           "overlay_ok": health_mod.overlay_ok(digest),
+           "pass": (res.breaches == 0 and bool(slo_ok)
+                    and health_mod.overlay_ok(digest)
+                    and widths == [w0, w_hi, w_lo]
+                    and timeline["n_active"] == w_lo)}
+    if feed is not None:
+        from partisan_tpu import ingress as ingress_mod
+
+        out["ingress"] = ingress_mod.poll(res.state.ingress)
+    return out
+
+
 def slo_gate(p99: dict, bound: int) -> tuple[bool, list[dict]]:
     """Per-channel p99 pass/fail rows against ``bound`` rounds (the
     ``--slo`` gate over ``latency.percentiles`` output).  Channels
@@ -1945,16 +2071,19 @@ ALL = {
     6: config6_echo,
     7: config7_soak,
     8: config8_overload,
+    9: config9_elastic,
 }
 
 DEFAULT_SIZES = {1: 16, 2: 1000, 3: 10_000, 4: 10_000, 5: 100_000, 6: 2,
-                 7: 10_000, 8: 96}
+                 7: 10_000, 8: 96, 9: 8192}
 
 # Scenarios excluded from run_all's default sweep (run them with
-# --only/--soak/--slo): the soak is hours of simulated time by design;
-# the overload scenario is the backpressure controller's A/B harness
-# and SLO-gate input, driven by --slo / --control-ab.
-OPT_IN = frozenset({7, 8})
+# --only/--soak/--slo/--elastic): the soak is hours of simulated time
+# by design; the overload scenario is the backpressure controller's
+# A/B harness and SLO-gate input, driven by --slo / --control-ab; the
+# elastic scenario scales half->full->quarter mid-storm under a flash
+# crowd through the soak engine (config 9).
+OPT_IN = frozenset({7, 8, 9})
 
 
 def run_all(scale: float = 1.0, only=None) -> list[dict]:
@@ -2012,6 +2141,18 @@ if __name__ == "__main__":
                          "(equivalent to --only 7)")
     ap.add_argument("--soak-rounds", type=int, default=2000,
                     help="soak horizon in rounds (with --soak)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the runtime-elasticity scenario (config "
+                         "9) only: scale half->full->quarter mid-storm "
+                         "under flash-crowd traffic through the "
+                         "chunked soak engine — conservation + overlay "
+                         "recovery + per-channel p99 gates; exit "
+                         "non-zero if any gate breaches")
+    ap.add_argument("--ingress-trace", default=None, metavar="PATH",
+                    help="with --elastic: replay a recorded external-"
+                         "arrival trace (ingress.Journal JSON lines) "
+                         "through the host→device inject ring "
+                         "alongside the in-scan traffic")
     ap.add_argument("--ckpt-dir", default=None,
                     help="persist soak checkpoints here (atomic, "
                          "fingerprinted; with --soak)")
@@ -2078,6 +2219,13 @@ if __name__ == "__main__":
             with open(args.slo_out, "w") as f:
                 json.dump(suite, f, indent=1)
         raise SystemExit(0 if (ok and suite["pass"]) else 1)
+    if args.elastic:
+        out9 = config9_elastic(
+            n=max(64, int(DEFAULT_SIZES[9] * args.scale)),
+            ingress_trace=args.ingress_trace,
+            ckpt_dir=args.ckpt_dir)
+        print(json.dumps(out9), flush=True)
+        raise SystemExit(0 if out9["pass"] else 1)
     if args.soak:
         print(json.dumps(config7_soak(
             n=max(64, int(DEFAULT_SIZES[7] * args.scale)),

@@ -267,6 +267,14 @@ class Omission(Action):
         return state._replace(interpose=jnp.asarray(new))
 
 
+# Elastic resize actions (elastic.py — re-exported here so storm
+# timelines read naturally: scale-out activates + enrolls rows through
+# the manager's join machinery, scale-in drains through the leave path
+# and deactivates IN-SCAN at its drain deadline).  Duck-typed Actions
+# with the same purity obligation.
+from partisan_tpu.elastic import ScaleIn, ScaleOut  # noqa: E402,F401
+
+
 @dataclasses.dataclass(frozen=True)
 class Script(Action):
     """Escape hatch: ``fn(cluster, state, rnd) -> state``.  The caller
@@ -491,6 +499,14 @@ class Soak:
     invariants: Sequence[Invariant] = ()
     cfg: SoakConfig = dataclasses.field(default_factory=SoakConfig)
     bus: Any = None               # telemetry.Bus (optional, live events)
+    ingress: Any = None           # ingress.IngressFeed (optional): the
+    #                               streaming-ingress lane's boundary
+    #                               hook — externally-enqueued requests
+    #                               drain into the device inject buffer
+    #                               at every chunk boundary, journaled
+    #                               so a rewound retry or fresh-process
+    #                               resume re-injects the recorded
+    #                               batches (replay-exact, like storms)
     step_fn: Callable[[Any, Any, int], Any] | None = None
     sleep_fn: Callable[[float], None] = time.sleep
 
@@ -631,6 +647,15 @@ class Soak:
             nxt = self.storm.next_after(rnd)
             if nxt is not None:
                 limit = min(limit, nxt - rnd)
+        if self.ingress is not None \
+                and hasattr(self.ingress, "next_after"):
+            # Recorded ingress batches are boundary-keyed like storm
+            # events: the sizer clips at the next recorded round so a
+            # replayed trace's batches always land on a boundary, even
+            # under adaptive chunking.
+            nxt = self.ingress.next_after(rnd)
+            if nxt is not None:
+                limit = min(limit, nxt - rnd)
         if c.checkpoint_every > 0:
             limit = min(limit, last_ckpt + c.checkpoint_every - rnd)
         return max(1, min(k, limit))
@@ -705,10 +730,27 @@ class Soak:
                     or r - last_ckpt >= self.cfg.checkpoint_every:
                 self._checkpoint(state, r)
                 last_ckpt = r
+                if self.ingress is not None \
+                        and hasattr(self.ingress, "prune"):
+                    # a rewind never goes below this checkpoint, so
+                    # replay records before it are dead weight (the
+                    # journal FILE — the fresh-process contract — is
+                    # never pruned)
+                    self.ingress.prune(r)
             # 3. storm actions due at this round
             if self.storm is not None:
                 for action in self.storm.due(r):
                     state = action.apply(self._cluster(), state, r)
+            # 3b. ingress boundary drain (after actions, before the
+            #     chunk — the checkpoint at r precedes both, so a
+            #     resume re-applies actions AND re-injects the
+            #     journaled batch: one replay protocol for faults,
+            #     traffic, resizes and external arrivals)
+            if self.ingress is not None:
+                state, rep = self.ingress.drain(self._cluster(),
+                                                state, r)
+                if rep is not None:
+                    self._log_event(log, "ingress_drain", **rep)
             # 4. size and run the chunk, guarded
             k = self._chunk_size(r, until_round, per_round_s, last_ckpt)
             t0 = time.perf_counter()
@@ -828,6 +870,20 @@ class Soak:
                 from partisan_tpu import workload as workload_mod
 
                 row["traffic"] = workload_mod.poll(nxt_state.traffic)
+            if getattr(nxt_state, "elastic", ()) != ():
+                # elastic operands in force (active width, pending
+                # drain boundary/deadline, resize count) — the rows
+                # soak_report --elastic surfaces and
+                # replay_elastic_events complements
+                from partisan_tpu import elastic as elastic_mod
+
+                row["elastic"] = elastic_mod.poll(nxt_state.elastic)
+            if getattr(nxt_state, "ingress", ()) != ():
+                # inject-buffer occupancy + cumulative injected/shed
+                # ledgers (the admission-control series)
+                from partisan_tpu import ingress as ingress_mod
+
+                row["ingress"] = ingress_mod.poll(nxt_state.ingress)
             if self.cfg.poll_latency \
                     and getattr(nxt_state, "latency", ()) != ():
                 # WINDOWED per-channel p99 (this chunk's deliveries

@@ -69,6 +69,19 @@ SOAK_CHUNK_RETRY = ("partisan", "soak", "chunk_retry")
 SOAK_CHECKPOINT_RESTORED = ("partisan", "soak", "checkpoint_restored")
 SOAK_INVARIANT_BREACH = ("partisan", "soak", "invariant_breach")
 
+# Elastic-resize events (elastic.py resize ring -> discrete events):
+# every n_active transition the jitted round recorded — host
+# activations (scale-out) and in-scan drain deactivations (scale-in)
+# alike — direction-tagged.
+ELASTIC_SCALE_OUT = ("partisan", "elastic", "scale_out")
+ELASTIC_SCALE_IN = ("partisan", "elastic", "scale_in")
+
+# Streaming-ingress events (ingress.py feed reports in the soak log ->
+# discrete events): a boundary drain that staged external requests,
+# and one that shed (buffer-full) or deferred (quota) some.
+INGRESS_DRAIN = ("partisan", "ingress", "drain")
+INGRESS_SHED = ("partisan", "ingress", "shed")
+
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
 
@@ -511,6 +524,55 @@ def replay_soak_events(bus: Bus, log) -> int:
         meta["round"] = int(entry.get("round", -1))
         bus.execute(event, meas, meta)
         n_events += 1
+    return n_events
+
+
+def replay_elastic_events(bus: Bus, snap: Mapping[str, Any]) -> int:
+    """Replay an elastic-timeline snapshot (``elastic.snapshot`` — the
+    in-scan resize ring: round, n_active AFTER and BEFORE each
+    transition) as direction-tagged ``partisan.elastic.*`` events —
+    the stored from-width tags the direction, so the first entry of a
+    wrapped (or shrink-first) window cannot misreport.  Returns the
+    number of events emitted."""
+    rounds = list(snap.get("rounds", ()))
+    widths = list(snap.get("widths", ()))
+    froms = list(snap.get("from", ()))
+    n_events = 0
+    for r, w, f in zip(rounds, widths, froms):
+        if int(w) == int(f):
+            continue
+        bus.execute(ELASTIC_SCALE_OUT if int(w) > int(f)
+                    else ELASTIC_SCALE_IN,
+                    {"n_active": int(w)},
+                    {"round": int(r), "from": int(f)})
+        n_events += 1
+    return n_events
+
+
+def replay_ingress_events(bus: Bus, log) -> int:
+    """Replay a soak log's ``ingress_drain`` entries (the feed's
+    boundary reports) as ``partisan.ingress.*`` events: one ``drain``
+    per staging boundary, plus a ``shed`` when the boundary shed
+    (per-node buffer full) or deferred (quota) requests.  Returns the
+    number of events emitted."""
+    n_events = 0
+    for entry in log:
+        if entry.get("kind") != "ingress_drain":
+            continue
+        meta = {"round": int(entry.get("round", -1)),
+                "replayed": bool(entry.get("replayed", False))}
+        bus.execute(INGRESS_DRAIN,
+                    {"staged": int(entry.get("staged", 0))}, meta)
+        n_events += 1
+        shed = int(entry.get("shed_buffer_full", 0))
+        invalid = int(entry.get("shed_invalid", 0))
+        deferred = int(entry.get("deferred", 0))
+        if shed or invalid or deferred:
+            bus.execute(INGRESS_SHED,
+                        {"shed_buffer_full": shed,
+                         "shed_invalid": invalid,
+                         "deferred": deferred}, meta)
+            n_events += 1
     return n_events
 
 

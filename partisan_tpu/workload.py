@@ -153,45 +153,75 @@ def churn(cfg: Config, ts: TrafficState, faults: faults_mod.FaultState,
     return faults._replace(alive=alive)
 
 
-def generate(cfg: Config, comm, ts: TrafficState, ctx):
-    """One round of open-loop arrivals: returns ``(state', emitted)``
-    with ``emitted`` a fresh ``[n_local, burst_max]`` APP emission
-    block (plane-major under ``Config.plane_major``, like every model
-    emission) for ``round_body``'s single assembly concatenate.
-    Crashed/inactive rows (``ctx.alive`` False) emit nothing."""
+def arrival_law(cfg: Config, seed, rnd, gids, rate_x1000, width):
+    """The open-loop arrival LAW for one round, factored so the in-scan
+    generator and the host-side trace mirror (:func:`trace_arrivals` —
+    the ingress lane's recorded-trace arrival mode) can never drift:
+    returns ``(fire bool[rows, B], dst int32[rows, B])`` for the nodes
+    in ``gids`` at the given rate and active width.  ``fire`` is the
+    raw law — callers AND in liveness (``ctx.alive``) and any draining
+    mask themselves.  Pure in (seed, rnd, gids, rate, width)."""
     t = cfg.traffic
-    gids = comm.local_ids()
-    n = comm.n_local
     B = t.burst_max
-    ch = cfg.channel_id(t.channel)
-    rate = ts.rate_x1000.astype(jnp.float32) / jnp.float32(1000)
+    gids = jnp.asarray(gids, jnp.int32)
+    rate = jnp.asarray(rate_x1000, jnp.int32).astype(jnp.float32) \
+        / jnp.float32(1000)
     wvec = jnp.asarray(slot_weights(cfg), jnp.float32)       # [B]
     ks = jnp.arange(B, dtype=jnp.int32)
     sid = gids[:, None] * 64 + ks[None, :]    # distinct stream per slot
 
-    # ctx.seed, not cfg.seed: arrivals key off the salted per-run
-    # stream (fleet members draw independent workloads)
-    h_arr = faults_mod.edge_hash(ctx.seed, ctx.rnd, _ARRIVAL_SALT,
+    h_arr = faults_mod.edge_hash(seed, rnd, _ARRIVAL_SALT,
                                  sid, gids[:, None])
-    fire = faults_mod.hash_bernoulli(h_arr, rate * wvec[None, :]) \
-        & ctx.alive[:, None]
+    fire = faults_mod.hash_bernoulli(h_arr, rate * wvec[None, :])
 
-    # Destination: hot-spot law over the ACTIVE id space.  The width
-    # comes from the n_active operand (not cfg.n_nodes) so a
-    # width-operand run at n_active=w draws the same destinations as a
-    # native n_nodes=w run — the prefix-dynamics contract.
-    h_dst = faults_mod.edge_hash(ctx.seed, ctx.rnd, _DST_SALT,
+    # Destination: hot-spot law over the ACTIVE id space (width).
+    h_dst = faults_mod.edge_hash(seed, rnd, _DST_SALT,
                                  sid, gids[:, None])
     u = (h_dst >> 8).astype(jnp.float32) / jnp.float32(2 ** 24)
     for _ in range(t.hot_skew):
         u = u * u
-    wd = (jnp.int32(cfg.n_nodes) if isinstance(ctx.n_active, tuple)
-          else ctx.n_active)
+    wd = jnp.asarray(width, jnp.int32)
     d = jnp.minimum((u * wd.astype(jnp.float32)).astype(jnp.int32),
                     wd - 1)
     # no self-sends: bump onto the next active id (wrapping)
     bump = jnp.where(d + 1 >= wd, 0, d + 1)
     d = jnp.where(d == gids[:, None], bump, d)
+    return fire, d
+
+
+def generate(cfg: Config, comm, ts: TrafficState, ctx, width=None):
+    """One round of open-loop arrivals: returns ``(state', emitted)``
+    with ``emitted`` a fresh ``[n_local, burst_max]`` APP emission
+    block (plane-major under ``Config.plane_major``, like every model
+    emission) for ``round_body``'s single assembly concatenate.
+    Crashed/inactive rows (``ctx.alive`` False) emit nothing.
+    ``width`` optionally overrides the active id space (the elastic
+    drain redirection, cluster.round_body under ``Config.elastic``:
+    draining rows neither source nor attract NEW arrivals — the
+    graceful-leave half of a scale-in); default is the n_active
+    operand (or the full width)."""
+    t = cfg.traffic
+    gids = comm.local_ids()
+    n = comm.n_local
+    B = t.burst_max
+    ch = cfg.channel_id(t.channel)
+    redirected = width is not None
+    if width is None:
+        width = (jnp.int32(cfg.n_nodes)
+                 if isinstance(ctx.n_active, tuple) else ctx.n_active)
+    # ctx.seed, not cfg.seed: arrivals key off the salted per-run
+    # stream (fleet members draw independent workloads).  The width
+    # comes from the n_active operand (not cfg.n_nodes) so a
+    # width-operand run at n_active=w draws the same destinations as a
+    # native n_nodes=w run — the prefix-dynamics contract.
+    fire, d = arrival_law(cfg, ctx.seed, ctx.rnd, gids, ts.rate_x1000,
+                          width)
+    fire = fire & ctx.alive[:, None]
+    if redirected:
+        # Elastic drain: rows at/above the redirected width stop
+        # SOURCING new arrivals too (ctx.alive alone keeps them live —
+        # they must still flush in-flight protocol traffic).
+        fire = fire & (gids[:, None] < jnp.asarray(width, jnp.int32))
     dst = jnp.where(fire, d, -1)
 
     emitted = msg_ops.build(
@@ -236,6 +266,55 @@ def snapshot(ts: TrafficState) -> dict:
     idx = ring_order(rnd)
     return {"rounds": rnd[idx], "arrivals": np.asarray(host.arr_ring)[idx],
             "sent": int(host.sent), "rate_x1000": int(host.rate_x1000)}
+
+
+def trace_arrivals(cfg: Config, r0: int, r1: int, *, rate_x1000=None,
+                   alive=None, width=None, seed=None) -> list:
+    """Host-side mirror of the in-scan arrival law over rounds
+    ``[r0, r1)``: the recorded-trace producer for the ingress lane's
+    second arrival mode (ingress.py).  Returns ``ingress.Request``
+    tuples — each in-scan arrival becomes an external request released
+    at the SAME round, from the SAME source, to the SAME destination
+    and channel, carrying ``TRAFFIC_OP`` — so a ring-injected trace is
+    delivery-equivalent to the arrivals born in-scan
+    (tests/test_ingress.py gates this).
+
+    Exactness constraint: the mirror shares :func:`arrival_law` with
+    ``generate`` (they cannot drift), but the in-scan fire mask also
+    ANDs ``ctx.alive`` — so the mirror is exact only over a window
+    where the alive mask is KNOWN and constant (pass ``alive``; a calm
+    window, no churn/crash events inside [r0, r1)).  ``rate_x1000``/
+    ``width`` default to the config's base rate and full width;
+    ``seed`` to ``cfg.seed`` (pass the salted effective seed for
+    fleet members)."""
+    import numpy as np
+
+    from partisan_tpu import ingress as ingress_mod
+
+    t = cfg.traffic
+    n = cfg.n_nodes
+    ch = cfg.channel_id(t.channel)
+    if rate_x1000 is None:
+        rate_x1000 = t.rate_x1000
+    if width is None:
+        width = n
+    if seed is None:
+        seed = cfg.seed
+    gids = jnp.arange(n, dtype=jnp.int32)
+    alive_m = (np.ones((n,), bool) if alive is None
+               else np.asarray(alive, bool))
+    out = []
+    for r in range(int(r0), int(r1)):
+        fire, d = arrival_law(cfg, seed, jnp.int32(r), gids,
+                              rate_x1000, width)
+        fire = np.asarray(fire) & alive_m[:, None] \
+            & (np.arange(n)[:, None] < int(width))
+        d = np.asarray(d)
+        for src, k in zip(*np.nonzero(fire)):
+            out.append(ingress_mod.Request(
+                rnd=r, src=int(src), dst=int(d[src, k]), channel=ch,
+                payload=TRAFFIC_OP))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -32,9 +32,10 @@ WIDE_N = 4096 if FULL else 768
 SCAMP_BAND_N = 512 if FULL else 192
 # randomized-overlay trials per oracle gate (health BFS / provenance
 # trace-replay): the gates assert EXACT parity per overlay either way
-# (12 still sweeps faulted/partitioned/churned variants — ISSUE 14
-# runtime paydown offsetting the new fleet suite)
-ORACLE_TRIALS = 40 if FULL else 12
+# (10 still sweeps faulted/partitioned/churned variants — ISSUE 15
+# runtime paydown offsetting the new elastic/ingress suites, after
+# ISSUE 14's 16->12)
+ORACLE_TRIALS = 40 if FULL else 10
 # mixed-fault soak width (tests/test_soak.py 500-round storm): the
 # storm schedule and every invariant are width-independent (80 keeps
 # the crash batches > a quarter of the overlay — ISSUE 14 paydown)

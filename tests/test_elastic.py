@@ -1,0 +1,400 @@
+"""Runtime elasticity (ISSUE 15): scale-out through the join path,
+graceful scale-in through the leave path + in-scan drain deactivation,
+resize-safe checkpoints, and the elastic timeline's exact replay
+across mid-storm kill/restore.
+
+The load-bearing contracts, each pinned here:
+
+1. **Scale-out parity** — a scaled-out prefix run is bit-identical to
+   a native-width run applying the same activation + join batch: the
+   prefix-dynamics contract (tests/test_program_budget.py) extended
+   to RUNTIME growth.
+2. **Graceful scale-in** — the drain leaks zero messages: conservation
+   holds exactly through the drain window, the dead-receiver cause
+   stays at zero (nothing was still addressed to the departed when
+   they deactivated), and plane reductions reconcile across the
+   resize.
+3. **Replay** — a worker crash after a resize rewinds to a checkpoint
+   BEFORE it and replays the elastic timeline bit-for-bit.
+4. **Resize-safe checkpoints** — the width-free fingerprint accepts a
+   snapshot into the same program at any width and (``resize=True``)
+   into a WIDER program; every other config drift still fails loudly,
+   naming the drifted fields.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from partisan_tpu import checkpoint as ck
+from partisan_tpu import elastic, metrics, soak, workload
+from partisan_tpu.cluster import Cluster, activate
+from partisan_tpu.config import Config, PlumtreeConfig, TrafficConfig
+from partisan_tpu.models.plumtree import Plumtree
+from support import assert_states_bitidentical
+
+
+def _cfg(n, **kw):
+    kw.setdefault("msg_words", 16)
+    kw.setdefault("width_operand", True)
+    kw.setdefault("elastic", True)
+    return Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
+                  partition_mode="groups", max_broadcasts=8,
+                  inbox_cap=16, timer_stagger=False,
+                  plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4),
+                  **kw)
+
+
+def _boot_prefix(cl, w, k=20):
+    """Activate the w-prefix and wave-join it (the ladder's rng
+    discipline, shared with test_program_budget)."""
+    st = activate(cl.init(), w)
+    rng = np.random.default_rng(7)
+    base = 1
+    while base < w:
+        hi = min(base * 4, w)
+        nodes = np.arange(base, hi, dtype=np.int32)
+        tgts = rng.integers(0, base, size=nodes.shape[0]).astype(np.int32)
+        st = st._replace(manager=cl.manager.join_many(
+            cl.cfg, st.manager, nodes, tgts))
+        st = cl.steps(st, 10)
+        base = hi
+    return cl.steps(st, k)
+
+
+def _prefix_equal(small_tree, big_tree, w_small, w_big, label):
+    """Every leaf of ``big_tree`` restricted to the node-axis prefix
+    equals ``small_tree``'s bit-for-bit (the test_program_budget
+    helper, re-homed for runtime resizes)."""
+    import jax.tree_util as jtu
+
+    ls = jtu.tree_leaves_with_path(small_tree)
+    lb = jtu.tree_leaves_with_path(big_tree)
+    assert len(ls) == len(lb), (label, len(ls), len(lb))
+    for (pa, a), (_pb, b) in zip(ls, lb):
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        where = label + jtu.keystr(pa)
+        if a.shape != b.shape:
+            assert (a.ndim == b.ndim and a.ndim >= 1
+                    and a.shape[0] == w_small and b.shape[0] == w_big
+                    and a.shape[1:] == b.shape[1:]), \
+                f"{where}: unmappable shapes {a.shape} vs {b.shape}"
+            b = b[:w_small]
+        assert np.array_equal(a, b), \
+            f"{where}: {np.sum(a != b)} of {a.size} elements differ"
+
+
+# ---------------------------------------------------------------------------
+# 1. scale-out parity
+# ---------------------------------------------------------------------------
+
+def test_scale_out_prefix_bit_identical_to_native_width():
+    """ScaleOut on a 64-capacity cluster == the same activation + join
+    batch on a native 32-capacity cluster: every prefix leaf
+    bit-identical after the join settles."""
+    w0, w1, n_big = 16, 32, 64
+    big = Cluster(_cfg(n_big), model=Plumtree())
+    small = Cluster(_cfg(w1), model=Plumtree())
+
+    outs = {}
+    for name, cl in (("big", big), ("small", small)):
+        st = _boot_prefix(cl, w0)
+        st = elastic.scale_out(cl, st, w1)
+        st = cl.steps(st, 40)
+        # the boot activation's from-width IS the construction
+        # capacity (64 vs 32 — static, documented on ElasticState):
+        # neutralize that single entry; everything else must match
+        st = st._replace(elastic=st.elastic._replace(
+            from_ring=st.elastic.from_ring.at[0].set(0)))
+        outs[name] = st
+
+    _prefix_equal(outs["small"], outs["big"], w1, n_big,
+                  "scale_out_native")
+    # every activated row actually joined (no silent pre-wiring, no
+    # orphans after the retry loop settles)
+    act = np.asarray(jax.device_get(outs["big"].manager.active))
+    assert float((act[:w1].max(axis=1) >= 0).mean()) == 1.0
+    # rows above the scaled width stayed inert (bit-equal to init)
+    init_m = jax.device_get(big.init().manager)
+    got_m = jax.device_get(outs["big"].manager)
+    for f in type(got_m)._fields:
+        a, b = np.asarray(getattr(got_m, f)), \
+            np.asarray(getattr(init_m, f))
+        if a.ndim >= 1 and a.shape[0] == n_big:
+            assert np.array_equal(a[w1:], b[w1:]), f
+
+
+def test_scale_validation_raises_at_host_boundary():
+    cl = Cluster(_cfg(32), model=Plumtree())
+    st = activate(cl.init(), 16)
+    with pytest.raises(ValueError, match="out of range"):
+        activate(st, 33)
+    with pytest.raises(ValueError, match="out of range"):
+        activate(st, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        elastic.scale_out(cl, st, 100)
+    with pytest.raises(ValueError, match="must grow"):
+        elastic.scale_out(cl, st, 16)
+    with pytest.raises(ValueError, match="must shrink"):
+        elastic.ScaleIn(16).apply(cl, st, 0)
+    with pytest.raises(ValueError, match="drain window"):
+        elastic.ScaleIn(8, drain=0).apply(cl, st, 0)
+    # no width operand at all -> both paths refuse
+    cl2 = Cluster(_cfg(16, width_operand=False, elastic=False),
+                  model=Plumtree())
+    st2 = cl2.init()
+    with pytest.raises(ValueError, match="width_operand"):
+        elastic.ScaleOut(16).apply(cl2, st2, 0)
+    with pytest.raises(ValueError, match="elastic"):
+        elastic.ScaleIn(8).apply(cl2, st2, 0)
+
+
+# ---------------------------------------------------------------------------
+# 2. graceful scale-in: zero leak + exact plane reductions
+# ---------------------------------------------------------------------------
+
+def test_scale_in_drains_without_leaking_messages():
+    """Scale-in under live open-loop traffic: conservation holds
+    exactly through the drain window, NOTHING dies at a dead receiver
+    (the leave gossip + traffic redirection emptied the departing
+    rows' inboxes before deactivation), and the metrics plane's
+    cause-tagged drops reconcile with legacy Stats across the
+    resize."""
+    n = 48
+    cl = Cluster(_cfg(n, metrics=True, metrics_ring=256,
+                      traffic=TrafficConfig(enabled=True,
+                                            rate_x1000=400,
+                                            burst_max=2)),
+                 model=Plumtree())
+    st = _boot_prefix(cl, 32)
+    st = elastic.scale_in(cl, st, 16, drain=20, settle=20)
+    assert int(st.n_active) == 16
+
+    s = jax.device_get(st.stats)
+    assert int(s.emitted) == int(s.delivered) + int(s.dropped)
+    tot = metrics.totals(metrics.snapshot(st.metrics))
+    # cause-tagged drops reconcile with the cumulative counter (the
+    # run fits the ring), and the departure cost no dead-receiver
+    # drops: zero leak through the drain window
+    assert tot["dropped"] == int(s.dropped)
+    assert tot["drops_by_cause"]["dead_receiver"] == 0
+    # the elastic timeline recorded boot + the in-scan deactivation
+    snap = elastic.snapshot(st.elastic)
+    assert [int(w) for w in snap["widths"]] == [32, 16]
+    assert snap["drain_lo"] == -1
+    # departed rows are out of the overlay: no survivor still holds an
+    # active edge to a departed id
+    act = np.asarray(jax.device_get(st.manager.active))[:16]
+    assert not np.any(act >= 16)
+
+
+def test_traffic_redirects_away_from_draining_rows():
+    """During the drain window NEW open-loop arrivals neither source
+    at nor target draining rows (the round.elastic redirection)."""
+    n = 32
+    cl = Cluster(_cfg(n, metrics=True, metrics_ring=128,
+                      traffic=TrafficConfig(enabled=True,
+                                            rate_x1000=800,
+                                            burst_max=2)),
+                 model=Plumtree())
+    st = _boot_prefix(cl, n)
+    st = elastic.ScaleIn(8, drain=200).apply(
+        cl, st, int(jax.device_get(st.rnd)))
+    st2, tr = cl.record(st, 12)
+    sent = np.asarray(tr.sent)          # [T, n, E, W]
+    kind = sent[..., 0]
+    # traffic records are APP-kind with the TRAFFIC_OP payload word
+    from partisan_tpu import types as T
+
+    is_traffic = (kind == T.MsgKind.APP) \
+        & (sent[..., T.P0] == workload.TRAFFIC_OP)
+    srcs = np.broadcast_to(np.arange(n)[None, :, None],
+                           is_traffic.shape)
+    assert not np.any(is_traffic & (srcs >= 8)), \
+        "draining rows sourced new arrivals"
+    assert not np.any(is_traffic & (sent[..., 2] >= 8)), \
+        "new arrivals targeted draining rows"
+
+
+# ---------------------------------------------------------------------------
+# 3. mid-storm kill/restore replays the elastic timeline
+# ---------------------------------------------------------------------------
+
+def test_mid_storm_kill_restore_replays_elastic_timeline(tmp_path):
+    """A worker crash AFTER the scale-out rewinds to a checkpoint
+    before it; the retried run replays ScaleOut + flash crowd +
+    CrashBatch + ScaleIn bit-for-bit — final state identical to the
+    uncrashed reference."""
+    n = 48
+
+    def mk():
+        return Cluster(_cfg(n, metrics=True, metrics_ring=256,
+                            traffic=TrafficConfig(enabled=True,
+                                                  rate_x1000=300,
+                                                  burst_max=2)),
+                       model=Plumtree())
+
+    cl = mk()
+    st0 = _boot_prefix(cl, 24)
+    start = int(jax.device_get(st0.rnd))
+    events = (workload.flash_crowd(10, 30, 1200, 300)
+              + ((10, soak.ScaleOut(48)),
+                 (20, soak.CrashBatch(frac=0.05)),
+                 (40, soak.ScaleIn(12, drain=15))))
+    storm = soak.Storm(events=tuple(sorted(events, key=lambda e: e[0])),
+                       start=start)
+
+    def run(crash):
+        warm = [mk()]
+        fired = {"done": False}
+
+        def step_fn(c, s, k):
+            r = int(jax.device_get(s.rnd))
+            if crash and not fired["done"] and r >= start + 30:
+                fired["done"] = True
+                raise jax.errors.JaxRuntimeError("injected crash")
+            return c.steps(s, k)
+
+        eng = soak.Soak(
+            make_cluster=lambda: warm.pop() if warm else mk(),
+            storm=storm, step_fn=step_fn,
+            invariants=[soak.conservation()],
+            cfg=soak.SoakConfig(chunk_fixed=10, cooldown_s=0.0),
+            sleep_fn=lambda s: None)
+        return eng.run(jax.device_put(jax.device_get(st0)), rounds=70)
+
+    ref = run(crash=False)
+    got = run(crash=True)
+    assert ref.retries == 0 and got.retries == 1
+    assert got.breaches == 0
+    assert_states_bitidentical(ref.state, got.state, "kill_restore")
+    snap = elastic.snapshot(got.state.elastic)
+    assert [int(w) for w in snap["widths"]] == [24, 48, 12]
+
+
+# ---------------------------------------------------------------------------
+# 4. resize-safe checkpoints
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_restores_across_width_and_resumes_wider(tmp_path):
+    """A snapshot at n_active=16 restores into the SAME program (the
+    fingerprint no longer bakes the width in) and resumes at 32; the
+    same snapshot prefix-embeds into a WIDER program with
+    resize=True."""
+    cfg = _cfg(48)
+    cl = Cluster(cfg, model=Plumtree())
+    st = _boot_prefix(cl, 16)
+    p = str(tmp_path / "c.npz")
+    ck.save(st, p, cfg=cfg)
+
+    out = ck.restore(p, cl.init(), cfg=cfg)
+    assert_states_bitidentical(st, out, "same_program")
+    out = elastic.scale_out(cl, out, 32)
+    out = cl.steps(out, 10)
+    assert int(out.n_active) == 32
+
+    # wider program: prefix-embed, inert high rows = template init
+    cfg2 = _cfg(96)
+    cl2 = Cluster(cfg2, model=Plumtree())
+    with pytest.raises(ck.CheckpointError, match="resize=True"):
+        ck.restore(p, cl2.init(), cfg=cfg2)
+    out2 = ck.restore(p, cl2.init(), cfg=cfg2, resize=True)
+    assert int(out2.n_active) == 16
+    _prefix_equal(st, out2, 48, 96, "resized")
+    # the resumed wider run steps and scales to the NEW capacity
+    out2 = elastic.scale_out(cl2, cl2.steps(out2, 5), 96)
+    out2 = cl2.steps(out2, 5)
+    assert int(out2.n_active) == 96
+    # shrinking into a narrower program is refused even with resize
+    cfg3 = _cfg(24)
+    with pytest.raises(ck.CheckpointError, match="cannot shrink"):
+        ck.restore(p, Cluster(cfg3, model=Plumtree()).init(),
+                   cfg=cfg3, resize=True)
+
+
+def test_checkpoint_mismatch_names_drifted_fields(tmp_path):
+    cfg = _cfg(32)
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    p = str(tmp_path / "c.npz")
+    ck.save(st, p, cfg=cfg)
+    drifted = cfg.replace(seed=99, inbox_cap=24)
+    with pytest.raises(ck.CheckpointError) as ei:
+        ck.restore(p, Cluster(drifted, model=Plumtree()).init(),
+                   cfg=drifted)
+    msg = str(ei.value)
+    assert "drifted fields" in msg
+    assert "seed: checkpoint 5 != expected 99" in msg
+    assert "inbox_cap: checkpoint 16 != expected 24" in msg
+    # n_nodes drift alone does NOT trip the fingerprint (width-free)
+    assert "n_nodes" not in msg
+
+
+def test_checkpoint_v2_files_validate_against_legacy_fingerprint(
+        tmp_path):
+    """A hand-built version-2 file (width-inclusive fingerprint, no
+    field table) still restores, and still rejects drift — via the
+    legacy digest, computed over the v2-ERA repr (post-v2 fields
+    stripped at their defaults; a v2-era config had no elastic/ingress
+    lanes)."""
+    cfg = _cfg(16, width_operand=False, elastic=False)
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    leaves = jax.tree.leaves(st)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    p = str(tmp_path / "v2.npz")
+    np.savez_compressed(
+        p, version=2, n_leaves=len(leaves),
+        rnd=np.int64(0),
+        fingerprint=np.str_(ck.legacy_fingerprint(cfg)), **arrays)
+    out = ck.restore(p, cl.init(), cfg=cfg)
+    assert_states_bitidentical(st, out, "v2")
+    # the legacy digest must hash the v2-ERA repr: every post-v2 field
+    # stripped at its default, so an old file under an identical
+    # logical config never false-fails
+    blob = repr(cfg)
+    for group in ck._POST_V2_FIELD_SEGMENTS:
+        for seg in group:
+            blob = blob.replace(seg, "", 1)
+    for field in ("elastic=", "ingress=", "salt_operand=",
+                  "fleet_width=", "traffic="):
+        assert field not in blob, field
+    # a file saved in ANY v2 era validates: its digest is in the set
+    import hashlib
+    oldest = hashlib.sha256(
+        f"{blob}|wire={ck._wire_desc(cfg)}".encode()).hexdigest()
+    assert oldest in ck.legacy_fingerprints(cfg)
+    # resize without cfg is an explicit error, not a shape traceback
+    with pytest.raises(ValueError, match="needs cfg"):
+        ck.restore(p, cl.init(), resize=True)
+    with pytest.raises(ck.CheckpointError, match="different"):
+        drifted = cfg.replace(seed=99)
+        ck.restore(p, Cluster(drifted, model=Plumtree()).init(),
+                   cfg=drifted)
+
+
+def test_elastic_timeline_events_replay():
+    from partisan_tpu import telemetry
+
+    cl = Cluster(_cfg(32), model=Plumtree())
+    st = _boot_prefix(cl, 16, k=10)
+    st = elastic.scale_out(cl, st, 32)
+    st = cl.steps(st, 5)
+    st = elastic.scale_in(cl, st, 8, drain=5)
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "elastic"), rec)
+    n = telemetry.replay_elastic_events(bus, elastic.snapshot(st.elastic))
+    kinds = [e[0][2] for e in rec.events]
+    assert n == 3
+    # the BOOT activation (capacity 32 -> prefix 16) is itself a
+    # narrowing — the stored from-width tags it correctly
+    assert kinds == ["scale_in", "scale_out", "scale_in"]
+    assert [e[1]["n_active"] for e in rec.events] == [16, 32, 8]
+    assert [e[2]["from"] for e in rec.events] == [32, 16, 32]

@@ -1,0 +1,335 @@
+"""Streaming ingress (ISSUE 15): the double-buffered host→device
+inject ring, admission control, the journal replay contract, and the
+delivery-equivalence gate — a recorded external trace injected through
+the ring delivers exactly what the same arrivals born in-scan deliver.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from partisan_tpu import ingress, metrics, soak, workload
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import (Config, IngressConfig, PlumtreeConfig,
+                                 TrafficConfig)
+from partisan_tpu.ingress import IngressFeed, IngressRing, Request
+from partisan_tpu.models.plumtree import Plumtree
+from support import (assert_scan_lint_clean, assert_states_bitidentical,
+                     boot_hyparview)
+
+
+def _cfg(n=24, **kw):
+    kw.setdefault("msg_words", 16)
+    kw.setdefault("ingress", IngressConfig(enabled=True, slots=8))
+    return Config(n_nodes=n, seed=5, peer_service_manager="hyparview",
+                  partition_mode="groups", max_broadcasts=8,
+                  inbox_cap=24, timer_stagger=False,
+                  plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4),
+                  **kw)
+
+
+# ---------------------------------------------------------------------------
+# the host ring
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_offer_sheds_deterministically():
+    ring = IngressRing(cap=4)
+    reqs = [Request(0, i, i + 1) for i in range(6)]
+    assert ring.offer(reqs) == 4
+    assert ring.offered == 6 and ring.shed_full == 2
+    assert len(ring) == 4
+    # tail-drop: the FIRST four survived
+    batch = ring.begin_drain()
+    assert [r.src for r in batch] == [0, 1, 2, 3]
+
+
+def test_ring_double_buffer_overlaps_offer_with_drain():
+    ring = IngressRing(cap=16)
+    ring.offer([Request(0, 1, 2), Request(0, 2, 3)])
+    batch = ring.begin_drain()
+    assert len(batch) == 2 and len(ring) == 0
+    # offers during the drain land in the fresh front buffer
+    ring.offer([Request(1, 3, 4)])
+    # quota-rejected requests go back to the head of the line
+    ring.defer(batch[1:])
+    nxt = ring.begin_drain()
+    assert [r.src for r in nxt] == [2, 3], "deferred drains FIRST"
+
+
+# ---------------------------------------------------------------------------
+# the in-scan release + admission accounting
+# ---------------------------------------------------------------------------
+
+def test_release_emits_at_release_round_and_conserves():
+    cfg = _cfg(metrics=True, metrics_ring=64)
+    cl = Cluster(cfg, model=Plumtree())
+    st = boot_hyparview(cl, settle=20)
+    r = int(jax.device_get(st.rnd))
+    reqs = [Request(r + 2, 1, 5, 0, 91), Request(r + 2, 2, 6, 0, 91),
+            Request(r + 4, 3, 7, 3, 91)]
+    st, shed, invalid = ingress.stage(cfg, st, reqs, r)
+    assert shed == 0 and invalid == 0
+    st2, tr = cl.record(st, 6)
+    from partisan_tpu import types as T
+
+    sent = np.asarray(tr.sent)
+    is_ing = (sent[..., T.W_KIND] == T.MsgKind.APP) \
+        & (sent[..., T.P0] == 91)
+    # each request emitted exactly once, in its release round
+    rounds = np.asarray(tr.rnd)
+    by_round = {int(rounds[t]): int(is_ing[t].sum())
+                for t in range(sent.shape[0])}
+    assert by_round[r + 2] == 2 and by_round[r + 4] == 1
+    assert sum(by_round.values()) == 3
+    assert ingress.poll(st2.ingress) == {"staged": 0, "injected": 3,
+                                         "shed": 0}
+    s = jax.device_get(st2.stats)
+    assert int(s.emitted) == int(s.delivered) + int(s.dropped)
+
+
+def test_admission_sheds_count_emitted_and_dropped_under_cause():
+    """Buffer-full staging sheds and dead-source releases both land
+    under CAUSE_INGRESS — and count as OFFERED load, so conservation
+    and the metrics reconciliation hold exactly."""
+    cfg = _cfg(metrics=True, metrics_ring=64,
+               ingress=IngressConfig(enabled=True, slots=2))
+    cl = Cluster(cfg, model=Plumtree())
+    st = boot_hyparview(cl, settle=20)
+    r = int(jax.device_get(st.rnd))
+    # 3 requests on one row with 2 slots -> 1 buffer-full shed
+    reqs = [Request(r + 1, 4, 5), Request(r + 1, 4, 6),
+            Request(r + 1, 4, 7)]
+    st, shed, invalid = ingress.stage(cfg, st, reqs, r)
+    assert shed == 1 and invalid == 0
+    # a MALFORMED request (src beyond the id space) sheds under its
+    # own counter — a bad trace never masquerades as buffer pressure
+    st, shed_m, invalid_m = ingress.stage(
+        cfg, st, [Request(r + 1, 999, 3)], r)
+    assert shed_m == 0 and invalid_m == 1
+    # a request on a row crashed before release -> dead-source shed
+    st, shed2, inv2 = ingress.stage(cfg, st, [Request(r + 1, 9, 3)], r)
+    assert shed2 == 0 and inv2 == 0
+    st = st._replace(faults=st.faults._replace(
+        alive=st.faults.alive.at[9].set(False)))
+    st = cl.steps(st, 4)
+    s = jax.device_get(st.stats)
+    assert int(s.emitted) == int(s.delivered) + int(s.dropped)
+    tot = metrics.totals(metrics.snapshot(st.metrics))
+    assert tot["drops_by_cause"]["ingress_shed"] == 3
+    assert tot["dropped"] == int(s.dropped)
+    assert ingress.poll(st.ingress)["shed"] == 3
+
+
+def test_ingress_scan_lint_clean():
+    cl = Cluster(_cfg(), model=Plumtree())
+    assert_scan_lint_clean(cl, cl.init(), k=4, name="ingress-scan")
+
+
+def test_feed_quota_defers_and_rides_backpressure():
+    cfg = _cfg(ingress=IngressConfig(enabled=True, slots=8, quota=2))
+    cl = Cluster(cfg, model=Plumtree())
+    st = cl.init()
+    ring = IngressRing(cap=64)
+    ring.offer([Request(0, i, i + 1, 0) for i in range(5)])
+    feed = IngressFeed(ring=ring)
+    st, rep = feed.drain(cl, st, 0)
+    assert rep["staged"] == 2 and rep["deferred"] == 3
+    st, rep = feed.drain(cl, st, 1)
+    assert rep["staged"] == 2 and rep["deferred"] == 1
+    # release-round window: far-future requests stay in the ring
+    ring2 = IngressRing(cap=64)
+    ring2.offer([Request(100, 1, 2), Request(3, 2, 3)])
+    feed2 = IngressFeed(ring=ring2, window=10)
+    st2, rep2 = feed2.drain(cl, cl.init(), 0)
+    assert rep2["staged"] == 1 and rep2["deferred"] == 1
+
+
+# ---------------------------------------------------------------------------
+# delivery equivalence: recorded trace through the ring == in-scan
+# ---------------------------------------------------------------------------
+
+def test_recorded_trace_delivery_equivalent_to_in_scan():
+    """The same arrival stream, two ways: (A) born in-scan by the
+    open-loop generator; (B) recorded by the host mirror
+    (workload.trace_arrivals), written as a replay trace, and injected
+    through the inject ring at soak chunk boundaries.  Every record
+    carries the same (round, src, dst, channel, payload), so stats and
+    the per-channel delivered series are identical."""
+    n, r_run = 24, 24
+    base = dict(metrics=True, metrics_ring=128)
+    rate = 400
+
+    # A: in-scan traffic.  The generator boots at rate 0 (a quiet boot
+    # both arrival modes share record-for-record) and the storm steps
+    # the rate up exactly at the comparison window's start.
+    cfg_a = _cfg(n, traffic=TrafficConfig(enabled=True, rate_x1000=0,
+                                          burst_max=2),
+                 ingress=IngressConfig(enabled=False), **base)
+    cl_a = Cluster(cfg_a, model=Plumtree())
+    st_a = boot_hyparview(cl_a, settle=20)
+    r0 = int(jax.device_get(st_a.rnd))
+    eng_a = soak.Soak(
+        make_cluster=lambda: cl_a,
+        storm=soak.Storm(events=((0, workload.SetRate(rate)),),
+                         start=r0),
+        cfg=soak.SoakConfig(chunk_fixed=6))
+    st_a = eng_a.run(st_a, rounds=r_run).state
+
+    # B: the same arrivals, recorded host-side and ring-injected.
+    # Config identical except the arrival LANE (traffic off, ingress
+    # on) — the calm window keeps the mirror exact (alive constant).
+    cfg_b = _cfg(n, traffic=TrafficConfig(enabled=False, rate_x1000=0,
+                                          burst_max=2),
+                 ingress=IngressConfig(enabled=True, slots=16), **base)
+    cl_b = Cluster(cfg_b, model=Plumtree())
+    st_b = boot_hyparview(cl_b, settle=20)
+    assert int(jax.device_get(st_b.rnd)) == r0
+    alive = np.asarray(jax.device_get(st_b.faults.alive))
+    reqs = workload.trace_arrivals(cfg_a, r0, r0 + r_run,
+                                   rate_x1000=rate, alive=alive)
+    assert reqs, "the window generated no arrivals — raise the rate"
+    ring = IngressRing(cap=len(reqs) + 1)
+    ring.offer(reqs)
+    feed = IngressFeed(ring=ring, window=6)
+    eng = soak.Soak(make_cluster=lambda: cl_b, ingress=feed,
+                    cfg=soak.SoakConfig(chunk_fixed=6))
+    res = eng.run(st_b, rounds=r_run)
+    st_b = res.state
+
+    sa, sb = jax.device_get(st_a.stats), jax.device_get(st_b.stats)
+    assert int(sa.emitted) == int(sb.emitted)
+    assert int(sa.delivered) == int(sb.delivered)
+    assert int(sa.dropped) == int(sb.dropped)
+    ta = metrics.snapshot(st_a.metrics)
+    tb = metrics.snapshot(st_b.metrics)
+    assert np.array_equal(ta["delivered"], tb["delivered"]), \
+        "per-channel delivered series diverge between arrival modes"
+    assert np.array_equal(ta["emitted"], tb["emitted"])
+    # nothing shed on the way in: the buffer was sized for the window
+    assert ingress.poll(st_b.ingress)["shed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# journal replay: kill/restore re-injects the recorded batches
+# ---------------------------------------------------------------------------
+
+def test_journal_replay_after_kill_restores_bit_identical(tmp_path):
+    n = 24
+
+    def mk():
+        return Cluster(_cfg(n, metrics=True, metrics_ring=128),
+                       model=Plumtree())
+
+    cl0 = mk()
+    st0 = boot_hyparview(cl0, settle=20)
+    start = int(jax.device_get(st0.rnd))
+    # release rounds span [start+3, start+17]: boundary start+12
+    # drains a batch, so the crash injected there rewinds PAST a
+    # journaled drain and must replay it
+    reqs = [Request(start + 3 + (i % 15), i % n, (i * 5 + 1) % n, 0, 91)
+            for i in range(40)]
+
+    def run(tag, crash):
+        ring = IngressRing(cap=64)
+        ring.offer(reqs)
+        feed = IngressFeed(ring=ring,
+                           journal_path=str(tmp_path / f"{tag}.jsonl"),
+                           window=6)
+        warm = [mk()]
+        fired = {"done": False}
+
+        def step_fn(c, s, k):
+            r = int(jax.device_get(s.rnd))
+            if crash and not fired["done"] and r >= start + 12:
+                fired["done"] = True
+                raise jax.errors.JaxRuntimeError("injected crash")
+            return c.steps(s, k)
+
+        eng = soak.Soak(
+            make_cluster=lambda: warm.pop() if warm else mk(),
+            ingress=feed, step_fn=step_fn,
+            invariants=[soak.conservation()],
+            cfg=soak.SoakConfig(chunk_fixed=6, cooldown_s=0.0),
+            sleep_fn=lambda s: None)
+        return eng.run(jax.device_put(jax.device_get(st0)), rounds=24)
+
+    ref = run("ref", crash=False)
+    got = run("crash", crash=True)
+    assert got.retries == 1 and ref.retries == 0
+    assert ref.breaches == 0 and got.breaches == 0
+    assert_states_bitidentical(ref.state, got.state, "journal_replay")
+    # the rewound boundary re-injected from the journal, not the ring
+    replays = [e for e in got.log if e.get("kind") == "ingress_drain"
+               and e.get("replayed")]
+    assert replays, "no boundary was replayed from the journal"
+    # and a journal alone (no ring) is a complete arrival mode
+    feed3 = IngressFeed(journal_path=str(tmp_path / "ref.jsonl"))
+    eng3 = soak.Soak(make_cluster=mk, ingress=feed3,
+                     cfg=soak.SoakConfig(chunk_fixed=6))
+    res3 = eng3.run(jax.device_put(jax.device_get(st0)), rounds=24)
+    assert_states_bitidentical(ref.state, res3.state, "trace_mode")
+
+
+def test_write_trace_and_ingress_events(tmp_path):
+    from partisan_tpu import telemetry
+
+    p = str(tmp_path / "trace.jsonl")
+    reqs = [Request(3, 1, 2), Request(4, 2, 3), Request(9, 3, 4)]
+    assert ingress.write_trace(p, reqs, every=4) == 3
+    loaded = ingress.Journal.load(p)
+    assert sorted(loaded) == [0, 4, 8]
+    assert loaded[0] == [Request(3, 1, 2, 0, 0)]
+
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "ingress"), rec)
+    log = [{"kind": "ingress_drain", "round": 7, "staged": 4,
+            "shed_buffer_full": 1, "shed_invalid": 1, "deferred": 2,
+            "replayed": False}]
+    assert telemetry.replay_ingress_events(bus, log) == 2
+    kinds = [e[0][2] for e in rec.events]
+    assert kinds == ["drain", "shed"]
+
+
+def test_adaptive_chunking_lands_boundaries_on_recorded_rounds(
+        tmp_path):
+    """With ADAPTIVE chunk sizing (chunk_fixed=0 — the default) the
+    soak's sizer must clip at the feed's recorded rounds, exactly like
+    storm events, so a replayed trace's batches are never skipped."""
+    cl = Cluster(_cfg(16, metrics=True, metrics_ring=64),
+                 model=Plumtree())
+    st0 = boot_hyparview(cl, settle=20)
+    start = int(jax.device_get(st0.rnd))
+    # batches at off-ladder boundary rounds the adaptive sizer would
+    # otherwise stride straight past
+    reqs = [Request(start + r, (r + i) % 16, (r + i + 1) % 16, 0, 91)
+            for r in (3, 7, 13, 19) for i in range(3)]
+    p = str(tmp_path / "trace.jsonl")
+    j = ingress.Journal(p)
+    for r in (3, 7, 13, 19):
+        j.append(start + r, [q for q in reqs if q.rnd == start + r])
+    feed = IngressFeed(journal_path=p)
+    eng = soak.Soak(make_cluster=lambda: cl, ingress=feed,
+                    invariants=[soak.conservation()],
+                    cfg=soak.SoakConfig(chunk_init=100))
+    res = eng.run(st0, rounds=30)
+    assert res.breaches == 0
+    assert ingress.poll(res.state.ingress)["injected"] == len(reqs)
+    drains = [e["round"] for e in res.log
+              if e.get("kind") == "ingress_drain"]
+    assert drains == [start + r for r in (3, 7, 13, 19)], \
+        "boundaries did not land on the recorded rounds"
+
+
+def test_feed_requires_armed_lane():
+    cl = Cluster(_cfg(ingress=IngressConfig(enabled=False)),
+                 model=Plumtree())
+    feed = IngressFeed(ring=IngressRing(cap=4))
+    feed.ring.offer([Request(0, 1, 2)])
+    with pytest.raises(ValueError, match="enabled=True"):
+        feed.drain(cl, cl.init(), 0)

@@ -292,6 +292,29 @@ def test_fleet_report_cli_smoke():
         1 for r in members if r["rounds_to_converge"] >= 0)
 
 
+def test_soak_report_elastic_smoke():
+    """--elastic: the soak boots at half capacity, scales out to full
+    and gracefully back in through the storm — chunk rows carry the
+    elastic operands, the width trajectory lands back at the boot
+    width via the in-scan drain deactivation, and the resize events
+    replay as partisan.elastic.* alongside the soak events."""
+    out = _run("soak_report.py", "32", "40", "--chunk", "10",
+               "--elastic")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    chunks = [r for r in rows if r["kind"] == "chunk"]
+    assert chunks and all("elastic" in c for c in chunks)
+    widths = [c["elastic"]["n_active"] for c in chunks]
+    assert max(widths) == 32, widths          # the scale-out fired
+    assert chunks[-1]["elastic"]["n_active"] == 16   # ...and the drain
+    assert chunks[-1]["elastic"]["resizes"] == 3
+    events = [tuple(r["event"]) for r in rows if r["kind"] == "event"]
+    assert ("partisan", "elastic", "scale_out") in events
+    assert ("partisan", "elastic", "scale_in") in events
+    assert rows[-1]["kind"] == "summary"
+    assert rows[-1]["breaches"] == 0
+
+
 def test_soak_report_traffic_smoke():
     """--traffic: the open-loop generator rides the soak — chunk rows
     carry the generator operands and a windowed per-channel p99, and

@@ -11,7 +11,7 @@ trailing summary::
 
     python tools/soak_report.py [n] [rounds] [--chunk K] [--crash-at R]
                                 [--breach] [--control] [--traffic]
-                                [--ckpt-dir DIR]
+                                [--elastic] [--ckpt-dir DIR]
 
 ``--crash-at R`` injects a ``JaxRuntimeError`` into the first chunk
 dispatch that would cross R rounds into the soak — off-TPU proof of
@@ -31,8 +31,13 @@ rate / churn / cumulative arrivals) plus a WINDOWED per-channel p99
 (``p99``, the latency plane's cumulative histograms diffed at chunk
 boundaries), and the replayed ``partisan.traffic.*`` events
 (``flash_crowd``, ``slo_breach_window``) print alongside the soak
-events.  Importable: ``report(result)`` renders any
-``soak.SoakResult``.
+events.  ``--elastic`` boots at HALF the capacity and scripts a
+scale-out to full width plus a graceful scale-in (leave-path drain +
+in-scan deactivation) through the same storm: every chunk row carries
+the elastic operands in force (``elastic``: active width / pending
+drain / resize count), and the replayed ``partisan.elastic.*`` resize
+events print alongside the soak events.  Importable:
+``report(result)`` renders any ``soak.SoakResult``.
 """
 
 from __future__ import annotations
@@ -61,6 +66,17 @@ def report(res, out=sys.stdout, channels=None, slo_rounds=None) -> dict:
     bus = telemetry.Bus()
     bus.attach("report", ("partisan", "soak"), rec)
     telemetry.replay_soak_events(bus, res.log)
+    if getattr(res.state, "elastic", ()) != ():
+        # resize events (scale_out / scale_in), replayed from the
+        # in-scan elastic timeline ring
+        from partisan_tpu import elastic as elastic_mod
+
+        bus.attach("elastic", ("partisan", "elastic"), rec)
+        telemetry.replay_elastic_events(
+            bus, elastic_mod.snapshot(res.state.elastic))
+    if any(e.get("kind") == "ingress_drain" for e in res.log):
+        bus.attach("ingress", ("partisan", "ingress"), rec)
+        telemetry.replay_ingress_events(bus, res.log)
     if any("traffic" in row for row in res.chunks):
         # traffic-plane events (flash_crowd / slo_breach_window),
         # replayed from the chunk rows' operand + windowed-p99 series
@@ -89,7 +105,8 @@ def report(res, out=sys.stdout, channels=None, slo_rounds=None) -> dict:
 
 
 USAGE = ("usage: soak_report.py [n] [rounds] [--chunk K] [--crash-at R] "
-         "[--breach] [--control] [--traffic] [--ckpt-dir DIR]")
+         "[--breach] [--control] [--traffic] [--elastic] "
+         "[--ckpt-dir DIR]")
 
 
 def main() -> None:
@@ -115,6 +132,7 @@ def main() -> None:
     VALUE_FLAGS = ("--chunk", "--crash-at", "--ckpt-dir")
     argv = sys.argv[1:]
     args, opts, breach, control, traffic = [], {}, False, False, False
+    elastic = False
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -131,6 +149,9 @@ def main() -> None:
             i += 1
         elif a == "--traffic":
             traffic = True
+            i += 1
+        elif a == "--elastic":
+            elastic = True
             i += 1
         elif a.startswith("--"):
             raise SystemExit(f"unknown flag {a}\n{USAGE}")
@@ -164,6 +185,11 @@ def main() -> None:
                                        rate_x1000=TRAFFIC_BASE,
                                        hot_skew=1,
                                        ring=max(64, rounds))
+    if elastic:
+        # the runtime-resize machinery: boot at half capacity below,
+        # then scale out to full + gracefully back in via the storm
+        ctl["width_operand"] = True
+        ctl["elastic"] = True
 
     def mk():
         return Cluster(Config(
@@ -192,13 +218,31 @@ def main() -> None:
     # settle), not a re-implementation that would drift from it.
     from partisan_tpu.scenarios import _boot_overlay
 
-    st = _boot_overlay(cl, n, settle_execs=2)
+    boot_w = n
+    if elastic:
+        from partisan_tpu.cluster import activate
+
+        if n < 4:
+            raise SystemExit(
+                f"--elastic needs n >= 4 (got {n}): the demo boots at "
+                "half capacity and scales out to full")
+        boot_w = n // 2
+        st0 = activate(cl.init(), boot_w)
+        st = _boot_overlay(cl, boot_w, settle_execs=2, state=st0)
+    else:
+        st = _boot_overlay(cl, n, settle_execs=2)
     start = int(jax.device_get(st.rnd))
 
     q = max(10, rounds // 4)
     events = [(0, soak.LinkDrop(0.15)), (q, soak.Heal()),
               (2 * q, soak.CrashBatch(frac=0.05)),
               (2 * q + q // 2, soak.Heal(revive=True))]
+    if elastic:
+        # scale out to full capacity early, scale gracefully back to
+        # the boot width across a bounded drain in the final quarter
+        events.append((q // 2, soak.ScaleOut(n)))
+        events.append((3 * q, soak.ScaleIn(boot_w,
+                                           drain=max(2, q // 4))))
     if breach:
         # Hold a split across the tail so the armed one-component
         # invariant breaches at the following chunk boundaries.
