@@ -483,6 +483,31 @@ def _pallas_verdict(budget_s: float) -> dict:
         return {"verdict": "SKIP", "reason": repr(exc)[:200]}
 
 
+def _history_card(doc: dict) -> dict:
+    """Fold this run into the bench-history ledger
+    (partisan_tpu/perfwatch.py via tools/bench_history.py): append one
+    row per measured size keyed by (n, config, host fingerprint) and
+    delta against the best prior comparable entry.  The card reports
+    regressions; it never fails the bench (the hard gate is
+    ``bench_history.py --check``)."""
+    try:
+        from partisan_tpu import perfwatch
+
+        ledger = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              perfwatch.LEDGER_DEFAULT)
+        source = time.strftime("bench_%Y%m%d_%H%M%S")
+        rows = perfwatch.doc_rows(doc, source)
+        prior = perfwatch.read_ledger(ledger)
+        fresh = perfwatch.append_rows(ledger, rows)
+        deltas = perfwatch.ledger_deltas(fresh, prior)
+        return {"ledger": os.path.basename(ledger),
+                "rows": len(fresh), "deltas": deltas,
+                "regressions": sum(1 for d in deltas
+                                   if d.get("regression"))}
+    except Exception as exc:  # bookkeeping must never sink the bench
+        return {"verdict": "SKIP", "reason": repr(exc)[:200]}
+
+
 def _lint_verdict(budget_s: float) -> dict:
     """Fold a quick jaxlint run (tools/jaxlint.py --quick: plain round,
     everything-on scan, capture round + package rules) into the
@@ -690,7 +715,7 @@ def main() -> None:
         raise SystemExit("bench failed at every size")
     top = results[max(results)]
     warm = top["warm"]
-    print(json.dumps({
+    doc = {
         "pallas_probe": _pallas_verdict(remaining()),
         "jaxlint": _lint_verdict(remaining()),
         "cost": _cost_card(remaining()),
@@ -715,7 +740,9 @@ def main() -> None:
         "validation": ("bridge-path 16-node trace "
                        "(tools/traces/trace16.json); no live BEAM in "
                        "image"),
-    }))
+    }
+    doc["bench_history"] = _history_card(doc)
+    print(json.dumps(doc))
 
 
 def fleet(argv) -> None:

@@ -372,12 +372,30 @@ def dry_1m_report(n: int = 1_000_000, n_devices: int = 8) -> dict:
     return card
 
 
+def bench_cfg(n: int = 32_768, *, width_operand: bool = False):
+    """The PLAIN bench config (hyparview+plumtree, planes off —
+    bench.py's make_cfg capacity knobs).  Single source for everything
+    that must price/measure the SAME round program: the cost census
+    (`bench_round_program`) and the measured phase attribution in
+    tools/perf_report.py (perfwatch reconciliation only joins cleanly
+    when predicted and measured runs share one config)."""
+    from partisan_tpu.config import Config, HyParViewConfig, \
+        PlumtreeConfig
+
+    return Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
+                  msg_words=16, partition_mode="groups",
+                  max_broadcasts=8, inbox_cap=16, emit_compact=32,
+                  timer_stagger=False, width_operand=width_operand,
+                  hyparview=HyParViewConfig(isolation_window_ms=25_000),
+                  plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+
+
 def bench_round_program(n: int = 32_768, *,
                         width_operand: bool = False) -> Program:
-    """Trace the PLAIN bench-config round (hyparview+plumtree, planes
-    off — bench.py's make_cfg capacity knobs) at ``n`` nodes,
-    abstractly: this is the program BENCH_NOTES' cost model prices and
-    the round-11 before/after numbers quote.  No device, no compile.
+    """Trace the PLAIN bench-config round (`bench_cfg`) at ``n``
+    nodes, abstractly: this is the program BENCH_NOTES' cost model
+    prices and the round-11 before/after numbers quote.  No device, no
+    compile.
 
     ``width_operand=True`` adds the bootstrap ladder's active-prefix
     masking that bench.py actually runs with (``--cost --width-op``;
@@ -386,17 +404,10 @@ def bench_round_program(n: int = 32_768, *,
     import jax
 
     from partisan_tpu.cluster import Cluster
-    from partisan_tpu.config import Config, HyParViewConfig, \
-        PlumtreeConfig
     from partisan_tpu.lint.core import trace_program
     from partisan_tpu.models.plumtree import Plumtree
 
-    cfg = Config(n_nodes=n, seed=1, peer_service_manager="hyparview",
-                 msg_words=16, partition_mode="groups",
-                 max_broadcasts=8, inbox_cap=16, emit_compact=32,
-                 timer_stagger=False, width_operand=width_operand,
-                 hyparview=HyParViewConfig(isolation_window_ms=25_000),
-                 plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
+    cfg = bench_cfg(n, width_operand=width_operand)
     cl = Cluster(cfg, model=Plumtree())
     state = jax.eval_shape(cl._build_init)
     name = f"round/bench-{n}" + ("+width" if width_operand else "")

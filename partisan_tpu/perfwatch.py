@@ -1,0 +1,695 @@
+"""Runtime performance observatory — measured phase attribution,
+dispatch-wall decomposition, measured-vs-predicted reconciliation and
+the bench-history ledger (ISSUE 16).
+
+Everything in here is HOST-SIDE ONLY.  The module never adds a traced
+eqn to any round program: phase attribution parses ``jax.profiler``
+trace captures *after* the fact, the dispatch meter brackets existing
+``block_until_ready``-style syncs with ``time.perf_counter``, and the
+ledger is pure JSON bookkeeping.  tests/test_perfwatch.py asserts the
+zero-traced-eqns guarantee through the existing lint matrix.
+
+Four pieces:
+
+* **Phase attribution** (`capture`, `attribute`) — a minimal protobuf
+  wire-format reader (no TF dependency) joins the op-level events in
+  ``<host>.trace.json.gz`` against the HloProto op metadata embedded in
+  ``<host>.xplane.pb`` to recover the ``round.*`` named_scope each HLO
+  op came from — the SAME phase keys `lint/cost.py` predicts with and
+  the zero-cost lint rule gates on.  Works on CPU with the exact code
+  path an on-chip session will use.
+* **Dispatch-wall meter** (`dispatch_timeline`, `decompose`,
+  `decompose_chunks`, `pipeline_probe`) — submit→ready bracketing that
+  splits a chunked run into in-execution time vs dispatch gap, plus a
+  double-buffered-dispatch probe quantifying ROADMAP item 1(b)
+  headroom.
+* **Reconciliation** (`reconcile`) — joins measured phase ms against
+  the cost-meter census to compute effective bytes/s per phase and
+  flag outliers: the machine-generated VMEM-fusion target list for
+  ROADMAP item 1(a).
+* **Bench-history ledger** (`artifact_rows`, `append_rows`,
+  `ledger_deltas`) — append-only JSON-lines keyed by
+  (kind, n, config, host fingerprint); deltas vs the best prior
+  comparable entry; regression beyond a band is a hard failure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import time
+from typing import Any, Callable, Iterator
+
+# --------------------------------------------------------------------
+# protobuf wire format (reader + just-enough writer)
+#
+# The profiler artifacts are protobufs but the container has no
+# tensorflow/protobuf-compiled schema for them; the wire format itself
+# is trivial.  Field numbers below were verified against jax 0.4.37
+# CPU captures (tests round-trip them through `_encode_field`).
+# --------------------------------------------------------------------
+
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    r = s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        s += 7
+        if not b & 0x80:
+            return r, i
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, value) over one message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:  # pragma: no cover - groups don't appear in profiler pbs
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def _encode_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _encode_field(fn: int, value) -> bytes:
+    """Encode one field: int -> varint, bytes/str -> length-delimited."""
+    if isinstance(value, int):
+        return _encode_varint(fn << 3 | 0) + _encode_varint(value)
+    if isinstance(value, str):
+        value = value.encode()
+    return _encode_varint(fn << 3 | 2) + _encode_varint(len(value)) + value
+
+
+# --------------------------------------------------------------------
+# HloProto scope map: (module, op) -> named_scope op_name
+# --------------------------------------------------------------------
+
+# XSpace.planes=1; XPlane{name=2, event_metadata map=4,
+# stat_metadata map=5, stats=6}; map entry{key=1, value=2};
+# XEventMetadata{id=1, name=2, stats=5};
+# XStat{metadata_id=1, bytes_value=6}; XStatMetadata{id=1, name=2};
+# HloProto{hlo_module=1}; HloModuleProto{name=1, computations=3};
+# HloComputationProto{instructions=2};
+# HloInstructionProto{name=1, metadata=7}; OpMetadata{op_name=2}.
+# On jax 0.4.x CPU the HloProto rides the "/host:metadata" plane as an
+# XStat (metadata name "Hlo Proto", bytes_value) attached to each
+# module's XEventMetadata entry.
+
+
+def _norm_module(name: str) -> str:
+    """``jit_steps(3)`` and ``jit_steps`` are the same module — the
+    ``(id)`` suffix differs between the xplane metadata plane and the
+    trace.json ``hlo_module`` arg."""
+    return name.split("(")[0]
+
+
+def hlo_scope_map(xplane: bytes) -> dict[tuple[str, str], str]:
+    """Parse an ``.xplane.pb`` into ``{(module, op_name): scope_path}``.
+
+    The scope path is the full ``jit(f)/.../round.phase/op`` metadata
+    op_name XLA records per instruction; `phase_of_op_name` extracts
+    the ``round.*`` segment from it.
+    """
+    out: dict[tuple[str, str], str] = {}
+    for fn, _wt, plane in _fields(xplane):
+        if fn != 1:
+            continue
+        name = b""
+        stat_names: dict[int, bytes] = {}
+        stats: list[bytes] = []
+        for pfn, _pwt, pv in _fields(plane):
+            if pfn == 2:
+                name = pv
+            elif pfn == 4:  # event_metadata map entry -> XEventMetadata
+                for efn, _ewt, ev in _fields(pv):
+                    if efn != 2:
+                        continue
+                    for mfn, _mwt, mv in _fields(ev):
+                        if mfn == 5:  # XEventMetadata.stats
+                            stats.append(mv)
+            elif pfn == 5:  # stat_metadata map entry
+                k = v = None
+                for efn, _ewt, ev in _fields(pv):
+                    if efn == 1:
+                        k = ev
+                    elif efn == 2:
+                        v = ev
+                if k is not None and v is not None:
+                    for mfn, _mwt, mv in _fields(v):
+                        if mfn == 2:
+                            stat_names[k] = mv
+            elif pfn == 6:
+                stats.append(pv)
+        if b"metadata" not in name:
+            continue
+        hlo_ids = {k for k, v in stat_names.items() if v == b"Hlo Proto"}
+        for st in stats:
+            mid, blob = None, None
+            for sfn, _swt, sv in _fields(st):
+                if sfn == 1:
+                    mid = sv
+                elif sfn == 6:
+                    blob = sv
+            if mid not in hlo_ids or blob is None:
+                continue
+            for hfn, _hwt, hv in _fields(blob):
+                if hfn != 1:  # HloProto.hlo_module
+                    continue
+                mod_name = ""
+                for m_fn, _m_wt, m_v in _fields(hv):
+                    if m_fn == 1:
+                        mod_name = _norm_module(m_v.decode())
+                    elif m_fn == 3:  # computations
+                        for c_fn, _c_wt, c_v in _fields(m_v):
+                            if c_fn != 2:  # instructions
+                                continue
+                            op = scope = ""
+                            for ifn, _iwt, iv in _fields(c_v):
+                                if ifn == 1:
+                                    op = iv.decode()
+                                elif ifn == 7:  # OpMetadata
+                                    for ofn, _owt, ov in _fields(iv):
+                                        if ofn == 2:
+                                            scope = ov.decode()
+                            if op and scope:
+                                out[(mod_name, op)] = scope
+    return out
+
+
+def phase_of_op_name(op_name: str) -> str:
+    """Extract the ``round.*`` named_scope segment from an XLA metadata
+    op_name — the same rule `lint/cost.py` applies to jaxpr eqn
+    source_info, so measured and predicted tables share keys.  Ops with
+    no round scope land in the ``"-"`` bucket, matching the census's
+    unphased bucket."""
+    for seg in op_name.split("/"):
+        if seg.startswith("round."):
+            return seg
+    return "-"
+
+
+# --------------------------------------------------------------------
+# trace.json op events + capture discovery
+# --------------------------------------------------------------------
+
+
+def trace_events(path: str) -> list[dict]:
+    """Load device op events (have hlo_op/hlo_module args and a µs
+    duration) from a ``.trace.json.gz``."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        doc = json.load(f)
+    out = []
+    for ev in doc.get("traceEvents", []):
+        args = ev.get("args") or {}
+        if ev.get("ph") == "X" and "hlo_op" in args and "hlo_module" in args:
+            out.append({"module": _norm_module(args["hlo_module"]),
+                        "op": args["hlo_op"],
+                        "dur_us": float(ev.get("dur", 0))})
+    return out
+
+
+def find_capture(trace_dir: str) -> tuple[str, str] | None:
+    """Newest (xplane.pb, trace.json.gz) pair under a profiler dir."""
+    runs = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*")))
+    for run in reversed(runs):
+        xs = sorted(glob.glob(os.path.join(run, "*.xplane.pb")))
+        ts = sorted(glob.glob(os.path.join(run, "*.trace.json.gz")))
+        if xs and ts:
+            return xs[0], ts[0]
+    return None
+
+
+def attribute(trace_dir: str) -> dict[str, dict]:
+    """Collapse the newest capture under ``trace_dir`` into
+    ``{phase: {"ms": float, "events": int}}`` over ``round.*`` phases
+    (plus ``"-"`` for unattributed device time)."""
+    pair = find_capture(trace_dir)
+    if pair is None:
+        return {}
+    xplane_path, trace_path = pair
+    with open(xplane_path, "rb") as f:
+        scopes = hlo_scope_map(f.read())
+    phases: dict[str, dict] = {}
+    for ev in trace_events(trace_path):
+        scope = scopes.get((ev["module"], ev["op"]), "")
+        ph = phase_of_op_name(scope)
+        slot = phases.setdefault(ph, {"ms": 0.0, "events": 0})
+        slot["ms"] += ev["dur_us"] / 1000.0
+        slot["events"] += 1
+    for slot in phases.values():
+        slot["ms"] = round(slot["ms"], 4)
+    return phases
+
+
+@contextlib.contextmanager
+def capture(trace_dir: str | None = None):
+    """Profiler capture scoped to a ``with`` block.
+
+    ``trace_dir`` falls back to the ``PROFILE_TRACE_DIR`` env var (the
+    tools/profile_round.py convention); with neither set this is a
+    no-op yielding None, so call sites stay unconditional.  Yields the
+    directory to attribute() afterwards.
+
+    Uses a raw ProfilerSession with the PYTHON TRACER OFF instead of
+    ``jax.profiler.trace``: jax's default (python_tracer_level=1)
+    floods long captures with per-call host events, and the
+    trace.json export caps at ~1M events — the device op events
+    attribution needs were the ones truncated away.  Device + runtime
+    tracing (host_tracer_level=2, hlo_proto on) is unchanged; falls
+    back to ``jax.profiler.trace`` if the raw API moves.
+    """
+    trace_dir = trace_dir or os.environ.get("PROFILE_TRACE_DIR")
+    if not trace_dir:
+        yield None
+        return
+    import jax
+
+    try:
+        from jax._src.lib import xla_client
+
+        opts = xla_client.profiler.ProfileOptions()
+        opts.python_tracer_level = 0
+        opts.enable_hlo_proto = True
+        jax.devices()  # init the backend before the tracer attaches
+        sess = xla_client.profiler.ProfilerSession(opts)
+    except Exception:
+        with jax.profiler.trace(trace_dir):
+            yield trace_dir
+        return
+    try:
+        yield trace_dir
+    finally:
+        sess.export(sess.stop(), str(trace_dir))
+
+
+# --------------------------------------------------------------------
+# dispatch-wall meter
+# --------------------------------------------------------------------
+
+
+def dispatch_timeline(step: Callable, sync: Callable, state,
+                      *, chunks: int, k: int) -> tuple[list[dict], Any]:
+    """Run ``chunks`` × ``step(state, k)`` with submit→ready bracketing.
+
+    Returns (records, final_state); each record has ``submit_t``,
+    ``ready_t`` and ``gap_s`` (host time between the previous chunk's
+    ready and this chunk's submit — pure dispatch overhead, no device
+    work in flight)."""
+    records = []
+    prev_ready = None
+    for _ in range(chunks):
+        submit = time.perf_counter()
+        state = step(state, k)
+        sync(state)
+        ready = time.perf_counter()
+        records.append({
+            "submit_t": submit, "ready_t": ready, "k": k,
+            "wall_s": ready - submit,
+            "gap_s": None if prev_ready is None else submit - prev_ready,
+        })
+        prev_ready = ready
+    return records, state
+
+
+def decompose(records: list[dict]) -> dict:
+    """Split a timeline into in-execution vs dispatch-gap time."""
+    rows = [r for r in records if r.get("wall_s") is not None]
+    if not rows:
+        return {}
+    exec_s = sum(r["wall_s"] for r in rows)
+    gaps = [r["gap_s"] for r in rows if r.get("gap_s") is not None]
+    gap_s = sum(gaps)
+    total = exec_s + gap_s
+    return {
+        "chunks": len(rows),
+        "in_execution_s": round(exec_s, 4),
+        "gap_s": round(gap_s, 4),
+        "gap_share": round(gap_s / total, 4) if total > 0 else 0.0,
+        "per_chunk_gap_ms": (round(1000.0 * gap_s / len(gaps), 3)
+                             if gaps else None),
+    }
+
+
+def decompose_chunks(chunks: list[dict]) -> dict:
+    """`decompose` over soak.run_chunked chunk rows (their ``wall_s`` /
+    ``gap_s`` fields are already submit→ready brackets)."""
+    return decompose([
+        {"wall_s": c.get("wall_s"), "gap_s": c.get("gap_s")}
+        for c in chunks if isinstance(c, dict) and "wall_s" in c])
+
+
+def pipeline_probe(step: Callable, sync: Callable, state,
+                   *, reps: int = 6, k: int = 10) -> tuple[dict, Any]:
+    """Measure double-buffered dispatch headroom (ROADMAP item 1(b)).
+
+    Serial: submit+sync each chunk (today's soak loop).  Pipelined:
+    chain ``reps`` dispatches and sync once — JAX's async dispatch
+    overlaps submit with execution.  ``overlap`` is the measured share
+    of serial wall the chaining recovers."""
+    # warm both paths so neither pays compile
+    state = step(state, k)
+    sync(state)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state = step(state, k)
+        sync(state)
+    serial_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state = step(state, k)
+    sync(state)
+    pipelined_s = time.perf_counter() - t0
+
+    return {
+        "reps": reps, "k": k,
+        "serial_s": round(serial_s, 4),
+        "pipelined_s": round(pipelined_s, 4),
+        "overlap": (round(max(0.0, 1.0 - pipelined_s / serial_s), 4)
+                    if serial_s > 0 else 0.0),
+        "saved_ms_per_chunk": round(
+            1000.0 * max(0.0, serial_s - pipelined_s) / reps, 3),
+    }, state
+
+
+# --------------------------------------------------------------------
+# measured-vs-predicted reconciliation
+# --------------------------------------------------------------------
+
+
+def reconcile(measured: dict[str, dict], census, *, rounds: int = 1,
+              outlier_x: float = 3.0) -> list[dict]:
+    """Join a measured phase table against a `lint.cost.Census`.
+
+    One row per census phase (so the key sets match by construction —
+    the acceptance gate), carrying measured ms, predicted footprint
+    bytes (interm + 4·fetched words, per round × ``rounds`` executed
+    under capture), effective bytes/s, and an ``outlier`` flag: a
+    phase whose share of measured time exceeds ``outlier_x`` × its
+    share of predicted bytes (with a small absolute-time floor so µs
+    phases don't flag).  Outliers are the VMEM-fusion target list for
+    ROADMAP item 1(a)."""
+    phases = dict(census.phases)
+    meas = dict(measured)
+    total_ms = sum(m.get("ms", 0.0) for m in meas.values()) or 0.0
+
+    def footprint(pc) -> int:
+        return int(pc.interm_bytes + 4 * pc.fetched)
+
+    total_bytes = sum(footprint(pc) for pc in phases.values()) or 0
+    rows = []
+    for name in sorted(phases):
+        pc = phases[name]
+        m = meas.get(name, {})
+        ms = float(m.get("ms", 0.0))
+        fp = footprint(pc) * max(1, rounds)
+        row = {
+            "phase": name,
+            "measured_ms": round(ms, 4),
+            "events": int(m.get("events", 0)),
+            "predicted_bytes": fp,
+            "gathers": int(pc.gathers),
+            "scatters": int(pc.scatters),
+            "eqns": int(pc.eqns),
+            "eff_bytes_per_s": (round(fp / (ms / 1000.0))
+                                if ms > 0 else None),
+        }
+        time_share = ms / total_ms if total_ms > 0 else 0.0
+        byte_share = fp / (total_bytes * max(1, rounds)) \
+            if total_bytes > 0 else 0.0
+        row["time_share"] = round(time_share, 4)
+        row["outlier"] = bool(
+            ms >= 0.05 * total_ms and total_ms > 0
+            and time_share > outlier_x * max(byte_share, 1e-12))
+        rows.append(row)
+    # device time attributed to ops outside every census phase (e.g.
+    # capture-scope injections) — keep it visible without inventing a
+    # key the census lacks, unless the census itself has "-".
+    extra = {k: v for k, v in meas.items() if k not in phases}
+    if extra:
+        ms = sum(v.get("ms", 0.0) for v in extra.values())
+        rows.append({"phase": "(unattributed)",
+                     "measured_ms": round(ms, 4),
+                     "events": sum(int(v.get("events", 0))
+                                   for v in extra.values()),
+                     "predicted_bytes": 0, "gathers": 0, "scatters": 0,
+                     "eqns": 0, "eff_bytes_per_s": None,
+                     "time_share": round(ms / total_ms, 4)
+                     if total_ms > 0 else 0.0,
+                     "outlier": False})
+    return rows
+
+
+# --------------------------------------------------------------------
+# bench-history ledger
+# --------------------------------------------------------------------
+
+LEDGER_DEFAULT = "BENCH_LEDGER.jsonl"
+# Standing states documented in BENCH_NOTES.md: the relay still blocks
+# Pallas lowering, and the ~60 s fault-repro wall still stands.  Rows
+# record them per run so the prose stops being the source of truth;
+# override per-ingest once either falls.
+PALLAS_DEFAULT = "BLOCKED"
+MINUTE_WALL_DEFAULT = "STANDING"
+
+
+def host_fingerprint() -> str:
+    """Fingerprint live runs by backend platform — ledger deltas only
+    compare within one fingerprint (a CPU run regressing vs a TPU run
+    is noise, not signal)."""
+    import jax
+
+    return jax.default_backend()
+
+
+def _tail_host(tail: str) -> str:
+    t = tail or ""
+    for plat in ("axon", "tpu", "gpu", "cpu"):
+        if f"Platform '{plat}'" in t or f"platform: {plat}" in t:
+            return plat
+    return "unknown"
+
+
+def doc_rows(doc: dict, source: str, *, pallas: str | None = None,
+             minute_wall: str | None = None) -> list[dict]:
+    """Flatten one bench artifact (BENCH_r*.json / MULTICHIP_r*.json /
+    a live bench.py result doc) into ledger rows."""
+    pallas = pallas or PALLAS_DEFAULT
+    minute_wall = minute_wall or MINUTE_WALL_DEFAULT
+    rows: list[dict] = []
+
+    if "n_devices" in doc:  # MULTICHIP probe artifact
+        rows.append({"kind": "multichip", "source": source,
+                     "n_devices": int(doc["n_devices"]),
+                     "ok": bool(doc.get("ok")),
+                     "skipped": bool(doc.get("skipped")),
+                     "host": _tail_host(doc.get("tail", ""))})
+        return rows
+
+    parsed = doc.get("parsed") or doc
+    host = _tail_host(doc.get("tail", "")) \
+        if "tail" in doc else host_fingerprint()
+    probe = doc.get("pallas_probe") or {}
+    if isinstance(probe, dict) and probe.get("verdict"):
+        pallas = probe["verdict"]
+
+    def bench_row(n: int, rps, conv=None, conv_wall=None) -> dict:
+        return {"kind": "bench", "source": source, "n": int(n),
+                "config": "bench", "host": host,
+                "rounds_per_sec": (round(float(rps), 4)
+                                   if rps is not None else None),
+                "convergence_rounds": (int(conv)
+                                       if conv is not None else None),
+                "convergence_wall_s": (round(float(conv_wall), 4)
+                                       if conv_wall is not None else None),
+                "pallas": pallas, "minute_wall": minute_wall}
+
+    sizes = parsed.get("all_sizes") or {}
+    for n_str, rec in sizes.items():
+        if not isinstance(rec, dict):
+            continue
+        rps = rec.get("rounds_per_sec")
+        if isinstance(rps, dict):  # live bench.py: {"warm": {...}}
+            rps = ((rec.get("warm") or {}).get("rounds_per_sec")
+                   or {}).get("median")
+            conv = (rec.get("convergence") or {}).get("rounds")
+            wall = (rec.get("convergence") or {}).get("wall_s")
+        else:
+            conv = rec.get("convergence_rounds")
+            wall = rec.get("convergence_wall_s")
+        if rps is None and isinstance(rec.get("warm"), dict):
+            w = rec["warm"].get("rounds_per_sec")
+            rps = w.get("median") if isinstance(w, dict) else w
+            conv = conv or (rec.get("convergence") or {}).get("rounds")
+            wall = wall or (rec.get("convergence") or {}).get("wall_s")
+        if rps is not None:
+            rows.append(bench_row(int(n_str), rps, conv, wall))
+
+    if not rows and parsed.get("value") is not None:
+        # r01/r02 shape: one headline metric, n embedded in the name
+        import re
+
+        m = re.search(r"(\d[\d_,]*)-node", str(parsed.get("metric", "")))
+        n = int(re.sub(r"[_,]", "", m.group(1))) if m else 0
+        unit = str(parsed.get("unit", ""))
+        rps = parsed["value"] if "round" in unit else None
+        rows.append(bench_row(n, rps))
+        if rps is None:
+            rows[-1]["metric"] = parsed.get("metric")
+            rows[-1]["value"] = parsed.get("value")
+            rows[-1]["unit"] = unit
+    return rows
+
+
+def artifact_rows(path: str, **kw) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    return doc_rows(doc, os.path.basename(path), **kw)
+
+
+def _row_key(row: dict) -> tuple:
+    if row.get("kind") == "multichip":
+        return ("multichip", row.get("source"), row.get("n_devices"))
+    return ("bench", row.get("source"), row.get("n"))
+
+
+def read_ledger(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def append_rows(path: str, rows: list[dict]) -> list[dict]:
+    """Append rows not already present (dedup on kind/source/n) —
+    append-only: re-ingesting the same artifacts is idempotent.
+    Returns the rows actually written."""
+    seen = {_row_key(r) for r in read_ledger(path)}
+    fresh = [r for r in rows if _row_key(r) not in seen]
+    if fresh:
+        with open(path, "a") as f:
+            for r in fresh:
+                f.write(json.dumps(r, sort_keys=True) + "\n")
+    return fresh
+
+
+def ledger_deltas(new_rows: list[dict], prior_rows: list[dict],
+                  *, band: float = 0.10) -> list[dict]:
+    """Delta each new bench row against the best prior COMPARABLE row:
+    same kind/config/n AND same host fingerprint (cross-host
+    comparison is refused — reported as no-comparable, never a
+    regression), from a different source artifact."""
+    out = []
+    for row in new_rows:
+        if row.get("kind") != "bench" or row.get("rounds_per_sec") is None:
+            continue
+        cands = [p for p in prior_rows
+                 if p.get("kind") == "bench"
+                 and p.get("config") == row.get("config")
+                 and p.get("n") == row.get("n")
+                 and p.get("host") == row.get("host")
+                 and p.get("source") != row.get("source")
+                 and p.get("rounds_per_sec") is not None]
+        d = {"kind": "delta", "source": row.get("source"),
+             "n": row.get("n"), "host": row.get("host"),
+             "rounds_per_sec": row.get("rounds_per_sec")}
+        if not cands:
+            cross = any(p.get("kind") == "bench"
+                        and p.get("n") == row.get("n")
+                        and p.get("host") != row.get("host")
+                        for p in prior_rows)
+            d.update(delta_pct=None, regression=False,
+                     reason=("host-fingerprint mismatch — not comparable"
+                             if cross else "no prior comparable entry"))
+        else:
+            best = max(cands, key=lambda p: p["rounds_per_sec"])
+            pct = ((row["rounds_per_sec"] - best["rounds_per_sec"])
+                   / best["rounds_per_sec"] * 100.0)
+            d.update(best_prior=best["rounds_per_sec"],
+                     best_source=best.get("source"),
+                     delta_pct=round(pct, 2),
+                     regression=bool(pct < -band * 100.0))
+        out.append(d)
+    return out
+
+
+# --------------------------------------------------------------------
+# synthetic capture (test fixture) — encodes a REAL capture layout so
+# tests exercise the exact parse path live captures take
+# --------------------------------------------------------------------
+
+
+def write_synthetic_capture(trace_dir: str, module: str,
+                            ops: list[tuple[str, str, float]]) -> None:
+    """Write a ``plugins/profile/<run>/host.{xplane.pb,trace.json.gz}``
+    pair for ``ops`` = [(op_name, scope_path, dur_us), ...]."""
+    run = os.path.join(trace_dir, "plugins", "profile", "0001")
+    os.makedirs(run, exist_ok=True)
+
+    insts = b"".join(
+        _encode_field(2, _encode_field(1, op) +
+                      _encode_field(7, _encode_field(2, scope)))
+        for op, scope, _ in ops)
+    hlo_module = _encode_field(1, f"{module}(1)") + _encode_field(3, insts)
+    hlo_proto = _encode_field(1, hlo_module)
+    # stat_metadata map: id 61 -> "Hlo Proto"; one stat carrying it
+    stat_md = _encode_field(
+        5, _encode_field(1, 61) +
+        _encode_field(2, _encode_field(1, 61) +
+                      _encode_field(2, "Hlo Proto")))
+    stat = _encode_field(1, 61) + _encode_field(6, hlo_proto)
+    # the real jax 0.4.x layout: HloProto stat attached to the
+    # module's XEventMetadata entry in the event_metadata map
+    event_md = _encode_field(
+        4, _encode_field(1, 7) +
+        _encode_field(2, _encode_field(1, 7) +
+                      _encode_field(2, f"{module}(1)") +
+                      _encode_field(5, stat)))
+    plane = _encode_field(1, _encode_field(2, "/host:metadata") +
+                          stat_md + event_md)
+    with open(os.path.join(run, "host.xplane.pb"), "wb") as f:
+        f.write(plane)
+
+    events = [{"ph": "X", "ts": 1000 + i, "dur": dur, "name": op,
+               "pid": 1, "tid": 1,
+               "args": {"hlo_module": module, "hlo_op": op}}
+              for i, (op, _scope, dur) in enumerate(ops)]
+    with gzip.open(os.path.join(run, "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": events}, f)

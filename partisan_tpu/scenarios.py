@@ -2105,6 +2105,60 @@ def run_all(scale: float = 1.0, only=None) -> list[dict]:
     return out
 
 
+def _run_cli(args):
+    import json
+
+    if args.control_ab:
+        print(json.dumps(control_ab(scale=args.scale)), flush=True)
+        raise SystemExit(0)
+    if args.slo is not None:
+        n8 = max(48, int(DEFAULT_SIZES[8] * args.scale))
+        static = config8_overload(n=n8, adaptive=False)
+        adaptive = config8_overload(n=n8, adaptive=True)
+        print(json.dumps({"kind": "overload_static", **static}),
+              flush=True)
+        print(json.dumps({"kind": "overload_adaptive", **adaptive}),
+              flush=True)
+        ok, rows = slo_gate(adaptive["p99"], args.slo)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        print(json.dumps({"kind": "slo_verdict", "pass": ok,
+                          "bound": args.slo}), flush=True)
+        # the traffic-plane multi-scenario suite (ROADMAP item 3): one
+        # verdict line per scenario, then the committed-artifact object
+        suite = traffic_slo(scale=args.scale, bound=args.slo)
+        for name, entry in suite["scenarios"].items():
+            line = {"kind": "traffic_slo_scenario", "model": name,
+                    "ok": entry["ok"],
+                    "isolation": entry.get("isolation", False)}
+            if "win" in entry:
+                line["win"] = entry["win"]
+                line["bulk_p99_static"] = entry["static"]["bulk_p99"]
+                line["bulk_p99_adaptive"] = \
+                    entry["adaptive"]["bulk_p99"]
+            print(json.dumps(line), flush=True)
+        print(json.dumps({"kind": "traffic_slo", **suite}), flush=True)
+        if args.slo_out:
+            with open(args.slo_out, "w") as f:
+                json.dump(suite, f, indent=1)
+        raise SystemExit(0 if (ok and suite["pass"]) else 1)
+    if args.elastic:
+        out9 = config9_elastic(
+            n=max(64, int(DEFAULT_SIZES[9] * args.scale)),
+            ingress_trace=args.ingress_trace,
+            ckpt_dir=args.ckpt_dir)
+        print(json.dumps(out9), flush=True)
+        raise SystemExit(0 if out9["pass"] else 1)
+    if args.soak:
+        print(json.dumps(config7_soak(
+            n=max(64, int(DEFAULT_SIZES[7] * args.scale)),
+            rounds=args.soak_rounds, ckpt_dir=args.ckpt_dir)),
+            flush=True)
+    else:
+        for r in run_all(scale=args.scale, only=args.only):
+            print(json.dumps(r), flush=True)
+
+
 if __name__ == "__main__":
     import argparse
     import json
@@ -2177,6 +2231,13 @@ if __name__ == "__main__":
                          "backpressure p99, healing rounds-to-heal, "
                          "calm no-regression) and print the comparison "
                          "object (the committed CONTROL_AB.json)")
+    ap.add_argument("--perf", action="store_true",
+                    help="capture a jax.profiler trace of the run and "
+                         "emit the measured per-phase device-time "
+                         "table (partisan_tpu/perfwatch.py attribution "
+                         "over the round.* named_scopes — the cost "
+                         "meter's phase keys) to stderr as JSON lines "
+                         "(stdout is unchanged)")
     args = ap.parse_args()
     METRICS = METRICS or args.metrics
     LATENCY = LATENCY or args.latency
@@ -2185,52 +2246,27 @@ if __name__ == "__main__":
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    if args.control_ab:
-        print(json.dumps(control_ab(scale=args.scale)), flush=True)
-        raise SystemExit(0)
-    if args.slo is not None:
-        n8 = max(48, int(DEFAULT_SIZES[8] * args.scale))
-        static = config8_overload(n=n8, adaptive=False)
-        adaptive = config8_overload(n=n8, adaptive=True)
-        print(json.dumps({"kind": "overload_static", **static}),
-              flush=True)
-        print(json.dumps({"kind": "overload_adaptive", **adaptive}),
-              flush=True)
-        ok, rows = slo_gate(adaptive["p99"], args.slo)
-        for row in rows:
-            print(json.dumps(row), flush=True)
-        print(json.dumps({"kind": "slo_verdict", "pass": ok,
-                          "bound": args.slo}), flush=True)
-        # the traffic-plane multi-scenario suite (ROADMAP item 3): one
-        # verdict line per scenario, then the committed-artifact object
-        suite = traffic_slo(scale=args.scale, bound=args.slo)
-        for name, entry in suite["scenarios"].items():
-            line = {"kind": "traffic_slo_scenario", "model": name,
-                    "ok": entry["ok"],
-                    "isolation": entry.get("isolation", False)}
-            if "win" in entry:
-                line["win"] = entry["win"]
-                line["bulk_p99_static"] = entry["static"]["bulk_p99"]
-                line["bulk_p99_adaptive"] = \
-                    entry["adaptive"]["bulk_p99"]
-            print(json.dumps(line), flush=True)
-        print(json.dumps({"kind": "traffic_slo", **suite}), flush=True)
-        if args.slo_out:
-            with open(args.slo_out, "w") as f:
-                json.dump(suite, f, indent=1)
-        raise SystemExit(0 if (ok and suite["pass"]) else 1)
-    if args.elastic:
-        out9 = config9_elastic(
-            n=max(64, int(DEFAULT_SIZES[9] * args.scale)),
-            ingress_trace=args.ingress_trace,
-            ckpt_dir=args.ckpt_dir)
-        print(json.dumps(out9), flush=True)
-        raise SystemExit(0 if out9["pass"] else 1)
-    if args.soak:
-        print(json.dumps(config7_soak(
-            n=max(64, int(DEFAULT_SIZES[7] * args.scale)),
-            rounds=args.soak_rounds, ckpt_dir=args.ckpt_dir)),
-            flush=True)
-    else:
-        for r in run_all(scale=args.scale, only=args.only):
-            print(json.dumps(r), flush=True)
+    _perf_cm = _perf_dir = None
+    if args.perf:
+        import tempfile
+
+        from partisan_tpu import perfwatch
+
+        _perf_dir = tempfile.mkdtemp(prefix="ptpu_perf_")
+        _perf_cm = perfwatch.capture(_perf_dir)
+        _perf_cm.__enter__()
+    try:
+        _run_cli(args)
+    finally:
+        if _perf_cm is not None:
+            import shutil
+            import sys
+
+            # close the profiler FIRST (this finally also runs on the
+            # branches' SystemExit), then attribute the capture
+            _perf_cm.__exit__(None, None, None)
+            for _name, _slot in sorted(
+                    perfwatch.attribute(_perf_dir).items()):
+                print(json.dumps({"kind": "perf_phase", "phase": _name,
+                                  **_slot}), file=sys.stderr, flush=True)
+            shutil.rmtree(_perf_dir, ignore_errors=True)

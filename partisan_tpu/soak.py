@@ -475,7 +475,10 @@ class SoakConfig:
 class SoakResult:
     state: Any
     rounds: int                   # rounds actually advanced
-    chunks: list[dict]            # per-chunk rows (round, k, wall, ...)
+    chunks: list[dict]            # per-chunk rows (round, k, wall_s,
+    #   per_round_s, rounds_per_s, gap_s = host time since the previous
+    #   chunk's device-ready — perfwatch.decompose_chunks splits the
+    #   run into in-execution vs dispatch-gap time from these)
     log: list[dict]               # recovery/breach event log
     retries: int
     breaches: int
@@ -711,6 +714,12 @@ class Soak:
         crash_streak = 0
         deg_retries = 0
         armed = False
+        # Dispatch-wall meter (perfwatch): host time from the previous
+        # chunk's device-ready to this chunk's submit is pure
+        # non-execution overhead — checkpoints, storms, ingress drains
+        # and dispatch itself.  Reset across restores so cooldown and
+        # rebuild never masquerade as dispatch gap.
+        prev_ready = None
         # Chunk lengths already executed in the CURRENT context: the
         # first run of each distinct scan length pays trace/compile, so
         # only repeat ("warm") lengths feed the baseline, the adaptive
@@ -773,12 +782,16 @@ class Soak:
                 self.sleep_fn(cool)
                 state, r = self._restore(log, fresh_context=True)
                 ctx_lengths = set()
+                prev_ready = None
                 armed = True
                 # drop rows for rounds the rewind will re-run — replay
                 # re-logs them, and sum(row.k) must equal rounds run
                 chunks[:] = [row for row in chunks if row["round"] < r]
                 continue
-            wall = time.perf_counter() - t0
+            ready_t = time.perf_counter()
+            wall = ready_t - t0
+            gap_s = None if prev_ready is None else t0 - prev_ready
+            prev_ready = ready_t
             crash_streak = 0      # a completed chunk breaks the streak
             if got != r + k:
                 raise RuntimeError(
@@ -819,6 +832,7 @@ class Soak:
                     self.sleep_fn(cool)
                     state, r = self._restore(log, fresh_context=True)
                     ctx_lengths = set()
+                    prev_ready = None
                     chunks[:] = [row for row in chunks
                                  if row["round"] < r]
                     continue
@@ -843,7 +857,11 @@ class Soak:
                 per_round_s = this_per_round if per_round_s is None \
                     else 0.5 * per_round_s + 0.5 * this_per_round
             row = {"round": r, "k": k, "wall_s": round(wall, 4),
-                   "per_round_s": round(this_per_round, 6)}
+                   "per_round_s": round(this_per_round, 6),
+                   "rounds_per_s": round(k / wall, 3) if wall > 0
+                   else None}
+            if gap_s is not None:
+                row["gap_s"] = round(gap_s, 4)
             if getattr(nxt_state, "health", ()) != ():
                 from partisan_tpu import health as health_mod
 

@@ -82,6 +82,14 @@ ELASTIC_SCALE_IN = ("partisan", "elastic", "scale_in")
 INGRESS_DRAIN = ("partisan", "ingress", "drain")
 INGRESS_SHED = ("partisan", "ingress", "shed")
 
+# Performance-observatory events (perfwatch host-side measurements ->
+# discrete events): the dispatch-wall decomposition of a chunked run,
+# a measured-vs-predicted phase outlier (the VMEM-fusion target list),
+# and a bench-ledger regression verdict.
+PERF_DISPATCH_WALL = ("partisan", "perf", "dispatch_wall")
+PERF_PHASE_OUTLIER = ("partisan", "perf", "phase_outlier")
+PERF_REGRESSION = ("partisan", "perf", "regression")
+
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
 
@@ -573,6 +581,47 @@ def replay_ingress_events(bus: Bus, log) -> int:
                          "shed_invalid": invalid,
                          "deferred": deferred}, meta)
             n_events += 1
+    return n_events
+
+
+def replay_perf_events(bus: Bus, *, dispatch: Mapping[str, Any] | None = None,
+                       phases=None, deltas=None) -> int:
+    """Replay perfwatch host-side measurements as ``partisan.perf.*``
+    events: one ``dispatch_wall`` per decomposition (perfwatch
+    ``decompose``/``decompose_chunks`` dict), one ``phase_outlier`` per
+    reconciliation row flagged ``outlier`` (perfwatch ``reconcile``),
+    and one ``regression`` per ledger delta flagged ``regression``
+    (perfwatch ``ledger_deltas``).  Returns the number of events
+    emitted."""
+    n_events = 0
+    if dispatch:
+        bus.execute(PERF_DISPATCH_WALL,
+                    {"in_execution_s": float(
+                        dispatch.get("in_execution_s", 0.0)),
+                     "gap_s": float(dispatch.get("gap_s", 0.0)),
+                     "gap_share": float(dispatch.get("gap_share", 0.0))},
+                    {"chunks": int(dispatch.get("chunks", 0))})
+        n_events += 1
+    for row in phases or []:
+        if not row.get("outlier"):
+            continue
+        bus.execute(PERF_PHASE_OUTLIER,
+                    {"measured_ms": float(row.get("measured_ms", 0.0)),
+                     "predicted_bytes": int(
+                         row.get("predicted_bytes", 0)),
+                     "time_share": float(row.get("time_share", 0.0))},
+                    {"phase": row.get("phase")})
+        n_events += 1
+    for d in deltas or []:
+        if not d.get("regression"):
+            continue
+        bus.execute(PERF_REGRESSION,
+                    {"rounds_per_sec": float(
+                        d.get("rounds_per_sec", 0.0)),
+                     "delta_pct": float(d.get("delta_pct", 0.0))},
+                    {"n": d.get("n"), "host": d.get("host"),
+                     "source": d.get("source")})
+        n_events += 1
     return n_events
 
 

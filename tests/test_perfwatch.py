@@ -1,0 +1,346 @@
+"""Runtime performance observatory (partisan_tpu/perfwatch.py).
+
+Five guarantees:
+
+1. **Phase attribution parity** — a synthetic profiler capture (the
+   real plugins/profile layout, encoded with perfwatch's own protobuf
+   writer) and a REAL ``jax.profiler`` capture of a scoped program both
+   attribute device time to the exact ``round.*`` named_scope keys the
+   cost meter censuses.
+2. **Dispatch-gap decomposition** — exact arithmetic on a stubbed
+   timeline; soak chunk rows carry the wall/gap brackets it reads.
+3. **Reconciliation** — rows keyed exactly by the census's phase keys,
+   outlier flagging (time share ≫ byte share) on a synthetic census.
+4. **Ledger semantics** — append/dedup idempotence, best-prior deltas,
+   the regression band, and the cross-host-fingerprint refusal.
+5. **Zero traced eqns** — perfwatch is host-side only: the bench-round
+   census is eqn-identical under a live capture, and a scan traced
+   under ``capture()`` stays CLEAN under the standing lint rules.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import support
+from partisan_tpu import perfwatch
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.models.plumtree import Plumtree
+
+
+def _cluster(n, seed):
+    cl = Cluster(support.hv_config(n, seed, partition_mode="groups",
+                                   inbox_cap=16),
+                 model=Plumtree())
+    return cl, support.boot_hyparview(cl, settle=20)
+
+
+# ---------------------------------------------------------------------------
+# phase attribution
+# ---------------------------------------------------------------------------
+
+def test_synthetic_capture_attribution_parity(tmp_path):
+    """The synthetic fixture exercises the REAL parse path: protobuf
+    xplane -> HloProto scope map, trace.json -> op durations, join on
+    (module, op)."""
+    ops = [
+        ("dot.1", "jit(steps)/while/body/round.model/dot", 1200.0),
+        ("add.7", "jit(steps)/while/body/round.model/add", 300.0),
+        ("gather.2", "jit(steps)/while/body/round.manager/gather", 500.0),
+        ("mul.9", "jit(steps)/transpose/mul", 40.0),
+    ]
+    perfwatch.write_synthetic_capture(str(tmp_path), "jit_steps", ops)
+    got = perfwatch.attribute(str(tmp_path))
+    assert got["round.model"] == {"ms": 1.5, "events": 2}
+    assert got["round.manager"] == {"ms": 0.5, "events": 1}
+    assert got["-"] == {"ms": 0.04, "events": 1}
+    # unknown (module, op) pairs — e.g. ops the HloProto never named —
+    # land in "-", never crash and never invent a phase
+    assert set(got) == {"round.model", "round.manager", "-"}
+
+
+def test_real_capture_attributes_round_scopes(tmp_path):
+    """End-to-end on the live profiler: a jitted scan with round.*
+    named_scopes must produce measured ms under those exact keys."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(x):
+        with jax.named_scope("round.model"):
+            x = jnp.dot(x, x)
+        with jax.named_scope("round.route"):
+            x = x + 1.0
+        return x
+
+    f = jax.jit(lambda x: jax.lax.scan(
+        lambda c, _: (body(c), None), x, None, length=4)[0])
+    x = jnp.ones((64, 64))
+    f(x).block_until_ready()          # compile outside the capture
+    with perfwatch.capture(str(tmp_path)):
+        f(x).block_until_ready()
+    got = perfwatch.attribute(str(tmp_path))
+    assert got.get("round.model", {}).get("ms", 0.0) > 0.0, got
+    assert got.get("round.model", {}).get("events", 0) > 0
+    # the same segment-extraction rule as lint/cost.py: first round.*
+    # path segment wins, everything else is "-"
+    assert perfwatch.phase_of_op_name(
+        "jit(steps)/jit(main)/while/body/round.model/add") \
+        == "round.model"
+    assert perfwatch.phase_of_op_name("jit(steps)/transpose") == "-"
+    assert perfwatch.phase_of_op_name("") == "-"
+
+
+def test_capture_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("PROFILE_TRACE_DIR", raising=False)
+    with perfwatch.capture() as d:
+        assert d is None
+
+
+# ---------------------------------------------------------------------------
+# dispatch-wall decomposition
+# ---------------------------------------------------------------------------
+
+def test_decompose_stubbed_timeline_exact():
+    records = [
+        {"wall_s": 2.0, "gap_s": None},   # first chunk: no prior ready
+        {"wall_s": 1.0, "gap_s": 0.5},
+        {"wall_s": 1.0, "gap_s": 0.5},
+    ]
+    d = perfwatch.decompose(records)
+    assert d["chunks"] == 3
+    assert d["in_execution_s"] == 4.0
+    assert d["gap_s"] == 1.0
+    assert d["gap_share"] == round(1.0 / 5.0, 4)
+    assert d["per_chunk_gap_ms"] == 500.0
+    assert perfwatch.decompose([]) == {}
+    # soak chunk rows carry extra keys; rows without wall_s are skipped
+    d2 = perfwatch.decompose_chunks(
+        [{"round": 0, "k": 5, "wall_s": 2.0},
+         {"round": 5, "k": 5, "wall_s": 1.0, "gap_s": 0.5,
+          "digest": 3}, "not-a-dict"])
+    assert d2["chunks"] == 2 and d2["gap_s"] == 0.5
+
+
+def test_soak_chunk_rows_carry_dispatch_fields():
+    """Soak.run chunk rows must bracket wall and gap (the dispatch
+    meter's input), with the first chunk gap-less."""
+    from partisan_tpu import soak
+
+    cl, st = _cluster(16, seed=3)
+    eng = soak.Soak(make_cluster=lambda: cl,
+                    cfg=soak.SoakConfig(chunk_fixed=10,
+                                        checkpoint_every=40))
+    res = eng.run(st, rounds=40)
+    assert len(res.chunks) == 4
+    for i, row in enumerate(res.chunks):
+        assert row["rounds_per_s"] > 0
+        assert ("gap_s" in row) == (i > 0), res.chunks
+        if i > 0:
+            assert row["gap_s"] >= 0.0
+    d = perfwatch.decompose_chunks(res.chunks)
+    assert d["chunks"] == 4 and d["in_execution_s"] > 0
+    assert 0.0 <= d["gap_share"] < 1.0
+
+
+def test_pipeline_probe_structure():
+    """The probe must produce a measured overlap number in [0, 1] and
+    keep advancing the state (chained dispatch included)."""
+    import jax
+
+    from partisan_tpu.scenarios import _sync
+
+    cl, st = _cluster(16, seed=4)
+    r0 = int(jax.device_get(st.rnd))
+    probe, st2 = perfwatch.pipeline_probe(
+        lambda s, k: cl.steps(s, k), _sync, st, reps=3, k=4)
+    assert probe["reps"] == 3 and probe["k"] == 4
+    assert probe["serial_s"] > 0 and probe["pipelined_s"] > 0
+    assert 0.0 <= probe["overlap"] <= 1.0
+    assert probe["saved_ms_per_chunk"] >= 0.0
+    # warmup (1) + serial (3) + pipelined (3) chunks of 4 rounds
+    assert int(jax.device_get(st2.rnd)) == r0 + 7 * 4
+
+
+# ---------------------------------------------------------------------------
+# measured-vs-predicted reconciliation
+# ---------------------------------------------------------------------------
+
+def _fake_census(phases):
+    from partisan_tpu.lint.cost import Census, PhaseCost
+
+    costs = {name: PhaseCost(gathers=1, scatters=0, fetched=0,
+                             interm_bytes=b, eqns=4)
+             for name, b in phases.items()}
+    total = sum(costs.values(), PhaseCost())
+    return Census(phases=costs, total=total, n=64)
+
+
+def test_reconcile_keys_match_census_and_flags_outliers():
+    census = _fake_census({"round.manager": 8_000_000,
+                           "round.model": 1_000_000,
+                           "round.route": 1_000_000})
+    # round.model burns half the measured time on a 10% byte share ->
+    # outlier; round.manager is slow but proportional -> clean
+    measured = {"round.manager": {"ms": 40.0, "events": 10},
+                "round.model": {"ms": 50.0, "events": 10},
+                "round.route": {"ms": 10.0, "events": 2}}
+    rows = perfwatch.reconcile(measured, census, rounds=1)
+    assert [r["phase"] for r in rows] == sorted(census.phases)
+    by = {r["phase"]: r for r in rows}
+    assert by["round.model"]["outlier"] is True
+    assert by["round.manager"]["outlier"] is False
+    assert by["round.model"]["eff_bytes_per_s"] == \
+        round(1_000_000 / (50.0 / 1000.0))
+    # a phase the capture never saw still rows out (measured 0)
+    rows2 = perfwatch.reconcile({}, census)
+    assert [r["phase"] for r in rows2] == sorted(census.phases)
+    assert all(r["measured_ms"] == 0.0 and not r["outlier"]
+               for r in rows2)
+    # measured keys outside the census surface as "(unattributed)",
+    # never as an invented census key
+    rows3 = perfwatch.reconcile(
+        {"round.ghost": {"ms": 5.0, "events": 1}}, census)
+    assert rows3[-1]["phase"] == "(unattributed)"
+    assert rows3[-1]["measured_ms"] == 5.0
+
+
+def test_reconcile_tiny_phase_never_flags():
+    """The absolute-time floor: µs-scale phases can't be outliers even
+    with a zero byte footprint."""
+    census = _fake_census({"round.big": 10_000_000, "round.tiny": 0})
+    measured = {"round.big": {"ms": 100.0, "events": 5},
+                "round.tiny": {"ms": 0.5, "events": 1}}
+    by = {r["phase"]: r
+          for r in perfwatch.reconcile(measured, census)}
+    assert by["round.tiny"]["outlier"] is False
+
+
+# ---------------------------------------------------------------------------
+# bench-history ledger
+# ---------------------------------------------------------------------------
+
+def _bench_doc(rps, n=1000, host_tail="Platform 'axon' ready"):
+    return {"round": 1,
+            "parsed": {"all_sizes": {str(n): {
+                "rounds_per_sec": rps, "convergence_rounds": 20,
+                "convergence_wall_s": 9.0}}},
+            "tail": host_tail}
+
+
+def test_ledger_append_dedup_and_delta(tmp_path):
+    led = str(tmp_path / "ledger.jsonl")
+    r1 = perfwatch.doc_rows(_bench_doc(10.0), "a.json")
+    assert r1[0]["host"] == "axon"
+    assert r1[0]["pallas"] == "BLOCKED"        # the standing default
+    assert r1[0]["minute_wall"] == "STANDING"
+    assert perfwatch.append_rows(led, r1) == r1
+    # idempotent: same (source, n) never re-appends
+    assert perfwatch.append_rows(led, r1) == []
+    assert len(perfwatch.read_ledger(led)) == 1
+    # second artifact: improvement vs best prior comparable
+    prior = perfwatch.read_ledger(led)
+    r2 = perfwatch.doc_rows(_bench_doc(12.0), "b.json")
+    perfwatch.append_rows(led, r2)
+    (d,) = perfwatch.ledger_deltas(r2, prior)
+    assert d["delta_pct"] == 20.0 and d["regression"] is False
+    assert d["best_source"] == "a.json"
+    # regression beyond the band trips; inside the band does not
+    r3 = perfwatch.doc_rows(_bench_doc(10.2), "c.json")
+    (d3,) = perfwatch.ledger_deltas(r3, perfwatch.read_ledger(led))
+    assert d3["regression"] is True            # -15% vs best (12.0)
+    (d4,) = perfwatch.ledger_deltas(
+        perfwatch.doc_rows(_bench_doc(11.5), "d.json"),
+        perfwatch.read_ledger(led), band=0.10)
+    assert d4["regression"] is False           # -4.2% inside the band
+
+
+def test_ledger_refuses_cross_host_comparison(tmp_path):
+    led = str(tmp_path / "ledger.jsonl")
+    perfwatch.append_rows(
+        led, perfwatch.doc_rows(_bench_doc(50.0), "tpu_run.json"))
+    cpu_rows = perfwatch.doc_rows(
+        _bench_doc(1.0, host_tail="Platform 'cpu' ready"), "cpu.json")
+    assert cpu_rows[0]["host"] == "cpu"
+    (d,) = perfwatch.ledger_deltas(cpu_rows, perfwatch.read_ledger(led))
+    # 50x slower but a DIFFERENT host fingerprint: refused, not flagged
+    assert d["delta_pct"] is None and d["regression"] is False
+    assert "host-fingerprint" in d["reason"]
+
+
+def test_ledger_parses_committed_artifact_shapes():
+    """Every committed BENCH_r*.json / MULTICHIP_r*.json must ingest
+    (the acceptance floor: >= 5 bench rows across the set)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench_rows, multi_rows = [], []
+    for p in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        bench_rows += perfwatch.artifact_rows(p)
+    for p in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))):
+        multi_rows += perfwatch.artifact_rows(p)
+    assert len([r for r in bench_rows
+                if r["rounds_per_sec"] is not None]) >= 5
+    assert all(r["kind"] == "bench" and r["n"] > 0 for r in bench_rows)
+    assert all(r["kind"] == "multichip" for r in multi_rows)
+    # the committed ledger tracks exactly these artifacts
+    led = os.path.join(repo, perfwatch.LEDGER_DEFAULT)
+    if os.path.exists(led):
+        committed = perfwatch.read_ledger(led)
+        assert {perfwatch._row_key(r) for r in committed} >= \
+            {perfwatch._row_key(r) for r in bench_rows}
+
+
+def test_live_bench_doc_rows_use_backend_fingerprint():
+    doc = {"pallas_probe": {"verdict": "PASS"},
+           "all_sizes": {"4096": {"warm": {
+               "rounds_per_sec": {"median": 7.5, "p90": 8.0}},
+               "convergence": {"rounds": 30, "wall_s": 4.0}}}}
+    (row,) = perfwatch.doc_rows(doc, "live.json")
+    assert row["rounds_per_sec"] == 7.5
+    assert row["host"] == perfwatch.host_fingerprint()
+    assert row["pallas"] == "PASS"   # live probe verdict overrides
+    assert row["convergence_rounds"] == 30
+
+
+# ---------------------------------------------------------------------------
+# zero-cost guarantee: perfwatch is host-side only
+# ---------------------------------------------------------------------------
+
+def test_capture_adds_zero_traced_eqns(tmp_path):
+    """The observatory must not change the traced program: the census
+    (eqn counts per phase) of the bench round is identical whether or
+    not a capture is live, and a scan traced under capture stays CLEAN
+    under the standing lint matrix rules (no host callback, zero-cost
+    keying, narrow dtypes, scatter overlap)."""
+    from partisan_tpu.lint.cost import bench_round_program, \
+        census_program
+
+    base = census_program(bench_round_program(64))
+    with perfwatch.capture(str(tmp_path)):
+        under = census_program(bench_round_program(64))
+        cl = Cluster(support.hv_config(24, seed=7,
+                                       partition_mode="groups"),
+                     model=Plumtree())
+        support.assert_scan_lint_clean(cl, cl.init(), k=4,
+                                       name="perfwatch-capture-scan")
+    assert {p: c.eqns for p, c in base.phases.items()} == \
+        {p: c.eqns for p, c in under.phases.items()}
+    assert base.total.eqns == under.total.eqns
+
+
+def test_reconcile_is_pure_host(tmp_path):
+    """Attribution + reconciliation never touch jax tracing: they run
+    on parsed JSON/proto bytes alone (no traced eqns to count — there
+    is no jaxpr anywhere in the path)."""
+    perfwatch.write_synthetic_capture(
+        str(tmp_path), "jit_steps",
+        [("dot.1", "jit(steps)/round.model/dot", 100.0)])
+    measured = perfwatch.attribute(str(tmp_path))
+    census = _fake_census({"round.model": 1_000_000})
+    rows = perfwatch.reconcile(measured, census)
+    assert rows[0]["phase"] == "round.model"
+    assert rows[0]["measured_ms"] == pytest.approx(0.1)

@@ -247,10 +247,12 @@ def test_tools_cli_completeness():
     tools_dir = os.path.join(_REPO, "tools")
     tools = sorted(f for f in os.listdir(tools_dir)
                    if f.endswith(".py"))
-    assert len(tools) >= 11, tools
+    assert len(tools) >= 13, tools
     assert "soak_report.py" in tools
     assert "jaxlint.py" in tools
     assert "fleet_report.py" in tools
+    assert "perf_report.py" in tools
+    assert "bench_history.py" in tools
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     procs = {}
     for tool in tools:
@@ -313,6 +315,84 @@ def test_soak_report_elastic_smoke():
     assert ("partisan", "elastic", "scale_in") in events
     assert rows[-1]["kind"] == "summary"
     assert rows[-1]["breaches"] == 0
+
+
+def test_perf_report_cli_smoke():
+    """Runtime observatory CLI end-to-end on CPU: --one captures a
+    profiled run, attributes device time to the SAME round.* phase
+    keys the cost census predicts with (keys_match is the acceptance
+    gate), and reconciles measured vs predicted per phase."""
+    out = _run("perf_report.py", "--one", "128")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    phases = [r for r in rows if r["kind"] == "perf_phase"]
+    assert {"round.manager", "round.model"} <= \
+        {r["phase"] for r in phases}, phases
+    for r in phases:
+        assert {"measured_ms", "predicted_bytes", "eff_bytes_per_s",
+                "time_share", "outlier"} <= set(r)
+    summary = next(r for r in rows if r["kind"] == "perf")
+    assert summary["keys_match"] is True, summary
+    # outlier flags replay as partisan.perf.phase_outlier events
+    events = [tuple(r["event"]) for r in rows if r["kind"] == "event"]
+    assert all(ev[:2] == ("partisan", "perf") for ev in events)
+    bad = _run("perf_report.py", "--one", "not_a_number")
+    assert bad.returncode != 0
+
+
+def test_perf_report_dispatch_smoke():
+    """--dispatch: submit→ready bracketing over a chunked run — chunk
+    rows plus the in-execution vs dispatch-gap decomposition and its
+    replayed partisan.perf.dispatch_wall event."""
+    out = _run("perf_report.py", "--dispatch", "64", "--chunks", "3",
+               "--k", "5")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    disp = next(r for r in rows if r["kind"] == "dispatch_wall")
+    assert disp["chunks"] == 3
+    assert disp["in_execution_s"] > 0
+    assert 0.0 <= disp["gap_share"] < 1.0
+    events = [tuple(r["event"]) for r in rows if r["kind"] == "event"]
+    assert ("partisan", "perf", "dispatch_wall") in events
+
+
+def test_bench_history_cli(tmp_path):
+    """Ledger CLI end-to-end: ingesting the committed artifacts into a
+    fresh ledger yields >= 5 comparable bench rows (the acceptance
+    floor), re-ingest is a no-op, and a degraded synthetic artifact
+    trips the --check regression exit."""
+    led = str(tmp_path / "ledger.jsonl")
+    out = _run("bench_history.py", "--ledger", led)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    summary = rows[-1]
+    assert summary["kind"] == "summary"
+    bench = [r for r in rows if r.get("kind") == "bench"
+             and r.get("rounds_per_sec") is not None]
+    assert len(bench) >= 5, summary
+    # the committed history validates the gate: r04's 32768 run really
+    # did regress -10.7% vs r03 before r05 recovered it
+    deltas = [r for r in rows if r.get("kind") == "delta"]
+    assert [d["n"] for d in deltas if d["regression"]] == [32768], deltas
+    # idempotent: same artifacts, nothing new written
+    again = _run("bench_history.py", "--ledger", led)
+    assert json.loads(again.stdout.strip().splitlines()[-1])[
+        "rows_written"] == 0
+    # a degraded run vs the committed history must FAIL under --check
+    deg = tmp_path / "BENCH_degraded.json"
+    with open(deg, "w") as f:
+        json.dump({"parsed": {"all_sizes": {"100000": {
+            "rounds_per_sec": 1.0, "convergence_rounds": 20,
+            "convergence_wall_s": 60.0}}},
+            "tail": "Platform 'axon' interpreter"}, f)
+    chk = _run("bench_history.py", str(deg), "--ledger", led, "--check")
+    assert chk.returncode == 1, chk.stdout[-2000:] + chk.stderr[-2000:]
+    lines = [json.loads(ln) for ln in chk.stdout.strip().splitlines()]
+    deltas = [r for r in lines if r.get("kind") == "delta"]
+    assert any(d["regression"] for d in deltas), lines
+    # the regression replays as a partisan.perf.regression event
+    events = [tuple(r["event"]) for r in lines if r.get("kind") == "event"]
+    assert ("partisan", "perf", "regression") in events
 
 
 def test_soak_report_traffic_smoke():

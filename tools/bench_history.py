@@ -1,0 +1,126 @@
+"""Bench-history ledger: append-only performance trajectory with a
+regression gate (partisan_tpu/perfwatch.py ledger core).
+
+Ingests bench artifacts — the committed ``BENCH_r*.json`` /
+``MULTICHIP_r*.json`` round records and any future ``bench.py`` output
+— into an append-only JSON-lines ledger keyed by (kind, n, config,
+host fingerprint)::
+
+    python tools/bench_history.py                      # ingest defaults
+    python tools/bench_history.py out.json --check     # gate on regression
+    python tools/bench_history.py --ledger L.jsonl a.json b.json
+
+Each bench row carries rounds/sec, convergence, the host fingerprint
+parsed from the artifact's platform tail (live runs: the jax backend),
+and the standing Pallas-relay / minute-wall states (override with
+``--pallas V`` / ``--minute-wall V`` once either falls).  Every new
+row is delta'd against the best PRIOR comparable entry — same n,
+config and host fingerprint, cross-host comparison refused — and
+``--check`` exits 1 when any delta regresses beyond ``--band`` (default
+0.10 = 10%).  bench.py runs this as a post-run card; regressions also
+replay as ``partisan.perf.regression`` telemetry events.
+
+Re-ingesting the same artifacts is idempotent (dedup on source+n).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools._lib.jaxcache import enable_persistent_cache
+
+USAGE = ("usage: bench_history.py [artifacts...] [--ledger PATH] "
+         "[--band F] [--check] [--pallas V] [--minute-wall V]")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ingest(paths, ledger_path: str, *, band: float = 0.10,
+           pallas: str | None = None, minute_wall: str | None = None,
+           out=None) -> tuple[list[dict], list[dict]]:
+    """Ingest artifacts in order (so deltas form a trajectory);
+    returns (written_rows, deltas)."""
+    from partisan_tpu import perfwatch, telemetry
+
+    out = out or sys.stdout
+    written: list[dict] = []
+    deltas: list[dict] = []
+    for path in paths:
+        try:
+            rows = perfwatch.artifact_rows(path, pallas=pallas,
+                                           minute_wall=minute_wall)
+        except (OSError, ValueError, KeyError) as e:
+            print(json.dumps({"kind": "skip", "source": path,
+                              "error": str(e)[:120]}),
+                  file=out, flush=True)
+            continue
+        prior = perfwatch.read_ledger(ledger_path)
+        fresh = perfwatch.append_rows(ledger_path, rows)
+        for r in fresh:
+            print(json.dumps(r), file=out, flush=True)
+        ds = perfwatch.ledger_deltas(fresh, prior, band=band)
+        for d in ds:
+            print(json.dumps(d), file=out, flush=True)
+        written.extend(fresh)
+        deltas.extend(ds)
+    bus = telemetry.Bus()
+    bus.attach("bench-history", ("partisan", "perf"),
+               lambda ev, m, meta: print(
+                   json.dumps({"kind": "event", "event": list(ev),
+                               **m, **meta}), file=out, flush=True))
+    telemetry.replay_perf_events(bus, deltas=deltas)
+    regressions = [d for d in deltas if d.get("regression")]
+    print(json.dumps({
+        "kind": "summary", "ledger": ledger_path,
+        "rows_written": len(written),
+        "rows_total": len(perfwatch.read_ledger(ledger_path)),
+        "deltas": len(deltas), "regressions": len(regressions),
+        "band_pct": round(band * 100.0, 1),
+    }), file=out, flush=True)
+    return written, deltas
+
+
+def default_artifacts() -> list[str]:
+    return (sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+            + sorted(glob.glob(os.path.join(_REPO, "MULTICHIP_r*.json"))))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--help" in argv or "-h" in argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return 0
+    enable_persistent_cache()
+
+    def flag_val(name, default=None):
+        if name in argv:
+            i = argv.index(name)
+            v = argv[i + 1]
+            del argv[i:i + 2]
+            return v
+        return default
+
+    ledger = flag_val("--ledger",
+                      os.path.join(_REPO, "BENCH_LEDGER.jsonl"))
+    band = float(flag_val("--band", "0.10"))
+    pallas = flag_val("--pallas")
+    minute_wall = flag_val("--minute-wall")
+    check = "--check" in argv
+    if check:
+        argv.remove("--check")
+    paths = [a for a in argv if not a.startswith("--")] \
+        or default_artifacts()
+    _written, deltas = ingest(paths, ledger, band=band, pallas=pallas,
+                              minute_wall=minute_wall)
+    if check and any(d.get("regression") for d in deltas):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

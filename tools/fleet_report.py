@@ -52,10 +52,15 @@ def report(card: dict, out=None) -> None:
     for ch, dist in card.get("p99", {}).items():
         print(json.dumps({"kind": "distribution", "metric": "p99",
                           "channel": ch, **dist}), file=out, flush=True)
+    wall = card.get("wall_s") or 0
     print(json.dumps({
         "kind": "summary", "width": card["width"], "n": card["n"],
         "rounds": card["rounds"], "converged": card["converged"],
         "programs": card["programs"], "wall_s": card["wall_s"],
+        # population-level throughput (perfwatch's rounds/s convention:
+        # rounds advanced per wall second, all members in one program)
+        "rounds_per_s": (round(card["rounds"] / wall, 3)
+                         if wall > 0 else None),
     }), file=out, flush=True)
 
 

@@ -1,0 +1,182 @@
+"""Runtime performance observatory CLI (partisan_tpu/perfwatch.py).
+
+Measures where wall-clock actually goes — the runtime complement to
+the static cost meter (`lint/cost.py`) — in three modes::
+
+    python tools/perf_report.py --one N            # measured phase table
+    python tools/perf_report.py --dispatch N       # dispatch-wall meter
+    python tools/perf_report.py --pipeline-probe N # double-buffer probe
+
+``--one`` boots the PLAIN bench-config cluster (`lint.cost.bench_cfg`
+— the exact program the cost census prices), captures a
+``jax.profiler`` trace of steady-state executions, attributes device
+time to the ``round.*`` named_scope phases, and reconciles measured ms
+against the census's predicted byte footprint: one ``perf_phase`` JSON
+line per census phase (measured_ms / predicted_bytes / eff_bytes_per_s
+/ outlier) and a ``perf`` summary with ``keys_match`` — the measured
+phase keys are the census keys, so outlier rows are a machine-generated
+VMEM-fusion target list (ROADMAP item 1(a)).
+
+``--dispatch`` runs a short chunked soak and decomposes its chunk rows
+into in-execution vs dispatch-gap time (``dispatch_wall`` line).
+``--pipeline-probe`` measures double-buffered dispatch (chain K
+submits, sync once) against the serial submit+sync loop, quantifying
+ROADMAP item 1(b)'s claimed headroom (``pipeline_probe`` line).
+
+Flags: ``--chunks C`` (dispatch/probe repetitions, default 6),
+``--k K`` (rounds per chunk, default scenarios.K_PROG).
+Outlier and dispatch events replay through telemetry
+(``partisan.perf.*``).  Works on CPU with the same code paths an
+on-chip session uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools._lib.jaxcache import enable_persistent_cache
+
+USAGE = ("usage: perf_report.py (--one | --dispatch | --pipeline-probe) N"
+         " [--chunks C] [--k K]")
+
+
+def _boot(n: int):
+    from partisan_tpu.cluster import Cluster
+    from partisan_tpu.lint.cost import bench_cfg
+    from partisan_tpu.models.plumtree import Plumtree
+    from partisan_tpu.scenarios import _boot_overlay
+
+    cl = Cluster(bench_cfg(n), model=Plumtree())
+    st = _boot_overlay(cl, n, settle_execs=2)
+    return cl, st
+
+
+def _emit(line: dict, out) -> None:
+    print(json.dumps(line), file=out, flush=True)
+
+
+def phase_table(n: int, *, execs: int = 3, out=None) -> list[dict]:
+    """Capture → attribute → reconcile; returns the reconciled rows."""
+    from partisan_tpu import perfwatch, telemetry
+    from partisan_tpu.lint.cost import bench_round_program, \
+        census_program
+    from partisan_tpu.scenarios import K_PROG, _sync
+
+    out = out or sys.stdout
+    cl, st = _boot(n)
+    with tempfile.TemporaryDirectory() as td:
+        with perfwatch.capture(td):
+            for _ in range(execs):
+                st = cl.steps(st, K_PROG)
+                _sync(st)
+        measured = perfwatch.attribute(td)
+    cens = census_program(bench_round_program(n))
+    rows = perfwatch.reconcile(measured, cens, rounds=execs * K_PROG)
+    for row in rows:
+        _emit({"kind": "perf_phase", "n": n, **row}, out)
+    meas_keys = {k for k in measured if k.startswith("round.")}
+    summary = {
+        "kind": "perf", "n": n, "execs": execs, "k": K_PROG,
+        "phases": len(cens.phases),
+        "measured_ms": round(sum(m["ms"] for m in measured.values()), 4),
+        "keys_match": meas_keys <= set(cens.phases),
+        "outliers": [r["phase"] for r in rows if r["outlier"]],
+    }
+    _emit(summary, out)
+    bus = telemetry.Bus()
+    bus.attach("perf-report", ("partisan", "perf"),
+               lambda ev, m, meta: _emit(
+                   {"kind": "event", "event": list(ev), **m, **meta},
+                   out))
+    telemetry.replay_perf_events(bus, phases=rows)
+    return rows
+
+
+def dispatch_meter(n: int, *, chunks: int = 6, k: int | None = None,
+                   out=None) -> dict:
+    """Short chunked soak → chunk rows → dispatch-wall decomposition."""
+    from partisan_tpu import perfwatch, soak as soak_mod, telemetry
+    from partisan_tpu.scenarios import K_PROG
+
+    out = out or sys.stdout
+    k = k or K_PROG
+    cl, st = _boot(n)
+    warm = [cl]
+    engine = soak_mod.Soak(
+        make_cluster=lambda: warm.pop() if warm else cl.rebuild(),
+        cfg=soak_mod.SoakConfig(chunk_fixed=k,
+                                checkpoint_every=chunks * k))
+    res = engine.run(st, rounds=chunks * k)
+    for row in res.chunks:
+        _emit({"kind": "chunk", **row}, out)
+    disp = perfwatch.decompose_chunks(res.chunks)
+    _emit({"kind": "dispatch_wall", "n": n, **disp}, out)
+    bus = telemetry.Bus()
+    bus.attach("perf-report", ("partisan", "perf"),
+               lambda ev, m, meta: _emit(
+                   {"kind": "event", "event": list(ev), **m, **meta},
+                   out))
+    telemetry.replay_perf_events(bus, dispatch=disp)
+    return disp
+
+
+def pipeline_probe(n: int, *, reps: int = 6, k: int | None = None,
+                   out=None) -> dict:
+    """Measured double-buffered-dispatch overlap (ROADMAP item 1(b))."""
+    from partisan_tpu import perfwatch
+    from partisan_tpu.scenarios import K_PROG, _sync
+
+    out = out or sys.stdout
+    k = k or K_PROG
+    cl, st = _boot(n)
+    probe, _ = perfwatch.pipeline_probe(
+        lambda s, kk: cl.steps(s, kk), _sync, st, reps=reps, k=k)
+    _emit({"kind": "pipeline_probe", "n": n, **probe}, out)
+    return probe
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--help" in argv or "-h" in argv or not argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return 0
+    enable_persistent_cache()
+
+    def flag_val(name, default):
+        if name in argv:
+            i = argv.index(name)
+            v = int(argv[i + 1])
+            del argv[i:i + 2]
+            return v
+        return default
+
+    chunks = flag_val("--chunks", 6)
+    k = flag_val("--k", None)
+    modes = [m for m in ("--one", "--dispatch", "--pipeline-probe")
+             if m in argv]
+    for m in modes:
+        argv.remove(m)
+    sizes = [int(a) for a in argv if a.isdigit()]
+    n = sizes[0] if sizes else 512
+    bogus = [a for a in argv if not a.isdigit()]
+    if not modes or bogus:
+        print(USAGE, file=sys.stderr)
+        return 2
+    for m in modes:
+        if m == "--one":
+            phase_table(n)
+        elif m == "--dispatch":
+            dispatch_meter(n, chunks=chunks, k=k)
+        else:
+            pipeline_probe(n, reps=chunks, k=k)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,11 @@ shape-determined (static shapes; only the lax.cond gates depend on
 content), so a synthetic overlay prices the ops faithfully without a
 multi-minute bootstrap.  Results drive the round-5 hot-path work; keep
 findings in BENCH_NOTES.md.
+
+Set ``PROFILE_TRACE_DIR=/tmp/trace`` to capture a ``jax.profiler``
+trace of the timed executions (the profile_round.py convention, shared
+via partisan_tpu/perfwatch.py — one parser, two CLIs) and print the
+measured per-phase attribution as JSON lines on stderr.
 """
 
 from __future__ import annotations
@@ -318,8 +323,23 @@ if __name__ == "__main__":
             raise SystemExit(cost_census(
                 size, budgets="--budgets" in sys.argv,
                 width_op="--width-op" in sys.argv))
-        if layout_ab:
-            main(size, plane_major=False, tag="interleaved")
-            main(size, plane_major=True, tag="plane")
-        else:
-            main(size)
+        # PROFILE_TRACE_DIR rides the same capture + trace-parsing core
+        # as profile_round.py (partisan_tpu/perfwatch.py): a no-op when
+        # unset, else the isolated-phase executions are captured and
+        # attributed to round.* scopes (the FULL-round reference run
+        # carries them) on stderr.
+        from partisan_tpu import perfwatch
+
+        with perfwatch.capture() as trace_dir:
+            if layout_ab:
+                main(size, plane_major=False, tag="interleaved")
+                main(size, plane_major=True, tag="plane")
+            else:
+                main(size)
+        if trace_dir:
+            import json
+
+            for name, slot in sorted(
+                    perfwatch.attribute(trace_dir).items()):
+                print(json.dumps({"kind": "perf_phase", "phase": name,
+                                  **slot}), file=sys.stderr, flush=True)

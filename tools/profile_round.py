@@ -16,7 +16,10 @@ phase name.
 Set ``PROFILE_TRACE_DIR=/tmp/trace`` to capture a ``jax.profiler``
 trace of the timed executions (each labeled with a
 ``TraceAnnotation``), viewable in TensorBoard/Perfetto, where the
-timeline buckets map 1:1 onto those phase names.
+timeline buckets map 1:1 onto those phase names.  The capture is also
+parsed in-process (partisan_tpu/perfwatch.py — the shared trace-parsing
+core behind tools/perf_report.py) into per-phase device-time JSON lines
+on stderr.
 """
 
 from __future__ import annotations
@@ -59,11 +62,9 @@ def measure(n: int, label: str, *, model: bool = True, active: bool = False,
     best = float("inf")
     ver = 1
     trace_dir = os.environ.get("PROFILE_TRACE_DIR")
-    import contextlib
+    from partisan_tpu import perfwatch
 
-    trace_cm = (jax.profiler.trace(trace_dir) if trace_dir
-                else contextlib.nullcontext())
-    with trace_cm:
+    with perfwatch.capture(trace_dir):
         for i in range(3):
             if active and pt is not None:
                 ver += 1
@@ -78,6 +79,16 @@ def measure(n: int, label: str, *, model: bool = True, active: bool = False,
                 best = min(best, time.perf_counter() - t0)
     print(f"{label:34s} per-round {best / K_PROG * 1e3:7.1f} ms   "
           f"(boot+compile {boot:.0f}s)", flush=True)
+    if trace_dir:
+        # measured phase attribution (perfwatch parses the capture we
+        # just wrote) — JSON lines on stderr so the aligned table above
+        # stays greppable
+        import json
+
+        for name, slot in sorted(perfwatch.attribute(trace_dir).items()):
+            print(json.dumps({"kind": "perf_phase", "label": label,
+                              "phase": name, **slot}),
+                  file=sys.stderr, flush=True)
 
 
 USAGE = "usage: profile_round.py [n] [smoke|r5|ablations]"

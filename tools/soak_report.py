@@ -4,7 +4,9 @@ self-describing JSON object per line).
 Boots a HyParView+Plumtree overlay with the health plane on, then
 drives it through the chunked soak engine (partisan_tpu/soak.py) under
 a repeating fault storm — printing one line per chunk (round, size,
-wall, health digest), one line per recovery/breach event
+wall, rounds/s, dispatch gap, health digest), a ``dispatch_wall``
+decomposition of the whole run into in-execution vs dispatch-gap time
+(partisan_tpu/perfwatch.py), one line per recovery/breach event
 (``chunk_retry`` / ``checkpoint_restored`` / ``invariant_breach`` with
 its dump paths), the replayed ``partisan.soak.*`` bus events, and a
 trailing summary::
@@ -93,6 +95,16 @@ def report(res, out=sys.stdout, channels=None, slo_rounds=None) -> dict:
         telemetry.replay_control_events(
             bus, control_mod.snapshot(res.state.control),
             channels=channels)
+    # dispatch-wall decomposition (perfwatch): the chunk rows' wall_s /
+    # gap_s brackets split the run into in-execution vs dispatch-gap
+    # time — the measured form of ROADMAP item 1(b)'s ~80 ms wall
+    from partisan_tpu import perfwatch
+
+    disp = perfwatch.decompose_chunks(res.chunks)
+    if disp:
+        print(json.dumps({"kind": "dispatch_wall", **disp}), file=out)
+        bus.attach("perf", ("partisan", "perf"), rec)
+        telemetry.replay_perf_events(bus, dispatch=disp)
     for event, meas, meta in rec.events:
         print(json.dumps({"kind": "event", "event": list(event),
                           **meas, **meta}, default=str), file=out)
@@ -100,6 +112,8 @@ def report(res, out=sys.stdout, channels=None, slo_rounds=None) -> dict:
                "chunks": len(res.chunks), "programs": res.programs,
                "retries": res.retries, "breaches": res.breaches,
                "healthy": res.healthy()}
+    if disp:
+        summary["gap_share"] = disp["gap_share"]
     print(json.dumps(summary), file=out)
     return summary
 
