@@ -411,3 +411,63 @@ def snapshot(cs: ControlState) -> dict:
             "adjustments": int(host.healing.adjustments),
         }
     return out
+
+
+def decisions(snap: dict, *, channels: tuple[str, ...] | None = None
+              ) -> list[dict]:
+    """Derive the decision rings' DISCRETE controller moves — the
+    single source of truth ``telemetry.replay_control_events`` (and
+    through it the opslog journal) emits from.  The rings record the
+    operand in force after EVERY round, so a decision is a round where
+    it CHANGED.  One self-describing dict per move, round-keyed, in
+    ring order:
+
+    - ``fanout_adjusted`` — the plumtree eager-link budget stepped,
+    - ``shed_threshold_changed`` — a channel's backpressure level
+      moved (the channel name in the row),
+    - ``healing_escalated`` — the overlay repair boost changed
+      (escalations and relaxations both; ``direction`` tags which).
+    """
+    import numpy as np
+
+    out: list[dict] = []
+    fan = snap.get("fanout")
+    if fan is not None:
+        rounds = np.asarray(fan["rounds"])
+        cap = np.asarray(fan["cap"])
+        for i in range(1, len(rounds)):
+            if cap[i] != cap[i - 1]:
+                out.append({"kind": "fanout_adjusted",
+                            "round": int(rounds[i]),
+                            "cap": int(cap[i]), "prev": int(cap[i - 1])})
+    bp = snap.get("backpressure")
+    if bp is not None:
+        rounds = np.asarray(bp["rounds"])
+        press = np.asarray(bp["press"])
+        C = press.shape[1] if press.ndim == 2 else 0
+        # index-padded: a caller-supplied tuple shorter than the ring's
+        # channel axis falls back to ch{i} instead of IndexError
+        given = tuple(channels) if channels is not None else ()
+        names = tuple(given[i] if i < len(given) else f"ch{i}"
+                      for i in range(C))
+        for i in range(1, len(rounds)):
+            for c in range(C):
+                if press[i, c] != press[i - 1, c]:
+                    out.append({"kind": "shed_threshold_changed",
+                                "round": int(rounds[i]),
+                                "channel": names[c],
+                                "press": int(press[i, c]),
+                                "prev": int(press[i - 1, c])})
+    heal = snap.get("healing")
+    if heal is not None:
+        rounds = np.asarray(heal["rounds"])
+        boost = np.asarray(heal["boost"])
+        for i in range(1, len(rounds)):
+            if boost[i] != boost[i - 1]:
+                out.append({"kind": "healing_escalated",
+                            "round": int(rounds[i]),
+                            "boost": int(boost[i]),
+                            "prev": int(boost[i - 1]),
+                            "direction": "escalate"
+                            if boost[i] > boost[i - 1] else "relax"})
+    return out

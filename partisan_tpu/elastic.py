@@ -183,6 +183,24 @@ def snapshot(es: ElasticState) -> dict:
             "n_active": int(host.prev_active)}
 
 
+def transitions(snap: dict) -> list[dict]:
+    """Derive the resize ring's DISCRETE width transitions — the
+    single source of truth ``telemetry.replay_elastic_events`` (and
+    through it the opslog journal) emits from.  One round-keyed dict
+    per real transition (the stored from-width tags the direction, so
+    the first entry of a wrapped or shrink-first window cannot
+    misreport; no-op entries are skipped)."""
+    out: list[dict] = []
+    for r, w, f in zip(snap.get("rounds", ()), snap.get("widths", ()),
+                       snap.get("from", ())):
+        if int(w) == int(f):
+            continue
+        out.append({"kind": "scale_out" if int(w) > int(f)
+                    else "scale_in", "round": int(r),
+                    "n_active": int(w), "from": int(f)})
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Validation + the join/leave plumbing
 # ---------------------------------------------------------------------------

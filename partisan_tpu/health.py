@@ -602,6 +602,60 @@ def snapshot(hs: HealthState) -> dict:
     return out
 
 
+def transitions(snap: dict, *, churn_threshold: int = 1,
+                falling: bool = False) -> list[dict]:
+    """Derive the ring's DISCRETE overlay transitions — the single
+    source of truth ``telemetry.replay_health_events`` (and through it
+    the opslog journal) emits from.  One self-describing dict per
+    transition, round-keyed:
+
+    - ``partition_detected`` — component count rises above 1 AFTER some
+      snapshot in the window showed one component (a cold bootstrap's
+      half-built components are not a partition).  Edge-triggered.
+    - ``overlay_healed`` — the count returns to 1 after a detected
+      split.
+    - ``churn`` — windowed join/leave/up/down totals at or above
+      ``churn_threshold``; edge-triggered.
+    - ``churn_settled`` (only with ``falling=True``) — the first
+      window back below the threshold after a hot run: the falling
+      edge the incident matcher closes churn spans on.
+    """
+    import numpy as np
+
+    comps = np.asarray(snap["components"])
+    rounds = np.asarray(snap["rounds"])
+    churn_total = (np.asarray(snap["joins"]) + np.asarray(snap["leaves"])
+                   + np.asarray(snap["ups"]) + np.asarray(snap["downs"]))
+    out: list[dict] = []
+    was_one = False
+    split = False
+    churn_hot = False
+    for i, rnd in enumerate(rounds):
+        c = int(comps[i])
+        if split and c == 1:
+            out.append({"kind": "overlay_healed", "round": int(rnd),
+                        "components": c})
+            split = False
+        if was_one and not split and c > 1:
+            out.append({"kind": "partition_detected", "round": int(rnd),
+                        "components": c,
+                        "isolated": int(snap["isolated"][i])})
+            split = True
+        was_one = was_one or c == 1
+        hot = int(churn_total[i]) >= churn_threshold
+        if hot and not churn_hot:
+            out.append({"kind": "churn", "round": int(rnd),
+                        "joins": int(snap["joins"][i]),
+                        "leaves": int(snap["leaves"][i]),
+                        "ups": int(snap["ups"][i]),
+                        "downs": int(snap["downs"][i])})
+        elif falling and churn_hot and not hot:
+            out.append({"kind": "churn_settled", "round": int(rnd),
+                        "quiet": int(churn_total[i])})
+        churn_hot = hot
+    return out
+
+
 def rows(snap: dict) -> list[dict]:
     """JSON-lines-friendly view: one self-describing dict per snapshot
     (the ``BENCH_*.json`` idiom)."""

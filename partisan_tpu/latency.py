@@ -347,6 +347,33 @@ def window_snap(prev: dict | None, cur: dict) -> dict:
     }
 
 
+def breach_accounting(rows, *, slo_rounds: int,
+                      channels: tuple[str, ...] | None = None) -> dict:
+    """Per-channel SLO breach accounting over a windowed p99 series —
+    the latency-plane half of the opslog error-budget math.
+
+    ``rows`` is an iterable of ``(round, k, p99_by_channel)`` triples
+    (the soak chunk rows' ``poll_latency`` series: chunk start round,
+    chunk length, and the windowed per-channel p99 dict — ``None``
+    entries mean no deliveries that window and never breach).  A
+    window breaches when its p99 EXCEEDS ``slo_rounds`` (p99 == bound
+    passes, matching every other SLO gate).
+
+    Returns ``{channel: [(round, k, breached), ...]}`` for every
+    channel seen (or the ``channels`` given), each list in row order —
+    the cumulative walk budget burn rates and exhaustion rounds are
+    computed from."""
+    out: dict[str, list] = {ch: [] for ch in (channels or ())}
+    for rnd, k, p99 in rows:
+        for ch, v in (p99 or {}).items():
+            if channels is not None and ch not in out:
+                continue
+            out.setdefault(ch, []).append(
+                (int(rnd), int(k),
+                 bool(v is not None and v > slo_rounds)))
+    return out
+
+
 def flight_trace(fl: FlightState):
     """Decode a flight-recorder ring into a ``trace.Trace`` ordered by
     round — the post-mortem view of the last K rounds, interchangeable

@@ -1,0 +1,776 @@
+"""Unified ops journal & incident observatory (HOST-SIDE ONLY).
+
+Everything in here is host-side bookkeeping over already-materialized
+data — plane snapshots, soak chunk rows, storm timelines, telemetry
+bus events.  Nothing touches a traced value, so building a journal
+adds ZERO eqns to any jitted program (perfwatch's contract; pinned by
+tests/test_opslog.py census parity).
+
+The repo's five device-resident observability planes each replay into
+independent ``telemetry.replay_*`` event streams; nothing correlated
+them.  This module fuses every signal into ONE round-keyed, causally
+ordered timeline (the Dapper move — spans with causal parentage over
+independent event streams, applied to Partisan's operational claims:
+per-channel isolation and recovery under load) and matches incident
+spans over it: *fault injected -> plane detects -> controller reacts
+-> overlay/SLO recovers*, with measured round-latencies for each leg.
+
+Entry schema
+------------
+Each :class:`Entry` carries ``(round, stream, event, severity,
+channel?, cause_id?)`` plus free-form ``measurements`` (numeric) and
+``metadata``.  Streams:
+
+- ``inject``   — the storm/traffic/elastic timeline's GROUND TRUTH
+  (``inject.<ActionClass>``, one entry per due action),
+- ``chunk``    — soak chunk rows (k, wall_s, rounds_per_s, gap_s in
+  the measurements; digest/healthy/traffic/p99/... in the metadata),
+- ``metrics``/``latency``/``health``/``broadcast``/``traffic``/
+  ``control``/``elastic``/``ingress``/``soak``/``perf`` — the
+  telemetry bus adapters, one stream per event family (the stream is
+  the event tuple's second element),
+- ``ops``      — markers this module synthesizes from window-shaped
+  signals: ``ops.slo_recovered`` at each SLO breach window's end
+  round, ``ops.crowd_ended`` at each flash-crowd window's falling
+  edge (``workload.crowd_windows``).
+
+Ordering contract (the documented total order)
+----------------------------------------------
+Entries sort by ``(round, STREAM_RANK[stream], event, channel, seq)``.
+Injections rank before observations at the same round (ground truth
+precedes detection), chunk rows before plane events, detections
+(metrics/health/...) before reactions (control), and synthesized
+``ops`` markers last.  ``seq`` is the journal append order — a
+deterministic tiebreak because :func:`from_soak` replays its sources
+in one fixed order.
+
+Identity & dedup (the append-only/resume contract)
+--------------------------------------------------
+The dedup key is ``(round, stream, event, channel, node?, dup?)``:
+appending the same identity twice keeps the FIRST copy.  Soak chunk
+rows rewound by a crash retry, a killed run's journal re-appended by
+its fresh-process resume (both runs replay the identical timeline),
+or overlapping ring windows therefore never produce duplicate
+entries — ``to_jsonl(append=True)`` plus :func:`from_jsonl` is the
+kill/restore merge path, and the matched span set is bit-identical
+to an uninterrupted run's (tests/test_incident.py).  Same-class
+injections landing on one round are disambiguated by a ``dup`` index
+in their metadata.
+
+The JSON-lines file (one entry per line, plus ``journal_meta`` lines
+carrying stream coverage) is the artifact scenario gates commit.
+
+Span matcher catalog & budget math: see :data:`RULES` and
+:func:`error_budgets`; surfaces: ``tools/incident_report.py``,
+``trace_export.py --ops``, ``scenarios.py --ops``, ``soak_report.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Mapping
+
+from partisan_tpu import telemetry
+
+# The ordering contract's stream ranks: injections (ground truth)
+# first, then execution evidence (chunk rows), then the detection
+# planes, then reactions (control/elastic actuation), then the
+# recovery/ops tail.  Unknown streams rank between control and ops.
+STREAM_RANK: dict[str, int] = {
+    "inject": 0, "chunk": 1, "membership": 2, "channel": 3,
+    "metrics": 4, "latency": 5, "health": 6, "broadcast": 7,
+    "traffic": 8, "control": 9, "elastic": 10, "ingress": 11,
+    "soak": 12, "perf": 13, "ops": 20,
+}
+_UNKNOWN_RANK = 15
+
+SEVERITIES = ("info", "warn", "error")
+
+# Journal-only synthesized event names (NOT bus events — the bus
+# registry is telemetry.EVENTS; these exist only as journal entries).
+# ``inject.*`` names are derived from action class names at runtime.
+OPS_EVENTS: dict[str, str] = {         # name -> severity
+    "chunk": "info",
+    "ops.slo_recovered": "info",
+    "ops.crowd_ended": "info",
+}
+
+# Injection severity by action class: faults file as warn, cures and
+# benign/operational actions as info.
+_INJECT_SEVERITY = {
+    "LinkDrop": "warn", "CrashBatch": "warn", "Partition": "warn",
+    "Churn": "warn", "Omission": "warn", "DirectedCut": "warn",
+    "Stragglers": "warn", "SetChurn": "warn",
+}
+
+_EVENT_SEVERITY = {".".join(name): spec.severity
+                   for name, spec in telemetry.EVENTS.items()}
+
+
+def severity_of(event: str) -> str:
+    """Severity for a journal event name: the telemetry registry for
+    ``partisan.*`` names, the OPS_EVENTS table for synthesized ones,
+    the action-class table for ``inject.*``; ``info`` otherwise."""
+    if event.startswith("inject."):
+        return _INJECT_SEVERITY.get(event.split(".", 1)[1], "info")
+    return _EVENT_SEVERITY.get(event) or OPS_EVENTS.get(event, "info")
+
+
+@dataclasses.dataclass
+class Entry:
+    """One timeline entry — the ``(round, stream, event, severity,
+    channel?, cause_id?)`` record of the module docstring."""
+
+    round: int
+    stream: str
+    event: str
+    severity: str = "info"
+    channel: str | None = None
+    cause_id: str | None = None
+    measurements: dict = dataclasses.field(default_factory=dict)
+    metadata: dict = dataclasses.field(default_factory=dict)
+    seq: int = 0
+
+    def key(self) -> tuple:
+        """The dedup identity (module docstring: Identity & dedup)."""
+        return (self.round, self.stream, self.event, self.channel,
+                self.metadata.get("node"), self.metadata.get("dup"))
+
+    def sort_key(self) -> tuple:
+        """The documented total order."""
+        return (self.round, STREAM_RANK.get(self.stream, _UNKNOWN_RANK),
+                self.event, self.channel or "", self.seq)
+
+    def to_json(self) -> dict:
+        return {"round": self.round, "stream": self.stream,
+                "event": self.event, "severity": self.severity,
+                "channel": self.channel, "cause_id": self.cause_id,
+                "seq": self.seq,
+                "measurements": _jsonable(self.measurements),
+                "metadata": _jsonable(self.metadata)}
+
+
+def _jsonable(v):
+    """Coerce numpy scalars/arrays (plane snapshots leak them into
+    poll dicts) into plain JSON types."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if hasattr(v, "item") and not isinstance(v, (str, bytes)):
+        try:
+            return v.item()
+        except (TypeError, ValueError):
+            return v.tolist()
+    return v
+
+
+@dataclasses.dataclass
+class Journal:
+    """The unified ops journal: an append-only, deduplicating entry
+    store plus the stream-coverage map the matcher's observability
+    classification reads (``streams[s]`` = the earliest round stream
+    ``s``'s source could have reported — ring-windowed planes only
+    attest their tail)."""
+
+    entries: list[Entry] = dataclasses.field(default_factory=list)
+    streams: dict[str, int] = dataclasses.field(default_factory=dict)
+    start: int | None = None
+    end: int | None = None
+
+    def __post_init__(self) -> None:
+        self._keys = {e.key() for e in self.entries}
+
+    # ---- building -----------------------------------------------------
+    def append(self, round: int, stream: str, event: str, *,
+               severity: str | None = None, channel: str | None = None,
+               cause_id: str | None = None,
+               measurements: Mapping | None = None,
+               metadata: Mapping | None = None) -> Entry | None:
+        """Append one entry; returns None (and keeps the first copy)
+        when an entry with the same identity is already journaled."""
+        e = Entry(round=int(round), stream=stream, event=event,
+                  severity=severity or severity_of(event),
+                  channel=channel, cause_id=cause_id,
+                  measurements=dict(measurements or {}),
+                  metadata=dict(metadata or {}),
+                  seq=len(self.entries))
+        k = e.key()
+        if k in self._keys:
+            return None
+        self._keys.add(k)
+        self.entries.append(e)
+        return e
+
+    def cover(self, stream: str, start: int) -> None:
+        """Record that ``stream``'s source covers rounds >= ``start``
+        (min-merged: coverage only ever widens)."""
+        cur = self.streams.get(stream)
+        self.streams[stream] = int(start) if cur is None \
+            else min(cur, int(start))
+
+    def bus_handler(self, *, default_round: int = -1) -> Callable:
+        """A ``telemetry.Bus`` handler that journals every event it
+        sees: stream = the event tuple's second element, severity from
+        the registry, channel/round lifted from the metadata."""
+        def handle(event, measurements, metadata):
+            name = ".".join(event)
+            rnd = metadata.get("round")
+            if rnd is None or int(rnd) < 0:
+                rnd = default_round
+            self.append(int(rnd), event[1] if len(event) > 1 else "bus",
+                        name, channel=metadata.get("channel"),
+                        measurements=measurements, metadata=metadata)
+        return handle
+
+    # ---- reading ------------------------------------------------------
+    def sorted_entries(self) -> list[Entry]:
+        return sorted(self.entries, key=Entry.sort_key)
+
+    def span_window(self) -> tuple[int, int]:
+        """(start, end) rounds the journal covers — recorded bounds
+        when known, else the entry extremes."""
+        if self.start is not None and self.end is not None:
+            return self.start, self.end
+        rounds = [e.round for e in self.entries if e.round >= 0]
+        lo = min(rounds) if rounds else 0
+        hi = max(rounds) if rounds else 0
+        return (self.start if self.start is not None else lo,
+                self.end if self.end is not None else hi)
+
+    # ---- persistence --------------------------------------------------
+    def to_jsonl(self, path, *, append: bool = True) -> int:
+        """Write the journal as JSON lines (one ``journal_meta`` line
+        plus one line per entry, in append order — the append-only
+        artifact).  Returns the number of entry lines written."""
+        mode = "a" if append else "w"
+        with open(path, mode) as fh:
+            fh.write(json.dumps({"journal_meta": {
+                "streams": self.streams, "start": self.start,
+                "end": self.end}}) + "\n")
+            for e in self.entries:
+                fh.write(json.dumps(e.to_json()) + "\n")
+        return len(self.entries)
+
+    @classmethod
+    def from_jsonl(cls, path) -> "Journal":
+        """Load (and MERGE) a journal file: meta lines union their
+        coverage maps (min per stream) and widen start/end; entry
+        lines dedup on identity, first copy wins — so a killed run's
+        journal with its resume's appended (see module docstring)
+        loads as one consistent timeline."""
+        j = cls()
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                meta = d.get("journal_meta")
+                if meta is not None:
+                    for s, lo in (meta.get("streams") or {}).items():
+                        j.cover(s, lo)
+                    if meta.get("start") is not None:
+                        j.start = meta["start"] if j.start is None \
+                            else min(j.start, meta["start"])
+                    if meta.get("end") is not None:
+                        j.end = meta["end"] if j.end is None \
+                            else max(j.end, meta["end"])
+                    continue
+                j.append(d["round"], d["stream"], d["event"],
+                         severity=d.get("severity"),
+                         channel=d.get("channel"),
+                         cause_id=d.get("cause_id"),
+                         measurements=d.get("measurements"),
+                         metadata=d.get("metadata"))
+        return j
+
+
+# ---------------------------------------------------------------------------
+# The fusion builder: one SoakResult (+ its storm) -> one Journal
+# ---------------------------------------------------------------------------
+
+def _inject_fields(action) -> tuple[dict, dict]:
+    """Split a timeline action's dataclass fields into journal
+    measurements (numeric) and metadata (everything else, stringified
+    when not JSON-native)."""
+    meas: dict = {}
+    meta: dict = {}
+    if dataclasses.is_dataclass(action):
+        for f in dataclasses.fields(action):
+            v = getattr(action, f.name)
+            if isinstance(v, bool):
+                meas[f.name] = int(v)
+            elif isinstance(v, (int, float)):
+                meas[f.name] = v
+            elif isinstance(v, str) or v is None:
+                meta[f.name] = v
+            elif isinstance(v, (tuple, list)):
+                meta[f.name] = [x if isinstance(x, (int, float, str))
+                                else repr(x) for x in v]
+            else:
+                meta[f.name] = type(v).__name__
+    return meas, meta
+
+
+def from_soak(res, *, storm=None, state=None, channels=None,
+              slo_rounds: int | None = None,
+              crowd_x1000: int | None = None,
+              start: int | None = None, end: int | None = None,
+              journal: Journal | None = None) -> Journal:
+    """Fuse one soak run into a :class:`Journal`: the storm's injected
+    ground truth, the chunk rows, every applicable ``telemetry.
+    replay_*`` stream read off the final state's rings (falling edges
+    on — the matcher's recovery markers), and the synthesized ``ops``
+    markers.  Pass an existing ``journal`` to merge (the kill/restore
+    append path).  ``state`` defaults to ``res.state``; ``start``/
+    ``end`` default to the run's own bounds."""
+    j = journal if journal is not None else Journal()
+    state = res.state if state is None else state
+    chunks = list(res.chunks)
+    if start is None:
+        start = getattr(res, "start", None)
+        if start is None:
+            start = chunks[0]["round"] if chunks else 0
+    if end is None:
+        end = (chunks[-1]["round"] + chunks[-1].get("k", 0)) if chunks \
+            else start + getattr(res, "rounds", 0)
+    j.start = start if j.start is None else min(j.start, start)
+    j.end = end if j.end is None else max(j.end, end)
+
+    # (1) injected ground truth — the storm timeline scanned over the
+    # run's absolute rounds (storms are pure in the absolute round, so
+    # a resumed run re-derives the identical entries).
+    j.cover("inject", start)
+    if storm is not None:
+        for r in range(int(start), int(end) + 1):
+            seen: dict[str, int] = {}
+            for action in storm.due(r):
+                name = f"inject.{type(action).__name__}"
+                dup = seen.get(name, 0)
+                seen[name] = dup + 1
+                meas, meta = _inject_fields(action)
+                if dup:
+                    meta["dup"] = dup
+                j.append(r, "inject", name,
+                         cause_id=f"{r}:{name}" + (f"#{dup}" if dup
+                                                   else ""),
+                         measurements=meas, metadata=meta)
+
+    # (2) chunk rows — execution evidence (timing in measurements,
+    # polls/digests in metadata).
+    j.cover("chunk", start)
+    _timing = ("k", "wall_s", "per_round_s", "rounds_per_s", "gap_s")
+    for row in chunks:
+        meas = {k: row[k] for k in _timing if k in row}
+        meta = {k: v for k, v in row.items()
+                if k not in _timing and k != "round"}
+        j.append(row["round"], "chunk", "chunk",
+                 measurements=meas, metadata=meta)
+    if any("traffic" in r for r in chunks):
+        j.cover("traffic", start)
+
+    # (3) the telemetry streams — one Bus, one journaling handler,
+    # every applicable adapter replayed in a fixed order (the seq
+    # tiebreak's determinism).  Ring-windowed planes cover only their
+    # window; the coverage map records how far back each attests.
+    bus = telemetry.Bus()
+    bus.attach("opslog", ("partisan",),
+               j.bus_handler(default_round=int(end)))
+    if getattr(state, "metrics", ()) != ():
+        from partisan_tpu import metrics as metrics_mod
+
+        snap = metrics_mod.snapshot(state.metrics)
+        rounds = snap.get("rounds")
+        j.cover("metrics", int(min(rounds)) if len(rounds) else end)
+        telemetry.replay_metrics_events(bus, snap, falling=True)
+    if getattr(state, "health", ()) != ():
+        from partisan_tpu import health as health_mod
+
+        snap = health_mod.snapshot(state.health)
+        rounds = snap.get("rounds")
+        j.cover("health", int(min(rounds)) if len(rounds) else end)
+        telemetry.replay_health_events(bus, snap, falling=True)
+    if getattr(state, "provenance", ()) != ():
+        from partisan_tpu import provenance as prov_mod
+
+        snap = prov_mod.snapshot(state.provenance)
+        rounds = snap.get("rounds")
+        j.cover("broadcast", int(min(rounds)) if len(rounds) else end)
+        telemetry.replay_broadcast_events(bus, snap)
+    if getattr(state, "control", ()) != ():
+        from partisan_tpu import control as control_mod
+
+        snap = control_mod.snapshot(state.control)
+        lows = [int(min(sub["rounds"])) for sub in snap.values()
+                if len(sub.get("rounds", ()))]
+        j.cover("control", min(lows) if lows else end)
+        telemetry.replay_control_events(bus, snap, channels=channels)
+    if getattr(state, "elastic", ()) != ():
+        from partisan_tpu import elastic as elastic_mod
+
+        snap = elastic_mod.snapshot(state.elastic)
+        rounds = [int(r) for r in snap.get("rounds", ()) if int(r) >= 0]
+        j.cover("elastic", min(rounds) if rounds else end)
+        telemetry.replay_elastic_events(bus, snap)
+    telemetry.replay_traffic_events(bus, chunks, slo_rounds=slo_rounds,
+                                    crowd_x1000=crowd_x1000)
+    j.cover("soak", start)
+    telemetry.replay_soak_events(bus, res.log)
+    if any(e.get("kind") == "ingress_drain" for e in res.log):
+        j.cover("ingress", start)
+        telemetry.replay_ingress_events(bus, res.log)
+    if getattr(state, "latency", ()) != () and slo_rounds is not None:
+        from partisan_tpu import latency as latency_mod
+
+        j.cover("latency", start)
+        telemetry.replay_latency_events(
+            bus, latency_mod.snapshot(state.latency),
+            slo_rounds=slo_rounds, channels=channels, rnd=int(end))
+    if len(chunks) >= 2:
+        from partisan_tpu import perfwatch
+
+        j.cover("perf", start)
+        telemetry.replay_perf_events(
+            bus, dispatch=perfwatch.decompose_chunks(chunks),
+            rnd=int(end))
+    bus.detach("opslog")
+
+    # (4) synthesized ops markers — recovery edges derived from
+    # window-shaped signals.
+    j.cover("ops", start)
+    for e in list(j.entries):
+        if e.event == "partisan.traffic.slo_breach_window":
+            j.append(int(e.metadata.get("end_round", e.round)), "ops",
+                     "ops.slo_recovered", channel=e.channel,
+                     measurements={"worst_p99": e.measurements.get(
+                         "worst_p99")},
+                     metadata={"window_start": e.round})
+    from partisan_tpu import workload as workload_mod
+
+    for w in workload_mod.crowd_windows(chunks, crowd_x1000=crowd_x1000):
+        if w["end"] is not None:
+            j.append(w["end"], "ops", "ops.crowd_ended",
+                     measurements={"peak_x1000": w["peak_x1000"]},
+                     metadata={"window_start": w["start"]})
+    return j
+
+
+# ---------------------------------------------------------------------------
+# The incident-span matcher
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One cause->detection->reaction->recovery pattern.  ``detect``/
+    ``react``/``recover`` are tuples of event names or ``(name,
+    predicate)`` pairs (predicate: ``fn(entry, ctx) -> bool``).
+    ``requires`` is an any-of tuple of streams whose coverage decides
+    observability (a Partition on a run with no health OR metrics
+    plane is unobservable, not undetected).  ``react`` is always
+    optional — a controller-less run closes spans without one.
+    ``recover_last`` picks the LAST recovery candidate in the window
+    (flash crowds: the p99 is recovered when the last breach window
+    closed, not the first)."""
+
+    name: str
+    cause: str
+    detect: tuple = ()
+    react: tuple = ()
+    recover: tuple = ()
+    requires: tuple = ()
+    cause_pred: Callable | None = None
+    recover_last: bool = False
+
+
+def _downs(e, ctx):
+    return e.measurements.get("downs", 0) > 0 \
+        or e.measurements.get("leaves", 0) > 0
+
+
+def _ups(e, ctx):
+    return e.measurements.get("ups", 0) > 0 \
+        or e.measurements.get("joins", 0) > 0
+
+
+def _churn_on(e, ctx):
+    return e.measurements.get("x1e6", 0) > 0
+
+
+def _crowd_rate(e, ctx):
+    return e.measurements.get("x1000", 0) >= ctx.get(
+        "crowd_x1000", float("inf"))
+
+
+def _link_on(e, ctx):
+    return e.measurements.get("p", 0) > 0
+
+
+def _escalate(e, ctx):
+    return e.metadata.get("direction") == "escalate"
+
+
+# The span matcher catalog (ARCHITECTURE.md "Ops journal & incident
+# observatory" documents each chain).  Every fault-class injection the
+# scenarios fire has a rule; cures (Heal, SetRate-to-base, SetChurn-0,
+# Stragglers-0) and escape hatches (Script, Omission, DirectedCut,
+# Stragglers) are benign — they are either recovery ground truth or
+# have no plane that attests them yet.
+RULES: tuple[Rule, ...] = (
+    Rule("partition", cause="inject.Partition",
+         detect=("partisan.health.partition_detected",
+                 "partisan.metrics.partition_detected"),
+         react=(("partisan.control.healing_escalated", _escalate),),
+         recover=("partisan.health.overlay_healed",
+                  "partisan.metrics.partition_cleared"),
+         requires=("health", "metrics")),
+    Rule("crash", cause="inject.CrashBatch",
+         detect=(("partisan.health.churn", _downs),
+                 "partisan.health.partition_detected"),
+         react=(("partisan.control.healing_escalated", _escalate),),
+         recover=(("partisan.health.churn", _ups),
+                  "partisan.health.overlay_healed"),
+         requires=("health",)),
+    Rule("churn", cause="inject.Churn",
+         detect=("partisan.health.churn",),
+         recover=(("partisan.health.churn", _ups),
+                  "partisan.health.churn_settled"),
+         requires=("health",)),
+    Rule("churn_pulse", cause="inject.SetChurn", cause_pred=_churn_on,
+         detect=("partisan.health.churn",),
+         recover=("partisan.health.churn_settled",),
+         requires=("health",)),
+    Rule("link_drop", cause="inject.LinkDrop", cause_pred=_link_on,
+         detect=("partisan.metrics.drop_spike",
+                 "partisan.metrics.shed_spike"),
+         recover=("partisan.metrics.drop_cleared",
+                  "partisan.metrics.shed_cleared"),
+         requires=("metrics",)),
+    Rule("flash_crowd", cause="inject.SetRate", cause_pred=_crowd_rate,
+         detect=("partisan.traffic.flash_crowd",),
+         react=("partisan.control.shed_threshold_changed",),
+         recover=("ops.slo_recovered", "ops.crowd_ended"),
+         requires=("traffic",), recover_last=True),
+    Rule("scale_out", cause="inject.ScaleOut",
+         detect=("partisan.elastic.scale_out",),
+         recover=("partisan.elastic.scale_out",),
+         requires=("elastic",)),
+    Rule("scale_in", cause="inject.ScaleIn",
+         detect=("partisan.elastic.scale_in",),
+         recover=("partisan.elastic.scale_in",),
+         requires=("elastic",)),
+)
+
+
+def _candidates(entries, names, ctx):
+    """Entries matching a rule's candidate tuple, in timeline order."""
+    specs = [(n, None) if isinstance(n, str) else (n[0], n[1])
+             for n in names]
+    out = []
+    for e in entries:
+        for name, pred in specs:
+            if e.event == name and (pred is None or pred(e, ctx)):
+                out.append(e)
+                break
+    return out
+
+
+def match(journal: Journal, rules: tuple = RULES, *,
+          crowd_x1000: int | None = None) -> dict:
+    """Match incident spans over the journal (module docstring).
+
+    Per rule: cause instances are FOLDED into one incident when no
+    recovery candidate separates them (two churn pulses with nothing
+    settled in between are one incident), then each incident claims —
+    in timeline order, pointers never rewind — its first detection,
+    its first reaction at-or-after the detection, and its first (or
+    last, ``recover_last``) recovery at-or-after the detection, all
+    before the next incident of the same rule.  Statuses: ``closed``
+    (detected + recovered), ``open`` (detected, never recovered),
+    ``undetected`` (no plane event claimed — THE gate failure),
+    ``unobservable`` (every stream that could attest it is off or its
+    ring window starts after the cause — reported, not gated).
+
+    Also reports *orphan reactions*: controller moves no span claimed.
+
+    Returns ``{"spans": [...], "orphans": [...], "counts": {...}}``.
+    """
+    entries = journal.sorted_entries()
+    order = {id(e): i for i, e in enumerate(entries)}
+    _, jend = journal.span_window()
+    ctx: dict[str, Any] = {}
+    if crowd_x1000 is not None:
+        ctx["crowd_x1000"] = crowd_x1000
+    else:
+        for e in entries:
+            if e.stream == "chunk" and "traffic" in e.metadata:
+                base = int(e.metadata["traffic"].get("rate_x1000", 0))
+                ctx["crowd_x1000"] = 2 * max(base, 1)
+                break
+    spans: list[dict] = []
+    claimed_react: set[int] = set()
+    react_pool: dict[int, Entry] = {}
+    for rule in rules:
+        for e in _candidates(entries, rule.react, ctx):
+            react_pool[id(e)] = e
+        causes = [e for e in entries
+                  if e.stream == "inject" and e.event == rule.cause
+                  and (rule.cause_pred is None
+                       or rule.cause_pred(e, ctx))]
+        if not causes:
+            continue
+        detect_c = _candidates(entries, rule.detect, ctx)
+        react_c = _candidates(entries, rule.react, ctx)
+        recover_c = _candidates(entries, rule.recover, ctx)
+        # fold causes separated by no recovery candidate
+        groups: list[list[Entry]] = []
+        for c in causes:
+            if groups and not any(
+                    groups[-1][-1].round <= rc.round < c.round
+                    for rc in recover_c):
+                groups[-1].append(c)
+            else:
+                groups.append([c])
+        di = ri = vi = 0
+        for gi, group in enumerate(groups):
+            cause = group[0]
+            window_end = groups[gi + 1][0].round if gi + 1 < len(groups) \
+                else jend + 1
+            span = {"kind": "ops_span", "rule": rule.name,
+                    "cause": cause.event, "cause_round": cause.round,
+                    "cause_id": cause.cause_id,
+                    "causes_folded": len(group),
+                    "detect_round": None, "detect_event": None,
+                    "react_round": None, "react_event": None,
+                    "recover_round": None, "recover_event": None,
+                    "detect_latency": None, "react_latency": None,
+                    "recover_latency": None, "channel": None,
+                    "status": "undetected"}
+            observable = not rule.requires or any(
+                journal.streams.get(s, jend + 1) <= cause.round
+                for s in rule.requires)
+            if not observable:
+                span["status"] = "unobservable"
+                spans.append(span)
+                continue
+            while di < len(detect_c) and detect_c[di].round < cause.round:
+                di += 1
+            det = None
+            if di < len(detect_c) and detect_c[di].round < window_end:
+                det = detect_c[di]
+                di += 1
+            if det is None:
+                spans.append(span)
+                continue
+            span.update(detect_round=det.round, detect_event=det.event,
+                        detect_latency=det.round - cause.round,
+                        channel=det.channel, status="open")
+            while vi < len(recover_c) \
+                    and order[id(recover_c[vi])] < order[id(det)]:
+                vi += 1
+            rec = None
+            while vi < len(recover_c) \
+                    and recover_c[vi].round < window_end:
+                rec = recover_c[vi]
+                vi += 1
+                if not rule.recover_last:
+                    break
+            # a reaction belongs to the incident interval: at or after
+            # detection, before the window closes, and (once recovered)
+            # no later than the recovery itself
+            while ri < len(react_c) and react_c[ri].round < det.round:
+                ri += 1
+            if ri < len(react_c) and react_c[ri].round < window_end \
+                    and (rec is None or react_c[ri].round <= rec.round):
+                rea = react_c[ri]
+                ri += 1
+                claimed_react.add(id(rea))
+                span.update(react_round=rea.round,
+                            react_event=rea.event,
+                            react_latency=rea.round - det.round)
+            if rec is not None:
+                span.update(recover_round=rec.round,
+                            recover_event=rec.event,
+                            recover_latency=rec.round - cause.round,
+                            status="closed")
+            spans.append(span)
+    orphans = [{"kind": "ops_orphan", "event": e.event,
+                "round": e.round, "channel": e.channel}
+               for i, e in sorted(react_pool.items(),
+                                  key=lambda kv: order[kv[0]])
+               if i not in claimed_react]
+    counts = {"spans": len(spans)}
+    for st in ("closed", "open", "undetected", "unobservable"):
+        counts[st] = sum(1 for s in spans if s["status"] == st)
+    counts["orphans"] = len(orphans)
+    spans.sort(key=lambda s: (s["cause_round"], s["rule"]))
+    return {"spans": spans, "orphans": orphans, "counts": counts}
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets
+# ---------------------------------------------------------------------------
+
+def error_budgets(journal: Journal, *, slo_rounds: int,
+                  budget_frac: float = 0.25,
+                  channels: tuple[str, ...] | None = None) -> list[dict]:
+    """Per-channel burn-rate accounting over the windowed latency
+    polls (the chunk entries' ``p99`` series, ``SoakConfig.
+    poll_latency``).  Budget math: a channel's error budget is
+    ``budget_frac`` of its polled rounds; every chunk whose windowed
+    p99 EXCEEDS ``slo_rounds`` burns its ``k`` rounds; ``burn`` is
+    rounds-burned over budget (>= 1.0 means exhausted) and
+    ``exhausted_round`` the start round of the chunk that crossed the
+    line (``None`` while budget remains).  The breach accounting
+    itself is ``latency.breach_accounting`` — one SLO semantic shared
+    with every other gate."""
+    from partisan_tpu import latency as latency_mod
+
+    rows = [(e.round, int(e.measurements.get("k", 0)),
+             e.metadata.get("p99"))
+            for e in journal.sorted_entries() if e.stream == "chunk"]
+    rows = [r for r in rows if r[2]]
+    acct = latency_mod.breach_accounting(rows, slo_rounds=slo_rounds,
+                                         channels=channels)
+    out = []
+    for ch in sorted(acct):
+        series = acct[ch]
+        total = sum(k for _, k, _ in series)
+        budget = budget_frac * total
+        burned = 0
+        exhausted_round = None
+        for rnd, k, breached in series:
+            if breached:
+                burned += k
+                if exhausted_round is None and burned > budget:
+                    exhausted_round = rnd
+        out.append({"kind": "ops_budget", "channel": ch,
+                    "rounds": total, "breach_rounds": burned,
+                    "budget_rounds": round(budget, 2),
+                    "burn": round(burned / budget, 4) if budget
+                    else (0.0 if not burned else float("inf")),
+                    "exhausted_round": exhausted_round})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+def gate(matched: dict, budgets=None, *, exempt: tuple = ()) -> dict:
+    """The scenario/CI verdict: every observable incident must CLOSE
+    (no open spans, no undetected causes) and no non-exempt channel's
+    error budget may be exhausted.  Orphan reactions and unobservable
+    causes are reported, not gated."""
+    counts = matched["counts"]
+    exhausted = [b["channel"] for b in budgets or ()
+                 if b["exhausted_round"] is not None
+                 and b["channel"] not in exempt]
+    ok = counts["open"] == 0 and counts["undetected"] == 0 \
+        and not exhausted
+    return {"kind": "ops_gate", "ok": ok, "open": counts["open"],
+            "undetected": counts["undetected"],
+            "unobservable": counts["unobservable"],
+            "closed": counts["closed"], "orphans": counts["orphans"],
+            "budget_exhausted": exhausted}

@@ -48,6 +48,50 @@ HEALTH = False
 # pair + dissemination-forest/redundancy accumulation in the carry;
 # redundancy ratio / tree depth / coverage round emitted to stderr.
 PROVENANCE = False
+# Ops-journal opt-in (--ops): soak-engine scenarios (configs 7/9 and
+# the traffic suite) fuse their run into the unified ops journal
+# (opslog.py), print the matched detect->react->recover incident spans
+# to stderr as JSON lines, and fold the span gate — every observable
+# injected fault must CLOSE — into their pass verdicts.
+OPS = False
+# --ops-out PATH: also commit the journal artifact (JSON lines,
+# opslog.Journal.to_jsonl) so tools/incident_report.py --gate can
+# re-judge it offline; the config label is suffixed before the
+# extension when several scenarios write in one invocation.
+OPS_OUT = None
+
+
+def _emit_ops(res, storm, label, *, channels=None, slo_rounds=None,
+              crowd_x1000=None) -> dict:
+    """Fuse a soak run into the ops journal, print its incident spans
+    (+ orphan reactions, error budgets, gate verdict) to stderr as
+    JSON lines, optionally commit the journal artifact (OPS_OUT), and
+    return the counts+verdict dict scenario gates fold in."""
+    import json
+    import sys
+
+    from partisan_tpu import opslog
+
+    journal = opslog.from_soak(res, storm=storm, channels=channels,
+                               slo_rounds=slo_rounds,
+                               crowd_x1000=crowd_x1000)
+    matched = opslog.match(journal, crowd_x1000=crowd_x1000)
+    for span in matched["spans"]:
+        print(json.dumps({"config": label, **span}), file=sys.stderr)
+    for orphan in matched["orphans"]:
+        print(json.dumps({"config": label, **orphan}), file=sys.stderr)
+    if slo_rounds is not None:
+        # budgets print for the record; the scenario verdict gates on
+        # spans only (incident_report.py --slo-rounds gates budgets)
+        for row in opslog.error_budgets(journal, slo_rounds=slo_rounds):
+            print(json.dumps({"config": label, **row}), file=sys.stderr)
+    verdict = opslog.gate(matched)
+    print(json.dumps({"config": label, **verdict}), file=sys.stderr)
+    if OPS_OUT:
+        root, ext = _os.path.splitext(OPS_OUT)
+        journal.to_jsonl(f"{root}.{label}{ext or '.jsonl'}"
+                         if label is not None else OPS_OUT)
+    return {**matched["counts"], "ok": verdict["ok"]}
 
 
 def _metrics_cfg(cfg):
@@ -986,14 +1030,18 @@ def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200):
               file=_sys.stderr)
     _emit_metrics(cl.cfg, res.state, 7)
     digest = health_mod.digest(res.state)
-    return {"config": 7, "n": n, "rounds": res.rounds,
-            "chunks": len(res.chunks), "programs": res.programs,
-            "retries": res.retries, "breaches": res.breaches,
-            "storm_period": p,
-            "wall_s": round(wall, 1),
-            "rounds_per_sec": round(res.rounds / max(wall, 1e-9), 1),
-            "components": health_mod.digest_components(digest),
-            "healthy": health_mod.healthy(digest)}
+    out = {"config": 7, "n": n, "rounds": res.rounds,
+           "chunks": len(res.chunks), "programs": res.programs,
+           "retries": res.retries, "breaches": res.breaches,
+           "storm_period": p,
+           "wall_s": round(wall, 1),
+           "rounds_per_sec": round(res.rounds / max(wall, 1e-9), 1),
+           "components": health_mod.digest_components(digest),
+           "healthy": health_mod.healthy(digest)}
+    if OPS:
+        out["ops"] = _emit_ops(
+            res, storm, 7, channels=tuple(c.name for c in cl.cfg.channels))
+    return out
 
 
 def config8_overload(n=96, waves=10, wave_len=12, adaptive=True,
@@ -1178,6 +1226,11 @@ def config9_elastic(n=8192, seed=7, drain=3 * K_PROG, bound=8,
         from partisan_tpu import ingress as ingress_mod
 
         out["ingress"] = ingress_mod.poll(res.state.ingress)
+    if OPS:
+        out["ops"] = _emit_ops(res, storm, 9, channels=names,
+                               slo_rounds=bound,
+                               crowd_x1000=crowd_rate)
+        out["pass"] = bool(out["pass"] and out["ops"]["ok"])
     return out
 
 
@@ -1943,6 +1996,10 @@ def traffic_scenario(model_name: str, n: int = 64, rounds: int = 240,
         "app_ok": bool(app_ok), "app": app_info,
         "wall_s": round(wall, 1),
     }
+    if OPS:
+        out["ops"] = _emit_ops(res, storm, f"traffic_{model_name}",
+                               channels=names, slo_rounds=bound,
+                               crowd_x1000=crowd_x1000)
     if px:
         # Broadcast-under-load gate (ROADMAP item 3 remaining): the
         # scheduled plumtree broadcasts' dissemination, judged in the
@@ -2034,7 +2091,8 @@ def traffic_slo(scale: float = 1.0, bound: int = TRAFFIC_SLO_BOUND) -> dict:
         ok = (adaptive["control_ok"] and adaptive["app_ok"]
               and adaptive["breaches"] == 0
               and adaptive.get("overlay_ok", True)
-              and adaptive.get("broadcast_ok", True))
+              and adaptive.get("broadcast_ok", True)
+              and adaptive.get("ops", {}).get("ok", True))
         entry["ok"] = bool(ok)
         all_ok = all_ok and ok
         if name in TRAFFIC_AB_MODELS:
@@ -2150,10 +2208,12 @@ def _run_cli(args):
         print(json.dumps(out9), flush=True)
         raise SystemExit(0 if out9["pass"] else 1)
     if args.soak:
-        print(json.dumps(config7_soak(
+        out7 = config7_soak(
             n=max(64, int(DEFAULT_SIZES[7] * args.scale)),
-            rounds=args.soak_rounds, ckpt_dir=args.ckpt_dir)),
-            flush=True)
+            rounds=args.soak_rounds, ckpt_dir=args.ckpt_dir)
+        print(json.dumps(out7), flush=True)
+        if not out7.get("ops", {}).get("ok", True):
+            raise SystemExit(1)
     else:
         for r in run_all(scale=args.scale, only=args.only):
             print(json.dumps(r), flush=True)
@@ -2231,6 +2291,19 @@ if __name__ == "__main__":
                          "backpressure p99, healing rounds-to-heal, "
                          "calm no-regression) and print the comparison "
                          "object (the committed CONTROL_AB.json)")
+    ap.add_argument("--ops", action="store_true",
+                    help="fuse each soak-engine run (configs 7/9, the "
+                         "traffic suite) into the unified ops journal "
+                         "(opslog.py), print the matched detect->"
+                         "react->recover incident spans + error "
+                         "budgets + gate verdict to stderr as JSON "
+                         "lines, and fold the span gate into the "
+                         "scenario's pass verdict / exit status")
+    ap.add_argument("--ops-out", default=None, metavar="PATH",
+                    help="with --ops: also write the journal artifact "
+                         "(opslog JSON lines; the config label is "
+                         "suffixed before the extension) for "
+                         "tools/incident_report.py --gate")
     ap.add_argument("--perf", action="store_true",
                     help="capture a jax.profiler trace of the run and "
                          "emit the measured per-phase device-time "
@@ -2243,6 +2316,8 @@ if __name__ == "__main__":
     LATENCY = LATENCY or args.latency
     HEALTH = HEALTH or args.health
     PROVENANCE = PROVENANCE or args.provenance
+    OPS = OPS or args.ops
+    OPS_OUT = OPS_OUT or args.ops_out
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/partisan_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -483,6 +483,9 @@ class SoakResult:
     retries: int
     breaches: int
     programs: int                 # distinct chunk lengths executed
+    start: int = 0                # absolute round the run entered at —
+    #   the opslog journal's injection-scan anchor (a resumed run's
+    #   start is its restore round, not the storm's round 0)
 
     def healthy(self) -> bool:
         return self.breaches == 0
@@ -930,7 +933,7 @@ class Soak:
             self._checkpoint(state, r)
         return SoakResult(state=state, rounds=r - start, chunks=chunks,
                           log=log, retries=retries, breaches=breaches,
-                          programs=len(lengths))
+                          programs=len(lengths), start=start)
 
 
 # ---------------------------------------------------------------------------

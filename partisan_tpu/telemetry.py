@@ -28,9 +28,14 @@ CHANNEL_CONFIGURED = ("partisan", "channel", "configured")
 
 # Metrics-plane threshold events (metrics.py ring -> discrete events;
 # the sim extension of the reference catalog — same bus, same shape).
+# The ``*_cleared`` falling edges are opt-in (``falling=True``): the
+# incident matcher's recovery markers for sustained spikes.
 METRICS_SHED_SPIKE = ("partisan", "metrics", "shed_spike")
 METRICS_DROP_SPIKE = ("partisan", "metrics", "drop_spike")
 METRICS_PARTITION = ("partisan", "metrics", "partition_detected")
+METRICS_SHED_CLEARED = ("partisan", "metrics", "shed_cleared")
+METRICS_DROP_CLEARED = ("partisan", "metrics", "drop_cleared")
+METRICS_PARTITION_CLEARED = ("partisan", "metrics", "partition_cleared")
 
 # Latency-plane SLO events (latency.py histograms -> discrete events).
 LATENCY_SLO_BREACH = ("partisan", "latency", "slo_breach")
@@ -41,6 +46,7 @@ LATENCY_SLO_BREACH = ("partisan", "latency", "slo_breach")
 HEALTH_PARTITION = ("partisan", "health", "partition_detected")
 HEALTH_HEALED = ("partisan", "health", "overlay_healed")
 HEALTH_CHURN = ("partisan", "health", "churn")
+HEALTH_CHURN_SETTLED = ("partisan", "health", "churn_settled")
 
 # Provenance-plane broadcast events (provenance.py rings -> discrete
 # events): redundant-duplicate spikes, graft storms and their repair.
@@ -89,6 +95,111 @@ INGRESS_SHED = ("partisan", "ingress", "shed")
 PERF_DISPATCH_WALL = ("partisan", "perf", "dispatch_wall")
 PERF_PHASE_OUTLIER = ("partisan", "perf", "phase_outlier")
 PERF_REGRESSION = ("partisan", "perf", "regression")
+
+
+# ---------------------------------------------------------------------------
+# The event-name registry: ONE catalog of every ``partisan.*`` event,
+# its severity, and the measurement/metadata fields an emission must
+# carry.  Every adapter in this module emits through :func:`emit`,
+# which refuses unregistered names and missing required fields — the
+# sync guard tests/test_opslog.py pins additionally fails on any
+# ad-hoc ("partisan", ...) literal elsewhere in the tree.  The opslog
+# journal reads severities from here, so a new event is registered
+# once and every surface (bus, journal, incident report, Perfetto
+# export) picks it up.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class EventSpec:
+    """Registry row: the event name tuple, the severity the opslog
+    journal files it under, and the REQUIRED measurement/metadata
+    keys (emissions may carry more; they may not carry less)."""
+
+    name: tuple
+    severity: str = "info"        # "info" | "warn" | "error"
+    measurements: tuple = ()
+    metadata: tuple = ()
+
+
+EVENTS: dict[tuple, EventSpec] = {spec.name: spec for spec in (
+    EventSpec(PEER_JOIN, "info", ("count",), ("node", "round")),
+    EventSpec(PEER_LEAVE, "warn", ("count",), ("node", "round")),
+    EventSpec(PEER_UP, "info", ("count",), ("node", "round")),
+    EventSpec(PEER_DOWN, "warn", ("count",), ("node", "round")),
+    EventSpec(CHANNEL_CONFIGURED, "info", ("parallelism",),
+              ("channel", "monotonic")),
+    EventSpec(METRICS_SHED_SPIKE, "warn", ("shed",), ("round",)),
+    EventSpec(METRICS_DROP_SPIKE, "warn", ("dropped",), ("round",)),
+    EventSpec(METRICS_PARTITION, "error", ("edges_min", "alive"),
+              ("round",)),
+    EventSpec(METRICS_SHED_CLEARED, "info", ("shed",), ("round",)),
+    EventSpec(METRICS_DROP_CLEARED, "info", ("dropped",), ("round",)),
+    EventSpec(METRICS_PARTITION_CLEARED, "info", ("edges_min",),
+              ("round",)),
+    EventSpec(LATENCY_SLO_BREACH, "warn",
+              ("age_rounds", "count", "max_age_rounds"),
+              ("channel", "quantile", "slo_rounds")),
+    EventSpec(HEALTH_PARTITION, "error", ("components", "isolated"),
+              ("round",)),
+    EventSpec(HEALTH_HEALED, "info", ("components",), ("round",)),
+    EventSpec(HEALTH_CHURN, "warn", ("joins", "leaves", "ups", "downs"),
+              ("round",)),
+    EventSpec(HEALTH_CHURN_SETTLED, "info", ("quiet",), ("round",)),
+    EventSpec(BROADCAST_REDUNDANCY, "warn",
+              ("duplicates", "gossip", "ratio"), ("round",)),
+    EventSpec(BROADCAST_GRAFT_STORM, "warn", ("grafts",), ("round",)),
+    EventSpec(BROADCAST_TREE_REPAIRED, "info", ("storm_rounds",),
+              ("round",)),
+    EventSpec(CONTROL_FANOUT_ADJUSTED, "info", ("cap", "prev"),
+              ("round",)),
+    EventSpec(CONTROL_SHED_CHANGED, "info", ("press", "prev"),
+              ("round", "channel")),
+    EventSpec(CONTROL_HEALING, "info", ("boost", "prev"),
+              ("round", "direction")),
+    EventSpec(TRAFFIC_FLASH_CROWD, "warn", ("rate_x1000", "sent"),
+              ("round",)),
+    EventSpec(TRAFFIC_SLO_BREACH_WINDOW, "warn", ("worst_p99", "chunks"),
+              ("round", "end_round", "channel", "slo_rounds")),
+    EventSpec(SOAK_CHUNK_RETRY, "warn", (), ("round",)),
+    EventSpec(SOAK_CHECKPOINT_RESTORED, "warn", (), ("round",)),
+    EventSpec(SOAK_INVARIANT_BREACH, "error", (), ("round",)),
+    EventSpec(ELASTIC_SCALE_OUT, "info", ("n_active",),
+              ("round", "from")),
+    EventSpec(ELASTIC_SCALE_IN, "info", ("n_active",),
+              ("round", "from")),
+    EventSpec(INGRESS_DRAIN, "info", ("staged",), ("round",)),
+    EventSpec(INGRESS_SHED, "warn",
+              ("shed_buffer_full", "shed_invalid", "deferred"),
+              ("round",)),
+    EventSpec(PERF_DISPATCH_WALL, "info",
+              ("in_execution_s", "gap_s", "gap_share"), ("chunks",)),
+    EventSpec(PERF_PHASE_OUTLIER, "warn",
+              ("measured_ms", "predicted_bytes", "time_share"),
+              ("phase",)),
+    EventSpec(PERF_REGRESSION, "error", ("rounds_per_sec", "delta_pct"),
+              ()),
+)}
+
+
+def emit(bus: "Bus", event: tuple, measurements: Mapping[str, Any],
+         metadata: Mapping[str, Any] | None = None) -> None:
+    """The registry-checked emission path every adapter in this module
+    uses: refuses an unregistered event name or an emission missing
+    the spec's required fields, then forwards to ``bus.execute``."""
+    spec = EVENTS.get(tuple(event))
+    if spec is None:
+        raise ValueError(
+            f"unregistered telemetry event {tuple(event)!r} — add an "
+            f"EventSpec to telemetry.EVENTS (the registry is the only "
+            f"emission path)")
+    missing = [k for k in spec.measurements if k not in measurements]
+    missing += [k for k in spec.metadata if k not in (metadata or {})]
+    if missing:
+        raise ValueError(
+            f"event {tuple(event)!r} emitted without required "
+            f"field(s) {missing} (see telemetry.EVENTS)")
+    bus.execute(event, measurements, metadata)
+
 
 Handler = Callable[[tuple, Mapping[str, Any], Mapping[str, Any]], None]
 
@@ -140,23 +251,24 @@ def emit_membership_events(bus: Bus, cfg, manager, prev_state, state,
     after = np.asarray(manager.members(cfg, state.manager))[observer]
     rnd = int(state.rnd)
     for node in np.flatnonzero(~before & after):
-        bus.execute(PEER_JOIN, {"count": 1},
-                    {"node": int(node), "round": rnd})
+        emit(bus, PEER_JOIN, {"count": 1},
+             {"node": int(node), "round": rnd})
     for node in np.flatnonzero(before & ~after):
-        bus.execute(PEER_LEAVE, {"count": 1},
-                    {"node": int(node), "round": rnd})
+        emit(bus, PEER_LEAVE, {"count": 1},
+             {"node": int(node), "round": rnd})
     palive = np.asarray(prev_state.faults.alive)
     alive = np.asarray(state.faults.alive)
     for node in np.flatnonzero(~palive & alive):
-        bus.execute(PEER_UP, {"count": 1}, {"node": int(node), "round": rnd})
+        emit(bus, PEER_UP, {"count": 1}, {"node": int(node), "round": rnd})
     for node in np.flatnonzero(palive & ~alive):
-        bus.execute(PEER_DOWN, {"count": 1},
-                    {"node": int(node), "round": rnd})
+        emit(bus, PEER_DOWN, {"count": 1},
+             {"node": int(node), "round": rnd})
 
 
 def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
                           shed_threshold: int = 1,
-                          drop_threshold: int = 1) -> int:
+                          drop_threshold: int = 1,
+                          falling: bool = False) -> int:
     """Replay a metrics snapshot (``metrics.snapshot``) as discrete
     threshold-crossing events through the bus — the host-side adapter
     from the device-resident counter ring to the reference's
@@ -177,6 +289,11 @@ def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
       (edges_min > 0) — nodes that have not yet JOINED also have zero
       out-edges, and a cold bootstrap is not a partition.
 
+    With ``falling=True`` the matching ``*_cleared`` falling edges are
+    emitted too (first round back below the threshold after a hot run)
+    — the opslog matcher's recovery markers; off by default so the
+    adapter's historical event counts are unchanged.
+
     Returns the number of events emitted."""
     shed = np.asarray(snap["shed"])
     drops = np.asarray(snap["drops"]).sum(axis=1)
@@ -195,6 +312,9 @@ def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
     isolated = (edges_min == 0) & (np.asarray(snap["alive"]) > 1) \
         & was_connected
     n_events = 0
+    cleared = {METRICS_SHED_SPIKE: METRICS_SHED_CLEARED,
+               METRICS_DROP_SPIKE: METRICS_DROP_CLEARED,
+               METRICS_PARTITION: METRICS_PARTITION_CLEARED}
     prev = {"shed": False, "drop": False, "part": False}
     for i, rnd in enumerate(rounds):
         for key, hot, event, meas in (
@@ -207,7 +327,10 @@ def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
                  {"edges_min": int(snap["edges_min"][i]),
                   "alive": int(snap["alive"][i])})):
             if hot and not prev[key]:
-                bus.execute(event, meas, {"round": int(rnd)})
+                emit(bus, event, meas, {"round": int(rnd)})
+                n_events += 1
+            elif falling and prev[key] and not hot:
+                emit(bus, cleared[event], meas, {"round": int(rnd)})
                 n_events += 1
             prev[key] = hot
     return n_events
@@ -215,13 +338,18 @@ def replay_metrics_events(bus: Bus, snap: Mapping[str, Any], *,
 
 def replay_latency_events(bus: Bus, lat_snap: Mapping[str, Any], *,
                           slo_rounds: int, quantile: float = 0.99,
-                          channels: tuple[str, ...] | None = None) -> int:
+                          channels: tuple[str, ...] | None = None,
+                          rnd: int | None = None) -> int:
     """Replay a latency snapshot (``latency.snapshot`` /
     ``latency.percentiles`` input) as SLO threshold-crossing events:
     one ``partisan.latency.slo_breach`` per channel whose ``quantile``
     delivery age is at or above ``slo_rounds`` rounds — the host-side
     adapter from the device-resident age histograms to the telemetry
     bus (same shape as :func:`replay_metrics_events`).
+
+    The histograms are cumulative, so these events have no round of
+    their own; pass ``rnd`` (the round the snapshot was taken at) to
+    round-key them for the opslog journal's total order.
 
     Returns the number of events emitted."""
     from partisan_tpu import latency as latency_mod
@@ -237,66 +365,51 @@ def replay_latency_events(bus: Bus, lat_snap: Mapping[str, Any], *,
         age = entry.get(label)
         if age is None or age < slo_rounds:
             continue
-        bus.execute(LATENCY_SLO_BREACH,
-                    {"age_rounds": int(age), "count": entry["count"],
-                     "max_age_rounds": entry["max"]},
-                    {"channel": ch_name, "quantile": label,
-                     "slo_rounds": int(slo_rounds)})
+        meta = {"channel": ch_name, "quantile": label,
+                "slo_rounds": int(slo_rounds)}
+        if rnd is not None:
+            meta["round"] = int(rnd)
+        emit(bus, LATENCY_SLO_BREACH,
+             {"age_rounds": int(age), "count": entry["count"],
+              "max_age_rounds": entry["max"]}, meta)
         n_events += 1
     return n_events
 
 
 def replay_health_events(bus: Bus, snap: Mapping[str, Any], *,
-                         churn_threshold: int = 1) -> int:
+                         churn_threshold: int = 1,
+                         falling: bool = False) -> int:
     """Replay a health snapshot (``health.snapshot``) as discrete
     overlay events through the bus — the host-side adapter from the
     device-resident topology ring to the telemetry idiom (same shape as
-    :func:`replay_metrics_events`).
+    :func:`replay_metrics_events`).  The transition derivation itself
+    lives in ``health.transitions`` (the plane owns its discrete-event
+    semantics; this adapter owns the bus mapping):
 
-    - ``partition_detected`` — the component count rises above 1 AFTER
-      some snapshot in the window showed one component (a cold
-      bootstrap's many half-built components are not a partition; a
-      split of a previously-whole overlay is).  Edge-triggered: a
-      sustained split is one event.
-    - ``overlay_healed`` — the count returns to 1 after a detected
-      split.
+    - ``partition_detected`` — a split of a previously-whole overlay
+      (cold bootstrap suppressed).  Edge-triggered.
+    - ``overlay_healed`` — the component count returns to 1 after a
+      detected split.
     - ``churn`` — windowed join/leave/up/down totals at or above
       ``churn_threshold``; edge-triggered like the metrics spikes.
+    - ``churn_settled`` (only with ``falling=True``) — the falling
+      edge after a hot churn run; off by default so the adapter's
+      historical event counts are unchanged.
 
     Returns the number of events emitted."""
-    comps = np.asarray(snap["components"])
-    rounds = np.asarray(snap["rounds"])
-    churn_total = (np.asarray(snap["joins"]) + np.asarray(snap["leaves"])
-                   + np.asarray(snap["ups"]) + np.asarray(snap["downs"]))
+    from partisan_tpu import health as health_mod
+
+    events = {"partition_detected": HEALTH_PARTITION,
+              "overlay_healed": HEALTH_HEALED,
+              "churn": HEALTH_CHURN,
+              "churn_settled": HEALTH_CHURN_SETTLED}
     n_events = 0
-    was_one = False
-    split = False
-    churn_hot = False
-    for i, rnd in enumerate(rounds):
-        c = int(comps[i])
-        if split and c == 1:
-            bus.execute(HEALTH_HEALED, {"components": c},
-                        {"round": int(rnd)})
-            n_events += 1
-            split = False
-        if was_one and not split and c > 1:
-            bus.execute(HEALTH_PARTITION,
-                        {"components": c,
-                         "isolated": int(snap["isolated"][i])},
-                        {"round": int(rnd)})
-            n_events += 1
-            split = True
-        was_one = was_one or c == 1
-        hot = int(churn_total[i]) >= churn_threshold
-        if hot and not churn_hot:
-            bus.execute(HEALTH_CHURN,
-                        {"joins": int(snap["joins"][i]),
-                         "leaves": int(snap["leaves"][i]),
-                         "ups": int(snap["ups"][i]),
-                         "downs": int(snap["downs"][i])},
-                        {"round": int(rnd)})
-            n_events += 1
-        churn_hot = hot
+    for tr in health_mod.transitions(dict(snap),
+                                     churn_threshold=churn_threshold,
+                                     falling=falling):
+        meas = {k: v for k, v in tr.items() if k not in ("kind", "round")}
+        emit(bus, events[tr["kind"]], meas, {"round": tr["round"]})
+        n_events += 1
     return n_events
 
 
@@ -337,22 +450,22 @@ def replay_broadcast_events(bus: Bus, snap: Mapping[str, Any], *,
         g = int(gossip[i])
         hot = g >= redundancy_min and dup[i] / g >= redundancy_ratio
         if hot and not red_hot:
-            bus.execute(BROADCAST_REDUNDANCY,
-                        {"duplicates": int(dup[i]), "gossip": g,
-                         "ratio": round(float(dup[i]) / g, 4)},
-                        {"round": int(rnd)})
+            emit(bus, BROADCAST_REDUNDANCY,
+                 {"duplicates": int(dup[i]), "gossip": g,
+                  "ratio": round(float(dup[i]) / g, 4)},
+                 {"round": int(rnd)})
             n_events += 1
         red_hot = hot
         storming = int(grafts[i]) >= graft_threshold
         if storming and storm_start is None:
-            bus.execute(BROADCAST_GRAFT_STORM,
-                        {"grafts": int(grafts[i])}, {"round": int(rnd)})
+            emit(bus, BROADCAST_GRAFT_STORM,
+                 {"grafts": int(grafts[i])}, {"round": int(rnd)})
             n_events += 1
             storm_start = int(rnd)
         elif storm_start is not None and int(grafts[i]) == 0:
-            bus.execute(BROADCAST_TREE_REPAIRED,
-                        {"storm_rounds": int(rnd) - storm_start},
-                        {"round": int(rnd)})
+            emit(bus, BROADCAST_TREE_REPAIRED,
+                 {"storm_rounds": int(rnd) - storm_start},
+                 {"round": int(rnd)})
             n_events += 1
             storm_start = None
     return n_events
@@ -373,50 +486,24 @@ def replay_control_events(bus: Bus, snap: Mapping[str, Any], *,
     - ``healing_escalated`` — the overlay repair boost changed
       (escalations and relaxations both; direction in the metadata).
 
-    Returns the number of events emitted."""
+    The ring diffing itself lives in ``control.decisions`` (the plane
+    owns its discrete-event semantics; this adapter owns the bus
+    mapping).  Returns the number of events emitted."""
+    from partisan_tpu import control as control_mod
+
+    events = {"fanout_adjusted": CONTROL_FANOUT_ADJUSTED,
+              "shed_threshold_changed": CONTROL_SHED_CHANGED,
+              "healing_escalated": CONTROL_HEALING}
     n_events = 0
-    fan = snap.get("fanout")
-    if fan is not None:
-        rounds = np.asarray(fan["rounds"])
-        cap = np.asarray(fan["cap"])
-        for i in range(1, len(rounds)):
-            if cap[i] != cap[i - 1]:
-                bus.execute(CONTROL_FANOUT_ADJUSTED,
-                            {"cap": int(cap[i]), "prev": int(cap[i - 1])},
-                            {"round": int(rounds[i])})
-                n_events += 1
-    bp = snap.get("backpressure")
-    if bp is not None:
-        rounds = np.asarray(bp["rounds"])
-        press = np.asarray(bp["press"])
-        C = press.shape[1] if press.ndim == 2 else 0
-        # index-padded: a caller-supplied tuple shorter than the ring's
-        # channel axis falls back to ch{i} instead of IndexError
-        given = tuple(channels) if channels is not None else ()
-        names = tuple(given[i] if i < len(given) else f"ch{i}"
-                      for i in range(C))
-        for i in range(1, len(rounds)):
-            for c in range(C):
-                if press[i, c] != press[i - 1, c]:
-                    bus.execute(CONTROL_SHED_CHANGED,
-                                {"press": int(press[i, c]),
-                                 "prev": int(press[i - 1, c])},
-                                {"round": int(rounds[i]),
-                                 "channel": names[c]})
-                    n_events += 1
-    heal = snap.get("healing")
-    if heal is not None:
-        rounds = np.asarray(heal["rounds"])
-        boost = np.asarray(heal["boost"])
-        for i in range(1, len(rounds)):
-            if boost[i] != boost[i - 1]:
-                bus.execute(CONTROL_HEALING,
-                            {"boost": int(boost[i]),
-                             "prev": int(boost[i - 1])},
-                            {"round": int(rounds[i]),
-                             "direction": "escalate"
-                             if boost[i] > boost[i - 1] else "relax"})
-                n_events += 1
+    for d in control_mod.decisions(dict(snap), channels=channels):
+        meta = {"round": d["round"]}
+        for k in ("channel", "direction"):
+            if k in d:
+                meta[k] = d[k]
+        meas = {k: v for k, v in d.items()
+                if k not in ("kind", "round", "channel", "direction")}
+        emit(bus, events[d["kind"]], meas, meta)
+        n_events += 1
     return n_events
 
 
@@ -453,22 +540,22 @@ def replay_traffic_events(bus: Bus, chunks, *, slo_rounds: int | None = None,
             rate = int(r["traffic"].get("rate_x1000", 0))
             h = rate >= thresh
             if h and not hot:
-                bus.execute(TRAFFIC_FLASH_CROWD,
-                            {"rate_x1000": rate,
-                             "sent": int(r["traffic"].get("sent", 0))},
-                            {"round": int(r["round"])})
+                emit(bus, TRAFFIC_FLASH_CROWD,
+                     {"rate_x1000": rate,
+                      "sent": int(r["traffic"].get("sent", 0))},
+                     {"round": int(r["round"])})
                 n_events += 1
             hot = h
     if slo_rounds is not None:
         window: dict | None = None
 
-        def emit(w):
-            bus.execute(TRAFFIC_SLO_BREACH_WINDOW,
-                        {"worst_p99": w["worst_p99"],
-                         "chunks": w["chunks"]},
-                        {"round": w["start"], "end_round": w["end"],
-                         "channel": w["channel"],
-                         "slo_rounds": int(slo_rounds)})
+        def _emit_window(w):
+            emit(bus, TRAFFIC_SLO_BREACH_WINDOW,
+                 {"worst_p99": w["worst_p99"],
+                  "chunks": w["chunks"]},
+                 {"round": w["start"], "end_round": w["end"],
+                  "channel": w["channel"],
+                  "slo_rounds": int(slo_rounds)})
 
         for r in chunks:
             p99 = r.get("p99") or {}
@@ -489,11 +576,11 @@ def replay_traffic_events(bus: Bus, chunks, *, slo_rounds: int | None = None,
                         window["channel"] = worst[0]
                         window["worst_p99"] = int(worst[1])
             elif window is not None:
-                emit(window)
+                _emit_window(window)
                 n_events += 1
                 window = None
         if window is not None:
-            emit(window)
+            _emit_window(window)
             n_events += 1
     return n_events
 
@@ -530,7 +617,7 @@ def replay_soak_events(bus: Bus, log) -> int:
         meta = {k: v for k, v in entry.items()
                 if not isinstance(v, (int, float)) and k != "kind"}
         meta["round"] = int(entry.get("round", -1))
-        bus.execute(event, meas, meta)
+        emit(bus, event, meas, meta)
         n_events += 1
     return n_events
 
@@ -540,19 +627,19 @@ def replay_elastic_events(bus: Bus, snap: Mapping[str, Any]) -> int:
     in-scan resize ring: round, n_active AFTER and BEFORE each
     transition) as direction-tagged ``partisan.elastic.*`` events —
     the stored from-width tags the direction, so the first entry of a
-    wrapped (or shrink-first) window cannot misreport.  Returns the
-    number of events emitted."""
-    rounds = list(snap.get("rounds", ()))
-    widths = list(snap.get("widths", ()))
-    froms = list(snap.get("from", ()))
+    wrapped (or shrink-first) window cannot misreport.  The transition
+    derivation lives in ``elastic.transitions`` (the plane owns its
+    discrete-event semantics; this adapter owns the bus mapping).
+    Every event is round-keyed — the opslog span matcher closes resize
+    spans on them.  Returns the number of events emitted."""
+    from partisan_tpu import elastic as elastic_mod
+
     n_events = 0
-    for r, w, f in zip(rounds, widths, froms):
-        if int(w) == int(f):
-            continue
-        bus.execute(ELASTIC_SCALE_OUT if int(w) > int(f)
-                    else ELASTIC_SCALE_IN,
-                    {"n_active": int(w)},
-                    {"round": int(r), "from": int(f)})
+    for tr in elastic_mod.transitions(dict(snap)):
+        emit(bus, ELASTIC_SCALE_OUT if tr["kind"] == "scale_out"
+             else ELASTIC_SCALE_IN,
+             {"n_active": tr["n_active"]},
+             {"round": tr["round"], "from": tr["from"]})
         n_events += 1
     return n_events
 
@@ -569,58 +656,62 @@ def replay_ingress_events(bus: Bus, log) -> int:
             continue
         meta = {"round": int(entry.get("round", -1)),
                 "replayed": bool(entry.get("replayed", False))}
-        bus.execute(INGRESS_DRAIN,
-                    {"staged": int(entry.get("staged", 0))}, meta)
+        emit(bus, INGRESS_DRAIN,
+             {"staged": int(entry.get("staged", 0))}, meta)
         n_events += 1
         shed = int(entry.get("shed_buffer_full", 0))
         invalid = int(entry.get("shed_invalid", 0))
         deferred = int(entry.get("deferred", 0))
         if shed or invalid or deferred:
-            bus.execute(INGRESS_SHED,
-                        {"shed_buffer_full": shed,
-                         "shed_invalid": invalid,
-                         "deferred": deferred}, meta)
+            emit(bus, INGRESS_SHED,
+                 {"shed_buffer_full": shed,
+                  "shed_invalid": invalid,
+                  "deferred": deferred}, meta)
             n_events += 1
     return n_events
 
 
 def replay_perf_events(bus: Bus, *, dispatch: Mapping[str, Any] | None = None,
-                       phases=None, deltas=None) -> int:
+                       phases=None, deltas=None,
+                       rnd: int | None = None) -> int:
     """Replay perfwatch host-side measurements as ``partisan.perf.*``
     events: one ``dispatch_wall`` per decomposition (perfwatch
     ``decompose``/``decompose_chunks`` dict), one ``phase_outlier`` per
     reconciliation row flagged ``outlier`` (perfwatch ``reconcile``),
     and one ``regression`` per ledger delta flagged ``regression``
-    (perfwatch ``ledger_deltas``).  Returns the number of events
-    emitted."""
+    (perfwatch ``ledger_deltas``).  These are whole-run measurements
+    with no round of their own; pass ``rnd`` (the run's final round)
+    to round-key them for the opslog journal's total order.  Returns
+    the number of events emitted."""
     n_events = 0
+    stamp = {} if rnd is None else {"round": int(rnd)}
     if dispatch:
-        bus.execute(PERF_DISPATCH_WALL,
-                    {"in_execution_s": float(
-                        dispatch.get("in_execution_s", 0.0)),
-                     "gap_s": float(dispatch.get("gap_s", 0.0)),
-                     "gap_share": float(dispatch.get("gap_share", 0.0))},
-                    {"chunks": int(dispatch.get("chunks", 0))})
+        emit(bus, PERF_DISPATCH_WALL,
+             {"in_execution_s": float(
+                 dispatch.get("in_execution_s", 0.0)),
+              "gap_s": float(dispatch.get("gap_s", 0.0)),
+              "gap_share": float(dispatch.get("gap_share", 0.0))},
+             {"chunks": int(dispatch.get("chunks", 0)), **stamp})
         n_events += 1
     for row in phases or []:
         if not row.get("outlier"):
             continue
-        bus.execute(PERF_PHASE_OUTLIER,
-                    {"measured_ms": float(row.get("measured_ms", 0.0)),
-                     "predicted_bytes": int(
-                         row.get("predicted_bytes", 0)),
-                     "time_share": float(row.get("time_share", 0.0))},
-                    {"phase": row.get("phase")})
+        emit(bus, PERF_PHASE_OUTLIER,
+             {"measured_ms": float(row.get("measured_ms", 0.0)),
+              "predicted_bytes": int(
+                  row.get("predicted_bytes", 0)),
+              "time_share": float(row.get("time_share", 0.0))},
+             {"phase": row.get("phase"), **stamp})
         n_events += 1
     for d in deltas or []:
         if not d.get("regression"):
             continue
-        bus.execute(PERF_REGRESSION,
-                    {"rounds_per_sec": float(
-                        d.get("rounds_per_sec", 0.0)),
-                     "delta_pct": float(d.get("delta_pct", 0.0))},
-                    {"n": d.get("n"), "host": d.get("host"),
-                     "source": d.get("source")})
+        emit(bus, PERF_REGRESSION,
+             {"rounds_per_sec": float(
+                 d.get("rounds_per_sec", 0.0)),
+              "delta_pct": float(d.get("delta_pct", 0.0))},
+             {"n": d.get("n"), "host": d.get("host"),
+              "source": d.get("source"), **stamp})
         n_events += 1
     return n_events
 
@@ -628,9 +719,9 @@ def replay_perf_events(bus: Bus, *, dispatch: Mapping[str, Any] | None = None,
 def emit_channels_configured(bus: Bus, cfg) -> None:
     """partisan_config.erl:834-843's channel-configured event."""
     for ch in cfg.channels:
-        bus.execute(CHANNEL_CONFIGURED,
-                    {"parallelism": ch.parallelism},
-                    {"channel": ch.name, "monotonic": ch.monotonic})
+        emit(bus, CHANNEL_CONFIGURED,
+             {"parallelism": ch.parallelism},
+             {"channel": ch.name, "monotonic": ch.monotonic})
 
 
 def distance_metrics(dist_state) -> dict:

@@ -452,6 +452,40 @@ def flash_crowd(off: int, rounds: int, x1000: int,
     return ((off, SetRate(x1000)), (off + rounds, SetRate(base_x1000)))
 
 
+def crowd_windows(rows, *, crowd_x1000: int | None = None) -> list[dict]:
+    """Derive flash-crowd WINDOWS from a soak run's chunk rows (each
+    optionally carrying a ``traffic`` poll): maximal runs of chunks
+    whose observed rate multiplier is at or above ``crowd_x1000``
+    (default: 2x the first row's rate — the same threshold
+    ``telemetry.replay_traffic_events`` edge-triggers its
+    ``flash_crowd`` event on).  Returns one dict per window with its
+    ``start`` round, ``end`` round (the first cooled row; ``None``
+    while still hot at the series' end) and ``peak_x1000`` — the
+    falling edges the opslog matcher closes flash-crowd spans on."""
+    rows = [r for r in rows if "traffic" in r]
+    if not rows:
+        return []
+    base = int(rows[0]["traffic"].get("rate_x1000", 0))
+    thresh = crowd_x1000 if crowd_x1000 is not None else 2 * max(base, 1)
+    out: list[dict] = []
+    window: dict | None = None
+    for r in rows:
+        rate = int(r["traffic"].get("rate_x1000", 0))
+        if rate >= thresh:
+            if window is None:
+                window = {"start": int(r["round"]), "end": None,
+                          "peak_x1000": rate}
+            else:
+                window["peak_x1000"] = max(window["peak_x1000"], rate)
+        elif window is not None:
+            window["end"] = int(r["round"])
+            out.append(window)
+            window = None
+    if window is not None:
+        out.append(window)
+    return out
+
+
 def _staircase(period: int, steps: int, make_action) -> tuple:
     """A triangle wave across ``period`` rounds as ``2·steps + 1``
     events: the rising and falling steps plus a CLOSING base-level

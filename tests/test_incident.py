@@ -1,0 +1,166 @@
+"""End-to-end incident observatory over a REAL mixed-fault soak run
+(the ISSUE 17 acceptance suite):
+
+1. every injected fault class resolves to a CLOSED incident span with
+   measured detect/react/recover round-latencies, and the ops gate
+   passes — the claim ``scenarios.py --ops`` folds into its verdicts,
+2. the span set survives a mid-incident kill + fresh-engine restore
+   BIT-FOR-BIT: the killed run's journal with its resume's appended
+   (``to_jsonl(append=True)`` + ``Journal.from_jsonl`` merge) matches
+   an uninterrupted run's span set exactly,
+3. building the journal traces ZERO eqns (perfwatch's census-parity
+   contract: opslog is host-side bookkeeping only).
+
+One module-scoped storm soak feeds all three: a full fault cycle
+(link drop -> crash batch -> partition -> churn, each cured) with the
+metrics + latency + health planes and the healing controller armed, so
+every rule chain in the catalog has both its detection plane and its
+reaction source live.
+"""
+
+import jax
+import pytest
+
+import support
+from partisan_tpu import opslog, soak
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, ControlConfig
+from partisan_tpu.models.plumtree import Plumtree
+
+N = support.OPS_SOAK_N
+
+# One full cycle, every action cured inside the run: LinkDrop cleared
+# at +6, the crash batch revived at +30 (which also heals the +20
+# partition), the churn stopped at +50 — 70 rounds covers every
+# falling edge the matcher closes on.
+STORM_EVENTS = (
+    (0, soak.LinkDrop(0.2)),
+    (6, soak.Heal()),
+    (10, soak.CrashBatch(frac=0.05)),
+    (20, soak.Partition()),
+    (30, soak.Heal(revive=True)),
+    (40, soak.Churn(0.05, 0.05)),
+    (50, soak.Heal(revive=True)),
+)
+ROUNDS = 70
+KILL_AT = 30          # mid-partition: injected at +20, healed at +30
+
+
+def _mk():
+    cfg = Config(n_nodes=N, seed=3, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 metrics=True, metrics_ring=128, latency=True,
+                 health=5, health_ring=64,
+                 control=ControlConfig(healing=True))
+    return Cluster(cfg, model=Plumtree())
+
+
+def _storm(start):
+    return soak.Storm(events=STORM_EVENTS, start=start, period=0)
+
+
+@pytest.fixture(scope="module")
+def incident_run(tmp_path_factory):
+    """The shared storm soak: an uninterrupted reference run PLUS the
+    same timeline as a killed run (stopped at the partition-heal
+    boundary, mid-incident) resumed by a fresh engine from its on-disk
+    checkpoint."""
+    ckpt = tmp_path_factory.mktemp("ops_ckpt")
+    cl = _mk()
+    n = cl.cfg.n_nodes
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager,
+                             list(range(1, n)), [0] * (n - 1))
+    st = cl.steps(st._replace(manager=m), 20)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0,
+                                              int(st.rnd)))
+    st = cl.steps(st, 5)
+    r0 = int(jax.device_get(st.rnd))
+
+    eng_a = soak.Soak(make_cluster=lambda: cl, storm=_storm(r0),
+                      cfg=soak.SoakConfig(chunk_fixed=10,
+                                          checkpoint_dir=str(ckpt)))
+    res_a = eng_a.run(st, until_round=r0 + KILL_AT)
+    # the fresh-process path: new cluster, new (identically declared)
+    # storm, resumed from the newest checkpoint
+    eng_b = soak.Soak(make_cluster=_mk, storm=_storm(r0),
+                      cfg=soak.SoakConfig(chunk_fixed=10,
+                                          checkpoint_dir=str(ckpt)))
+    res_b = eng_b.run(resume=True, until_round=r0 + ROUNDS)
+    eng_ref = soak.Soak(make_cluster=lambda: cl, storm=_storm(r0),
+                        cfg=soak.SoakConfig(chunk_fixed=10))
+    res_ref = eng_ref.run(st, rounds=ROUNDS)
+    return {"r0": r0, "res_a": res_a, "res_b": res_b,
+            "res_ref": res_ref, "storm": _storm(r0)}
+
+
+def test_every_injected_fault_resolves_to_closed_span(incident_run):
+    r0 = incident_run["r0"]
+    j = opslog.from_soak(incident_run["res_ref"],
+                         storm=incident_run["storm"], slo_rounds=6)
+    # the fusion recorded every live source's coverage
+    for s in ("inject", "chunk", "metrics", "health", "control",
+              "latency", "soak", "perf", "ops"):
+        assert s in j.streams, f"stream {s} not covered"
+    m = opslog.match(j)
+    spans = {s["rule"]: s for s in m["spans"]}
+    assert set(spans) == {"link_drop", "crash", "partition", "churn"}
+    for rule, s in spans.items():
+        assert s["status"] == "closed", f"{rule}: {s}"
+        assert s["detect_latency"] >= 0
+        assert s["recover_round"] > s["cause_round"] >= r0
+        assert s["recover_latency"] >= s["detect_latency"]
+    # the healing controller's escalation was claimed by its incident,
+    # not orphaned
+    assert spans["partition"]["react_event"] \
+        == "partisan.control.healing_escalated" \
+        or spans["crash"]["react_event"] \
+        == "partisan.control.healing_escalated"
+    assert m["orphans"] == []
+    budgets = opslog.error_budgets(j, slo_rounds=6)
+    verdict = opslog.gate(m, budgets)
+    assert verdict["ok"], verdict
+
+
+def test_kill_restore_reconstructs_identical_span_set(incident_run,
+                                                      tmp_path):
+    """Satellite 3: journal A (killed mid-partition) appended with
+    journal B (fresh-engine resume) merges — via the JSON-lines
+    artifact itself — to the exact span set of the uninterrupted run."""
+    storm = incident_run["storm"]
+    path = tmp_path / "ops.jsonl"
+    ja = opslog.from_soak(incident_run["res_a"], storm=storm)
+    spans_a = opslog.match(ja)["spans"]
+    # the kill really was mid-incident: the partition is detected but
+    # not yet recovered when the run stops
+    (part_a,) = [s for s in spans_a if s["rule"] == "partition"]
+    assert part_a["status"] == "open"
+    ja.to_jsonl(path)
+    jb = opslog.from_soak(incident_run["res_b"], storm=storm)
+    jb.to_jsonl(path, append=True)
+
+    merged = opslog.match(opslog.Journal.from_jsonl(path))
+    ref = opslog.match(opslog.from_soak(incident_run["res_ref"],
+                                        storm=storm))
+    assert merged["spans"] == ref["spans"]
+    assert merged["counts"]["closed"] == 4
+    # (orphans are NOT compared: journal A preserves ring history the
+    # uninterrupted run's decision ring evicted by the end — the merge
+    # keeps strictly MORE evidence, and spans are identical anyway)
+
+
+def test_journal_building_traces_zero_eqns(incident_run):
+    """opslog is host-side only: fusing the journal, matching spans and
+    accounting budgets change NOTHING in any traced program (the
+    perfwatch census-parity pin)."""
+    from partisan_tpu.lint.cost import bench_round_program, \
+        census_program
+
+    base = census_program(bench_round_program(64))
+    j = opslog.from_soak(incident_run["res_ref"],
+                         storm=incident_run["storm"], slo_rounds=6)
+    opslog.gate(opslog.match(j), opslog.error_budgets(j, slo_rounds=6))
+    under = census_program(bench_round_program(64))
+    assert {p: c.eqns for p, c in base.phases.items()} == \
+        {p: c.eqns for p, c in under.phases.items()}
+    assert base.total.eqns == under.total.eqns

@@ -1,0 +1,379 @@
+"""Unit contracts of the unified ops journal (partisan_tpu/opslog.py):
+entry ordering, identity/dedup, JSON-lines persistence/merge, the
+telemetry event-name registry sync guard, the incident-span matcher's
+semantics on synthetic timelines, and the SLO error-budget math.
+
+Everything here is host-side and synthetic — no cluster, no device
+work.  The end-to-end journal built from a REAL soak run (and the
+kill/restore bit-parity of its span set) lives in tests/test_incident.py.
+"""
+
+import ast
+import pathlib
+
+import numpy as np
+import pytest
+
+from partisan_tpu import opslog, telemetry
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# journal: ordering, identity, persistence
+# ---------------------------------------------------------------------------
+
+def test_sorted_entries_follow_documented_total_order():
+    """At one round: injections (ground truth) < chunk rows < detection
+    planes < control reactions < synthesized ops markers; unknown
+    streams rank between the known tail and ops; rounds dominate."""
+    j = opslog.Journal()
+    j.append(5, "ops", "ops.crowd_ended")
+    j.append(5, "metrics", "partisan.metrics.drop_spike")
+    j.append(5, "chunk", "chunk")
+    j.append(5, "control", "partisan.control.healing_escalated")
+    j.append(5, "inject", "inject.LinkDrop")
+    j.append(5, "mystery", "whatever")
+    j.append(3, "health", "partisan.health.churn")
+    got = [(e.round, e.stream) for e in j.sorted_entries()]
+    assert got == [(3, "health"), (5, "inject"), (5, "chunk"),
+                   (5, "metrics"), (5, "control"), (5, "mystery"),
+                   (5, "ops")]
+
+
+def test_append_dedups_on_identity_first_copy_wins():
+    j = opslog.Journal()
+    first = j.append(30, "chunk", "chunk", measurements={"k": 10})
+    dup = j.append(30, "chunk", "chunk", measurements={"k": 99})
+    assert first is not None and dup is None
+    assert len(j.entries) == 1
+    assert j.entries[0].measurements == {"k": 10}
+    # a dup index in the metadata is a distinct identity (two same-class
+    # injections landing on one round)
+    assert j.append(30, "chunk", "chunk", metadata={"dup": 1}) is not None
+    assert len(j.entries) == 2
+
+
+def test_severity_defaults():
+    assert opslog.severity_of("inject.Partition") == "warn"
+    assert opslog.severity_of("inject.Heal") == "info"
+    assert opslog.severity_of("partisan.health.partition_detected") \
+        == "error"
+    assert opslog.severity_of("partisan.health.churn_settled") == "info"
+    assert opslog.severity_of("chunk") == "info"
+    assert opslog.severity_of("ops.slo_recovered") == "info"
+    assert opslog.severity_of("no.such.event") == "info"
+
+
+def test_bus_handler_journals_registry_events():
+    j = opslog.Journal()
+    bus = telemetry.Bus()
+    bus.attach("j", ("partisan",), j.bus_handler(default_round=40))
+    telemetry.emit(bus, telemetry.HEALTH_CHURN,
+                   {"joins": 1, "leaves": 0, "ups": 0, "downs": 2},
+                   {"round": 7})
+    telemetry.emit(bus, telemetry.LATENCY_SLO_BREACH,
+                   {"age_rounds": 9.0, "count": 3, "max_age_rounds": 12},
+                   {"channel": "gossip", "quantile": 0.99,
+                    "slo_rounds": 6})
+    (churn, slo) = j.sorted_entries()
+    assert (churn.round, churn.stream, churn.severity) == (7, "health",
+                                                           "warn")
+    assert churn.event == "partisan.health.churn"
+    # no round metadata -> the handler's default (journal end)
+    assert (slo.round, slo.channel) == (40, "gossip")
+
+
+def test_jsonl_roundtrip_and_resume_merge(tmp_path):
+    p = tmp_path / "ops.jsonl"
+    a = opslog.Journal()
+    a.start, a.end = 0, 30
+    a.cover("inject", 0)
+    a.cover("health", 10)
+    a.append(5, "inject", "inject.Partition", cause_id="5:inject.Partition",
+             measurements={}, metadata={"mode": None})
+    a.append(12, "health", "partisan.health.partition_detected",
+             measurements={"components": 2, "isolated": 1},
+             metadata={"round": 12})
+    a.to_jsonl(p)
+
+    back = opslog.Journal.from_jsonl(p)
+    assert back.streams == a.streams
+    assert (back.start, back.end) == (0, 30)
+    assert [e.key() for e in back.sorted_entries()] \
+        == [e.key() for e in a.sorted_entries()]
+    assert back.sorted_entries()[0].cause_id == "5:inject.Partition"
+
+    # the kill/restore path: a resumed run re-journals an overlapping
+    # window and APPENDS — the merge dedups and widens the bounds
+    b = opslog.Journal()
+    b.start, b.end = 5, 60
+    b.cover("health", 10)
+    b.append(5, "inject", "inject.Partition",
+             cause_id="5:inject.Partition")          # duplicate identity
+    b.append(18, "health", "partisan.health.overlay_healed",
+             measurements={"components": 1})
+    b.to_jsonl(p, append=True)
+    merged = opslog.Journal.from_jsonl(p)
+    assert len(merged.entries) == 3
+    assert (merged.start, merged.end) == (0, 60)
+    assert merged.streams == {"inject": 0, "health": 10}
+
+
+# ---------------------------------------------------------------------------
+# telemetry event-name registry (ISSUE 17 satellite): one registry,
+# no ad-hoc event strings anywhere in the package or the tools
+# ---------------------------------------------------------------------------
+
+def _literal_event_tuples():
+    """Every tuple literal of string constants starting with
+    "partisan" in partisan_tpu/ and tools/ — the AST sweep that keeps
+    the registry the single namespace for event names."""
+    found = []
+    for sub in ("partisan_tpu", "tools"):
+        for p in sorted((REPO / sub).rglob("*.py")):
+            for node in ast.walk(ast.parse(p.read_text())):
+                if not (isinstance(node, ast.Tuple) and node.elts):
+                    continue
+                if not all(isinstance(e, ast.Constant)
+                           and isinstance(e.value, str)
+                           for e in node.elts):
+                    continue
+                vals = tuple(e.value for e in node.elts)
+                if vals[0] == "partisan":
+                    found.append((f"{p.relative_to(REPO)}:{node.lineno}",
+                                  vals))
+    return found
+
+
+def test_every_event_tuple_literal_is_registered():
+    """Full event names (3+ parts) must be telemetry.EVENTS keys;
+    shorter tuples are bus-subscription prefixes and must prefix some
+    registered name.  An unregistered ad-hoc tuple anywhere in the
+    package or tools fails here BY NAME — the sync guard."""
+    registered = set(telemetry.EVENTS)
+    prefixes = {name[:k] for name in registered
+                for k in range(1, len(name))}
+    tuples = _literal_event_tuples()
+    # the registry's own constant definitions are in the sweep, so an
+    # empty result would mean the scanner broke, not a clean tree
+    assert len([v for _, v in tuples if len(v) >= 3]) \
+        >= len(registered)
+    for where, vals in tuples:
+        if len(vals) >= 3:
+            assert vals in registered, \
+                f"{where}: unregistered event tuple {vals}"
+        else:
+            assert vals in prefixes, \
+                f"{where}: unknown event prefix {vals}"
+
+
+def test_emit_refuses_unregistered_and_incomplete_events():
+    bus = telemetry.Bus()
+    with pytest.raises(ValueError, match="unregistered"):
+        telemetry.emit(bus, ("partisan", "health", "made_up"), {}, {})
+    with pytest.raises(ValueError, match="required"):
+        telemetry.emit(bus, telemetry.HEALTH_CHURN,
+                       {"joins": 1}, {"round": 3})
+    assert len(telemetry.EVENTS) >= 34
+
+
+# ---------------------------------------------------------------------------
+# falling-edge recovery markers (the matcher's close events)
+# ---------------------------------------------------------------------------
+
+def test_health_transitions_emit_churn_settled_falling_edge():
+    snap = {"components": np.array([1, 1, 1, 1]),
+            "isolated": np.zeros(4, int),
+            "rounds": np.array([0, 5, 10, 15]),
+            "joins": np.array([0, 2, 2, 0]),
+            "leaves": np.zeros(4, int),
+            "ups": np.zeros(4, int), "downs": np.zeros(4, int)}
+    from partisan_tpu import health
+    kinds = [t["kind"] for t in health.transitions(snap, falling=True)]
+    assert kinds == ["churn", "churn_settled"]
+    # off by default: historical event counts unchanged
+    assert [t["kind"] for t in health.transitions(snap)] == ["churn"]
+
+
+def test_metrics_replay_falling_edges_close_drop_spikes():
+    snap = {"shed": np.zeros(5, int),
+            "drops": np.array([[0], [4], [4], [0], [0]]),
+            "edges_min": np.array([2, 2, 2, 2, 2]),
+            "alive": np.full(5, 8), "rounds": np.arange(5)}
+    rec = telemetry.Recorder()
+    bus = telemetry.Bus()
+    bus.attach("t", ("partisan", "metrics"), rec)
+    n = telemetry.replay_metrics_events(bus, snap, falling=True)
+    assert [e[0] for e in rec.events] == [
+        telemetry.METRICS_DROP_SPIKE, telemetry.METRICS_DROP_CLEARED]
+    assert n == 2
+
+
+# ---------------------------------------------------------------------------
+# the incident-span matcher, on synthetic timelines
+# ---------------------------------------------------------------------------
+
+def _journal(entries, streams=None, end=40):
+    j = opslog.Journal()
+    j.start, j.end = 0, end
+    for s, lo in (streams or {}).items():
+        j.cover(s, lo)
+    for rnd, stream, event, kw in entries:
+        j.append(rnd, stream, event, **kw)
+    return j
+
+
+def _partition_timeline(*, healed=True, react_round=13):
+    rows = [
+        (10, "inject", "inject.Partition",
+         {"cause_id": "10:inject.Partition"}),
+        (12, "health", "partisan.health.partition_detected",
+         {"measurements": {"components": 2}}),
+        (react_round, "control", "partisan.control.healing_escalated",
+         {"metadata": {"direction": "escalate"}}),
+    ]
+    if healed:
+        rows.append((18, "health", "partisan.health.overlay_healed", {}))
+    return rows
+
+
+def test_match_closed_span_measures_every_leg():
+    j = _journal(_partition_timeline(), streams={"health": 0})
+    m = opslog.match(j)
+    (span,) = m["spans"]
+    assert span["status"] == "closed"
+    assert (span["rule"], span["cause_id"]) \
+        == ("partition", "10:inject.Partition")
+    assert (span["detect_round"], span["detect_latency"]) == (12, 2)
+    assert (span["react_round"], span["react_latency"]) == (13, 1)
+    assert (span["recover_round"], span["recover_latency"]) == (18, 8)
+    assert m["orphans"] == []
+    assert opslog.gate(m)["ok"]
+
+
+def test_match_open_undetected_and_unobservable():
+    # detected but never recovered -> open (gates)
+    m_open = opslog.match(_journal(_partition_timeline(healed=False),
+                                   streams={"health": 0}))
+    assert m_open["spans"][0]["status"] == "open"
+    assert not opslog.gate(m_open)["ok"]
+    # observable cause with no plane event -> undetected (gates)
+    m_und = opslog.match(_journal(
+        [(10, "inject", "inject.Partition", {})], streams={"health": 0}))
+    assert m_und["spans"][0]["status"] == "undetected"
+    assert not opslog.gate(m_und)["ok"]
+    # the attesting streams' ring windows start after the cause (or the
+    # planes are off) -> unobservable: reported, NOT gated
+    m_uno = opslog.match(_journal(
+        [(10, "inject", "inject.Partition", {})], streams={"health": 25}))
+    assert m_uno["spans"][0]["status"] == "unobservable"
+    v = opslog.gate(m_uno)
+    assert v["ok"] and v["unobservable"] == 1
+
+
+def test_match_folds_causes_with_no_recovery_between():
+    # downs-only: a recovery candidate needs ups/joins, so this churn
+    # detects without also closing the span
+    base = {"measurements": {"joins": 0, "leaves": 0, "ups": 0,
+                             "downs": 1}}
+    up = {"measurements": {"joins": 0, "leaves": 0, "ups": 1,
+                           "downs": 0}}
+    folded = opslog.match(_journal([
+        (10, "inject", "inject.Churn", {}),
+        (11, "health", "partisan.health.churn", base),
+        (14, "inject", "inject.Churn", {}),
+        (20, "health", "partisan.health.churn", up),
+    ], streams={"health": 0}))
+    (span,) = folded["spans"]
+    assert span["causes_folded"] == 2 and span["status"] == "closed"
+    # a recovery BETWEEN the causes splits them into two incidents
+    split = opslog.match(_journal([
+        (10, "inject", "inject.Churn", {}),
+        (11, "health", "partisan.health.churn", base),
+        (12, "health", "partisan.health.churn_settled", {}),
+        (14, "inject", "inject.Churn", {}),
+        (15, "health", "partisan.health.churn", base),
+        (21, "health", "partisan.health.churn_settled", {}),
+    ], streams={"health": 0}))
+    assert [s["status"] for s in split["spans"]] == ["closed", "closed"]
+    assert [s["recover_round"] for s in split["spans"]] == [12, 21]
+
+
+def test_match_flash_crowd_recovers_on_last_window_edge():
+    """recover_last: the crowd is over when the LAST breach window
+    closed, not the first."""
+    m = opslog.match(_journal([
+        (10, "inject", "inject.SetRate", {"measurements": {"x1000": 8}}),
+        (10, "traffic", "partisan.traffic.flash_crowd",
+         {"measurements": {"rate_x1000": 8}}),
+        (15, "ops", "ops.slo_recovered", {}),
+        (20, "ops", "ops.crowd_ended", {}),
+    ], streams={"traffic": 0}), crowd_x1000=5)
+    (span,) = m["spans"]
+    assert span["rule"] == "flash_crowd" and span["status"] == "closed"
+    assert span["recover_round"] == 20
+    # below the crowd threshold the SetRate is not a fault at all
+    calm = opslog.match(_journal(
+        [(10, "inject", "inject.SetRate",
+          {"measurements": {"x1000": 2}})],
+        streams={"traffic": 0}), crowd_x1000=5)
+    assert calm["spans"] == []
+
+
+def test_match_reports_orphan_reactions():
+    """A controller escalation no span claims is an orphan; one AFTER
+    its incident's recovery is outside the incident interval and
+    orphans too.  Relax-direction healing moves are routine decay, not
+    reactions."""
+    m = opslog.match(_journal([
+        (5, "control", "partisan.control.healing_escalated",
+         {"metadata": {"direction": "escalate"}}),
+        (6, "control", "partisan.control.healing_escalated",
+         {"metadata": {"direction": "relax"}}),
+    ]))
+    assert [o["round"] for o in m["orphans"]] == [5]
+    assert m["orphans"][0]["kind"] == "ops_orphan"
+    late = opslog.match(_journal(
+        _partition_timeline(react_round=25), streams={"health": 0}))
+    (span,) = late["spans"]
+    assert span["status"] == "closed" and span["react_round"] is None
+    assert [o["round"] for o in late["orphans"]] == [25]
+    assert opslog.gate(late)["ok"]       # orphans report, never gate
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets
+# ---------------------------------------------------------------------------
+
+def _chunk(rnd, k, p99):
+    return (rnd, "chunk", "chunk",
+            {"measurements": {"k": k}, "metadata": {"p99": p99}})
+
+
+def test_error_budget_burn_and_exhaustion():
+    j = _journal([
+        _chunk(0, 10, {"gossip": 5.0, "rpc": 4.0}),
+        _chunk(10, 10, {"gossip": 20.0, "rpc": 4.0}),
+        _chunk(20, 10, {"gossip": 20.0, "rpc": 4.0}),
+        _chunk(30, 10, {"gossip": 5.0, "rpc": None}),
+    ])
+    budgets = {b["channel"]: b
+               for b in opslog.error_budgets(j, slo_rounds=10)}
+    g = budgets["gossip"]
+    # 40 polled rounds, budget 25% = 10; chunks at 10 and 20 breach
+    # (p99 > bound; == passes), burning 20 rounds -> burn 2.0 and the
+    # line is crossed at the SECOND breaching chunk
+    assert (g["rounds"], g["budget_rounds"]) == (40, 10.0)
+    assert (g["breach_rounds"], g["burn"]) == (20, 2.0)
+    assert g["exhausted_round"] == 20
+    r = budgets["rpc"]
+    assert (r["breach_rounds"], r["burn"], r["exhausted_round"]) \
+        == (0, 0.0, None)
+    # the gate: an exhausted channel fails unless exempted
+    matched = {"counts": {"spans": 0, "closed": 0, "open": 0,
+                          "undetected": 0, "unobservable": 0,
+                          "orphans": 0}}
+    assert not opslog.gate(matched, list(budgets.values()))["ok"]
+    v = opslog.gate(matched, list(budgets.values()), exempt=("gossip",))
+    assert v["ok"] and v["budget_exhausted"] == []

@@ -247,7 +247,8 @@ def test_tools_cli_completeness():
     tools_dir = os.path.join(_REPO, "tools")
     tools = sorted(f for f in os.listdir(tools_dir)
                    if f.endswith(".py"))
-    assert len(tools) >= 13, tools
+    assert len(tools) >= 14, tools
+    assert "incident_report.py" in tools
     assert "soak_report.py" in tools
     assert "jaxlint.py" in tools
     assert "fleet_report.py" in tools
@@ -393,6 +394,88 @@ def test_bench_history_cli(tmp_path):
     # the regression replays as a partisan.perf.regression event
     events = [tuple(r["event"]) for r in lines if r.get("kind") == "event"]
     assert ("partisan", "perf", "regression") in events
+
+
+def _ops_journal_fixture(path, *, healed=True):
+    """A handcrafted ops-journal artifact: one injected partition,
+    detected at +2 — and (``healed``) recovered at +7.  The meta line
+    covers the health stream from round 0 so the cause is observable
+    (an uncovered stream would classify it unobservable, which never
+    gates)."""
+    lines = [
+        {"journal_meta": {"streams": {"inject": 0, "health": 0},
+                          "start": 0, "end": 30}},
+        {"round": 5, "stream": "inject", "event": "inject.Partition",
+         "cause_id": "5:inject.Partition"},
+        {"round": 7, "stream": "health",
+         "event": "partisan.health.partition_detected",
+         "measurements": {"components": 2}},
+    ]
+    if healed:
+        lines.append({"round": 12, "stream": "health",
+                      "event": "partisan.health.overlay_healed",
+                      "measurements": {"components": 1}})
+    with open(path, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+
+
+def test_incident_report_cli_gate(tmp_path):
+    """Incident-observatory CLI: a closed-span journal passes --gate
+    (exit 0) with the span's measured latencies on its ops_span line; a
+    journal whose incident never recovered fails it (exit 2, status
+    open) — the committed-artifact CI gate, end to end."""
+    good = tmp_path / "good.jsonl"
+    _ops_journal_fixture(good)
+    out = _run("incident_report.py", str(good), "--gate")
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert rows[-1]["kind"] == "summary" and rows[-1]["ok"] is True
+    (span,) = [r for r in rows if r["kind"] == "ops_span"]
+    assert span["rule"] == "partition" and span["status"] == "closed"
+    assert (span["detect_latency"], span["recover_latency"]) == (2, 7)
+    verdict = next(r for r in rows if r["kind"] == "ops_gate")
+    assert verdict["ok"] and verdict["closed"] == 1
+
+    bad = tmp_path / "bad.jsonl"
+    _ops_journal_fixture(bad, healed=False)
+    out = _run("incident_report.py", str(bad), "--gate")
+    assert out.returncode == 2, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    (span,) = [r for r in rows if r["kind"] == "ops_span"]
+    assert span["status"] == "open"
+    assert rows[-1]["kind"] == "summary" and rows[-1]["ok"] is False
+    # honest exit codes on argv misuse too
+    assert _run("incident_report.py").returncode != 0
+    assert _run("incident_report.py", str(good),
+                "--bogus").returncode != 0
+    assert _run("incident_report.py",
+                str(tmp_path / "missing.jsonl")).returncode != 0
+
+
+def test_trace_export_ops_cli_smoke(tmp_path):
+    """trace_export --ops, journal-only form (one positional): the
+    incident track renders as its own process with the injection
+    instant on the storm thread and the matched span as a duration
+    event from cause to recovery."""
+    jpath = tmp_path / "ops.jsonl"
+    _ops_journal_fixture(jpath)
+    out_path = tmp_path / "ops_trace.json"
+    out = _run("trace_export.py", str(out_path), "--ops", str(jpath))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "journal entries" in out.stderr, out.stderr
+    with open(out_path) as f:
+        events = json.load(f)["traceEvents"]
+    procs = [e for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert {p["args"]["name"] for p in procs} == {"partisan_ops"}
+    (inject,) = [e for e in events if e.get("cat") == "ops.inject"]
+    assert inject["ph"] == "i" and inject["name"] == "inject.Partition"
+    (span,) = [e for e in events if e.get("cat") == "ops.span"]
+    assert span["ph"] == "X" and span["name"] == "partition"
+    # cause round 5 -> recovery round 12, in --round-ms=1000 microseconds
+    assert (span["ts"], span["dur"]) == (5_000_000, 7_000_000)
+    assert span["args"]["status"] == "closed"
 
 
 def test_soak_report_traffic_smoke():

@@ -1,0 +1,104 @@
+"""Incident observatory report (the ``BENCH_*.json`` idiom: one
+self-describing JSON object per line).
+
+Loads an ops-journal JSON-lines artifact (``opslog.Journal.to_jsonl``
+— what ``scenarios.py --ops`` and ``soak_report.py`` commit), matches
+the incident-span catalog over it (``opslog.match``: every injected
+fault paired with its detection, reaction, and recovery, with measured
+round-latencies for each leg), accounts the per-channel SLO error
+budgets (``opslog.error_budgets``), and prints::
+
+    {"kind": "ops_span",   ...}   one per matched incident
+    {"kind": "ops_orphan", ...}   reactions no span claimed
+    {"kind": "ops_budget", ...}   one per polled channel
+    {"kind": "ops_gate",   ...}   the verdict (always printed)
+    {"kind": "summary",    ...}   last line, always
+
+Usage::
+
+    python tools/incident_report.py JOURNAL [--gate] [--slo-rounds N]
+        [--budget-frac F] [--exempt CH1,CH2] [--crowd-x1000 N]
+
+``--gate`` makes the exit status the verdict: nonzero when any
+observable incident stayed open or undetected, or a non-exempt
+channel's error budget exhausted (``opslog.gate``) — the scenario/CI
+gate for committed soak artifacts.  Budgets need ``--slo-rounds``
+(the journal's chunk entries must carry windowed p99 polls,
+``SoakConfig.poll_latency``); without it only spans gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+USAGE = ("usage: incident_report.py JOURNAL [--gate] [--slo-rounds N] "
+         "[--budget-frac F] [--exempt CH1,CH2] [--crowd-x1000 N]")
+
+
+def main() -> None:
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
+    VALUE_FLAGS = ("--slo-rounds", "--budget-frac", "--exempt",
+                   "--crowd-x1000")
+    argv = sys.argv[1:]
+    args, opts, do_gate = [], {}, False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in VALUE_FLAGS:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{a} needs a value\n{USAGE}")
+            opts[a] = argv[i + 1]
+            i += 2
+        elif a == "--gate":
+            do_gate = True
+            i += 1
+        elif a.startswith("--"):
+            raise SystemExit(f"unknown flag {a}\n{USAGE}")
+        else:
+            args.append(a)
+            i += 1
+    if len(args) != 1:
+        raise SystemExit(USAGE)
+    path = args[0]
+    if not os.path.exists(path):
+        raise SystemExit(f"no such journal: {path}")
+
+    from partisan_tpu import opslog
+
+    journal = opslog.Journal.from_jsonl(path)
+    crowd = opts.get("--crowd-x1000")
+    matched = opslog.match(
+        journal, crowd_x1000=int(crowd) if crowd else None)
+    for span in matched["spans"]:
+        print(json.dumps(span))
+    for orphan in matched["orphans"]:
+        print(json.dumps(orphan))
+    budgets = None
+    slo = opts.get("--slo-rounds")
+    if slo is not None:
+        budgets = opslog.error_budgets(
+            journal, slo_rounds=int(slo),
+            budget_frac=float(opts.get("--budget-frac", 0.25)))
+        for row in budgets:
+            print(json.dumps(row))
+    exempt = tuple(c for c in opts.get("--exempt", "").split(",") if c)
+    verdict = opslog.gate(matched, budgets, exempt=exempt)
+    print(json.dumps(verdict))
+    lo, hi = journal.span_window()
+    print(json.dumps({"kind": "summary", "entries": len(journal.entries),
+                      "start": lo, "end": hi,
+                      "streams": sorted(journal.streams),
+                      **matched["counts"], "ok": verdict["ok"]}))
+    if do_gate and not verdict["ok"]:
+        raise SystemExit(2)
+
+
+if __name__ == "__main__":
+    main()

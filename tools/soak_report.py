@@ -38,8 +38,11 @@ scale-out to full width plus a graceful scale-in (leave-path drain +
 in-scan deactivation) through the same storm: every chunk row carries
 the elastic operands in force (``elastic``: active width / pending
 drain / resize count), and the replayed ``partisan.elastic.*`` resize
-events print alongside the soak events.  Importable:
-``report(result)`` renders any ``soak.SoakResult``.
+events print alongside the soak events.  Every run also prints its
+matched incident spans (``ops_span`` lines — fault injected ->
+detected -> reacted -> recovered, with round latencies; opslog.py)
+and folds the span counts + gate verdict into the summary.
+Importable: ``report(result)`` renders any ``soak.SoakResult``.
 """
 
 from __future__ import annotations
@@ -52,12 +55,17 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def report(res, out=sys.stdout, channels=None, slo_rounds=None) -> dict:
+def report(res, out=sys.stdout, channels=None, slo_rounds=None,
+           storm=None) -> dict:
     """Dump a ``soak.SoakResult`` as JSON lines; returns (and prints as
     the last line) the summary dict.  ``channels`` optionally names the
     config's channels so controller shed events carry real labels;
     ``slo_rounds`` arms the traffic replay's breach-window events when
-    chunk rows carry the windowed p99 series."""
+    chunk rows carry the windowed p99 series.  ``storm`` (the timeline
+    the run was driven under) arms the incident observatory: the run
+    fuses into an ops journal (``opslog.from_soak``), the matched
+    detect->react->recover spans print as ``ops_span`` lines, and the
+    summary carries the span counts + gate verdict."""
     from partisan_tpu import telemetry
 
     for row in res.chunks:
@@ -114,6 +122,21 @@ def report(res, out=sys.stdout, channels=None, slo_rounds=None) -> dict:
                "healthy": res.healthy()}
     if disp:
         summary["gap_share"] = disp["gap_share"]
+    if storm is not None:
+        # the incident observatory: injected ground truth fused with
+        # every replayed stream, spans matched over the one timeline
+        from partisan_tpu import opslog
+
+        journal = opslog.from_soak(res, storm=storm, channels=channels,
+                                   slo_rounds=slo_rounds)
+        matched = opslog.match(journal)
+        for span in matched["spans"]:
+            print(json.dumps(span), file=out)
+        for orphan in matched["orphans"]:
+            print(json.dumps(orphan), file=out)
+        verdict = opslog.gate(matched)
+        print(json.dumps(verdict), file=out)
+        summary["ops"] = {**matched["counts"], "ok": verdict["ok"]}
     print(json.dumps(summary), file=out)
     return summary
 
@@ -303,7 +326,7 @@ def main() -> None:
         sleep_fn=lambda s: None)
     res = eng.run(st, rounds=rounds)
     report(res, channels=tuple(c.name for c in cl.cfg.channels),
-           slo_rounds=4 if traffic else None)
+           slo_rounds=4 if traffic else None, storm=storm)
 
 
 if __name__ == "__main__":

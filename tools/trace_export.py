@@ -23,17 +23,28 @@ Converts a :class:`partisan_tpu.trace.Trace` — whether captured by
   a parent-linked flow arrow (``ph: "s"`` on the parent's track at the
   parent's claim round -> ``ph: "f"`` on the child's track at its
   claim round, category ``round.provenance``) — Perfetto renders the
-  tree that ACTUALLY delivered each broadcast, Dapper-style.
+  tree that ACTUALLY delivered each broadcast, Dapper-style,
+- **the ops timeline as an incident track** (``--ops journal.jsonl``,
+  an ``opslog.Journal`` artifact): a second process (``partisan_ops``)
+  where every injected fault is an instant (``ph: "i"``, one storm
+  track) and every matched incident span a duration event (``ph:
+  "X"``) from its cause round to its recovery round — detection/
+  reaction/recovery latencies in the args, open spans extended to the
+  journal's end and suffixed ``(open)``.  With ``--ops`` alone the
+  wire trace may be omitted (one positional: ``out.json``); with both,
+  the tracks land in one file and the rounds line up.
 
 Usage::
 
     python tools/trace_export.py trace.npz out.json [--round-ms 1000]
-        [--provenance prov.npz]
+        [--provenance prov.npz] [--ops journal.jsonl]
+    python tools/trace_export.py out.json --ops journal.jsonl
 
 ``--provenance`` takes a snapshot saved with ``np.savez(path,
 **provenance.snapshot(state.provenance))``.  Importable:
 ``to_trace_events(trace)`` returns the event list;
-``to_flow_events(snap)`` the dissemination arrows; ``export(trace,
+``to_flow_events(snap)`` the dissemination arrows;
+``to_ops_events(journal)`` the incident track; ``export(trace,
 path)`` writes the JSON file.  Event-count contract
 (tests/test_latency.py roundtrip): the number of non-metadata events
 equals ``sum(1 for _ in trace.events())`` plus two per flow arrow —
@@ -53,6 +64,7 @@ from tools._lib.jaxcache import enable_persistent_cache
 enable_persistent_cache()
 
 PID = 1
+OPS_PID = 2          # the incident track renders as its own process
 
 # jax.named_scope phase labels (cluster.round_body) — the category each
 # event class maps to.
@@ -133,17 +145,79 @@ def to_flow_events(snap, *, slots=None, round_ms: int = 1000) -> list[dict]:
     return events
 
 
+def to_ops_events(journal, *, matched=None,
+                  round_ms: int = 1000) -> list[dict]:
+    """The incident track (``opslog``): injections as instants on one
+    storm track, matched spans as duration events (cause round ->
+    recovery round; open spans run to the journal's end, their name
+    suffixed ``(open)``) on one track per rule, all under a second
+    process so the ops timeline sits beside the wire trace with the
+    rounds aligned.  ``matched`` defaults to ``opslog.match(journal)``."""
+    from partisan_tpu import opslog
+
+    us = round_ms * 1000
+    if matched is None:
+        matched = opslog.match(journal)
+    _, jend = journal.span_window()
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": OPS_PID,
+         "args": {"name": "partisan_ops"}},
+        {"name": "thread_name", "ph": "M", "pid": OPS_PID, "tid": 0,
+         "args": {"name": "injected"}},
+    ]
+    for e in journal.sorted_entries():
+        if e.stream != "inject":
+            continue
+        events.append({
+            "name": e.event, "ph": "i", "ts": e.round * us,
+            "pid": OPS_PID, "tid": 0, "s": "t",
+            "cat": "ops.inject",
+            "args": _args({"round": e.round, "severity": e.severity,
+                           **e.measurements})})
+    rules = sorted({s["rule"] for s in matched["spans"]})
+    tids = {r: i + 1 for i, r in enumerate(rules)}
+    for r, tid in tids.items():
+        events.append({"name": "thread_name", "ph": "M", "pid": OPS_PID,
+                       "tid": tid, "args": {"name": f"incident {r}"}})
+    for s in matched["spans"]:
+        if s["status"] in ("undetected", "unobservable"):
+            continue
+        end = s["recover_round"] if s["recover_round"] is not None \
+            else jend
+        name = s["rule"] if s["status"] == "closed" \
+            else f"{s['rule']} (open)"
+        events.append({
+            "name": name, "ph": "X", "ts": s["cause_round"] * us,
+            "dur": max(end - s["cause_round"], 1) * us,
+            "pid": OPS_PID, "tid": tids[s["rule"]], "cat": "ops.span",
+            "args": _args({k: s[k] for k in (
+                "cause", "cause_round", "detect_event", "detect_round",
+                "detect_latency", "react_event", "react_round",
+                "react_latency", "recover_event", "recover_round",
+                "recover_latency", "status", "channel")})})
+    return events
+
+
+def _args(d: dict) -> dict:
+    return {k: v for k, v in d.items() if v is not None}
+
+
 def export(tr, path: str, *, round_ms: int = 1000,
            channels: tuple[str, ...] | None = None,
-           provenance=None, slots=None) -> int:
+           provenance=None, slots=None, ops=None) -> int:
     """Write ``{"traceEvents": [...]}`` to ``path``; returns the number
     of non-metadata events written.  ``provenance`` optionally merges a
     provenance snapshot's dissemination-tree flow arrows
-    (:func:`to_flow_events`) into the same file."""
-    events = to_trace_events(tr, round_ms=round_ms, channels=channels)
+    (:func:`to_flow_events`) into the same file; ``ops`` (an
+    ``opslog.Journal``) the incident track (:func:`to_ops_events`).
+    ``tr=None`` with ``ops`` exports the incident track alone."""
+    events = [] if tr is None else \
+        to_trace_events(tr, round_ms=round_ms, channels=channels)
     if provenance is not None:
         events += to_flow_events(provenance, slots=slots,
                                  round_ms=round_ms)
+    if ops is not None:
+        events += to_ops_events(ops, round_ms=round_ms)
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)
@@ -151,7 +225,8 @@ def export(tr, path: str, *, round_ms: int = 1000,
 
 
 USAGE = ("usage: trace_export.py <trace.npz> <out.json> [--round-ms N] "
-         "[--provenance prov.npz]")
+         "[--provenance prov.npz] [--ops journal.jsonl] | "
+         "trace_export.py <out.json> --ops journal.jsonl")
 
 
 def main() -> None:
@@ -162,7 +237,7 @@ def main() -> None:
         print(USAGE)
         print(__doc__.strip())
         return
-    round_ms, prov_path, args, i = 1000, None, [], 0
+    round_ms, prov_path, ops_path, args, i = 1000, None, None, [], 0
     while i < len(argv):
         a = argv[i]
         if a.startswith("--round-ms"):
@@ -177,10 +252,18 @@ def main() -> None:
             else:
                 i += 1
                 prov_path = argv[i]
+        elif a.startswith("--ops"):
+            if "=" in a:
+                ops_path = a.split("=", 1)[1]
+            else:
+                i += 1
+                ops_path = argv[i]
         else:
             args.append(a)
         i += 1
-    if len(args) != 2:
+    # Two positionals (trace in, json out) normally; ops-only export
+    # takes just the output path.
+    if len(args) not in ((1, 2) if ops_path is not None else (2,)):
         print(USAGE, file=sys.stderr)
         raise SystemExit(2)
     snap = None
@@ -189,10 +272,18 @@ def main() -> None:
 
         with np.load(prov_path) as z:
             snap = {k: z[k] for k in z.files}
-    tr = Trace.load(args[0])
-    n = export(tr, args[1], round_ms=round_ms, provenance=snap)
-    print(f"{n} events ({tr.n_rounds} rounds, {tr.n_nodes} nodes) "
-          f"-> {args[1]}", file=sys.stderr)
+    ops = None
+    if ops_path is not None:
+        from partisan_tpu import opslog
+
+        ops = opslog.Journal.from_jsonl(ops_path)
+    tr = Trace.load(args[0]) if len(args) == 2 else None
+    out = args[-1]
+    n = export(tr, out, round_ms=round_ms, provenance=snap, ops=ops)
+    shape = (f"{tr.n_rounds} rounds, {tr.n_nodes} nodes"
+             if tr is not None else
+             f"{len(ops.entries)} journal entries")
+    print(f"{n} events ({shape}) -> {out}", file=sys.stderr)
 
 
 if __name__ == "__main__":
