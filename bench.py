@@ -56,7 +56,7 @@ PER_SIZE_CAP_S = 340.0         # no single rung may eat the whole budget
 
 def run(n: int, verbose: bool = False, metrics: bool = False,
         latency: bool = False, health: bool = False,
-        provenance: bool = False) -> dict:
+        provenance: bool = False, superstep: int = 1) -> dict:
     from partisan_tpu.config import Config, HyParViewConfig, \
         PlumtreeConfig
     from partisan_tpu.models.plumtree import Plumtree
@@ -129,6 +129,12 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
                       # bootstrap ladder: rung width rides the n_active
                       # operand instead of recompiling per width
                       width_operand=True,
+                      # opt-in fused supersteps (--superstep R): R
+                      # rounds per scan step, one execution per
+                      # K_PROG/R steps — program size O(1) in R
+                      # (tests/test_program_budget.py), bit parity
+                      # pinned in tests/test_superstep.py
+                      superstep=superstep,
                       hyparview=HyParViewConfig(
                           isolation_window_ms=25_000),
                       plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
@@ -298,6 +304,8 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
               "dropped": int(st.stats.dropped),
               "emitted": int(st.stats.emitted),
               "phases": phases}
+    if superstep > 1:   # keys the history ledger's config like-for-like
+        result["superstep"] = superstep
     if metrics:
         # Per-round series (the most recent metrics_ring rounds) to
         # stderr as JSON lines; stdout keeps the one-line contract.
@@ -361,7 +369,8 @@ def run(n: int, verbose: bool = False, metrics: bool = False,
 
 
 def _run_one_subprocess(n: int, timeout_s: float,
-                        cache_dir: str | None = None) -> dict | None:
+                        cache_dir: str | None = None,
+                        superstep: int = 1) -> dict | None:
     """Run one ladder size in a FRESH interpreter: a TPU device error
     poisons the process context, so in-process retries always fail —
     subprocess isolation makes each attempt independent.  ``cache_dir``
@@ -372,6 +381,8 @@ def _run_one_subprocess(n: int, timeout_s: float,
     cmd = [sys.executable, __file__, "--one", str(n)]
     if cache_dir is not None:
         cmd += ["--cache-dir", cache_dir]
+    if superstep > 1:
+        cmd += ["--superstep", str(superstep)]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout_s)
@@ -642,6 +653,12 @@ def main() -> None:
 
     t_start = time.time()
     results: dict[int, dict] = {}
+    # --superstep R: run the whole ladder with R rounds fused per scan
+    # step; the artifact and its ledger rows key as config bench-ssR so
+    # history deltas stay like-for-like (perfwatch.doc_rows).
+    superstep = 1
+    if "--superstep" in sys.argv:
+        superstep = int(sys.argv[sys.argv.index("--superstep") + 1])
 
     def remaining() -> float:
         return TIME_BUDGET_S - (time.time() - t_start) - 10
@@ -662,7 +679,8 @@ def main() -> None:
             if not runs and remaining() < (60 if results else 120):
                 break
             got = _run_one_subprocess(
-                n, timeout_s=max(60.0, min(PER_SIZE_CAP_S, remaining())))
+                n, timeout_s=max(60.0, min(PER_SIZE_CAP_S, remaining())),
+                superstep=superstep)
             if got is not None:
                 runs.append(got)
             else:
@@ -692,7 +710,7 @@ def main() -> None:
             try:
                 cold = _run_one_subprocess(
                     top_n, timeout_s=max(60.0, remaining()),
-                    cache_dir=cold_dir)
+                    cache_dir=cold_dir, superstep=superstep)
             finally:
                 # the cold cache holds the full serialized round
                 # program (~60 MB at 100k) — never reused, always
@@ -741,6 +759,8 @@ def main() -> None:
                        "(tools/traces/trace16.json); no live BEAM in "
                        "image"),
     }
+    if superstep > 1:
+        doc["superstep"] = superstep
     doc["bench_history"] = _history_card(doc)
     print(json.dumps(doc))
 
@@ -780,7 +800,9 @@ if __name__ == "__main__":
                 metrics="--metrics" in sys.argv,
                 latency="--latency" in sys.argv,
                 health="--health" in sys.argv,
-                provenance="--provenance" in sys.argv)
+                provenance="--provenance" in sys.argv,
+                superstep=(int(sys.argv[sys.argv.index("--superstep") + 1])
+                           if "--superstep" in sys.argv else 1))
         print(json.dumps({"size_phases": {str(r["n"]): r["phases"]}}),
               file=sys.stderr)
         print(json.dumps(r))

@@ -643,8 +643,18 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                 cfg, comm, pspec, pv, rnd=state.rnd, emitted=prov_stack,
                 inbox_data=inbox.data, dead=dead,
                 alive_local=alive_local)
+    # Dead-receiver stage.  On the fast wire path the data mask is the
+    # identity: wire_cut_from_info severs every edge whose destination
+    # is dead (~alive_d, from the SAME faults_wire.alive that `dead`
+    # complements), so no record addressed to a dead row survives into
+    # route — skipping the per-plane [n, cap, ·] select consumes the
+    # routed inbox in place (phase fusion; the generic path keeps the
+    # mask because interposition chains may rewrite destinations after
+    # the fault filter).  The count/drops arithmetic stays: [n]-vector
+    # work is free and keeps the books uniform across both paths.
     inbox = exchange.Inbox(
-        data=plane_ops.where(dead[:, None], 0, inbox.data),
+        data=inbox.data if fast_wire
+        else plane_ops.where(dead[:, None], 0, inbox.data),
         count=jnp.where(dead, 0, inbox.count),
         drops=inbox.drops + jnp.where(dead, inbox.count, 0),
     )
@@ -930,9 +940,34 @@ class Cluster:
                           state, interpose=self.interpose)
 
     def _scan(self, state: ClusterState, k: int) -> ClusterState:
-        return jax.lax.scan(
-            lambda s, _: (self._round(s), None), state, None, length=k
-        )[0]
+        # Fused supersteps (Config.superstep=R): an outer scan whose
+        # body is an inner R-round scan.  The round body still traces
+        # exactly ONCE (the inner scan's jaxpr is shared by reference
+        # in the outer body), so program size is O(1) in R — guarded by
+        # tests/test_program_budget.py::test_superstep_program_o1 —
+        # and the result is the same R*outer+rem sequential round
+        # applications as the flat scan: bit-identical for any R.
+        # Cadence conds inside round_body key off the carried
+        # state.rnd, never the scan index, so health/control/flight/
+        # elastic fire on true round numbers across the fold.
+        R = self.cfg.superstep
+        if R <= 1:
+            return jax.lax.scan(
+                lambda s, _: (self._round(s), None), state, None, length=k
+            )[0]
+        outer, rem = divmod(k, R)
+
+        def inner(s, r):
+            return jax.lax.scan(
+                lambda t, _: (self._round(t), None), s, None, length=r)[0]
+
+        if outer:
+            state = jax.lax.scan(
+                lambda s, _: (inner(s, R), None), state, None,
+                length=outer)[0]
+        if rem:   # R non-divisors of k: a remainder scan, same body
+            state = inner(state, rem)
+        return state
 
     def _round_traced(self, state: ClusterState):
         return round_body(self.cfg, self.manager, self.model, self.comm,

@@ -429,6 +429,24 @@ class Config:
     max_broadcasts: int = 64     # concurrent broadcast slots (plumtree/anti-entropy)
     n_actors: int = 64           # vclock width for causal delivery
     seed: int = 0                # deterministic seeding (partisan_config:seed/0)
+    superstep: int = 1           # rounds fused per scan step: steps(k)
+    #                              runs an outer scan of ceil(k/R) fused
+    #                              R-round inner scans (+ a remainder
+    #                              scan when R does not divide k).  The
+    #                              round body traces ONCE either way —
+    #                              program size is O(1) in R (the
+    #                              superstep rung of the jaxlint
+    #                              matrix) — but each soak/bench
+    #                              dispatch now carries R rounds, so
+    #                              the ~80 ms host round-trip amortizes
+    #                              R-fold and soak's chunk_cap lifts to
+    #                              a memory-meter-guarded cap*R
+    #                              (ROADMAP item 1 "dispatch wall").
+    #                              Cadence conds (health, control,
+    #                              flight, elastic drain) key off the
+    #                              CARRIED round counter, so any R is
+    #                              bit-identical to superstep=1
+    #                              (tests/test_superstep.py).
 
     # --- channel capacity enforcement ----------------------------------
     channel_capacity: bool = False  # enforce ChannelSpec.parallelism as
@@ -648,6 +666,9 @@ class Config:
                 raise ValueError(f"channel {c.name}: parallelism must be >= 1")
         if self.msg_words < 8:
             raise ValueError("msg_words must be >= 8 (header is 8 words)")
+        if self.superstep < 1:
+            raise ValueError(
+                f"superstep must be >= 1, got {self.superstep}")
         if self.partition_mode not in ("auto", "dense", "groups"):
             raise ValueError(
                 f"partition_mode {self.partition_mode!r} not in "

@@ -96,7 +96,7 @@ def edge_hash(seed: int | Array, rnd: Array, salt: int, src: Array,
                 + jnp.uint32(salt & 0xFFFFFFFF))
     h = _mix32(jnp.asarray(src, jnp.uint32) ^ jnp.uint32(0x9E3779B1))
     h = _mix32(h ^ jnp.asarray(dst, jnp.uint32))
-    h = _mix32(h ^ jnp.asarray(rnd, jnp.uint32) ^ site)
+    h = _mix32(h ^ (jnp.asarray(rnd, jnp.uint32) ^ site))
     return h
 
 
@@ -106,8 +106,11 @@ def hash_bernoulli(h: Array, p: Array) -> Array:
     [0, 1 - 2^-24]: p=1.0 fires always, p=0.0 never (a 32-bit h/2^32
     would round up to exactly 1.0 for h >= 0xFFFFFF80 and break
     drop-everything scenarios)."""
-    u = (h >> 8).astype(jnp.float32) / jnp.float32(2**24)
-    return u < jnp.asarray(p, jnp.float32)
+    # u < p with u = (h>>8)/2^24 — compare at the integer scale instead
+    # so the power-of-two normalization rides the SCALAR side (exact
+    # either way; one full-width divide less on the wire-cut path).
+    return (h >> 8).astype(jnp.float32) < \
+        jnp.asarray(p, jnp.float32) * jnp.float32(2**24)
 
 
 def edge_cut(faults: FaultState, src: Array, dst: Array, seed: int,

@@ -18,7 +18,12 @@ and intermediate bytes scale ~linearly in n, so a 32-node pin gates the
 
 History: pinned at PR 11's gather-coalesced round — 59 gather/scatter
 eqns in the plain 32k round vs 102 at PR 10 (-42%), 1716.5 MiB vs
-2472.8 MiB materialized [n, ., .] intermediates (-31%).
+2472.8 MiB materialized [n, ., .] intermediates (-31%).  Re-pinned at
+ISSUE 18's outlier-driven phase fusion (rank32 XOR-reassociation +
+single-pass murmur mix, integer-threshold fault draws, packed plumtree
+flag fold, dead fast-wire column skip): 1402.0 MiB in the plain 32k
+round vs 1716.5 (-18.3%), every matrix entry's bytes/eqns down in
+lockstep, gather/scatter counts unchanged.
 """
 
 from __future__ import annotations
@@ -43,13 +48,27 @@ DRY_1M: dict = {
     "state_mib_per_device": 176.0,
 }
 
+# The superstep cap-lift admission budget (ISSUE 18): soak's sizer may
+# stretch one execution past chunk_cap rounds (to chunk_cap * R under
+# Config.superstep=R) ONLY when the round program's materialized-
+# intermediate census at the cluster's requested n clears this
+# per-device pin — a longer execution holds its dispatch open past the
+# envelope chunk_cap was measured under, so admission is justified by
+# measured headroom, never assumed.  2048 MiB admits the plain 32k
+# bench round (1402.0 MiB at the round-8 fusion, BENCH_NOTES) with
+# ~45% headroom while refusing ~100k+ rounds whose per-round
+# intermediates alone approach device HBM.  Soak._superstep_guard
+# evaluates it abstractly (no compile); tests/test_superstep.py gates
+# both verdict directions.
+SUPERSTEP_INTERM_BUDGET_MIB = 2048.0
+
 BUDGETS: dict = {
     # The plain bench round (hyparview+plumtree, planes off) — the hot
     # path every BENCH_r0x prices.
     "round/planes-off": {
         "gather_scatter": 56,
-        "interm_kib": 1884.0,
-        "eqns": 3355,
+        "interm_kib": 1556.1,
+        "eqns": 3173,
     },
     # Every observability plane + the width operand — the bench/soak
     # shape with full accounting on.  Re-pinned at ISSUE 13's
@@ -63,8 +82,8 @@ BUDGETS: dict = {
     # drops stack, priced at one broadcast + one add.)
     "round/all-planes+width": {
         "gather_scatter": 114,
-        "interm_kib": 2322.0,
-        "eqns": 4295,
+        "interm_kib": 1984.4,
+        "eqns": 4104,
     },
     # The open-loop traffic generator over the plain round (PR 12):
     # +2 gather/scatter (the burst-slot arrival draw's emission build)
@@ -73,8 +92,8 @@ BUDGETS: dict = {
     # OFF is bit-identical to "round/planes-off" (zero-cost rule).
     "round/traffic": {
         "gather_scatter": 58,
-        "interm_kib": 1945.0,
-        "eqns": 3502,
+        "interm_kib": 1614.0,
+        "eqns": 3320,
     },
     # The elastic round (ISSUE 15): width operand + the in-scan drain
     # gauge/resize ring + the traffic generator with drain
@@ -85,8 +104,8 @@ BUDGETS: dict = {
     # to the planes-off round (zero-cost rule).
     "round/elastic": {
         "gather_scatter": 61,
-        "interm_kib": 1945.0,
-        "eqns": 3549,
+        "interm_kib": 1614.2,
+        "eqns": 3367,
     },
     # The ingress-armed round (ISSUE 15): staged-request release over
     # the plain round — ZERO extra gathers/scatters (the inject buffer
@@ -96,8 +115,8 @@ BUDGETS: dict = {
     # audits the chunked shape the soak engine dispatches.
     "round/ingress": {
         "gather_scatter": 56,
-        "interm_kib": 1915.0,
-        "eqns": 3422,
+        "interm_kib": 1586.0,
+        "eqns": 3240,
     },
     # The vmapped fleet round (ISSUE 14): W=4 members of the plain
     # hyparview+plumtree round batched by fleet.Fleet.  The
@@ -111,6 +130,6 @@ BUDGETS: dict = {
     "fleet/round": {
         "gather_scatter": 58,
         "interm_kib": 19.0,
-        "eqns": 5221,
+        "eqns": 5019,
     },
 }

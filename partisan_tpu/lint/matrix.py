@@ -256,6 +256,15 @@ def default_matrix() -> list[Program]:
                        base_cfg(ingress=IngressConfig(enabled=True,
                                                       slots=4)),
                        scan=4),
+        # fused supersteps (ISSUE 18): the nested round scan — outer
+        # scan of length-R inner scans plus a same-body remainder —
+        # over the everything-on carry, at an R that does NOT divide
+        # the scan length so BOTH nest arms trace.  Every program rule
+        # (no-host-callback, interleave, narrow dtypes, scatter
+        # overlap) must hold through the nesting, and the eqn census
+        # pins the O(1)-in-R program size the soak cap lift assumes.
+        _round_program("scan/superstep",
+                       full_cfg(n=16, superstep=4), scan=6),
         # the sharded-by-default path (ROADMAP item 2): the plain
         # sharded round and the health-carrying one, traced through a
         # real shard_map on the 8-virtual-device host mesh — the

@@ -374,10 +374,15 @@ class Plumtree:
             acc = jnp.zeros((n_local, B, K), jnp.int32).at[
                 r2e, b, jnp.where(ks_ok, ki, K)].add(packed_c,
                                                      mode="drop")
+            # Field tests without unpacking: counts can't carry across
+            # the 10-bit fields (each <= cap <= 1023), so mask-in-place
+            # reads field 2 and the top field needs no mask at all
+            # (acc < 2**30 keeps the arithmetic shift positive) — two
+            # fewer full [n, B, K] intermediates, same booleans.
             prune_req = (acc & 1023) > 0
-            unprune = ((acc >> 10) & 1023) > 0
+            unprune = (acc & (1023 << 10)) != 0
             pruned = (pruned | prune_req) & ~unprune
-            lazyp = lazyp & ~(((acc >> 20) & 1023) > 0)
+            lazyp = lazyp & ~((acc >> 20) > 0)
 
             # ---- per-slot replies (against the round-start store) -----
             present_b = hd.present(data_b)                          # [n, cap]

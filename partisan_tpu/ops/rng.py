@@ -59,12 +59,17 @@ def rank32(seed: int | jax.Array, rnd: jax.Array, tag: int, a, b=0,
     """Deterministic uint32 ranking keys from integer coordinates.
 
     The cheap alternative to deriving per-site threefry keys + gumbel
-    tables on the round's hot path: two murmur3 finalizer passes over a
+    tables on the round's hot path: ONE murmur3 finalizer pass over a
     multiplicative-xor combine of (node, slot, element, round, call
-    site).  Uniform ranking by these keys is equivalent to gumbel-top-k
-    sampling for uniform choice, and the keys are placement-invariant
-    (coordinates are global ids) — the same determinism contract as
-    :func:`node_keys`, at a fraction of the memory traffic.
+    site).  fmix32 is a full-avalanche finalizer by construction, so a
+    second pass adds no sampling quality — it only doubled the
+    dominant full-width hash-chain traffic the round-cost census
+    prices (BENCH_NOTES "bytes floor"; dropped in the phase-fusion
+    PR, streams re-pinned).  Uniform ranking by these keys is
+    equivalent to gumbel-top-k sampling for uniform choice, and the
+    keys are placement-invariant (coordinates are global ids) — the
+    same determinism contract as :func:`node_keys`, at a fraction of
+    the memory traffic.
 
     ``tag`` namespaces call sites (use distinct small ints).  Streams are
     independent of :func:`partisan_tpu.faults.edge_hash` by construction
@@ -83,12 +88,16 @@ def rank32(seed: int | jax.Array, rnd: jax.Array, tag: int, a, b=0,
     else:
         site = (jnp.asarray(seed, jnp.uint32) * jnp.uint32(0x27D4EB2F)
                 + jnp.uint32((tag * 0x165667B1) & 0xFFFFFFFF))
-    x = (jnp.asarray(a, jnp.uint32) * jnp.uint32(0x9E3779B1)
-         ^ jnp.asarray(b, jnp.uint32) * jnp.uint32(0x85EBCA77)
-         ^ jnp.asarray(c, jnp.uint32) * jnp.uint32(0xC2B2AE3D)
-         ^ (jnp.asarray(rnd, jnp.uint32) * jnp.uint32(0x27D4EB2F)
-            + site))
-    return _mix32(_mix32(x))
+    # XOR is associative: fold the (usually low-rank) b/c/round terms
+    # first so only ONE combine broadcasts to the full [n, ...] key
+    # shape — the a-term — instead of three (phase-fusion contract:
+    # same bits, fewer full-width intermediates for lint/cost.py).
+    rest = (jnp.asarray(b, jnp.uint32) * jnp.uint32(0x85EBCA77)
+            ^ jnp.asarray(c, jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+            ^ (jnp.asarray(rnd, jnp.uint32) * jnp.uint32(0x27D4EB2F)
+               + site))
+    x = jnp.asarray(a, jnp.uint32) * jnp.uint32(0x9E3779B1) ^ rest
+    return _mix32(x)
 
 
 def choice_slots(key: jax.Array, valid: jax.Array, k: int) -> jax.Array:

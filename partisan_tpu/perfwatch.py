@@ -343,15 +343,25 @@ def dispatch_timeline(step: Callable, sync: Callable, state,
 
 
 def decompose(records: list[dict]) -> dict:
-    """Split a timeline into in-execution vs dispatch-gap time."""
+    """Split a timeline into in-execution vs dispatch-gap time.
+
+    Pipelined rows (soak's ``pipeline_depth >= 2``) carry ``busy_s``
+    — the ready-to-ready execution span — because their ``wall_s``
+    includes queue wait behind the previous in-flight chunk, and
+    double-counting the overlap would inflate in-execution time past
+    the wall clock.  Their gaps are already clamped to true stalls
+    (zero when the device never idled), so the gap column keeps
+    meaning "device waited on the host" in both regimes."""
     rows = [r for r in records if r.get("wall_s") is not None]
     if not rows:
         return {}
-    exec_s = sum(r["wall_s"] for r in rows)
-    gaps = [r["gap_s"] for r in rows if r.get("gap_s") is not None]
+    exec_s = sum(r["busy_s"] if r.get("busy_s") is not None
+                 else r["wall_s"] for r in rows)
+    gaps = [max(0.0, r["gap_s"]) for r in rows
+            if r.get("gap_s") is not None]
     gap_s = sum(gaps)
     total = exec_s + gap_s
-    return {
+    out = {
         "chunks": len(rows),
         "in_execution_s": round(exec_s, 4),
         "gap_s": round(gap_s, 4),
@@ -359,13 +369,19 @@ def decompose(records: list[dict]) -> dict:
         "per_chunk_gap_ms": (round(1000.0 * gap_s / len(gaps), 3)
                              if gaps else None),
     }
+    overlapped = sum(1 for r in rows if r.get("pipelined"))
+    if overlapped:
+        out["overlapped_chunks"] = overlapped
+    return out
 
 
 def decompose_chunks(chunks: list[dict]) -> dict:
     """`decompose` over soak.run_chunked chunk rows (their ``wall_s`` /
-    ``gap_s`` fields are already submit→ready brackets)."""
+    ``gap_s`` fields are already submit→ready brackets; pipelined rows
+    pass ``busy_s``/``pipelined`` through for the overlapped regime)."""
     return decompose([
-        {"wall_s": c.get("wall_s"), "gap_s": c.get("gap_s")}
+        {"wall_s": c.get("wall_s"), "gap_s": c.get("gap_s"),
+         "busy_s": c.get("busy_s"), "pipelined": c.get("pipelined")}
         for c in chunks if isinstance(c, dict) and "wall_s" in c])
 
 
@@ -525,9 +541,17 @@ def doc_rows(doc: dict, source: str, *, pallas: str | None = None,
     if isinstance(probe, dict) and probe.get("verdict"):
         pallas = probe["verdict"]
 
+    # Superstep runs (bench.py --superstep R) are keyed as their own
+    # config: R rounds fused per scan step changes what one execution
+    # means, so deltas/--check must only ever compare like-for-like —
+    # a fused run regressing against a plain prior (or vice versa) is
+    # a config change, not a perf signal.
+    ss = int(parsed.get("superstep") or 1)
+    cfg_label = "bench" if ss <= 1 else f"bench-ss{ss}"
+
     def bench_row(n: int, rps, conv=None, conv_wall=None) -> dict:
         return {"kind": "bench", "source": source, "n": int(n),
-                "config": "bench", "host": host,
+                "config": cfg_label, "host": host,
                 "rounds_per_sec": (round(float(rps), 4)
                                    if rps is not None else None),
                 "convergence_rounds": (int(conv)
@@ -582,7 +606,10 @@ def artifact_rows(path: str, **kw) -> list[dict]:
 def _row_key(row: dict) -> tuple:
     if row.get("kind") == "multichip":
         return ("multichip", row.get("source"), row.get("n_devices"))
-    return ("bench", row.get("source"), row.get("n"))
+    # config in the key: one artifact may carry plain AND superstep
+    # rows for the same (source, n) — both must land
+    return ("bench", row.get("source"), row.get("n"),
+            row.get("config", "bench"))
 
 
 def read_ledger(path: str) -> list[dict]:

@@ -970,7 +970,8 @@ def config6_echo(n=2, sizes_kb=(1024, 2048, 4096, 8192),
             "measured_runs": n_runs, "rows": rows}
 
 
-def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200):
+def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200,
+                 superstep=1, pipeline=1):
     """Long-horizon soak (ROADMAP item 4): a repeating fault storm —
     iid link drop → heal → crash batch → full partition → heal+revive →
     churn ticks → heal — driven for thousands of rounds through the
@@ -980,7 +981,10 @@ def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200):
     retried from the last checkpoint, and the health digest polled per
     chunk (one int32) as the convergence signal.  Per-chunk rows go to
     stderr as JSON lines (``kind: soak_chunk``); the stdout object
-    carries the engine's recovery/breach accounting."""
+    carries the engine's recovery/breach accounting.  ``superstep``
+    fuses R rounds per scan step (the engine's guarded cap lift
+    engages); ``pipeline`` >= 2 keeps that many chunk executions in
+    flight between boundaries (ISSUE 18)."""
     from partisan_tpu import health as health_mod
     from partisan_tpu import soak as soak_mod
     from partisan_tpu.cluster import Cluster
@@ -994,6 +998,7 @@ def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200):
             n_nodes=n, seed=7, peer_service_manager="hyparview",
             msg_words=16, partition_mode="groups",
             health=K_PROG, health_ring=512,
+            superstep=superstep,
             emit_compact=32 if n > 4096 else 0)), model=Plumtree())
 
     cl = mk()
@@ -1018,7 +1023,8 @@ def config7_soak(n=10_000, rounds=2000, ckpt_dir=None, storm_period=200):
         make_cluster=lambda: warm.pop() if warm else mk(), storm=storm,
         invariants=[soak_mod.conservation()],
         cfg=soak_mod.SoakConfig(checkpoint_dir=ckpt_dir,
-                                checkpoint_every=10 * K_PROG))
+                                checkpoint_every=10 * K_PROG,
+                                pipeline_depth=pipeline))
     t0 = time.perf_counter()
     res = eng.run(st, rounds=rounds)
     wall = time.perf_counter() - t0
@@ -2210,7 +2216,8 @@ def _run_cli(args):
     if args.soak:
         out7 = config7_soak(
             n=max(64, int(DEFAULT_SIZES[7] * args.scale)),
-            rounds=args.soak_rounds, ckpt_dir=args.ckpt_dir)
+            rounds=args.soak_rounds, ckpt_dir=args.ckpt_dir,
+            superstep=args.superstep, pipeline=args.pipeline)
         print(json.dumps(out7), flush=True)
         if not out7.get("ops", {}).get("ok", True):
             raise SystemExit(1)
@@ -2255,6 +2262,14 @@ if __name__ == "__main__":
                          "(equivalent to --only 7)")
     ap.add_argument("--soak-rounds", type=int, default=2000,
                     help="soak horizon in rounds (with --soak)")
+    ap.add_argument("--superstep", type=int, default=1, metavar="R",
+                    help="with --soak: fuse R rounds per scan step "
+                         "(Config.superstep; the engine's census-"
+                         "guarded cap lift engages)")
+    ap.add_argument("--pipeline", type=int, default=1, metavar="D",
+                    help="with --soak: keep up to D chunk executions "
+                         "in flight between checkpoint/storm "
+                         "boundaries (SoakConfig.pipeline_depth)")
     ap.add_argument("--elastic", action="store_true",
                     help="run the runtime-elasticity scenario (config "
                          "9) only: scale half->full->quarter mid-storm "

@@ -25,6 +25,26 @@ quantized to a 1-2-5 ladder so the number of distinct scan programs
 stays O(log cap) (scan-length changes recompile the round at full
 width — the round-2 program-discipline lesson).
 
+**Fused supersteps & pipelined dispatch** (ISSUE 18).  When the
+cluster folds R rounds into each scan step (``Config.superstep=R``),
+one execution of ``chunk_cap`` scan steps advances ``chunk_cap * R``
+rounds at the SAME program length, so the sizer lifts the hard cap to
+``chunk_cap * R`` — but only after a memory-meter guard
+(:meth:`Soak._superstep_guard`): the round program's materialized-
+intermediate census (lint/cost.py, abstract, at the requested n) must
+clear the pinned ``cost_budgets.SUPERSTEP_INTERM_BUDGET_MIB`` budget
+before a longer-than-measured execution is admitted.  Adaptive chunk
+lengths quantize to ladder multiples of R so guarded executions are
+whole supersteps and the distinct-program count stays O(log cap).
+Orthogonally, ``SoakConfig.pipeline_depth >= 2`` pipelines dispatch:
+chunk i+1 is submitted before blocking on chunk i, overlapping host
+bookkeeping with device execution inside boundary-free STRETCHES —
+boundary work (invariants, checkpoints, storm actions, ingress
+drains) runs only where the pipeline is drained, and stretches never
+cross a storm event, a recorded ingress round, a checkpoint-due round
+or the soak end, so the state evolution stays bit-identical to the
+synchronous protocol (tests/test_soak.py pipelined-parity suite).
+
 **Crash-safe execution.**  Every chunk dispatch is guarded: a
 ``jax.errors.JaxRuntimeError`` (worker crash) triggers
 retry-with-backoff — cool down (doubling), rebuild the cluster through
@@ -469,6 +489,14 @@ class SoakConfig:
     #                               engine diffs cumulative histograms
     #                               between boundaries — the SLO-window
     #                               series replay_traffic_events reads
+    pipeline_depth: int = 1       # >=2: pipelined chunk dispatch —
+    #                               keep up to this many chunk
+    #                               submissions in flight between
+    #                               boundaries (1 = the synchronous
+    #                               protocol).  Needs checkpoint_every
+    #                               > 0: with 0 every boundary
+    #                               checkpoints, so there is nothing to
+    #                               overlap and the loop runs sync.
 
 
 @dataclasses.dataclass
@@ -478,7 +506,11 @@ class SoakResult:
     chunks: list[dict]            # per-chunk rows (round, k, wall_s,
     #   per_round_s, rounds_per_s, gap_s = host time since the previous
     #   chunk's device-ready — perfwatch.decompose_chunks splits the
-    #   run into in-execution vs dispatch-gap time from these)
+    #   run into in-execution vs dispatch-gap time from these).
+    #   Pipelined rows (submitted before the previous chunk's ready)
+    #   add pipelined=True and busy_s (ready-to-ready execution span —
+    #   wall_s includes queue wait there, and gap_s is clamped to true
+    #   stalls only)
     log: list[dict]               # recovery/breach event log
     retries: int
     breaches: int
@@ -526,6 +558,8 @@ class Soak:
         #                           at the checkpoint's histograms on
         #                           restore so replayed windows match
         #                           the rows the rewind dropped)
+        self._cap_lift = None     # superstep cap-lift verdict cache
+        self._cap_info: dict = {}  # ... and the census evidence for it
 
     # ---- pieces -------------------------------------------------------
     def _cluster(self):
@@ -635,19 +669,78 @@ class Soak:
             breaches += 1
         return breaches
 
+    def _superstep(self) -> int:
+        """Rounds fused per scan step by the cluster
+        (``Config.superstep``, 1 for cluster-likes without one)."""
+        cfg = getattr(self._cluster(), "cfg", None)
+        return max(1, int(getattr(cfg, "superstep", 1) or 1))
+
+    def _superstep_guard(self) -> tuple[bool, dict]:
+        """Memory-meter gate for the superstep cap lift: a
+        longer-than-measured single execution is only admitted when the
+        round program's materialized-intermediate census (lint/cost.py
+        — abstract trace at the cluster's REQUESTED n, no compile, no
+        device) clears the pinned per-device budget
+        ``cost_budgets.SUPERSTEP_INTERM_BUDGET_MIB``.  Cluster-likes
+        without a traceable single-device round (sharded wrappers, test
+        doubles) never lift — the measured-safe cap stands."""
+        cl = self._cluster()
+        try:
+            from partisan_tpu.lint.core import trace_program
+            from partisan_tpu.lint.cost import census_program
+            from partisan_tpu.lint.cost_budgets import (
+                SUPERSTEP_INTERM_BUDGET_MIB)
+
+            state = jax.eval_shape(cl._build_init)
+            prog = trace_program(
+                f"soak/superstep-{cl.cfg.n_nodes}", cl._round, state,
+                cl.cfg)
+            mib = census_program(prog).total.interm_bytes / 2**20
+            return mib <= SUPERSTEP_INTERM_BUDGET_MIB, {
+                "interm_mib": round(mib, 2),
+                "budget_mib": SUPERSTEP_INTERM_BUDGET_MIB}
+        except Exception as exc:   # no census, no lift
+            return False, {"error": repr(exc)[:200]}
+
+    def _chunk_cap(self) -> int:
+        """Per-execution round cap.  ``Config.superstep=R`` folds R
+        rounds into each scan step, so ``chunk_cap`` scan steps advance
+        ``chunk_cap * R`` rounds at the SAME program length — the cap
+        lifts by R, but only once the memory meter
+        (:meth:`_superstep_guard`) clears: a longer execution holds its
+        dispatch open past the envelope ``chunk_cap`` was measured
+        under, and admission must be justified by headroom, not hoped.
+        The verdict is cached per engine (per rebuilt context it would
+        be identical — the census is a pure function of the config)."""
+        R = self._superstep()
+        if R <= 1:
+            return self.cfg.chunk_cap
+        if self._cap_lift is None:
+            self._cap_lift, self._cap_info = self._superstep_guard()
+        return self.cfg.chunk_cap * (R if self._cap_lift else 1)
+
     def _chunk_size(self, rnd: int, until: int, per_round_s,
                     last_ckpt: int) -> int:
         """Next chunk length: adaptive ladder value under the wall
         budget and hard cap, clipped so the chunk crosses neither the
-        soak end, the next storm event, nor the checkpoint cadence."""
+        soak end, the next storm event, nor the checkpoint cadence.
+        Under ``Config.superstep=R`` the cap is the (guarded) lifted
+        one and adaptive lengths quantize to ladder multiples OF R, so
+        guarded executions are whole fused supersteps and the
+        distinct-program count stays O(log cap) exactly as before."""
         c = self.cfg
+        cap = self._chunk_cap()
+        R = self._superstep()
         if c.chunk_fixed > 0:
-            k = min(c.chunk_fixed, c.chunk_cap)
+            k = min(c.chunk_fixed, cap)
         elif per_round_s is None or per_round_s <= 0:
-            k = min(_ladder_floor(c.chunk_init), c.chunk_cap)
+            k = min(_ladder_floor(c.chunk_init), cap) if R <= 1 \
+                else min(_ladder_floor(max(c.chunk_init // R, 1)) * R,
+                         cap)
         else:
             want = c.chunk_target_s / per_round_s
-            k = _ladder_floor(min(want, c.chunk_cap))
+            k = _ladder_floor(min(want, cap)) if R <= 1 \
+                else min(_ladder_floor(max(want / R, 1.0)) * R, cap)
         limit = until - rnd
         if self.storm is not None:
             nxt = self.storm.next_after(rnd)
@@ -729,6 +822,12 @@ class Soak:
         # sizer, and the degraded-worker verdict.  Reset on every
         # fresh-context rebuild — everything re-traces there.
         ctx_lengths: set[int] = set()
+        if self._superstep() > 1:
+            cap = self._chunk_cap()   # evaluates + caches the guard
+            self._log_event(log, "superstep_cap",
+                            superstep=self._superstep(), chunk_cap=cap,
+                            lifted=bool(self._cap_lift),
+                            **self._cap_info)
 
         while r < until_round:
             # 1. invariant checks on the state entering this boundary
@@ -763,166 +862,268 @@ class Soak:
                                                 state, r)
                 if rep is not None:
                     self._log_event(log, "ingress_drain", **rep)
-            # 4. size and run the chunk, guarded
-            k = self._chunk_size(r, until_round, per_round_s, last_ckpt)
-            t0 = time.perf_counter()
-            try:
-                nxt_state = step(self._cluster(), state, k)
-                got = _sync(nxt_state)
-            except jax.errors.JaxRuntimeError as e:
-                crash_streak += 1
-                if crash_streak > self.cfg.max_retries:
-                    # exhausted BEFORE logging: the log records only
-                    # retries that actually ran
-                    raise RuntimeError(
-                        f"soak gave up at round {r}: "
-                        f"{crash_streak - 1} retries exhausted") from e
-                cool = self.cfg.cooldown_s * (2 ** (crash_streak - 1))
-                self._log_event(log, "chunk_retry", round=r, k=k,
-                                attempt=crash_streak, cooldown_s=cool,
-                                error=str(e)[:200])
-                retries += 1
-                self.sleep_fn(cool)
-                state, r = self._restore(log, fresh_context=True)
-                ctx_lengths = set()
-                prev_ready = None
-                armed = True
-                # drop rows for rounds the rewind will re-run — replay
-                # re-logs them, and sum(row.k) must equal rounds run
-                chunks[:] = [row for row in chunks if row["round"] < r]
-                continue
-            ready_t = time.perf_counter()
-            wall = ready_t - t0
-            gap_s = None if prev_ready is None else t0 - prev_ready
-            prev_ready = ready_t
-            crash_streak = 0      # a completed chunk breaks the streak
-            if got != r + k:
-                raise RuntimeError(
-                    f"chunk advanced to round {got}, expected {r + k}")
-            this_per_round = wall / k
-            warm = k in ctx_lengths
-            ctx_lengths.add(k)
-            taint_baseline = not warm
-            # 5. degraded-worker detection.  Compile-tainted chunks
-            #    (first run of a length in this context) are no
-            #    evidence either way; after a restore the first WARM
-            #    chunk is judged against the pre-restore baseline —
-            #    real degradation persists across chunks (MINUTE_FAULT's
-            #    measured ~20x was steady post-crash state, not a
-            #    one-off compile).
-            if warm and armed and not baseline:
-                # A crash before any warm sample existed: there is no
-                # healthy reference to judge against, and the samples
-                # about to seed the baseline may themselves be
-                # degraded.  Say so instead of silently skipping — the
-                # operator can compare per_round_s against other runs.
-                self._log_event(log, "degraded_unjudged", round=r, k=k,
-                                per_round_s=this_per_round)
-                armed = False
-            if warm and armed and baseline:
-                base = sorted(baseline)[len(baseline) // 2]
-                degraded = this_per_round \
-                    > self.cfg.degraded_factor * base
-                if degraded and deg_retries < self.cfg.max_retries:
-                    deg_retries += 1
-                    cool = self.cfg.cooldown_s * (2 ** deg_retries)
-                    self._log_event(
-                        log, "chunk_retry", round=r, k=k,
-                        attempt=deg_retries, cooldown_s=cool,
-                        degraded=True, per_round_s=this_per_round,
-                        baseline_s=base)
+            # 4. size and dispatch, guarded.  pipeline_depth >= 2 keeps
+            #    up to that many chunk dispatches in flight inside one
+            #    boundary-free STRETCH: chunk i+1 is submitted before
+            #    blocking on chunk i, so host bookkeeping (rows, sizing,
+            #    log/bus writes) overlaps device execution.  Stretches
+            #    never cross a storm event, a recorded ingress round, a
+            #    checkpoint-due round or the soak end, and steps 1-3b
+            #    run only at stretch edges — where the pipeline is
+            #    drained — so the state evolution is bit-identical to
+            #    the synchronous loop (tests/test_soak.py
+            #    pipelined-parity suite).  checkpoint_every == 0 means
+            #    every boundary checkpoints: nothing to overlap, the
+            #    loop degenerates to the synchronous protocol.
+            depth = max(1, self.cfg.pipeline_depth)
+            if depth > 1 and self.cfg.checkpoint_every > 0:
+                stretch_end = until_round
+                if self.storm is not None:
+                    nxt = self.storm.next_after(r)
+                    if nxt is not None:
+                        stretch_end = min(stretch_end, nxt)
+                if self.ingress is not None \
+                        and hasattr(self.ingress, "next_after"):
+                    nxt = self.ingress.next_after(r)
+                    if nxt is not None:
+                        stretch_end = min(stretch_end, nxt)
+                stretch_end = min(
+                    stretch_end, last_ckpt + self.cfg.checkpoint_every)
+            else:
+                depth = 1
+                stretch_end = r + self._chunk_size(
+                    r, until_round, per_round_s, last_ckpt)
+            donating = bool(getattr(self._cluster(), "donate", False))
+            pending: list[tuple] = []   # in-flight (submit_t, round,
+            #                             k, state, derived rnd probe)
+            rr, cur = r, state
+            redo = False
+            while rr < stretch_end or pending:
+                k = None
+                try:
+                    while rr < stretch_end and len(pending) < depth:
+                        k = self._chunk_size(rr, stretch_end,
+                                             per_round_s, last_ckpt)
+                        t0 = time.perf_counter()
+                        cur = step(self._cluster(), cur, k)
+                        # A donated carry dies at the NEXT submit:
+                        # derive a round scalar now so the drain can
+                        # barrier on this chunk without reading the
+                        # (soon donated-away) state buffers.
+                        probe = cur.rnd + 0 \
+                            if depth > 1 and donating else None
+                        pending.append((t0, rr, k, cur, probe))
+                        rr += k
+                    t0, r0, k, nxt_state, probe = pending.pop(0)
+                    # the true execution barrier for THIS chunk; when
+                    # a later in-flight dispatch consumed nxt_state's
+                    # buffers (donation) only the probe is readable
+                    donated_away = donating and rr > r0 + k
+                    got = int(jax.device_get(probe)) if donated_away \
+                        else _sync(nxt_state)
+                except jax.errors.JaxRuntimeError as e:
+                    # A crash poisons every later in-flight dispatch
+                    # too: drop the whole pipeline and rewind to the
+                    # last synchronized checkpoint.  Rows are appended
+                    # only on completed barriers, so sum(row.k) ==
+                    # rounds run holds across the rewind — in-flight
+                    # chunks that died never counted.
+                    crash_streak += 1
+                    if crash_streak > self.cfg.max_retries:
+                        # exhausted BEFORE logging: the log records
+                        # only retries that actually ran
+                        raise RuntimeError(
+                            f"soak gave up at round {r}: "
+                            f"{crash_streak - 1} retries "
+                            f"exhausted") from e
+                    cool = self.cfg.cooldown_s \
+                        * (2 ** (crash_streak - 1))
+                    self._log_event(log, "chunk_retry", round=r, k=k,
+                                    attempt=crash_streak,
+                                    cooldown_s=cool,
+                                    error=str(e)[:200])
                     retries += 1
                     self.sleep_fn(cool)
                     state, r = self._restore(log, fresh_context=True)
                     ctx_lengths = set()
                     prev_ready = None
+                    armed = True
+                    # drop rows for rounds the rewind will re-run —
+                    # replay re-logs them, and sum(row.k) must equal
+                    # rounds run
                     chunks[:] = [row for row in chunks
                                  if row["round"] < r]
-                    continue
-                if degraded:
-                    # Retries exhausted: accept and SAY SO.  The sample
-                    # still feeds the adaptive sizer (chunks must fit
-                    # the wall budget at the real, degraded rate) but
-                    # never the verdict baseline — a re-baselined
-                    # median would make future degradation invisible.
-                    self._log_event(
-                        log, "degraded_accepted", round=r, k=k,
-                        per_round_s=this_per_round, baseline_s=base)
-                    taint_baseline = True
-                else:
-                    deg_retries = 0
-                armed = False
-            if not taint_baseline:
-                baseline.append(this_per_round)
-                if len(baseline) > 32:
-                    baseline.pop(0)
-            if warm:
-                per_round_s = this_per_round if per_round_s is None \
-                    else 0.5 * per_round_s + 0.5 * this_per_round
-            row = {"round": r, "k": k, "wall_s": round(wall, 4),
-                   "per_round_s": round(this_per_round, 6),
-                   "rounds_per_s": round(k / wall, 3) if wall > 0
-                   else None}
-            if gap_s is not None:
-                row["gap_s"] = round(gap_s, 4)
-            if getattr(nxt_state, "health", ()) != ():
-                from partisan_tpu import health as health_mod
+                    redo = True
+                    break
+                ready_t = time.perf_counter()
+                wall = ready_t - t0
+                # Overlapped submit (pipelined): this chunk entered
+                # the device queue before the previous one finished,
+                # so wall includes queue wait — the honest execution
+                # span is ready-to-ready, and the dispatch gap is zero
+                # (the device never idled).  Serial submits keep
+                # wall == busy and the full submit-lag gap as before.
+                overlapped = prev_ready is not None and t0 < prev_ready
+                busy = ready_t - prev_ready if overlapped else wall
+                gap_s = None if prev_ready is None \
+                    else max(0.0, t0 - prev_ready)
+                prev_ready = ready_t
+                crash_streak = 0  # a completed chunk breaks the streak
+                if got != r + k:
+                    raise RuntimeError(
+                        f"chunk advanced to round {got}, "
+                        f"expected {r + k}")
+                this_per_round = busy / k
+                warm = k in ctx_lengths
+                ctx_lengths.add(k)
+                taint_baseline = not warm
+                # 5. degraded-worker detection.  Compile-tainted chunks
+                #    (first run of a length in this context) are no
+                #    evidence either way; after a restore the first WARM
+                #    chunk is judged against the pre-restore baseline —
+                #    real degradation persists across chunks
+                #    (MINUTE_FAULT's measured ~20x was steady
+                #    post-crash state, not a one-off compile).
+                if warm and armed and not baseline:
+                    # A crash before any warm sample existed: there is
+                    # no healthy reference to judge against, and the
+                    # samples about to seed the baseline may themselves
+                    # be degraded.  Say so instead of silently skipping
+                    # — the operator can compare per_round_s against
+                    # other runs.
+                    self._log_event(log, "degraded_unjudged", round=r,
+                                    k=k, per_round_s=this_per_round)
+                    armed = False
+                if warm and armed and baseline:
+                    base = sorted(baseline)[len(baseline) // 2]
+                    degraded = this_per_round \
+                        > self.cfg.degraded_factor * base
+                    if degraded and deg_retries < self.cfg.max_retries:
+                        deg_retries += 1
+                        cool = self.cfg.cooldown_s * (2 ** deg_retries)
+                        self._log_event(
+                            log, "chunk_retry", round=r, k=k,
+                            attempt=deg_retries, cooldown_s=cool,
+                            degraded=True, per_round_s=this_per_round,
+                            baseline_s=base)
+                        retries += 1
+                        self.sleep_fn(cool)
+                        state, r = self._restore(log,
+                                                 fresh_context=True)
+                        ctx_lengths = set()
+                        prev_ready = None
+                        chunks[:] = [row for row in chunks
+                                     if row["round"] < r]
+                        redo = True
+                        break
+                    if degraded:
+                        # Retries exhausted: accept and SAY SO.  The
+                        # sample still feeds the adaptive sizer (chunks
+                        # must fit the wall budget at the real,
+                        # degraded rate) but never the verdict baseline
+                        # — a re-baselined median would make future
+                        # degradation invisible.
+                        self._log_event(
+                            log, "degraded_accepted", round=r, k=k,
+                            per_round_s=this_per_round, baseline_s=base)
+                        taint_baseline = True
+                    else:
+                        deg_retries = 0
+                    armed = False
+                if not taint_baseline:
+                    baseline.append(this_per_round)
+                    if len(baseline) > 32:
+                        baseline.pop(0)
+                if warm:
+                    per_round_s = this_per_round if per_round_s is None \
+                        else 0.5 * per_round_s + 0.5 * this_per_round
+                row = {"round": r, "k": k, "wall_s": round(wall, 4),
+                       "per_round_s": round(this_per_round, 6),
+                       "rounds_per_s": round(k / busy, 3) if busy > 0
+                       else None}
+                if gap_s is not None:
+                    row["gap_s"] = round(gap_s, 4)
+                if overlapped:
+                    # perfwatch.decompose_chunks reads busy_s for the
+                    # overlapped regime — wall_s includes queue wait
+                    # behind the previous in-flight chunk
+                    row["pipelined"] = True
+                    row["busy_s"] = round(busy, 4)
+                # Per-row plane polls read state leaves, which a later
+                # in-flight chunk consumed when the cluster donates —
+                # those rows skip polls; the stretch's LAST chunk (and
+                # every chunk of a non-donating cluster) polls as
+                # always.
+                poll_state = () if donated_away else nxt_state
+                if getattr(poll_state, "health", ()) != ():
+                    from partisan_tpu import health as health_mod
 
-                word = health_mod.digest(nxt_state)
-                row["digest"] = word
-                # fleet states poll a per-member digest list: the row is
-                # healthy when every member is
-                row["healthy"] = (
-                    all(health_mod.healthy(w) for w in word)
-                    if isinstance(word, list)
-                    else health_mod.healthy(word))
-            if getattr(nxt_state, "control", ()) != ():
-                # in-scan controller operands at the chunk boundary (a
-                # few scalar transfers): eager cap / pressure levels /
-                # heal boost in force, surfaced per soak_report row
-                from partisan_tpu import control as control_mod
+                    word = health_mod.digest(poll_state)
+                    row["digest"] = word
+                    # fleet states poll a per-member digest list: the
+                    # row is healthy when every member is
+                    row["healthy"] = (
+                        all(health_mod.healthy(w) for w in word)
+                        if isinstance(word, list)
+                        else health_mod.healthy(word))
+                if getattr(poll_state, "control", ()) != ():
+                    # in-scan controller operands at the chunk boundary
+                    # (a few scalar transfers): eager cap / pressure
+                    # levels / heal boost in force, surfaced per
+                    # soak_report row
+                    from partisan_tpu import control as control_mod
 
-                row["control"] = control_mod.poll(nxt_state.control)
-            if getattr(nxt_state, "traffic", ()) != ():
-                # traffic-generator operands in force (rate multiplier,
-                # churn probability, cumulative arrivals) — the series
-                # telemetry.replay_traffic_events derives flash-crowd
-                # events from
-                from partisan_tpu import workload as workload_mod
+                    row["control"] = control_mod.poll(
+                        poll_state.control)
+                if getattr(poll_state, "traffic", ()) != ():
+                    # traffic-generator operands in force (rate
+                    # multiplier, churn probability, cumulative
+                    # arrivals) — the series
+                    # telemetry.replay_traffic_events derives
+                    # flash-crowd events from
+                    from partisan_tpu import workload as workload_mod
 
-                row["traffic"] = workload_mod.poll(nxt_state.traffic)
-            if getattr(nxt_state, "elastic", ()) != ():
-                # elastic operands in force (active width, pending
-                # drain boundary/deadline, resize count) — the rows
-                # soak_report --elastic surfaces and
-                # replay_elastic_events complements
-                from partisan_tpu import elastic as elastic_mod
+                    row["traffic"] = workload_mod.poll(
+                        poll_state.traffic)
+                if getattr(poll_state, "elastic", ()) != ():
+                    # elastic operands in force (active width, pending
+                    # drain boundary/deadline, resize count) — the rows
+                    # soak_report --elastic surfaces and
+                    # replay_elastic_events complements
+                    from partisan_tpu import elastic as elastic_mod
 
-                row["elastic"] = elastic_mod.poll(nxt_state.elastic)
-            if getattr(nxt_state, "ingress", ()) != ():
-                # inject-buffer occupancy + cumulative injected/shed
-                # ledgers (the admission-control series)
-                from partisan_tpu import ingress as ingress_mod
+                    row["elastic"] = elastic_mod.poll(
+                        poll_state.elastic)
+                if getattr(poll_state, "ingress", ()) != ():
+                    # inject-buffer occupancy + cumulative
+                    # injected/shed ledgers (the admission-control
+                    # series)
+                    from partisan_tpu import ingress as ingress_mod
 
-                row["ingress"] = ingress_mod.poll(nxt_state.ingress)
-            if self.cfg.poll_latency \
-                    and getattr(nxt_state, "latency", ()) != ():
-                # WINDOWED per-channel p99 (this chunk's deliveries
-                # only): the cumulative histograms diff at boundaries,
-                # turning the plane into the per-window SLO series
-                from partisan_tpu import latency as latency_mod
+                    row["ingress"] = ingress_mod.poll(
+                        poll_state.ingress)
+                if self.cfg.poll_latency \
+                        and getattr(poll_state, "latency", ()) != ():
+                    # WINDOWED per-channel p99 (this chunk's deliveries
+                    # only): the cumulative histograms diff at
+                    # boundaries, turning the plane into the per-window
+                    # SLO series
+                    from partisan_tpu import latency as latency_mod
 
-                snap = latency_mod.snapshot(nxt_state.latency)
-                names = tuple(
-                    c.name for c in self._cluster().cfg.channels)
-                pct = latency_mod.percentiles(
-                    latency_mod.window_snap(self._lat_prev, snap),
-                    channels=names)
-                row["p99"] = {ch: e["p99"] for ch, e in pct.items()}
-                self._lat_prev = snap
-            chunks.append(row)
-            lengths.add(k)
-            state, r = nxt_state, got
+                    snap = latency_mod.snapshot(poll_state.latency)
+                    names = tuple(
+                        c.name for c in self._cluster().cfg.channels)
+                    pct = latency_mod.percentiles(
+                        latency_mod.window_snap(self._lat_prev, snap),
+                        channels=names)
+                    row["p99"] = {ch: e["p99"]
+                                  for ch, e in pct.items()}
+                    self._lat_prev = snap
+                chunks.append(row)
+                lengths.add(k)
+                state, r = nxt_state, got
+            if redo:
+                continue
 
         # final boundary: invariants + on-disk checkpoint at the end
         # round (a persisted soak resumes from its own tail).  The

@@ -32,10 +32,10 @@ WIDE_N = 4096 if FULL else 768
 SCAMP_BAND_N = 512 if FULL else 192
 # randomized-overlay trials per oracle gate (health BFS / provenance
 # trace-replay): the gates assert EXACT parity per overlay either way
-# (6 still sweeps faulted/partitioned/churned variants — ISSUE 17
-# paydown offsetting the new incident-observatory soak suite, after
-# ISSUE 15's 16->12->10 and ISSUE 16's 10->8)
-ORACLE_TRIALS = 40 if FULL else 6
+# (5 still sweeps faulted/partitioned/churned variants — ISSUE 18
+# paydown offsetting the new superstep/pipelined-dispatch suites,
+# after ISSUE 15's 16->12->10, ISSUE 16's 10->8 and ISSUE 17's 8->6)
+ORACLE_TRIALS = 40 if FULL else 5
 # mixed-fault soak width (tests/test_soak.py 500-round storm): the
 # storm schedule and every invariant are width-independent (80 keeps
 # the crash batches > a quarter of the overlay — ISSUE 14 paydown)
@@ -64,10 +64,11 @@ FASTSV_TRIALS = 64 if FULL else 50
 FLEET_PAR_W = 8 if FULL else 4          # fleet-vs-loop parity width
 FLEET_SEARCH_W = 64                     # acceptance floor, both modes
 FLEET_TUNE_N = 128 if FULL else 64      # tune harness overlay size
-FLEET_TUNE_WAVES = 12 if FULL else 5    # broadcast waves per tune run
-#   (5: tune only ranks candidate bands — every wave re-runs the same
+FLEET_TUNE_WAVES = 12 if FULL else 4    # broadcast waves per tune run
+#   (4: tune only ranks candidate bands — every wave re-runs the same
 #   jitted member program, so fewer waves trims wall without touching
-#   an assertion — ISSUE 16 paydown 12->6, ISSUE 17 6->5)
+#   an assertion — ISSUE 16 paydown 12->6, ISSUE 17 6->5, ISSUE 18
+#   5->4 offsetting the superstep/pipelined-dispatch suites)
 # incident-observatory soak width (tests/test_incident.py): the span
 # matcher and kill/restore parity are width-independent — 32 keeps the
 # 5% crash batch >= one node and the partition two real components

@@ -166,10 +166,14 @@ def test_fast_wire_compaction_overflow_characterization():
     from partisan_tpu.models.plumtree import Plumtree
 
     def make(force_generic):
-        cfg = Config(n_nodes=96, seed=5, peer_service_manager="hyparview",
+        cfg = Config(n_nodes=96, seed=6, peer_service_manager="hyparview",
                      msg_words=16, partition_mode="groups",
                      max_broadcasts=4, inbox_cap=8,
                      emit_compact=4,      # small enough to overflow
+                     # seed re-tuned when the rank32 stream changed
+                     # (single-pass finalizer): the characterization
+                     # needs a round whose live emissions overflow
+                     # emit_compact under faults
                      metrics=True, metrics_ring=8,
                      plumtree=PlumtreeConfig(push_slots=2, lazy_cap=4))
         probe = interpose.Observe(

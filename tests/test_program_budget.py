@@ -370,3 +370,26 @@ def test_active_alive_masks_prefix():
     st = activate(cl.init(), 10)
     m = np.asarray(jax.device_get(active_alive(st)))
     assert m[:10].all() and not m[10:].any()
+
+
+def test_superstep_program_o1():
+    """ISSUE 18 fused supersteps: ``Config.superstep=R`` folds R rounds
+    into one jitted execution by nesting the round scan (outer scan of
+    inner length-R scans) — the round body traces ONCE and the inner
+    jaxpr is shared by reference, so program size is O(1) in R.  Pin
+    it: the scan program's recursive eqn census at R=8 equals R=1 up
+    to the constant nesting wrapper, and is flat in k."""
+    from partisan_tpu.lint.core import iter_eqns
+
+    def eqns_for(superstep, k):
+        cl = Cluster(_cfg(16, False, superstep=superstep),
+                     model=Plumtree())
+        st = jax.eval_shape(cl._build_init)
+        jaxpr = jax.make_jaxpr(lambda s: cl._scan(s, k))(st)
+        return sum(1 for _ in iter_eqns(jaxpr.jaxpr))
+
+    e1 = eqns_for(1, 8)
+    e8 = eqns_for(8, 8)
+    e8_long = eqns_for(8, 64)   # 8 supersteps, same single inner body
+    assert e8 <= e1 + 8, (e1, e8)
+    assert e8_long <= e8 + 8, (e8, e8_long)

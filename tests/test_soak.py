@@ -869,3 +869,94 @@ def test_omission_merge_idempotent_under_restore(tmp_path):
     again = storm.events[0][1].apply(mk(), res.state, r0 + 2)
     assert np.array_equal(np.asarray(jax.device_get(again.interpose)),
                           final)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined chunk dispatch (ISSUE 18): pipeline_depth >= 2 submits
+# chunk i+1 before blocking on chunk i inside boundary-free stretches.
+# The contracts: bit parity with the synchronous engine under a
+# crash+partition storm (boundary work only ever runs on a drained
+# pipeline), in-flight chunks that die re-dispatch from the last
+# synchronized carry without double-counting, and donated carries are
+# barriered through a derived probe so per-row polls never read
+# donated-away buffers.
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_soak_bit_parity_crash_partition_storm(tmp_path):
+    """Depth-2 pipelined soak under the full fault cycle with a worker
+    kill injected while a chunk is in flight: the crash drops the whole
+    pipeline, rewinds to the last synchronized checkpoint, and the
+    final state is bit-identical to the unchunked storm reference.
+    Replayed rows reconcile exactly: sum(k) == rounds run, and the
+    overlapped rows carry clamped true-stall gaps."""
+    from partisan_tpu import perfwatch
+
+    def mk():
+        return _planes_cluster()
+
+    cl = mk()
+    st = _booted(cl)
+    r0 = int(jax.device_get(st.rnd))
+    storm = _test_storm(r0, period=0)
+    crashed = {"done": False}
+
+    def step(c, s, k):
+        r = int(jax.device_get(s.rnd))
+        # fires while the previous chunk of the stretch is in flight:
+        # the pipeline (not just one dispatch) must rewind
+        if not crashed["done"] and r + k > r0 + 30:
+            crashed["done"] = True
+            raise jax.errors.JaxRuntimeError("injected worker crash")
+        return c.steps(s, k)
+
+    eng = soak.Soak(
+        make_cluster=mk, storm=storm, step_fn=step,
+        cfg=soak.SoakConfig(chunk_fixed=5, pipeline_depth=2,
+                            checkpoint_every=10, cooldown_s=0.0,
+                            checkpoint_dir=str(tmp_path),
+                            degraded_factor=1e9),
+        sleep_fn=lambda s: None)
+    res = eng.run(st, rounds=40)
+    assert res.retries == 1 and crashed["done"]
+    kinds = [e["kind"] for e in res.log]
+    assert kinds.count("chunk_retry") == 1
+    assert kinds.count("checkpoint_restored") == 1
+    # rows reconcile across the mid-pipeline rewind: no double-count
+    assert sum(row["k"] for row in res.chunks) == res.rounds == 40
+    # the pipeline actually overlapped (some row submitted before the
+    # previous chunk's ready), and its gap is a clamped true stall
+    piped = [row for row in res.chunks if row.get("pipelined")]
+    assert piped and all(row["gap_s"] == 0.0 for row in piped)
+    assert all(row.get("gap_s", 0.0) >= 0.0 for row in res.chunks)
+    d = perfwatch.decompose_chunks(res.chunks)
+    assert d["overlapped_chunks"] == len(piped) and d["gap_s"] >= 0.0
+    ref = soak.reference_run(mk(), st, r0 + 40, storm=storm)
+    assert_states_bitidentical(res.state, ref, "pipelined_storm_crash")
+
+
+def test_pipelined_donated_carry_probe_and_poll_gating():
+    """A donating cluster under depth-2 pipelining: the engine barriers
+    in-flight chunks through a derived round probe (their carry buffers
+    are donated to the next dispatch), skips per-row plane polls for
+    exactly those rows, polls the stretch-final rows as always — and
+    the run is bit-identical to the plain synchronous engine's."""
+    cfg = _planes_cluster().cfg
+    cl_plain = Cluster(cfg, model=Plumtree())
+    st = _booted(cl_plain)
+    r0 = int(jax.device_get(st.rnd))
+    ref = soak.reference_run(cl_plain, st, r0 + 20)
+
+    eng = soak.Soak(
+        make_cluster=lambda: Cluster(cfg, model=Plumtree(), donate=True),
+        cfg=soak.SoakConfig(chunk_fixed=5, pipeline_depth=2,
+                            checkpoint_every=10))
+    res = eng.run(st, rounds=20)
+    assert res.rounds == 20
+    assert_states_bitidentical(res.state, ref, "pipelined_donated")
+    # stretches are 10 rounds = 2 chunks: the first of each pair was
+    # donated away (no polls), the stretch-final one polled as always
+    assert [row["k"] for row in res.chunks] == [5, 5, 5, 5]
+    assert "digest" not in res.chunks[0] and "digest" in res.chunks[1]
+    assert "digest" not in res.chunks[2] and "digest" in res.chunks[3]
+    assert res.chunks[1].get("pipelined") and res.chunks[3].get("pipelined")

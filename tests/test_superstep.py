@@ -1,0 +1,170 @@
+"""Fused supersteps (ISSUE 18 tentpole): ``Config.superstep=R`` folds
+R rounds into ONE jitted execution by nesting the round scan — an
+outer scan of inner length-R scans plus a same-body remainder scan, so
+any k decomposes as k = outer*R + rem with the round body traced once.
+
+Contracts pinned here:
+
+1. **Bit parity** — the fused program is the SAME function: stepping k
+   rounds at R=4 equals R=1 bit-for-bit with every observability plane,
+   the flight ring and all three in-scan controllers in the carry, for
+   R non-divisors of k (the remainder path).  Cadence conds (timers,
+   health snapshots, controller reviews) key on the carried ``rnd``,
+   so they fire on true round numbers regardless of fusion.
+2. **Cap lift under the memory meter** — soak's sizer lifts the
+   per-execution round cap to ``chunk_cap * R`` only when the round
+   program's materialized-intermediate census clears the pinned
+   ``cost_budgets.SUPERSTEP_INTERM_BUDGET_MIB`` (both verdict
+   directions tested), quantizing adaptive lengths to ladder multiples
+   of R; a >1000-round soak then lands in a SINGLE execution, issuing
+   1/8th the dispatches of the unfused engine (the dispatch-count
+   meter, via perfwatch).
+3. **Crash replay** — a mid-storm worker kill under superstep chunking
+   restores and replays bit-identically against the UNFUSED unchunked
+   reference: cross-R parity of the whole recovery protocol.
+
+(The O(1)-in-R program-size guard lives in
+tests/test_program_budget.py::test_superstep_program_o1.)
+"""
+
+import jax
+
+from partisan_tpu import perfwatch, soak
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, ControlConfig
+from partisan_tpu.models.plumtree import Plumtree
+
+from support import assert_states_bitidentical
+
+
+def _full_cluster(superstep=1, n=24, seed=3):
+    """Every plane + flight ring + all three controllers in the carry."""
+    cfg = Config(n_nodes=n, seed=seed, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 metrics=True, metrics_ring=64, latency=True,
+                 health=5, health_ring=32,
+                 provenance=True, provenance_ring=64,
+                 flight_rounds=4, channel_capacity=True,
+                 control=ControlConfig(fanout=True, backpressure=True,
+                                       healing=True, ring=16),
+                 superstep=superstep)
+    return Cluster(cfg, model=Plumtree())
+
+
+def _booted(cl, settle=20):
+    n = cl.cfg.n_nodes
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager,
+                             list(range(1, n)), [0] * (n - 1))
+    st = cl.steps(st._replace(manager=m), settle)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0, int(st.rnd)))
+    return cl.steps(st, 5)
+
+
+def _plain_cluster(superstep=1, n=16, seed=7):
+    return Cluster(Config(n_nodes=n, seed=seed, superstep=superstep),
+                   model=Plumtree())
+
+
+def test_superstep_bit_parity_all_planes_controllers():
+    """R=4 over k=13 (non-divisor: 3 supersteps + remainder 1) equals
+    R=1 bit-for-bit — planes, flight ring and controller leaves
+    included, so cadence conds demonstrably fired on true rounds."""
+    cl1 = _full_cluster(superstep=1)
+    cl4 = _full_cluster(superstep=4)
+    st = _booted(cl1)
+    ref = cl1.steps(st, 13)
+    got = cl4.steps(st, 13)
+    assert_states_bitidentical(got, ref, "superstep_r4_k13")
+
+
+def test_superstep_cap_lift_and_memory_guard(monkeypatch):
+    """The sizer's cap lifts to chunk_cap*R only when the census clears
+    the pinned budget; adaptive lengths quantize to ladder multiples of
+    R; an un-censusable cluster-like never lifts."""
+    mk = lambda: _plain_cluster(superstep=8)  # noqa: E731
+    eng = soak.Soak(make_cluster=mk)
+    assert eng._chunk_cap() == 8 * eng.cfg.chunk_cap     # n=16 clears
+    assert eng._cap_info["interm_mib"] \
+        <= eng._cap_info["budget_mib"]
+    # adaptive sizing: ladder-of-R quantization, capped at the lift
+    k = eng._chunk_size(0, 10**9, 0.001, 0)
+    assert k % 8 == 0 and k == 8000
+    k0 = eng._chunk_size(0, 10**9, None, 0)              # chunk_init path
+    assert k0 % 8 == 0
+    # budget refused -> the measured-safe cap stands (fresh engine:
+    # the verdict is cached per engine)
+    from partisan_tpu.lint import cost_budgets
+    monkeypatch.setattr(cost_budgets, "SUPERSTEP_INTERM_BUDGET_MIB", 0.0)
+    eng2 = soak.Soak(make_cluster=mk)
+    assert eng2._chunk_cap() == eng2.cfg.chunk_cap
+    assert not eng2._cap_lift
+
+    # a cluster-like the census cannot trace: no lift, no crash
+    class Opaque:
+        cfg = type("C", (), {"superstep": 8, "n_nodes": 4})()
+    monkeypatch.undo()
+    eng3 = soak.Soak(make_cluster=Opaque)
+    assert eng3._chunk_cap() == eng3.cfg.chunk_cap
+    assert "error" in eng3._cap_info
+
+
+def test_superstep_soak_1200_rounds_single_execution():
+    """The dispatch-count meter: at superstep=8 the guarded cap lift
+    lands a 1200-round soak in ONE execution (>1000 rounds in a single
+    dispatch), while the unfused engine needs 8 — and the two final
+    states are bit-identical."""
+    cfg = soak.SoakConfig(chunk_cap=150, chunk_fixed=1200,
+                          checkpoint_every=1200)
+    res1 = soak.Soak(make_cluster=lambda: _plain_cluster(superstep=1),
+                     cfg=cfg).run(rounds=1200)
+    res8 = soak.Soak(make_cluster=lambda: _plain_cluster(superstep=8),
+                     cfg=cfg).run(rounds=1200)
+    assert res1.rounds == res8.rounds == 1200
+    d1 = perfwatch.decompose_chunks(res1.chunks)
+    d8 = perfwatch.decompose_chunks(res8.chunks)
+    assert d1["chunks"] == 8 and d8["chunks"] == 1      # <= 1/8th
+    assert res8.chunks[0]["k"] == 1200                  # one >1000-round
+    #                                                     execution
+    lift = [e for e in res8.log if e["kind"] == "superstep_cap"]
+    assert lift and lift[0]["lifted"] and lift[0]["chunk_cap"] == 1200
+    assert_states_bitidentical(res8.state, res1.state, "superstep_soak")
+
+
+def test_superstep_mid_storm_kill_restore_replay(tmp_path):
+    """Cross-R crash replay: a worker kill mid-storm under superstep=4
+    chunking (retry + fresh context + checkpoint restore) must land
+    bit-identically on the UNFUSED unchunked storm reference — the
+    whole recovery protocol composes with fusion, and replayed rows
+    reconcile (sum(k) == rounds run)."""
+    mk = lambda: _full_cluster(superstep=4)  # noqa: E731
+    cl1 = _full_cluster(superstep=1)
+    st = _booted(cl1)
+    r0 = int(jax.device_get(st.rnd))
+    storm = soak.Storm(events=(
+        (0, soak.LinkDrop(0.2)),
+        (4, soak.CrashBatch(frac=0.05)),
+        (8, soak.Partition()),
+        (12, soak.Heal(revive=True)),
+        (16, soak.Churn(0.02, 0.02)),
+    ), start=r0)
+    crashed = {"done": False}
+
+    def step(c, s, k):
+        r = int(jax.device_get(s.rnd))
+        if not crashed["done"] and r + k > r0 + 25:
+            crashed["done"] = True
+            raise jax.errors.JaxRuntimeError("injected worker crash")
+        return c.steps(s, k)
+
+    eng = soak.Soak(
+        make_cluster=mk, storm=storm, step_fn=step,
+        cfg=soak.SoakConfig(chunk_fixed=10, cooldown_s=0.0,
+                            checkpoint_dir=str(tmp_path),
+                            degraded_factor=1e9),
+        sleep_fn=lambda s: None)
+    res = eng.run(st, rounds=40)
+    assert res.retries == 1 and crashed["done"]
+    assert sum(row["k"] for row in res.chunks) == res.rounds
+    ref = soak.reference_run(cl1, st, r0 + 40, storm=storm)
+    assert_states_bitidentical(res.state, ref, "superstep_storm_resume")

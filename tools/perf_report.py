@@ -24,8 +24,13 @@ submits, sync once) against the serial submit+sync loop, quantifying
 ROADMAP item 1(b)'s claimed headroom (``pipeline_probe`` line).
 
 Flags: ``--chunks C`` (dispatch/probe repetitions, default 6),
-``--k K`` (rounds per chunk, default scenarios.K_PROG).
-Outlier and dispatch events replay through telemetry
+``--k K`` (rounds per chunk, default scenarios.K_PROG),
+``--pipeline D`` (dispatch mode: run the soak engine's pipelined
+dispatch at depth D — overlapped rows land in the decomposition),
+``--superstep R`` (fuse R rounds per scan step, ISSUE 18), and
+``--superstep-axis`` (sweep R in {1, 4, 8, 16} — one
+dispatch_wall/pipeline_probe line per R, the fused-dispatch headroom
+curve).  Outlier and dispatch events replay through telemetry
 (``partisan.perf.*``).  Works on CPU with the same code paths an
 on-chip session uses.
 """
@@ -42,16 +47,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools._lib.jaxcache import enable_persistent_cache
 
 USAGE = ("usage: perf_report.py (--one | --dispatch | --pipeline-probe) N"
-         " [--chunks C] [--k K]")
+         " [--chunks C] [--k K] [--pipeline D] [--superstep R |"
+         " --superstep-axis]")
 
 
-def _boot(n: int):
+def _boot(n: int, superstep: int = 1):
+    import dataclasses
+
     from partisan_tpu.cluster import Cluster
     from partisan_tpu.lint.cost import bench_cfg
     from partisan_tpu.models.plumtree import Plumtree
     from partisan_tpu.scenarios import _boot_overlay
 
-    cl = Cluster(bench_cfg(n), model=Plumtree())
+    cfg = bench_cfg(n)
+    if superstep > 1:
+        cfg = dataclasses.replace(cfg, superstep=superstep)
+    cl = Cluster(cfg, model=Plumtree())
     st = _boot_overlay(cl, n, settle_execs=2)
     return cl, st
 
@@ -98,23 +109,33 @@ def phase_table(n: int, *, execs: int = 3, out=None) -> list[dict]:
 
 
 def dispatch_meter(n: int, *, chunks: int = 6, k: int | None = None,
+                   superstep: int = 1, depth: int = 1,
                    out=None) -> dict:
-    """Short chunked soak → chunk rows → dispatch-wall decomposition."""
+    """Short chunked soak → chunk rows → dispatch-wall decomposition.
+    ``superstep`` fuses R rounds per scan step (the engine's guarded
+    cap lift + ladder-of-R sizing engage); ``depth`` >= 2 runs the
+    pipelined dispatch so the decomposition shows the overlapped
+    regime (busy_s spans, true-stall gaps)."""
     from partisan_tpu import perfwatch, soak as soak_mod, telemetry
     from partisan_tpu.scenarios import K_PROG
 
     out = out or sys.stdout
     k = k or K_PROG
-    cl, st = _boot(n)
+    cl, st = _boot(n, superstep=superstep)
     warm = [cl]
     engine = soak_mod.Soak(
         make_cluster=lambda: warm.pop() if warm else cl.rebuild(),
         cfg=soak_mod.SoakConfig(chunk_fixed=k,
-                                checkpoint_every=chunks * k))
+                                checkpoint_every=chunks * k,
+                                pipeline_depth=depth))
     res = engine.run(st, rounds=chunks * k)
     for row in res.chunks:
         _emit({"kind": "chunk", **row}, out)
     disp = perfwatch.decompose_chunks(res.chunks)
+    if superstep > 1:
+        disp["superstep"] = superstep
+    if depth > 1:
+        disp["pipeline_depth"] = depth
     _emit({"kind": "dispatch_wall", "n": n, **disp}, out)
     bus = telemetry.Bus()
     bus.attach("perf-report", ("partisan", "perf"),
@@ -126,16 +147,21 @@ def dispatch_meter(n: int, *, chunks: int = 6, k: int | None = None,
 
 
 def pipeline_probe(n: int, *, reps: int = 6, k: int | None = None,
-                   out=None) -> dict:
-    """Measured double-buffered-dispatch overlap (ROADMAP item 1(b))."""
+                   superstep: int = 1, out=None) -> dict:
+    """Measured double-buffered-dispatch overlap (ROADMAP item 1(b)).
+    With ``superstep=R`` the probed program fuses R rounds per scan
+    step — swept over the axis, the line quantifies how much of the
+    serial dispatch wall fusion already removed before pipelining."""
     from partisan_tpu import perfwatch
     from partisan_tpu.scenarios import K_PROG, _sync
 
     out = out or sys.stdout
     k = k or K_PROG
-    cl, st = _boot(n)
+    cl, st = _boot(n, superstep=superstep)
     probe, _ = perfwatch.pipeline_probe(
         lambda s, kk: cl.steps(s, kk), _sync, st, reps=reps, k=k)
+    if superstep > 1:
+        probe["superstep"] = superstep
     _emit({"kind": "pipeline_probe", "n": n, **probe}, out)
     return probe
 
@@ -158,6 +184,12 @@ def main(argv=None) -> int:
 
     chunks = flag_val("--chunks", 6)
     k = flag_val("--k", None)
+    depth = flag_val("--pipeline", 1)
+    ss_axis = "--superstep-axis" in argv
+    if ss_axis:
+        argv.remove("--superstep-axis")
+    superstep = flag_val("--superstep", 1)
+    supersteps = (1, 4, 8, 16) if ss_axis else (superstep,)
     modes = [m for m in ("--one", "--dispatch", "--pipeline-probe")
              if m in argv]
     for m in modes:
@@ -170,11 +202,14 @@ def main(argv=None) -> int:
         return 2
     for m in modes:
         if m == "--one":
-            phase_table(n)
-        elif m == "--dispatch":
-            dispatch_meter(n, chunks=chunks, k=k)
-        else:
-            pipeline_probe(n, reps=chunks, k=k)
+            phase_table(n)       # the phase table prices the plain round
+            continue
+        for ss in supersteps:
+            if m == "--dispatch":
+                dispatch_meter(n, chunks=chunks, k=k, superstep=ss,
+                               depth=depth)
+            else:
+                pipeline_probe(n, reps=chunks, k=k, superstep=ss)
     return 0
 
 
