@@ -357,10 +357,28 @@ def decompose(records: list[dict]) -> dict:
         return {}
     exec_s = sum(r["busy_s"] if r.get("busy_s") is not None
                  else r["wall_s"] for r in rows)
-    gaps = [max(0.0, r["gap_s"]) for r in rows
-            if r.get("gap_s") is not None]
+    # Telemetry-spool drains (soak rows' ``spool_s``) run between a
+    # chunk's ready and the NEXT submit, so they land inside the next
+    # row's gap_s — attribute that host time to its own column instead
+    # of letting collection cost masquerade as dispatch wall.
+    gaps = []
+    spool_s = 0.0
+    prev_spool = None
+    for r in rows:
+        if r.get("gap_s") is not None:
+            g = max(0.0, r["gap_s"])
+            if prev_spool:
+                sp = min(float(prev_spool), g)
+                spool_s += sp
+                g -= sp
+            gaps.append(g)
+        prev_spool = r.get("spool_s")
+    if prev_spool:
+        # the last row's drain happened after its ready too — no later
+        # gap absorbs it, but it is still spool host time
+        spool_s += float(prev_spool)
     gap_s = sum(gaps)
-    total = exec_s + gap_s
+    total = exec_s + gap_s + spool_s
     out = {
         "chunks": len(rows),
         "in_execution_s": round(exec_s, 4),
@@ -369,6 +387,8 @@ def decompose(records: list[dict]) -> dict:
         "per_chunk_gap_ms": (round(1000.0 * gap_s / len(gaps), 3)
                              if gaps else None),
     }
+    if spool_s > 0:
+        out["spool_s"] = round(spool_s, 4)
     overlapped = sum(1 for r in rows if r.get("pipelined"))
     if overlapped:
         out["overlapped_chunks"] = overlapped
@@ -381,7 +401,8 @@ def decompose_chunks(chunks: list[dict]) -> dict:
     pass ``busy_s``/``pipelined`` through for the overlapped regime)."""
     return decompose([
         {"wall_s": c.get("wall_s"), "gap_s": c.get("gap_s"),
-         "busy_s": c.get("busy_s"), "pipelined": c.get("pipelined")}
+         "busy_s": c.get("busy_s"), "pipelined": c.get("pipelined"),
+         "spool_s": c.get("spool_s")}
         for c in chunks if isinstance(c, dict) and "wall_s" in c])
 
 

@@ -545,6 +545,13 @@ class Soak:
     #                               so a rewound retry or fresh-process
     #                               resume re-injects the recorded
     #                               batches (replay-exact, like storms)
+    spool: Any = None             # spool.Spool (optional): the
+    #                               full-horizon telemetry spool —
+    #                               armed at run entry, drained at
+    #                               every polled chunk boundary (ring
+    #                               deltas appended, dedup-keyed), and
+    #                               re-anchored on rewinds so replayed
+    #                               rounds re-drain (first copy wins)
     step_fn: Callable[[Any, Any, int], Any] | None = None
     sleep_fn: Callable[[float], None] = time.sleep
 
@@ -605,6 +612,12 @@ class Soak:
             self._lat_prev = latency_mod.snapshot(state.latency)
         else:
             self._lat_prev = None
+        # Re-open the spool's delta windows at the restore round: the
+        # replayed chunks re-drain their rings (first copy wins — the
+        # re-executed rounds are bit-identical), and an adaptive rerun
+        # that lands new boundaries still spools its rows.
+        if self.spool is not None:
+            self.spool.reanchor(self._hold_rnd)
         # Mid-run restores always come from the in-memory snapshot (the
         # on-disk copy, when a dir is set, is the same bytes but is only
         # read by a fresh-process resume) — the event says so honestly.
@@ -794,6 +807,10 @@ class Soak:
                 raise ValueError("pass rounds= or until_round=")
             until_round = r + rounds
         start = r
+        if self.spool is not None:
+            self.spool.arm(start)
+            spool_channels = tuple(
+                c.name for c in getattr(cl.cfg, "channels", ()))
         chunks: list[dict] = []
         log: list[dict] = []
         retries = breaches = 0
@@ -1119,6 +1136,28 @@ class Soak:
                     row["p99"] = {ch: e["p99"]
                                   for ch, e in pct.items()}
                     self._lat_prev = snap
+                if self.spool is not None and not donated_away:
+                    # full-horizon spool drain at the boundary the
+                    # barrier already synchronized (donated rows have
+                    # no readable state — the stretch's last chunk
+                    # catches their ring deltas).  Host time is stamped
+                    # into the row so perfwatch.decompose can subtract
+                    # it from the next chunk's dispatch gap.
+                    sp0 = time.perf_counter()
+                    ptr = self.spool.drain(
+                        poll_state, got, channels=spool_channels,
+                        p99=row.get("p99"), k=k, window_round=r)
+                    row["spool_s"] = round(
+                        time.perf_counter() - sp0, 4)
+                    row["spool"] = ptr
+                    if self.bus is not None:
+                        from partisan_tpu import telemetry \
+                            as telemetry_mod
+
+                        telemetry_mod.emit(
+                            self.bus, telemetry_mod.SPOOL_DRAINED,
+                            {"rows": ptr["rows"]},
+                            {"round": got, "line": ptr["line"]})
                 chunks.append(row)
                 lengths.add(k)
                 state, r = nxt_state, got

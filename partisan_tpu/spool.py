@@ -1,0 +1,395 @@
+"""Full-horizon telemetry spool: the always-on collection path.
+
+Every observability plane records into a bounded device-resident ring,
+so a post-hoc ``snapshot()`` only attests the ring's TAIL window — any
+incident older than the window is "unobservable" to the opslog matcher
+(opslog.py, the coverage map).  The spool closes that gap on the host
+side: at every soak chunk boundary (the host-sync point that already
+exists — no new traced equations, census parity pinned by
+tests/test_spool.py) it drains each armed plane's ring *delta* since
+the last drain into an append-only JSON-lines file.  The union of the
+deltas is the full horizon: ``opslog.ingest_spool`` extends
+``Journal.streams`` coverage back to the run's entry round, and
+formerly-unobservable spans become real closed/undetected verdicts.
+
+Contracts (ARCHITECTURE.md "Full-horizon telemetry spool & operator
+console" documents each):
+
+- **Record identity + merge.**  One JSON object per line, dedup
+  identity ``(round, stream, event)`` — the journal's Entry identity
+  with no channel/node/dup axis (spool rows are whole-cluster ring
+  rows).  First copy wins; re-draining a replayed window after a
+  kill/restore or a rewound retry appends nothing, because the
+  re-executed rounds are bit-identical (deterministic scan from a
+  checkpoint) and their keys are already present.
+- **Bit-identity.**  Records carry ONLY device-derived values (ring
+  rows, poll scalars) — never host timing — and every record is keyed
+  by the round the device stamped it with.  Under pinned chunk
+  boundaries (``SoakConfig.chunk_fixed``, a non-donating cluster) a
+  kill/restore run and a ``pipeline_depth > 1`` run produce files
+  byte-identical to the uninterrupted run's (tests/test_spool.py).
+- **Pipeline-boundary rule.**  Drains happen only where the soak loop
+  already synchronizes: after a completed chunk barrier, and — when
+  the cluster donates its carry — only at drained-pipeline boundaries
+  (the rows that poll at all).  The spool never adds a sync point.
+- **Drain cost is accounted.**  The soak loop stamps each chunk row
+  with ``spool_s`` (host seconds spent draining) and
+  ``perfwatch.decompose`` subtracts it from the dispatch gap, so
+  collection cost can't masquerade as dispatch wall.
+
+Every record's ``event`` field is a dot-joined ``telemetry.EVENTS``
+name (the ``partisan.spool.*`` family) — the one registry stays the
+only event namespace, and the sync-guard test covers the spool too.
+
+Known windowed-skip: ``health.deg_hist`` (a histogram row) and the
+``digests`` words are not spooled — the discrete transitions the
+journal consumes never read them, and rows stay flat JSON scalars and
+short lists.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+from partisan_tpu import telemetry
+
+# Dot-joined record type names (the spool file's ``event`` field).
+EV_METRICS = ".".join(telemetry.SPOOL_METRICS_ROW)
+EV_HEALTH = ".".join(telemetry.SPOOL_HEALTH_ROW)
+EV_BROADCAST = ".".join(telemetry.SPOOL_BROADCAST_ROW)
+EV_CTL_FANOUT = ".".join(telemetry.SPOOL_CONTROL_FANOUT)
+EV_CTL_BACKPRESSURE = ".".join(telemetry.SPOOL_CONTROL_BACKPRESSURE)
+EV_CTL_HEALING = ".".join(telemetry.SPOOL_CONTROL_HEALING)
+EV_TRAFFIC = ".".join(telemetry.SPOOL_TRAFFIC_ROW)
+EV_ELASTIC = ".".join(telemetry.SPOOL_ELASTIC_RESIZE)
+EV_LATENCY = ".".join(telemetry.SPOOL_LATENCY_WINDOW)
+EV_INGRESS = ".".join(telemetry.SPOOL_INGRESS_LEVEL)
+
+# record stream per event — the journal-facing plane names (opslog
+# STREAM_RANK's vocabulary), fixed write order within a drain so the
+# file is deterministic.
+EVENT_STREAMS = (
+    (EV_METRICS, "metrics"),
+    (EV_HEALTH, "health"),
+    (EV_BROADCAST, "broadcast"),
+    (EV_CTL_FANOUT, "control"),
+    (EV_CTL_BACKPRESSURE, "control"),
+    (EV_CTL_HEALING, "control"),
+    (EV_TRAFFIC, "traffic"),
+    (EV_ELASTIC, "elastic"),
+    (EV_LATENCY, "latency"),
+    (EV_INGRESS, "ingress"),
+)
+STREAM_OF = dict(EVENT_STREAMS)
+
+
+def _jsonable(v):
+    """Coerce numpy scalars/arrays into plain JSON values — the spool
+    line must not depend on numpy's repr."""
+    import numpy as np
+
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return _jsonable(v.tolist())
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    return v
+
+
+@dataclasses.dataclass
+class Spool:
+    """One run's append-only telemetry spool.
+
+    ``arm(start)`` stamps the run's entry round (the coverage anchor
+    ``opslog.ingest_spool`` extends streams back to); ``drain(state,
+    rnd, ...)`` decodes each armed plane's ring and appends every
+    not-yet-spooled row; ``reanchor(rnd)`` re-opens the delta windows
+    after a soak rewind (re-drained rows dedup — first copy wins).
+
+    Opening an existing file RESUMES it: the constructor recovers the
+    dedup keys and per-event high-water marks from the lines on disk
+    (tolerating a torn final line from a killed process), so a
+    fresh-process ``resume=True`` soak appends exactly the rows the
+    killed run never wrote.
+    """
+
+    path: str
+
+    def __post_init__(self):
+        self._keys: set = set()          # (round, stream, event)
+        self._marks: dict[str, int] = {}  # event -> newest spooled round
+        self._start: int | None = None
+        self._meta: dict = {}
+        self._fh = None
+        self._lines = 0
+        self._gaps = 0                    # ring windows that opened past
+        #                                   the previous mark: rounds
+        #                                   lost to wraparound between
+        #                                   drains (in-memory only — a
+        #                                   counter in the file would
+        #                                   break bit-identity)
+        self._load()
+
+    # ---- file state ---------------------------------------------------
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue              # torn tail from a killed run
+                if "spool_meta" in obj:
+                    self._meta = obj["spool_meta"]
+                    self._start = self._meta.get("start")
+                    self._lines += 1
+                    continue
+                key = (obj["round"], obj["stream"], obj["event"])
+                self._keys.add(key)
+                ev = obj["event"]
+                self._marks[ev] = max(self._marks.get(ev, -1),
+                                      int(obj["round"]))
+                self._lines += 1
+
+    def _open(self, planes, channels) -> None:
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        if self._lines == 0:
+            # lazy header at the FIRST drain (the armed planes are only
+            # known then); a resumed file keeps its original header —
+            # one header per file, byte-identity preserved
+            self._meta = {"version": 1, "start": self._start,
+                          "planes": list(planes),
+                          "channels": list(channels or ())}
+            self._fh.write(json.dumps({"spool_meta": self._meta},
+                                      separators=(",", ":")) + "\n")
+            self._lines += 1
+
+    def arm(self, start: int) -> None:
+        """Stamp the run's entry round — every plane attests from here
+        (each ring row since ``start`` reaches some drain)."""
+        if self._start is None:
+            self._start = int(start)
+
+    # ---- the drain ----------------------------------------------------
+    def _emit(self, event: str, rnd: int, meas: dict) -> int:
+        key = (int(rnd), STREAM_OF[event], event)
+        if key in self._keys:
+            return 0
+        rec = {"round": key[0], "stream": key[1], "event": event,
+               "measurements": _jsonable(meas)}
+        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._keys.add(key)
+        self._lines += 1
+        return 1
+
+    def _ring_rows(self, event: str, rounds, fields) -> int:
+        """Append the ring delta: rows newer than the event's mark, in
+        round order.  ``fields(i) -> measurements``."""
+        mark = self._marks.get(event, -1)
+        fresh = [(int(r), i) for i, r in enumerate(rounds)
+                 if int(r) > mark]
+        if not fresh:
+            return 0
+        fresh.sort()
+        if mark >= 0:
+            # wraparound heuristic: the oldest surviving undrained row
+            # should continue the ring's own cadence; a larger jump
+            # means rows fell off between drains
+            stride = min((b - a for (a, _), (b, _)
+                          in zip(fresh, fresh[1:])), default=1)
+            if fresh[0][0] > mark + stride:
+                self._gaps += 1
+        written = 0
+        for r, i in fresh:
+            written += self._emit(event, r, fields(i))
+        self._marks[event] = max(mark, fresh[-1][0])
+        return written
+
+    def drain(self, state, rnd: int, *, channels=None, p99=None,
+              k=None, window_round=None) -> dict:
+        """Drain every armed plane's ring delta at a chunk boundary.
+
+        ``rnd`` is the boundary round (the chunk's end); ``p99``/``k``/
+        ``window_round`` carry the soak loop's windowed-latency poll
+        (the chunk-start-keyed SLO series).  Returns ``{"rows": n,
+        "line": file_line_count}`` — the chunk row's spool pointer.
+        Host-side only: ring decodes reuse the planes' own snapshot
+        readers (one device->host transfer each, never inside a scan).
+        """
+        planes = []
+        for attr in ("metrics", "health", "provenance", "control",
+                     "traffic", "elastic", "ingress"):
+            if getattr(state, attr, ()) != ():
+                planes.append(attr)
+        if p99 is not None:
+            planes.append("latency")
+        self._open(planes, channels)
+        w = 0
+
+        if getattr(state, "metrics", ()) != ():
+            from partisan_tpu import metrics as metrics_mod
+
+            snap = metrics_mod.snapshot(state.metrics)
+            w += self._ring_rows(EV_METRICS, snap["rounds"], lambda i: {
+                "emitted": snap["emitted"][i],
+                "delivered": snap["delivered"][i],
+                "causal": snap["causal"][i],
+                "shed": snap["shed"][i],
+                "drops": snap["drops"][i],
+                "inbox_hwm": snap["inbox_hwm"][i],
+                "inbox_occ": snap["inbox_occ"][i],
+                "edges_total": snap["edges_total"][i],
+                "edges_min": snap["edges_min"][i],
+                "edges_max": snap["edges_max"][i],
+                "alive": snap["alive"][i],
+                "dlv_overflow": snap["dlv_overflow"][i],
+            })
+        if getattr(state, "health", ()) != ():
+            from partisan_tpu import health as health_mod
+
+            snap = health_mod.snapshot(state.health)
+            w += self._ring_rows(EV_HEALTH, snap["rounds"], lambda i: {
+                "components": snap["components"][i],
+                "isolated": snap["isolated"][i],
+                "deg_min": snap["deg_min"][i],
+                "deg_max": snap["deg_max"][i],
+                "sym_violations": snap["sym_violations"][i],
+                "joins": snap["joins"][i],
+                "leaves": snap["leaves"][i],
+                "ups": snap["ups"][i],
+                "downs": snap["downs"][i],
+            })
+        if getattr(state, "provenance", ()) != ():
+            from partisan_tpu import provenance as prov_mod
+
+            snap = prov_mod.snapshot(state.provenance)
+            w += self._ring_rows(EV_BROADCAST, snap["rounds"],
+                                 lambda i: {
+                "dup": snap["dup"][i],
+                "gossip": snap["gossip"][i],
+                "claims": snap["claims"][i],
+                "ctl": snap["ctl"][i],
+            })
+        if getattr(state, "control", ()) != ():
+            from partisan_tpu import control as control_mod
+
+            snap = control_mod.snapshot(state.control)
+            fan = snap.get("fanout")
+            if fan is not None:
+                w += self._ring_rows(
+                    EV_CTL_FANOUT, fan["rounds"],
+                    lambda i: {"cap": fan["cap"][i]})
+            bp = snap.get("backpressure")
+            if bp is not None:
+                w += self._ring_rows(
+                    EV_CTL_BACKPRESSURE, bp["rounds"],
+                    lambda i: {"press": bp["press"][i]})
+            heal = snap.get("healing")
+            if heal is not None:
+                w += self._ring_rows(
+                    EV_CTL_HEALING, heal["rounds"],
+                    lambda i: {"boost": heal["boost"][i]})
+        if getattr(state, "traffic", ()) != ():
+            from partisan_tpu import workload as workload_mod
+
+            snap = workload_mod.snapshot(state.traffic)
+            # rate_x1000 is the operand in force over the drained delta
+            # (SetRate applies only at boundaries, and a non-donating
+            # cluster drains every chunk) — deterministic device state,
+            # so the row is boundary-invariant
+            rate = int(snap["rate_x1000"])
+            w += self._ring_rows(EV_TRAFFIC, snap["rounds"], lambda i: {
+                "arrivals": snap["arrivals"][i],
+                "rate_x1000": rate,
+            })
+        if getattr(state, "elastic", ()) != ():
+            from partisan_tpu import elastic as elastic_mod
+
+            snap = elastic_mod.snapshot(state.elastic)
+            w += self._ring_rows(EV_ELASTIC, snap["rounds"], lambda i: {
+                "width": snap["widths"][i],
+                "from": snap["from"][i],
+            })
+        if p99 is not None and window_round is not None:
+            w += self._emit(EV_LATENCY, int(window_round),
+                            {"k": int(k or 0), "p99": dict(p99)})
+        if getattr(state, "ingress", ()) != ():
+            from partisan_tpu import ingress as ingress_mod
+
+            lvl = ingress_mod.poll(state.ingress)
+            w += self._emit(EV_INGRESS, int(rnd), {
+                "staged": lvl["staged"],
+                "injected": lvl["injected"],
+                "shed": lvl["shed"],
+            })
+        self._fh.flush()
+        return {"rows": w, "line": self._lines}
+
+    # ---- rewind / introspection --------------------------------------
+    def reanchor(self, rnd: int) -> None:
+        """Re-open the delta windows after a soak rewind to round
+        ``rnd``: re-executed rounds re-drain (and dedup — first copy
+        wins) instead of being mark-skipped, so an adaptive-chunk rerun
+        that lands NEW boundaries still spools its rows."""
+        for ev in list(self._marks):
+            self._marks[ev] = min(self._marks[ev], int(rnd))
+
+    def stats(self) -> dict:
+        return {"path": self.path, "lines": self._lines,
+                "rows": len(self._keys), "ring_gaps": self._gaps,
+                "start": self._start}
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read(path: str) -> tuple[dict, list[dict]]:
+    """Read a spool file: ``(meta, records)``.  Malformed lines (the
+    torn tail of a live or killed writer — the ``--follow`` tailing
+    path) are skipped; duplicate identities keep the FIRST copy (the
+    journal's merge contract); records come back round-sorted per
+    event, globally ordered by ``(round, stream, event)``."""
+    meta: dict = {}
+    seen: set = set()
+    records: list[dict] = []
+    if not os.path.exists(path):
+        return meta, records
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if "spool_meta" in obj:
+                sm = obj["spool_meta"]
+                if not meta:
+                    meta = dict(sm)
+                continue
+            try:
+                key = (int(obj["round"]), obj["stream"], obj["event"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if key in seen:
+                continue
+            seen.add(key)
+            records.append(obj)
+    records.sort(key=lambda rec: (rec["round"], rec["stream"],
+                                  rec["event"]))
+    return meta, records

@@ -96,6 +96,26 @@ PERF_DISPATCH_WALL = ("partisan", "perf", "dispatch_wall")
 PERF_PHASE_OUTLIER = ("partisan", "perf", "phase_outlier")
 PERF_REGRESSION = ("partisan", "perf", "regression")
 
+# Full-horizon telemetry-spool records (spool.py): the ``*.row`` /
+# ``*.resize`` / ``*.window`` / ``*.level`` names are the EVENT FIELD
+# of the spool's append-only JSON-lines records (one per plane ring
+# row drained at a soak chunk boundary — journal dedup identity, never
+# emitted on a bus), registered here so the one registry stays the
+# only event namespace.  ``drained`` is the live bus marker the soak
+# engine emits after each drain (rows written + file line pointer).
+SPOOL_METRICS_ROW = ("partisan", "spool", "metrics", "row")
+SPOOL_HEALTH_ROW = ("partisan", "spool", "health", "row")
+SPOOL_BROADCAST_ROW = ("partisan", "spool", "broadcast", "row")
+SPOOL_CONTROL_FANOUT = ("partisan", "spool", "control", "fanout")
+SPOOL_CONTROL_BACKPRESSURE = ("partisan", "spool", "control",
+                              "backpressure")
+SPOOL_CONTROL_HEALING = ("partisan", "spool", "control", "healing")
+SPOOL_TRAFFIC_ROW = ("partisan", "spool", "traffic", "row")
+SPOOL_ELASTIC_RESIZE = ("partisan", "spool", "elastic", "resize")
+SPOOL_LATENCY_WINDOW = ("partisan", "spool", "latency", "window")
+SPOOL_INGRESS_LEVEL = ("partisan", "spool", "ingress", "level")
+SPOOL_DRAINED = ("partisan", "spool", "drained")
+
 
 # ---------------------------------------------------------------------------
 # The event-name registry: ONE catalog of every ``partisan.*`` event,
@@ -178,6 +198,21 @@ EVENTS: dict[tuple, EventSpec] = {spec.name: spec for spec in (
               ("phase",)),
     EventSpec(PERF_REGRESSION, "error", ("rounds_per_sec", "delta_pct"),
               ()),
+    EventSpec(SPOOL_METRICS_ROW, "info",
+              ("shed", "drops", "edges_min", "alive"), ()),
+    EventSpec(SPOOL_HEALTH_ROW, "info",
+              ("components", "isolated", "joins", "leaves", "ups",
+               "downs"), ()),
+    EventSpec(SPOOL_BROADCAST_ROW, "info", ("dup", "gossip", "ctl"), ()),
+    EventSpec(SPOOL_CONTROL_FANOUT, "info", ("cap",), ()),
+    EventSpec(SPOOL_CONTROL_BACKPRESSURE, "info", ("press",), ()),
+    EventSpec(SPOOL_CONTROL_HEALING, "info", ("boost",), ()),
+    EventSpec(SPOOL_TRAFFIC_ROW, "info", ("arrivals",), ()),
+    EventSpec(SPOOL_ELASTIC_RESIZE, "info", ("width", "from"), ()),
+    EventSpec(SPOOL_LATENCY_WINDOW, "info", ("k",), ()),
+    EventSpec(SPOOL_INGRESS_LEVEL, "info",
+              ("staged", "injected", "shed"), ()),
+    EventSpec(SPOOL_DRAINED, "info", ("rows",), ("round", "line")),
 )}
 
 

@@ -64,15 +64,17 @@ FASTSV_TRIALS = 64 if FULL else 50
 FLEET_PAR_W = 8 if FULL else 4          # fleet-vs-loop parity width
 FLEET_SEARCH_W = 64                     # acceptance floor, both modes
 FLEET_TUNE_N = 128 if FULL else 64      # tune harness overlay size
-FLEET_TUNE_WAVES = 12 if FULL else 4    # broadcast waves per tune run
-#   (4: tune only ranks candidate bands — every wave re-runs the same
+FLEET_TUNE_WAVES = 12 if FULL else 3    # broadcast waves per tune run
+#   (3: tune only ranks candidate bands — every wave re-runs the same
 #   jitted member program, so fewer waves trims wall without touching
 #   an assertion — ISSUE 16 paydown 12->6, ISSUE 17 6->5, ISSUE 18
-#   5->4 offsetting the superstep/pipelined-dispatch suites)
+#   5->4, ISSUE 19 4->3 offsetting the spool suites; 3 still ranks the
+#   adaptive band ahead of static at full coverage, deterministically)
 # incident-observatory soak width (tests/test_incident.py): the span
-# matcher and kill/restore parity are width-independent — 32 keeps the
+# matcher and kill/restore parity are width-independent — 24 keeps the
 # 5% crash batch >= one node and the partition two real components
-OPS_SOAK_N = 48 if FULL else 32
+# (ISSUE 19 paydown 32->24, offsetting the new spool suites)
+OPS_SOAK_N = 48 if FULL else 24
 
 
 def hv_config(n, seed, **kw):

@@ -1,0 +1,293 @@
+"""Full-horizon telemetry spool (the ISSUE 19 acceptance suite):
+
+1. the spool is BIT-DETERMINISTIC across execution regimes — a
+   kill + fresh-engine-restore run and a ``pipeline_depth > 1`` run
+   produce files byte-identical to the uninterrupted run's (the spool
+   records only device-derived values at pinned chunk boundaries),
+2. it FLIPS observability verdicts both directions: an incident whose
+   every ring window expired is "unobservable" on ring evidence and a
+   real "closed" span once the spool is ingested — and a handcrafted
+   spool that attests the window WITHOUT the detection flips the same
+   span to "undetected" (the gate failure ring expiry used to hide),
+3. draining is host-side only (census parity: zero traced eqns) and
+   its cost is accounted (``spool_s`` chunk stamps, perfwatch's
+   gap-vs-spool attribution), bounded loosely against execution time,
+4. every spool record's event name is registered in
+   ``telemetry.EVENTS`` and ``opslog.ingest_spool`` is idempotent
+   (re-ingest appends nothing — the dedup-identity merge contract).
+
+One module-scoped storm soak feeds all of it: TINY rings (16 rows) and
+a partition injected early then healed, so by the run's end every ring
+has wrapped far past the incident — exactly the span the spool exists
+to preserve.
+"""
+
+import hashlib
+import json
+import os
+
+import jax
+import pytest
+
+import support  # noqa: F401  (sys.path side effect for partisan_tpu)
+from partisan_tpu import opslog, perfwatch, soak, spool, telemetry
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, ControlConfig
+from partisan_tpu.models.plumtree import Plumtree
+
+N = 16
+# partition at +4, healed at +10, run to +60: the 16-row rings retain
+# only rounds ~44..60 at the end, so the incident is ring-expired
+STORM_EVENTS = ((4, soak.Partition()), (10, soak.Heal()))
+ROUNDS = 60
+KILL_AT = 30
+
+
+def _mk():
+    cfg = Config(n_nodes=N, seed=5, peer_service_manager="hyparview",
+                 msg_words=16, partition_mode="groups",
+                 metrics=True, metrics_ring=16, latency=True,
+                 health=1, health_ring=16,
+                 control=ControlConfig(healing=True))
+    return Cluster(cfg, model=Plumtree())
+
+
+def _storm(start):
+    return soak.Storm(events=STORM_EVENTS, start=start, period=0)
+
+
+def _sha(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _cfg(**kw):
+    kw.setdefault("chunk_fixed", 10)
+    kw.setdefault("poll_latency", True)
+    return soak.SoakConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def spool_run(tmp_path_factory):
+    """The shared storm soak, spooled three ways: an uninterrupted
+    reference, a killed run whose fresh-engine resume REOPENS the same
+    spool file, and a pipelined (depth-2) run."""
+    tmp = tmp_path_factory.mktemp("spool")
+    cl = _mk()
+    st = cl.init()
+    m = cl.manager.join_many(cl.cfg, st.manager,
+                             list(range(1, N)), [0] * (N - 1))
+    st = cl.steps(st._replace(manager=m), 20)
+    st = st._replace(model=cl.model.broadcast(st.model, 0, 0,
+                                              int(st.rnd)))
+    st = cl.steps(st, 5)
+    r0 = int(jax.device_get(st.rnd))
+
+    ref_path = str(tmp / "ref.spool.jsonl")
+    sp_ref = spool.Spool(ref_path)
+    eng = soak.Soak(make_cluster=lambda: cl, storm=_storm(r0),
+                    cfg=_cfg(), spool=sp_ref)
+    res_ref = eng.run(st, rounds=ROUNDS)
+    sp_ref.close()
+
+    ckpt = tmp_path_factory.mktemp("spool_ckpt")
+    kr_path = str(tmp / "kr.spool.jsonl")
+    sp_a = spool.Spool(kr_path)
+    eng_a = soak.Soak(make_cluster=lambda: cl, storm=_storm(r0),
+                      cfg=_cfg(checkpoint_dir=str(ckpt)), spool=sp_a)
+    eng_a.run(st, until_round=r0 + KILL_AT)
+    sp_a.close()
+    # the fresh-process path: new cluster, new spool OBJECT on the same
+    # file (the constructor recovers dedup keys + marks from disk)
+    sp_b = spool.Spool(kr_path)
+    eng_b = soak.Soak(make_cluster=_mk, storm=_storm(r0),
+                      cfg=_cfg(checkpoint_dir=str(ckpt)), spool=sp_b)
+    eng_b.run(resume=True, until_round=r0 + ROUNDS)
+    sp_b.close()
+
+    pipe_path = str(tmp / "pipe.spool.jsonl")
+    sp_p = spool.Spool(pipe_path)
+    eng_p = soak.Soak(make_cluster=lambda: cl, storm=_storm(r0),
+                      cfg=_cfg(pipeline_depth=2, checkpoint_every=20),
+                      spool=sp_p)
+    eng_p.run(st, rounds=ROUNDS)
+    sp_p.close()
+
+    return {"r0": r0, "cl": cl, "boot": st, "res_ref": res_ref,
+            "ref": ref_path, "kr": kr_path, "pipe": pipe_path,
+            "stats": sp_ref.stats()}
+
+
+def test_spool_bit_identical_across_regimes(spool_run):
+    """Acceptance: kill/restore AND pipelined spools byte-identical to
+    the uninterrupted run's."""
+    h_ref = _sha(spool_run["ref"])
+    assert h_ref == _sha(spool_run["kr"]), \
+        "kill/restore spool differs from the uninterrupted run's"
+    assert h_ref == _sha(spool_run["pipe"]), \
+        "pipelined spool differs from the uninterrupted run's"
+    st = spool_run["stats"]
+    assert st["rows"] > 0 and st["start"] == spool_run["r0"]
+    # the resumed file kept its ORIGINAL header: exactly one meta line
+    with open(spool_run["kr"]) as f:
+        metas = [ln for ln in f if "spool_meta" in ln]
+    assert len(metas) == 1
+
+
+def test_spool_flips_unobservable_to_closed(spool_run):
+    """The coverage flip: ring-expired partition is "unobservable" on
+    final-ring evidence, a measured CLOSED span once the spool extends
+    coverage to the run's entry round."""
+    r0 = spool_run["r0"]
+    res = spool_run["res_ref"]
+
+    j_ring = opslog.from_soak(res, storm=_storm(r0), slo_rounds=8)
+    (part,) = [s for s in opslog.match(j_ring)["spans"]
+               if s["rule"] == "partition"]
+    assert part["status"] == "unobservable"
+    # ...BECAUSE the final rings start after the cause, not because the
+    # planes were off
+    assert j_ring.streams["health"] > part["cause_round"]
+    assert j_ring.streams["metrics"] > part["cause_round"]
+    # unobservable is reported, never gated
+    assert opslog.gate(opslog.match(j_ring))["ok"]
+
+    j_sp = opslog.ingest_spool(
+        spool_run["ref"],
+        journal=opslog.from_soak(res, storm=_storm(r0), slo_rounds=8),
+        slo_rounds=8)
+    assert "spool" in j_sp.streams
+    for s in ("health", "metrics", "latency"):
+        assert j_sp.streams[s] == r0, s
+    m = opslog.match(j_sp)
+    (part,) = [s for s in m["spans"] if s["rule"] == "partition"]
+    assert part["status"] == "closed"
+    assert part["cause_round"] == r0 + 4
+    assert part["detect_latency"] >= 0
+    assert part["recover_round"] >= r0 + 10
+    assert m["counts"]["unobservable"] == 0
+    assert m["orphans"] == []
+    assert opslog.gate(m)["ok"]
+    # the recovery marker is a spool-sourced FALLING edge (the replay
+    # adapters run with falling=True over the spooled series)
+    from partisan_tpu import health as health_mod
+
+    ring = health_mod.snapshot(res.state.health)["rounds"]
+    ring_lo = min(int(r) for r in ring if int(r) >= 0)
+    healed = [e for e in j_sp.entries
+              if e.event == "partisan.health.overlay_healed"]
+    assert healed and min(e.round for e in healed) < ring_lo
+
+
+def test_handcrafted_spool_flips_unobservable_to_undetected(tmp_path):
+    """The other direction: a spool that attests the incident window
+    with NO detection turns "unobservable" into "undetected" — the
+    real gate failure ring expiry used to mask."""
+    j = opslog.Journal()
+    j.cover("inject", 0)
+    j.append(5, "inject", "inject.Partition", cause_id="p0")
+    j.cover("health", 50)        # the final ring's window: too late
+    j.start, j.end = 0, 60
+    (span,) = opslog.match(j)["spans"]
+    assert span["status"] == "unobservable"
+    assert opslog.gate(opslog.match(j))["ok"]
+
+    sp = tmp_path / "flat.spool.jsonl"
+    lines = [json.dumps({"spool_meta": {
+        "version": 1, "start": 0, "planes": ["health"],
+        "channels": []}})]
+    for r in range(0, 61, 2):
+        lines.append(json.dumps({
+            "round": r, "stream": "health", "event": spool.EV_HEALTH,
+            "measurements": {"components": 1, "isolated": 0,
+                             "deg_min": 3, "deg_max": 5,
+                             "sym_violations": 0, "joins": 0,
+                             "leaves": 0, "ups": 0, "downs": 0}}))
+    sp.write_text("\n".join(lines) + "\n")
+
+    j2 = opslog.ingest_spool(str(sp), journal=j)
+    assert j2.streams["health"] == 0
+    (span,) = opslog.match(j2)["spans"]
+    assert span["status"] == "undetected"
+    verdict = opslog.gate(opslog.match(j2))
+    assert not verdict["ok"] and verdict["undetected"] == 1
+
+
+def test_drain_traces_zero_eqns(spool_run, tmp_path):
+    """The drain is host-side bookkeeping only: a direct Spool.drain
+    over a live state changes NOTHING in any traced program (the
+    perfwatch census-parity pin)."""
+    from partisan_tpu.lint.cost import bench_round_program, \
+        census_program
+
+    base = census_program(bench_round_program(64))
+    cl, st = spool_run["cl"], spool_run["boot"]
+    sp = spool.Spool(str(tmp_path / "census.spool.jsonl"))
+    sp.arm(int(jax.device_get(st.rnd)))
+    ptr = sp.drain(st, int(jax.device_get(st.rnd)),
+                   channels=tuple(c.name for c in cl.cfg.channels))
+    sp.close()
+    assert ptr["rows"] > 0
+    under = census_program(bench_round_program(64))
+    assert {p: c.eqns for p, c in base.phases.items()} == \
+        {p: c.eqns for p, c in under.phases.items()}
+    assert base.total.eqns == under.total.eqns
+
+
+def test_drain_cost_stamped_and_attributed(spool_run):
+    """Every polled chunk row carries its drain's host seconds, the
+    decomposition reports them as a spool column (not dispatch gap),
+    and the cost stays a small fraction of execution time."""
+    rows = [r for r in spool_run["res_ref"].chunks
+            if isinstance(r, dict) and "wall_s" in r]
+    assert rows and all("spool_s" in r and r["spool_s"] >= 0
+                        and "spool" in r for r in rows)
+    dec = perfwatch.decompose_chunks(spool_run["res_ref"].chunks)
+    assert dec.get("spool_s", 0) >= 0
+    # loose overhead bound: tiny-ring drains must not rival execution
+    assert sum(r["spool_s"] for r in rows) < 0.5 * dec["in_execution_s"]
+
+
+def test_decompose_attributes_spool_out_of_gap():
+    """Unit math: a drain between chunk K's ready and chunk K+1's
+    submit lands in K+1's gap_s — decompose moves min(spool, gap) into
+    the spool column, and the LAST row's drain (no later gap) is still
+    spool time."""
+    rows = [
+        {"wall_s": 1.0, "gap_s": 0.5, "spool_s": 0.2},
+        {"wall_s": 1.0, "gap_s": 0.3, "spool_s": 0.05},
+        {"wall_s": 1.0, "gap_s": 0.01, "spool_s": 0.4},
+    ]
+    dec = perfwatch.decompose(rows)
+    # row 1 gap untouched (no prior drain); row 2: 0.3 - 0.2; row 3:
+    # 0.01 fully absorbed (clamped at the gap); final drain 0.4 added
+    assert dec["gap_s"] == pytest.approx(0.5 + 0.1 + 0.0)
+    assert dec["spool_s"] == pytest.approx(0.2 + 0.01 + 0.4)
+    assert dec["in_execution_s"] == pytest.approx(3.0)
+
+
+def test_every_record_event_is_registered(spool_run):
+    """Satellite 3: the spool writes only ``telemetry.EVENTS`` names
+    (dot-joined), under the stream opslog ranks them by."""
+    registered = {".".join(name) for name in telemetry.EVENTS}
+    meta, records = spool.read(spool_run["ref"])
+    assert meta["start"] == spool_run["r0"]
+    assert records
+    for rec in records:
+        assert rec["event"] in registered, rec["event"]
+        assert rec["stream"] == spool.STREAM_OF[rec["event"]]
+    # the run spooled every plane the scenario armed
+    events = {r["event"] for r in records}
+    assert {spool.EV_METRICS, spool.EV_HEALTH, spool.EV_CTL_HEALING,
+            spool.EV_LATENCY} <= events
+
+
+def test_ingest_spool_is_idempotent(spool_run):
+    """Re-ingesting the same spool appends nothing: entry identity
+    dedups, coverage min-merges, the span set is unchanged."""
+    once = opslog.ingest_spool(spool_run["ref"])
+    n1, spans1 = len(once.entries), opslog.match(once)["spans"]
+    twice = opslog.ingest_spool(spool_run["ref"], journal=once)
+    assert twice is once
+    assert len(twice.entries) == n1
+    assert opslog.match(twice)["spans"] == spans1

@@ -247,8 +247,9 @@ def test_tools_cli_completeness():
     tools_dir = os.path.join(_REPO, "tools")
     tools = sorted(f for f in os.listdir(tools_dir)
                    if f.endswith(".py"))
-    assert len(tools) >= 14, tools
+    assert len(tools) >= 15, tools
     assert "incident_report.py" in tools
+    assert "ops_watch.py" in tools
     assert "soak_report.py" in tools
     assert "jaxlint.py" in tools
     assert "fleet_report.py" in tools
@@ -476,6 +477,151 @@ def test_trace_export_ops_cli_smoke(tmp_path):
     # cause round 5 -> recovery round 12, in --round-ms=1000 microseconds
     assert (span["ts"], span["dur"]) == (5_000_000, 7_000_000)
     assert span["args"]["status"] == "closed"
+
+
+def _spool_fixture(path):
+    """A handcrafted telemetry spool: the health plane attests every
+    round 0..30 (components 2 over 7..11 — a partition window the
+    replay adapters turn into the detected/healed edge pair) plus three
+    windowed-latency polls, one of them an SLO breach."""
+    from partisan_tpu import spool as spool_mod
+
+    lines = [{"spool_meta": {"version": 1, "start": 0,
+                             "planes": ["health", "latency"],
+                             "channels": ["default"]}}]
+    for r in range(31):
+        lines.append({
+            "round": r, "stream": "health",
+            "event": spool_mod.EV_HEALTH,
+            "measurements": {"components": 2 if 7 <= r < 12 else 1,
+                             "isolated": 0, "deg_min": 3, "deg_max": 5,
+                             "sym_violations": 0, "joins": 0,
+                             "leaves": 0, "ups": 0, "downs": 0}})
+    for r, p99 in ((0, 2.0), (10, 30.0), (20, 2.0)):
+        lines.append({"round": r, "stream": "latency",
+                      "event": spool_mod.EV_LATENCY,
+                      "measurements": {"k": 10,
+                                       "p99": {"default": p99}}})
+    with open(path, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+
+
+def _ring_expired_journal(path):
+    """A journal whose only plane coverage starts AFTER the cause — the
+    ring-expired shape the spool re-judges."""
+    lines = [
+        {"journal_meta": {"streams": {"inject": 0, "health": 50},
+                          "start": 0, "end": 30}},
+        {"round": 5, "stream": "inject", "event": "inject.Partition",
+         "cause_id": "5:inject.Partition"},
+    ]
+    with open(path, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+
+
+def test_ops_watch_cli_smoke(tmp_path):
+    """Operator console, one-shot: spool + ring-expired journal fuse
+    into a CLOSED span, per-channel burn rows, and a status frame whose
+    coverage includes the spool stream."""
+    sp, jp = tmp_path / "run.spool.jsonl", tmp_path / "run.jsonl"
+    _spool_fixture(sp)
+    _ring_expired_journal(jp)
+    out = _run("ops_watch.py", str(sp), str(jp), "--slo-rounds", "8")
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    status = rows[-1]
+    assert status["kind"] == "ops_watch"
+    assert status["records"] == 34 and status["round"] == 30
+    assert status["start"] == 0
+    assert "spool" in status["streams"] and "health" in status["streams"]
+    assert status["spans"]["closed"] == 1
+    assert status["spans"]["unobservable"] == 0
+    (span,) = [r for r in rows if r["kind"] == "ops_span"]
+    assert span["rule"] == "partition" and span["status"] == "closed"
+    (burn,) = [r for r in rows if r["kind"] == "ops_burn"]
+    assert burn["channel"] == "default"
+    assert burn["breach_rounds"] > 0 and burn["burn"] > 0
+    # honest exit codes: a missing spool and a bogus flag both fail
+    assert _run("ops_watch.py",
+                str(tmp_path / "missing.spool.jsonl")).returncode != 0
+    assert _run("ops_watch.py", str(sp), "--bogus").returncode != 0
+
+
+def test_ops_watch_follow_smoke(tmp_path):
+    """--follow: bounded polls tail the spool and the second frame
+    carries the live spool-progress rate."""
+    sp = tmp_path / "run.spool.jsonl"
+    _spool_fixture(sp)
+    out = _run("ops_watch.py", str(sp), "--follow", "--polls", "2",
+               "--interval", "0.1")
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    frames = [json.loads(ln) for ln in out.stdout.strip().splitlines()
+              if json.loads(ln)["kind"] == "ops_watch"]
+    assert len(frames) == 2
+    # no new rounds between polls: the live rate is an honest zero
+    assert frames[1]["live_rounds_per_s"] == 0.0
+
+
+def test_incident_report_spool_flip(tmp_path):
+    """--spool re-judges a ring-expired journal: unobservable without
+    the spool, a real closed span (exit 0, coverage extended) with it."""
+    sp, jp = tmp_path / "run.spool.jsonl", tmp_path / "run.jsonl"
+    _spool_fixture(sp)
+    _ring_expired_journal(jp)
+    out = _run("incident_report.py", str(jp), "--gate")
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert rows[-1]["unobservable"] == 1 and rows[-1]["closed"] == 0
+
+    out = _run("incident_report.py", str(jp), "--gate",
+               "--spool", str(sp))
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert rows[-1]["closed"] == 1 and rows[-1]["unobservable"] == 0
+    assert "spool" in rows[-1]["streams"]
+    assert _run("incident_report.py", str(jp), "--spool",
+                str(tmp_path / "missing.spool.jsonl")).returncode != 0
+
+
+def test_incident_report_committed_spool_artifact():
+    """The committed OPS_r02 pair re-judges: ring evidence alone leaves
+    the partition unobservable; the spool artifact closes it — both
+    under --gate with exit 0 (the acceptance artifact, end to end)."""
+    out = _run("incident_report.py", "OPS_r02.jsonl", "--gate",
+               "--slo-rounds", "8")
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert rows[-1]["unobservable"] >= 1 and rows[-1]["closed"] == 0
+
+    out = _run("incident_report.py", "OPS_r02.jsonl", "--gate",
+               "--slo-rounds", "8", "--spool", "OPS_r02.spool.jsonl")
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert rows[-1]["closed"] >= 1 and rows[-1]["unobservable"] == 0
+    assert rows[-1]["orphans"] == 0
+    assert "spool" in rows[-1]["streams"]
+
+
+def test_soak_report_spool_smoke():
+    """--spool: the soak runs with a live spool attached — chunk rows
+    carry the drain-cost stamp and pointer, the spool_stats line
+    reconciles, and the summary reports the drain-cost column."""
+    out = _run("soak_report.py", "32", "30", "--chunk", "10", "--spool")
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    kinds = [r["kind"] for r in rows]
+    assert "spool" in kinds and "spool_stats" in kinds
+    chunks = [r for r in rows if r["kind"] == "chunk"]
+    assert chunks and all(
+        "spool_s" in c and c["spool"]["line"] > 0 for c in chunks)
+    stats = next(r for r in rows if r["kind"] == "spool_stats")
+    # file reconciles: every line but the header is a dedup-keyed row
+    assert stats["rows"] > 0 and stats["lines"] == stats["rows"] + 1
+    summary = rows[-1]
+    assert summary["spool_chunks"] == len(chunks)
+    assert summary["spool_s"] >= 0
 
 
 def test_soak_report_traffic_smoke():

@@ -18,6 +18,7 @@ Usage::
 
     python tools/incident_report.py JOURNAL [--gate] [--slo-rounds N]
         [--budget-frac F] [--exempt CH1,CH2] [--crowd-x1000 N]
+        [--spool SPOOL]
 
 ``--gate`` makes the exit status the verdict: nonzero when any
 observable incident stayed open or undetected, or a non-exempt
@@ -25,6 +26,12 @@ channel's error budget exhausted (``opslog.gate``) — the scenario/CI
 gate for committed soak artifacts.  Budgets need ``--slo-rounds``
 (the journal's chunk entries must carry windowed p99 polls,
 ``SoakConfig.poll_latency``); without it only spans gate.
+
+``--spool SPOOL`` merges a full-horizon telemetry spool
+(``opslog.ingest_spool``) into the journal before matching: plane
+coverage extends back to the spool's start, so ring-expired incidents
+judge as real closed/undetected spans instead of "unobservable" —
+the re-judge path for committed ``OPS_*.spool.jsonl`` artifacts.
 """
 
 from __future__ import annotations
@@ -36,7 +43,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 USAGE = ("usage: incident_report.py JOURNAL [--gate] [--slo-rounds N] "
-         "[--budget-frac F] [--exempt CH1,CH2] [--crowd-x1000 N]")
+         "[--budget-frac F] [--exempt CH1,CH2] [--crowd-x1000 N] "
+         "[--spool SPOOL]")
 
 
 def main() -> None:
@@ -45,7 +53,7 @@ def main() -> None:
         print(__doc__.strip())
         return
     VALUE_FLAGS = ("--slo-rounds", "--budget-frac", "--exempt",
-                   "--crowd-x1000")
+                   "--crowd-x1000", "--spool")
     argv = sys.argv[1:]
     args, opts, do_gate = [], {}, False
     i = 0
@@ -74,6 +82,15 @@ def main() -> None:
 
     journal = opslog.Journal.from_jsonl(path)
     crowd = opts.get("--crowd-x1000")
+    spool_path = opts.get("--spool")
+    if spool_path is not None:
+        if not os.path.exists(spool_path):
+            raise SystemExit(f"no such spool: {spool_path}")
+        slo_opt = opts.get("--slo-rounds")
+        journal = opslog.ingest_spool(
+            spool_path, journal=journal,
+            slo_rounds=int(slo_opt) if slo_opt else None,
+            crowd_x1000=int(crowd) if crowd else None)
     matched = opslog.match(
         journal, crowd_x1000=int(crowd) if crowd else None)
     for span in matched["spans"]:

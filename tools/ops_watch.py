@@ -1,0 +1,323 @@
+"""Live operator console over the full-horizon telemetry spool (the
+serving-front-end operator view for ROADMAP item 3).
+
+One-shot: load a spool (spool.py) plus any journal artifacts, fuse
+them (``opslog.ingest_spool`` — plane coverage extends back to the
+spool's start), and print the operator view as JSON lines::
+
+    {"kind": "ops_watch", ...}    the status frame (always last)
+    {"kind": "ops_span", ...}     one per matched incident span
+    {"kind": "ops_burn", ...}     per-channel SLO burn rate (needs
+                                  --slo-rounds + spooled latency
+                                  windows)
+
+``--follow`` tails a RUNNING soak's spool (+ journal): re-read every
+``--interval`` seconds (torn trailing lines from the live writer are
+skipped — the spool reader's contract), render the status frame with a
+live rounds/s rate (spooled-round progress over wall time), and repeat
+``--polls`` times (0 = until interrupted).
+
+``--expose HOST:PORT`` additionally serves the status over a TCP line
+protocol (the bridge socket server's concurrency model — ARCHITECTURE
+"The live bridge": thread per connection, one lock, localhost rigs):
+a client sends ``status\\n`` and receives the current status frame as
+one JSON line; ``spans\\n`` the span list; ``quit\\n`` closes.  This
+is the opt-in exposition a serving front end scrapes.
+
+Usage::
+
+    python tools/ops_watch.py SPOOL [JOURNAL ...] [--follow]
+        [--interval S] [--polls N] [--slo-rounds N] [--budget-frac F]
+        [--crowd-x1000 N] [--expose HOST:PORT]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+USAGE = ("usage: ops_watch.py SPOOL [JOURNAL ...] [--follow] "
+         "[--interval S] [--polls N] [--slo-rounds N] "
+         "[--budget-frac F] [--crowd-x1000 N] [--expose HOST:PORT]")
+
+
+def _merge(dst, src) -> None:
+    """Merge journal ``src`` into ``dst`` (the from_jsonl contract:
+    entry dedup first-copy-wins, coverage min-merged, bounds widened)."""
+    for s, lo in src.streams.items():
+        dst.cover(s, lo)
+    if src.start is not None:
+        dst.start = src.start if dst.start is None \
+            else min(dst.start, src.start)
+    if src.end is not None:
+        dst.end = src.end if dst.end is None else max(dst.end, src.end)
+    for e in src.entries:
+        dst.append(e.round, e.stream, e.event, severity=e.severity,
+                   channel=e.channel, cause_id=e.cause_id,
+                   measurements=e.measurements, metadata=e.metadata)
+
+
+def _burn_rows(records):
+    """Spooled latency windows -> ``latency.breach_accounting`` rows
+    ``(round, k, p99_by_channel)``."""
+    from partisan_tpu import spool as spool_mod
+
+    return [(int(r["round"]), int(r["measurements"].get("k", 0)),
+             r["measurements"].get("p99") or {})
+            for r in records if r["event"] == spool_mod.EV_LATENCY]
+
+
+def burn_rates(records, *, slo_rounds: int,
+               budget_frac: float = 0.25) -> list[dict]:
+    """Per-channel SLO burn over the spool's windowed-p99 series — the
+    same budget math as ``opslog.error_budgets``, fed straight from
+    spool records so a chunk-row journal isn't required."""
+    from partisan_tpu import latency as latency_mod
+
+    acct = latency_mod.breach_accounting(_burn_rows(records),
+                                         slo_rounds=slo_rounds)
+    out = []
+    for ch in sorted(acct):
+        series = acct[ch]
+        total = sum(k for _, k, _ in series)
+        budget = budget_frac * total
+        burned = sum(k for _, k, b in series if b)
+        out.append({"kind": "ops_burn", "channel": ch,
+                    "rounds": total, "breach_rounds": burned,
+                    "burn": round(burned / budget, 4) if budget
+                    else (0.0 if not burned else float("inf"))})
+    return out
+
+
+def build_status(spool_path: str, journal_paths, *,
+                 slo_rounds: int | None = None,
+                 budget_frac: float = 0.25,
+                 crowd_x1000: int | None = None) -> dict:
+    """One console frame: spool progress, incident-span state,
+    per-channel burn, rounds/s — everything derived from the on-disk
+    spool + journal artifacts (live-tail safe: torn lines skipped)."""
+    from partisan_tpu import opslog, spool as spool_mod
+
+    meta, records = spool_mod.read(spool_path)
+    j = opslog.Journal()
+    for p in journal_paths:
+        _merge(j, opslog.Journal.from_jsonl(p))
+    j = opslog.ingest_spool(spool_path, journal=j,
+                            slo_rounds=slo_rounds,
+                            crowd_x1000=crowd_x1000)
+    matched = opslog.match(j, crowd_x1000=crowd_x1000)
+    hi = max((r["round"] for r in records), default=None)
+    # mean engine-side rounds/s when chunk rows are journaled (the
+    # one-shot view; --follow adds the live spool-progress rate)
+    rates = [e.measurements["rounds_per_s"] for e in j.entries
+             if e.stream == "chunk"
+             and e.measurements.get("rounds_per_s") is not None]
+    status = {
+        "kind": "ops_watch",
+        "spool": spool_path,
+        "records": len(records),
+        "start": meta.get("start"),
+        "round": hi,
+        "planes": meta.get("planes") or [],
+        "streams": sorted(j.streams),
+        "spans": matched["counts"],
+        "rounds_per_s": (round(sum(rates) / len(rates), 3)
+                         if rates else None),
+    }
+    burns = burn_rates(records, slo_rounds=slo_rounds,
+                       budget_frac=budget_frac) if slo_rounds else []
+    return {"status": status, "spans": matched["spans"],
+            "burns": burns}
+
+
+class ExpositionServer:
+    """Line-protocol status exposition (the bridge socket server's
+    lifecycle: ``create_server`` + background accept loop + thread per
+    connection + one lock; socket_server.py).  Commands are newline-
+    terminated ASCII; every reply is one JSON line."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._lock = threading.Lock()
+        self._frame: dict = {"status": {"kind": "ops_watch"},
+                             "spans": [], "burns": []}
+        self._sock = socket.create_server((host, port))
+        self.host, self.port = self._sock.getsockname()
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._accept_thread: threading.Thread | None = None
+        self._stopping = threading.Event()
+
+    def set_frame(self, frame: dict) -> None:
+        with self._lock:
+            self._frame = frame
+
+    # ---- lifecycle (socket_server.py's shape) -------------------------
+    def serve_background(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def close(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # ---- internals ----------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        try:
+            rf = conn.makefile("r", encoding="ascii", errors="replace")
+            for line in rf:
+                cmd = line.strip()
+                if cmd == "quit":
+                    return
+                with self._lock:
+                    frame = self._frame
+                if cmd == "status":
+                    reply = frame["status"]
+                elif cmd == "spans":
+                    reply = {"kind": "ops_spans",
+                             "spans": frame["spans"]}
+                elif cmd == "burns":
+                    reply = {"kind": "ops_burns",
+                             "burns": frame["burns"]}
+                else:
+                    reply = {"kind": "error",
+                             "error": f"unknown command: {cmd}"}
+                conn.sendall((json.dumps(reply) + "\n").encode("ascii"))
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+
+def _print_frame(frame: dict, out=sys.stdout) -> None:
+    for span in frame["spans"]:
+        print(json.dumps(span), file=out)
+    for b in frame["burns"]:
+        print(json.dumps(b), file=out)
+    print(json.dumps(frame["status"]), file=out, flush=True)
+
+
+def main() -> None:
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
+    VALUE_FLAGS = ("--interval", "--polls", "--slo-rounds",
+                   "--budget-frac", "--crowd-x1000", "--expose")
+    argv = sys.argv[1:]
+    args, opts, follow = [], {}, False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in VALUE_FLAGS:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{a} needs a value\n{USAGE}")
+            opts[a] = argv[i + 1]
+            i += 2
+        elif a == "--follow":
+            follow = True
+            i += 1
+        elif a.startswith("--"):
+            raise SystemExit(f"unknown flag {a}\n{USAGE}")
+        else:
+            args.append(a)
+            i += 1
+    if not args:
+        raise SystemExit(USAGE)
+    spool_path, journal_paths = args[0], args[1:]
+    for p in journal_paths:
+        if not os.path.exists(p):
+            raise SystemExit(f"no such journal: {p}")
+    slo = opts.get("--slo-rounds")
+    kw = dict(slo_rounds=int(slo) if slo else None,
+              budget_frac=float(opts.get("--budget-frac", 0.25)),
+              crowd_x1000=(int(opts["--crowd-x1000"])
+                           if "--crowd-x1000" in opts else None))
+    srv = None
+    if "--expose" in opts:
+        host, _, port = opts["--expose"].rpartition(":")
+        srv = ExpositionServer(host or "127.0.0.1", int(port))
+        srv.serve_background()
+        print(json.dumps({"kind": "expose", "host": srv.host,
+                          "port": srv.port}), flush=True)
+
+    if not follow:
+        if not os.path.exists(spool_path):
+            raise SystemExit(f"no such spool: {spool_path}")
+        frame = build_status(spool_path, journal_paths, **kw)
+        if srv is not None:
+            srv.set_frame(frame)
+        _print_frame(frame)
+        if srv is not None:
+            srv.close()
+        return
+
+    interval = float(opts.get("--interval", 2.0))
+    polls = int(opts.get("--polls", 0))
+    prev_round, prev_t = None, None
+    n = 0
+    try:
+        while True:
+            # a --follow console may start BEFORE the soak's first
+            # drain: an absent spool is an empty frame, not an error
+            frame = build_status(spool_path, journal_paths, **kw) \
+                if os.path.exists(spool_path) \
+                else {"status": {"kind": "ops_watch",
+                                 "spool": spool_path, "records": 0,
+                                 "round": None},
+                      "spans": [], "burns": []}
+            now = time.monotonic()
+            cur = frame["status"].get("round")
+            if (prev_round is not None and cur is not None
+                    and now > prev_t):
+                frame["status"]["live_rounds_per_s"] = round(
+                    (cur - prev_round) / (now - prev_t), 3)
+            prev_round, prev_t = cur, now
+            if srv is not None:
+                srv.set_frame(frame)
+            _print_frame(frame)
+            n += 1
+            if polls and n >= polls:
+                break
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if srv is not None:
+            srv.close()
+
+
+if __name__ == "__main__":
+    main()

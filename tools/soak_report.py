@@ -13,7 +13,15 @@ trailing summary::
 
     python tools/soak_report.py [n] [rounds] [--chunk K] [--crash-at R]
                                 [--breach] [--control] [--traffic]
-                                [--elastic] [--ckpt-dir DIR]
+                                [--elastic] [--ckpt-dir DIR] [--spool]
+
+``--spool`` arms the full-horizon telemetry spool (spool.py) on a
+temp file (path announced as a ``{"kind": "spool"}`` line — tail it
+live with ``tools/ops_watch.py --follow``): every chunk boundary
+drains each plane's ring delta, chunk rows carry the measured drain
+cost (``spool_s``), the ``dispatch_wall`` line separates that cost
+from the dispatch gap, and the summary prints the drain-cost column
+(``spool_s`` total + ``spool_chunks``).
 
 ``--crash-at R`` injects a ``JaxRuntimeError`` into the first chunk
 dispatch that would cross R rounds into the soak — off-TPU proof of
@@ -122,6 +130,14 @@ def report(res, out=sys.stdout, channels=None, slo_rounds=None,
                "healthy": res.healthy()}
     if disp:
         summary["gap_share"] = disp["gap_share"]
+    # drain-cost column: total host seconds the telemetry spool's
+    # per-boundary drains took (stamped per chunk row; perfwatch's
+    # decomposition already separates it from the dispatch gap)
+    spool_cost = [row["spool_s"] for row in res.chunks
+                  if "spool_s" in row]
+    if spool_cost:
+        summary["spool_s"] = round(sum(spool_cost), 4)
+        summary["spool_chunks"] = len(spool_cost)
     if storm is not None:
         # the incident observatory: injected ground truth fused with
         # every replayed stream, spans matched over the one timeline
@@ -143,7 +159,7 @@ def report(res, out=sys.stdout, channels=None, slo_rounds=None,
 
 USAGE = ("usage: soak_report.py [n] [rounds] [--chunk K] [--crash-at R] "
          "[--breach] [--control] [--traffic] [--elastic] "
-         "[--ckpt-dir DIR]")
+         "[--ckpt-dir DIR] [--spool]")
 
 
 def main() -> None:
@@ -169,7 +185,7 @@ def main() -> None:
     VALUE_FLAGS = ("--chunk", "--crash-at", "--ckpt-dir")
     argv = sys.argv[1:]
     args, opts, breach, control, traffic = [], {}, False, False, False
-    elastic = False
+    elastic = spool_on = False
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -189,6 +205,9 @@ def main() -> None:
             i += 1
         elif a == "--elastic":
             elastic = True
+            i += 1
+        elif a == "--spool":
+            spool_on = True
             i += 1
         elif a.startswith("--"):
             raise SystemExit(f"unknown flag {a}\n{USAGE}")
@@ -315,6 +334,18 @@ def main() -> None:
     if breach:
         dump_dir = tempfile.mkdtemp(prefix="soak_dumps_")
         print(json.dumps({"kind": "dump_dir", "path": dump_dir}))
+    # --spool: arm the full-horizon telemetry spool on a temp file
+    # (announced so ops_watch can one-shot or --follow it live)
+    sp = None
+    if spool_on:
+        from partisan_tpu import spool as spool_mod
+
+        fd, sp_path = tempfile.mkstemp(prefix="soak_",
+                                       suffix=".spool.jsonl")
+        os.close(fd)
+        os.unlink(sp_path)      # Spool appends; start from empty
+        sp = spool_mod.Spool(sp_path)
+        print(json.dumps({"kind": "spool", "path": sp_path}))
     warm = [cl]      # first _cluster() reuses the booted instance
     eng = soak.Soak(
         make_cluster=lambda: warm.pop() if warm else mk(),
@@ -323,8 +354,11 @@ def main() -> None:
         cfg=soak.SoakConfig(chunk_fixed=chunk, checkpoint_dir=ckpt_dir,
                             cooldown_s=0.0, dump_dir=dump_dir,
                             poll_latency=traffic),
-        sleep_fn=lambda s: None)
+        sleep_fn=lambda s: None, spool=sp)
     res = eng.run(st, rounds=rounds)
+    if sp is not None:
+        sp.close()
+        print(json.dumps({"kind": "spool_stats", **sp.stats()}))
     report(res, channels=tuple(c.name for c in cl.cfg.channels),
            slo_rounds=4 if traffic else None, storm=storm)
 
