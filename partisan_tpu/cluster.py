@@ -37,6 +37,7 @@ from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
 from partisan_tpu import provenance as provenance_mod
+from partisan_tpu import watchdog as watchdog_mod
 from partisan_tpu import workload as workload_mod
 from partisan_tpu.comm import LocalComm
 from partisan_tpu.config import Config
@@ -130,6 +131,18 @@ class ClusterState(NamedTuple):
     #                         AND dropped (CAUSE_INGRESS) so the
     #                         conservation law survives admission
     #                         control.
+    watchdog: Any = ()      # watchdog.WatchdogState in-scan invariant
+    #                         plane (or () when Config.watchdog is off
+    #                         — zero cost).  Evaluated at the END of
+    #                         the round over the freshly committed
+    #                         ledger deltas and plane words: one packed
+    #                         violation word per round in a ring, a
+    #                         latched first_breach_rnd (min-reduced),
+    #                         and the trip latch that freezes the
+    #                         flight recorder at a breach — so a fused
+    #                         superstep execution detects invariant
+    #                         violations at their EXACT round instead
+    #                         of the next chunk boundary.
 
 
 class TraceRound(NamedTuple):
@@ -173,6 +186,7 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         seed = jnp.uint32(cfg.seed) + jnp.asarray(state.salt, jnp.uint32)
     ex = elastic_mod.enabled(cfg)   # static: runtime-resize machinery
     gx = ingress_mod.enabled(cfg)   # static: host→device inject lane
+    wdx = watchdog_mod.enabled(cfg)  # static: in-scan invariant plane
     # Elastic stage FIRST (before any active-prefix mask derives): a
     # pending scale-in deactivation fires here when its drain deadline
     # passes — the only place the round program itself moves the
@@ -543,9 +557,24 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
             # Flight recorder: the same (sent, dropped) pair capture
             # mode returns, written into the carry's K-round ring.
             with jax.named_scope("round.flight"):
-                fstate = latency_mod.record_flight(
-                    cfg, state.flight, rnd=state.rnd, sent=sent_wire,
-                    dropped=fault_dropped)
+                def _flight_write():
+                    return latency_mod.record_flight(
+                        cfg, state.flight, rnd=state.rnd,
+                        sent=sent_wire, dropped=fault_dropped)
+
+                if wdx and cfg.watchdog.trip_flight:
+                    # Trip mode (watchdog.py): once the PREVIOUS
+                    # round's watchdog latched a breach, the ring
+                    # freezes — the breach round itself is the last
+                    # slot written (the latch is set AFTER this write,
+                    # at the end of its round), so the offending wire
+                    # traffic survives to the chunk boundary instead
+                    # of wrapping.
+                    fstate = jax.lax.cond(state.watchdog.tripped > 0,
+                                          lambda: state.flight,
+                                          _flight_write)
+                else:
+                    fstate = _flight_write()
         if lx:
             lat_fault = latency_mod.age_hist(sent, fault_dropped,
                                              state.rnd)
@@ -677,10 +706,21 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
 
     # `dropped` tracks the event lane only: a causal broadcast is one
     # emission with up-to-n deliveries, so it gets its own counter.
+    drop_delta = n_emitted - ev_delivered
+    if cfg.watchdog.inject_round >= 0:
+        # Watchdog test plane: deterministic ledger corruption at
+        # exactly one round — a pure function of the carried round
+        # counter, so it replays identically across chunking,
+        # superstep, checkpoint resume and sharding, and fires
+        # regardless of watchdog.enabled (the plane-off baseline must
+        # corrupt the same books the host invariants audit).
+        drop_delta = drop_delta + jnp.where(
+            state.rnd == cfg.watchdog.inject_round,
+            jnp.int32(cfg.watchdog.inject_amount), jnp.int32(0))
     stats = Stats(
         emitted=state.stats.emitted + n_emitted,
         delivered=state.stats.delivered + ev_delivered + causal_delivered,
-        dropped=state.stats.dropped + (n_emitted - ev_delivered),
+        dropped=state.stats.dropped + drop_delta,
     )
     mets = state.metrics
     if mx:
@@ -754,6 +794,22 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
         ctrl = control_mod.update(cfg, state.control, rnd=state.rnd,
                                   pv=pv, health=hstate,
                                   chmax=ctrl_chmax)
+    wstate = state.watchdog
+    if wdx:
+        # Invariant watchdog (watchdog.py): fold this round's freshly
+        # committed ledger deltas + plane words into one violation
+        # word and latch the first breach round.  Runs LAST so it
+        # audits exactly the values the carry commits — including any
+        # injected corruption in drop_delta above.  Every input is
+        # already cross-shard reduced, so the plane replicates.
+        with jax.named_scope("round.watchdog"):
+            wstate = watchdog_mod.update(
+                cfg, comm, state.watchdog, rnd=state.rnd,
+                emitted=n_emitted,
+                delivered=ev_delivered + causal_delivered,
+                dropped=drop_delta, drops=drops_vec,
+                digest=hstate.digest if hx else None,
+                age_hwm=lt.age_hwm if lx else None)
     out = ClusterState(rnd=state.rnd + 1, faults=state.faults,
                        inbox=inbox, manager=mstate, model=dstate_model,
                        delivery=dstate, stats=stats, interpose=istate,
@@ -761,7 +817,8 @@ def round_body(cfg: Config, manager: Any, model: Any, comm: Any,
                        flight=fstate, n_active=n_act,
                        health=hstate, provenance=pv, control=ctrl,
                        traffic=tstate, salt=state.salt,
-                       elastic=estate, ingress=gstate)
+                       elastic=estate, ingress=gstate,
+                       watchdog=wstate)
     if capture:
         return out, TraceRound(rnd=state.rnd, sent=sent_wire,
                                dropped=fault_dropped)
@@ -924,6 +981,8 @@ class Cluster:
                      if elastic_mod.enabled(cfg) else ()),
             ingress=(ingress_mod.init(cfg, comm)
                      if ingress_mod.enabled(cfg) else ()),
+            watchdog=(watchdog_mod.init(cfg)
+                      if watchdog_mod.enabled(cfg) else ()),
         )
 
     def _build_init(self) -> ClusterState:

@@ -309,6 +309,57 @@ class IngressConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class WatchdogConfig:
+    """In-scan invariant watchdog plane (watchdog.py): the invariants
+    soak.py used to re-derive host-side at chunk boundaries, evaluated
+    ON DEVICE at the end of every round and packed into one violation
+    word per round — so a breach inside a fused-superstep execution is
+    attributed to its EXACT round instead of the next host poll, up to
+    ``chunk_cap * superstep`` rounds late (ISSUE 20; the detection half
+    of ROADMAP item 5's production-day gate).
+
+    Checks folded into the word (watchdog.py V_* bits):
+
+    - conservation — this round's emitted − delivered − dropped ledger
+      delta is nonzero (the soak ``conservation`` invariant, per round);
+    - non-negativity — a non-residual drops-taxonomy cause counter went
+      negative (``CAUSE_OTHER`` is a residual that legitimately dips
+      under channel-capacity defer/release churn, so it is exempt);
+    - digest degradation — the health digest is valid but an overlay
+      bit (one-component / no-isolates / min-degree) dropped (only
+      when ``Config.health > 0``);
+    - age bound — a per-channel delivered-age high-water mark exceeded
+      ``age_bound`` (only when ``age_bound > 0``; needs
+      ``Config.latency``).
+
+    The plane is replicated under sharding — every input is an
+    already-reduced plane value, and the ``first_breach_rnd`` latch is
+    min-reduced (``allmin``) — and bit-exact across checkpoint/resume,
+    superstep and pipeline_depth (the latch and ring ride the carry).
+    Off (the default): the ``ClusterState.watchdog`` leaf is ``()`` and
+    no op traces under ``round.watchdog`` — zero cost, bit-identical
+    rounds (lint zero-cost rule + pinned cost budget)."""
+
+    enabled: bool = False
+    ring: int = 64          # violation words kept (ring, slot = rnd % R)
+    trip_flight: bool = False   # freeze the flight-recorder ring from
+    #                             the round AFTER the first breach, so
+    #                             the offending wire traffic survives to
+    #                             the chunk boundary instead of being
+    #                             wrapped over (requires flight_rounds>0)
+    age_bound: int = 0      # >0: arm the per-channel age-HWM breach bit
+    #                         at this bound in rounds (requires latency)
+    # --- test plane: deterministic ledger corruption -------------------
+    inject_round: int = -1  # >= 0: corrupt the stats.dropped ledger by
+    #                         inject_amount at exactly this round —
+    #                         INDEPENDENT of ``enabled`` so the same
+    #                         breach drives both the plane-off
+    #                         (chunk-boundary host detection) baseline
+    #                         and the plane-on exact-round run
+    inject_amount: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class ScampConfig:
     """SCAMP parameters (include/partisan.hrl:240-241)."""
 
@@ -412,6 +463,7 @@ class Config:
     control: ControlConfig = ControlConfig()
     traffic: TrafficConfig = TrafficConfig()
     ingress: IngressConfig = IngressConfig()
+    watchdog: WatchdogConfig = WatchdogConfig()
 
     # --- tensor capacities (sim-specific) ------------------------------
     inbox_cap: int = 32          # queued event messages per node per round
@@ -749,6 +801,37 @@ class Config:
                 raise ValueError(
                     f"ingress.quota must be >= 0 (0 = unlimited), got "
                     f"{self.ingress.quota}")
+        if self.watchdog.enabled:
+            # Every violation-word input is a metrics-plane value (the
+            # drops cause taxonomy + the per-round ledger deltas the
+            # ring reconciles against) — arming the watchdog without it
+            # would silently check nothing.
+            if not self.metrics:
+                raise ValueError(
+                    "watchdog.enabled reads the metrics plane's drop-"
+                    "cause taxonomy — set Config(metrics=True)")
+            if self.watchdog.ring < 1:
+                raise ValueError(
+                    f"watchdog.ring must be >= 1, got "
+                    f"{self.watchdog.ring}")
+            if self.watchdog.trip_flight and self.flight_rounds <= 0:
+                raise ValueError(
+                    "watchdog.trip_flight freezes the flight-recorder "
+                    "ring — set Config(flight_rounds=K)")
+            if self.watchdog.age_bound > 0 and not self.latency:
+                raise ValueError(
+                    "watchdog.age_bound reads the latency plane's "
+                    "per-channel age high-water marks — set "
+                    "Config(latency=True)")
+            if self.watchdog.age_bound < 0:
+                raise ValueError(
+                    f"watchdog.age_bound must be >= 0, got "
+                    f"{self.watchdog.age_bound}")
+        if self.watchdog.inject_round >= 0 \
+                and self.watchdog.inject_amount < 1:
+            raise ValueError(
+                f"watchdog.inject_amount must be >= 1, got "
+                f"{self.watchdog.inject_amount}")
         if self.fleet_width < 0:
             raise ValueError(
                 f"fleet_width must be >= 0, got {self.fleet_width}")
@@ -954,4 +1037,6 @@ class Config:
             d["traffic"] = TrafficConfig(**d["traffic"])
         if "ingress" in d and isinstance(d["ingress"], Mapping):
             d["ingress"] = IngressConfig(**d["ingress"])
+        if "watchdog" in d and isinstance(d["watchdog"], Mapping):
+            d["watchdog"] = WatchdogConfig(**d["watchdog"])
         return cls(**d)

@@ -118,6 +118,19 @@ BUDGETS: dict = {
         "interm_kib": 1586.0,
         "eqns": 3240,
     },
+    # The watchdog-armed round (ISSUE 20): metrics + the in-scan
+    # invariant plane.  Over the metrics-only round (gs 70 / 1625.5
+    # KiB / 3349 eqns at this pin): +2 scatters (the violation-word
+    # ring's slot write and its round-label write) and ~43 eqns of
+    # bit packing, latch min-fold, and trip accumulation — ZERO
+    # intermediate-byte growth, the plane is scalar words plus an
+    # int32[ring] buffer.  OFF is bit-identical to the metrics round
+    # (zero-cost rule keys on round.watchdog).
+    "round/watchdog": {
+        "gather_scatter": 72,
+        "interm_kib": 1625.5,
+        "eqns": 3392,
+    },
     # The vmapped fleet round (ISSUE 14): W=4 members of the plain
     # hyparview+plumtree round batched by fleet.Fleet.  The
     # gather/scatter and eqn counts are the ratchet here — they must

@@ -20,7 +20,8 @@ from __future__ import annotations
 import jax
 
 from partisan_tpu.config import (Config, ControlConfig, IngressConfig,
-                                 PlumtreeConfig, TrafficConfig)
+                                 PlumtreeConfig, TrafficConfig,
+                                 WatchdogConfig)
 from partisan_tpu.lint.core import Program, trace_program
 
 
@@ -54,6 +55,7 @@ def control_full_cfg(n: int = 32, flight: bool = False, **kw) -> Config:
     kw.setdefault("elastic", True)
     kw.setdefault("elastic_ring", 8)
     kw.setdefault("ingress", IngressConfig(enabled=True, slots=4))
+    kw.setdefault("watchdog", WatchdogConfig(enabled=True, ring=8))
     return full_cfg(n, flight=flight, channel_capacity=True,
                     control=ControlConfig(fanout=True, backpressure=True,
                                           healing=True, ring=8), **kw)
@@ -256,6 +258,28 @@ def default_matrix() -> list[Program]:
                        base_cfg(ingress=IngressConfig(enabled=True,
                                                       slots=4)),
                        scan=4),
+        # the in-scan invariant watchdog (ISSUE 20): the plane alone
+        # over the metrics round (its one prerequisite — the drop-cause
+        # taxonomy it audits), cost-pinned; and the SOAK shape — the
+        # watchdog riding the fused-superstep scan with trip mode armed
+        # over the flight ring, which is exactly the exact-round
+        # detection configuration the acceptance run dispatches.  Every
+        # entry above covers the off-state (no round.watchdog scope may
+        # appear there — zero-cost rule).
+        _round_program("round/watchdog",
+                       base_cfg(metrics=True, metrics_ring=16,
+                                watchdog=WatchdogConfig(enabled=True,
+                                                        ring=8))),
+        # (superstep divides the scan length here on purpose: a
+        # remainder arm would trace the flight interleave twice and
+        # the one-interleave budget is per program — the non-dividing
+        # nest shape is "scan/superstep"'s audit, not this one's)
+        _round_program("scan/watchdog-soak",
+                       full_cfg(n=16, flight=True, superstep=4,
+                                watchdog=WatchdogConfig(
+                                    enabled=True, ring=8,
+                                    trip_flight=True)),
+                       scan=8),
         # fused supersteps (ISSUE 18): the nested round scan — outer
         # scan of length-R inner scans plus a same-body remainder —
         # over the everything-on carry, at an R that does NOT divide

@@ -158,6 +158,7 @@ def _planes_of(cfg):
         ("traffic", cfg.traffic.enabled),
         ("elastic", bool(cfg.elastic)),
         ("ingress", cfg.ingress.enabled),
+        ("watchdog", cfg.watchdog.enabled),
     )
 
 

@@ -79,9 +79,9 @@ from partisan_tpu import telemetry
 # recovery/ops tail.  Unknown streams rank between control and ops.
 STREAM_RANK: dict[str, int] = {
     "inject": 0, "chunk": 1, "membership": 2, "channel": 3,
-    "metrics": 4, "latency": 5, "health": 6, "broadcast": 7,
-    "traffic": 8, "control": 9, "elastic": 10, "ingress": 11,
-    "soak": 12, "perf": 13, "spool": 14, "ops": 20,
+    "metrics": 4, "watchdog": 4, "latency": 5, "health": 6,
+    "broadcast": 7, "traffic": 8, "control": 9, "elastic": 10,
+    "ingress": 11, "soak": 12, "perf": 13, "spool": 14, "ops": 20,
 }
 _UNKNOWN_RANK = 15
 
@@ -101,7 +101,7 @@ OPS_EVENTS: dict[str, str] = {         # name -> severity
 _INJECT_SEVERITY = {
     "LinkDrop": "warn", "CrashBatch": "warn", "Partition": "warn",
     "Churn": "warn", "Omission": "warn", "DirectedCut": "warn",
-    "Stragglers": "warn", "SetChurn": "warn",
+    "Stragglers": "warn", "SetChurn": "warn", "BreachInject": "warn",
 }
 
 _EVENT_SEVERITY = {".".join(name): spec.severity
@@ -357,6 +357,18 @@ def from_soak(res, *, storm=None, state=None, channels=None,
                          cause_id=f"{r}:{name}" + (f"#{dup}" if dup
                                                    else ""),
                          measurements=meas, metadata=meta)
+    # The watchdog test plane's configured ledger corruption is
+    # injected ground truth too (cfg-keyed, not storm-keyed): the soak
+    # engine logged its exact round at run entry, so a BreachInject
+    # cause anchors the ledger_breach rule's detect-latency math.
+    for entry in res.log:
+        if entry.get("kind") == "breach_injected":
+            r = int(entry["round"])
+            j.append(r, "inject", "inject.BreachInject",
+                     cause_id=f"{r}:inject.BreachInject",
+                     measurements={"amount": int(entry.get("amount", 0)),
+                                   "armed": int(bool(
+                                       entry.get("armed")))})
 
     # (2) chunk rows — execution evidence (timing in measurements,
     # polls/digests in metadata).
@@ -414,6 +426,13 @@ def from_soak(res, *, storm=None, state=None, channels=None,
         rounds = [int(r) for r in snap.get("rounds", ()) if int(r) >= 0]
         j.cover("elastic", min(rounds) if rounds else end)
         telemetry.replay_elastic_events(bus, snap)
+    if getattr(state, "watchdog", ()) != ():
+        from partisan_tpu import watchdog as watchdog_mod
+
+        snap = watchdog_mod.snapshot(state.watchdog)
+        rounds = [int(r) for r in snap.get("rounds", ()) if int(r) >= 0]
+        j.cover("watchdog", min(rounds) if rounds else end)
+        telemetry.replay_watchdog_events(bus, snap)
     telemetry.replay_traffic_events(bus, chunks, slo_rounds=slo_rounds,
                                     crowd_x1000=crowd_x1000)
     j.cover("soak", start)
@@ -597,6 +616,29 @@ def ingest_spool(path, *, journal: Journal | None = None,
                                         slo_rounds=slo_rounds)
     if by_event.get(spool_mod.EV_INGRESS):
         j.cover("ingress", cov)
+    recs = by_event.get(spool_mod.EV_WATCHDOG)
+    if recs:
+        # The spool keeps only breach rounds (quiet rounds carry no
+        # signal), so the edge-triggered replay needs the zero rows
+        # back: a gap between spooled rounds was quiet, and one quiet
+        # round after the last breach (when the spool attests a later
+        # round at all) closes the run — the clearing edge the matcher
+        # uses as the ledger_breach recovery marker.
+        j.cover("watchdog", cov)
+        rounds: list[int] = []
+        words: list[int] = []
+        for rec in recs:
+            rd = int(rec["round"])
+            if rounds and rd > rounds[-1] + 1:
+                rounds.append(rounds[-1] + 1)
+                words.append(0)
+            rounds.append(rd)
+            words.append(int(rec["measurements"]["word"]))
+        if rounds and rounds[-1] < hi:
+            rounds.append(rounds[-1] + 1)
+            words.append(0)
+        telemetry.replay_watchdog_events(
+            bus, {"rounds": rounds, "words": words, "tripped": 0})
     bus.detach("opslog-spool")
 
     # synthesized ops markers — the same falling-edge rule as
@@ -723,6 +765,18 @@ RULES: tuple[Rule, ...] = (
          detect=("partisan.elastic.scale_in",),
          recover=("partisan.elastic.scale_in",),
          requires=("elastic",)),
+    # The watchdog's injected ledger corruption: detected by the
+    # in-scan plane at the EXACT breach round (the breach_detected
+    # replay, or the soak engine's round-exact latch report) — with
+    # the plane off, only the host-side invariant_breach at the chunk
+    # boundary remains, which is precisely the detect-latency gap the
+    # plane exists to close.  Recovery is the violation word's
+    # clearing edge (the per-round checks going quiet again).
+    Rule("ledger_breach", cause="inject.BreachInject",
+         detect=("partisan.watchdog.breach_detected",
+                 "partisan.soak.invariant_breach"),
+         recover=("partisan.watchdog.breach_cleared",),
+         requires=("watchdog", "soak")),
 )
 
 
@@ -869,6 +923,32 @@ def match(journal: Journal, rules: tuple = RULES, *,
     counts["orphans"] = len(orphans)
     spans.sort(key=lambda s: (s["cause_round"], s["rule"]))
     return {"spans": spans, "orphans": orphans, "counts": counts}
+
+
+# ---------------------------------------------------------------------------
+# Watchdog breach state
+# ---------------------------------------------------------------------------
+
+def watchdog_summary(journal: Journal) -> dict:
+    """Breach state from the journal's watchdog stream (the in-scan
+    invariant plane, watchdog.py): armed?, breach count, first breach
+    round (the device latch's exact round — never a chunk boundary),
+    trip state.  ``armed`` keys on stream coverage so a quiet armed
+    run still reports it is being watched; the ops tools print this
+    as their ``watchdog`` status line."""
+    detected = [e for e in journal.entries
+                if e.stream == "watchdog"
+                and e.event.endswith("breach_detected")]
+    tripped = any(e.stream == "watchdog"
+                  and e.event.endswith("flight_tripped")
+                  for e in journal.entries)
+    return {
+        "armed": "watchdog" in journal.streams,
+        "breaches": len(detected),
+        "first_breach_rnd": (min(e.round for e in detected)
+                             if detected else None),
+        "tripped": tripped,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,7 @@ from partisan_tpu import latency as latency_mod
 from partisan_tpu import managers as managers_mod
 from partisan_tpu import metrics as metrics_mod
 from partisan_tpu import provenance as provenance_mod
+from partisan_tpu import watchdog as watchdog_mod
 from partisan_tpu import workload as workload_mod
 from partisan_tpu.cluster import ClusterState, Stats, round_body, run_until
 from partisan_tpu.comm import LocalComm
@@ -333,6 +334,12 @@ class ShardedCluster:
                          dst=shard, channel=shard, payload=shard,
                          release=shard, shed_pend=repl,
                          shed_total=repl, injected=repl)),
+            # Watchdog invariant plane: every input is an already-
+            # reduced plane value and the first-breach latch min-
+            # reduces its candidate, so the whole leaf is identical on
+            # every shard — replicated like the metrics ring it sits
+            # beside.
+            watchdog=spec_like(state.watchdog, repl),
         )
 
     # ---- state construction ------------------------------------------
@@ -379,6 +386,8 @@ class ShardedCluster:
                      if elastic_mod.enabled(cfg) else ()),
             ingress=(ingress_mod.init(cfg, self.host_comm)
                      if ingress_mod.enabled(cfg) else ()),
+            watchdog=(watchdog_mod.init(cfg)
+                      if watchdog_mod.enabled(cfg) else ()),
         )
         if latency_mod.flight_enabled(cfg):
             # Wire-stack shape discovery by abstract trace (see

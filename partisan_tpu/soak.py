@@ -80,7 +80,14 @@ digest's one-component bit) run at every boundary; a breach logs a
 (decoded to a replayable trace) plus metrics/latency/health/provenance
 snapshots to ``dump_dir`` — the post-mortem artifacts for "what broke
 at round 50,000".  The health digest is polled per chunk (one int32
-transfer) into the chunk log.
+transfer) into the chunk log.  When the cluster carries the in-scan
+watchdog plane (``Config.watchdog``), the device already evaluated the
+conservation/digest invariants at EVERY round inside the scan — the
+engine polls the latched verdict per chunk (three scalars) instead of
+re-deriving the same checks in numpy, and a breach is reported at its
+exact ``first_breach_rnd``, not the chunk boundary.  The delegated
+host re-checks stay available as a cross-check mode
+(``PARTISAN_TEST_FULL=1`` runs both).
 
 Everything the engine does host-side lands in ``SoakResult.log`` as
 self-describing dicts; ``telemetry.replay_soak_events`` turns them into
@@ -364,6 +371,16 @@ class Storm:
 # ---------------------------------------------------------------------------
 # Invariants
 # ---------------------------------------------------------------------------
+
+# Host-side checks the in-scan watchdog plane subsumes (watchdog.py
+# evaluates the same laws at EVERY round, device-resident): when the
+# plane is armed these skip at boundaries — the device verdict is
+# strictly stronger (round-exact, superstep-interior) — unless
+# PARTISAN_TEST_FULL=1 re-enables them as a cross-check of the plane
+# itself.
+WATCHDOG_DELEGATED = frozenset(
+    {"conservation", "flow_conservation", "digest_one_component"})
+
 
 @dataclasses.dataclass(frozen=True)
 class Invariant:
@@ -671,7 +688,14 @@ class Soak:
 
     def _check_invariants(self, state, rnd: int, log: list) -> int:
         breaches = 0
+        armed = getattr(state, "watchdog", ()) != ()
+        cross = bool(os.environ.get("PARTISAN_TEST_FULL"))
         for inv in self.invariants:
+            if armed and not cross and inv.name in WATCHDOG_DELEGATED:
+                # The device plane evaluated this law at every round
+                # inside the scan — the latched verdict below covers
+                # it, round-exactly.  PARTISAN_TEST_FULL=1 runs both.
+                continue
             ok, info = inv.check(self._cluster(), state)
             if ok or (rnd, inv.name) in self._seen_breaches:
                 continue
@@ -680,7 +704,39 @@ class Soak:
             self._log_event(log, "invariant_breach", round=rnd,
                             invariant=inv.name, info=info, dumps=dumps)
             breaches += 1
+        if armed:
+            breaches += self._watchdog_verdict(state, rnd, log)
         return breaches
+
+    def _watchdog_verdict(self, state, rnd: int, log: list) -> int:
+        """Poll the in-scan plane's latch (three scalar transfers) and
+        report a breach at its EXACT first_breach_rnd — superstep-
+        interior rounds included — instead of the boundary round the
+        host checks would have blamed."""
+        from partisan_tpu import watchdog as watchdog_mod
+
+        verdict = watchdog_mod.poll(state.watchdog)
+        n = verdict["breaches"]
+        if isinstance(n, list):   # fleet state: any member's latch
+            fired = any(b > 0 for b in n)
+            firsts = [f for f in verdict["first_breach_rnd"] if f >= 0]
+            first = min(firsts) if firsts else -1
+        else:
+            fired = n > 0
+            first = verdict["first_breach_rnd"]
+        if not fired or (first, "watchdog") in self._seen_breaches:
+            return 0
+        self._seen_breaches.add((first, "watchdog"))
+        info = dict(verdict)
+        if not isinstance(n, list):
+            # decoded ring rows for the post-mortem (which checks
+            # fired, per breach round still in the ring)
+            info["rows"] = watchdog_mod.rows(
+                watchdog_mod.snapshot(state.watchdog))
+        dumps = self._dump_breach(state, first, "watchdog", info)
+        self._log_event(log, "invariant_breach", round=first,
+                        invariant="watchdog", info=info, dumps=dumps)
+        return 1
 
     def _superstep(self) -> int:
         """Rounds fused per scan step by the cluster
@@ -845,6 +901,16 @@ class Soak:
                             superstep=self._superstep(), chunk_cap=cap,
                             lifted=bool(self._cap_lift),
                             **self._cap_info)
+        wd_cfg = getattr(cl.cfg, "watchdog", None)
+        if wd_cfg is not None and wd_cfg.inject_round >= 0 \
+                and start <= wd_cfg.inject_round < until_round:
+            # Ground truth for the detection tests and the opslog's
+            # injection scan: the configured ledger corruption fires
+            # inside this run, at exactly this round.
+            self._log_event(log, "breach_injected",
+                            round=int(wd_cfg.inject_round),
+                            amount=int(wd_cfg.inject_amount),
+                            armed=bool(wd_cfg.enabled))
 
         while r < until_round:
             # 1. invariant checks on the state entering this boundary
@@ -1119,6 +1185,14 @@ class Soak:
 
                     row["ingress"] = ingress_mod.poll(
                         poll_state.ingress)
+                if getattr(poll_state, "watchdog", ()) != ():
+                    # in-scan invariant verdict (breach count,
+                    # first_breach_rnd, trip latch) — the per-chunk
+                    # series ops_watch's watchdog line reads
+                    from partisan_tpu import watchdog as watchdog_mod
+
+                    row["watchdog"] = watchdog_mod.poll(
+                        poll_state.watchdog)
                 if self.cfg.poll_latency \
                         and getattr(poll_state, "latency", ()) != ():
                     # WINDOWED per-channel p99 (this chunk's deliveries

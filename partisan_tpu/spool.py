@@ -51,7 +51,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any
 
 from partisan_tpu import telemetry
 
@@ -66,6 +65,7 @@ EV_TRAFFIC = ".".join(telemetry.SPOOL_TRAFFIC_ROW)
 EV_ELASTIC = ".".join(telemetry.SPOOL_ELASTIC_RESIZE)
 EV_LATENCY = ".".join(telemetry.SPOOL_LATENCY_WINDOW)
 EV_INGRESS = ".".join(telemetry.SPOOL_INGRESS_LEVEL)
+EV_WATCHDOG = ".".join(telemetry.SPOOL_WATCHDOG_ROW)
 
 # record stream per event — the journal-facing plane names (opslog
 # STREAM_RANK's vocabulary), fixed write order within a drain so the
@@ -81,6 +81,7 @@ EVENT_STREAMS = (
     (EV_ELASTIC, "elastic"),
     (EV_LATENCY, "latency"),
     (EV_INGRESS, "ingress"),
+    (EV_WATCHDOG, "watchdog"),
 )
 STREAM_OF = dict(EVENT_STREAMS)
 
@@ -230,7 +231,7 @@ class Spool:
         """
         planes = []
         for attr in ("metrics", "health", "provenance", "control",
-                     "traffic", "elastic", "ingress"):
+                     "traffic", "elastic", "ingress", "watchdog"):
             if getattr(state, attr, ()) != ():
                 planes.append(attr)
         if p99 is not None:
@@ -334,6 +335,27 @@ class Spool:
                 "injected": lvl["injected"],
                 "shed": lvl["shed"],
             })
+        if getattr(state, "watchdog", ()) != ():
+            from partisan_tpu import watchdog as watchdog_mod
+
+            snap = watchdog_mod.snapshot(state.watchdog)
+            # The watchdog ring advances EVERY round (unlike the
+            # cadenced planes above), so only breach rounds spool —
+            # quiet rounds carry no signal, and an every-round drain
+            # would dominate the file.  The mark still advances over
+            # the whole delta so re-drains stay cheap.
+            mark = self._marks.get(EV_WATCHDOG, -1)
+            fresh = sorted((int(r), i)
+                           for i, r in enumerate(snap["rounds"])
+                           if int(r) > mark)
+            for r, i in fresh:
+                word = int(snap["words"][i])
+                if word:
+                    w += self._emit(EV_WATCHDOG, r, {
+                        "word": word,
+                        **watchdog_mod.decode_word(word)})
+            if fresh:
+                self._marks[EV_WATCHDOG] = max(mark, fresh[-1][0])
         self._fh.flush()
         return {"rows": w, "line": self._lines}
 

@@ -88,6 +88,15 @@ ELASTIC_SCALE_IN = ("partisan", "elastic", "scale_in")
 INGRESS_DRAIN = ("partisan", "ingress", "drain")
 INGRESS_SHED = ("partisan", "ingress", "shed")
 
+# Watchdog-plane events (watchdog.py violation ring -> discrete
+# events): the in-scan invariant plane's breach edges.  Unlike every
+# plane above, the DETECTION already happened on device at the exact
+# round — these replays only surface it, so the opslog ingests them as
+# round-exact detection legs instead of chunk-quantized ones.
+WATCHDOG_BREACH_DETECTED = ("partisan", "watchdog", "breach_detected")
+WATCHDOG_BREACH_CLEARED = ("partisan", "watchdog", "breach_cleared")
+WATCHDOG_FLIGHT_TRIPPED = ("partisan", "watchdog", "flight_tripped")
+
 # Performance-observatory events (perfwatch host-side measurements ->
 # discrete events): the dispatch-wall decomposition of a chunked run,
 # a measured-vs-predicted phase outlier (the VMEM-fusion target list),
@@ -114,6 +123,7 @@ SPOOL_TRAFFIC_ROW = ("partisan", "spool", "traffic", "row")
 SPOOL_ELASTIC_RESIZE = ("partisan", "spool", "elastic", "resize")
 SPOOL_LATENCY_WINDOW = ("partisan", "spool", "latency", "window")
 SPOOL_INGRESS_LEVEL = ("partisan", "spool", "ingress", "level")
+SPOOL_WATCHDOG_ROW = ("partisan", "spool", "watchdog", "row")
 SPOOL_DRAINED = ("partisan", "spool", "drained")
 
 
@@ -191,6 +201,11 @@ EVENTS: dict[tuple, EventSpec] = {spec.name: spec for spec in (
     EventSpec(INGRESS_SHED, "warn",
               ("shed_buffer_full", "shed_invalid", "deferred"),
               ("round",)),
+    EventSpec(WATCHDOG_BREACH_DETECTED, "error", ("word", "delta"),
+              ("round",)),
+    EventSpec(WATCHDOG_BREACH_CLEARED, "info", ("breach_rounds",),
+              ("round",)),
+    EventSpec(WATCHDOG_FLIGHT_TRIPPED, "warn", ("word",), ("round",)),
     EventSpec(PERF_DISPATCH_WALL, "info",
               ("in_execution_s", "gap_s", "gap_share"), ("chunks",)),
     EventSpec(PERF_PHASE_OUTLIER, "warn",
@@ -212,6 +227,7 @@ EVENTS: dict[tuple, EventSpec] = {spec.name: spec for spec in (
     EventSpec(SPOOL_LATENCY_WINDOW, "info", ("k",), ()),
     EventSpec(SPOOL_INGRESS_LEVEL, "info",
               ("staged", "injected", "shed"), ()),
+    EventSpec(SPOOL_WATCHDOG_ROW, "info", ("word",), ()),
     EventSpec(SPOOL_DRAINED, "info", ("rows",), ("round", "line")),
 )}
 
@@ -654,6 +670,51 @@ def replay_soak_events(bus: Bus, log) -> int:
         meta["round"] = int(entry.get("round", -1))
         emit(bus, event, meas, meta)
         n_events += 1
+    return n_events
+
+
+def replay_watchdog_events(bus: Bus, snap: Mapping[str, Any]) -> int:
+    """Replay a watchdog snapshot (``watchdog.snapshot`` — the decoded
+    violation ring plus the scalar latches) as discrete
+    ``partisan.watchdog.*`` bus events — same edge-triggered shape as
+    the plane replays above, with one crucial difference: the
+    detection ROUND is the device's, not the boundary's, so the opslog
+    files these as round-exact detection legs.
+
+    - ``breach_detected`` — the first round of a nonzero-word run
+      (measurements carry the packed word and its conservation delta),
+    - ``breach_cleared`` — the first zero-word round after a run
+      (measurements carry the run's length in rounds),
+    - ``flight_tripped`` — once, at the first breach still in the
+      ring, when the snapshot's trip latch is set (the flight recorder
+      froze there — watchdog.py trip semantics).
+
+    Returns the number of events emitted."""
+    from partisan_tpu import watchdog as watchdog_mod
+
+    n_events = 0
+    hot = False
+    hot_start = 0
+    trip_pending = bool(snap.get("tripped"))
+    for r, w in zip(snap["rounds"], snap["words"]):
+        r, w = int(r), int(w)
+        if w and not hot:
+            emit(bus, WATCHDOG_BREACH_DETECTED,
+                 {"word": w,
+                  "delta": watchdog_mod.decode_word(w)["delta"]},
+                 {"round": r})
+            n_events += 1
+            hot_start = r
+            if trip_pending:
+                emit(bus, WATCHDOG_FLIGHT_TRIPPED, {"word": w},
+                     {"round": r})
+                n_events += 1
+                trip_pending = False
+        elif not w and hot:
+            emit(bus, WATCHDOG_BREACH_CLEARED,
+                 {"breach_rounds": r - hot_start}, {"round": r})
+            n_events += 1
+        hot = bool(w)
     return n_events
 
 

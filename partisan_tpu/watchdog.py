@@ -1,0 +1,219 @@
+"""In-scan invariant watchdog plane: device-resident breach detection
+at the EXACT round it occurs (ISSUE 20; the detection half of ROADMAP
+item 5's production-day gate).
+
+PR 18's fused supersteps made one XLA execution span >1000 rounds, but
+every invariant (the conservation law, health-digest degradation, the
+per-channel age SLO) was still a host-side numpy check at chunk
+boundaries — a mid-execution breach surfaced up to ``chunk_cap *
+superstep`` rounds late, with no round attribution and a flight ring
+that may have wrapped past the faulting rounds.  This plane moves the
+checks INTO ``round_body``: each round's already-reduced plane values
+fold into one packed violation word, ring-buffered beside the metrics
+ring, with a latched ``first_breach_rnd`` and an optional trip mode
+that freezes the flight recorder at the breach so the offending wire
+traffic survives to the chunk boundary (the Filibuster stance —
+detection belongs in the data path, not the poll loop; PAPER.md).
+
+Violation-word layout (int32, one per round)::
+
+    bit 0   V_CONSERVATION  emitted - delivered - dropped != 0
+    bit 1   V_NEGATIVE      a non-residual drops-cause counter < 0
+    bit 2   V_DIGEST        health digest valid but an overlay bit down
+    bit 3   V_AGE           a channel age-HWM exceeded watchdog.age_bound
+    bits 8..23              |conservation delta|, clamped to 0xFFFF
+
+Shared discipline with every other plane (metrics/health/control):
+
+- pure + deterministic — the word is a function of the round's reduced
+  plane values only, so chunked, superstepped, checkpointed and
+  pipelined runs latch the SAME first breach round;
+- replicated under sharding — every input is already allsum/allmax-
+  reduced in round_body, and the first-breach latch min-reduces
+  (``comm.allmin``) its candidate, so all shards carry identical state
+  (``parallel/sharded.py`` replicates every leaf);
+- zero cost when off — the ``ClusterState.watchdog`` leaf is ``()``
+  and no op traces under ``round.watchdog`` (the lint zero-cost rule
+  keys on both — the scope label here is load-bearing);
+- observable — ``poll`` is the per-chunk scalar read soak delegates
+  its host checks to, ``snapshot`` decodes the ring for the spool /
+  replay adapters (``telemetry.replay_watchdog_events``), and the
+  opslog ingests the replayed ``partisan.watchdog.*`` events as
+  round-exact DETECTION legs of incident spans.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from partisan_tpu.config import Config
+
+# Violation-word bits (layout pinned in ARCHITECTURE.md).
+V_CONSERVATION = 1 << 0
+V_NEGATIVE = 1 << 1
+V_DIGEST = 1 << 2
+V_AGE = 1 << 3
+DELTA_SHIFT = 8
+DELTA_MASK = 0xFFFF
+
+# The first-breach latch's "never" value (shared idiom with
+# control.py's _BIG): min-reduce-friendly, far above any round count.
+_BIG = jnp.int32(2**30)
+NEVER = int(2**30)
+
+
+class WatchdogState(NamedTuple):
+    """The watchdog carry leaf — a violation ring plus scalar latches.
+    Everything is an already-reduced (replicated) value."""
+
+    rnd: Array          # int32[R] — ring round labels (-1 = never)
+    word: Array         # int32[R] — packed violation words
+    breaches: Array     # int32 — cumulative count of breach rounds
+    first_breach: Array  # int32 — latched first breach round (_BIG =
+    #                      none yet; min-reduced, checkpoint-exact)
+    tripped: Array      # int32 0/1 — flight-recorder freeze latch
+    #                     (always carried; stays 0 unless trip_flight)
+
+
+def enabled(cfg: Config) -> bool:
+    return cfg.watchdog.enabled
+
+
+def init(cfg: Config) -> WatchdogState:
+    R = cfg.watchdog.ring
+    return WatchdogState(
+        rnd=jnp.full((R,), -1, jnp.int32),
+        word=jnp.zeros((R,), jnp.int32),
+        breaches=jnp.int32(0),
+        first_breach=_BIG,
+        tripped=jnp.int32(0),
+    )
+
+
+def update(cfg: Config, comm, ws: WatchdogState, *, rnd, emitted,
+           delivered, dropped, drops, digest=None,
+           age_hwm=None) -> WatchdogState:
+    """Fold one round's invariant checks into the violation word and
+    ring-write it.  Callers (cluster.round_body) pass this round's
+    DELTAS, already cross-shard reduced: ``emitted``/``delivered``/
+    ``dropped`` are the Stats ledger increments (dropped includes any
+    injected corruption — the watchdog audits the ledger that is
+    actually kept), ``drops`` the metrics cause vector, ``digest`` the
+    freshly written health digest word (None when the plane is off),
+    ``age_hwm`` the latency plane's cumulative per-channel age HWMs
+    (None when off or unarmed)."""
+    from partisan_tpu import metrics as metrics_mod
+
+    delta = emitted - delivered - dropped
+    word = jnp.where(delta != 0, jnp.int32(V_CONSERVATION),
+                     jnp.int32(0))
+    # Non-negativity of the cause taxonomy: CAUSE_OTHER is a residual
+    # that closes the books by construction and legitimately dips
+    # negative under channel-capacity defer/release churn — exempt.
+    neg = jnp.any(drops[: metrics_mod.CAUSE_OTHER] < 0)
+    word = word | jnp.where(neg, jnp.int32(V_NEGATIVE), jnp.int32(0))
+    if digest is not None:
+        from partisan_tpu import health as health_mod
+
+        valid = (digest & health_mod.DIGEST_VALID) != 0
+        degraded = valid & ((digest & health_mod.OVERLAY_BITS)
+                            != health_mod.OVERLAY_BITS)
+        word = word | jnp.where(degraded, jnp.int32(V_DIGEST),
+                                jnp.int32(0))
+    if age_hwm is not None and cfg.watchdog.age_bound > 0:
+        over = jnp.any(age_hwm > jnp.int32(cfg.watchdog.age_bound))
+        word = word | jnp.where(over, jnp.int32(V_AGE), jnp.int32(0))
+    mag = jnp.clip(jnp.abs(delta), 0, DELTA_MASK).astype(jnp.int32)
+    word = word | (mag << DELTA_SHIFT)
+
+    breach = word != 0
+    # The latch min-reduces its candidate: replicated inputs make the
+    # allmin a value-level no-op, but it keeps the reduction discipline
+    # explicit (a future shard-local check slots in without a silent
+    # divergence window).
+    cand = jnp.where(breach, rnd, _BIG)
+    first = jnp.minimum(ws.first_breach, comm.allmin(cand))
+    tripped = ws.tripped
+    if cfg.watchdog.trip_flight:
+        tripped = jnp.maximum(tripped, breach.astype(jnp.int32))
+    slot = jnp.mod(rnd, cfg.watchdog.ring)
+    return WatchdogState(
+        rnd=ws.rnd.at[slot].set(rnd),
+        word=ws.word.at[slot].set(word),
+        breaches=ws.breaches + breach.astype(jnp.int32),
+        first_breach=first,
+        tripped=tripped,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side readers
+# ---------------------------------------------------------------------------
+
+def _latch_round(first_breach):
+    """-1 when the latch never fired, else the breach round (handles
+    the fleet-batched per-member list shape too)."""
+    if isinstance(first_breach, list):
+        return [_latch_round(f) for f in first_breach]
+    return -1 if first_breach >= NEVER else first_breach
+
+
+def poll(ws: WatchdogState) -> dict:
+    """The per-chunk scalar read (one device->host transfer of three
+    scalars): what soak delegates its host-side invariant checks to."""
+    from partisan_tpu.metrics import host_int
+
+    return {
+        "breaches": host_int(ws.breaches),
+        "first_breach_rnd": _latch_round(host_int(ws.first_breach)),
+        "tripped": host_int(ws.tripped),
+    }
+
+
+def decode_word(word: int) -> dict:
+    """One violation word -> its named checks (the layout contract the
+    tools print and ARCHITECTURE.md documents)."""
+    word = int(word)
+    return {
+        "conservation": bool(word & V_CONSERVATION),
+        "negative": bool(word & V_NEGATIVE),
+        "digest": bool(word & V_DIGEST),
+        "age": bool(word & V_AGE),
+        "delta": (word >> DELTA_SHIFT) & DELTA_MASK,
+    }
+
+
+def snapshot(ws: WatchdogState) -> dict:
+    """Decode the ring into round-ordered series (one device->host
+    transfer, AFTER the scan) plus the scalar latches — the spool's
+    drain source and the replay adapter's input."""
+    import numpy as np
+
+    from partisan_tpu.metrics import ring_order
+
+    ws = jax.device_get(ws)
+    rnd = np.asarray(ws.rnd)
+    idx = ring_order(rnd)
+    return {
+        "rounds": rnd[idx].astype(int).tolist(),
+        "words": np.asarray(ws.word)[idx].astype(int).tolist(),
+        "breaches": int(ws.breaches),
+        "first_breach_rnd": _latch_round(int(ws.first_breach)),
+        "tripped": int(ws.tripped),
+    }
+
+
+def rows(snap: dict) -> list[dict]:
+    """Per-round report rows from a snapshot (tools/watchdog_report.py,
+    ops_watch): only rounds whose word is nonzero — quiet rounds carry
+    no information beyond ring coverage."""
+    out = []
+    for r, w in zip(snap["rounds"], snap["words"]):
+        if w:
+            out.append({"round": int(r), "word": int(w),
+                        **decode_word(w)})
+    return out

@@ -24,7 +24,6 @@ to preserve.
 
 import hashlib
 import json
-import os
 
 import jax
 import pytest

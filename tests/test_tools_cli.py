@@ -247,9 +247,10 @@ def test_tools_cli_completeness():
     tools_dir = os.path.join(_REPO, "tools")
     tools = sorted(f for f in os.listdir(tools_dir)
                    if f.endswith(".py"))
-    assert len(tools) >= 15, tools
+    assert len(tools) >= 16, tools
     assert "incident_report.py" in tools
     assert "ops_watch.py" in tools
+    assert "watchdog_report.py" in tools
     assert "soak_report.py" in tools
     assert "jaxlint.py" in tools
     assert "fleet_report.py" in tools
@@ -602,6 +603,87 @@ def test_incident_report_committed_spool_artifact():
     assert rows[-1]["closed"] >= 1 and rows[-1]["unobservable"] == 0
     assert rows[-1]["orphans"] == 0
     assert "spool" in rows[-1]["streams"]
+
+
+def _watchdog_journal_fixture(path, *, breached=True):
+    """A handcrafted watchdog journal: the stream covered from round 0,
+    (``breached``) one conservation breach at round 17 (word 769 =
+    V_CONSERVATION | delta 3 << 8) cleared one round later."""
+    lines = [
+        {"journal_meta": {"streams": {"inject": 0, "watchdog": 0},
+                          "start": 0, "end": 40}},
+    ]
+    if breached:
+        lines += [
+            {"round": 17, "stream": "watchdog",
+             "event": "partisan.watchdog.breach_detected",
+             "measurements": {"word": 769, "delta": 3}},
+            {"round": 18, "stream": "watchdog",
+             "event": "partisan.watchdog.breach_cleared",
+             "measurements": {"breach_rounds": 1}},
+        ]
+    with open(path, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+
+
+def test_watchdog_report_cli_smoke(tmp_path):
+    """Watchdog breach report end-to-end: the breach row decodes the
+    packed violation word at the exact latched round, the summary
+    reconciles, and --gate is an honest verdict in all three shapes
+    (breached fails, clean-armed passes, unarmed fails)."""
+    jp = tmp_path / "wd.jsonl"
+    _watchdog_journal_fixture(jp)
+    out = _run("watchdog_report.py", str(jp))
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    (breach,) = [r for r in rows if r["kind"] == "breach"]
+    assert breach["round"] == 17 and breach["word"] == 769
+    assert breach["conservation"] is True and breach["delta"] == 3
+    assert not (breach["negative"] or breach["digest"] or breach["age"])
+    (cleared,) = [r for r in rows if r["kind"] == "cleared"]
+    assert cleared["round"] == 18
+    summary = rows[-1]
+    assert summary["kind"] == "summary"
+    assert summary["armed"] and summary["breaches"] == 1
+    assert summary["first_breach_rnd"] == 17
+    assert summary["tripped"] is False
+    # --gate: a breach fails, a clean ARMED run passes, unarmed fails
+    assert _run("watchdog_report.py", str(jp),
+                "--gate").returncode == 2
+    clean = tmp_path / "clean.jsonl"
+    _watchdog_journal_fixture(clean, breached=False)
+    assert _run("watchdog_report.py", str(clean),
+                "--gate").returncode == 0
+    unarmed = tmp_path / "unarmed.jsonl"
+    _ops_journal_fixture(unarmed)
+    assert _run("watchdog_report.py", str(unarmed),
+                "--gate").returncode == 2
+    # honest exit codes on argv misuse
+    assert _run("watchdog_report.py").returncode != 0
+    assert _run("watchdog_report.py", str(jp), "--bogus").returncode != 0
+    assert _run("watchdog_report.py",
+                str(tmp_path / "missing.jsonl")).returncode != 0
+
+
+def test_ops_watch_watchdog_line(tmp_path):
+    """The operator console's watchdog status line: a journal carrying
+    the watchdog stream surfaces armed/breaches/first_breach_rnd in the
+    status frame; a watchdog-free spool reports unarmed."""
+    sp = tmp_path / "run.spool.jsonl"
+    _spool_fixture(sp)
+    jp = tmp_path / "wd.jsonl"
+    _watchdog_journal_fixture(jp)
+    out = _run("ops_watch.py", str(sp), str(jp))
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    status = json.loads(out.stdout.strip().splitlines()[-1])
+    assert status["watchdog"] == {"armed": True, "breaches": 1,
+                                  "first_breach_rnd": 17,
+                                  "tripped": False}
+    out = _run("ops_watch.py", str(sp))
+    status = json.loads(out.stdout.strip().splitlines()[-1])
+    assert status["watchdog"]["armed"] is False
+    assert status["watchdog"]["breaches"] == 0
 
 
 def test_soak_report_spool_smoke():

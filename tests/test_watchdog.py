@@ -1,0 +1,297 @@
+"""In-scan invariant watchdog plane (the ISSUE 20 acceptance suite).
+
+The tentpole claim: a conservation breach injected MID-SUPERSTEP into
+a >1000-round single execution (``Config.superstep=8`` under the
+soak's lifted chunk cap) is detected at EXACTLY its injection round by
+the device-resident plane — latch, soak log, chunk poll and opslog
+detection leg all agree — while the identical plane-off run can only
+blame the chunk boundary, ``rounds - inject`` rounds late.
+
+Around it, the plane's standing contracts: bit-parity when off AND
+when on (the plane observes, never steers — trip mode aside),
+replication under sharding, checkpoint/kill/restore latch replay
+(including a kill BEFORE the injection round: the corruption is pure
+in ``state.rnd``, so the resumed timeline re-injects and re-latches
+identically), the trip mode freezing the flight recorder at the
+breach round, zero traced cost when off, and the edge-triggered
+telemetry replay.
+"""
+
+import jax
+import pytest
+
+import support
+from partisan_tpu import latency as latency_mod
+from partisan_tpu import opslog, soak, telemetry
+from partisan_tpu import watchdog as watchdog_mod
+from partisan_tpu.cluster import Cluster
+from partisan_tpu.config import Config, WatchdogConfig
+from partisan_tpu.trace import Trace
+
+N = 16
+BOOT = 15                  # boot_fullmesh settle rounds (rnd at entry)
+ROUNDS = 1280              # ONE execution, > 1000 rounds (superstep=8)
+INJECT = BOOT + 643        # mid-superstep (658 % 8 == 2), mid-chunk
+AMOUNT = 3
+
+
+def _cfg(**kw):
+    kw.setdefault("metrics", True)
+    kw.setdefault("metrics_ring", 32)
+    return support.fm_config(N, kw.pop("seed", 7), **kw)
+
+
+def _boot(cl):
+    st = support.boot_fullmesh(cl)
+    assert int(jax.device_get(st.rnd)) == BOOT
+    return st
+
+
+@pytest.fixture(scope="module")
+def detection_runs():
+    """The acceptance pair: the same 1280-round seeded soak (superstep
+    8, fixed single chunk) with the plane armed vs absent, the same
+    ledger corruption injected at round 658 in both."""
+    runs = {}
+    for armed in (True, False):
+        cfg = _cfg(superstep=8,
+                   watchdog=WatchdogConfig(
+                       enabled=armed, ring=2048,
+                       inject_round=INJECT, inject_amount=AMOUNT))
+        cl = Cluster(cfg)
+        st = _boot(cl)
+        eng = soak.Soak(make_cluster=lambda cl=cl: cl,
+                        invariants=(soak.conservation(),),
+                        cfg=soak.SoakConfig(chunk_fixed=ROUNDS))
+        runs[armed] = eng.run(st, rounds=ROUNDS)
+    return runs
+
+
+def test_exact_round_detection_inside_fused_superstep(detection_runs):
+    """Acceptance: the armed run reports first_breach_rnd == the
+    injection round from inside a single >1000-round execution; the
+    plane-off run's host check can only blame the chunk boundary."""
+    res = detection_runs[True]
+    # one execution, longer than the unlifted 1000-round cap
+    assert len(res.chunks) == 1 and res.chunks[0]["k"] == ROUNDS
+    (cap,) = [e for e in res.log if e["kind"] == "superstep_cap"]
+    assert cap["lifted"] and cap["chunk_cap"] >= ROUNDS
+    # injected ground truth logged at run entry
+    (inj,) = [e for e in res.log if e["kind"] == "breach_injected"]
+    assert inj["round"] == INJECT and inj["armed"] is True
+    # the latch, the soak verdict and the chunk poll all name the round
+    assert res.breaches == 1
+    (br,) = [e for e in res.log if e["kind"] == "invariant_breach"]
+    assert br["invariant"] == "watchdog"
+    assert br["round"] == INJECT
+    assert br["info"]["rows"] == [
+        {"round": INJECT, "word": (AMOUNT << watchdog_mod.DELTA_SHIFT)
+         | watchdog_mod.V_CONSERVATION, "conservation": True,
+         "negative": False, "digest": False, "age": False,
+         "delta": AMOUNT}]
+    verdict = watchdog_mod.poll(res.state.watchdog)
+    assert verdict["first_breach_rnd"] == INJECT
+    assert verdict["breaches"] == 1 and verdict["tripped"] == 0
+    assert res.chunks[0]["watchdog"] == verdict
+
+    # the plane-off run detects the same corruption via the delegated
+    # host conservation check — at the boundary, 637 rounds late
+    off = detection_runs[False]
+    assert off.breaches >= 1
+    (inj,) = [e for e in off.log if e["kind"] == "breach_injected"]
+    assert inj["armed"] is False
+    offs = [e for e in off.log if e["kind"] == "invariant_breach"]
+    assert all(e["invariant"] == "conservation" for e in offs)
+    boundary = min(e["round"] for e in offs)
+    assert boundary == BOOT + ROUNDS                # the chunk boundary
+    assert boundary - INJECT == ROUNDS - 643        # 637 rounds late
+
+
+def test_opslog_detection_leg_uses_watchdog_round(detection_runs):
+    """The incident span: armed, the ledger_breach detection leg is
+    the watchdog's round (latency 0, cleared one round later); off,
+    the only detect candidate is the boundary-round host breach."""
+    j = opslog.from_soak(detection_runs[True])
+    assert "watchdog" in j.streams
+    spans = {s["rule"]: s for s in opslog.match(j)["spans"]}
+    span = spans["ledger_breach"]
+    assert span["status"] == "closed"
+    assert span["cause_round"] == INJECT
+    assert span["detect_event"] == "partisan.watchdog.breach_detected"
+    assert span["detect_latency"] == 0              # round-exact
+    assert span["recover_latency"] == 1             # cleared at +1
+
+    j_off = opslog.from_soak(detection_runs[False])
+    assert "watchdog" not in j_off.streams
+    spans = {s["rule"]: s for s in opslog.match(j_off)["spans"]}
+    span = spans["ledger_breach"]
+    assert span["detect_event"] == "partisan.soak.invariant_breach"
+    assert span["detect_latency"] == ROUNDS - 643   # boundary-late
+
+
+def test_event_replay_edges(detection_runs):
+    """replay_watchdog_events over the final ring: one detected edge
+    at the injection round (word + delta), one cleared edge one round
+    later, nothing else — and ops_watch's status line agrees."""
+    snap = watchdog_mod.snapshot(detection_runs[True].state.watchdog)
+    bus, rec = telemetry.Bus(), telemetry.Recorder()
+    bus.attach("rec", ("partisan", "watchdog"), rec)
+    n = telemetry.replay_watchdog_events(bus, snap)
+    assert n == 2
+    ((_, meas, meta),) = rec.of(telemetry.WATCHDOG_BREACH_DETECTED)
+    assert meta["round"] == INJECT
+    assert meas["delta"] == AMOUNT
+    assert meas["word"] & watchdog_mod.V_CONSERVATION
+    ((_, meas, meta),) = rec.of(telemetry.WATCHDOG_BREACH_CLEARED)
+    assert meta["round"] == INJECT + 1
+    assert meas["breach_rounds"] == 1
+    assert not rec.of(telemetry.WATCHDOG_FLIGHT_TRIPPED)
+    wd = opslog.watchdog_summary(opslog.from_soak(detection_runs[True]))
+    assert wd == {"armed": True, "breaches": 1,
+                  "first_breach_rnd": INJECT, "tripped": False}
+
+
+def test_plane_off_and_on_bit_parity():
+    """Off: the carry leaf is () and the run is bit-identical to a
+    config without the plane.  On (no trip): every NON-watchdog leaf
+    is still bit-identical — the plane observes, it never steers."""
+    outs = {}
+    for key, wd in (("absent", WatchdogConfig()),
+                    ("off", WatchdogConfig(enabled=False, ring=8)),
+                    ("on", WatchdogConfig(enabled=True, ring=8))):
+        cl = Cluster(_cfg(watchdog=wd))
+        st = cl.steps(_boot(cl), 40)
+        outs[key] = st
+    assert outs["absent"].watchdog == () and outs["off"].watchdog == ()
+    support.assert_states_bitidentical(outs["absent"], outs["off"],
+                                       "watchdog-off")
+    assert outs["on"].watchdog != ()
+    support.assert_states_bitidentical(
+        outs["absent"], outs["on"]._replace(watchdog=()), "watchdog-on")
+    assert watchdog_mod.poll(outs["on"].watchdog) == {
+        "breaches": 0, "first_breach_rnd": -1, "tripped": 0}
+
+
+def test_sharded_parity(mesh8):
+    """Replication: the sharded round's watchdog leaf — ring, latch
+    and trip word — is bit-identical to the single-device run's, with
+    the injected breach latched at the same round on every shard."""
+    from partisan_tpu.parallel import ShardedCluster
+
+    cfg = _cfg(seed=21,
+               watchdog=WatchdogConfig(enabled=True, ring=16,
+                                       inject_round=BOOT + 20,
+                                       inject_amount=2))
+    local = Cluster(cfg)
+    st_l = local.steps(_boot(local), 40)
+    shard = ShardedCluster(cfg, mesh8)
+    st_s = shard.steps(_boot(shard), 40)
+    support.assert_states_bitidentical(st_l, st_s, "sharded-watchdog")
+    assert watchdog_mod.poll(st_s.watchdog) \
+        == watchdog_mod.poll(st_l.watchdog) \
+        == {"breaches": 1, "first_breach_rnd": BOOT + 20, "tripped": 0}
+
+
+def test_kill_restore_replays_latch(tmp_path):
+    """Checkpoint/kill/restore bit-exactness, in the HARD direction:
+    the run is killed BEFORE the injection round, so the fresh-engine
+    resume must re-run the corruption from its checkpoint and latch
+    the same first_breach_rnd the uninterrupted run latched."""
+    inject = BOOT + 250
+    cfg = _cfg(watchdog=WatchdogConfig(enabled=True, ring=64,
+                                       inject_round=inject,
+                                       inject_amount=AMOUNT))
+
+    def mk():
+        return Cluster(cfg)
+
+    cl = mk()
+    st = _boot(cl)
+    ckpt = str(tmp_path / "ckpt")
+    eng_a = soak.Soak(make_cluster=lambda: cl,
+                      cfg=soak.SoakConfig(chunk_fixed=100,
+                                          checkpoint_dir=ckpt))
+    res_a = eng_a.run(st, until_round=BOOT + 200)   # killed pre-inject
+    assert watchdog_mod.poll(res_a.state.watchdog)[
+        "first_breach_rnd"] == -1
+    eng_b = soak.Soak(make_cluster=mk,
+                      cfg=soak.SoakConfig(chunk_fixed=100,
+                                          checkpoint_dir=ckpt))
+    res_b = eng_b.run(resume=True, until_round=BOOT + 400)
+    eng_ref = soak.Soak(make_cluster=lambda: cl,
+                        cfg=soak.SoakConfig(chunk_fixed=100))
+    res_ref = eng_ref.run(st, until_round=BOOT + 400)
+    support.assert_states_bitidentical(res_ref.state, res_b.state,
+                                       "kill-restore")
+    assert watchdog_mod.poll(res_b.state.watchdog) \
+        == watchdog_mod.poll(res_ref.state.watchdog)
+    assert watchdog_mod.poll(res_b.state.watchdog)[
+        "first_breach_rnd"] == inject
+    # both engines filed the round-exact soak verdict
+    for res in (res_b, res_ref):
+        (br,) = [e for e in res.log
+                 if e["kind"] == "invariant_breach"]
+        assert (br["invariant"], br["round"]) == ("watchdog", inject)
+
+
+def test_trip_freezes_flight_ring(tmp_path):
+    """Trip mode: the flight recorder's last written round is the
+    breach round — the offending wire traffic survives arbitrarily far
+    past the breach — and the frozen ring still round-trips through
+    the Trace save/load path."""
+    inject = BOOT + 20
+    cfg = _cfg(flight_rounds=16,
+               watchdog=WatchdogConfig(enabled=True, ring=16,
+                                       trip_flight=True,
+                                       inject_round=inject,
+                                       inject_amount=AMOUNT))
+    cl = Cluster(cfg)
+    st = cl.steps(_boot(cl), 45)                    # 25 rounds past it
+    assert watchdog_mod.poll(st.watchdog) == {
+        "breaches": 1, "first_breach_rnd": inject, "tripped": 1}
+    tr = latency_mod.flight_trace(st.flight)
+    rounds = [int(r) for r in tr.rounds]
+    # breach round written (the trip gate reads the CARRIED latch),
+    # nothing after it — the ring froze 25 rounds ago
+    assert max(rounds) == inject
+    assert rounds == list(range(inject - 15, inject + 1))
+    p = tmp_path / "frozen_flight.npz"
+    tr.save(p)
+    assert Trace.load(p).matches(tr)
+    # without trip, the same config's ring holds the LAST 16 rounds
+    cfg2 = _cfg(flight_rounds=16,
+                watchdog=WatchdogConfig(enabled=True, ring=16,
+                                        inject_round=inject,
+                                        inject_amount=AMOUNT))
+    cl2 = Cluster(cfg2)
+    st2 = cl2.steps(_boot(cl2), 45)
+    assert int(max(latency_mod.flight_trace(st2.flight).rounds)) \
+        == BOOT + 45 - 1
+
+
+def test_zero_cost_when_off_and_clean_when_on():
+    """The scan lint: no round.watchdog scope and an empty carry leaf
+    when off; the armed program (scope REQUIRED by the zero-cost
+    rule's on-plane check) traces clean too."""
+    for wd in (WatchdogConfig(),
+               WatchdogConfig(enabled=True, ring=8)):
+        cl = Cluster(_cfg(watchdog=wd))
+        support.assert_scan_lint_clean(cl, _boot(cl), 6)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        Config(n_nodes=8, watchdog=WatchdogConfig(enabled=True))
+    with pytest.raises(ValueError):
+        Config(n_nodes=8, metrics=True,
+               watchdog=WatchdogConfig(enabled=True, ring=0))
+    with pytest.raises(ValueError):
+        Config(n_nodes=8, metrics=True,
+               watchdog=WatchdogConfig(enabled=True, trip_flight=True))
+    with pytest.raises(ValueError):
+        Config(n_nodes=8, metrics=True,
+               watchdog=WatchdogConfig(enabled=True, age_bound=5))
+    with pytest.raises(ValueError):
+        Config(n_nodes=8, metrics=True,
+               watchdog=WatchdogConfig(enabled=True, inject_round=3,
+                                       inject_amount=0))

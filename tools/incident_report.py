@@ -8,11 +8,16 @@ fault paired with its detection, reaction, and recovery, with measured
 round-latencies for each leg), accounts the per-channel SLO error
 budgets (``opslog.error_budgets``), and prints::
 
-    {"kind": "ops_span",   ...}   one per matched incident
-    {"kind": "ops_orphan", ...}   reactions no span claimed
-    {"kind": "ops_budget", ...}   one per polled channel
-    {"kind": "ops_gate",   ...}   the verdict (always printed)
-    {"kind": "summary",    ...}   last line, always
+    {"kind": "ops_span",     ...}   one per matched incident
+    {"kind": "ops_orphan",   ...}   reactions no span claimed
+    {"kind": "ops_watchdog", ...}   in-scan invariant breach state
+                                    (when the journal carries the
+                                    watchdog stream: armed, breach
+                                    count, exact first breach round,
+                                    trip state)
+    {"kind": "ops_budget",   ...}   one per polled channel
+    {"kind": "ops_gate",     ...}   the verdict (always printed)
+    {"kind": "summary",      ...}   last line, always
 
 Usage::
 
@@ -97,6 +102,9 @@ def main() -> None:
         print(json.dumps(span))
     for orphan in matched["orphans"]:
         print(json.dumps(orphan))
+    if "watchdog" in journal.streams:
+        print(json.dumps({"kind": "ops_watchdog",
+                          **opslog.watchdog_summary(journal)}))
     budgets = None
     slo = opts.get("--slo-rounds")
     if slo is not None:

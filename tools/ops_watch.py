@@ -21,8 +21,15 @@ live rounds/s rate (spooled-round progress over wall time), and repeat
 protocol (the bridge socket server's concurrency model — ARCHITECTURE
 "The live bridge": thread per connection, one lock, localhost rigs):
 a client sends ``status\\n`` and receives the current status frame as
-one JSON line; ``spans\\n`` the span list; ``quit\\n`` closes.  This
-is the opt-in exposition a serving front end scrapes.
+one JSON line; ``spans\\n`` the span list; ``watchdog\\n`` the in-scan
+invariant plane's breach state (armed / breach count / first breach
+round / trip); ``quit\\n`` closes.  This is the opt-in exposition a
+serving front end scrapes.
+
+The status frame carries a ``watchdog`` line whenever journal or
+spool attest the watchdog stream: ``{"armed": true, "breaches": N,
+"first_breach_rnd": R, "tripped": false}`` — R is the device latch's
+exact breach round, not a chunk boundary.
 
 Usage::
 
@@ -127,6 +134,7 @@ def build_status(spool_path: str, journal_paths, *,
         "planes": meta.get("planes") or [],
         "streams": sorted(j.streams),
         "spans": matched["counts"],
+        "watchdog": opslog.watchdog_summary(j),
         "rounds_per_s": (round(sum(rates) / len(rates), 3)
                          if rates else None),
     }
@@ -211,6 +219,12 @@ class ExpositionServer:
                 elif cmd == "burns":
                     reply = {"kind": "ops_burns",
                              "burns": frame["burns"]}
+                elif cmd == "watchdog":
+                    reply = {"kind": "ops_watchdog",
+                             **(frame["status"].get("watchdog")
+                                or {"armed": False, "breaches": 0,
+                                    "first_breach_rnd": None,
+                                    "tripped": False})}
                 else:
                     reply = {"kind": "error",
                              "error": f"unknown command: {cmd}"}

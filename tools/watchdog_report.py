@@ -1,0 +1,112 @@
+"""Invariant-watchdog breach report (the ``BENCH_*.json`` idiom: one
+self-describing JSON object per line).
+
+Loads an ops-journal JSON-lines artifact (``opslog.Journal.to_jsonl``)
+and/or a full-horizon telemetry spool (``--spool``,
+``opslog.ingest_spool``), filters the fused journal to the watchdog
+stream — the in-scan invariant plane's round-exact breach evidence
+(watchdog.py: violation words latched INSIDE the fused-superstep scan,
+not at chunk boundaries) — and prints::
+
+    {"kind": "breach",  ...}   one per breach_detected entry: the
+                               exact breach round, the packed
+                               violation word, and its decoded bits
+                               (conservation / negative / digest /
+                               age + the clamped conservation delta)
+    {"kind": "cleared", ...}   one per breach_cleared entry
+    {"kind": "tripped", ...}   one per flight_tripped entry (trip
+                               mode froze the flight ring at the
+                               breach round)
+    {"kind": "summary", ...}   last line, always: armed?, breach
+                               count, first_breach_rnd (the device
+                               latch), trip state
+
+Usage::
+
+    python tools/watchdog_report.py JOURNAL [--spool SPOOL] [--gate]
+
+``--gate`` makes the exit status the verdict: nonzero when the
+watchdog stream attests any breach — the "books stayed closed" CI
+gate for committed soak artifacts.  An artifact with no watchdog
+coverage FAILS the gate too (an unarmed run proves nothing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+USAGE = "usage: watchdog_report.py JOURNAL [--spool SPOOL] [--gate]"
+
+_KINDS = {"breach_detected": "breach", "breach_cleared": "cleared",
+          "flight_tripped": "tripped"}
+
+
+def rows_of(journal) -> list[dict]:
+    """The watchdog stream as report rows, round-ordered."""
+    from partisan_tpu import watchdog as watchdog_mod
+
+    out = []
+    for e in journal.sorted_entries():
+        if e.stream != "watchdog":
+            continue
+        kind = _KINDS.get(e.event.rsplit(".", 1)[-1])
+        if kind is None:
+            continue
+        row = {"kind": kind, "round": e.round, **e.measurements}
+        if "word" in e.measurements:
+            row.update(watchdog_mod.decode_word(
+                int(e.measurements["word"])))
+        out.append(row)
+    return out
+
+
+def main() -> None:
+    if "--help" in sys.argv or "-h" in sys.argv:
+        print(USAGE)
+        print(__doc__.strip())
+        return
+    argv = sys.argv[1:]
+    args, spool_path, do_gate = [], None, False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--spool":
+            if i + 1 >= len(argv):
+                raise SystemExit(f"--spool needs a value\n{USAGE}")
+            spool_path = argv[i + 1]
+            i += 2
+        elif a == "--gate":
+            do_gate = True
+            i += 1
+        elif a.startswith("--"):
+            raise SystemExit(f"unknown flag {a}\n{USAGE}")
+        else:
+            args.append(a)
+            i += 1
+    if len(args) != 1:
+        raise SystemExit(USAGE)
+    path = args[0]
+    if not os.path.exists(path):
+        raise SystemExit(f"no such journal: {path}")
+
+    from partisan_tpu import opslog
+
+    journal = opslog.Journal.from_jsonl(path)
+    if spool_path is not None:
+        if not os.path.exists(spool_path):
+            raise SystemExit(f"no such spool: {spool_path}")
+        journal = opslog.ingest_spool(spool_path, journal=journal)
+    for row in rows_of(journal):
+        print(json.dumps(row))
+    summary = opslog.watchdog_summary(journal)
+    print(json.dumps({"kind": "summary", **summary}))
+    if do_gate and (summary["breaches"] or not summary["armed"]):
+        raise SystemExit(2)
+
+
+if __name__ == "__main__":
+    main()
